@@ -1,0 +1,32 @@
+"""horaedb_tpu — a TPU-native time-series metric engine.
+
+A ground-up rebuild of Apache HoraeDB's new metric engine (the `main`-branch
+rewrite surveyed in SURVEY.md) designed TPU-first:
+
+- Columnar, time-partitioned LSM storage over object storage: every write is a
+  sorted parquet SST; a snapshot+delta manifest is the source of truth and the
+  checkpoint/recovery log (reference: src/columnar_storage).
+- The scan pipeline (predicate filter -> k-way sorted merge -> sequence-based
+  dedup/value-merge -> aggregate) runs as jit-compiled JAX/XLA kernels on
+  device, sharded over a `jax.sharding.Mesh` for multi-chip scale
+  (reference: src/columnar_storage/src/read.rs, re-designed for XLA).
+- Time-window compaction with TTL expiry re-encodes k overlapping SSTs into
+  one via an on-device merge+dedup (reference: src/columnar_storage/src/compaction).
+- Prometheus remote-write ingest via a pooled zero-copy C++ wire parser that
+  emits columnar arrays ready for device transfer (reference: src/remote_write).
+- The VictoriaMetrics-style metric/series/inverted-index tables specified by
+  the reference RFC (docs/rfcs/20240827-metric-engine.md) but left todo!().
+
+Package layout:
+  common/    errors, ReadableDuration/ReadableSize, clock        (ref: src/common)
+  pb/        protobuf types: sst manifest + Prometheus remote-write (ref: src/pb_types)
+  objstore/  object-store abstraction (local FS / in-memory)     (ref: object_store crate)
+  storage/   ColumnarStorage engine: manifest, SSTs, scan, compaction
+  ops/       device kernels: sort/filter/merge/dedup/downsample/aggregate
+  parallel/  device mesh, sharded segment-parallel scan (ICI collectives)
+  ingest/    remote-write parser (C++ native + Python fallback)
+  engine/    metric engine: metrics/series/inverted-index tables
+  server/    HTTP server + config
+"""
+
+__version__ = "0.1.0"

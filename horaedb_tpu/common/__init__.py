@@ -1,0 +1,14 @@
+"""Foundation utilities (reference: src/common/src/lib.rs:22-26)."""
+
+from horaedb_tpu.common.error import HoraeError, ensure, context
+from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
+from horaedb_tpu.common.size_ext import ReadableSize
+
+__all__ = [
+    "HoraeError",
+    "ensure",
+    "context",
+    "ReadableDuration",
+    "ReadableSize",
+    "now_ms",
+]

@@ -1,0 +1,43 @@
+"""Error type for the whole framework.
+
+The reference funnels every failure into a single `Error::Internal(anyhow::Error)`
+with pervasive `.context(...)` chains (src/common/src/error.rs:4-13). The Python
+analog is one exception type plus helpers that mirror `ensure!` / `.context()`.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+
+class HoraeError(Exception):
+    """Single internal error type; message carries the context chain."""
+
+    def __init__(self, msg: str, cause: BaseException | None = None):
+        super().__init__(msg)
+        self.__cause__ = cause
+
+    def __str__(self) -> str:  # render the full context chain like anyhow
+        parts = [self.args[0] if self.args else self.__class__.__name__]
+        cur = self.__cause__
+        while cur is not None:
+            parts.append(str(cur))
+            cur = cur.__cause__
+        return ": ".join(parts)
+
+
+def ensure(cond: bool, msg: str) -> None:
+    """`ensure!` analog (src/columnar_storage/src/macros.rs:18-30)."""
+    if not cond:
+        raise HoraeError(msg)
+
+
+@contextmanager
+def context(msg: str):
+    """`.context(msg)` analog: wrap any raised exception in HoraeError(msg)."""
+    try:
+        yield
+    except HoraeError as e:
+        raise HoraeError(msg, cause=e) from e
+    except Exception as e:  # noqa: BLE001 - deliberate funnel
+        raise HoraeError(msg, cause=e) from e

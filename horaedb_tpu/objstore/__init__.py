@@ -1,0 +1,180 @@
+"""Object-store abstraction — the durability layer and inter-component "network".
+
+The reference's shared medium is the `object_store` crate's put/get/list/delete/
+head API over S3-like storage, with LocalFileSystem as the dev backend
+(SURVEY §5.8; reference: src/columnar_storage/src/types.rs:135, used at
+storage.rs:193,216 and manifest/mod.rs:139-143,301-315). We keep the same
+five-verb contract. All methods are async; LocalStore offloads blocking file IO
+to threads so manifest/compaction loops never block the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+
+from horaedb_tpu.common.error import HoraeError
+
+
+@dataclass(frozen=True)
+class ObjectMeta:
+    """Result of `head` — the subset of metadata the engine uses."""
+
+    path: str
+    size: int
+
+
+class NotFound(HoraeError):
+    """Raised by get/head/delete on a missing object (manifest recovery
+    distinguishes missing-snapshot from corrupt-snapshot, manifest/mod.rs:336-354)."""
+
+
+class ObjectStore(ABC):
+    """put/get/list/delete/head over a flat namespace of `/`-separated keys."""
+
+    @abstractmethod
+    async def put(self, path: str, data: bytes) -> None: ...
+
+    @abstractmethod
+    async def get(self, path: str) -> bytes: ...
+
+    @abstractmethod
+    async def list(self, prefix: str) -> list[ObjectMeta]: ...
+
+    @abstractmethod
+    async def delete(self, path: str) -> None: ...
+
+    @abstractmethod
+    async def head(self, path: str) -> ObjectMeta: ...
+
+    # Local filesystem path for readers that need one (parquet mmap); stores
+    # without local paths return None and callers fall back to `get` bytes.
+    def local_path(self, path: str) -> str | None:
+        return None
+
+
+class MemStore(ObjectStore):
+    """In-memory store for tests (the reference uses tmpdir+LocalFileSystem as
+    its fake backend, storage.rs:394-396; we provide both)."""
+
+    def __init__(self) -> None:
+        self._objects: dict[str, bytes] = {}
+        self._lock = asyncio.Lock()
+
+    async def put(self, path: str, data: bytes) -> None:
+        async with self._lock:
+            self._objects[path] = bytes(data)
+
+    async def get(self, path: str) -> bytes:
+        try:
+            return self._objects[path]
+        except KeyError:
+            raise NotFound(f"object not found: {path}") from None
+
+    async def list(self, prefix: str) -> list[ObjectMeta]:
+        norm = prefix.rstrip("/") + "/" if prefix else ""
+        out = [
+            ObjectMeta(path=k, size=len(v))
+            for k, v in self._objects.items()
+            if k.startswith(norm)
+        ]
+        out.sort(key=lambda m: m.path)
+        return out
+
+    async def delete(self, path: str) -> None:
+        async with self._lock:
+            if self._objects.pop(path, None) is None:
+                raise NotFound(f"object not found: {path}")
+
+    async def head(self, path: str) -> ObjectMeta:
+        try:
+            return ObjectMeta(path=path, size=len(self._objects[path]))
+        except KeyError:
+            raise NotFound(f"object not found: {path}") from None
+
+
+class LocalStore(ObjectStore):
+    """Object store over a local directory (reference: object_store's
+    LocalFileSystem, built in src/server/src/main.rs:122-124)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _fs_path(self, path: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
+        if p != self.root and not p.startswith(self.root + os.sep):
+            raise HoraeError(f"path escapes store root: {path}")
+        return p
+
+    async def put(self, path: str, data: bytes) -> None:
+        def _put() -> None:
+            fs = self._fs_path(path)
+            os.makedirs(os.path.dirname(fs), exist_ok=True)
+            # Atomic replace: write sidecar then rename, so a crashed put never
+            # leaves a truncated snapshot (manifest commit point semantics,
+            # manifest/mod.rs:301-307).
+            tmp = fs + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, fs)
+
+        await asyncio.to_thread(_put)
+
+    async def get(self, path: str) -> bytes:
+        def _get() -> bytes:
+            fs = self._fs_path(path)
+            try:
+                with open(fs, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                raise NotFound(f"object not found: {path}") from None
+
+        return await asyncio.to_thread(_get)
+
+    async def list(self, prefix: str) -> list[ObjectMeta]:
+        def _list() -> list[ObjectMeta]:
+            base = self._fs_path(prefix) if prefix else self.root
+            out: list[ObjectMeta] = []
+            if not os.path.isdir(base):
+                return out
+            for dirpath, _dirnames, filenames in os.walk(base):
+                for name in filenames:
+                    if name.endswith(".tmp"):
+                        continue
+                    fs = os.path.join(dirpath, name)
+                    rel = os.path.relpath(fs, self.root).replace(os.sep, "/")
+                    out.append(ObjectMeta(path=rel, size=os.path.getsize(fs)))
+            out.sort(key=lambda m: m.path)
+            return out
+
+        return await asyncio.to_thread(_list)
+
+    async def delete(self, path: str) -> None:
+        def _delete() -> None:
+            try:
+                os.remove(self._fs_path(path))
+            except FileNotFoundError:
+                raise NotFound(f"object not found: {path}") from None
+
+        await asyncio.to_thread(_delete)
+
+    async def head(self, path: str) -> ObjectMeta:
+        def _head() -> ObjectMeta:
+            try:
+                return ObjectMeta(path=path, size=os.path.getsize(self._fs_path(path)))
+            except FileNotFoundError:
+                raise NotFound(f"object not found: {path}") from None
+
+        return await asyncio.to_thread(_head)
+
+    def local_path(self, path: str) -> str | None:
+        return self._fs_path(path)
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.root, ignore_errors=True)

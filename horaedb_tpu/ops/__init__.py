@@ -1,0 +1,32 @@
+"""Device kernels: the TPU data plane.
+
+This package replaces the reference's DataFusion execution pipeline
+(ParquetExec -> FilterExec -> SortPreservingMergeExec -> MergeExec,
+src/columnar_storage/src/read.rs:429-494) with jit-compiled XLA:
+
+  blocks.py     struct-of-arrays device block format (padded, static shapes)
+  sort.py       multi-column lexicographic sort (XLA sort on composite keys)
+  filter.py     vectorized predicate evaluation -> boolean mask
+  dedup.py      run-boundary detection + last-value (max-seq) group masks
+  merge.py      k-way sorted merge as concat+sort (the XLA-idiomatic shape)
+  aggregate.py  segment reductions: group-by, time-bucket downsample
+
+Everything operates on fixed-size padded blocks with validity masks — XLA
+wants static shapes (SURVEY §7 risk (a)/(e)); dynamic row counts travel as
+scalar `num_valid` operands and padding rows carry +inf sort keys so they sink
+to the tail of every ordering.
+
+Exact dedup/merge semantics need 64-bit keys (ids are u64 hashes, timestamps
+i64), so importing this package enables jax x64. The perf-critical aggregate
+kernels additionally offer dense-i32/f32 fast paths that avoid emulated
+64-bit arithmetic on the MXU-adjacent vector units.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from horaedb_tpu.ops.blocks import Block  # noqa: E402
+from horaedb_tpu.ops import sort, filter as filter_ops, dedup, merge, aggregate  # noqa: E402
+
+__all__ = ["Block", "sort", "filter_ops", "dedup", "merge", "aggregate"]

@@ -1,0 +1,101 @@
+"""Segment reductions: group-by-tag aggregation and time-bucket downsampling.
+
+These are the kernels behind BASELINE configs 1-4 (range-aggregate,
+group-by-tag avg/min/max, 5-minute downsample). The design maps each
+(group, time-bucket) cell to a flat segment index and reduces with XLA
+scatter-adds (`jax.ops.segment_*`) — one pass over the data, no sort needed,
+entirely fusible with the predicate mask from filter.py.
+
+Invalid/padding rows are routed to an out-of-range segment index, which XLA's
+scatter drop-semantics discard for free — no host-side compaction on the
+aggregate path (SURVEY §7 risk (e) resolved by reduction, not masking).
+
+Dense i32 indices + f32 accumulation are deliberate: TPUs emulate 64-bit
+integer lanes, so hot aggregation runs on native-width types. Host code maps
+u64 TSIDs to dense series indices before dispatch (ops/__init__ docstring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked_index(index: jax.Array, valid: jax.Array, num_segments: int) -> jax.Array:
+    """Invalid rows -> index == num_segments (dropped by segment ops)."""
+    return jnp.where(valid, index, num_segments).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def grouped_stats(
+    values: jax.Array,
+    index: jax.Array,
+    valid: jax.Array,
+    num_segments: int,
+) -> dict[str, jax.Array]:
+    """sum / count / min / max / mean per segment, one fused pass.
+
+    Empty segments report count 0, sum 0, min +inf, max -inf, mean NaN.
+    """
+    idx = _masked_index(index, valid, num_segments)
+    ones = valid.astype(values.dtype)
+    s = jax.ops.segment_sum(jnp.where(valid, values, 0), idx, num_segments + 1)
+    c = jax.ops.segment_sum(ones, idx, num_segments + 1)
+    mn = jax.ops.segment_min(jnp.where(valid, values, jnp.inf), idx, num_segments + 1)
+    mx = jax.ops.segment_max(jnp.where(valid, values, -jnp.inf), idx, num_segments + 1)
+    s, c, mn, mx = s[:-1], c[:-1], mn[:-1], mx[:-1]
+    return {"sum": s, "count": c, "min": mn, "max": mx, "mean": s / c}
+
+
+def bucket_of(ts: jax.Array, t0, bucket_ms) -> jax.Array:
+    """Time-bucket index relative to t0. i64-safe, result is i32-dense."""
+    return ((ts - t0) // bucket_ms).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("num_series", "num_buckets"))
+def downsample(
+    ts: jax.Array,
+    series_idx: jax.Array,
+    values: jax.Array,
+    valid: jax.Array,
+    t0,
+    bucket_ms,
+    num_series: int,
+    num_buckets: int,
+) -> dict[str, jax.Array]:
+    """Per-(series, bucket) stats as dense [num_series, num_buckets] grids —
+    the 5m-avg downsample of BASELINE config 4.
+    """
+    bucket = bucket_of(ts, t0, bucket_ms)
+    in_grid = valid & (bucket >= 0) & (bucket < num_buckets) \
+        & (series_idx >= 0) & (series_idx < num_series)
+    flat = series_idx.astype(jnp.int32) * num_buckets + bucket
+    stats = grouped_stats(values, flat, in_grid, num_series * num_buckets)
+    return {k: v.reshape(num_series, num_buckets) for k, v in stats.items()}
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def segment_last_value(
+    values: jax.Array,
+    seq: jax.Array,
+    index: jax.Array,
+    valid: jax.Array,
+    num_segments: int,
+) -> jax.Array:
+    """Value of the max-seq row per segment — dedup-as-reduction for
+    aggregation pipelines that don't need full row materialization.
+    Implemented as an argmax over (seq) per segment via segment_max on a
+    packed (seq, position) key."""
+    n = values.shape[0]
+    idx = _masked_index(index, valid, num_segments)
+    # Two-stage argmax (no packed-key arithmetic: real sequences are ns-clock
+    # file ids ~1.8e18, so seq*n would overflow int64): find each segment's
+    # max seq, then take the latest row achieving it.
+    seq_i = seq.astype(jnp.int64)
+    max_seq = jax.ops.segment_max(jnp.where(valid, seq_i, jnp.iinfo(jnp.int64).min), idx, num_segments + 1)
+    winner = valid & (seq_i == max_seq[idx])
+    pos = jnp.arange(n, dtype=jnp.int64)
+    best_pos = jax.ops.segment_max(jnp.where(winner, pos, -1), idx, num_segments + 1)[:-1]
+    return jnp.where(best_pos >= 0, values[jnp.clip(best_pos, 0, n - 1)], jnp.nan)

@@ -1,0 +1,133 @@
+"""Struct-of-arrays device blocks.
+
+The host↔device interchange format: a `Block` is an ordered mapping of column
+name -> 1-D device array, all the same padded length, plus `num_valid`. This
+is the TPU analog of the reference's Arrow RecordBatch flowing through its
+ExecutionPlan (SURVEY C2: "pk columns + value + seq lane in a struct-of-arrays
+layout in HBM").
+
+Conversion accepts pyarrow RecordBatches with numeric columns; strings/binary
+stay on host (SURVEY §7 risk (b)) — the metric engine's data-plane schema is
+all-numeric by construction (MetricId, TSID, FieldId, Timestamp, Value).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.common.error import HoraeError, ensure
+
+# Default padding granule: big enough to keep XLA recompiles rare across
+# varying batch sizes, small enough not to waste HBM on tiny writes.
+DEFAULT_PAD_MULTIPLE = 8192
+
+_ARROW_TO_NP = {
+    pa.int64(): np.int64,
+    pa.int32(): np.int32,
+    pa.uint64(): np.uint64,
+    pa.uint32(): np.uint32,
+    pa.float64(): np.float64,
+    pa.float32(): np.float32,
+    pa.timestamp("ms"): np.int64,
+}
+
+
+def _pad_len(n: int, multiple: int) -> int:
+    if n == 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def sort_sentinel(dtype) -> np.generic:
+    """Padding key that sorts after every valid key."""
+    dt = np.dtype(dtype)
+    if np.issubdtype(dt, np.floating):
+        return dt.type(np.inf)
+    return np.iinfo(dt).max
+
+
+@dataclass
+class Block:
+    """A padded SoA batch on device."""
+
+    columns: dict[str, jax.Array]
+    num_valid: int
+
+    @property
+    def padded_len(self) -> int:
+        return next(iter(self.columns.values())).shape[0] if self.columns else 0
+
+    @property
+    def names(self) -> list[str]:
+        return list(self.columns.keys())
+
+    def valid_mask(self) -> jax.Array:
+        return jnp.arange(self.padded_len) < self.num_valid
+
+    # -- conversions --------------------------------------------------------
+    @classmethod
+    def from_numpy(
+        cls,
+        arrays: dict[str, np.ndarray],
+        pad_multiple: int = DEFAULT_PAD_MULTIPLE,
+        pad_keys: tuple[str, ...] = (),
+    ) -> "Block":
+        """Pad host arrays to a static length and move them to device.
+
+        Columns named in `pad_keys` get max-value sentinels in the padding so
+        they sort to the tail; everything else pads with zeros.
+        """
+        lengths = {len(a) for a in arrays.values()}
+        ensure(len(lengths) == 1, f"ragged columns: { {k: len(v) for k, v in arrays.items()} }")
+        n = lengths.pop()
+        padded = _pad_len(n, pad_multiple)
+        out: dict[str, jax.Array] = {}
+        for name, arr in arrays.items():
+            if padded != n:
+                fill = sort_sentinel(arr.dtype) if name in pad_keys else arr.dtype.type(0)
+                arr = np.concatenate([arr, np.full(padded - n, fill, dtype=arr.dtype)])
+            out[name] = jnp.asarray(arr)
+        return cls(columns=out, num_valid=n)
+
+    @classmethod
+    def from_arrow(
+        cls,
+        batch: pa.RecordBatch,
+        pad_multiple: int = DEFAULT_PAD_MULTIPLE,
+        pad_keys: tuple[str, ...] = (),
+    ) -> "Block":
+        arrays: dict[str, np.ndarray] = {}
+        for name, col in zip(batch.schema.names, batch.columns):
+            arrays[name] = arrow_column_to_numpy(col)
+        return cls.from_numpy(arrays, pad_multiple=pad_multiple, pad_keys=pad_keys)
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        """Device -> host, truncated back to the valid row count."""
+        return {k: np.asarray(v)[: self.num_valid] for k, v in self.columns.items()}
+
+    def to_arrow(self, schema: pa.Schema | None = None) -> pa.RecordBatch:
+        host = self.to_numpy()
+        if schema is None:
+            return pa.RecordBatch.from_pydict(dict(host))
+        cols = []
+        for f in schema:
+            np_arr = host[f.name]
+            cols.append(pa.array(np_arr, type=f.type) if f.type != pa.timestamp("ms")
+                        else pa.array(np_arr.astype("datetime64[ms]")))
+        return pa.RecordBatch.from_arrays(cols, schema=schema)
+
+
+def arrow_column_to_numpy(col: pa.Array) -> np.ndarray:
+    """Lossless numeric conversion; nulls in numeric storage columns become 0
+    (only `__reserved__` is nullable in the storage schema and it is unused)."""
+    if col.null_count:
+        col = col.fill_null(0)
+    t = col.type
+    if t in _ARROW_TO_NP:
+        return col.to_numpy(zero_copy_only=False).astype(_ARROW_TO_NP[t], copy=False)
+    raise HoraeError(f"unsupported device column type: {t}")

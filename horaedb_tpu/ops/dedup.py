@@ -1,0 +1,60 @@
+"""Run-boundary detection and sequence-based dedup masks.
+
+Replaces the reference's MergeExec/MergeStream row-group loop
+(read.rs:99-385): input sorted by (pk..., __seq__), consecutive rows with
+equal pks form a group, and the merge operator collapses each group. On TPU
+the group scan becomes data-parallel mask algebra:
+
+  starts[i]   = valid[i] and any(pk[i] != pk[i-1])          (run boundary)
+  seg_ids     = cumsum(starts) - 1                          (group index)
+  last-value  = keep rows that end their group               (max __seq__ wins,
+                because the sort is stable with seq as the least key)
+
+This is exactly the "run-length boundary detection + segment-reduce" design
+named in SURVEY C8. The reference's `pending_batch` carry across stream
+batches (read.rs:308-330) maps to the host-side carry loop in
+storage/read.py for segments larger than one device block.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def run_starts(key_cols: list[jax.Array], valid: jax.Array) -> jax.Array:
+    """Boolean mask: row i begins a new primary-key group."""
+    n = key_cols[0].shape[0]
+    diff = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for col in key_cols:
+        prev = jnp.concatenate([col[:1], col[:-1]])
+        diff = diff | (col != prev)
+    return diff & valid
+
+
+def segment_ids(starts: jax.Array) -> jax.Array:
+    """Group index per row: 0-based, monotone. Padding rows inherit the last
+    group's id; mask with `valid` downstream."""
+    return jnp.cumsum(starts.astype(jnp.int32)) - 1
+
+
+def last_in_group_mask(starts: jax.Array, valid: jax.Array, num_valid) -> jax.Array:
+    """Keep-mask selecting the final row of every group — the LastValueOperator
+    (operator.rs:36-44): with rows sorted by (pk, seq), the last row of a group
+    holds the max sequence, i.e. the newest write wins (Overwrite mode)."""
+    n = starts.shape[0]
+    next_is_new = jnp.concatenate([starts[1:], jnp.ones(1, dtype=bool)])
+    is_final_valid_row = jnp.arange(n) == (num_valid - 1)
+    return valid & (next_is_new | is_final_valid_row)
+
+
+def dedup_last_value(
+    columns: dict[str, jax.Array],
+    key_names: list[str],
+    num_valid,
+) -> jax.Array:
+    """One-shot: keep-mask for Overwrite-mode dedup over a sorted block."""
+    n = columns[key_names[0]].shape[0]
+    valid = jnp.arange(n) < num_valid
+    starts = run_starts([columns[k] for k in key_names], valid)
+    return last_in_group_mask(starts, valid, num_valid)

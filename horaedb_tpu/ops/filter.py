@@ -1,0 +1,205 @@
+"""Vectorized predicate evaluation.
+
+Replaces DataFusion's FilterExec + pushed-down pruning predicate
+(read.rs:459-470). A predicate is a small static expression tree; evaluation
+compiles to a fused elementwise mask kernel. Literals are passed as traced
+scalars so changing a constant does NOT trigger an XLA recompile — only the
+tree *shape* is static.
+
+The same tree drives host-side SST/row-group pruning via min-max statistics
+(`prune_range`), mirroring parquet page pruning in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from horaedb_tpu.common.error import HoraeError
+
+# -- predicate tree ----------------------------------------------------------
+
+_OPS = ("eq", "ne", "lt", "le", "gt", "ge")
+
+
+@dataclass(frozen=True)
+class Compare:
+    column: str
+    op: str  # one of _OPS
+    literal: float | int
+
+    def __post_init__(self):
+        if self.op not in _OPS:
+            raise HoraeError(f"unknown compare op: {self.op}")
+
+
+@dataclass(frozen=True)
+class InSet:
+    """column IN (v1, v2, ...) — e.g. TSID membership from the inverted index.
+    On device this becomes a broadcast compare against a literal vector
+    (the 'device-side set-membership' op of SURVEY §7.7)."""
+
+    column: str
+    values: tuple
+
+    def __post_init__(self):
+        object.__setattr__(self, "values", tuple(self.values))
+
+
+@dataclass(frozen=True)
+class And:
+    children: tuple
+
+    def __init__(self, *children: "Predicate"):
+        object.__setattr__(self, "children", tuple(children))
+
+
+@dataclass(frozen=True)
+class Or:
+    children: tuple
+
+    def __init__(self, *children: "Predicate"):
+        object.__setattr__(self, "children", tuple(children))
+
+
+@dataclass(frozen=True)
+class Not:
+    child: "Predicate"
+
+
+Predicate = Union[Compare, InSet, And, Or, Not]
+
+
+@dataclass(frozen=True)
+class Slot:
+    """Placeholder for a literal extracted by `split_literals`. A predicate
+    whose Compare literals are Slots is a hashable *template*: jit-compiled
+    kernels key their cache on the template, and the literal values flow in
+    as traced scalars — new constants, same executable."""
+
+    idx: int
+
+
+def split_literals(pred: Predicate | None) -> tuple[Predicate | None, tuple]:
+    """Extract Compare literals into a tuple, leaving Slot markers behind.
+    InSet values stay static (their arity shapes the kernel anyway)."""
+    literals: list = []
+
+    def walk(p: Predicate) -> Predicate:
+        if isinstance(p, Compare):
+            literals.append(p.literal)
+            return Compare(p.column, p.op, Slot(len(literals) - 1))
+        if isinstance(p, And):
+            return And(*[walk(c) for c in p.children])
+        if isinstance(p, Or):
+            return Or(*[walk(c) for c in p.children])
+        if isinstance(p, Not):
+            return Not(walk(p.child))
+        return p  # InSet
+
+    if pred is None:
+        return None, ()
+    return walk(pred), tuple(literals)
+
+
+def time_range_pred(ts_column: str, start: int, end: int) -> Predicate:
+    """[start, end) range scan predicate."""
+    return And(Compare(ts_column, "ge", start), Compare(ts_column, "lt", end))
+
+
+# -- device evaluation -------------------------------------------------------
+
+def eval_predicate(
+    pred: Predicate | None,
+    columns: dict[str, jnp.ndarray],
+    literals: tuple = (),
+) -> jnp.ndarray:
+    """Boolean keep-mask over a block. Traceable under jit; `literals` feeds
+    Slot placeholders produced by `split_literals`."""
+    n = next(iter(columns.values())).shape[0]
+    if pred is None:
+        return jnp.ones(n, dtype=bool)
+    return _eval(pred, columns, literals)
+
+
+def _eval(pred: Predicate, cols: dict[str, jnp.ndarray], literals: tuple = ()) -> jnp.ndarray:
+    if isinstance(pred, Compare):
+        c = cols[pred.column]
+        raw = literals[pred.literal.idx] if isinstance(pred.literal, Slot) else pred.literal
+        lit = jnp.asarray(raw, dtype=c.dtype)
+        if pred.op == "eq":
+            return c == lit
+        if pred.op == "ne":
+            return c != lit
+        if pred.op == "lt":
+            return c < lit
+        if pred.op == "le":
+            return c <= lit
+        if pred.op == "gt":
+            return c > lit
+        return c >= lit
+    if isinstance(pred, InSet):
+        c = cols[pred.column]
+        vals = jnp.asarray(np.asarray(pred.values), dtype=c.dtype)
+        return jnp.any(c[:, None] == vals[None, :], axis=1)
+    if isinstance(pred, And):
+        out = _eval(pred.children[0], cols, literals)
+        for ch in pred.children[1:]:
+            out = out & _eval(ch, cols, literals)
+        return out
+    if isinstance(pred, Or):
+        out = _eval(pred.children[0], cols, literals)
+        for ch in pred.children[1:]:
+            out = out | _eval(ch, cols, literals)
+        return out
+    if isinstance(pred, Not):
+        return ~_eval(pred.child, cols, literals)
+    raise HoraeError(f"unknown predicate node: {pred!r}")
+
+
+# -- host-side min/max pruning ----------------------------------------------
+
+def prune_range(pred: Predicate | None, stats: dict[str, tuple]) -> bool:
+    """Can any row in a chunk with column [min, max] `stats` match?
+
+    Conservative: returns True (keep) unless the predicate provably rejects
+    the whole chunk. Used for SST- and row-group-level pruning, the analog of
+    the reference's pruning predicate on ParquetExec (read.rs:459-463).
+    """
+    if pred is None:
+        return True
+    return _prune(pred, stats)
+
+
+def _prune(pred: Predicate, stats: dict[str, tuple]) -> bool:
+    if isinstance(pred, Compare):
+        if pred.column not in stats:
+            return True
+        lo, hi = stats[pred.column]
+        v = pred.literal
+        if pred.op == "eq":
+            return lo <= v <= hi
+        if pred.op == "ne":
+            return not (lo == hi == v)
+        if pred.op == "lt":
+            return lo < v
+        if pred.op == "le":
+            return lo <= v
+        if pred.op == "gt":
+            return hi > v
+        return hi >= v
+    if isinstance(pred, InSet):
+        if pred.column not in stats:
+            return True
+        lo, hi = stats[pred.column]
+        return any(lo <= v <= hi for v in pred.values)
+    if isinstance(pred, And):
+        return all(_prune(c, stats) for c in pred.children)
+    if isinstance(pred, Or):
+        return any(_prune(c, stats) for c in pred.children)
+    if isinstance(pred, Not):
+        return True  # can't cheaply invert interval logic; stay conservative
+    raise HoraeError(f"unknown predicate node: {pred!r}")

@@ -1,0 +1,38 @@
+"""K-way sorted merge on device.
+
+The reference heap-merges k sorted SST streams row-by-row on CPU
+(SortPreservingMergeExec, read.rs:479-480). A comparison heap is the wrong
+shape for a vector machine; the XLA-idiomatic k-way merge is concatenate +
+one fused sort over the combined block — O(n log n) work but fully
+data-parallel, and the inputs being pre-sorted makes the sort's comparator
+networks cheap in practice. This is the core of both the scan path and the
+compaction executor (SURVEY C12, BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.ops.sort import sort_columns
+
+
+def concat_blocks(blocks: list[dict[str, jax.Array]]) -> dict[str, jax.Array]:
+    """Concatenate same-schema column dicts (padding rows and all)."""
+    ensure(len(blocks) > 0, "cannot merge zero blocks")
+    names = list(blocks[0].keys())
+    return {k: jnp.concatenate([b[k] for b in blocks]) for k in names}
+
+
+def merge_sorted(
+    blocks: list[dict[str, jax.Array]],
+    key_names: list[str],
+) -> dict[str, jax.Array]:
+    """Merge k sorted blocks into one block sorted by `key_names`.
+
+    Padding rows must carry sentinel keys (blocks.py) — they sink to the tail
+    of the merged ordering, so the result's valid region is the sum of input
+    valid counts.
+    """
+    return sort_columns(concat_blocks(blocks), key_names)

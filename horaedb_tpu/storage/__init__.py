@@ -1,0 +1,49 @@
+"""Columnar storage engine (reference: src/columnar_storage).
+
+Public surface mirrors the reference trait boundary
+(`trait ColumnarStorage { schema; write; scan; compact }`, storage.rs:58-89):
+
+    from horaedb_tpu.storage import (
+        ColumnarStorage, ObjectBasedStorage,
+        WriteRequest, ScanRequest, CompactRequest,
+        StorageConfig, UpdateMode, StorageSchema, TimeRange,
+    )
+"""
+
+from horaedb_tpu.storage.config import (
+    ManifestConfig,
+    SchedulerConfig,
+    StorageConfig,
+    UpdateMode,
+    WriteConfig,
+)
+from horaedb_tpu.storage.sst import FileMeta, SstFile, SstPathGenerator, allocate_id
+from horaedb_tpu.storage.storage import (
+    ColumnarStorage,
+    CompactRequest,
+    ObjectBasedStorage,
+    ScanRequest,
+    WriteRequest,
+)
+from horaedb_tpu.storage.types import StorageSchema, TimeRange, Timestamp, WriteResult
+
+__all__ = [
+    "ColumnarStorage",
+    "ObjectBasedStorage",
+    "WriteRequest",
+    "ScanRequest",
+    "CompactRequest",
+    "StorageConfig",
+    "WriteConfig",
+    "ManifestConfig",
+    "SchedulerConfig",
+    "UpdateMode",
+    "StorageSchema",
+    "TimeRange",
+    "Timestamp",
+    "WriteResult",
+    "SstFile",
+    "FileMeta",
+    "SstPathGenerator",
+    "allocate_id",
+]

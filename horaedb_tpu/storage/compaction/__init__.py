@@ -1,0 +1,26 @@
+"""Compaction: task, picker, executor, scheduler.
+
+Reference: src/columnar_storage/src/compaction/. The merge+dedup of k input
+SSTs runs on device through the same fused scan pipeline as queries
+(BASELINE config 5 / SURVEY C12); policy and orchestration are host control
+plane with the reference's exact semantics (memory gating, in_compaction
+marking, manifest-commit-before-physical-delete).
+"""
+
+from dataclasses import dataclass, field
+
+from horaedb_tpu.storage.sst import SstFile
+
+
+@dataclass
+class Task:
+    """One compaction unit (compaction/mod.rs:26-36)."""
+
+    inputs: list[SstFile] = field(default_factory=list)
+    expireds: list[SstFile] = field(default_factory=list)
+    # Set by Executor.pre_check once the memory budget is charged, so the
+    # release paths never refund a reservation that was never taken.
+    mem_reserved: bool = field(default=False, compare=False)
+
+    def input_size(self) -> int:
+        return sum(f.meta.size for f in self.inputs)

@@ -1,0 +1,156 @@
+"""Compaction executor: k-way merge+dedup on device, then manifest commit.
+
+Reference: src/columnar_storage/src/compaction/executor.rs. Semantics kept:
+- memory gate: in-use bytes + task input size must stay under the limit or
+  the task is rejected before running (executor.rs:93-114);
+- each admitted task immediately pings the trigger channel so the picker
+  looks for more work (executor.rs:147-151);
+- the k inputs merge through the SAME pipeline as scans with
+  keep_builtin=True (original __seq__ values survive into the output SST);
+- the manifest update (add new, delete inputs+expireds) is the commit point:
+  after it, physical deletes are best-effort and never fail the task
+  ("From now on, no error should be returned", executor.rs:218-219);
+- failures before the commit release memory and unmark the SSTs so the
+  picker can retry them (executor.rs:123-137).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import pyarrow as pa
+
+from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.storage.compaction import Task
+from horaedb_tpu.storage.sst import FileMeta, SstFile, allocate_id
+from horaedb_tpu.storage.types import TimeRange
+
+logger = logging.getLogger(__name__)
+
+
+class Executor:
+    def __init__(
+        self,
+        storage,  # ObjectBasedStorage (duck-typed to avoid an import cycle)
+        manifest,
+        mem_limit: int,
+        trigger: "asyncio.Queue[None]",
+    ):
+        self._storage = storage
+        self._manifest = manifest
+        self._mem_limit = mem_limit
+        self._inused_memory = 0
+        self._trigger = trigger
+        self._inflight: set[asyncio.Task] = set()
+
+    # -- admission (executor.rs:93-114) -------------------------------------
+    def pre_check(self, task: Task) -> None:
+        ensure(bool(task.inputs), "compaction task must have inputs")
+        ensure(
+            all(f.is_compaction() for f in task.inputs + task.expireds),
+            "compaction task files must be marked in_compaction",
+        )
+        task_size = task.input_size()
+        ensure(
+            self._inused_memory + task_size <= self._mem_limit,
+            f"Compaction memory usage too high, inused:{self._inused_memory}, "
+            f"task_size:{task_size}, limit:{self._mem_limit}",
+        )
+        self._inused_memory += task_size
+        task.mem_reserved = True
+
+    def _release(self, task: Task) -> None:
+        if task.mem_reserved:
+            self._inused_memory -= task.input_size()
+            task.mem_reserved = False
+
+    def on_success(self, task: Task) -> None:
+        self._release(task)
+
+    def on_failure(self, task: Task) -> None:
+        """Release the budget (only if charged — a pre_check rejection must
+        not drive the gate negative) and unmark SSTs for re-pick."""
+        self._release(task)
+        for sst in task.inputs + task.expireds:
+            sst.unmark_compaction()
+
+    def _trigger_more_task(self) -> None:
+        try:
+            self._trigger.put_nowait(None)
+        except asyncio.QueueFull:
+            pass
+
+    # -- submission (executor.rs:139-151, 261-272) ---------------------------
+    def submit(self, task: Task) -> asyncio.Task:
+        async def _run() -> None:
+            try:
+                await self.do_compaction(task)
+            except Exception:  # noqa: BLE001
+                logger.exception("Do compaction failed")
+                self.on_failure(task)
+            else:
+                self.on_success(task)
+
+        t = asyncio.create_task(_run(), name="compaction-task")
+        self._inflight.add(t)
+        t.add_done_callback(self._inflight.discard)
+        return t
+
+    async def drain(self) -> None:
+        """Wait for in-flight compactions (tests & shutdown)."""
+        while self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+
+    # -- the compaction itself (executor.rs:155-222) --------------------------
+    async def do_compaction(self, task: Task) -> None:
+        self.pre_check(task)
+        self._trigger_more_task()
+        logger.debug("Start do compaction, input_len=%d", len(task.inputs))
+
+        time_range = TimeRange.union_of([f.meta.time_range for f in task.inputs])
+        # Same merge pipeline as the scan path, on device, builtins kept.
+        batches = await self._storage.parquet_reader.scan_segment(
+            task.inputs,
+            predicate=None,
+            projections=None,
+            keep_builtin=True,
+        )
+        if not batches:
+            # All inputs were empty SSTs: commit a delete-only update instead
+            # of erroring (an error would unmark + re-pick the same files in
+            # an infinite retry loop).
+            to_deletes = [f.id for f in task.expireds] + [f.id for f in task.inputs]
+            await self._manifest.update([], to_deletes)
+            await self._delete_ssts(to_deletes)
+            return
+        table = pa.Table.from_batches(batches)
+
+        file_id = allocate_id()
+        size = await self._storage.write_sst(file_id, table)
+        file_meta = FileMeta(
+            max_sequence=file_id,
+            num_rows=table.num_rows,
+            size=size,
+            time_range=time_range,
+        )
+        logger.debug("Compact output new sst: id=%d rows=%d size=%d", file_id, table.num_rows, size)
+
+        # Commit point: add new THEN delete inputs+expireds, atomically in one
+        # manifest delta (executor.rs:206-216).
+        to_deletes = [f.id for f in task.expireds] + [f.id for f in task.inputs]
+        await self._manifest.update(
+            [SstFile(id=file_id, meta=file_meta)], to_deletes
+        )
+        # From now on, no error should be returned (executor.rs:218-219).
+        await self._delete_ssts(to_deletes)
+
+    async def _delete_ssts(self, ids: list[int]) -> None:
+        """Best-effort parallel physical deletes (executor.rs:224-253)."""
+        paths = [self._storage.parquet_reader._path_gen.generate(i) for i in ids]
+        results = await asyncio.gather(
+            *(self._storage._store.delete(p) for p in paths), return_exceptions=True
+        )
+        for p, r in zip(paths, results):
+            if isinstance(r, BaseException):
+                logger.error("Failed to delete sst %s: %s", p, r)

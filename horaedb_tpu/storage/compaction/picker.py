@@ -1,0 +1,104 @@
+"""Time-window compaction strategy.
+
+Reference: src/columnar_storage/src/compaction/picker.rs. Policy preserved
+exactly:
+- files already marked in_compaction are skipped; TTL-expired files are
+  collected separately (picker.rs:117-134);
+- remaining files bucket by segment (`time_range.start.truncate_by`), and
+  segments are scanned NEWEST first (picker.rs:155-188);
+- a segment qualifies with >= input_sst_min_num files; files sort size-asc
+  (smallest first) and accumulate up to input_sst_max_num files while total
+  size stays <= 1.1 x new_sst_max_size;
+- quirk preserved: expired files only ride along when some segment qualifies
+  (pick_compaction_files returning None aborts the pick entirely,
+  picker.rs:92-95);
+- the picker must run sequentially so an SST is never picked twice
+  (picker.rs:52-55) — here it only ever runs inside the scheduler's single
+  generate-task loop.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from horaedb_tpu.storage.compaction import Task
+from horaedb_tpu.storage.sst import SstFile
+from horaedb_tpu.storage.types import Timestamp
+
+logger = logging.getLogger(__name__)
+
+
+class TimeWindowCompactionStrategy:
+    def __init__(
+        self,
+        segment_duration_ms: int,
+        new_sst_max_size: int,
+        input_sst_max_num: int,
+        input_sst_min_num: int,
+    ):
+        self._segment_duration = segment_duration_ms
+        self._new_sst_max_size = new_sst_max_size
+        self._input_sst_max_num = input_sst_max_num
+        self._input_sst_min_num = input_sst_min_num
+
+    def pick_candidate(
+        self,
+        ssts: list[SstFile],
+        expire_before_ms: int | None,
+    ) -> Task | None:
+        uncompacted, expired = self._find_uncompacted_and_expired(ssts, expire_before_ms)
+        by_segment = self._files_by_segment(uncompacted)
+        picked = self._pick_compaction_files(by_segment)
+        if picked is None:
+            return None
+        if not picked and not expired:
+            return None
+        for f in picked:
+            f.mark_compaction()
+        for f in expired:
+            f.mark_compaction()
+        task = Task(inputs=picked, expireds=expired)
+        logger.debug(
+            "picked compaction task: inputs=%d expireds=%d size=%d",
+            len(picked), len(expired), task.input_size(),
+        )
+        return task
+
+    @staticmethod
+    def _find_uncompacted_and_expired(
+        files: list[SstFile], expire_before_ms: int | None
+    ) -> tuple[list[SstFile], list[SstFile]]:
+        uncompacted, expired = [], []
+        for f in files:
+            if f.is_compaction():
+                continue
+            (expired if f.is_expired(expire_before_ms) else uncompacted).append(f)
+        return uncompacted, expired
+
+    def _files_by_segment(self, files: list[SstFile]) -> dict[int, list[SstFile]]:
+        out: dict[int, list[SstFile]] = {}
+        for f in files:
+            seg = Timestamp(f.meta.time_range.start).truncate_by(self._segment_duration)
+            out.setdefault(seg.value, []).append(f)
+        return out
+
+    def _pick_compaction_files(
+        self, by_segment: dict[int, list[SstFile]]
+    ) -> list[SstFile] | None:
+        for seg in sorted(by_segment, reverse=True):  # newest first
+            files = by_segment[seg]
+            if len(files) < self._input_sst_min_num:
+                continue
+            files = sorted(files, key=lambda f: f.meta.size)  # smallest first
+            # Suppose compaction reduces size by ~10% (picker.rs:172-174).
+            budget = int(self._new_sst_max_size * 1.1)
+            picked: list[SstFile] = []
+            total = 0
+            for f in files[: self._input_sst_max_num]:
+                total += f.meta.size
+                if total > budget:
+                    break
+                picked.append(f)
+            if len(picked) >= self._input_sst_min_num:
+                return picked
+        return None

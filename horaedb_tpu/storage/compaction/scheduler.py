@@ -1,0 +1,109 @@
+"""Compaction scheduler: the picker loop and the executor-submit loop.
+
+Reference: src/columnar_storage/src/compaction/scheduler.rs. Shape preserved:
+- generate_task_loop: select!(schedule_interval tick | manual trigger) ->
+  pick_candidate over the manifest's SSTs -> push into a bounded task queue
+  (scheduler.rs:121-159);
+- recv_task_loop: pop tasks and hand them to the executor (scheduler.rs:114-119);
+- `trigger_compaction()` is the `/compact` HTTP hook (scheduler.rs:106-112);
+- TTL: expire horizon = now - ttl when a TTL is configured.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from horaedb_tpu.common.time_ext import now_ms
+from horaedb_tpu.storage.compaction import Task
+from horaedb_tpu.storage.compaction.executor import Executor
+from horaedb_tpu.storage.compaction.picker import TimeWindowCompactionStrategy
+from horaedb_tpu.storage.config import SchedulerConfig
+
+logger = logging.getLogger(__name__)
+
+
+class CompactionScheduler:
+    def __init__(
+        self,
+        storage,  # ObjectBasedStorage
+        manifest,
+        config: SchedulerConfig,
+        segment_duration_ms: int,
+    ):
+        self._config = config
+        self._manifest = manifest
+        self._trigger: asyncio.Queue[None] = asyncio.Queue(maxsize=4)
+        self._tasks: asyncio.Queue[Task] = asyncio.Queue(
+            maxsize=config.max_pending_compaction_tasks
+        )
+        self._picker = TimeWindowCompactionStrategy(
+            segment_duration_ms=segment_duration_ms,
+            new_sst_max_size=config.new_sst_max_size.as_bytes(),
+            input_sst_max_num=config.input_sst_max_num,
+            input_sst_min_num=config.input_sst_min_num,
+        )
+        self.executor = Executor(
+            storage=storage,
+            manifest=manifest,
+            mem_limit=config.memory_limit.as_bytes(),
+            trigger=self._trigger,
+        )
+        self._loops: list[asyncio.Task] = []
+
+    def start(self) -> None:
+        self._loops = [
+            asyncio.create_task(self._generate_task_loop(), name="compaction-picker"),
+            asyncio.create_task(self._recv_task_loop(), name="compaction-submit"),
+        ]
+
+    async def close(self) -> None:
+        for t in self._loops:
+            t.cancel()
+        await asyncio.gather(*self._loops, return_exceptions=True)
+        self._loops = []
+        await self.executor.drain()
+
+    def trigger_compaction(self) -> None:
+        """Manual trigger, e.g. the `/compact` endpoint (scheduler.rs:106-112)."""
+        try:
+            self._trigger.put_nowait(None)
+        except asyncio.QueueFull:
+            logger.debug("compaction trigger channel full; pick already pending")
+
+    # -- loops ---------------------------------------------------------------
+    async def _generate_task_loop(self) -> None:
+        interval = self._config.schedule_interval.seconds
+        while True:
+            sleep = asyncio.create_task(asyncio.sleep(interval))
+            recv = asyncio.create_task(self._trigger.get())
+            done, pending = await asyncio.wait(
+                {sleep, recv}, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in pending:
+                t.cancel()
+            self.pick_once()
+
+    def pick_once(self) -> bool:
+        """One sequential pick; returns True if a task was enqueued."""
+        expire_before = None
+        if self._config.ttl is not None:
+            expire_before = now_ms() - self._config.ttl.as_millis()
+        task = self._picker.pick_candidate(self._manifest.all_ssts(), expire_before)
+        if task is None:
+            return False
+        try:
+            self._tasks.put_nowait(task)
+            return True
+        except asyncio.QueueFull:
+            # Task queue full: unmark so a later pick retries these files
+            # (no memory to release — reservation happens in pre_check).
+            logger.warning("compaction task queue full; dropping pick")
+            for f in task.inputs + task.expireds:
+                f.unmark_compaction()
+            return False
+
+    async def _recv_task_loop(self) -> None:
+        while True:
+            task = await self._tasks.get()
+            self.executor.submit(task)

@@ -1,0 +1,147 @@
+"""Storage engine configuration (reference: src/columnar_storage/src/config.rs:24-172).
+
+Same knob tree and defaults as the reference; values deserialize from TOML via
+`from_dict`, with ReadableDuration/ReadableSize strings accepted anywhere a
+duration/size appears.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, fields as dc_fields
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.common.size_ext import ReadableSize
+from horaedb_tpu.common.time_ext import ReadableDuration
+
+
+class UpdateMode(enum.Enum):
+    """How duplicate primary keys merge at read/compact time (config.rs)."""
+
+    OVERWRITE = "Overwrite"  # keep the row with max sequence
+    APPEND = "Append"        # concatenate binary value columns
+
+    @classmethod
+    def parse(cls, v: "str | UpdateMode") -> "UpdateMode":
+        if isinstance(v, UpdateMode):
+            return v
+        for m in cls:
+            if m.value.lower() == str(v).lower():
+                return m
+        raise HoraeError(f"unknown update mode: {v!r}")
+
+
+class ParquetCompression(enum.Enum):
+    UNCOMPRESSED = "none"
+    SNAPPY = "snappy"
+    LZ4 = "lz4"
+    ZSTD = "zstd"
+    GZIP = "gzip"
+
+    @classmethod
+    def parse(cls, v: "str | ParquetCompression") -> "ParquetCompression":
+        if isinstance(v, ParquetCompression):
+            return v
+        for m in cls:
+            if m.value.lower() == str(v).lower() or m.name.lower() == str(v).lower():
+                return m
+        raise HoraeError(f"unknown compression: {v!r}")
+
+
+def _from_dict(cls, d: dict):
+    """Build a config dataclass from a (possibly partial) dict, recursing into
+    nested config dataclasses and parsing human-readable value types —
+    unknown keys are rejected like serde's deny_unknown_fields."""
+    if d is None:
+        return cls()
+    known = {f.name: f for f in dc_fields(cls)}
+    unknown = set(d) - set(known)
+    if unknown:
+        raise HoraeError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs = {}
+    for name, value in d.items():
+        default = getattr(cls(), name)
+        if name == "ttl" and value is not None:
+            kwargs[name] = ReadableDuration.parse(value)
+        elif hasattr(type(default), "parse") and not isinstance(value, dict):
+            kwargs[name] = type(default).parse(value)
+        elif hasattr(default, "__dataclass_fields__"):
+            kwargs[name] = _from_dict(type(default), value)
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+@dataclass
+class ColumnOptions:
+    """Per-column parquet overrides (config.rs WriteConfig column options)."""
+
+    enable_dict: bool | None = None
+    enable_bloom_filter: bool | None = None
+    encoding: str | None = None
+    compression: str | None = None
+
+
+@dataclass
+class WriteConfig:
+    """Parquet writer knobs (config.rs, defaults preserved)."""
+
+    max_row_group_size: int = 8192
+    write_batch_size: int = 1024
+    enable_sorting_columns: bool = True
+    enable_dict: bool = False
+    enable_bloom_filter: bool = False
+    compression: ParquetCompression = ParquetCompression.SNAPPY
+    column_options: dict | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "WriteConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class ManifestConfig:
+    """Manifest merger thresholds (config.rs; semantics in manifest/mod.rs):
+    - soft limit: schedule a background merge;
+    - hard limit: REJECT writes until the merger catches up."""
+
+    channel_size: int = 3
+    merge_interval: ReadableDuration = field(default_factory=lambda: ReadableDuration.secs(5))
+    min_merge_threshold: int = 10
+    soft_merge_threshold: int = 50
+    hard_merge_threshold: int = 90
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "ManifestConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class SchedulerConfig:
+    """Compaction scheduler knobs (config.rs SchedulerConfig)."""
+
+    schedule_interval: ReadableDuration = field(default_factory=lambda: ReadableDuration.secs(10))
+    max_pending_compaction_tasks: int = 10
+    memory_limit: ReadableSize = field(default_factory=lambda: ReadableSize.gb(2))
+    ttl: ReadableDuration | None = None
+    new_sst_max_size: ReadableSize = field(default_factory=lambda: ReadableSize.gb(1))
+    input_sst_max_num: int = 30
+    input_sst_min_num: int = 5
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "SchedulerConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class StorageConfig:
+    """Top-level storage config (config.rs StorageConfig)."""
+
+    write: WriteConfig = field(default_factory=WriteConfig)
+    manifest: ManifestConfig = field(default_factory=ManifestConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    update_mode: UpdateMode = UpdateMode.OVERWRITE
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "StorageConfig":
+        return _from_dict(cls, d)

@@ -1,0 +1,234 @@
+"""Manifest: snapshot + delta log over the object store.
+
+Reference: src/columnar_storage/src/manifest/mod.rs. Semantics preserved:
+
+- In-memory list of live SSTs; every update writes one protobuf delta file to
+  `{root}/manifest/delta/{id}` (the durability point) then applies in memory.
+- A background merger folds deltas into the binary `snapshot` file
+  (`encoding.py`) on a timer OR when signalled; startup runs a first merge so
+  recovery = read snapshot after folding leftover deltas (mod.rs:195-215).
+- Delta-count backpressure: above the soft threshold a merge is scheduled;
+  above the hard threshold the write is REJECTED with an error
+  (mod.rs:248-262) — the engine's overload-protection contract.
+- Merge applies all adds BEFORE all deletes because delta files are read in
+  unspecified order (mod.rs:289-299).
+- Post-commit delta deletions never fail the merge — log-only
+  (mod.rs:310-330).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from horaedb_tpu.common.error import HoraeError, context, ensure
+from horaedb_tpu.objstore import NotFound, ObjectStore
+from horaedb_tpu.storage.config import ManifestConfig
+from horaedb_tpu.storage.manifest import encoding
+from horaedb_tpu.storage.manifest.encoding import Snapshot, decode_update, encode_update
+from horaedb_tpu.storage.sst import FileMeta, SstFile, allocate_id
+from horaedb_tpu.storage.types import TimeRange
+
+logger = logging.getLogger(__name__)
+
+PREFIX_PATH = "manifest"
+SNAPSHOT_FILENAME = "snapshot"
+DELTA_PREFIX = "delta"
+
+
+def snapshot_path(root: str) -> str:
+    return f"{root}/{PREFIX_PATH}/{SNAPSHOT_FILENAME}"
+
+
+def delta_dir(root: str) -> str:
+    return f"{root}/{PREFIX_PATH}/{DELTA_PREFIX}"
+
+
+def delta_path(root: str, file_id: int) -> str:
+    return f"{delta_dir(root)}/{file_id}"
+
+
+class ManifestMerger:
+    """Background delta→snapshot folder (mod.rs:178-333)."""
+
+    def __init__(self, root: str, store: ObjectStore, config: ManifestConfig):
+        self._root = root
+        self._store = store
+        self._config = config
+        self._deltas_num = 0
+        self._merge_signal: asyncio.Queue[None] = asyncio.Queue(maxsize=config.channel_size)
+        self._task: asyncio.Task | None = None
+        self._merge_lock = asyncio.Lock()
+
+    async def bootstrap(self) -> None:
+        """First-run merge: fold any leftover deltas from a previous life into
+        the snapshot so `read_snapshot` returns complete state (mod.rs:212-215)."""
+        await self.do_merge()
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="manifest-merger")
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # -- write-path hooks ---------------------------------------------------
+    def maybe_schedule_merge(self) -> None:
+        """Count one new delta; soft→signal merge, hard→reject (mod.rs:248-262)."""
+        self._deltas_num += 1
+        if self._deltas_num > self._config.hard_merge_threshold:
+            self._deltas_num -= 1
+            raise HoraeError(
+                f"Too many manifest delta files: {self._deltas_num + 1}, "
+                f"hard limit: {self._config.hard_merge_threshold}"
+            )
+        if self._deltas_num > self._config.soft_merge_threshold:
+            try:
+                self._merge_signal.put_nowait(None)
+            except asyncio.QueueFull:
+                pass  # a merge is already queued; dropping the signal is fine
+
+    def on_delta_write_failed(self) -> None:
+        self._deltas_num -= 1
+
+    @property
+    def deltas_num(self) -> int:
+        return self._deltas_num
+
+    # -- merge loop ---------------------------------------------------------
+    async def _run(self) -> None:
+        """select!(interval tick, merge signal) loop (mod.rs:218-240)."""
+        interval = self._config.merge_interval.seconds
+        while True:
+            sleep = asyncio.create_task(asyncio.sleep(interval))
+            recv = asyncio.create_task(self._merge_signal.get())
+            done, pending = await asyncio.wait(
+                {sleep, recv}, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in pending:
+                t.cancel()
+            for t in done:
+                with_exc = t.exception()
+                if with_exc is not None and not isinstance(with_exc, asyncio.CancelledError):
+                    raise with_exc
+            if self._deltas_num > self._config.min_merge_threshold:
+                try:
+                    await self.do_merge()
+                except Exception:  # noqa: BLE001 - keep the loop alive
+                    logger.exception("manifest merge failed; will retry")
+
+    async def do_merge(self) -> None:
+        """Fold all delta files into the snapshot (mod.rs:274-333)."""
+        async with self._merge_lock:
+            metas = await self._store.list(delta_dir(self._root))
+            if not metas:
+                return
+            paths = [m.path for m in metas]
+            # Parallel delta reads (TokioScope analog, mod.rs:283-287).
+            blobs = await asyncio.gather(*(self._store.get(p) for p in paths))
+
+            snapshot = await read_snapshot(self._store, snapshot_path(self._root))
+            all_adds: list[SstFile] = []
+            all_deletes: list[int] = []
+            for blob in blobs:
+                adds, deletes = decode_update(blob)
+                all_adds.extend(adds)
+                all_deletes.extend(deletes)
+            # Adds before deletes: deltas arrive unsorted (mod.rs:289-299).
+            snapshot.add_records(all_adds)
+            snapshot.delete_records(all_deletes)
+
+            with context("write manifest snapshot"):
+                await self._store.put(snapshot_path(self._root), snapshot.to_bytes())
+            # Commit point passed: delta deletions are best-effort (mod.rs:310-330).
+            results = await asyncio.gather(
+                *(self._store.delete(p) for p in paths), return_exceptions=True
+            )
+            for p, r in zip(paths, results):
+                if isinstance(r, BaseException):
+                    logger.error("failed to delete merged delta %s: %s", p, r)
+            self._deltas_num = max(0, self._deltas_num - len(paths))
+
+
+async def read_snapshot(store: ObjectStore, path: str) -> Snapshot:
+    """Missing snapshot is an empty one (mod.rs:336-354)."""
+    try:
+        data = await store.get(path)
+    except NotFound:
+        return Snapshot.empty()
+    with context(f"decode manifest snapshot {path}"):
+        return Snapshot.from_bytes(data)
+
+
+class Manifest:
+    """Live-SST registry (mod.rs:66-176)."""
+
+    def __init__(self, root: str, store: ObjectStore, config: ManifestConfig):
+        self._root = root
+        self._store = store
+        self._config = config
+        self._ssts: list[SstFile] = []
+        self._merger = ManifestMerger(root, store, config)
+
+    @classmethod
+    async def try_new(
+        cls,
+        root: str,
+        store: ObjectStore,
+        config: ManifestConfig | None = None,
+        start_background_merger: bool = True,
+    ) -> "Manifest":
+        m = cls(root, store, config or ManifestConfig())
+        await m._merger.bootstrap()
+        snapshot = await read_snapshot(store, snapshot_path(root))
+        m._ssts = snapshot.into_ssts()
+        logger.info(
+            "manifest loaded: root=%s ssts=%d", root, len(m._ssts)
+        )
+        if start_background_merger:
+            m._merger.start()
+        return m
+
+    async def close(self) -> None:
+        await self._merger.close()
+
+    # -- updates ------------------------------------------------------------
+    async def add_file(self, file_id: int, meta: FileMeta) -> None:
+        await self.update([SstFile(id=file_id, meta=meta)], [])
+
+    async def update(self, to_adds: list[SstFile], to_deletes: list[int]) -> None:
+        """Durability point: write one delta file, then apply in memory
+        (mod.rs:120-157). Hard backpressure may reject the update."""
+        self._merger.maybe_schedule_merge()
+        payload = encode_update(to_adds, to_deletes)
+        path = delta_path(self._root, allocate_id())
+        try:
+            with context("write manifest delta"):
+                await self._store.put(path, payload)
+        except Exception:
+            self._merger.on_delta_write_failed()
+            raise
+        delete_set = set(to_deletes)
+        self._ssts = [s for s in self._ssts if s.id not in delete_set]
+        self._ssts.extend(to_adds)
+
+    # -- queries ------------------------------------------------------------
+    def all_ssts(self) -> list[SstFile]:
+        return list(self._ssts)
+
+    def find_ssts(self, time_range: TimeRange) -> list[SstFile]:
+        """Overlap filter (mod.rs:165-172)."""
+        return [s for s in self._ssts if s.meta.time_range.overlaps(time_range)]
+
+    async def force_merge(self) -> None:
+        """Deterministic merge hook for tests and shutdown."""
+        await self._merger.do_merge()
+
+    @property
+    def deltas_num(self) -> int:
+        return self._merger.deltas_num

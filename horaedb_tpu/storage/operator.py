@@ -1,0 +1,57 @@
+"""Host-side merge operators for duplicate primary-key groups.
+
+Reference: src/columnar_storage/src/operator.rs. Overwrite mode (LastValue)
+runs on device as a mask kernel (ops/dedup.py); these host operators are
+(a) the Append-mode bytes-concat path, which is inherently variable-length
+and stays on host (SURVEY §7 risk (b)), and (b) the oracle implementation the
+device path is differentially tested against.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import pyarrow as pa
+
+from horaedb_tpu.common.error import HoraeError, ensure
+
+
+class MergeOperator(ABC):
+    """Collapse one group of rows sharing a primary key into a single row
+    (operator.rs:30-34)."""
+
+    @abstractmethod
+    def merge(self, group: pa.RecordBatch) -> pa.RecordBatch: ...
+
+
+class LastValueOperator(MergeOperator):
+    """Overwrite mode: the row with max sequence wins. Input groups arrive
+    sorted by (pk, seq) so that is the final row (operator.rs:36-44)."""
+
+    def merge(self, group: pa.RecordBatch) -> pa.RecordBatch:
+        ensure(group.num_rows > 0, "empty merge group")
+        return group.slice(group.num_rows - 1, 1)
+
+
+class BytesMergeOperator(MergeOperator):
+    """Append mode: binary value columns concatenate across the group; other
+    columns come from the first row (operator.rs:59-111)."""
+
+    def __init__(self, value_idxes: list[int]):
+        self._value_idxes = value_idxes
+
+    def merge(self, group: pa.RecordBatch) -> pa.RecordBatch:
+        ensure(group.num_rows > 0, "empty merge group")
+        if group.num_rows == 1:
+            return group
+        cols = []
+        for i, col in enumerate(group.columns):
+            if i in self._value_idxes:
+                t = col.type
+                if not (pa.types.is_binary(t) or pa.types.is_large_binary(t)):
+                    raise HoraeError(f"append-mode value column must be binary, got {t}")
+                joined = b"".join(v for v in col.to_pylist() if v is not None)
+                cols.append(pa.array([joined], type=t))
+            else:
+                cols.append(col.slice(0, 1))
+        return pa.RecordBatch.from_arrays(cols, schema=group.schema)

@@ -1,0 +1,371 @@
+"""Scan pipeline: parquet SSTs -> device filter/merge/dedup -> record batches.
+
+This module replaces the reference's DataFusion physical plan
+(`build_df_plan`: ParquetExec -> FilterExec -> SortPreservingMergeExec ->
+MergeExec, src/columnar_storage/src/read.rs:429-494) with a TPU execution
+shape:
+
+  1. host: row-group-pruned parquet reads per SST (the analog of the custom
+     ParquetFileReaderFactory + pruning predicate, read.rs:66-93,459-463),
+     fanned out concurrently;
+  2. device: ONE fused XLA kernel per segment — predicate mask, k-way merge
+     (sort over the concatenated block with rejected rows sunk to the tail),
+     and last-value dedup mask (reference MergeExec semantics,
+     read.rs:99-385);
+  3. host: gather surviving rows, strip builtin columns unless keep_builtin,
+     emit fixed-size record batches old->new.
+
+Ordering contract preserved: output sorted by (pk..., __seq__), duplicates
+collapsed per UpdateMode; filter runs BEFORE dedup exactly like the
+reference's plan, so a newest-version row rejected by the predicate exposes
+the older surviving version.
+
+Append mode and binary value columns follow the hybrid path: the device
+computes the sort permutation and group boundaries over the numeric key lanes
+and the host applies pyarrow takes + BytesMergeOperator (SURVEY §7 risk (b)).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import AsyncIterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.ops import dedup as dedup_ops
+from horaedb_tpu.ops import filter as filter_ops
+from horaedb_tpu.ops.blocks import Block, arrow_column_to_numpy
+from horaedb_tpu.ops.filter import Predicate
+from horaedb_tpu.storage.config import UpdateMode
+from horaedb_tpu.storage.operator import BytesMergeOperator, LastValueOperator
+from horaedb_tpu.storage.sst import SstFile, SstPathGenerator
+from horaedb_tpu.storage.types import (
+    RESERVED_COLUMN_NAME,
+    SEQ_COLUMN_NAME,
+    StorageSchema,
+    TimeRange,
+)
+
+DEFAULT_SCAN_BATCH_SIZE = 8192
+
+
+@dataclass
+class ScanRequest:
+    """Reference: storage.rs ScanRequest — range prunes SSTs (row-exact time
+    filtering is the caller's predicate, matching reference semantics)."""
+
+    range: TimeRange
+    predicate: Predicate | None = None
+    projections: list[int] | None = None
+
+
+@dataclass
+class CompactRequest:
+    pass
+
+
+@dataclass
+class WriteRequest:
+    batch: pa.RecordBatch
+    time_range: TimeRange
+    # Whether to check the batch is within the same segment (storage.rs:307-316).
+    enable_check: bool = True
+
+
+# ---------------------------------------------------------------------------
+# fused per-segment scan kernel
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=256)
+def _build_scan_kernel(
+    col_names: tuple[str, ...],
+    sort_keys: tuple[str, ...],
+    pk_names: tuple[str, ...],
+    template: Predicate | None,
+    do_dedup: bool,
+):
+    """jit-compiled: mask -> sort(rejected to tail) -> dedup mask.
+
+    Cache key is (schema columns, sort keys, predicate *template*, mode); the
+    predicate's literal values are traced operands (ops/filter.py Slot), so a
+    new constant reuses the compiled executable.
+    """
+
+    @jax.jit
+    def kernel(cols: dict, literals: tuple, num_valid):
+        n = cols[sort_keys[0]].shape[0]
+        valid = jnp.arange(n) < num_valid
+        mask = filter_ops.eval_predicate(template, cols, literals) & valid
+        # Rejected/padding rows sink: ~mask is the most significant sort key.
+        keys = [cols[k] for k in sort_keys]
+        perm = jnp.lexsort(tuple(reversed([(~mask).astype(jnp.int32)] + keys)))
+        sorted_cols = {k: jnp.take(v, perm, axis=0) for k, v in cols.items()}
+        kept = jnp.sum(mask)
+        if do_dedup:
+            keep = dedup_ops.dedup_last_value(sorted_cols, list(pk_names), kept)
+        else:
+            keep = jnp.arange(n) < kept
+        starts = dedup_ops.run_starts(
+            [sorted_cols[k] for k in pk_names], jnp.arange(n) < kept
+        )
+        return sorted_cols, perm, keep, starts, kept
+
+    del col_names  # part of the cache key only
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# parquet IO with row-group pruning
+# ---------------------------------------------------------------------------
+
+
+class ParquetReader:
+    """Per-SST parquet access + the per-segment device pipeline
+    (reference: read.rs ParquetReader/build_df_plan)."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        sst_path_gen: SstPathGenerator,
+        schema: StorageSchema,
+    ):
+        self._store = store
+        self._path_gen = sst_path_gen
+        self._schema = schema
+
+    async def read_sst(
+        self,
+        sst: SstFile,
+        columns: list[str] | None,
+        predicate: Predicate | None,
+    ) -> pa.Table:
+        """Read one SST's projected columns, skipping row groups whose
+        min/max statistics can't satisfy the predicate."""
+        path = self._path_gen.generate(sst.id)
+
+        def _read() -> pa.Table:
+            local = self._store.local_path(path)
+            if local is None:
+                raise _NeedBytes()
+            return _read_pruned(pq.ParquetFile(local), columns, predicate)
+
+        def _read_bytes(data: bytes) -> pa.Table:
+            pf = pq.ParquetFile(io.BytesIO(data))
+            return _read_pruned(pf, columns, predicate)
+
+        try:
+            return await asyncio.to_thread(_read)
+        except _NeedBytes:
+            data = await self._store.get(path)
+            return await asyncio.to_thread(_read_bytes, data)
+
+    async def scan_segment(
+        self,
+        ssts: list[SstFile],
+        predicate: Predicate | None,
+        projections: list[int] | None,
+        keep_builtin: bool,
+        batch_size: int = DEFAULT_SCAN_BATCH_SIZE,
+    ) -> list[pa.RecordBatch]:
+        """The fused device pipeline for one time segment."""
+        schema = self._schema
+        proj = schema.fill_required_projections(projections)
+        all_names = schema.arrow_schema.names
+        if proj is None:
+            read_names = list(all_names)
+        else:
+            read_names = [all_names[i] for i in sorted(proj)]
+        if keep_builtin and RESERVED_COLUMN_NAME not in read_names:
+            read_names.append(RESERVED_COLUMN_NAME)
+
+        tables = await asyncio.gather(
+            *(self.read_sst(s, read_names, predicate) for s in ssts)
+        )
+        tables = [t for t in tables if t.num_rows > 0]
+        if not tables:
+            return []
+        table = pa.concat_tables(tables).combine_chunks()
+
+        pk_names = tuple(schema.primary_key_names)
+        sort_keys = pk_names + (SEQ_COLUMN_NAME,)
+
+        numeric_names, binary_names = [], []
+        for name in table.schema.names:
+            t = table.schema.field(name).type
+            if pa.types.is_binary(t) or pa.types.is_large_binary(t) or pa.types.is_string(t):
+                binary_names.append(name)
+            else:
+                numeric_names.append(name)
+        ensure(
+            all(k in numeric_names for k in sort_keys),
+            "primary key and seq columns must be numeric for the device path",
+        )
+
+        arrays = {
+            name: arrow_column_to_numpy(table.column(name).combine_chunks())
+            for name in numeric_names
+        }
+        block = Block.from_numpy(arrays, pad_keys=sort_keys)
+
+        template, literals = filter_ops.split_literals(predicate)
+        do_dedup = (
+            schema.update_mode == UpdateMode.OVERWRITE and not binary_names
+        )
+        kernel = _build_scan_kernel(
+            tuple(block.names), sort_keys, pk_names, template, do_dedup
+        )
+        sorted_cols, perm, keep, starts, kept = kernel(
+            block.columns, literals, block.num_valid
+        )
+
+        keep_np = np.asarray(keep)
+        if schema.update_mode == UpdateMode.OVERWRITE and binary_names:
+            # hybrid path: device picked the surviving rows; host gathers
+            # binary columns through the same permutation.
+            keep_np = np.asarray(
+                dedup_ops.dedup_last_value(sorted_cols, list(pk_names), kept)
+            )
+
+        # Output = everything fetched (pk + __seq__ are force-included in the
+        # projection, types.rs:203-216) minus builtins unless keep_builtin —
+        # matching the reference plan's output schema after MergeExec.
+        out_names = [n for n in read_names if keep_builtin or not StorageSchema.is_builtin_name(n)]
+
+        if schema.update_mode == UpdateMode.APPEND and binary_names:
+            result = self._materialize_append_mode(
+                table, sorted_cols, np.asarray(perm), np.asarray(starts),
+                int(kept), numeric_names, binary_names, out_names,
+            )
+        else:
+            result = self._materialize(
+                table, sorted_cols, np.asarray(perm), keep_np,
+                numeric_names, binary_names, out_names,
+            )
+        if result.num_rows == 0:
+            return []
+        return [result.slice(i, batch_size) for i in range(0, result.num_rows, batch_size)]
+
+    # -- host materialization ------------------------------------------------
+    def _materialize(
+        self,
+        table: pa.Table,
+        sorted_cols: dict[str, jax.Array],
+        perm: np.ndarray,
+        keep: np.ndarray,
+        numeric_names: list[str],
+        binary_names: list[str],
+        out_names: list[str],
+    ) -> pa.RecordBatch:
+        keep_idx = np.nonzero(keep)[0]
+        cols = []
+        for name in out_names:
+            f = table.schema.field(name)
+            if name in binary_names:
+                row_idx = perm[keep_idx]
+                row_idx = row_idx[row_idx < table.num_rows]
+                arr = table.column(name).combine_chunks().take(pa.array(row_idx))
+                cols.append(arr)
+            else:
+                np_col = np.asarray(sorted_cols[name])[keep_idx]
+                cols.append(_np_to_arrow(np_col, f.type))
+        return pa.RecordBatch.from_arrays(
+            cols, schema=pa.schema([table.schema.field(n) for n in out_names])
+        )
+
+    def _materialize_append_mode(
+        self,
+        table: pa.Table,
+        sorted_cols: dict[str, jax.Array],
+        perm: np.ndarray,
+        starts: np.ndarray,
+        kept: int,
+        numeric_names: list[str],
+        binary_names: list[str],
+        out_names: list[str],
+    ) -> pa.RecordBatch:
+        """Append mode with binary values: groups collapse by concatenating
+        value bytes (BytesMergeOperator) on host; group extents come from the
+        device run-boundary mask."""
+        value_names = {
+            self._schema.arrow_schema.names[i] for i in self._schema.value_idxes
+        }
+        start_idx = np.nonzero(starts[:kept])[0]
+        ends = np.append(start_idx[1:], kept)
+        cols = []
+        for name in out_names:
+            f = table.schema.field(name)
+            if name in binary_names:
+                src = table.column(name).combine_chunks().take(pa.array(perm[:kept]))
+                if name in value_names:
+                    vals = src.to_pylist()
+                    joined = [
+                        b"".join(v for v in vals[s:e] if v is not None)
+                        for s, e in zip(start_idx, ends)
+                    ]
+                    cols.append(pa.array(joined, type=f.type))
+                else:
+                    cols.append(src.take(pa.array(start_idx)))
+            else:
+                np_col = np.asarray(sorted_cols[name])[:kept]
+                # non-value numeric columns take the group's first row; numeric
+                # value columns in append mode also take first (reference only
+                # concatenates binary value columns, operator.rs:59-111)
+                cols.append(_np_to_arrow(np_col[start_idx], f.type))
+        return pa.RecordBatch.from_arrays(
+            cols, schema=pa.schema([table.schema.field(n) for n in out_names])
+        )
+
+
+class _NeedBytes(Exception):
+    pass
+
+
+def _read_pruned(
+    pf: pq.ParquetFile,
+    columns: list[str] | None,
+    predicate: Predicate | None,
+) -> pa.Table:
+    keep_groups = []
+    meta = pf.metadata
+    for rg in range(meta.num_row_groups):
+        stats: dict[str, tuple] = {}
+        g = meta.row_group(rg)
+        for ci in range(g.num_columns):
+            col = g.column(ci)
+            st = col.statistics
+            if st is not None and st.has_min_max:
+                stats[col.path_in_schema] = (_stat_value(st.min), _stat_value(st.max))
+        if filter_ops.prune_range(predicate, stats):
+            keep_groups.append(rg)
+    if not keep_groups:
+        return pf.schema_arrow.empty_table()
+    return pf.read_row_groups(keep_groups, columns=columns, use_threads=True)
+
+
+def _stat_value(v):
+    """Normalize parquet statistics to the numeric domain predicates use
+    (timestamp columns report datetime.datetime; literals are epoch ms)."""
+    import calendar
+    import datetime
+
+    if isinstance(v, datetime.datetime):
+        # exact integer epoch ms — float .timestamp()*1000 truncates ~1% of
+        # millisecond values down by 1, which would mis-prune row groups
+        return calendar.timegm(v.utctimetuple()) * 1000 + v.microsecond // 1000
+    return v
+
+
+def _np_to_arrow(arr: np.ndarray, t: pa.DataType) -> pa.Array:
+    if t == pa.timestamp("ms"):
+        return pa.array(arr.astype("datetime64[ms]"))
+    return pa.array(arr, type=t)

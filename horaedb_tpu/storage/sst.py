@@ -1,0 +1,119 @@
+"""SST file handles: id allocation, metadata, TTL, compaction marking, paths.
+
+Reference: src/columnar_storage/src/sst.rs. Invariants preserved:
+- file ids come from a process-wide monotonic counter seeded with the
+  nanosecond wall clock, so ids never go backwards across restarts
+  (sst.rs:36-46) — the id doubles as the write sequence used for dedup;
+- `in_compaction` is a flag ensuring an SST is picked at most once
+  (mark/unmark, sst.rs:97-107);
+- `is_expired` compares the range end against a TTL horizon (sst.rs:109-114);
+- data path layout is `{prefix}/data/{id}.sst` (sst.rs:202-204).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.pb import sst_pb2
+from horaedb_tpu.storage.types import TimeRange
+
+PREFIX_PATH = "data"
+
+_U64_MASK = (1 << 64) - 1
+
+
+class _IdAllocator:
+    """Monotonic id allocator seeded from the ns clock (sst.rs:36-46).
+
+    Don't move the server clock backwards between restarts — same caveat as
+    the reference.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counter = itertools.count(time.time_ns() & _U64_MASK)
+
+    def allocate(self) -> int:
+        with self._lock:
+            return next(self._counter) & _U64_MASK
+
+
+_ALLOCATOR = _IdAllocator()
+
+
+def allocate_id() -> int:
+    return _ALLOCATOR.allocate()
+
+
+@dataclass(frozen=True)
+class FileMeta:
+    """SST metadata carried in the manifest (sst.rs FileMeta)."""
+
+    max_sequence: int
+    num_rows: int
+    size: int
+    time_range: TimeRange
+
+
+@dataclass
+class SstFile:
+    """Handle to one immutable sorted parquet SST."""
+
+    id: int
+    meta: FileMeta
+    _in_compaction: bool = field(default=False, compare=False)
+
+    # -- compaction marking (sst.rs:97-107) --------------------------------
+    def mark_compaction(self) -> None:
+        self._in_compaction = True
+
+    def unmark_compaction(self) -> None:
+        self._in_compaction = False
+
+    def is_compaction(self) -> bool:
+        return self._in_compaction
+
+    # -- TTL (sst.rs:109-114) ----------------------------------------------
+    def is_expired(self, expire_before_ms: int | None) -> bool:
+        if expire_before_ms is None:
+            return False
+        return self.meta.time_range.end < expire_before_ms
+
+    # -- protobuf bridge (sst.rs:125-190) ----------------------------------
+    def to_pb(self) -> sst_pb2.SstFile:
+        pb = sst_pb2.SstFile()
+        pb.id = self.id
+        pb.meta.max_sequence = self.meta.max_sequence
+        pb.meta.num_rows = self.meta.num_rows
+        pb.meta.size = self.meta.size
+        pb.meta.time_range.start = self.meta.time_range.start
+        pb.meta.time_range.end = self.meta.time_range.end
+        return pb
+
+    @classmethod
+    def from_pb(cls, pb: sst_pb2.SstFile) -> "SstFile":
+        if not pb.HasField("meta"):
+            raise HoraeError(f"sst pb missing meta: id={pb.id}")
+        return cls(
+            id=pb.id,
+            meta=FileMeta(
+                max_sequence=pb.meta.max_sequence,
+                num_rows=pb.meta.num_rows,
+                size=pb.meta.size,
+                time_range=TimeRange(pb.meta.time_range.start, pb.meta.time_range.end),
+            ),
+        )
+
+
+@dataclass(frozen=True)
+class SstPathGenerator:
+    """`{prefix}/data/{id}.sst` (sst.rs:202-204)."""
+
+    prefix: str
+
+    def generate(self, file_id: int) -> str:
+        return f"{self.prefix}/{PREFIX_PATH}/{file_id}.sst"

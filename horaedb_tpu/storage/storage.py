@@ -1,0 +1,252 @@
+"""The columnar storage engine.
+
+Reference: src/columnar_storage/src/storage.rs. The trait boundary is
+preserved (`ColumnarStorage { schema; write; scan; compact }`,
+storage.rs:58-89) and the object layout is identical:
+
+    {root}/manifest/snapshot          binary snapshot (manifest/encoding.py)
+    {root}/manifest/delta/{id}        protobuf deltas
+    {root}/data/{id}.sst              sorted parquet SSTs
+
+Execution is TPU-shaped instead of DataFusion-shaped:
+- write: per-batch primary-key sort runs as one XLA lexsort on device
+  (replacing MemoryExec->SortExec, storage.rs:244-256), then parquet encode
+  on host with sorting-columns metadata;
+- scan: per-segment fused device pipeline (storage/read.py), segments
+  unioned old->new (storage.rs:343-369);
+- every write is one new sorted SST — no WAL, no memtable; the SST write is
+  the durability event, then the manifest delta commits it (SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+from abc import ABC, abstractmethod
+from typing import AsyncIterator
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from horaedb_tpu.common.error import HoraeError, context, ensure
+from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.ops import sort as sort_ops
+from horaedb_tpu.ops.blocks import arrow_column_to_numpy
+from horaedb_tpu.storage.config import StorageConfig, UpdateMode, WriteConfig
+from horaedb_tpu.storage.manifest import Manifest
+from horaedb_tpu.storage.read import (
+    CompactRequest,
+    ParquetReader,
+    ScanRequest,
+    WriteRequest,
+)
+from horaedb_tpu.storage.sst import FileMeta, SstFile, SstPathGenerator, allocate_id
+from horaedb_tpu.storage.types import (
+    StorageSchema,
+    TimeRange,
+    Timestamp,
+    WriteResult,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ColumnarStorage(ABC):
+    """The storage-engine interface (storage.rs:77-87). The output stream of
+    `scan` is sorted by primary keys, old segments before new ones."""
+
+    @property
+    @abstractmethod
+    def schema(self) -> StorageSchema: ...
+
+    @abstractmethod
+    async def write(self, req: WriteRequest) -> None: ...
+
+    @abstractmethod
+    def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]: ...
+
+    @abstractmethod
+    async def compact(self, req: CompactRequest) -> None: ...
+
+
+class ObjectBasedStorage(ColumnarStorage):
+    """Object-store-backed engine (storage.rs ObjectBasedStorage)."""
+
+    def __init__(self) -> None:
+        raise HoraeError("use ObjectBasedStorage.try_new")
+
+    @classmethod
+    async def try_new(
+        cls,
+        root: str,
+        store: ObjectStore,
+        arrow_schema: pa.Schema,
+        num_primary_keys: int,
+        segment_duration_ms: int,
+        config: StorageConfig | None = None,
+        enable_compaction_scheduler: bool = True,
+        start_background_merger: bool = True,
+    ) -> "ObjectBasedStorage":
+        self = object.__new__(cls)
+        config = config or StorageConfig()
+        self._root = root.strip("/")
+        self._store = store
+        self._config = config
+        self._segment_duration = segment_duration_ms
+        self._schema = StorageSchema.try_new(
+            arrow_schema, num_primary_keys, config.update_mode
+        )
+        self._manifest = await Manifest.try_new(
+            self._root,
+            store,
+            config.manifest,
+            start_background_merger=start_background_merger,
+        )
+        self._path_gen = SstPathGenerator(self._root)
+        self._reader = ParquetReader(store, self._path_gen, self._schema)
+        self._scheduler = None
+        if enable_compaction_scheduler:
+            # imported lazily: compaction depends on this module's writer
+            from horaedb_tpu.storage.compaction.scheduler import CompactionScheduler
+
+            self._scheduler = CompactionScheduler(
+                storage=self,
+                manifest=self._manifest,
+                config=config.scheduler,
+                segment_duration_ms=segment_duration_ms,
+            )
+            self._scheduler.start()
+        return self
+
+    async def close(self) -> None:
+        if self._scheduler is not None:
+            await self._scheduler.close()
+        await self._manifest.close()
+
+    # -- accessors ----------------------------------------------------------
+    @property
+    def schema(self) -> StorageSchema:
+        return self._schema
+
+    @property
+    def manifest(self) -> Manifest:
+        return self._manifest
+
+    @property
+    def parquet_reader(self) -> ParquetReader:
+        return self._reader
+
+    @property
+    def segment_duration_ms(self) -> int:
+        return self._segment_duration
+
+    # -- write path (storage.rs:189-333) ------------------------------------
+    async def write(self, req: WriteRequest) -> None:
+        if req.enable_check:
+            start_seg = Timestamp(req.time_range.start).truncate_by(self._segment_duration)
+            end_seg = Timestamp(req.time_range.end - 1).truncate_by(self._segment_duration)
+            ensure(
+                start_seg == end_seg,
+                f"time range of one write must fall in one segment, "
+                f"range: [{req.time_range.start}, {req.time_range.end})",
+            )
+        result = await self.write_batch(req.batch)
+        meta = FileMeta(
+            max_sequence=result.seq,
+            num_rows=req.batch.num_rows,
+            size=result.size,
+            time_range=req.time_range,
+        )
+        await self._manifest.add_file(result.id, meta)
+
+    async def write_batch(self, batch: pa.RecordBatch) -> WriteResult:
+        file_id = allocate_id()
+        sorted_batch = await asyncio.to_thread(self._sort_batch, batch)
+        # file ids are increasing, so the id doubles as the sequence
+        with_builtin = self._schema.fill_builtin_columns(sorted_batch, file_id)
+        table = pa.Table.from_batches([with_builtin])
+        size = await self.write_sst(file_id, table)
+        return WriteResult(id=file_id, seq=file_id, size=size)
+
+    def _sort_batch(self, batch: pa.RecordBatch) -> pa.RecordBatch:
+        """Primary-key sort on device (replaces SortExec, storage.rs:244-256).
+
+        The permutation is computed over the numeric pk lanes with one XLA
+        lexsort; the gather applies to all columns via pyarrow take so binary
+        payloads never touch the device.
+        """
+        if batch.num_rows <= 1:
+            return batch
+        pk_names = self._schema.primary_key_names
+        keys = []
+        for name in pk_names:
+            keys.append(arrow_column_to_numpy(batch.column(batch.schema.names.index(name))))
+        perm = np.asarray(sort_ops.sort_permutation([np.asarray(k) for k in keys]))
+        return batch.take(pa.array(perm))
+
+    async def write_sst(self, file_id: int, table: pa.Table) -> int:
+        """Encode a (sorted, builtin-filled) table as one parquet SST and put
+        it to the object store; returns the object size."""
+        path = self._path_gen.generate(file_id)
+        cfg = self._config.write
+
+        def _encode() -> bytes:
+            sink = io.BytesIO()
+            sorting = [
+                pq.SortingColumn(i)
+                for i in range(self._schema.num_primary_keys)
+            ] + [pq.SortingColumn(self._schema.seq_idx)]
+            writer = pq.ParquetWriter(
+                sink,
+                table.schema,
+                compression=cfg.compression.value if cfg.compression.value != "none" else "NONE",
+                use_dictionary=cfg.enable_dict,
+                write_statistics=True,
+                sorting_columns=sorting if cfg.enable_sorting_columns else None,
+            )
+            for start in range(0, table.num_rows, cfg.max_row_group_size):
+                writer.write_table(
+                    table.slice(start, cfg.max_row_group_size),
+                    row_group_size=cfg.max_row_group_size,
+                )
+            writer.close()
+            return sink.getvalue()
+
+        data = await asyncio.to_thread(_encode)
+        with context(f"write sst {path}"):
+            await self._store.put(path, data)
+        return len(data)
+
+    # -- scan path (storage.rs:335-370) --------------------------------------
+    async def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]:
+        ssts = self._manifest.find_ssts(req.range)
+        if not ssts:
+            return
+        for segment_ssts in self.group_by_segment(ssts):
+            batches = await self._reader.scan_segment(
+                segment_ssts,
+                predicate=req.predicate,
+                projections=req.projections,
+                keep_builtin=False,
+            )
+            for b in batches:
+                yield b
+
+    def group_by_segment(self, ssts: list[SstFile]) -> list[list[SstFile]]:
+        """Bucket SSTs by segment start, ordered old->new (storage.rs:343-345)."""
+        buckets: dict[int, list[SstFile]] = {}
+        for s in ssts:
+            seg = Timestamp(s.meta.time_range.start).truncate_by(self._segment_duration)
+            buckets.setdefault(seg.value, []).append(s)
+        return [buckets[k] for k in sorted(buckets)]
+
+    # -- compaction (storage.rs:372-374) --------------------------------------
+    async def compact(self, req: CompactRequest) -> None:
+        ensure(self._scheduler is not None, "compaction scheduler disabled")
+        self._scheduler.trigger_compaction()
+
+    @property
+    def compaction_scheduler(self):
+        return self._scheduler

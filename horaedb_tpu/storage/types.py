@@ -1,0 +1,164 @@
+"""Core storage types: timestamps, time ranges, the storage schema contract.
+
+Reference: src/columnar_storage/src/types.rs. The schema contract is identical:
+
+    pk_1, ..., pk_N, value_1, ..., value_M, __seq__, __reserved__
+
+- the first `num_primary_keys` user columns are the primary key (sort key);
+- at least one value column must follow;
+- `__seq__` (uint64) is the write sequence (== SST file id) used for dedup;
+- `__reserved__` (uint64, all-null today) holds future tombstone/expiry flags.
+
+Host-side batches are pyarrow RecordBatches; `ops/blocks.py` defines the
+device-side struct-of-arrays layout the kernels consume.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import pyarrow as pa
+
+from horaedb_tpu.common.error import ensure
+
+BUILTIN_COLUMN_NUM = 2
+SEQ_COLUMN_NAME = "__seq__"
+RESERVED_COLUMN_NAME = "__reserved__"
+
+
+@dataclass(frozen=True, order=True)
+class Timestamp:
+    """Millisecond timestamp (reference: types.rs:45-133)."""
+
+    value: int
+
+    MIN = -(2**63)
+    MAX = 2**63 - 1
+
+    def truncate_by(self, duration_ms: int) -> "Timestamp":
+        """Floor to a segment boundary (python floordiv floors toward -inf,
+        matching the bucketing the picker needs for negative timestamps)."""
+        return Timestamp(self.value - self.value % duration_ms)
+
+    def __add__(self, other: "Timestamp | int") -> "Timestamp":
+        o = other.value if isinstance(other, Timestamp) else other
+        return Timestamp(self.value + o)
+
+    def __sub__(self, other: "Timestamp | int") -> "Timestamp":
+        o = other.value if isinstance(other, Timestamp) else other
+        return Timestamp(self.value - o)
+
+
+@dataclass(frozen=True)
+class TimeRange:
+    """Half-open [start, end) in ms (reference: types.rs passim)."""
+
+    start: int  # inclusive
+    end: int    # exclusive
+
+    def __post_init__(self) -> None:
+        ensure(self.start <= self.end, f"invalid time range [{self.start}, {self.end})")
+
+    def overlaps(self, other: "TimeRange") -> bool:
+        return self.start < other.end and other.start < self.end
+
+    def contains(self, ts: int) -> bool:
+        return self.start <= ts < self.end
+
+    def merge(self, other: "TimeRange") -> "TimeRange":
+        return TimeRange(min(self.start, other.start), max(self.end, other.end))
+
+    @classmethod
+    def union_of(cls, ranges: list["TimeRange"]) -> "TimeRange":
+        ensure(len(ranges) > 0, "cannot union zero time ranges")
+        out = ranges[0]
+        for r in ranges[1:]:
+            out = out.merge(r)
+        return out
+
+
+@dataclass
+class WriteResult:
+    """Outcome of one SST write (reference: types.rs WriteResult)."""
+
+    id: int
+    seq: int
+    size: int
+
+
+@dataclass
+class StorageSchema:
+    """User schema + builtin columns (reference: types.rs:143-240)."""
+
+    arrow_schema: pa.Schema
+    num_primary_keys: int
+    seq_idx: int
+    reserved_idx: int
+    value_idxes: list[int]
+    update_mode: "object" = None  # UpdateMode; typed loosely to avoid import cycle
+
+    @classmethod
+    def try_new(
+        cls,
+        arrow_schema: pa.Schema,
+        num_primary_keys: int,
+        update_mode,
+    ) -> "StorageSchema":
+        ensure(num_primary_keys > 0, "num_primary_keys should large than 0")
+        names = arrow_schema.names
+        ensure(
+            SEQ_COLUMN_NAME not in names and RESERVED_COLUMN_NAME not in names,
+            "schema should not use builtin columns name",
+        )
+        value_idxes = list(range(num_primary_keys, len(names)))
+        ensure(len(value_idxes) > 0, "no value column found")
+
+        fields = list(arrow_schema) + [
+            pa.field(SEQ_COLUMN_NAME, pa.uint64(), nullable=True),
+            pa.field(RESERVED_COLUMN_NAME, pa.uint64(), nullable=True),
+        ]
+        full = pa.schema(fields, metadata=arrow_schema.metadata)
+        return cls(
+            arrow_schema=full,
+            num_primary_keys=num_primary_keys,
+            seq_idx=len(fields) - 2,
+            reserved_idx=len(fields) - 1,
+            value_idxes=value_idxes,
+            update_mode=update_mode,
+        )
+
+    @staticmethod
+    def is_builtin_name(name: str) -> bool:
+        return name in (SEQ_COLUMN_NAME, RESERVED_COLUMN_NAME)
+
+    @property
+    def primary_key_names(self) -> list[str]:
+        return self.arrow_schema.names[: self.num_primary_keys]
+
+    @property
+    def user_schema(self) -> pa.Schema:
+        """Schema without builtin columns (what scan returns by default)."""
+        return pa.schema(
+            [self.arrow_schema.field(i) for i in range(len(self.arrow_schema.names) - BUILTIN_COLUMN_NUM)],
+            metadata=self.arrow_schema.metadata,
+        )
+
+    def fill_required_projections(self, projections: list[int] | None) -> list[int] | None:
+        """Primary keys + __seq__ are always fetched (reference: types.rs:203-216)."""
+        if projections is None:
+            return None
+        proj = list(projections)
+        for i in range(self.num_primary_keys):
+            if i not in proj:
+                proj.append(i)
+        if self.seq_idx not in proj:
+            proj.append(self.seq_idx)
+        return proj
+
+    def fill_builtin_columns(self, batch: pa.RecordBatch, sequence: int) -> pa.RecordBatch:
+        """Append __seq__=sequence and all-null __reserved__ (types.rs:219-239)."""
+        n = batch.num_rows
+        cols = list(batch.columns)
+        cols.append(pa.array([sequence] * n, type=pa.uint64()))
+        cols.append(pa.nulls(n, type=pa.uint64()))
+        return pa.RecordBatch.from_arrays(cols, schema=self.arrow_schema)

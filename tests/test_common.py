@@ -1,0 +1,105 @@
+"""Foundation tests (reference: time_ext.rs:219-288, size_ext.rs:190-295)."""
+
+import pytest
+
+from horaedb_tpu.common import (
+    HoraeError,
+    ReadableDuration,
+    ReadableSize,
+    context,
+    ensure,
+    now_ms,
+)
+
+
+class TestReadableDuration:
+    @pytest.mark.parametrize(
+        "text,ms",
+        [
+            ("1s", 1000),
+            ("2h5m", 2 * 3600_000 + 5 * 60_000),
+            ("1d", 24 * 3600_000),
+            ("500ms", 500),
+            ("1d2h3m4s5ms", 24 * 3600_000 + 2 * 3600_000 + 3 * 60_000 + 4000 + 5),
+            ("0.5h", 1800_000),
+            ("12h", 12 * 3600_000),
+            ("150", 150),  # bare number == ms
+        ],
+    )
+    def test_parse(self, text, ms):
+        assert ReadableDuration.parse(text).ms == ms
+
+    @pytest.mark.parametrize("bad", ["", "abc", "1x", "5m2h", "h", "1s500ms1d"])
+    def test_parse_invalid(self, bad):
+        with pytest.raises(HoraeError):
+            ReadableDuration.parse(bad)
+
+    @pytest.mark.parametrize(
+        "ms,text",
+        [
+            (1000, "1s"),
+            (2 * 3600_000 + 5 * 60_000, "2h5m"),
+            (0, "0s"),
+            (25 * 3600_000, "1d1h"),
+            (1500, "1s500ms"),
+        ],
+    )
+    def test_roundtrip_str(self, ms, text):
+        assert str(ReadableDuration(ms)) == text
+        assert ReadableDuration.parse(text).ms == ms
+
+    def test_constructors(self):
+        assert ReadableDuration.hours(12).ms == 12 * 3600_000
+        assert ReadableDuration.secs(5).seconds == 5.0
+        assert ReadableDuration.days(1) == ReadableDuration.hours(24)
+
+
+class TestReadableSize:
+    @pytest.mark.parametrize(
+        "text,n",
+        [
+            ("2GiB", 2 * 1024**3),
+            ("2GB", 2 * 1024**3),
+            ("512MiB", 512 * 1024**2),
+            ("4KB", 4096),
+            ("123B", 123),
+            ("123", 123),
+            ("0.5e6 B", 500_000),
+            ("1.5KiB", 1536),
+        ],
+    )
+    def test_parse(self, text, n):
+        assert ReadableSize.parse(text).bytes == n
+
+    @pytest.mark.parametrize("bad", ["", "GiB", "1QiB", "-1KB"])
+    def test_parse_invalid(self, bad):
+        with pytest.raises(HoraeError):
+            ReadableSize.parse(bad)
+
+    def test_str(self):
+        assert str(ReadableSize.gb(2)) == "2GiB"
+        assert str(ReadableSize(1536)) == "1536B"  # not an even KiB multiple... 1536 = 1.5KiB
+        assert str(ReadableSize.kb(4)) == "4KiB"
+
+    def test_constructors(self):
+        assert ReadableSize.mb(1).bytes == 1024**2
+
+
+class TestError:
+    def test_ensure(self):
+        ensure(True, "fine")
+        with pytest.raises(HoraeError, match="boom"):
+            ensure(False, "boom")
+
+    def test_context_chain(self):
+        with pytest.raises(HoraeError) as ei:
+            with context("outer"):
+                with context("inner"):
+                    raise ValueError("root cause")
+        assert "outer" in str(ei.value)
+        assert "inner" in str(ei.value)
+        assert "root cause" in str(ei.value)
+
+    def test_now_ms(self):
+        a = now_ms()
+        assert a > 1_700_000_000_000  # sanity: after 2023

@@ -1,0 +1,164 @@
+"""Compaction tests (reference: picker.rs:201-236 + executor semantics)."""
+
+import asyncio
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
+from horaedb_tpu.common.size_ext import ReadableSize
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.storage import (
+    ObjectBasedStorage,
+    ScanRequest,
+    SchedulerConfig,
+    StorageConfig,
+    TimeRange,
+    WriteRequest,
+)
+from horaedb_tpu.storage.compaction.picker import TimeWindowCompactionStrategy
+from horaedb_tpu.storage.sst import FileMeta, SstFile
+from tests.conftest import async_test
+from tests.test_storage import SEGMENT_MS, collect, make_batch, make_schema
+
+HOUR = 3_600_000
+
+
+def sst(i, start, size=100, rows=10):
+    return SstFile(
+        id=i,
+        meta=FileMeta(max_sequence=i, num_rows=rows, size=size, time_range=TimeRange(start, start + 10)),
+    )
+
+
+class TestPicker:
+    def make_picker(self, min_num=2, max_num=30, max_size=1 << 30):
+        return TimeWindowCompactionStrategy(
+            segment_duration_ms=HOUR,
+            new_sst_max_size=max_size,
+            input_sst_max_num=max_num,
+            input_sst_min_num=min_num,
+        )
+
+    def test_picks_newest_segment_first(self):
+        p = self.make_picker()
+        files = [sst(1, 0), sst(2, 10), sst(3, HOUR), sst(4, HOUR + 10)]
+        task = p.pick_candidate(files, None)
+        assert sorted(f.id for f in task.inputs) == [3, 4]
+        assert all(f.is_compaction() for f in task.inputs)
+
+    def test_min_num_not_met(self):
+        p = self.make_picker(min_num=5)
+        files = [sst(i, 0) for i in range(4)]
+        assert p.pick_candidate(files, None) is None
+
+    def test_in_compaction_files_excluded(self):
+        p = self.make_picker()
+        files = [sst(1, 0), sst(2, 0), sst(3, 0)]
+        files[0].mark_compaction()
+        task = p.pick_candidate(files, None)
+        assert sorted(f.id for f in task.inputs) == [2, 3]
+
+    def test_smallest_files_first_and_size_budget(self):
+        p = self.make_picker(min_num=2, max_size=100)
+        # budget = 110; sizes 10,20,90 -> picks 10,20 (90 would exceed)
+        files = [sst(1, 0, size=90), sst(2, 0, size=10), sst(3, 0, size=20)]
+        task = p.pick_candidate(files, None)
+        assert sorted(f.id for f in task.inputs) == [2, 3]
+
+    def test_max_num_cap(self):
+        p = self.make_picker(min_num=2, max_num=3)
+        files = [sst(i, 0, size=1) for i in range(10)]
+        task = p.pick_candidate(files, None)
+        assert len(task.inputs) == 3
+
+    def test_ttl_expired_ride_along(self):
+        p = self.make_picker()
+        old = [sst(1, 0), sst(2, 0)]
+        fresh = [sst(3, HOUR * 10), sst(4, HOUR * 10)]
+        task = p.pick_candidate(old + fresh, expire_before_ms=HOUR)
+        assert sorted(f.id for f in task.expireds) == [1, 2]
+        assert sorted(f.id for f in task.inputs) == [3, 4]
+
+    def test_expired_only_never_forms_task(self):
+        """Reference quirk preserved (picker.rs:92-95)."""
+        p = self.make_picker()
+        old = [sst(1, 0), sst(2, 0)]
+        assert p.pick_candidate(old, expire_before_ms=HOUR * 100) is None
+
+
+class TestExecutor:
+    @async_test
+    async def test_end_to_end_compaction(self):
+        store = MemStore()
+        cfg = StorageConfig(
+            scheduler=SchedulerConfig(
+                schedule_interval=ReadableDuration.millis(50),
+                input_sst_min_num=2,
+            )
+        )
+        eng = await ObjectBasedStorage.try_new(
+            "db", store, make_schema(), 2, SEGMENT_MS,
+            config=cfg, start_background_merger=False,
+        )
+        schema = make_schema()
+        for i in range(4):
+            await eng.write(
+                WriteRequest(
+                    make_batch(schema, [1, 2 + i], [0, 0], [10, 20], [float(i), 100.0 + i]),
+                    TimeRange(10, 21),
+                )
+            )
+        assert len(eng.manifest.all_ssts()) == 4
+        sched = eng.compaction_scheduler
+        assert sched.pick_once()
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if len(eng.manifest.all_ssts()) == 1:
+                break
+        await sched.executor.drain()
+        ssts = eng.manifest.all_ssts()
+        assert len(ssts) == 1
+        # merged SST: dedup kept newest value for pk (1,0)
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        row0 = t.filter(pa.compute.equal(t.column("pk1"), 1))
+        assert row0.column("value").to_pylist() == [3.0]
+        assert t.num_rows == 5  # pks: (1,0),(2,0),(3,0),(4,0),(5,0)
+        # old files physically deleted, only the new SST remains
+        data_objs = await store.list("db/data")
+        assert len(data_objs) == 1
+        await eng.close()
+
+    @async_test
+    async def test_memory_gate_rejects_oversize_task(self):
+        from horaedb_tpu.storage.compaction import Task
+        from horaedb_tpu.storage.compaction.executor import Executor
+        from horaedb_tpu.common.error import HoraeError
+
+        ex = Executor(storage=None, manifest=None, mem_limit=100, trigger=asyncio.Queue(1))
+        big = [sst(1, 0, size=80), sst(2, 0, size=80)]
+        for f in big:
+            f.mark_compaction()
+        task = Task(inputs=big)
+        with pytest.raises(HoraeError, match="memory usage too high"):
+            ex.pre_check(task)
+        # a rejected task never charged the budget; on_failure must not
+        # refund it into the negative (that would defeat the gate)
+        ex.on_failure(task)
+        assert ex._inused_memory == 0
+
+    @async_test
+    async def test_failure_unmarks_ssts(self):
+        from horaedb_tpu.storage.compaction import Task
+        from horaedb_tpu.storage.compaction.executor import Executor
+
+        ex = Executor(storage=None, manifest=None, mem_limit=10_000, trigger=asyncio.Queue(1))
+        files = [sst(1, 0), sst(2, 0)]
+        for f in files:
+            f.mark_compaction()
+        task = Task(inputs=files)
+        ex.pre_check(task)
+        ex.on_failure(task)
+        assert ex._inused_memory == 0
+        assert not any(f.is_compaction() for f in files)

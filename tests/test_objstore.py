@@ -1,0 +1,59 @@
+"""Object-store contract tests, run against both backends."""
+
+import pytest
+
+from horaedb_tpu.objstore import LocalStore, MemStore, NotFound
+from tests.conftest import async_test
+
+
+@pytest.fixture(params=["mem", "local"])
+def store(request, tmp_path):
+    if request.param == "mem":
+        return MemStore()
+    return LocalStore(str(tmp_path / "store"))
+
+
+@async_test
+async def _roundtrip(store):
+    await store.put("a/b/file1", b"hello")
+    await store.put("a/b/file2", b"world!")
+    await store.put("a/other", b"x")
+
+    assert await store.get("a/b/file1") == b"hello"
+    meta = await store.head("a/b/file2")
+    assert meta.size == 6
+
+    listed = await store.list("a/b")
+    assert [m.path for m in listed] == ["a/b/file1", "a/b/file2"]
+
+    await store.delete("a/b/file1")
+    with pytest.raises(NotFound):
+        await store.get("a/b/file1")
+    with pytest.raises(NotFound):
+        await store.head("a/b/file1")
+    with pytest.raises(NotFound):
+        await store.delete("a/b/file1")
+
+
+def test_roundtrip(store):
+    _roundtrip(store)
+
+
+@async_test
+async def _overwrite(store):
+    await store.put("k", b"v1")
+    await store.put("k", b"v2")
+    assert await store.get("k") == b"v2"
+
+
+def test_overwrite(store):
+    _overwrite(store)
+
+
+@async_test
+async def _list_empty(store):
+    assert await store.list("nope") == []
+
+
+def test_list_empty(store):
+    _list_empty(store)

@@ -1,0 +1,234 @@
+"""Device kernels vs numpy oracles (SURVEY §4: 'differential tests of device
+kernels vs numpy oracles at small scale')."""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.ops import aggregate, dedup, filter as filter_ops, merge, sort
+from horaedb_tpu.ops.blocks import Block, sort_sentinel
+
+
+def rand_columns(rng, n, key_space=10):
+    return {
+        "pk1": rng.integers(0, key_space, n).astype(np.int64),
+        "pk2": rng.integers(0, key_space, n).astype(np.int64),
+        "ts": rng.integers(0, 1_000_000, n).astype(np.int64),
+        "value": rng.normal(size=n).astype(np.float64),
+        "__seq__": rng.integers(0, 100, n).astype(np.uint64),
+    }
+
+
+class TestBlock:
+    def test_pad_and_roundtrip(self):
+        rng = np.random.default_rng(0)
+        arrays = rand_columns(rng, 100)
+        b = Block.from_numpy(arrays, pad_multiple=64, pad_keys=("pk1", "pk2"))
+        assert b.padded_len == 128
+        assert b.num_valid == 100
+        back = b.to_numpy()
+        for k in arrays:
+            np.testing.assert_array_equal(back[k], arrays[k])
+        # padding keys are max sentinels
+        pad_region = np.asarray(b.columns["pk1"])[100:]
+        assert (pad_region == np.iinfo(np.int64).max).all()
+        pad_vals = np.asarray(b.columns["value"])[100:]
+        assert (pad_vals == 0).all()
+
+    def test_sentinels(self):
+        assert sort_sentinel(np.int64) == np.iinfo(np.int64).max
+        assert sort_sentinel(np.float64) == np.inf
+        assert sort_sentinel(np.uint64) == np.iinfo(np.uint64).max
+
+    def test_arrow_roundtrip(self):
+        import pyarrow as pa
+
+        batch = pa.RecordBatch.from_pydict(
+            {"a": pa.array([1, 2, 3], type=pa.int64()), "v": pa.array([1.0, 2.0, 3.0])}
+        )
+        b = Block.from_arrow(batch, pad_multiple=8)
+        out = b.to_arrow()
+        assert out.num_rows == 3
+        assert out.column(0).to_pylist() == [1, 2, 3]
+
+
+class TestSort:
+    def test_matches_numpy_lexsort(self):
+        rng = np.random.default_rng(1)
+        cols = rand_columns(rng, 1000, key_space=20)
+        b = Block.from_numpy(cols, pad_multiple=256, pad_keys=("pk1", "pk2", "__seq__"))
+        out = sort.sort_columns(b.columns, ["pk1", "pk2", "__seq__"])
+        got = {k: np.asarray(v)[: b.num_valid] for k, v in out.items()}
+
+        order = np.lexsort((cols["__seq__"], cols["pk2"], cols["pk1"]))
+        for k in cols:
+            np.testing.assert_array_equal(got[k], cols[k][order])
+
+    def test_stability(self):
+        """Equal keys keep input order (required for the seq tie-break)."""
+        keys = np.array([2, 1, 2, 1, 2], dtype=np.int64)
+        payload = np.arange(5, dtype=np.int64)
+        out = sort.sort_columns({"k": keys, "p": payload}, ["k"])
+        np.testing.assert_array_equal(np.asarray(out["p"]), [1, 3, 0, 2, 4])
+
+
+class TestFilter:
+    def test_compare_and_bool_algebra(self):
+        rng = np.random.default_rng(2)
+        cols = rand_columns(rng, 500)
+        b = Block.from_numpy(cols, pad_multiple=512)
+        pred = filter_ops.And(
+            filter_ops.Compare("pk1", "eq", 3),
+            filter_ops.Or(
+                filter_ops.Compare("value", "gt", 0.0),
+                filter_ops.Compare("ts", "lt", 500_000),
+            ),
+        )
+        mask = np.asarray(filter_ops.eval_predicate(pred, b.columns))[: b.num_valid]
+        expect = (cols["pk1"] == 3) & ((cols["value"] > 0.0) | (cols["ts"] < 500_000))
+        np.testing.assert_array_equal(mask, expect)
+
+    def test_in_set(self):
+        cols = {"tsid": np.array([1, 5, 9, 5, 2], dtype=np.int64)}
+        mask = np.asarray(
+            filter_ops.eval_predicate(filter_ops.InSet("tsid", (5, 2)), cols)
+        )
+        np.testing.assert_array_equal(mask, [False, True, False, True, True])
+
+    def test_none_predicate_keeps_all(self):
+        cols = {"a": np.zeros(4, dtype=np.int64)}
+        assert np.asarray(filter_ops.eval_predicate(None, cols)).all()
+
+    def test_time_range_pred(self):
+        cols = {"ts": np.array([5, 10, 15, 20], dtype=np.int64)}
+        pred = filter_ops.time_range_pred("ts", 10, 20)
+        mask = np.asarray(filter_ops.eval_predicate(pred, cols))
+        np.testing.assert_array_equal(mask, [False, True, True, False])
+
+    def test_prune_range(self):
+        pred = filter_ops.And(
+            filter_ops.Compare("ts", "ge", 100),
+            filter_ops.Compare("ts", "lt", 200),
+        )
+        assert filter_ops.prune_range(pred, {"ts": (150, 180)})
+        assert filter_ops.prune_range(pred, {"ts": (0, 100)})      # 100 satisfies ge
+        assert not filter_ops.prune_range(pred, {"ts": (0, 99)})
+        assert not filter_ops.prune_range(pred, {"ts": (200, 300)})
+        assert filter_ops.prune_range(pred, {})                     # unknown col: keep
+        assert filter_ops.prune_range(None, {"ts": (0, 1)})
+
+
+class TestDedup:
+    def test_last_value_mask_matches_pandas_style_oracle(self):
+        rng = np.random.default_rng(3)
+        n = 800
+        cols = rand_columns(rng, n, key_space=8)
+        b = Block.from_numpy(cols, pad_multiple=1024, pad_keys=("pk1", "pk2", "__seq__"))
+        sorted_cols = sort.sort_columns(b.columns, ["pk1", "pk2", "__seq__"])
+        keep = np.asarray(
+            dedup.dedup_last_value(sorted_cols, ["pk1", "pk2"], b.num_valid)
+        )
+        got = {k: np.asarray(v)[keep] for k, v in sorted_cols.items()}
+
+        # oracle: for each (pk1, pk2) keep the row with max seq (ties: later row)
+        order = np.lexsort((cols["__seq__"], cols["pk2"], cols["pk1"]))
+        s = {k: v[order] for k, v in cols.items()}
+        expect_idx = []
+        i = 0
+        while i < n:
+            j = i
+            while j + 1 < n and s["pk1"][j + 1] == s["pk1"][i] and s["pk2"][j + 1] == s["pk2"][i]:
+                j += 1
+            expect_idx.append(j)
+            i = j + 1
+        for k in cols:
+            np.testing.assert_array_equal(got[k], s[k][np.array(expect_idx)])
+
+    def test_run_starts_and_segment_ids(self):
+        import jax.numpy as jnp
+
+        keys = jnp.asarray(np.array([1, 1, 2, 2, 2, 3], dtype=np.int64))
+        valid = jnp.ones(6, dtype=bool)
+        starts = np.asarray(dedup.run_starts([keys], valid))
+        np.testing.assert_array_equal(starts, [True, False, True, False, False, True])
+        seg = np.asarray(dedup.segment_ids(dedup.run_starts([keys], valid)))
+        np.testing.assert_array_equal(seg, [0, 0, 1, 1, 1, 2])
+
+
+class TestMerge:
+    def test_kway_merge_equals_global_sort(self):
+        rng = np.random.default_rng(4)
+        parts = []
+        all_rows = []
+        for _ in range(5):
+            cols = rand_columns(rng, 200, key_space=50)
+            order = np.lexsort((cols["__seq__"], cols["pk2"], cols["pk1"]))
+            cols = {k: v[order] for k, v in cols.items()}
+            all_rows.append(cols)
+            parts.append(
+                Block.from_numpy(cols, pad_multiple=256, pad_keys=("pk1", "pk2", "__seq__"))
+            )
+        merged = merge.merge_sorted([p.columns for p in parts], ["pk1", "pk2", "__seq__"])
+        total_valid = sum(p.num_valid for p in parts)
+        got = {k: np.asarray(v)[:total_valid] for k, v in merged.items()}
+
+        cat = {k: np.concatenate([r[k] for r in all_rows]) for k in all_rows[0]}
+        order = np.lexsort((cat["__seq__"], cat["pk2"], cat["pk1"]))
+        for k in cat:
+            np.testing.assert_array_equal(got[k], cat[k][order])
+
+
+class TestAggregate:
+    def test_grouped_stats_oracle(self):
+        rng = np.random.default_rng(5)
+        n, g = 1000, 16
+        idx = rng.integers(0, g, n).astype(np.int32)
+        vals = rng.normal(size=n)
+        valid = rng.random(n) < 0.9
+        out = aggregate.grouped_stats(vals, idx, valid, g)
+        for gi in range(g):
+            sel = vals[(idx == gi) & valid]
+            assert np.isclose(float(out["sum"][gi]), sel.sum())
+            assert float(out["count"][gi]) == len(sel)
+            if len(sel):
+                assert np.isclose(float(out["min"][gi]), sel.min())
+                assert np.isclose(float(out["max"][gi]), sel.max())
+                assert np.isclose(float(out["mean"][gi]), sel.mean())
+
+    def test_downsample_oracle(self):
+        rng = np.random.default_rng(6)
+        n, num_series, num_buckets = 2000, 4, 10
+        bucket_ms = 300_000  # 5m
+        t0 = 1_000_000
+        ts = t0 + rng.integers(0, num_buckets * bucket_ms, n).astype(np.int64)
+        sid = rng.integers(0, num_series, n).astype(np.int32)
+        vals = rng.normal(size=n)
+        valid = np.ones(n, dtype=bool)
+        out = aggregate.downsample(ts, sid, vals, valid, t0, bucket_ms, num_series, num_buckets)
+        assert out["mean"].shape == (num_series, num_buckets)
+        bucket = (ts - t0) // bucket_ms
+        for s in range(num_series):
+            for bkt in range(num_buckets):
+                sel = vals[(sid == s) & (bucket == bkt)]
+                if len(sel):
+                    assert np.isclose(float(out["mean"][s, bkt]), sel.mean()), (s, bkt)
+                else:
+                    assert float(out["count"][s, bkt]) == 0
+
+    def test_downsample_out_of_grid_rows_dropped(self):
+        ts = np.array([0, 1_000_000_000], dtype=np.int64)
+        sid = np.array([0, 0], dtype=np.int32)
+        vals = np.array([1.0, 99.0])
+        out = aggregate.downsample(
+            ts, sid, vals, np.ones(2, dtype=bool), 0, 1000, 1, 10
+        )
+        assert float(out["sum"].sum()) == 1.0
+
+    def test_segment_last_value(self):
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+        seq = np.array([10, 30, 20, 5], dtype=np.uint64)
+        idx = np.array([0, 0, 1, 1], dtype=np.int32)
+        valid = np.ones(4, dtype=bool)
+        out = np.asarray(
+            aggregate.segment_last_value(vals, seq, idx, valid, 2)
+        )
+        np.testing.assert_allclose(out, [2.0, 3.0])  # max-seq value per group

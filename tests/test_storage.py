@@ -1,0 +1,300 @@
+"""Engine round-trip tests (reference: storage.rs:377-537 inline tests)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.ops import filter as F
+from horaedb_tpu.storage import (
+    ObjectBasedStorage,
+    ScanRequest,
+    StorageConfig,
+    TimeRange,
+    UpdateMode,
+    WriteRequest,
+)
+from tests.conftest import async_test
+
+SEGMENT_MS = 3_600_000
+
+
+def make_schema():
+    return pa.schema(
+        [
+            ("pk1", pa.int64()),
+            ("pk2", pa.int64()),
+            ("ts", pa.int64()),
+            ("value", pa.float64()),
+        ]
+    )
+
+
+def make_batch(schema, pk1, pk2, ts, value):
+    return pa.RecordBatch.from_pydict(
+        {
+            "pk1": np.asarray(pk1, dtype=np.int64),
+            "pk2": np.asarray(pk2, dtype=np.int64),
+            "ts": np.asarray(ts, dtype=np.int64),
+            "value": np.asarray(value, dtype=np.float64),
+        },
+        schema=schema,
+    )
+
+
+async def new_engine(store, schema=None, num_pks=2, config=None):
+    return await ObjectBasedStorage.try_new(
+        root="db",
+        store=store,
+        arrow_schema=schema or make_schema(),
+        num_primary_keys=num_pks,
+        segment_duration_ms=SEGMENT_MS,
+        config=config,
+        enable_compaction_scheduler=False,
+        start_background_merger=False,
+    )
+
+
+async def collect(engine, req):
+    out = []
+    async for b in engine.scan(req):
+        out.append(b)
+    return pa.Table.from_batches(out) if out else None
+
+
+class TestWriteScan:
+    @async_test
+    async def test_roundtrip_overwrite_dedup(self):
+        """Two overlapping writes; newest seq wins per pk (storage.rs:392-491)."""
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        await eng.write(
+            WriteRequest(
+                make_batch(schema, [1, 2, 3], [0, 0, 0], [100, 200, 300], [1.0, 2.0, 3.0]),
+                TimeRange(100, 301),
+            )
+        )
+        await eng.write(
+            WriteRequest(
+                make_batch(schema, [2, 3, 4], [0, 0, 0], [201, 301, 401], [20.0, 30.0, 40.0]),
+                TimeRange(201, 402),
+            )
+        )
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.column("pk1").to_pylist() == [1, 2, 3, 4]
+        assert t.column("value").to_pylist() == [1.0, 20.0, 30.0, 40.0]
+        # builtin columns are stripped from scan output
+        assert t.schema.names == ["pk1", "pk2", "ts", "value"]
+        await eng.close()
+
+    @async_test
+    async def test_sorted_output_across_many_writes(self):
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        rng = np.random.default_rng(0)
+        seen = {}
+        for w in range(6):
+            pk1 = rng.integers(0, 50, 40)
+            pk2 = rng.integers(0, 4, 40)
+            vals = rng.normal(size=40)
+            await eng.write(
+                WriteRequest(
+                    make_batch(schema, pk1, pk2, np.full(40, 10), vals),
+                    TimeRange(10, 11),
+                )
+            )
+            for a, b, v in zip(pk1, pk2, vals):
+                seen[(a, b)] = v  # later writes overwrite
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        got = list(zip(t.column("pk1").to_pylist(), t.column("pk2").to_pylist()))
+        assert got == sorted(seen.keys())
+        for (a, b), v in zip(got, t.column("value").to_pylist()):
+            assert np.isclose(v, seen[(a, b)])
+        await eng.close()
+
+    @async_test
+    async def test_scan_with_predicate_and_projection(self):
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        await eng.write(
+            WriteRequest(
+                make_batch(schema, [1, 1, 2, 2], [1, 2, 1, 2], [10, 20, 30, 40], [1, 2, 3, 4]),
+                TimeRange(10, 41),
+            )
+        )
+        t = await collect(
+            eng,
+            ScanRequest(
+                range=TimeRange(0, SEGMENT_MS),
+                predicate=F.Compare("pk1", "eq", 1),
+                projections=[0, 1, 3],  # pk1, pk2, value
+            ),
+        )
+        assert t.schema.names == ["pk1", "pk2", "value"]
+        assert t.column("pk1").to_pylist() == [1, 1]
+        assert t.column("value").to_pylist() == [1.0, 2.0]
+        await eng.close()
+
+    @async_test
+    async def test_scan_with_inset_predicate(self):
+        """InSet (TSID membership) must evaluate inside the jitted kernel."""
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        await eng.write(
+            WriteRequest(
+                make_batch(schema, [1, 2, 3, 4], [0, 0, 0, 0], [10, 20, 30, 40], [1, 2, 3, 4]),
+                TimeRange(10, 41),
+            )
+        )
+        t = await collect(
+            eng,
+            ScanRequest(range=TimeRange(0, SEGMENT_MS), predicate=F.InSet("pk1", (2, 4))),
+        )
+        assert t.column("pk1").to_pylist() == [2, 4]
+        await eng.close()
+
+    @async_test
+    async def test_filter_before_dedup_reference_semantics(self):
+        """Filter runs before dedup (plan order read.rs:429-494): if the newest
+        version is filtered out, the older version surfaces."""
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        await eng.write(
+            WriteRequest(make_batch(schema, [1], [1], [10], [5.0]), TimeRange(10, 11))
+        )
+        await eng.write(
+            WriteRequest(make_batch(schema, [1], [1], [10], [50.0]), TimeRange(10, 11))
+        )
+        t = await collect(
+            eng,
+            ScanRequest(
+                range=TimeRange(0, SEGMENT_MS),
+                predicate=F.Compare("value", "lt", 10.0),
+            ),
+        )
+        assert t.column("value").to_pylist() == [5.0]
+        await eng.close()
+
+    @async_test
+    async def test_multi_segment_scan_old_to_new(self):
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        # segment 1 (hour 1) has larger pks than segment 0: output must still
+        # be old-segment first (trait contract, storage.rs:82-84)
+        t1 = SEGMENT_MS + 5
+        await eng.write(
+            WriteRequest(make_batch(schema, [1], [0], [t1], [11.0]), TimeRange(t1, t1 + 1))
+        )
+        await eng.write(
+            WriteRequest(make_batch(schema, [9], [0], [5], [9.0]), TimeRange(5, 6))
+        )
+        t = await collect(eng, ScanRequest(range=TimeRange(0, 2 * SEGMENT_MS)))
+        assert t.column("value").to_pylist() == [9.0, 11.0]
+        await eng.close()
+
+    @async_test
+    async def test_empty_scan_range(self):
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        await eng.write(
+            WriteRequest(make_batch(schema, [1], [1], [10], [1.0]), TimeRange(10, 11))
+        )
+        assert await collect(eng, ScanRequest(range=TimeRange(1000, 2000))) is None
+        await eng.close()
+
+    @async_test
+    async def test_write_cross_segment_rejected(self):
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        with pytest.raises(HoraeError, match="one segment"):
+            await eng.write(
+                WriteRequest(
+                    make_batch(schema, [1], [1], [10], [1.0]),
+                    TimeRange(10, SEGMENT_MS + 10),
+                )
+            )
+        await eng.close()
+
+    @async_test
+    async def test_restart_recovery(self):
+        store = MemStore()
+        eng = await new_engine(store)
+        schema = make_schema()
+        await eng.write(
+            WriteRequest(make_batch(schema, [1, 2], [0, 0], [10, 20], [1.0, 2.0]), TimeRange(10, 21))
+        )
+        await eng.close()
+        eng2 = await new_engine(store)
+        t = await collect(eng2, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.column("value").to_pylist() == [1.0, 2.0]
+        await eng2.close()
+
+
+class TestAppendMode:
+    @async_test
+    async def test_append_mode_keeps_duplicates(self):
+        """Append mode without binary columns: duplicates all survive, sorted."""
+        store = MemStore()
+        cfg = StorageConfig(update_mode=UpdateMode.APPEND)
+        eng = await new_engine(store, config=cfg)
+        schema = make_schema()
+        await eng.write(
+            WriteRequest(make_batch(schema, [1], [1], [10], [1.0]), TimeRange(10, 11))
+        )
+        await eng.write(
+            WriteRequest(make_batch(schema, [1], [1], [10], [2.0]), TimeRange(10, 11))
+        )
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.column("value").to_pylist() == [1.0, 2.0]
+        await eng.close()
+
+    @async_test
+    async def test_append_mode_binary_concat(self):
+        """Append mode with binary values: groups concat bytes
+        (BytesMergeOperator, operator.rs:59-111)."""
+        store = MemStore()
+        schema = pa.schema([("pk", pa.int64()), ("payload", pa.binary())])
+        cfg = StorageConfig(update_mode=UpdateMode.APPEND)
+        eng = await new_engine(store, schema=schema, num_pks=1, config=cfg)
+        b1 = pa.RecordBatch.from_pydict(
+            {"pk": np.array([1, 2], dtype=np.int64), "payload": [b"aa", b"xx"]}, schema=schema
+        )
+        b2 = pa.RecordBatch.from_pydict(
+            {"pk": np.array([1], dtype=np.int64), "payload": [b"bb"]}, schema=schema
+        )
+        await eng.write(WriteRequest(b1, TimeRange(10, 11)))
+        await eng.write(WriteRequest(b2, TimeRange(10, 11)))
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.column("pk").to_pylist() == [1, 2]
+        assert t.column("payload").to_pylist() == [b"aabb", b"xx"]
+        await eng.close()
+
+
+class TestOverwriteBinary:
+    @async_test
+    async def test_overwrite_with_binary_value(self):
+        """Overwrite mode with a binary value column: hybrid device/host path."""
+        store = MemStore()
+        schema = pa.schema([("pk", pa.int64()), ("payload", pa.binary())])
+        eng = await new_engine(store, schema=schema, num_pks=1)
+        b1 = pa.RecordBatch.from_pydict(
+            {"pk": np.array([1, 2], dtype=np.int64), "payload": [b"old1", b"old2"]}, schema=schema
+        )
+        b2 = pa.RecordBatch.from_pydict(
+            {"pk": np.array([2], dtype=np.int64), "payload": [b"new2"]}, schema=schema
+        )
+        await eng.write(WriteRequest(b1, TimeRange(10, 11)))
+        await eng.write(WriteRequest(b2, TimeRange(10, 11)))
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.column("pk").to_pylist() == [1, 2]
+        assert t.column("payload").to_pylist() == [b"old1", b"new2"]
+        await eng.close()
