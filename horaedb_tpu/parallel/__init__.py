@@ -1,0 +1,19 @@
+"""Device-mesh parallelism: the distributed execution layer.
+
+The reference snapshot has no inter-node runtime (SURVEY §2.5) — its scale
+story is shared object storage + per-segment plan parallelism on tokio
+runtimes. The TPU-native analogs this package provides (SURVEY §5.8):
+
+- a `jax.sharding.Mesh` over the slice (ICI) and across hosts (DCN via
+  `jax.distributed`), replacing tokio thread-pool parallelism;
+- segment/row data-parallel scans: rows shard over the mesh, each device
+  filters+reduces its shard, partial aggregates combine with XLA collectives
+  (psum/pmin/pmax riding ICI);
+- series-dimension sharding for group-by outputs (the tensor-parallel analog)
+  so huge cardinalities never materialize on one chip.
+"""
+
+from horaedb_tpu.parallel.mesh import make_mesh, mesh_devices
+from horaedb_tpu.parallel.scan import sharded_downsample, sharded_grouped_stats
+
+__all__ = ["make_mesh", "mesh_devices", "sharded_downsample", "sharded_grouped_stats"]

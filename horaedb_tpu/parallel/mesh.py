@@ -1,0 +1,47 @@
+"""Mesh construction helpers.
+
+Axis conventions:
+- "rows":   data parallelism over row blocks (segments/SST shards) — the
+            scan fan-out axis; collectives here are reductions (psum).
+- "series": output-grid sharding over series (group) space — the
+            tensor-parallel analog; group-by results stay sharded on it.
+
+A 1-chip mesh is (1, 1) and all collectives degenerate to identity, so the
+same pjit'ed code path serves laptop CPU, one TPU chip, and a full slice.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from horaedb_tpu.common.error import ensure
+
+
+def mesh_devices(n: int | None = None) -> list:
+    devs = jax.devices()
+    if n is None:
+        return devs
+    ensure(n <= len(devs), f"requested {n} devices, have {len(devs)}")
+    return devs[:n]
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    series_parallel: int = 1,
+    axis_names: tuple[str, str] = ("rows", "series"),
+) -> Mesh:
+    """Build a 2D (rows x series) mesh over the first `n_devices` devices.
+
+    `series_parallel` devices shard the group/series output dimension; the
+    rest data-parallel the rows. On multi-host topologies callers should pick
+    `series_parallel` to keep the series all-reduce inside one host's ICI
+    domain (scaling-book recipe: reductions ride ICI, DCN only sees the
+    row-axis partials).
+    """
+    devs = mesh_devices(n_devices)
+    n = len(devs)
+    ensure(n % series_parallel == 0, f"{n} devices not divisible by series_parallel={series_parallel}")
+    arr = np.array(devs).reshape(n // series_parallel, series_parallel)
+    return Mesh(arr, axis_names)

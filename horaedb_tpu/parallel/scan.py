@@ -1,0 +1,172 @@
+"""Sharded scan→filter→aggregate over the device mesh.
+
+This is the distributed form of ops/aggregate.py: rows shard over the "rows"
+mesh axis, the series/group dimension shards over "series", and partial
+(sum, count, min, max) grids combine with psum/pmin/pmax over the rows axis —
+the ICI collectives that replace the reference's single-node k-way merge of
+per-SST streams (SURVEY §2.5: "sharded shuffle/merge collectives").
+
+The output grids stay sharded over "series" (PartitionSpec("series") on the
+leading dim), so a 10M-series group-by never materializes on a single chip.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.ops import aggregate
+from horaedb_tpu.ops import filter as filter_ops
+from horaedb_tpu.ops.filter import Predicate
+
+
+def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
+                 num_buckets, with_minmax):
+    """Partial grids for this shard's rows, restricted to the series slice
+    [series_lo, series_lo + local_series).
+
+    sum and count share ONE variadic scatter (stacked features) — scatters
+    are the expensive op on TPU (random-index updates don't vectorize), so
+    the kernel issues as few as possible; min/max add two more and are only
+    computed when requested.
+    """
+    local_sid = sid - series_lo
+    bucket = ((ts - t0) // bucket_ms).astype(jnp.int32)
+    ok = (
+        valid
+        & (local_sid >= 0) & (local_sid < local_series)
+        & (bucket >= 0) & (bucket < num_buckets)
+    )
+    num_cells = local_series * num_buckets
+    flat = jnp.where(ok, local_sid.astype(jnp.int32) * num_buckets + bucket, num_cells)
+    s, c, mn, mx = aggregate.masked_segment_stats(
+        vals, flat, ok, num_cells, with_minmax=with_minmax
+    )
+    shape = (local_series, num_buckets)
+    if not with_minmax:
+        return s.reshape(shape), c.reshape(shape), None, None
+    return s.reshape(shape), c.reshape(shape), mn.reshape(shape), mx.reshape(shape)
+
+
+@lru_cache(maxsize=128)
+def build_sharded_downsample(
+    mesh: Mesh,
+    num_series: int,
+    num_buckets: int,
+    predicate: Predicate | None = None,
+    with_minmax: bool = True,
+):
+    """Compile the sharded downsample step for a fixed grid shape.
+
+    Returns fn(ts, sid, vals, valid, literals, t0, bucket_ms) -> dict of
+    [num_series, num_buckets] grids sharded P("series", None). Inputs are
+    1-D row arrays sharded P("rows") (row count must divide the rows axis).
+    `with_minmax=False` halves the scatter count for mean/sum-only queries.
+
+    Memoized: repeat queries with the same mesh/grid/predicate template reuse
+    the jitted executable. Pass predicates through `split_literals` first (or
+    literal-free) so a changed constant hits the cache.
+    """
+    series_par = mesh.shape["series"]
+    ensure(num_series % series_par == 0,
+           f"num_series={num_series} must divide over series axis={series_par}")
+    local_series = num_series // series_par
+    template, _ = filter_ops.split_literals(predicate)
+    keys = ("sum", "count", "min", "max", "mean") if with_minmax else ("sum", "count", "mean")
+
+    def step(ts, sid, vals, valid, literals, t0, bucket_ms):
+        cols = {"__ts__": ts, "__sid__": sid, "__val__": vals}
+        if template is not None:
+            valid = valid & filter_ops.eval_predicate(template, cols, literals)
+        s_idx = lax.axis_index("series")
+        lo = (s_idx * local_series).astype(sid.dtype)
+        s, c, mn, mx = _local_grids(
+            ts, sid, vals, valid, t0, bucket_ms, lo, local_series, num_buckets,
+            with_minmax,
+        )
+        # combine partials across the row shards (ICI all-reduce)
+        s = lax.psum(s, "rows")
+        c = lax.psum(c, "rows")
+        out = {"sum": s, "count": c, "mean": s / c}
+        if with_minmax:
+            out["min"] = lax.pmin(mn, "rows")
+            out["max"] = lax.pmax(mx, "rows")
+        return out
+
+    row_spec = P("rows")
+    grid_spec = P("series", None)
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(row_spec, row_spec, row_spec, row_spec, P(), P(), P()),
+        out_specs={k: grid_spec for k in keys},
+    )
+    return jax.jit(mapped)
+
+
+def sharded_downsample(
+    mesh: Mesh,
+    ts,
+    sid,
+    vals,
+    valid,
+    t0,
+    bucket_ms,
+    num_series: int,
+    num_buckets: int,
+    predicate: Predicate | None = None,
+    with_minmax: bool = True,
+):
+    """One-shot wrapper: splits predicate literals so repeat queries with new
+    constants reuse the memoized executable."""
+    template, literals = filter_ops.split_literals(predicate)
+    fn = build_sharded_downsample(mesh, num_series, num_buckets, template, with_minmax)
+    lit_arrays = tuple(jnp.asarray(l) for l in literals)
+    return fn(ts, sid, vals, valid, lit_arrays,
+              jnp.asarray(t0, dtype=ts.dtype), jnp.asarray(bucket_ms, dtype=ts.dtype))
+
+
+def sharded_grouped_stats(
+    mesh: Mesh,
+    group_idx,
+    vals,
+    valid,
+    num_groups: int,
+    predicate: Predicate | None = None,
+    with_minmax: bool = True,
+):
+    """Group-by aggregation (BASELINE config 3) = downsample with one bucket:
+    group ids play the series role, bucket axis is singleton."""
+    ts = jnp.zeros_like(group_idx)
+    out = sharded_downsample(
+        mesh, ts, group_idx, vals, valid,
+        t0=0, bucket_ms=1, num_series=num_groups, num_buckets=1,
+        predicate=predicate, with_minmax=with_minmax,
+    )
+    return {k: v[:, 0] for k, v in out.items()}
+
+
+def shard_rows(mesh: Mesh, arrays: tuple, pad_value=0):
+    """Place 1-D host arrays onto the mesh row-sharded (pads to a multiple of
+    the rows axis; returns (device_arrays, valid_mask))."""
+    import numpy as np
+
+    rows_par = mesh.shape["rows"]
+    n = len(arrays[0])
+    pad = (-n) % rows_par
+    out = []
+    sharding = NamedSharding(mesh, P("rows"))
+    for a in arrays:
+        if pad:
+            a = np.concatenate([a, np.full(pad, pad_value, dtype=a.dtype)])
+        out.append(jax.device_put(a, sharding))
+    valid = np.ones(n + pad, dtype=bool)
+    if pad:
+        valid[n:] = False
+    return tuple(out), jax.device_put(valid, sharding)

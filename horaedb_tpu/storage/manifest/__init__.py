@@ -204,8 +204,11 @@ class Manifest:
     async def update(self, to_adds: list[SstFile], to_deletes: list[int]) -> None:
         """Durability point: write one delta file, then apply in memory
         (mod.rs:120-157). Hard backpressure may reject the update."""
-        self._merger.maybe_schedule_merge()
+        # Encode BEFORE counting the delta: an encode failure (e.g. a meta
+        # field overflowing the u32 wire format) must not leak a phantom
+        # increment that the merger can never drain.
         payload = encode_update(to_adds, to_deletes)
+        self._merger.maybe_schedule_merge()
         path = delta_path(self._root, allocate_id())
         try:
             with context("write manifest delta"):

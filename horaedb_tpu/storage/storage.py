@@ -215,6 +215,11 @@ class ObjectBasedStorage(ColumnarStorage):
             return sink.getvalue()
 
         data = await asyncio.to_thread(_encode)
+        # The manifest wire format carries size/num_rows as u32 (sst.proto,
+        # encoding.py); reject before paying the upload so an unregistrable
+        # SST is never orphaned in the store.
+        ensure(len(data) < 2**32, f"sst too large for manifest format: {len(data)}")
+        ensure(table.num_rows < 2**32, f"sst row count too large: {table.num_rows}")
         with context(f"write sst {path}"):
             await self._store.put(path, data)
         return len(data)

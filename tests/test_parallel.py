@@ -1,0 +1,93 @@
+"""Sharded scan tests on the virtual 8-device CPU mesh (SURVEY §4 multi-node
+analog: fake meshes via xla_force_host_platform_device_count)."""
+
+import jax
+import numpy as np
+import pytest
+
+from horaedb_tpu.ops import filter as F
+from horaedb_tpu.parallel import make_mesh, sharded_downsample, sharded_grouped_stats
+from horaedb_tpu.parallel.scan import shard_rows
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual CPU devices"
+    return make_mesh(8, series_parallel=2)
+
+
+def make_data(n=4096, num_series=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ts = rng.integers(0, 1_000_000, n).astype(np.int64)
+    sid = rng.integers(0, num_series, n).astype(np.int32)
+    vals = rng.normal(size=n)
+    return ts, sid, vals
+
+
+class TestShardedDownsample:
+    def test_matches_numpy_oracle(self, mesh8):
+        num_series, num_buckets, bucket_ms = 16, 10, 100_000
+        ts, sid, vals = make_data()
+        (d_ts, d_sid, d_vals), d_valid = shard_rows(mesh8, (ts, sid, vals))
+        out = sharded_downsample(
+            mesh8, d_ts, d_sid, d_vals, d_valid, 0, bucket_ms, num_series, num_buckets
+        )
+        assert out["sum"].shape == (num_series, num_buckets)
+        bucket = ts // bucket_ms
+        for s in range(num_series):
+            for b in range(num_buckets):
+                sel = vals[(sid == s) & (bucket == b)]
+                assert np.isclose(float(out["count"][s, b]), len(sel))
+                if len(sel):
+                    assert np.isclose(float(out["sum"][s, b]), sel.sum())
+                    assert np.isclose(float(out["min"][s, b]), sel.min())
+                    assert np.isclose(float(out["max"][s, b]), sel.max())
+
+    def test_output_sharded_over_series(self, mesh8):
+        ts, sid, vals = make_data(1024)
+        (d_ts, d_sid, d_vals), d_valid = shard_rows(mesh8, (ts, sid, vals))
+        out = sharded_downsample(mesh8, d_ts, d_sid, d_vals, d_valid, 0, 100_000, 16, 4)
+        spec = out["sum"].sharding.spec
+        assert tuple(spec)[0] == "series"
+
+    def test_with_predicate(self, mesh8):
+        ts, sid, vals = make_data()
+        pred = F.Compare("__val__", "gt", 0.0)
+        (d_ts, d_sid, d_vals), d_valid = shard_rows(mesh8, (ts, sid, vals))
+        out = sharded_downsample(
+            mesh8, d_ts, d_sid, d_vals, d_valid, 0, 1_000_000, 16, 1, predicate=pred
+        )
+        for s in range(16):
+            sel = vals[(sid == s) & (vals > 0.0)]
+            assert np.isclose(float(out["sum"][s, 0]), sel.sum())
+
+
+class TestShardedGroupBy:
+    def test_matches_oracle(self, mesh8):
+        _, gid, vals = make_data(2048, num_series=32)
+        (d_gid, d_vals), d_valid = shard_rows(mesh8, (gid, vals))
+        out = sharded_grouped_stats(mesh8, d_gid, d_vals, d_valid, 32)
+        for g in range(32):
+            sel = vals[gid == g]
+            assert np.isclose(float(out["sum"][g]), sel.sum())
+            assert np.isclose(float(out["mean"][g]), sel.mean())
+
+
+class TestMesh:
+    def test_1d_mesh(self):
+        m = make_mesh(4)
+        assert m.shape == {"rows": 4, "series": 1}
+
+    def test_2d_mesh(self):
+        m = make_mesh(8, series_parallel=4)
+        assert m.shape == {"rows": 2, "series": 4}
+
+    def test_single_device_mesh_works(self):
+        m = make_mesh(1)
+        ts = np.array([0, 1], dtype=np.int64)
+        sid = np.array([0, 1], dtype=np.int32)
+        vals = np.array([1.0, 2.0])
+        (d_ts, d_sid, d_vals), d_valid = shard_rows(m, (ts, sid, vals))
+        out = sharded_downsample(m, d_ts, d_sid, d_vals, d_valid, 0, 10, 2, 1)
+        assert float(out["sum"][0, 0]) == 1.0
+        assert float(out["sum"][1, 0]) == 2.0
