@@ -1,0 +1,26 @@
+"""Metric engine: the VictoriaMetrics-style top layer.
+
+The reference declares this layer but left all three managers `todo!()`
+(src/metric_engine/src/{metric,index,data}/mod.rs:34-41); the actual design
+lives in its RFC (docs/rfcs/20240827-metric-engine.md). This package
+implements that design over ColumnarStorage tables:
+
+  metrics  {MetricName, MetricId, FieldName, FieldId, FieldType}   (RFC :108-112)
+  series   {MetricId, TSID, SeriesKey}                             (RFC :114-118)
+  index    {MetricId, TagKey, TagValue, TSID}  (inverted)          (RFC :132-136)
+  data     {MetricId, TSID, FieldId, Timestamp, Value}             (RFC :218-232)
+
+ids: metric_id = seahash(name), tsid = seahash(sorted tag KVs) (reference
+src/metric_engine/src/types.rs:18-41).
+
+TPU-first divergence (documented, deliberate): the RFC batches ~30min of
+compressed (ts, value) bytes per data row; here data rows stay RAW numeric
+columns — they feed XLA scan/aggregate kernels directly with no decompress
+stage, and parquet's own column encodings provide the compression. The
+first-N-columns primary key + seq-based dedup contracts are preserved.
+"""
+
+from horaedb_tpu.engine.types import MetricId, SeriesId, seahash
+from horaedb_tpu.engine.engine import MetricEngine, QueryRequest
+
+__all__ = ["MetricEngine", "QueryRequest", "MetricId", "SeriesId", "seahash"]

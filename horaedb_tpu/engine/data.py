@@ -1,0 +1,105 @@
+"""SampleManager: sample persistence + the device-side query pipeline.
+
+Implements the reference's `SampleManager::persist` skeleton
+(src/metric_engine/src/data/mod.rs:34-41, dead code in the snapshot): raw
+sample rows land in the `data` table bucketed per time segment (a storage
+write must not cross a segment, storage.rs:307-316), and queries run the
+storage scan with (metric_id eq + TSID set-membership + time range)
+predicates followed by on-device aggregation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.engine.tables import DATA_SCHEMA
+from horaedb_tpu.ops import aggregate as agg_ops
+from horaedb_tpu.ops import filter as F
+from horaedb_tpu.storage.read import ScanRequest, WriteRequest
+from horaedb_tpu.storage.types import TimeRange
+
+
+class SampleManager:
+    def __init__(self, storage, segment_duration_ms: int):
+        self._storage = storage
+        self._segment_duration = segment_duration_ms
+
+    async def persist(
+        self,
+        metric_ids: np.ndarray,  # u64 per sample
+        tsids: np.ndarray,       # u64 per sample
+        ts: np.ndarray,          # i64 ms per sample
+        values: np.ndarray,      # f64 per sample
+    ) -> None:
+        """One storage write per touched segment, rows sorted on device by
+        the write path."""
+        if len(ts) == 0:
+            return
+        seg = ts - (ts % self._segment_duration)
+        for seg_start in np.unique(seg):
+            m = seg == seg_start
+            batch = pa.RecordBatch.from_pydict(
+                {
+                    "metric_id": metric_ids[m].astype(np.uint64),
+                    "tsid": tsids[m].astype(np.uint64),
+                    "field_id": np.zeros(int(m.sum()), dtype=np.uint64),
+                    "ts": ts[m],
+                    "value": values[m],
+                },
+                schema=DATA_SCHEMA,
+            )
+            lo = int(ts[m].min())
+            hi = int(ts[m].max()) + 1
+            await self._storage.write(WriteRequest(batch, TimeRange(lo, hi)))
+
+    # -- queries ---------------------------------------------------------------
+    def _predicate(self, metric_id: int, tsids: list[int] | None, rng: TimeRange):
+        parts = [
+            F.Compare("metric_id", "eq", metric_id),
+            F.Compare("ts", "ge", rng.start),
+            F.Compare("ts", "lt", rng.end),
+        ]
+        if tsids is not None:
+            parts.append(F.InSet("tsid", tuple(tsids)))
+        return F.And(*parts)
+
+    async def query_raw(
+        self, metric_id: int, tsids: list[int] | None, rng: TimeRange
+    ) -> pa.Table | None:
+        """Materialized (merged, deduped) sample rows."""
+        batches = []
+        async for b in self._storage.scan(
+            ScanRequest(range=rng, predicate=self._predicate(metric_id, tsids, rng))
+        ):
+            batches.append(b)
+        return pa.Table.from_batches(batches) if batches else None
+
+    async def query_downsample(
+        self,
+        metric_id: int,
+        tsids: list[int] | None,
+        rng: TimeRange,
+        bucket_ms: int,
+    ) -> tuple[list[int], dict[str, np.ndarray]] | None:
+        """Per-(series, bucket) sum/count/min/max/mean grids, reduced on
+        device from the scanned rows. Returns (tsid order, grids)."""
+        table = await self.query_raw(metric_id, tsids, rng)
+        if table is None or table.num_rows == 0:
+            return None
+        t = table.column("ts").to_numpy()
+        v = table.column("value").to_numpy()
+        tsid_col = table.column("tsid").to_numpy()
+        uniq, sid_dense = np.unique(tsid_col, return_inverse=True)
+        num_buckets = -(-(rng.end - rng.start) // bucket_ms)
+        out = agg_ops.downsample(
+            t,
+            sid_dense.astype(np.int32),
+            v,
+            np.ones(len(v), dtype=bool),
+            rng.start,
+            bucket_ms,
+            num_series=len(uniq),
+            num_buckets=int(num_buckets),
+        )
+        return [int(x) for x in uniq], {k: np.asarray(val) for k, val in out.items()}

@@ -1,0 +1,158 @@
+"""MetricEngine facade: Prometheus-shaped writes and queries end-to-end.
+
+Ties the three managers over four ColumnarStorage tables (one sub-root each:
+{root}/{metrics,series,index,data}). The write path is the RFC pipeline:
+populate metric ids -> populate series ids (registering new series + inverted
+index entries) -> persist samples; the read path is index probe -> storage
+scan with device predicate -> device aggregation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.time_ext import now_ms
+from horaedb_tpu.engine import tables
+from horaedb_tpu.engine.data import SampleManager
+from horaedb_tpu.engine.index import IndexManager
+from horaedb_tpu.engine.metric import MetricManager
+from horaedb_tpu.ingest.types import ParsedWriteRequest
+from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.storage.config import StorageConfig
+from horaedb_tpu.storage.storage import ObjectBasedStorage
+from horaedb_tpu.storage.types import TimeRange
+
+NAME_LABEL = b"__name__"
+
+DEFAULT_SEGMENT_MS = 2 * 3600_000  # 2h data segments
+
+
+@dataclass
+class QueryRequest:
+    metric: bytes
+    start_ms: int
+    end_ms: int
+    filters: list[tuple[bytes, bytes]] = field(default_factory=list)
+    bucket_ms: int | None = None  # None -> raw rows
+
+
+class MetricEngine:
+    def __init__(self) -> None:
+        raise RuntimeError("use MetricEngine.open")
+
+    @classmethod
+    async def open(
+        cls,
+        root: str,
+        store: ObjectStore,
+        segment_duration_ms: int = DEFAULT_SEGMENT_MS,
+        config: StorageConfig | None = None,
+        enable_compaction: bool = True,
+    ) -> "MetricEngine":
+        self = object.__new__(cls)
+        self._store = store
+        self._segment_duration = segment_duration_ms
+
+        async def open_table(name, schema, num_pks, compaction):
+            return await ObjectBasedStorage.try_new(
+                root=f"{root}/{name}",
+                store=store,
+                arrow_schema=schema,
+                num_primary_keys=num_pks,
+                segment_duration_ms=segment_duration_ms,
+                config=config,
+                enable_compaction_scheduler=compaction,
+            )
+
+        self.metrics_table = await open_table(
+            "metrics", tables.METRICS_SCHEMA, tables.METRICS_NUM_PKS, False
+        )
+        self.series_table = await open_table(
+            "series", tables.SERIES_SCHEMA, tables.SERIES_NUM_PKS, False
+        )
+        self.index_table = await open_table(
+            "index", tables.INDEX_SCHEMA, tables.INDEX_NUM_PKS, False
+        )
+        self.data_table = await open_table(
+            "data", tables.DATA_SCHEMA, tables.DATA_NUM_PKS, enable_compaction
+        )
+
+        self.metric_mgr = MetricManager(self.metrics_table, segment_duration_ms)
+        self.index_mgr = IndexManager(self.series_table, self.index_table, segment_duration_ms)
+        self.sample_mgr = SampleManager(self.data_table, segment_duration_ms)
+        await self.metric_mgr.open()
+        await self.index_mgr.open()
+        return self
+
+    async def close(self) -> None:
+        for t in (self.metrics_table, self.series_table, self.index_table, self.data_table):
+            await t.close()
+
+    # -- write path -----------------------------------------------------------
+    async def write_parsed(self, req: ParsedWriteRequest) -> int:
+        """Ingest one decoded remote-write request; returns sample count."""
+        if req.n_series == 0:
+            return 0
+        ts_now = now_ms()
+        # 1. metric names from __name__ labels
+        names: list[bytes] = []
+        label_sets: list[list[tuple[bytes, bytes]]] = []
+        for s in range(req.n_series):
+            labels = req.series_labels(s)
+            name = b""
+            rest = []
+            for k, v in labels:
+                if k == NAME_LABEL:
+                    name = v
+                else:
+                    rest.append((k, v))
+            ensure(bool(name), f"series {s} missing __name__ label")
+            names.append(name)
+            label_sets.append(rest)
+        ids = await self.metric_mgr.populate_metric_ids(names, ts_now)
+        metric_per_series = [ids[n] for n in names]
+        # 2. series registration + tsids
+        tsids = await self.index_mgr.populate_series_ids(
+            metric_per_series, label_sets, ts_now
+        )
+        # 3. samples -> data rows
+        n = req.n_samples
+        if n == 0:
+            return 0
+        series_idx = req.sample_series
+        m_arr = np.asarray(metric_per_series, dtype=np.uint64)[series_idx]
+        t_arr = np.asarray(tsids, dtype=np.uint64)[series_idx]
+        await self.sample_mgr.persist(m_arr, t_arr, req.sample_ts, req.sample_value)
+        return n
+
+    # -- query path -------------------------------------------------------------
+    async def query(self, req: QueryRequest):
+        """Raw rows (bucket_ms None) or downsample grids per series."""
+        hit = self.metric_mgr.get(req.metric)
+        if hit is None:
+            return None
+        metric_id = hit[0]
+        tsids = self.index_mgr.find_tsids(metric_id, req.filters)
+        if tsids == []:
+            return None
+        rng = TimeRange(req.start_ms, req.end_ms)
+        if req.bucket_ms is None:
+            return await self.sample_mgr.query_raw(metric_id, tsids, rng)
+        return await self.sample_mgr.query_downsample(
+            metric_id, tsids, rng, req.bucket_ms
+        )
+
+    def label_values(self, metric: bytes, key: bytes) -> list[bytes]:
+        hit = self.metric_mgr.get(metric)
+        if hit is None:
+            return []
+        return self.index_mgr.label_values(hit[0], key)
+
+    async def compact(self) -> None:
+        """Manual compaction trigger on the data table (the /compact hook)."""
+        from horaedb_tpu.storage.read import CompactRequest
+
+        await self.data_table.compact(CompactRequest())

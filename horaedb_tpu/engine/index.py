@@ -1,0 +1,143 @@
+"""IndexManager: series registry + inverted tag index.
+
+Implements the reference's `IndexManager::populate_series_ids` skeleton
+(src/metric_engine/src/index/mod.rs:34-41, dead code in the snapshot) per
+the RFC: a `series` table mapping (metric_id, tsid) -> canonical series key,
+and an inverted `index` table mapping (metric_id, tag KV) -> posting list of
+TSIDs (RFC :114-136).
+
+Query side: `find_tsids` intersects posting lists for the given tag filters
+— the host-side index probe whose result feeds the device-side TSID
+set-membership filter (SURVEY §7.7). Hash collisions are handled by
+verifying the stored raw tag bytes.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.engine.tables import INDEX_SCHEMA, SERIES_SCHEMA
+from horaedb_tpu.engine.types import (
+    SeriesId,
+    series_id_of,
+    series_key_of,
+    tag_hash_of,
+)
+from horaedb_tpu.storage.read import ScanRequest, WriteRequest
+from horaedb_tpu.storage.types import TimeRange
+
+_ALL_TIME = TimeRange(-(2**62), 2**62)
+
+
+class IndexManager:
+    def __init__(self, series_storage, index_storage, segment_duration_ms: int):
+        self._series = series_storage
+        self._index = index_storage
+        self._segment_duration = segment_duration_ms
+        # (metric_id, tsid) set of known series — write-through cache
+        self._known: set[tuple[int, int]] = set()
+        # (metric_id, tag_hash) -> {tsid -> (key, value)} posting lists
+        self._postings: dict[tuple[int, int], dict[int, tuple[bytes, bytes]]] = defaultdict(dict)
+
+    async def open(self) -> None:
+        async for batch in self._series.scan(ScanRequest(range=_ALL_TIME)):
+            for m, t in zip(
+                batch.column("metric_id").to_pylist(), batch.column("tsid").to_pylist()
+            ):
+                self._known.add((m, t))
+        async for batch in self._index.scan(ScanRequest(range=_ALL_TIME)):
+            for m, h, t, k, v in zip(
+                batch.column("metric_id").to_pylist(),
+                batch.column("tag_hash").to_pylist(),
+                batch.column("tsid").to_pylist(),
+                batch.column("tag_key").to_pylist(),
+                batch.column("tag_value").to_pylist(),
+            ):
+                self._postings[(m, h)][t] = (k, v)
+
+    # -- write path ----------------------------------------------------------
+    async def populate_series_ids(
+        self,
+        metric_ids: list[int],
+        label_sets: list[list[tuple[bytes, bytes]]],
+        now_ms: int,
+    ) -> list[SeriesId]:
+        """Resolve TSIDs for (metric, labels) pairs, registering new series
+        in the series table and the inverted index."""
+        tsids: list[SeriesId] = []
+        new_series_rows: list[tuple[int, int, bytes]] = []
+        new_index_rows: list[tuple[int, int, int, bytes, bytes]] = []
+        for mid, labels in zip(metric_ids, label_sets):
+            key = series_key_of(labels)
+            tsid = series_id_of(key)
+            tsids.append(tsid)
+            if (mid, tsid) in self._known:
+                continue
+            self._known.add((mid, tsid))
+            new_series_rows.append((mid, tsid, key))
+            for k, v in labels:
+                h = tag_hash_of(k, v)
+                self._postings[(mid, h)][tsid] = (k, v)
+                new_index_rows.append((mid, h, tsid, k, v))
+        if new_series_rows:
+            await self._persist(new_series_rows, new_index_rows, now_ms)
+        return tsids
+
+    async def _persist(self, series_rows, index_rows, now_ms: int) -> None:
+        seg_start = now_ms - now_ms % self._segment_duration
+        rng = TimeRange(seg_start, seg_start + 1)
+        s_batch = pa.RecordBatch.from_pydict(
+            {
+                "metric_id": np.asarray([r[0] for r in series_rows], dtype=np.uint64),
+                "tsid": np.asarray([r[1] for r in series_rows], dtype=np.uint64),
+                "series_key": [r[2] for r in series_rows],
+            },
+            schema=SERIES_SCHEMA,
+        )
+        await self._series.write(WriteRequest(s_batch, rng))
+        if index_rows:
+            i_batch = pa.RecordBatch.from_pydict(
+                {
+                    "metric_id": np.asarray([r[0] for r in index_rows], dtype=np.uint64),
+                    "tag_hash": np.asarray([r[1] for r in index_rows], dtype=np.uint64),
+                    "tsid": np.asarray([r[2] for r in index_rows], dtype=np.uint64),
+                    "tag_key": [r[3] for r in index_rows],
+                    "tag_value": [r[4] for r in index_rows],
+                },
+                schema=INDEX_SCHEMA,
+            )
+            await self._index.write(WriteRequest(i_batch, rng))
+
+    # -- query path ------------------------------------------------------------
+    def find_tsids(
+        self, metric_id: int, filters: list[tuple[bytes, bytes]]
+    ) -> list[SeriesId] | None:
+        """TSIDs matching ALL tag filters; None means 'no tag filter' (caller
+        scans the whole metric). Posting lists verify raw bytes to reject
+        hash collisions."""
+        if not filters:
+            return None
+        result: set[int] | None = None
+        for k, v in filters:
+            h = tag_hash_of(k, v)
+            posting = self._postings.get((metric_id, h), {})
+            matched = {t for t, kv in posting.items() if kv == (k, v)}
+            result = matched if result is None else (result & matched)
+            if not result:
+                return []
+        return sorted(result)
+
+    def label_values(self, metric_id: int, key: bytes) -> list[bytes]:
+        """LabelValues via the inverted index (the RFC's two-step fallback,
+        RFC :120-130)."""
+        out = set()
+        for (m, _h), posting in self._postings.items():
+            if m != metric_id:
+                continue
+            for kv in posting.values():
+                if kv[0] == key:
+                    out.add(kv[1])
+        return sorted(out)

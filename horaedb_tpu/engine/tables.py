@@ -1,0 +1,61 @@
+"""Schemas of the four metric-engine tables over ColumnarStorage.
+
+RFC table layouts (docs/rfcs/20240827-metric-engine.md:100-145) mapped onto
+the storage schema contract (pk columns first, then values). String columns
+from the RFC are binary here (labels are not UTF-8-validated, matching the
+ingest contract), and each table gets numeric hash pks so primary-key
+comparisons stay on the device-friendly numeric path.
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+# metrics: pk (metric_id, field_id); values: names + type
+METRICS_SCHEMA = pa.schema(
+    [
+        ("metric_id", pa.uint64()),
+        ("field_id", pa.uint64()),
+        ("metric_name", pa.binary()),
+        ("field_name", pa.binary()),
+        ("field_type", pa.uint64()),
+    ]
+)
+METRICS_NUM_PKS = 2
+
+# series: pk (metric_id, tsid); value: the canonical sorted-label key
+SERIES_SCHEMA = pa.schema(
+    [
+        ("metric_id", pa.uint64()),
+        ("tsid", pa.uint64()),
+        ("series_key", pa.binary()),
+    ]
+)
+SERIES_NUM_PKS = 2
+
+# index (inverted): pk (metric_id, tag_hash, tsid); values: raw tag bytes for
+# collision verification and LabelValues queries
+INDEX_SCHEMA = pa.schema(
+    [
+        ("metric_id", pa.uint64()),
+        ("tag_hash", pa.uint64()),
+        ("tsid", pa.uint64()),
+        ("tag_key", pa.binary()),
+        ("tag_value", pa.binary()),
+    ]
+)
+INDEX_NUM_PKS = 3
+
+# data: pk (metric_id, tsid, field_id, ts); value: the sample
+# (RFC :218-232 keeps MetricID/TSID/FieldID as the sorted prefix; ts joins
+# the pk here because rows are raw samples, not 30-min compressed batches)
+DATA_SCHEMA = pa.schema(
+    [
+        ("metric_id", pa.uint64()),
+        ("tsid", pa.uint64()),
+        ("field_id", pa.uint64()),
+        ("ts", pa.int64()),
+        ("value", pa.float64()),
+    ]
+)
+DATA_NUM_PKS = 4

@@ -1,0 +1,13 @@
+"""Prometheus remote-write ingest (reference: src/remote_write).
+
+`PooledParser.decode(buf)` parses a remote-write protobuf payload into
+columnar arrays (`ParsedWriteRequest`) using the native C++ zero-copy parser
+(native/remote_write_parser.cc) with a pure-Python fallback. `decode_async`
+borrows a pooled parser arena (POOL_SIZE=64, matching pooled_types.rs:25-192)
+so steady-state ingest does no per-request allocation.
+"""
+
+from horaedb_tpu.ingest.types import ParsedWriteRequest
+from horaedb_tpu.ingest.pooled_parser import PooledParser, ParserPool, POOL_SIZE
+
+__all__ = ["ParsedWriteRequest", "PooledParser", "ParserPool", "POOL_SIZE"]

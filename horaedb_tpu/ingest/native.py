@@ -1,0 +1,143 @@
+"""ctypes binding to the C++ remote-write parser (native/remote_write_parser.cc).
+
+The shared library auto-builds on first use if the .so is missing and a C++
+toolchain exists; `load()` returns None when unavailable so callers fall back
+to the pure-Python decoder.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.ingest.types import ParsedWriteRequest
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libremote_write.so"))
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class _RwResult(ctypes.Structure):
+    _fields_ = [
+        ("n_series", ctypes.c_int64),
+        ("n_labels", ctypes.c_int64),
+        ("n_samples", ctypes.c_int64),
+        ("n_exemplars", ctypes.c_int64),
+        ("n_metadata", ctypes.c_int64),
+        ("series_label_start", ctypes.POINTER(ctypes.c_int64)),
+        ("series_label_count", ctypes.POINTER(ctypes.c_int64)),
+        ("series_sample_start", ctypes.POINTER(ctypes.c_int64)),
+        ("series_sample_count", ctypes.POINTER(ctypes.c_int64)),
+        ("label_name_off", ctypes.POINTER(ctypes.c_int64)),
+        ("label_name_len", ctypes.POINTER(ctypes.c_int64)),
+        ("label_value_off", ctypes.POINTER(ctypes.c_int64)),
+        ("label_value_len", ctypes.POINTER(ctypes.c_int64)),
+        ("sample_value", ctypes.POINTER(ctypes.c_double)),
+        ("sample_ts", ctypes.POINTER(ctypes.c_int64)),
+        ("sample_series", ctypes.POINTER(ctypes.c_int64)),
+        ("exemplar_value", ctypes.POINTER(ctypes.c_double)),
+        ("exemplar_ts", ctypes.POINTER(ctypes.c_int64)),
+        ("exemplar_series", ctypes.POINTER(ctypes.c_int64)),
+        ("meta_type", ctypes.POINTER(ctypes.c_int64)),
+        ("meta_name_off", ctypes.POINTER(ctypes.c_int64)),
+        ("meta_name_len", ctypes.POINTER(ctypes.c_int64)),
+    ]
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("native parser build failed: %s", e)
+        return False
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.rw_parser_new.restype = ctypes.c_void_p
+        lib.rw_parser_free.argtypes = [ctypes.c_void_p]
+        lib.rw_parse.restype = ctypes.c_int
+        lib.rw_parse.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(_RwResult),
+        ]
+        _lib = lib
+        return _lib
+
+
+def _as_np(ptr, n: int, dtype) -> np.ndarray:
+    """Copy an arena lane out into a standalone numpy array (the arena is
+    reused by the next parse on the same handle)."""
+    if n == 0:
+        return np.empty(0, dtype=dtype)
+    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+class NativeParser:
+    """One parser handle == one arena; not thread-safe (pool it)."""
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise HoraeError("native remote-write parser unavailable")
+        self._lib = lib
+        self._h = lib.rw_parser_new()
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.rw_parser_free(h)
+            self._h = None
+
+    def parse(self, payload: bytes) -> ParsedWriteRequest:
+        res = _RwResult()
+        rc = self._lib.rw_parse(self._h, payload, len(payload), ctypes.byref(res))
+        if rc != 0:
+            raise HoraeError("malformed remote-write payload")
+        ns, nl = res.n_series, res.n_labels
+        nsm, nex, nmd = res.n_samples, res.n_exemplars, res.n_metadata
+        return ParsedWriteRequest(
+            payload=payload,
+            series_label_start=_as_np(res.series_label_start, ns, np.int64),
+            series_label_count=_as_np(res.series_label_count, ns, np.int64),
+            series_sample_start=_as_np(res.series_sample_start, ns, np.int64),
+            series_sample_count=_as_np(res.series_sample_count, ns, np.int64),
+            label_name_off=_as_np(res.label_name_off, nl, np.int64),
+            label_name_len=_as_np(res.label_name_len, nl, np.int64),
+            label_value_off=_as_np(res.label_value_off, nl, np.int64),
+            label_value_len=_as_np(res.label_value_len, nl, np.int64),
+            sample_value=_as_np(res.sample_value, nsm, np.float64),
+            sample_ts=_as_np(res.sample_ts, nsm, np.int64),
+            sample_series=_as_np(res.sample_series, nsm, np.int64),
+            exemplar_value=_as_np(res.exemplar_value, nex, np.float64),
+            exemplar_ts=_as_np(res.exemplar_ts, nex, np.int64),
+            exemplar_series=_as_np(res.exemplar_series, nex, np.int64),
+            meta_type=_as_np(res.meta_type, nmd, np.int64),
+            meta_name_off=_as_np(res.meta_name_off, nmd, np.int64),
+            meta_name_len=_as_np(res.meta_name_len, nmd, np.int64),
+        )

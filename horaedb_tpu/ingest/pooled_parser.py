@@ -1,0 +1,76 @@
+"""Pooled parser front-end (reference: pooled_parser.rs:38-73).
+
+`decode` uses a fresh arena; `decode_async` borrows one of POOL_SIZE pooled
+native arenas (auto-returned), so steady-state ingest allocates nothing per
+request — the deadpool pattern of the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from horaedb_tpu.ingest.types import ParsedWriteRequest
+
+logger = logging.getLogger(__name__)
+
+POOL_SIZE = 64
+
+
+def _new_backend():
+    from horaedb_tpu.ingest import native
+
+    if native.load() is not None:
+        return native.NativeParser()
+    from horaedb_tpu.ingest.py_parser import PyParser
+
+    logger.warning("native remote-write parser unavailable; using Python fallback")
+    return PyParser()
+
+
+class ParserPool:
+    """Bounded pool of parser arenas (deadpool analog, POOL_SIZE=64)."""
+
+    def __init__(self, size: int = POOL_SIZE):
+        self._size = size
+        self._sem = asyncio.Semaphore(size)
+        self._free: list = []
+
+    async def decode(self, payload: bytes) -> ParsedWriteRequest:
+        async with self._sem:
+            parser = self._free.pop() if self._free else _new_backend()
+            try:
+                # native parse releases no GIL-bound state we await on; run in
+                # a thread so large payloads don't stall the event loop
+                return await asyncio.to_thread(parser.parse, payload)
+            finally:
+                self._free.append(parser)
+
+    @property
+    def status(self) -> dict:
+        """Pool telemetry (reference: pool_stats bin)."""
+        return {
+            "size": self._size,
+            "available": len(self._free),
+            "waiting": 0 if self._sem._value > 0 else abs(self._sem._value),  # noqa: SLF001
+        }
+
+
+_DEFAULT_POOL = None
+
+
+class PooledParser:
+    """API mirror of the reference PooledParser."""
+
+    @staticmethod
+    def decode(payload: bytes) -> ParsedWriteRequest:
+        """One-shot decode with a fresh parser (pooled_parser.rs `decode`)."""
+        return _new_backend().parse(payload)
+
+    @staticmethod
+    async def decode_async(payload: bytes) -> ParsedWriteRequest:
+        """Pooled decode (pooled_parser.rs `decode_async`)."""
+        global _DEFAULT_POOL
+        if _DEFAULT_POOL is None:
+            _DEFAULT_POOL = ParserPool()
+        return await _DEFAULT_POOL.decode(payload)

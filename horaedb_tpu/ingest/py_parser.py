@@ -1,0 +1,72 @@
+"""Pure-Python fallback decoder (and differential-test oracle).
+
+Uses the protoc-generated classes (pb/remote_write_pb2.py) — the known-good
+decode the native parser is differentially tested against, mirroring the
+reference's equivalence test vs prost (equivalence_test.rs:18-177).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.ingest.types import ParsedWriteRequest
+from horaedb_tpu.pb import remote_write_pb2
+
+
+class PyParser:
+    """Decodes via the protobuf runtime, then pivots to columnar form.
+    Offsets are into a rebuilt side buffer (the runtime copies strings, so
+    zero-copy into the original payload is not possible here)."""
+
+    def parse(self, payload: bytes) -> ParsedWriteRequest:
+        req = remote_write_pb2.WriteRequest()
+        try:
+            req.ParseFromString(payload)
+        except Exception as e:  # noqa: BLE001
+            raise HoraeError("malformed remote-write payload") from e
+
+        side = bytearray()
+        sls, slc, sss, ssc = [], [], [], []
+        lno, lnl, lvo, lvl = [], [], [], []
+        sv, st, ss = [], [], []
+        ev, et, es = [], [], []
+        mt, mno, mnl = [], [], []
+
+        def put(b: bytes) -> tuple[int, int]:
+            off = len(side)
+            side.extend(b)
+            return off, len(b)
+
+        for si, series in enumerate(req.timeseries):
+            sls.append(len(lno))
+            sss.append(len(sv))
+            for lab in series.labels:
+                o, l = put(lab.name.encode())
+                lno.append(o); lnl.append(l)
+                o, l = put(lab.value.encode())
+                lvo.append(o); lvl.append(l)
+            for smp in series.samples:
+                sv.append(smp.value); st.append(smp.timestamp); ss.append(si)
+            for ex in series.exemplars:
+                ev.append(ex.value); et.append(ex.timestamp); es.append(si)
+            slc.append(len(lno) - sls[-1])
+            ssc.append(len(sv) - sss[-1])
+        for md in req.metadata:
+            mt.append(int(md.type))
+            o, l = put(md.metric_family_name.encode())
+            mno.append(o); mnl.append(l)
+
+        i64 = lambda x: np.asarray(x, dtype=np.int64)  # noqa: E731
+        return ParsedWriteRequest(
+            payload=bytes(side),
+            series_label_start=i64(sls), series_label_count=i64(slc),
+            series_sample_start=i64(sss), series_sample_count=i64(ssc),
+            label_name_off=i64(lno), label_name_len=i64(lnl),
+            label_value_off=i64(lvo), label_value_len=i64(lvl),
+            sample_value=np.asarray(sv, dtype=np.float64),
+            sample_ts=i64(st), sample_series=i64(ss),
+            exemplar_value=np.asarray(ev, dtype=np.float64),
+            exemplar_ts=i64(et), exemplar_series=i64(es),
+            meta_type=i64(mt), meta_name_off=i64(mno), meta_name_len=i64(mnl),
+        )

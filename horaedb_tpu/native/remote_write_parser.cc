@@ -1,0 +1,371 @@
+// Pooled zero-copy Prometheus remote-write wire parser.
+//
+// TPU-native equivalent of the reference's hand-rolled Rust decoder
+// (src/remote_write/src/pb_reader.rs, pooled_parser.rs, pooled_types.rs,
+// repeated_field.rs). Design points carried over:
+//   - unrolled 10-byte varint fast path (pb_reader.rs:98-174, which credits
+//     Go's encoding/binary);
+//   - strings are NEVER copied or UTF-8 validated: labels land as
+//     (offset, length) slices into the caller's buffer
+//     (pooled_parser.rs:18-24 makes validation the caller's job);
+//   - arena reuse: all output vectors keep their capacity across parses —
+//     clear() without dealloc is the pooled-object trick the reference
+//     vendors RepeatedField for (repeated_field.rs:21-23).
+//
+// The output is COLUMNAR, not an object tree: flat sample/label arrays plus
+// per-series ranges, exactly the layout the engine ships to device HBM
+// (SURVEY R1: "labels/samples land as flat arrays ready for device
+// transfer"). Exposed as a C ABI for ctypes.
+//
+// Build: make -C horaedb_tpu/native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// wire reading
+// ---------------------------------------------------------------------------
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  bool eof() const { return p >= end; }
+  size_t remaining() const { return static_cast<size_t>(end - p); }
+};
+
+// Unrolled LEB128 decode; returns false on truncation/overflow.
+// Mirrors the reference's unrolled loop (pb_reader.rs:98-174).
+inline bool read_varint(Reader& r, uint64_t* out) {
+  const uint8_t* p = r.p;
+  size_t n = r.remaining();
+  if (n == 0) return false;
+  uint64_t b = p[0];
+  if ((b & 0x80) == 0) { *out = b; r.p += 1; return true; }
+  uint64_t v = b & 0x7f;
+#define STEP(i)                                        \
+  if (n <= (i)) return false;                          \
+  b = p[i];                                            \
+  v |= (b & 0x7f) << (7 * (i));                        \
+  if ((b & 0x80) == 0) { *out = v; r.p += (i) + 1; return true; }
+  STEP(1) STEP(2) STEP(3) STEP(4) STEP(5) STEP(6) STEP(7) STEP(8)
+#undef STEP
+  if (n <= 9) return false;
+  b = p[9];
+  if (b > 1) return false;  // 10th byte: only the lowest bit may be set
+  v |= b << 63;
+  *out = v;
+  r.p += 10;
+  return true;
+}
+
+inline bool read_fixed64_as_double(Reader& r, double* out) {
+  if (r.remaining() < 8) return false;
+  std::memcpy(out, r.p, 8);
+  r.p += 8;
+  return true;
+}
+
+inline bool read_len(Reader& r, uint64_t* len) {
+  if (!read_varint(r, len)) return false;
+  return *len <= r.remaining();
+}
+
+inline bool skip_field(Reader& r, uint32_t wire_type) {
+  switch (wire_type) {
+    case 0: {  // varint
+      uint64_t v;
+      return read_varint(r, &v);
+    }
+    case 1:  // fixed64
+      if (r.remaining() < 8) return false;
+      r.p += 8;
+      return true;
+    case 2: {  // length-delimited
+      uint64_t len;
+      if (!read_len(r, &len)) return false;
+      r.p += len;
+      return true;
+    }
+    case 5:  // fixed32
+      if (r.remaining() < 4) return false;
+      r.p += 4;
+      return true;
+    default:  // groups (3/4) unsupported, as in the reference
+      return false;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// columnar output arena
+// ---------------------------------------------------------------------------
+
+struct Parser {
+  const uint8_t* base = nullptr;  // current parse's buffer start
+
+  // per-series ranges
+  std::vector<int64_t> series_label_start, series_label_count;
+  std::vector<int64_t> series_sample_start, series_sample_count;
+  // flattened labels: byte ranges into the input buffer (zero-copy)
+  std::vector<int64_t> label_name_off, label_name_len;
+  std::vector<int64_t> label_value_off, label_value_len;
+  // flattened samples
+  std::vector<double> sample_value;
+  std::vector<int64_t> sample_ts;
+  std::vector<int64_t> sample_series;  // owning series index
+  // flattened exemplars (per series, labels not retained)
+  std::vector<double> exemplar_value;
+  std::vector<int64_t> exemplar_ts;
+  std::vector<int64_t> exemplar_series;
+  // metadata entries: {type, family name range, help range, unit range}
+  std::vector<int64_t> meta_type;
+  std::vector<int64_t> meta_name_off, meta_name_len;
+
+  void clear() {  // keeps capacity: the pooled-reuse contract
+    series_label_start.clear(); series_label_count.clear();
+    series_sample_start.clear(); series_sample_count.clear();
+    label_name_off.clear(); label_name_len.clear();
+    label_value_off.clear(); label_value_len.clear();
+    sample_value.clear(); sample_ts.clear(); sample_series.clear();
+    exemplar_value.clear(); exemplar_ts.clear(); exemplar_series.clear();
+    meta_type.clear(); meta_name_off.clear(); meta_name_len.clear();
+  }
+};
+
+inline int64_t off_of(const Parser& ps, const uint8_t* p) {
+  return static_cast<int64_t>(p - ps.base);
+}
+
+bool parse_label(Parser& ps, Reader r) {
+  int64_t noff = 0, nlen = 0, voff = 0, vlen = 0;
+  while (!r.eof()) {
+    uint64_t tag;
+    if (!read_varint(r, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 1 && wt == 2) {
+      uint64_t len;
+      if (!read_len(r, &len)) return false;
+      noff = off_of(ps, r.p); nlen = static_cast<int64_t>(len);
+      r.p += len;
+    } else if (field == 2 && wt == 2) {
+      uint64_t len;
+      if (!read_len(r, &len)) return false;
+      voff = off_of(ps, r.p); vlen = static_cast<int64_t>(len);
+      r.p += len;
+    } else if (!skip_field(r, wt)) {
+      return false;
+    }
+  }
+  ps.label_name_off.push_back(noff);
+  ps.label_name_len.push_back(nlen);
+  ps.label_value_off.push_back(voff);
+  ps.label_value_len.push_back(vlen);
+  return true;
+}
+
+bool parse_sample(Parser& ps, Reader r, int64_t series_idx) {
+  double value = 0;
+  int64_t ts = 0;
+  while (!r.eof()) {
+    uint64_t tag;
+    if (!read_varint(r, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 1 && wt == 1) {
+      if (!read_fixed64_as_double(r, &value)) return false;
+    } else if (field == 2 && wt == 0) {
+      uint64_t v;
+      if (!read_varint(r, &v)) return false;
+      ts = static_cast<int64_t>(v);
+    } else if (!skip_field(r, wt)) {
+      return false;
+    }
+  }
+  ps.sample_value.push_back(value);
+  ps.sample_ts.push_back(ts);
+  ps.sample_series.push_back(series_idx);
+  return true;
+}
+
+bool parse_exemplar(Parser& ps, Reader r, int64_t series_idx) {
+  double value = 0;
+  int64_t ts = 0;
+  while (!r.eof()) {
+    uint64_t tag;
+    if (!read_varint(r, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 2 && wt == 1) {
+      if (!read_fixed64_as_double(r, &value)) return false;
+    } else if (field == 3 && wt == 0) {
+      uint64_t v;
+      if (!read_varint(r, &v)) return false;
+      ts = static_cast<int64_t>(v);
+    } else if (!skip_field(r, wt)) {  // exemplar labels (1) skipped
+      return false;
+    }
+  }
+  ps.exemplar_value.push_back(value);
+  ps.exemplar_ts.push_back(ts);
+  ps.exemplar_series.push_back(series_idx);
+  return true;
+}
+
+bool parse_timeseries(Parser& ps, Reader r) {
+  int64_t series_idx = static_cast<int64_t>(ps.series_label_start.size());
+  ps.series_label_start.push_back(static_cast<int64_t>(ps.label_name_off.size()));
+  ps.series_sample_start.push_back(static_cast<int64_t>(ps.sample_value.size()));
+  while (!r.eof()) {
+    uint64_t tag;
+    if (!read_varint(r, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    uint64_t len;
+    switch (field) {
+      case 1:  // labels
+        if (wt != 2 || !read_len(r, &len)) return false;
+        if (!parse_label(ps, Reader{r.p, r.p + len})) return false;
+        r.p += len;
+        break;
+      case 2:  // samples
+        if (wt != 2 || !read_len(r, &len)) return false;
+        if (!parse_sample(ps, Reader{r.p, r.p + len}, series_idx)) return false;
+        r.p += len;
+        break;
+      case 3:  // exemplars
+        if (wt != 2 || !read_len(r, &len)) return false;
+        if (!parse_exemplar(ps, Reader{r.p, r.p + len}, series_idx)) return false;
+        r.p += len;
+        break;
+      default:
+        if (!skip_field(r, wt)) return false;
+    }
+  }
+  ps.series_label_count.push_back(
+      static_cast<int64_t>(ps.label_name_off.size()) - ps.series_label_start.back());
+  ps.series_sample_count.push_back(
+      static_cast<int64_t>(ps.sample_value.size()) - ps.series_sample_start.back());
+  return true;
+}
+
+bool parse_metadata(Parser& ps, Reader r) {
+  int64_t type = 0, noff = 0, nlen = 0;
+  while (!r.eof()) {
+    uint64_t tag;
+    if (!read_varint(r, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 1 && wt == 0) {
+      uint64_t v;
+      if (!read_varint(r, &v)) return false;
+      type = static_cast<int64_t>(v);
+    } else if (field == 2 && wt == 2) {
+      uint64_t len;
+      if (!read_len(r, &len)) return false;
+      noff = off_of(ps, r.p); nlen = static_cast<int64_t>(len);
+      r.p += len;
+    } else if (!skip_field(r, wt)) {
+      return false;
+    }
+  }
+  ps.meta_type.push_back(type);
+  ps.meta_name_off.push_back(noff);
+  ps.meta_name_len.push_back(nlen);
+  return true;
+}
+
+bool parse_write_request(Parser& ps, Reader r) {
+  while (!r.eof()) {
+    uint64_t tag;
+    if (!read_varint(r, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    uint64_t len;
+    switch (field) {
+      case 1:  // timeseries
+        if (wt != 2 || !read_len(r, &len)) return false;
+        if (!parse_timeseries(ps, Reader{r.p, r.p + len})) return false;
+        r.p += len;
+        break;
+      case 3:  // metadata
+        if (wt != 2 || !read_len(r, &len)) return false;
+        if (!parse_metadata(ps, Reader{r.p, r.p + len})) return false;
+        r.p += len;
+        break;
+      default:
+        if (!skip_field(r, wt)) return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Mirrors the vector layout above; pointers are valid until the next
+// rw_parse/rw_parser_free on the same handle.
+struct RwResult {
+  int64_t n_series;
+  int64_t n_labels;
+  int64_t n_samples;
+  int64_t n_exemplars;
+  int64_t n_metadata;
+  const int64_t* series_label_start;
+  const int64_t* series_label_count;
+  const int64_t* series_sample_start;
+  const int64_t* series_sample_count;
+  const int64_t* label_name_off;
+  const int64_t* label_name_len;
+  const int64_t* label_value_off;
+  const int64_t* label_value_len;
+  const double* sample_value;
+  const int64_t* sample_ts;
+  const int64_t* sample_series;
+  const double* exemplar_value;
+  const int64_t* exemplar_ts;
+  const int64_t* exemplar_series;
+  const int64_t* meta_type;
+  const int64_t* meta_name_off;
+  const int64_t* meta_name_len;
+};
+
+void* rw_parser_new() { return new Parser(); }
+
+void rw_parser_free(void* h) { delete static_cast<Parser*>(h); }
+
+// Returns 0 on success, non-zero on malformed input. Output arrays live in
+// the parser's arena (reused across calls, pooled semantics).
+int rw_parse(void* h, const uint8_t* buf, uint64_t len, RwResult* out) {
+  Parser& ps = *static_cast<Parser*>(h);
+  ps.clear();
+  ps.base = buf;
+  if (!parse_write_request(ps, Reader{buf, buf + len})) return 1;
+  out->n_series = static_cast<int64_t>(ps.series_label_start.size());
+  out->n_labels = static_cast<int64_t>(ps.label_name_off.size());
+  out->n_samples = static_cast<int64_t>(ps.sample_value.size());
+  out->n_exemplars = static_cast<int64_t>(ps.exemplar_value.size());
+  out->n_metadata = static_cast<int64_t>(ps.meta_type.size());
+  out->series_label_start = ps.series_label_start.data();
+  out->series_label_count = ps.series_label_count.data();
+  out->series_sample_start = ps.series_sample_start.data();
+  out->series_sample_count = ps.series_sample_count.data();
+  out->label_name_off = ps.label_name_off.data();
+  out->label_name_len = ps.label_name_len.data();
+  out->label_value_off = ps.label_value_off.data();
+  out->label_value_len = ps.label_value_len.data();
+  out->sample_value = ps.sample_value.data();
+  out->sample_ts = ps.sample_ts.data();
+  out->sample_series = ps.sample_series.data();
+  out->exemplar_value = ps.exemplar_value.data();
+  out->exemplar_ts = ps.exemplar_ts.data();
+  out->exemplar_series = ps.exemplar_series.data();
+  out->meta_type = ps.meta_type.data();
+  out->meta_name_off = ps.meta_name_off.data();
+  out->meta_name_len = ps.meta_name_len.data();
+  return 0;
+}
+
+}  // extern "C"

@@ -78,9 +78,22 @@ class Slot:
     """Placeholder for a literal extracted by `split_literals`. A predicate
     whose Compare literals are Slots is a hashable *template*: jit-compiled
     kernels key their cache on the template, and the literal values flow in
-    as traced scalars — new constants, same executable."""
+    as traced scalars — new constants, same executable. Carries the column
+    name so the cast site needs no second type-dispatched tree walk."""
 
     idx: int
+    column: str = ""
+
+
+def iter_nodes(pred: Predicate):
+    """Generic pre-order walk — the single structural traversal shared by
+    every predicate pass (split/cast/eval helpers)."""
+    yield pred
+    if isinstance(pred, (And, Or)):
+        for c in pred.children:
+            yield from iter_nodes(c)
+    elif isinstance(pred, Not):
+        yield from iter_nodes(pred.child)
 
 
 def split_literals(pred: Predicate | None) -> tuple[Predicate | None, tuple]:
@@ -91,7 +104,7 @@ def split_literals(pred: Predicate | None) -> tuple[Predicate | None, tuple]:
     def walk(p: Predicate) -> Predicate:
         if isinstance(p, Compare):
             literals.append(p.literal)
-            return Compare(p.column, p.op, Slot(len(literals) - 1))
+            return Compare(p.column, p.op, Slot(len(literals) - 1, p.column))
         if isinstance(p, And):
             return And(*[walk(c) for c in p.children])
         if isinstance(p, Or):
@@ -103,6 +116,48 @@ def split_literals(pred: Predicate | None) -> tuple[Predicate | None, tuple]:
     if pred is None:
         return None, ()
     return walk(pred), tuple(literals)
+
+
+def _checked_cast(v, dt: np.dtype, column: str):
+    """Cast a literal to a column dtype, rejecting values the dtype cannot
+    represent (silent wrapping or float truncation would silently change
+    lt/ge/eq semantics — and host-side pruning, which compares exactly,
+    would then disagree with device evaluation)."""
+    if np.issubdtype(dt, np.integer):
+        if isinstance(v, float):
+            if not v.is_integer():
+                raise HoraeError(
+                    f"fractional literal {v} on integer column {column!r}; "
+                    "rewrite the predicate with an integer bound"
+                )
+            v = int(v)
+        info = np.iinfo(dt)
+        if not (info.min <= v <= info.max):
+            raise HoraeError(
+                f"literal {v} out of range for column {column!r} ({dt})"
+            )
+    return np.asarray(v, dtype=dt)
+
+
+def literal_arrays(
+    template: Predicate | None, literals: tuple, dtypes: dict
+) -> tuple:
+    """Cast extracted literals to their columns' dtypes (a u64 id >= 2**63
+    overflows the default int64 conversion at the jit boundary)."""
+    if template is None:
+        return ()
+    slot_col: dict[int, str] = {}
+    for node in iter_nodes(template):
+        if isinstance(node, Compare) and isinstance(node.literal, Slot):
+            slot_col[node.literal.idx] = node.literal.column or node.column
+    out = []
+    for i, v in enumerate(literals):
+        col = slot_col.get(i)
+        dt = dtypes.get(col) if col is not None else None
+        out.append(
+            _checked_cast(v, np.dtype(dt), col) if dt is not None else np.asarray(v)
+        )
+    return tuple(out)
 
 
 def time_range_pred(ts_column: str, start: int, end: int) -> Predicate:
@@ -128,8 +183,10 @@ def eval_predicate(
 def _eval(pred: Predicate, cols: dict[str, jnp.ndarray], literals: tuple = ()) -> jnp.ndarray:
     if isinstance(pred, Compare):
         c = cols[pred.column]
-        raw = literals[pred.literal.idx] if isinstance(pred.literal, Slot) else pred.literal
-        lit = jnp.asarray(raw, dtype=c.dtype)
+        if isinstance(pred.literal, Slot):
+            lit = jnp.asarray(literals[pred.literal.idx], dtype=c.dtype)
+        else:
+            lit = jnp.asarray(_checked_cast(pred.literal, np.dtype(c.dtype), pred.column))
         if pred.op == "eq":
             return c == lit
         if pred.op == "ne":
@@ -143,7 +200,21 @@ def _eval(pred: Predicate, cols: dict[str, jnp.ndarray], literals: tuple = ()) -
         return c >= lit
     if isinstance(pred, InSet):
         c = cols[pred.column]
-        vals = jnp.asarray(np.asarray(pred.values), dtype=c.dtype)
+        dt = np.dtype(c.dtype)
+        vals_list = list(pred.values)
+        if np.issubdtype(dt, np.integer):
+            # equality can never hold for values the dtype can't represent
+            info = np.iinfo(dt)
+            vals_list = [
+                int(v) for v in vals_list
+                if (not isinstance(v, float) or v.is_integer())
+                and info.min <= v <= info.max
+            ]
+        if not vals_list:
+            return jnp.zeros(c.shape[0], dtype=bool)
+        # Build with the column dtype directly: np.asarray on a mixed-magnitude
+        # u64 tuple silently promotes to float64 and corrupts ids > 2**53.
+        vals = jnp.asarray(np.asarray(vals_list, dtype=dt))
         return jnp.any(c[:, None] == vals[None, :], axis=1)
     if isinstance(pred, And):
         out = _eval(pred.children[0], cols, literals)

@@ -127,7 +127,10 @@ def sharded_downsample(
     constants reuse the memoized executable."""
     template, literals = filter_ops.split_literals(predicate)
     fn = build_sharded_downsample(mesh, num_series, num_buckets, template, with_minmax)
-    lit_arrays = tuple(jnp.asarray(l) for l in literals)
+    lit_arrays = filter_ops.literal_arrays(
+        template, literals,
+        {"__ts__": ts.dtype, "__sid__": sid.dtype, "__val__": vals.dtype},
+    )
     return fn(ts, sid, vals, valid, lit_arrays,
               jnp.asarray(t0, dtype=ts.dtype), jnp.asarray(bucket_ms, dtype=ts.dtype))
 

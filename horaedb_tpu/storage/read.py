@@ -217,7 +217,10 @@ class ParquetReader:
         }
         block = Block.from_numpy(arrays, pad_keys=sort_keys)
 
-        template, literals = filter_ops.split_literals(predicate)
+        template, raw_literals = filter_ops.split_literals(predicate)
+        literals = filter_ops.literal_arrays(
+            template, raw_literals, {k: v.dtype for k, v in block.columns.items()}
+        )
         do_dedup = (
             schema.update_mode == UpdateMode.OVERWRITE and not binary_names
         )
@@ -337,6 +340,12 @@ def _read_pruned(
 ) -> pa.Table:
     keep_groups = []
     meta = pf.metadata
+    arrow_schema = pf.schema_arrow
+    unsigned = {
+        name
+        for name in arrow_schema.names
+        if pa.types.is_unsigned_integer(arrow_schema.field(name).type)
+    }
     for rg in range(meta.num_row_groups):
         stats: dict[str, tuple] = {}
         g = meta.row_group(rg)
@@ -344,7 +353,12 @@ def _read_pruned(
             col = g.column(ci)
             st = col.statistics
             if st is not None and st.has_min_max:
-                stats[col.path_in_schema] = (_stat_value(st.min), _stat_value(st.max))
+                name = col.path_in_schema
+                lo = _stat_value(st.min, name in unsigned)
+                hi = _stat_value(st.max, name in unsigned)
+                if lo > hi:  # u64 range straddling 2**63 wrapped; stats unusable
+                    continue
+                stats[name] = (lo, hi)
         if filter_ops.prune_range(predicate, stats):
             keep_groups.append(rg)
     if not keep_groups:
@@ -352,9 +366,11 @@ def _read_pruned(
     return pf.read_row_groups(keep_groups, columns=columns, use_threads=True)
 
 
-def _stat_value(v):
-    """Normalize parquet statistics to the numeric domain predicates use
-    (timestamp columns report datetime.datetime; literals are epoch ms)."""
+def _stat_value(v, is_unsigned: bool = False):
+    """Normalize parquet statistics to the numeric domain predicates use:
+    - timestamp columns report datetime.datetime; literals are epoch ms;
+    - uint64 columns are stored as signed int64 physically, so ids >= 2**63
+      (seahash ids routinely are) come back negative and must re-wrap."""
     import calendar
     import datetime
 
@@ -362,6 +378,8 @@ def _stat_value(v):
         # exact integer epoch ms — float .timestamp()*1000 truncates ~1% of
         # millisecond values down by 1, which would mis-prune row groups
         return calendar.timegm(v.utctimetuple()) * 1000 + v.microsecond // 1000
+    if is_unsigned and isinstance(v, int) and v < 0:
+        return v + (1 << 64)
     return v
 
 

@@ -1,0 +1,200 @@
+"""Metric engine tests: seahash conformance + ingest->index->query loops."""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.engine import MetricEngine, QueryRequest
+from horaedb_tpu.engine.types import (
+    seahash,
+    series_id_of,
+    series_key_of,
+    tag_hash_of,
+)
+from horaedb_tpu.ingest import PooledParser
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.pb import remote_write_pb2
+from tests.conftest import async_test
+
+HOUR = 3_600_000
+
+
+class TestSeahash:
+    def test_crate_documented_vector(self):
+        """The seahash crate's doc example: hash(b"to be or not to be")."""
+        assert seahash(b"to be or not to be") == 1988685042348123509
+
+    def test_determinism_and_spread(self):
+        xs = {seahash(f"metric-{i}".encode()) for i in range(1000)}
+        assert len(xs) == 1000
+        assert seahash(b"abc") == seahash(b"abc")
+
+    def test_series_key_injective(self):
+        a = series_key_of([(b"a", b"x=y"), (b"b", b"z")])
+        b = series_key_of([(b"a", b"x"), (b"=yb", b"z")])
+        assert a != b
+
+    def test_series_key_order_insensitive(self):
+        a = series_key_of([(b"a", b"1"), (b"b", b"2")])
+        b = series_key_of([(b"b", b"2"), (b"a", b"1")])
+        assert a == b
+        assert series_id_of(a) == series_id_of(b)
+
+    def test_tag_hash_distinct(self):
+        assert tag_hash_of(b"host", b"a") != tag_hash_of(b"host", b"b")
+        assert tag_hash_of(b"hos", b"ta") != tag_hash_of(b"host", b"a")
+
+
+def make_remote_write(series_samples) -> bytes:
+    """series_samples: list of (labels dict incl __name__, [(ts, val), ...])."""
+    req = remote_write_pb2.WriteRequest()
+    for labels, samples in series_samples:
+        ts = req.timeseries.add()
+        for k in sorted(labels):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = labels[k]
+        for t, v in samples:
+            s = ts.samples.add()
+            s.timestamp = t
+            s.value = v
+    return req.SerializeToString()
+
+
+async def open_engine(store):
+    return await MetricEngine.open(
+        "metrics-db", store, segment_duration_ms=HOUR, enable_compaction=False
+    )
+
+
+class TestMetricEngine:
+    @async_test
+    async def test_write_then_query_raw(self):
+        store = MemStore()
+        eng = await open_engine(store)
+        payload = make_remote_write(
+            [
+                ({"__name__": "cpu", "host": "a"}, [(1000, 1.0), (2000, 2.0)]),
+                ({"__name__": "cpu", "host": "b"}, [(1500, 5.0)]),
+                ({"__name__": "mem", "host": "a"}, [(1000, 9.0)]),
+            ]
+        )
+        parsed = PooledParser.decode(payload)
+        n = await eng.write_parsed(parsed)
+        assert n == 4
+
+        t = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000))
+        assert t.num_rows == 3
+        assert sorted(t.column("value").to_pylist()) == [1.0, 2.0, 5.0]
+
+        # tag filter: host=a only
+        t = await eng.query(
+            QueryRequest(
+                metric=b"cpu", start_ms=0, end_ms=10_000, filters=[(b"host", b"a")]
+            )
+        )
+        assert sorted(t.column("value").to_pylist()) == [1.0, 2.0]
+        await eng.close()
+
+    @async_test
+    async def test_unknown_metric_and_no_match_filter(self):
+        store = MemStore()
+        eng = await open_engine(store)
+        payload = make_remote_write([({"__name__": "cpu", "host": "a"}, [(1000, 1.0)])])
+        await eng.write_parsed(PooledParser.decode(payload))
+        assert await eng.query(QueryRequest(metric=b"nope", start_ms=0, end_ms=10)) is None
+        out = await eng.query(
+            QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000, filters=[(b"host", b"zzz")])
+        )
+        assert out is None
+        await eng.close()
+
+    @async_test
+    async def test_overwrite_same_series_same_ts(self):
+        """Same (metric, series, ts) written twice: newest seq wins."""
+        store = MemStore()
+        eng = await open_engine(store)
+        p1 = make_remote_write([({"__name__": "cpu", "host": "a"}, [(1000, 1.0)])])
+        p2 = make_remote_write([({"__name__": "cpu", "host": "a"}, [(1000, 42.0)])])
+        await eng.write_parsed(PooledParser.decode(p1))
+        await eng.write_parsed(PooledParser.decode(p2))
+        t = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000))
+        assert t.column("value").to_pylist() == [42.0]
+        await eng.close()
+
+    @async_test
+    async def test_downsample_query(self):
+        store = MemStore()
+        eng = await open_engine(store)
+        samples_a = [(i * 1000, float(i)) for i in range(60)]  # 1 min of 1s points
+        samples_b = [(i * 1000, 10.0) for i in range(60)]
+        payload = make_remote_write(
+            [
+                ({"__name__": "cpu", "host": "a"}, samples_a),
+                ({"__name__": "cpu", "host": "b"}, samples_b),
+            ]
+        )
+        await eng.write_parsed(PooledParser.decode(payload))
+        out = await eng.query(
+            QueryRequest(metric=b"cpu", start_ms=0, end_ms=60_000, bucket_ms=15_000)
+        )
+        tsids, grids = out
+        assert len(tsids) == 2
+        assert grids["mean"].shape == (2, 4)
+        # host=b series is constant 10.0
+        key_b = series_id_of(series_key_of([(b"host", b"b")]))
+        row_b = tsids.index(key_b)
+        np.testing.assert_allclose(grids["mean"][row_b], 10.0)
+        # host=a buckets: mean of 0..14 = 7, 15..29 = 22, ...
+        row_a = 1 - row_b
+        np.testing.assert_allclose(grids["mean"][row_a], [7.0, 22.0, 37.0, 52.0])
+        await eng.close()
+
+    @async_test
+    async def test_multi_segment_write(self):
+        """Samples spanning segments split into per-segment storage writes."""
+        store = MemStore()
+        eng = await open_engine(store)
+        payload = make_remote_write(
+            [({"__name__": "cpu", "host": "a"}, [(1000, 1.0), (HOUR + 1000, 2.0)])]
+        )
+        await eng.write_parsed(PooledParser.decode(payload))
+        assert len(eng.data_table.manifest.all_ssts()) == 2
+        t = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=2 * HOUR))
+        assert t.column("value").to_pylist() == [1.0, 2.0]
+        await eng.close()
+
+    @async_test
+    async def test_restart_recovers_index(self):
+        store = MemStore()
+        eng = await open_engine(store)
+        payload = make_remote_write(
+            [
+                ({"__name__": "cpu", "host": "a", "dc": "x"}, [(1000, 1.0)]),
+                ({"__name__": "cpu", "host": "b", "dc": "y"}, [(1000, 2.0)]),
+            ]
+        )
+        await eng.write_parsed(PooledParser.decode(payload))
+        await eng.close()
+
+        eng2 = await open_engine(store)
+        t = await eng2.query(
+            QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000, filters=[(b"dc", b"y")])
+        )
+        assert t.column("value").to_pylist() == [2.0]
+        assert eng2.label_values(b"cpu", b"host") == [b"a", b"b"]
+        await eng2.close()
+
+    @async_test
+    async def test_label_values(self):
+        store = MemStore()
+        eng = await open_engine(store)
+        payload = make_remote_write(
+            [
+                ({"__name__": "cpu", "host": f"h{i}"}, [(1000, 1.0)])
+                for i in range(5)
+            ]
+        )
+        await eng.write_parsed(PooledParser.decode(payload))
+        assert eng.label_values(b"cpu", b"host") == [b"h0", b"h1", b"h2", b"h3", b"h4"]
+        assert eng.label_values(b"cpu", b"nope") == []
+        await eng.close()

@@ -1,0 +1,160 @@
+"""Remote-write parser tests: differential native-vs-protobuf decoding
+(reference: equivalence_test.rs:18-177 differential-tests the hand-rolled
+parser against prost over captured payloads; we generate equivalent
+production-shaped payloads since the binary corpus lives in the read-only
+reference)."""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.ingest import ParsedWriteRequest, PooledParser, ParserPool
+from horaedb_tpu.ingest.py_parser import PyParser
+from horaedb_tpu.pb import remote_write_pb2
+from tests.conftest import async_test
+
+
+def make_payload(seed=0, n_series=50, with_exemplars=True, with_metadata=True) -> bytes:
+    """Production-shaped WriteRequest: host/metric labels, several samples."""
+    rng = random.Random(seed)
+    req = remote_write_pb2.WriteRequest()
+    for i in range(n_series):
+        ts = req.timeseries.add()
+        labels = {
+            "__name__": f"cpu_usage_{rng.randint(0, 5)}",
+            "host": f"host-{rng.randint(0, 100):03d}",
+            "region": rng.choice(["us-east-1", "eu-west-1", "ap-south-1"]),
+            "dc": f"dc{rng.randint(0, 3)}",
+        }
+        for k in sorted(labels):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = labels[k]
+        for _ in range(rng.randint(1, 10)):
+            s = ts.samples.add()
+            s.value = rng.normalvariate(0, 100)
+            s.timestamp = rng.randint(1_700_000_000_000, 1_800_000_000_000)
+        if with_exemplars and rng.random() < 0.3:
+            ex = ts.exemplars.add()
+            ex.value = rng.random()
+            ex.timestamp = rng.randint(1_700_000_000_000, 1_800_000_000_000)
+            lab = ex.labels.add()
+            lab.name = "trace_id"
+            lab.value = f"{rng.randint(0, 1 << 63):x}"
+    if with_metadata:
+        md = req.metadata.add()
+        md.type = remote_write_pb2.MetricMetadata.COUNTER
+        md.metric_family_name = "cpu_usage"
+        md.help = "cpu usage of host"
+        md.unit = "percent"
+    return req.SerializeToString()
+
+
+def native_parser():
+    from horaedb_tpu.ingest import native
+
+    if native.load() is None:
+        pytest.skip("native parser not available")
+    return native.NativeParser()
+
+
+def assert_equivalent(a: ParsedWriteRequest, b: ParsedWriteRequest):
+    """Structural equality regardless of each parser's buffer layout."""
+    assert a.n_series == b.n_series
+    assert a.n_samples == b.n_samples
+    np.testing.assert_array_equal(a.sample_value, b.sample_value)
+    np.testing.assert_array_equal(a.sample_ts, b.sample_ts)
+    np.testing.assert_array_equal(a.sample_series, b.sample_series)
+    np.testing.assert_array_equal(a.series_sample_count, b.series_sample_count)
+    np.testing.assert_array_equal(a.series_label_count, b.series_label_count)
+    for s in range(a.n_series):
+        assert a.series_labels(s) == b.series_labels(s)
+    np.testing.assert_array_equal(a.exemplar_value, b.exemplar_value)
+    np.testing.assert_array_equal(a.exemplar_ts, b.exemplar_ts)
+    np.testing.assert_array_equal(a.meta_type, b.meta_type)
+    for i in range(len(a.meta_type)):
+        assert a.meta_name(i) == b.meta_name(i)
+
+
+class TestDifferential:
+    def test_native_matches_protobuf_oracle(self):
+        native = native_parser()
+        oracle = PyParser()
+        for seed in range(10):
+            payload = make_payload(seed=seed, n_series=30)
+            assert_equivalent(native.parse(payload), oracle.parse(payload))
+
+    def test_sequential_reuse_50_iterations(self):
+        """Pool-reuse semantics: one arena, many parses (equivalence_test.rs
+        runs 50 sequential iterations)."""
+        native = native_parser()
+        oracle = PyParser()
+        payloads = [make_payload(seed=s) for s in range(5)]
+        for i in range(50):
+            p = payloads[i % len(payloads)]
+            assert_equivalent(native.parse(p), oracle.parse(p))
+
+    def test_empty_request(self):
+        native = native_parser()
+        out = native.parse(b"")
+        assert out.n_series == 0 and out.n_samples == 0
+
+    def test_unknown_fields_skipped(self):
+        """Forward compat: unknown fields at every level are skipped
+        (pb_reader.rs:400-429)."""
+        native = native_parser()
+        payload = make_payload(seed=1, n_series=2)
+        # append an unknown top-level field: tag 15 wire 2 + 3 bytes
+        unknown = bytes([15 << 3 | 2, 3, 1, 2, 3])
+        out = native.parse(payload + unknown)
+        assert out.n_series == 2
+
+    def test_malformed_rejected(self):
+        native = native_parser()
+        with pytest.raises(HoraeError):
+            native.parse(b"\x0a\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+        # truncated length-delimited field
+        with pytest.raises(HoraeError):
+            native.parse(bytes([1 << 3 | 2, 100, 1, 2]))
+
+    def test_large_varints_and_negative_timestamps(self):
+        req = remote_write_pb2.WriteRequest()
+        ts = req.timeseries.add()
+        lab = ts.labels.add(); lab.name = "n"; lab.value = "v"
+        s = ts.samples.add(); s.value = -1.5; s.timestamp = -12345  # sint? int64 negative -> 10-byte varint
+        payload = req.SerializeToString()
+        native = native_parser()
+        out = native.parse(payload)
+        assert out.sample_ts[0] == -12345
+        assert out.sample_value[0] == -1.5
+
+
+class TestPool:
+    @async_test
+    async def test_concurrent_decode_50_tasks(self):
+        """Concurrent pooled parsing (equivalence_test.rs concurrent half)."""
+        pool = ParserPool(size=8)
+        oracle = PyParser()
+        payloads = [make_payload(seed=s) for s in range(10)]
+        expected = [oracle.parse(p) for p in payloads]
+
+        async def one(i):
+            out = await pool.decode(payloads[i % 10])
+            assert_equivalent(out, expected[i % 10])
+
+        await asyncio.gather(*(one(i) for i in range(50)))
+        assert pool.status["size"] == 8
+
+    @async_test
+    async def test_pooled_decode_api(self):
+        payload = make_payload(seed=3)
+        out = await PooledParser.decode_async(payload)
+        assert out.n_series == 50
+
+    def test_oneshot_decode_api(self):
+        payload = make_payload(seed=3)
+        out = PooledParser.decode(payload)
+        assert out.n_series == 50

@@ -94,6 +94,37 @@ class TestFilter:
         )
         np.testing.assert_array_equal(mask, [False, True, False, True, True])
 
+    def test_in_set_u64_ids_exact(self):
+        """Mixed-magnitude u64 ids must not promote to float64 (which
+        corrupts ids > 2**53) — the seahash TSID-membership case."""
+        ids = np.array(
+            [48143032671202699, 12578593541292850658, 14329183490546117337, 7],
+            dtype=np.uint64,
+        )
+        pred = filter_ops.InSet("tsid", (48143032671202699, 12578593541292850658))
+        mask = np.asarray(filter_ops.eval_predicate(pred, {"tsid": ids}))
+        np.testing.assert_array_equal(mask, [True, True, False, False])
+
+    def test_in_set_unrepresentable_values_dropped(self):
+        """Negative / fractional values can never equal a u64 column —
+        dropped, not crashed (numpy raises OverflowError on a raw cast)."""
+        ids = np.array([5, 7], dtype=np.uint64)
+        pred = filter_ops.InSet("tsid", (-1, 5, 2**70, 6.5))
+        mask = np.asarray(filter_ops.eval_predicate(pred, {"tsid": ids}))
+        np.testing.assert_array_equal(mask, [True, False])
+        all_bad = filter_ops.InSet("tsid", (-1,))
+        mask = np.asarray(filter_ops.eval_predicate(all_bad, {"tsid": ids}))
+        np.testing.assert_array_equal(mask, [False, False])
+
+    def test_compare_out_of_domain_literal_rejected(self):
+        from horaedb_tpu.common.error import HoraeError
+
+        ids = np.array([5, 7], dtype=np.uint64)
+        with pytest.raises(HoraeError, match="out of range"):
+            filter_ops.eval_predicate(filter_ops.Compare("tsid", "lt", -1), {"tsid": ids})
+        with pytest.raises(HoraeError, match="fractional"):
+            filter_ops.eval_predicate(filter_ops.Compare("tsid", "lt", 1.5), {"tsid": ids})
+
     def test_none_predicate_keeps_all(self):
         cols = {"a": np.zeros(4, dtype=np.int64)}
         assert np.asarray(filter_ops.eval_predicate(None, cols)).all()
