@@ -35,24 +35,33 @@ class ParserPool:
         self._size = size
         self._sem = asyncio.Semaphore(size)
         self._free: list = []
+        self._in_use = 0
+        self._waiting = 0
 
     async def decode(self, payload: bytes) -> ParsedWriteRequest:
-        async with self._sem:
-            parser = self._free.pop() if self._free else _new_backend()
-            try:
-                # native parse releases no GIL-bound state we await on; run in
-                # a thread so large payloads don't stall the event loop
-                return await asyncio.to_thread(parser.parse, payload)
-            finally:
-                self._free.append(parser)
+        self._waiting += 1
+        try:
+            await self._sem.acquire()
+        finally:
+            self._waiting -= 1
+        self._in_use += 1
+        parser = self._free.pop() if self._free else _new_backend()
+        try:
+            # native parse releases no GIL-bound state we await on; run in a
+            # thread so large payloads don't stall the event loop
+            return await asyncio.to_thread(parser.parse, payload)
+        finally:
+            self._free.append(parser)
+            self._in_use -= 1
+            self._sem.release()
 
     @property
     def status(self) -> dict:
         """Pool telemetry (reference: pool_stats bin)."""
         return {
             "size": self._size,
-            "available": len(self._free),
-            "waiting": 0 if self._sem._value > 0 else abs(self._sem._value),  # noqa: SLF001
+            "available": self._size - self._in_use,
+            "waiting": self._waiting,
         }
 
 

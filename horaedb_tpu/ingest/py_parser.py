@@ -42,9 +42,9 @@ class PyParser:
             sls.append(len(lno))
             sss.append(len(sv))
             for lab in series.labels:
-                o, l = put(lab.name.encode())
+                o, l = put(lab.name)
                 lno.append(o); lnl.append(l)
-                o, l = put(lab.value.encode())
+                o, l = put(lab.value)
                 lvo.append(o); lvl.append(l)
             for smp in series.samples:
                 sv.append(smp.value); st.append(smp.timestamp); ss.append(si)
@@ -54,7 +54,7 @@ class PyParser:
             ssc.append(len(sv) - sss[-1])
         for md in req.metadata:
             mt.append(int(md.type))
-            o, l = put(md.metric_family_name.encode())
+            o, l = put(md.metric_family_name)
             mno.append(o); mnl.append(l)
 
         i64 = lambda x: np.asarray(x, dtype=np.int64)  # noqa: E731

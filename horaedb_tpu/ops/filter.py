@@ -74,6 +74,19 @@ Predicate = Union[Compare, InSet, And, Or, Not]
 
 
 @dataclass(frozen=True)
+class InSetProbe:
+    """Template form of InSet after `split_literals`: the membership values
+    travel as a dynamic padded array operand (values_slot) plus an active
+    mask (mask_slot), so a new TSID set of the same size bucket reuses the
+    compiled kernel instead of triggering an XLA recompile per query."""
+
+    column: str
+    values_slot: int
+    mask_slot: int
+    padded_size: int
+
+
+@dataclass(frozen=True)
 class Slot:
     """Placeholder for a literal extracted by `split_literals`. A predicate
     whose Compare literals are Slots is a hashable *template*: jit-compiled
@@ -96,22 +109,38 @@ def iter_nodes(pred: Predicate):
         yield from iter_nodes(pred.child)
 
 
+def _pad_bucket(n: int) -> int:
+    """Next power of two (min 1): membership arrays pad to size buckets so
+    compiled-kernel reuse is per bucket, not per exact set size."""
+    return 1 << max(0, n - 1).bit_length() if n > 0 else 1
+
+
 def split_literals(pred: Predicate | None) -> tuple[Predicate | None, tuple]:
-    """Extract Compare literals into a tuple, leaving Slot markers behind.
-    InSet values stay static (their arity shapes the kernel anyway)."""
+    """Extract literals into a tuple, leaving dynamic markers behind:
+    Compare literals become Slots; InSet value tuples become InSetProbe
+    (padded values array + active mask, two slots)."""
     literals: list = []
 
     def walk(p: Predicate) -> Predicate:
         if isinstance(p, Compare):
             literals.append(p.literal)
             return Compare(p.column, p.op, Slot(len(literals) - 1, p.column))
+        if isinstance(p, InSet):
+            literals.append(tuple(p.values))
+            literals.append(None)  # mask slot, filled by literal_arrays
+            return InSetProbe(
+                p.column,
+                len(literals) - 2,
+                len(literals) - 1,
+                _pad_bucket(len(p.values)),
+            )
         if isinstance(p, And):
             return And(*[walk(c) for c in p.children])
         if isinstance(p, Or):
             return Or(*[walk(c) for c in p.children])
         if isinstance(p, Not):
             return Not(walk(p.child))
-        return p  # InSet
+        return p
 
     if pred is None:
         return None, ()
@@ -147,16 +176,40 @@ def literal_arrays(
     if template is None:
         return ()
     slot_col: dict[int, str] = {}
+    inset_nodes: dict[int, InSetProbe] = {}
     for node in iter_nodes(template):
         if isinstance(node, Compare) and isinstance(node.literal, Slot):
             slot_col[node.literal.idx] = node.literal.column or node.column
-    out = []
+        elif isinstance(node, InSetProbe):
+            inset_nodes[node.values_slot] = node
+    out: list = [None] * len(literals)
     for i, v in enumerate(literals):
-        col = slot_col.get(i)
-        dt = dtypes.get(col) if col is not None else None
-        out.append(
-            _checked_cast(v, np.dtype(dt), col) if dt is not None else np.asarray(v)
-        )
+        if i in inset_nodes:
+            node = inset_nodes[i]
+            dt = np.dtype(dtypes.get(node.column, np.int64))
+            vals_list = list(v)
+            if np.issubdtype(dt, np.integer):
+                info = np.iinfo(dt)
+                vals_list = [
+                    int(x) for x in vals_list
+                    if (not isinstance(x, float) or x.is_integer())
+                    and info.min <= x <= info.max
+                ]
+            k = len(vals_list)
+            pad_val = vals_list[0] if k else 0
+            padded = vals_list + [pad_val] * (node.padded_size - k)
+            out[node.values_slot] = np.asarray(padded, dtype=dt)
+            mask = np.zeros(node.padded_size, dtype=bool)
+            mask[:k] = True
+            out[node.mask_slot] = mask
+        elif out[i] is None and i in slot_col:
+            col = slot_col[i]
+            dt = dtypes.get(col)
+            out[i] = (
+                _checked_cast(v, np.dtype(dt), col) if dt is not None else np.asarray(v)
+            )
+        elif out[i] is None:
+            out[i] = np.asarray(v) if v is not None else np.zeros(0, dtype=bool)
     return tuple(out)
 
 
@@ -198,6 +251,12 @@ def _eval(pred: Predicate, cols: dict[str, jnp.ndarray], literals: tuple = ()) -
         if pred.op == "gt":
             return c > lit
         return c >= lit
+    if isinstance(pred, InSetProbe):
+        c = cols[pred.column]
+        vals = jnp.asarray(literals[pred.values_slot])
+        active = jnp.asarray(literals[pred.mask_slot])
+        hit = (c[:, None] == vals[None, :].astype(c.dtype)) & active[None, :]
+        return jnp.any(hit, axis=1)
     if isinstance(pred, InSet):
         c = cols[pred.column]
         dt = np.dtype(c.dtype)
@@ -267,6 +326,8 @@ def _prune(pred: Predicate, stats: dict[str, tuple]) -> bool:
             return True
         lo, hi = stats[pred.column]
         return any(lo <= v <= hi for v in pred.values)
+    if isinstance(pred, InSetProbe):
+        return True  # membership values are dynamic; stay conservative
     if isinstance(pred, And):
         return all(_prune(c, stats) for c in pred.children)
     if isinstance(pred, Or):

@@ -1,0 +1,5 @@
+"""HTTP server + config (reference: src/server)."""
+
+from horaedb_tpu.server.config import Config
+
+__all__ = ["Config"]

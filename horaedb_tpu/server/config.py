@@ -1,0 +1,130 @@
+"""Server configuration (reference: src/server/src/config.rs:21-175).
+
+Same tree: port, test-write knobs, engine threads, object-store selection
+(tagged enum Local | S3-like), nested StorageConfig. TOML via tomllib,
+deny_unknown_fields semantics throughout, ReadableDuration/Size strings
+accepted anywhere a duration/size appears (docs/example.toml analog below).
+
+Example:
+
+    port = 5000
+
+    [test]
+    enable_write = true
+    write_worker_num = 2
+    write_interval = "500ms"
+    segment_duration = "12h"
+
+    [metric_engine.storage.object_store]
+    type = "Local"
+    data_dir = "/tmp/horaedb-tpu"
+
+    [metric_engine.storage.time_merge_storage]
+    update_mode = "Overwrite"
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.common.time_ext import ReadableDuration
+from horaedb_tpu.storage.config import StorageConfig, _from_dict
+
+
+@dataclass
+class TestConfig:
+    """Self-write load generator (reference config.rs TestConfig)."""
+
+    enable_write: bool = False
+    write_worker_num: int = 1
+    write_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.millis(500)
+    )
+    segment_duration: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.hours(12)
+    )
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "TestConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class ThreadConfig:
+    """Background executor sizing (reference: tokio runtime thread counts;
+    here: bounded concurrency for manifest/compaction work)."""
+
+    manifest_thread_num: int = 2
+    sst_thread_num: int = 2
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "ThreadConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class ObjectStoreConfig:
+    """Tagged store selection. `type = "Local"` is supported; `"S3"` parses
+    but is rejected at startup exactly like the reference (main.rs:112
+    panics 'S3 not support yet')."""
+
+    type: str = "Local"
+    data_dir: str = "/tmp/horaedb-tpu"
+    # S3-like knobs (parsed, unsupported at runtime)
+    region: str | None = None
+    endpoint: str | None = None
+    bucket: str | None = None
+    key_id: str | None = None
+    key_secret: str | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "ObjectStoreConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class EngineStorageConfig:
+    object_store: ObjectStoreConfig = field(default_factory=ObjectStoreConfig)
+    time_merge_storage: StorageConfig = field(default_factory=StorageConfig)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "EngineStorageConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class MetricEngineConfig:
+    threads: ThreadConfig = field(default_factory=ThreadConfig)
+    storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "MetricEngineConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class Config:
+    port: int = 5000
+    test: TestConfig = field(default_factory=TestConfig)
+    metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "Config":
+        return _from_dict(cls, d)
+
+    @classmethod
+    def from_toml(cls, text: str) -> "Config":
+        return cls.from_dict(tomllib.loads(text))
+
+    @classmethod
+    def from_file(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            return cls.from_dict(tomllib.load(f))
+
+    def validate(self) -> None:
+        ensure(
+            self.metric_engine.storage.object_store.type.lower() == "local",
+            "S3 not support yet",
+        )

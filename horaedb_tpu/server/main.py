@@ -1,0 +1,326 @@
+"""Server entrypoint (reference: src/server/src/main.rs:87-233).
+
+Bootstrap mirrors the reference: structured logging with file/line/time
+(tracing-subscriber analog), `--config <toml>`, LocalFileSystem object store,
+an ObjectBasedStorage on the hardcoded demo schema (pk1,pk2,pk3,value Int64,
+num_primary_keys=3, main.rs:178-185), the optional self-write load generator
+(bench_write, main.rs:187-233), and the HTTP surface:
+
+    GET  /                 greeting/health
+    GET  /toggle           flip the load generator (main.rs:59-80)
+    GET  /compact          manual compaction trigger
+    GET  /metrics          Prometheus text metrics (beyond the reference)
+
+plus the ingest/query endpoints the reference defines but never wired
+(remote_write "NOT yet wired into server", SURVEY L5):
+
+    POST /api/v1/write     Prometheus remote-write (snappy or raw protobuf)
+    POST /api/v1/query     JSON query -> rows or downsample grids
+    GET  /api/v1/labels    label values via the inverted index
+
+Run: python -m horaedb_tpu.server.main --config docs/example.toml
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+import numpy as np
+import pyarrow as pa
+from aiohttp import web
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.common.time_ext import now_ms
+from horaedb_tpu.engine import MetricEngine, QueryRequest
+from horaedb_tpu.ingest import ParserPool
+from horaedb_tpu.objstore import LocalStore
+from horaedb_tpu.server.config import Config
+from horaedb_tpu.server.metrics import GLOBAL_METRICS as METRICS
+from horaedb_tpu.storage.read import CompactRequest, ScanRequest, WriteRequest
+from horaedb_tpu.storage.storage import ObjectBasedStorage
+from horaedb_tpu.storage.types import TimeRange, Timestamp
+
+logger = logging.getLogger("horaedb_tpu.server")
+
+STATE_KEY = web.AppKey("state", object)
+
+
+def init_logging() -> None:
+    """file:line + local time + env filter (main.rs:88-94 analog; level from
+    the standard logging env var style: HORAEDB_LOG=DEBUG)."""
+    import os
+
+    level = os.environ.get("HORAEDB_LOG", "INFO").upper()
+    logging.basicConfig(
+        level=getattr(logging, level, logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(filename)s:%(lineno)d %(message)s",
+        stream=sys.stderr,
+    )
+
+
+def build_demo_schema() -> pa.Schema:
+    """Hardcoded demo schema (main.rs:178-185)."""
+    return pa.schema(
+        [
+            ("pk1", pa.int64()),
+            ("pk2", pa.int64()),
+            ("pk3", pa.int64()),
+            ("value", pa.int64()),
+        ]
+    )
+
+
+def snappy_decompress(buf: bytes) -> bytes:
+    """Raw-snappy decompress via pyarrow's codec (no python-snappy in the
+    image): the uncompressed length is the stream's leading uvarint."""
+    size, shift, i = 0, 0, 0
+    while True:
+        b = buf[i]
+        size |= (b & 0x7F) << shift
+        i += 1
+        if not (b & 0x80):
+            break
+        shift += 7
+    return bytes(pa.Codec("snappy").decompress(buf, decompressed_size=size))
+
+
+class ServerState:
+    def __init__(self, config: Config, storage, engine: MetricEngine):
+        self.config = config
+        self.storage = storage       # demo ColumnarStorage (reference parity)
+        self.engine = engine         # metric engine (remote-write path)
+        self.parser_pool = ParserPool()
+        self.write_enabled = asyncio.Event()
+        self.write_workers: list[asyncio.Task] = []
+
+
+# ---------------------------------------------------------------------------
+# handlers
+# ---------------------------------------------------------------------------
+
+
+async def handle_root(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok", "engine": "horaedb-tpu"})
+
+
+async def handle_toggle(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    if state.write_enabled.is_set():
+        state.write_enabled.clear()
+        flag = False
+    else:
+        state.write_enabled.set()
+        flag = True
+    return web.json_response({"enable_write": flag})
+
+
+async def handle_compact(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    await state.storage.compact(CompactRequest())
+    await state.engine.compact()
+    METRICS.inc("horaedb_compactions_triggered_total")
+    return web.json_response({"compaction": "triggered"})
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    pool = state.parser_pool.status
+    METRICS.set("horaedb_parser_pool_size", pool["size"])
+    METRICS.set("horaedb_parser_pool_available", pool["available"])
+    return web.Response(text=METRICS.render(), content_type="text/plain")
+
+
+async def handle_remote_write(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    body = await request.read()
+    if request.headers.get("Content-Encoding", "").lower() == "snappy":
+        try:
+            body = snappy_decompress(body)
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "bad snappy payload"}, status=400)
+    try:
+        parsed = await state.parser_pool.decode(body)
+    except Exception as e:  # noqa: BLE001
+        return web.json_response({"error": f"bad payload: {e}"}, status=400)
+    try:
+        n = await state.engine.write_parsed(parsed)
+    except HoraeError as e:
+        # client-shaped errors (e.g. missing __name__) stay 4xx
+        if "missing __name__" in str(e):
+            return web.json_response({"error": str(e)}, status=400)
+        logger.exception("remote write failed")
+        return web.json_response({"error": str(e)}, status=500)
+    except Exception as e:  # noqa: BLE001
+        # internal failures must be 5xx: remote-write senders retry 5xx but
+        # permanently DROP the batch on 4xx
+        logger.exception("remote write failed")
+        return web.json_response({"error": str(e)}, status=500)
+    METRICS.inc("horaedb_remote_write_requests_total")
+    METRICS.inc("horaedb_remote_write_samples_total", n)
+    return web.json_response({"samples": n}, status=200)
+
+
+async def handle_query(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    try:
+        q = await request.json()
+        req = QueryRequest(
+            metric=q["metric"].encode(),
+            start_ms=int(q["start_ms"]),
+            end_ms=int(q["end_ms"]),
+            filters=[(k.encode(), v.encode()) for k, v in q.get("filters", {}).items()],
+            bucket_ms=q.get("bucket_ms"),
+        )
+    except Exception as e:  # noqa: BLE001
+        return web.json_response({"error": f"bad query: {e}"}, status=400)
+    METRICS.inc("horaedb_queries_total")
+    out = await state.engine.query(req)
+    if out is None:
+        return web.json_response({"series": []})
+    if req.bucket_ms is None:
+        table = out
+        return web.json_response(
+            {
+                "rows": table.num_rows,
+                "tsid": [str(x) for x in table.column("tsid").to_pylist()],
+                "ts": table.column("ts").to_pylist(),
+                "value": table.column("value").to_pylist(),
+            }
+        )
+    tsids, grids = out
+    return web.json_response(
+        {
+            "tsids": [str(t) for t in tsids],
+            "buckets": grids["mean"].shape[1],
+            "mean": np.where(np.isnan(grids["mean"]), None, grids["mean"]).tolist(),
+            "count": grids["count"].tolist(),
+        }
+    )
+
+
+async def handle_labels(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    metric = request.query.get("metric", "").encode()
+    key = request.query.get("key", "").encode()
+    vals = state.engine.label_values(metric, key)
+    return web.json_response({"values": [v.decode(errors="replace") for v in vals]})
+
+
+# ---------------------------------------------------------------------------
+# self-write load generator (main.rs:187-233)
+# ---------------------------------------------------------------------------
+
+
+async def bench_write_worker(state: ServerState, worker_id: int) -> None:
+    interval = state.config.test.write_interval.seconds
+    rng = np.random.default_rng(worker_id)
+    schema = build_demo_schema()
+    while True:
+        await state.write_enabled.wait()
+        t = now_ms()
+        batch = pa.RecordBatch.from_pydict(
+            {
+                "pk1": rng.integers(0, 1000, 1000),
+                "pk2": rng.integers(0, 1000, 1000),
+                "pk3": rng.integers(0, 1000, 1000),
+                "value": rng.integers(0, 1_000_000, 1000),
+            },
+            schema=schema,
+        )
+        try:
+            await state.storage.write(
+                WriteRequest(batch, TimeRange(t, t + 1), enable_check=True)
+            )
+            METRICS.inc("horaedb_bench_writes_total")
+        except Exception:  # noqa: BLE001
+            logger.exception("bench write failed")
+        await asyncio.sleep(interval)
+
+
+# ---------------------------------------------------------------------------
+# bootstrap
+# ---------------------------------------------------------------------------
+
+
+async def build_app(config: Config) -> web.Application:
+    config.validate()
+    store = LocalStore(config.metric_engine.storage.object_store.data_dir)
+    segment_ms = config.test.segment_duration.as_millis()
+    storage = await ObjectBasedStorage.try_new(
+        root="demo",
+        store=store,
+        arrow_schema=build_demo_schema(),
+        num_primary_keys=3,
+        segment_duration_ms=segment_ms,
+        config=config.metric_engine.storage.time_merge_storage,
+    )
+    engine = await MetricEngine.open(
+        "metrics", store, segment_duration_ms=segment_ms,
+        config=config.metric_engine.storage.time_merge_storage,
+    )
+    state = ServerState(config, storage, engine)
+    if config.test.enable_write:
+        state.write_enabled.set()
+    for i in range(config.test.write_worker_num):
+        state.write_workers.append(
+            asyncio.create_task(bench_write_worker(state, i), name=f"bench-write-{i}")
+        )
+
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app[STATE_KEY] = state
+    app.add_routes(
+        [
+            web.get("/", handle_root),
+            web.get("/toggle", handle_toggle),
+            web.get("/compact", handle_compact),
+            web.get("/metrics", handle_metrics),
+            web.post("/api/v1/write", handle_remote_write),
+            web.post("/api/v1/query", handle_query),
+            web.get("/api/v1/labels", handle_labels),
+        ]
+    )
+
+    async def on_cleanup(app):
+        for t in state.write_workers:
+            t.cancel()
+        await state.storage.close()
+        await state.engine.close()
+
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def main() -> None:
+    init_logging()
+    # Escape hatch for CPU-only deployments and CI: force the jax platform
+    # BEFORE the backend initializes (some images pre-register an accelerator
+    # platform that wins over JAX_PLATFORMS).
+    import os
+
+    platform = os.environ.get("HORAEDB_JAX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    ap = argparse.ArgumentParser(description="horaedb-tpu server")
+    ap.add_argument("--config", help="toml config path")
+    args = ap.parse_args()
+    config = Config.from_file(args.config) if args.config else Config()
+    logger.info("starting horaedb-tpu server on 127.0.0.1:%d", config.port)
+
+    async def run():
+        app = await build_app(config)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", config.port)
+        await site.start()
+        await asyncio.Event().wait()  # serve forever
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Server metrics registry.
+
+The reference has logging but NO metrics endpoint (SURVEY §5.5 — DataFusion's
+metrics set is accepted but unused); the survey explicitly tells the TPU
+build to do better. Minimal dependency-free counters exposed in Prometheus
+text format at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._start = time.time()
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._counters[name] = value
+
+    def render(self) -> str:
+        with self._lock:
+            lines = [
+                "# TYPE horaedb_uptime_seconds gauge",
+                f"horaedb_uptime_seconds {time.time() - self._start:.1f}",
+            ]
+            for name in sorted(self._counters):
+                lines.append(f"{name} {self._counters[name]:g}")
+        return "\n".join(lines) + "\n"
+
+
+GLOBAL_METRICS = Metrics()

@@ -51,8 +51,8 @@ def make_remote_write(series_samples) -> bytes:
         ts = req.timeseries.add()
         for k in sorted(labels):
             lab = ts.labels.add()
-            lab.name = k
-            lab.value = labels[k]
+            lab.name = k.encode()
+            lab.value = labels[k].encode()
         for t, v in samples:
             s = ts.samples.add()
             s.timestamp = t

@@ -31,8 +31,8 @@ def make_payload(seed=0, n_series=50, with_exemplars=True, with_metadata=True) -
         }
         for k in sorted(labels):
             lab = ts.labels.add()
-            lab.name = k
-            lab.value = labels[k]
+            lab.name = k.encode()
+            lab.value = labels[k].encode()
         for _ in range(rng.randint(1, 10)):
             s = ts.samples.add()
             s.value = rng.normalvariate(0, 100)
@@ -42,14 +42,14 @@ def make_payload(seed=0, n_series=50, with_exemplars=True, with_metadata=True) -
             ex.value = rng.random()
             ex.timestamp = rng.randint(1_700_000_000_000, 1_800_000_000_000)
             lab = ex.labels.add()
-            lab.name = "trace_id"
-            lab.value = f"{rng.randint(0, 1 << 63):x}"
+            lab.name = b"trace_id"
+            lab.value = f"{rng.randint(0, 1 << 63):x}".encode()
     if with_metadata:
         md = req.metadata.add()
         md.type = remote_write_pb2.MetricMetadata.COUNTER
-        md.metric_family_name = "cpu_usage"
-        md.help = "cpu usage of host"
-        md.unit = "percent"
+        md.metric_family_name = b"cpu_usage"
+        md.help = b"cpu usage of host"
+        md.unit = b"percent"
     return req.SerializeToString()
 
 
@@ -120,10 +120,23 @@ class TestDifferential:
         with pytest.raises(HoraeError):
             native.parse(bytes([1 << 3 | 2, 100, 1, 2]))
 
+    def test_non_utf8_labels_accepted_by_both_parsers(self):
+        """The ingest contract: labels are raw bytes, never UTF-8 validated
+        (pooled_parser.rs:18-24) — both backends must accept them."""
+        req = remote_write_pb2.WriteRequest()
+        ts = req.timeseries.add()
+        lab = ts.labels.add(); lab.name = b"\xff\xfe"; lab.value = b"\x80bad"
+        s = ts.samples.add(); s.value = 1.0; s.timestamp = 5
+        payload = req.SerializeToString()
+        out_py = PyParser().parse(payload)
+        assert out_py.series_labels(0) == [(b"\xff\xfe", b"\x80bad")]
+        native = native_parser()
+        assert_equivalent(native.parse(payload), out_py)
+
     def test_large_varints_and_negative_timestamps(self):
         req = remote_write_pb2.WriteRequest()
         ts = req.timeseries.add()
-        lab = ts.labels.add(); lab.name = "n"; lab.value = "v"
+        lab = ts.labels.add(); lab.name = b"n"; lab.value = b"v"
         s = ts.samples.add(); s.value = -1.5; s.timestamp = -12345  # sint? int64 negative -> 10-byte varint
         payload = req.SerializeToString()
         native = native_parser()

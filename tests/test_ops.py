@@ -116,6 +116,23 @@ class TestFilter:
         mask = np.asarray(filter_ops.eval_predicate(all_bad, {"tsid": ids}))
         np.testing.assert_array_equal(mask, [False, False])
 
+    def test_inset_probe_template_stable_across_value_sets(self):
+        """split_literals turns InSet into a dynamic membership probe: two
+        different tsid sets of the same size bucket share one template (the
+        jit cache key), and evaluation stays exact."""
+        ids = np.array([1, 5, 9, 2**63 + 3], dtype=np.uint64)
+        p1 = filter_ops.InSet("tsid", (5, 2**63 + 3, 9))
+        p2 = filter_ops.InSet("tsid", (1, 2, 3))
+        t1, l1 = filter_ops.split_literals(p1)
+        t2, l2 = filter_ops.split_literals(p2)
+        assert t1 == t2  # same bucket (4) -> same template -> same kernel
+        a1 = filter_ops.literal_arrays(t1, l1, {"tsid": np.dtype(np.uint64)})
+        a2 = filter_ops.literal_arrays(t2, l2, {"tsid": np.dtype(np.uint64)})
+        m1 = np.asarray(filter_ops.eval_predicate(t1, {"tsid": ids}, a1))
+        m2 = np.asarray(filter_ops.eval_predicate(t2, {"tsid": ids}, a2))
+        np.testing.assert_array_equal(m1, [False, True, True, True])
+        np.testing.assert_array_equal(m2, [True, False, False, False])
+
     def test_compare_out_of_domain_literal_rejected(self):
         from horaedb_tpu.common.error import HoraeError
 

@@ -1,0 +1,170 @@
+"""HTTP server tests: endpoint surface + remote-write -> query loop."""
+
+import pyarrow as pa
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.server.config import Config
+from horaedb_tpu.server.main import build_app, snappy_decompress
+from tests.conftest import async_test
+from tests.test_engine import make_remote_write
+
+
+def make_config(tmp_path) -> Config:
+    return Config.from_toml(
+        f"""
+port = 0
+[test]
+segment_duration = "2h"
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+"""
+    )
+
+
+async def make_client(tmp_path) -> TestClient:
+    app = await build_app(make_config(tmp_path))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestConfigParsing:
+    def test_defaults(self):
+        c = Config.from_dict(None)
+        assert c.port == 5000
+        assert c.test.write_worker_num == 1
+        assert c.metric_engine.storage.object_store.type == "Local"
+
+    def test_example_toml_parses(self):
+        with open("docs/example.toml") as f:
+            c = Config.from_toml(f.read())
+        assert c.port == 5000
+        assert c.test.segment_duration.as_millis() == 12 * 3600_000
+        assert (
+            c.metric_engine.storage.time_merge_storage.scheduler.memory_limit.as_bytes()
+            == 2 * 1024**3
+        )
+
+    def test_unknown_key_rejected(self):
+        """deny_unknown_fields semantics (config.rs serde attribute)."""
+        with pytest.raises(HoraeError, match="unknown config keys"):
+            Config.from_toml("port = 1\nwhatever = 2\n")
+        with pytest.raises(HoraeError, match="unknown config keys"):
+            Config.from_toml("[test]\nnope = 1\n")
+
+    def test_s3_rejected_at_validate(self):
+        c = Config.from_toml(
+            '[metric_engine.storage.object_store]\ntype = "S3"\nbucket = "b"\n'
+        )
+        with pytest.raises(HoraeError, match="S3 not support yet"):
+            c.validate()
+
+
+class TestEndpoints:
+    @async_test
+    async def test_root_toggle_compact_metrics(self, tmp_path):
+        client = await make_client(tmp_path)
+        try:
+            r = await client.get("/")
+            assert r.status == 200
+            assert (await r.json())["status"] == "ok"
+
+            r = await client.get("/toggle")
+            assert (await r.json())["enable_write"] is True
+            r = await client.get("/toggle")
+            assert (await r.json())["enable_write"] is False
+
+            r = await client.get("/compact")
+            assert r.status == 200
+
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "horaedb_uptime_seconds" in text
+            assert "horaedb_parser_pool_size" in text
+        finally:
+            await client.close()
+
+    @async_test
+    async def test_remote_write_then_query(self, tmp_path):
+        client = await make_client(tmp_path)
+        try:
+            payload = make_remote_write(
+                [
+                    ({"__name__": "cpu", "host": "a"}, [(1000, 1.5), (2000, 2.5)]),
+                    ({"__name__": "cpu", "host": "b"}, [(1500, 7.0)]),
+                ]
+            )
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200
+            assert (await r.json())["samples"] == 3
+
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "cpu", "start_ms": 0, "end_ms": 10_000},
+            )
+            body = await r.json()
+            assert body["rows"] == 3
+            assert sorted(body["value"]) == [1.5, 2.5, 7.0]
+
+            # filtered query
+            r = await client.post(
+                "/api/v1/query",
+                json={
+                    "metric": "cpu",
+                    "start_ms": 0,
+                    "end_ms": 10_000,
+                    "filters": {"host": "a"},
+                },
+            )
+            body = await r.json()
+            assert body["rows"] == 2
+
+            # downsample query
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "cpu", "start_ms": 0, "end_ms": 4000, "bucket_ms": 2000},
+            )
+            body = await r.json()
+            assert body["buckets"] == 2
+            assert len(body["tsids"]) == 2
+
+            # labels
+            r = await client.get("/api/v1/labels?metric=cpu&key=host")
+            assert (await r.json())["values"] == ["a", "b"]
+        finally:
+            await client.close()
+
+    @async_test
+    async def test_remote_write_snappy(self, tmp_path):
+        client = await make_client(tmp_path)
+        try:
+            payload = make_remote_write([({"__name__": "m", "h": "x"}, [(1000, 1.0)])])
+            comp = bytes(pa.Codec("snappy").compress(payload))
+            assert snappy_decompress(comp) == payload
+            r = await client.post(
+                "/api/v1/write", data=comp, headers={"Content-Encoding": "snappy"}
+            )
+            assert r.status == 200
+            assert (await r.json())["samples"] == 1
+        finally:
+            await client.close()
+
+    @async_test
+    async def test_bad_requests(self, tmp_path):
+        client = await make_client(tmp_path)
+        try:
+            r = await client.post(
+                "/api/v1/write", data=b"\xff\xfe", headers={"Content-Encoding": "snappy"}
+            )
+            assert r.status == 400
+            r = await client.post("/api/v1/query", json={"metric": "x"})  # missing fields
+            assert r.status == 400
+            r = await client.post(
+                "/api/v1/query", json={"metric": "nope", "start_ms": 0, "end_ms": 1}
+            )
+            assert (await r.json())["series"] == []
+        finally:
+            await client.close()
