@@ -1,0 +1,68 @@
+"""Manifest snapshot codec benchmark (reference: src/benchmarks/src/
+encoding_bench.rs — decode + append + encode round-trip at configurable
+record/append counts).
+
+Usage: python benchmarks/encoding_bench.py [record_count] [append_count]
+Prints one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from horaedb_tpu.storage.manifest.encoding import Snapshot  # noqa: E402
+from horaedb_tpu.storage.sst import FileMeta, SstFile  # noqa: E402
+from horaedb_tpu.storage.types import TimeRange  # noqa: E402
+
+
+def make_files(n: int, base: int = 0) -> list[SstFile]:
+    return [
+        SstFile(
+            id=base + i,
+            meta=FileMeta(
+                max_sequence=base + i,
+                num_rows=10_000,
+                size=64 << 20,
+                time_range=TimeRange(i * 1000, i * 1000 + 1000),
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def main() -> None:
+    record_count = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    append_count = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000
+    iters = 20
+
+    snap = Snapshot.empty()
+    snap.add_records(make_files(record_count))
+    payload = snap.to_bytes()
+
+    start = time.perf_counter()
+    for i in range(iters):
+        s = Snapshot.from_bytes(payload)
+        s.add_records(make_files(append_count, base=10_000_000 + i * append_count))
+        _ = s.to_bytes()
+    elapsed = (time.perf_counter() - start) / iters
+
+    print(
+        json.dumps(
+            {
+                "bench": "manifest_encoding_roundtrip",
+                "record_count": record_count,
+                "append_count": append_count,
+                "ms_per_roundtrip": round(elapsed * 1000, 3),
+                "records_per_sec": round(record_count / elapsed),
+                "snapshot_bytes": len(payload),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

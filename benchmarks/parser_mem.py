@@ -1,0 +1,62 @@
+"""Parser memory benchmark (reference: src/benchmarks/src/bin/parser_mem.rs —
+jemalloc-instrumented per-parser memory diffs; here: tracemalloc for Python
+allocations + RSS deltas covering native arena growth).
+
+Usage: python benchmarks/parser_mem.py
+Prints one JSON line per parser.
+"""
+
+from __future__ import annotations
+
+import json
+import resource
+import sys
+import tracemalloc
+
+sys.path.insert(0, ".")
+
+from benchmarks.remote_write_bench import make_payload  # noqa: E402
+from horaedb_tpu.ingest.py_parser import PyParser  # noqa: E402
+
+
+def rss_kb() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+
+def measure(name: str, make_parser, payload: bytes, iters: int = 50) -> None:
+    parser = make_parser()
+    parser.parse(payload)  # allocate arena once
+    tracemalloc.start()
+    rss_before = rss_kb()
+    snap_before = tracemalloc.take_snapshot()
+    for _ in range(iters):
+        out = parser.parse(payload)
+    snap_after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    py_delta = sum(s.size_diff for s in snap_after.compare_to(snap_before, "filename"))
+    print(
+        json.dumps(
+            {
+                "bench": "parser_mem",
+                "parser": name,
+                "iters": iters,
+                "payload_bytes": len(payload),
+                "py_alloc_delta_bytes": py_delta,
+                "rss_delta_kb": rss_kb() - rss_before,
+                "samples_parsed": int(out.n_samples) * iters,
+            }
+        )
+    )
+
+
+def main() -> None:
+    payload = make_payload()
+    from horaedb_tpu.ingest import native
+
+    if native.load() is not None:
+        measure("native_cpp_pooled", native.NativeParser, payload)
+    measure("python_protobuf", PyParser, payload)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Parser-pool occupancy benchmark (reference: src/benchmarks/src/bin/
+pool_stats.rs — deadpool size/available/waiting across concurrency 1..500).
+
+Usage: python benchmarks/pool_stats.py
+Prints one JSON line per concurrency scale.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from benchmarks.remote_write_bench import make_payload  # noqa: E402
+from horaedb_tpu.ingest import ParserPool  # noqa: E402
+
+
+async def run_scale(pool: ParserPool, payload: bytes, concurrency: int) -> dict:
+    peak = {"available": pool.status["available"], "waiting": 0}
+
+    async def one():
+        st = pool.status
+        peak["available"] = min(peak["available"], st["available"])
+        peak["waiting"] = max(peak["waiting"], st["waiting"])
+        await pool.decode(payload)
+
+    await asyncio.gather(*(one() for _ in range(concurrency)))
+    st = pool.status
+    return {
+        "bench": "pool_stats",
+        "concurrency": concurrency,
+        "pool_size": st["size"],
+        "min_available": peak["available"],
+        "max_waiting": peak["waiting"],
+    }
+
+
+async def main() -> None:
+    payload = make_payload(n_series=50)
+    pool = ParserPool()
+    await pool.decode(payload)  # warm
+    for concurrency in (1, 2, 10, 50, 100, 200, 500):
+        print(json.dumps(await run_scale(pool, payload, concurrency)))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

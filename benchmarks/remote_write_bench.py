@@ -1,0 +1,101 @@
+"""Remote-write parser benchmark (reference: src/benchmarks/src/
+remote_write_bench.rs — compares parser implementations at sequential and
+concurrent scales; here: native C++ vs the protobuf-runtime fallback).
+
+Usage: python benchmarks/remote_write_bench.py
+Prints one JSON line per (parser, mode, scale).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from horaedb_tpu.ingest import ParserPool  # noqa: E402
+from horaedb_tpu.ingest.py_parser import PyParser  # noqa: E402
+from horaedb_tpu.pb import remote_write_pb2  # noqa: E402
+
+
+def make_payload(n_series: int = 200, samples_per_series: int = 10, seed: int = 0) -> bytes:
+    """~production-shaped payload (the reference's workload corpus is ~1.7MB
+    captured requests; this synthesizes a similar shape)."""
+    rng = random.Random(seed)
+    req = remote_write_pb2.WriteRequest()
+    for _ in range(n_series):
+        ts = req.timeseries.add()
+        for k, v in (
+            (b"__name__", f"metric_{rng.randint(0, 50)}".encode()),
+            (b"host", f"host-{rng.randint(0, 500):04d}".encode()),
+            (b"region", rng.choice([b"us-east-1", b"eu-west-1"])),
+            (b"job", b"node-exporter"),
+        ):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = v
+        for _ in range(samples_per_series):
+            s = ts.samples.add()
+            s.value = rng.normalvariate(0, 100)
+            s.timestamp = rng.randint(1_700_000_000_000, 1_800_000_000_000)
+    return req.SerializeToString()
+
+
+def bench_sequential(name: str, parse, payload: bytes, iters: int) -> None:
+    parse(payload)  # warm
+    start = time.perf_counter()
+    for _ in range(iters):
+        parse(payload)
+    elapsed = (time.perf_counter() - start) / iters
+    print(
+        json.dumps(
+            {
+                "bench": "remote_write_parse",
+                "parser": name,
+                "mode": "sequential",
+                "payload_bytes": len(payload),
+                "us_per_parse": round(elapsed * 1e6, 1),
+                "mb_per_sec": round(len(payload) / elapsed / 1e6, 1),
+            }
+        )
+    )
+
+
+async def bench_concurrent(payload: bytes, tasks: int, iters: int) -> None:
+    pool = ParserPool()
+    await pool.decode(payload)  # warm + build
+    start = time.perf_counter()
+    for _ in range(iters):
+        await asyncio.gather(*(pool.decode(payload) for _ in range(tasks)))
+    elapsed = (time.perf_counter() - start) / iters
+    print(
+        json.dumps(
+            {
+                "bench": "remote_write_parse",
+                "parser": "pooled_native",
+                "mode": "concurrent",
+                "tasks": tasks,
+                "payload_bytes": len(payload),
+                "requests_per_sec": round(tasks / elapsed),
+            }
+        )
+    )
+
+
+def main() -> None:
+    payload = make_payload()
+    from horaedb_tpu.ingest import native
+
+    if native.load() is not None:
+        parser = native.NativeParser()
+        bench_sequential("native_cpp", parser.parse, payload, 300)
+    bench_sequential("python_protobuf", PyParser().parse, payload, 50)
+    for tasks in (4, 16, 64):
+        asyncio.run(bench_concurrent(payload, tasks, 10))
+
+
+if __name__ == "__main__":
+    main()

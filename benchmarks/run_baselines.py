@@ -1,0 +1,245 @@
+"""The five BASELINE.json configs, measured (SURVEY §6: 'the baseline for the
+new framework is measured, not quoted').
+
+  1. TSBS single-groupby-1: sum, 1 metric, 1 host(series), 1h window, 5m
+     buckets — end-to-end through ObjectBasedStorage (parquet SSTs + device
+     scan pipeline).
+  2. Tag-equality predicate + range scan, 10M points / 100 series —
+     end-to-end storage scan with a TSID membership predicate.
+  3. Group-by-tag avg/min/max, 100M points / 1K series — device kernel path
+     (sharded_grouped_stats with min/max).
+  4. Time-bucket downsample (5m mean) over 1B points / 10K series — chunked
+     device passes accumulating partial grids (the streaming shape the
+     engine uses for segments larger than one block; chunk data is reused
+     across iterations with shifted windows — throughput is content-
+     independent).
+  5. SST compaction: 100-way merge+dedup of overlapping sorted runs on
+     device (the compaction executor's kernel).
+
+Usage:  python benchmarks/run_baselines.py [--quick]
+Prints one JSON line per config. --quick (default on CPU) shrinks sizes ~50x.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def _emit(cfg: int, name: str, n_rows: int, elapsed: float, extra: dict | None = None) -> None:
+    out = {
+        "config": cfg,
+        "bench": name,
+        "rows": n_rows,
+        "seconds": round(elapsed, 4),
+        "rows_per_sec": round(n_rows / elapsed),
+    }
+    out.update(extra or {})
+    print(json.dumps(out))
+
+
+# -- configs 1 & 2: end-to-end through the storage engine --------------------
+
+async def config_1_and_2(quick: bool) -> None:
+    import pyarrow as pa
+
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.ops import filter as F
+    from horaedb_tpu.storage import (
+        ObjectBasedStorage, ScanRequest, WriteRequest, TimeRange,
+    )
+
+    n_rows = 1_000_000 if quick else 10_000_000
+    n_series = 100
+    hour_ms = 3_600_000
+    schema = pa.schema(
+        [("series", pa.int64()), ("ts", pa.int64()), ("value", pa.float64())]
+    )
+    store = LocalStore(tempfile.mkdtemp(prefix="bl12_"))
+    eng = await ObjectBasedStorage.try_new(
+        "bl", store, schema, num_primary_keys=2, segment_duration_ms=12 * hour_ms,
+        enable_compaction_scheduler=False, start_background_merger=False,
+    )
+    rng = np.random.default_rng(0)
+    per_sst = n_rows // 8
+    for i in range(8):
+        batch = pa.RecordBatch.from_pydict(
+            {
+                "series": rng.integers(0, n_series, per_sst),
+                "ts": rng.integers(0, hour_ms, per_sst),
+                "value": rng.normal(size=per_sst),
+            },
+            schema=schema,
+        )
+        await eng.write(WriteRequest(batch, TimeRange(0, hour_ms)))
+
+    async def scan_rows(pred) -> int:
+        total = 0
+        async for b in eng.scan(ScanRequest(range=TimeRange(0, hour_ms), predicate=pred)):
+            total += b.num_rows
+        return total
+
+    # config 1: single series, 1h, sum over 5m buckets
+    pred1 = F.Compare("series", "eq", 7)
+    await scan_rows(pred1)  # warm/compile
+    start = time.perf_counter()
+    got = 0
+    async for b in eng.scan(ScanRequest(range=TimeRange(0, hour_ms), predicate=pred1)):
+        ts = b.column("ts").to_numpy()
+        v = b.column("value").to_numpy()
+        buckets = ts // 300_000
+        _ = np.bincount(buckets, weights=v, minlength=12)  # final 12-bucket sum
+        got += b.num_rows
+    _emit(1, "tsbs_single_groupby_1", n_rows, time.perf_counter() - start,
+          {"matched_rows": got, "note": "rows/sec = engine rows scanned over wall time"})
+
+    # config 2: tag-equality (series membership) + range scan
+    tsids = tuple(range(0, n_series, 10))
+    pred2 = F.InSet("series", tsids)
+    await scan_rows(pred2)  # warm
+    start = time.perf_counter()
+    got = await scan_rows(pred2)
+    _emit(2, "tag_predicate_range_scan", n_rows, time.perf_counter() - start,
+          {"matched_rows": got, "series_selected": len(tsids)})
+    await eng.close()
+
+
+# -- config 3: group-by-tag avg/min/max --------------------------------------
+
+def config_3(quick: bool) -> None:
+    import jax
+
+    from horaedb_tpu.parallel import make_mesh, sharded_grouped_stats
+    from horaedb_tpu.parallel.scan import shard_rows
+
+    n = 4_000_000 if quick else 100_000_000
+    groups = 1000
+    rng = np.random.default_rng(1)
+    gid = rng.integers(0, groups, n).astype(np.int32)
+    vals = rng.normal(size=n).astype(np.float32)
+    mesh = make_mesh(1)
+    (d_g, d_v), d_valid = shard_rows(mesh, (gid, vals))
+    out = sharded_grouped_stats(mesh, d_g, d_v, d_valid, groups)  # warm
+    probe = jax.jit(lambda o: o["sum"].sum() + o["min"].sum() + o["max"].sum())
+    float(np.asarray(probe(out)))
+    start = time.perf_counter()
+    out = sharded_grouped_stats(mesh, d_g, d_v, d_valid, groups)
+    float(np.asarray(probe(out)))
+    _emit(3, "group_by_tag_avg_min_max", n, time.perf_counter() - start,
+          {"groups": groups})
+
+
+# -- config 4: 1B-point downsample, chunked ----------------------------------
+
+def config_4(quick: bool) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from horaedb_tpu.parallel import make_mesh
+    from horaedb_tpu.parallel.scan import build_sharded_downsample
+
+    total = 40_000_000 if quick else 1_000_000_000
+    chunk = 8_000_000 if quick else 50_000_000
+    num_series, bucket_ms = 10_000, 300_000
+    span = 24 * 3_600_000
+    num_buckets = span // bucket_ms
+    rng = np.random.default_rng(2)
+    ts = rng.integers(0, span, chunk, dtype=np.int64).astype(np.int32)
+    sid = rng.integers(0, num_series, chunk, dtype=np.int64).astype(np.int32)
+    vals = rng.normal(size=chunk).astype(np.float32)
+    mesh = make_mesh(1)
+    fn = build_sharded_downsample(mesh, num_series, num_buckets, None, with_minmax=False)
+    d_ts, d_sid, d_vals = map(jax.device_put, (ts, sid, vals))
+    d_valid = jax.device_put(np.ones(chunk, dtype=bool))
+    t0 = jnp.asarray(0, jnp.int32)
+    bkt = jnp.asarray(bucket_ms, jnp.int32)
+    out = fn(d_ts, d_sid, d_vals, d_valid, (), t0, bkt)  # warm
+    probe = jax.jit(lambda a, b: a["sum"].sum() + b["sum"].sum())
+    acc = out
+    float(np.asarray(probe(acc, out)))
+    iters = total // chunk
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(d_ts, d_sid, d_vals, d_valid, (), t0, bkt)
+        acc = {k: acc[k] + out[k] for k in ("sum", "count")}
+    float(np.asarray(probe(acc, out)))
+    _emit(4, "downsample_5m_1b_points", iters * chunk, time.perf_counter() - start,
+          {"num_series": num_series, "chunks": iters, "chunk_rows": chunk})
+
+
+# -- config 5: 100-way SST merge + dedup on device ---------------------------
+
+def config_5(quick: bool) -> None:
+    import jax
+
+    from horaedb_tpu.ops import dedup as dedup_ops
+    from horaedb_tpu.ops import merge as merge_ops
+    from horaedb_tpu.ops.blocks import Block
+
+    ways = 100
+    rows_per_sst = 50_000 if quick else 500_000
+    key_space = ways * rows_per_sst // 4  # ~4x overlap -> real dedup work
+    rng = np.random.default_rng(3)
+    blocks = []
+    for i in range(ways):
+        pk = np.sort(rng.integers(0, key_space, rows_per_sst)).astype(np.int64)
+        seq = np.full(rows_per_sst, i, dtype=np.uint64)
+        val = rng.normal(size=rows_per_sst)
+        blocks.append(
+            Block.from_numpy(
+                {"pk": pk, "__seq__": seq, "value": val},
+                pad_multiple=rows_per_sst,
+                pad_keys=("pk", "__seq__"),
+            )
+        )
+    total = ways * rows_per_sst
+
+    @jax.jit
+    def merge_dedup(cols_list):
+        merged = merge_ops.merge_sorted(cols_list, ["pk", "__seq__"])
+        keep = dedup_ops.dedup_last_value(merged, ["pk"], total)
+        return merged["value"], keep
+
+    cols = [b.columns for b in blocks]
+    v, keep = merge_dedup(cols)  # warm
+    probe = jax.jit(lambda v, k: v.sum() + k.sum())
+    float(np.asarray(probe(v, keep)))
+    start = time.perf_counter()
+    v, keep = merge_dedup(cols)
+    float(np.asarray(probe(v, keep)))
+    elapsed = time.perf_counter() - start
+    bytes_total = total * 24  # pk + seq + value lanes
+    _emit(5, "compaction_100way_merge_dedup", total, elapsed,
+          {"ways": ways, "mb_per_sec": round(bytes_total / elapsed / 1e6, 1)})
+
+
+def main() -> None:
+    import os
+
+    import jax
+
+    # honor JAX_PLATFORMS even on images whose sitecustomize force-registers
+    # an accelerator platform (same escape hatch as the server entrypoint)
+    want = os.environ.get("HORAEDB_JAX_PLATFORM") or os.environ.get("JAX_PLATFORMS")
+    if want and "," not in want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:  # noqa: BLE001 - backend already initialized
+            pass
+
+    quick = "--quick" in sys.argv or jax.devices()[0].platform == "cpu"
+    asyncio.run(config_1_and_2(quick))
+    config_3(quick)
+    config_4(quick)
+    config_5(quick)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,7 +70,6 @@ class IndexManager:
         tsids: list[SeriesId] = []
         new_series_rows: list[tuple[int, int, bytes]] = []
         new_index_rows: list[tuple[int, int, int, bytes, bytes]] = []
-        new_labels: list[tuple[int, int, list]] = []
         staged: set[tuple[int, int]] = set()
         for mid, labels in zip(metric_ids, label_sets):
             key = series_key_of(labels)
@@ -80,7 +79,6 @@ class IndexManager:
                 continue
             staged.add((mid, tsid))
             new_series_rows.append((mid, tsid, key))
-            new_labels.append((mid, tsid, labels))
             for k, v in labels:
                 new_index_rows.append((mid, tag_hash_of(k, v), tsid, k, v))
         if new_series_rows:
@@ -89,10 +87,10 @@ class IndexManager:
             # index rows never land, silently dropping it from tag queries
             # after the client's retry (and from recovery after restart).
             await self._persist(new_series_rows, new_index_rows, now_ms)
-            for mid, tsid, labels in new_labels:
+            for mid, tsid, _key in new_series_rows:
                 self._known.add((mid, tsid))
-                for k, v in labels:
-                    self._postings[(mid, tag_hash_of(k, v))][tsid] = (k, v)
+            for mid, h, tsid, k, v in new_index_rows:
+                self._postings[(mid, h)][tsid] = (k, v)
         return tsids
 
     async def _persist(self, series_rows, index_rows, now_ms: int) -> None:

@@ -49,11 +49,18 @@ class ParserPool:
         try:
             # native parse releases no GIL-bound state we await on; run in a
             # thread so large payloads don't stall the event loop
-            return await asyncio.to_thread(parser.parse, payload)
+            result = await asyncio.to_thread(parser.parse, payload)
+        except asyncio.CancelledError:
+            # the worker thread may still be mutating this arena: never
+            # return it to the pool (a fresh one is allocated on demand)
+            parser = None
+            raise
         finally:
-            self._free.append(parser)
+            if parser is not None:
+                self._free.append(parser)
             self._in_use -= 1
             self._sem.release()
+        return result
 
     @property
     def status(self) -> dict:

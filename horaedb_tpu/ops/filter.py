@@ -115,10 +115,30 @@ def _pad_bucket(n: int) -> int:
     return 1 << max(0, n - 1).bit_length() if n > 0 else 1
 
 
+def is_template(pred: Predicate | None) -> bool:
+    """True if `pred` already went through split_literals (contains Slot or
+    InSetProbe markers)."""
+    if pred is None:
+        return False
+    for node in iter_nodes(pred):
+        if isinstance(node, InSetProbe):
+            return True
+        if isinstance(node, Compare) and isinstance(node.literal, Slot):
+            return True
+    return False
+
+
 def split_literals(pred: Predicate | None) -> tuple[Predicate | None, tuple]:
     """Extract literals into a tuple, leaving dynamic markers behind:
     Compare literals become Slots; InSet value tuples become InSetProbe
-    (padded values array + active mask, two slots)."""
+    (padded values array + active mask, two slots).
+
+    Idempotent: an already-split template passes through unchanged (with no
+    literals — the original split's literals remain authoritative); without
+    this, re-splitting would renumber Compare slots into collision with
+    InSetProbe value/mask slots."""
+    if is_template(pred):
+        return pred, ()
     literals: list = []
 
     def walk(p: Predicate) -> Predicate:

@@ -73,6 +73,11 @@ def build_demo_schema() -> pa.Schema:
     )
 
 
+# Largest decompressed remote-write payload the server will materialize; a
+# hostile leading uvarint must not drive an arbitrary allocation.
+MAX_DECOMPRESSED = 256 * 1024 * 1024
+
+
 def snappy_decompress(buf: bytes) -> bytes:
     """Raw-snappy decompress via pyarrow's codec (no python-snappy in the
     image): the uncompressed length is the stream's leading uvarint."""
@@ -84,6 +89,8 @@ def snappy_decompress(buf: bytes) -> bytes:
         if not (b & 0x80):
             break
         shift += 7
+    if size > MAX_DECOMPRESSED:
+        raise ValueError(f"decompressed size {size} exceeds limit")
     return bytes(pa.Codec("snappy").decompress(buf, decompressed_size=size))
 
 
@@ -286,6 +293,8 @@ async def build_app(config: Config) -> web.Application:
     async def on_cleanup(app):
         for t in state.write_workers:
             t.cancel()
+        # wait for in-flight writes before closing storage under them
+        await asyncio.gather(*state.write_workers, return_exceptions=True)
         await state.storage.close()
         await state.engine.close()
 

@@ -135,12 +135,18 @@ class SchedulerConfig:
 
 @dataclass
 class StorageConfig:
-    """Top-level storage config (config.rs StorageConfig)."""
+    """Top-level storage config (config.rs StorageConfig).
+
+    `scan_block_rows` is a TPU-build extension: the max rows one device pass
+    materializes. Segments above it scan hierarchically (chunked device
+    passes + merge tree) instead of one giant block — the blockwise-carry
+    answer to HBM limits (SURVEY §5.7/§7 risk (a))."""
 
     write: WriteConfig = field(default_factory=WriteConfig)
     manifest: ManifestConfig = field(default_factory=ManifestConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     update_mode: UpdateMode = UpdateMode.OVERWRITE
+    scan_block_rows: int = 32 * 1024 * 1024
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "StorageConfig":

@@ -138,10 +138,12 @@ class ParquetReader:
         store: ObjectStore,
         sst_path_gen: SstPathGenerator,
         schema: StorageSchema,
+        scan_block_rows: int = 32 * 1024 * 1024,
     ):
         self._store = store
         self._path_gen = sst_path_gen
         self._schema = schema
+        self._scan_block_rows = scan_block_rows
 
     async def read_sst(
         self,
@@ -177,16 +179,30 @@ class ParquetReader:
         keep_builtin: bool,
         batch_size: int = DEFAULT_SCAN_BATCH_SIZE,
     ) -> list[pa.RecordBatch]:
-        """The fused device pipeline for one time segment."""
+        """The fused device pipeline for one time segment.
+
+        Segments whose SSTs exceed `scan_block_rows` in total take the
+        hierarchical path: per-chunk device passes (filter+merge+dedup) whose
+        sorted outputs merge in a device tree — the blockwise/carry-state
+        streaming shape of SURVEY §5.7 (LastValue dedup is idempotent across
+        levels, so intermediate dedup is safe; Append mode never dedups).
+        """
+        # shared prologue/epilogue with the chunked path lives in
+        # _resolve_read_names/_output_names/_slice_batches
+        total_rows = sum(s.meta.num_rows for s in ssts)
+        if total_rows > self._scan_block_rows and len(ssts) > 1:
+            has_binary = any(
+                pa.types.is_binary(f.type) or pa.types.is_large_binary(f.type)
+                or pa.types.is_string(f.type)
+                for f in self._schema.arrow_schema
+            )
+            if not has_binary:
+                return await self._scan_segment_chunked(
+                    ssts, predicate, projections, keep_builtin, batch_size
+                )
+            # binary columns keep the single-block hybrid path
         schema = self._schema
-        proj = schema.fill_required_projections(projections)
-        all_names = schema.arrow_schema.names
-        if proj is None:
-            read_names = list(all_names)
-        else:
-            read_names = [all_names[i] for i in sorted(proj)]
-        if keep_builtin and RESERVED_COLUMN_NAME not in read_names:
-            read_names.append(RESERVED_COLUMN_NAME)
+        read_names = self._resolve_read_names(projections, keep_builtin)
 
         tables = await asyncio.gather(
             *(self.read_sst(s, read_names, predicate) for s in ssts)
@@ -239,10 +255,7 @@ class ParquetReader:
                 dedup_ops.dedup_last_value(sorted_cols, list(pk_names), kept)
             )
 
-        # Output = everything fetched (pk + __seq__ are force-included in the
-        # projection, types.rs:203-216) minus builtins unless keep_builtin —
-        # matching the reference plan's output schema after MergeExec.
-        out_names = [n for n in read_names if keep_builtin or not StorageSchema.is_builtin_name(n)]
+        out_names = self._output_names(read_names, keep_builtin)
 
         if schema.update_mode == UpdateMode.APPEND and binary_names:
             result = self._materialize_append_mode(
@@ -254,6 +267,122 @@ class ParquetReader:
                 table, sorted_cols, np.asarray(perm), keep_np,
                 numeric_names, binary_names, out_names,
             )
+        if result.num_rows == 0:
+            return []
+        return [result.slice(i, batch_size) for i in range(0, result.num_rows, batch_size)]
+
+    async def _scan_segment_chunked(
+        self,
+        ssts: list[SstFile],
+        predicate: Predicate | None,
+        projections: list[int] | None,
+        keep_builtin: bool,
+        batch_size: int,
+    ) -> list[pa.RecordBatch]:
+        """Hierarchical scan: chunked device passes + a device merge tree."""
+        schema = self._schema
+        all_names = schema.arrow_schema.names
+        read_names = self._resolve_read_names(projections, keep_builtin)
+        pk_names = tuple(schema.primary_key_names)
+        sort_keys = pk_names + (SEQ_COLUMN_NAME,)
+        do_dedup = schema.update_mode == UpdateMode.OVERWRITE
+        cap = self._scan_block_rows
+
+        def greedy_partition(items: list, rows_of) -> list[list]:
+            out, cur, cur_rows = [], [], 0
+            for it in items:
+                r = rows_of(it)
+                if cur and cur_rows + r > cap:
+                    out.append(cur)
+                    cur, cur_rows = [], 0
+                cur.append(it)
+                cur_rows += r
+            if cur:
+                out.append(cur)
+            return out
+
+        def run_block(arrays: dict[str, np.ndarray], template, literals) -> dict[str, np.ndarray]:
+            block = Block.from_numpy(arrays, pad_keys=sort_keys)
+            lit = filter_ops.literal_arrays(
+                template, literals, {k: v.dtype for k, v in block.columns.items()}
+            )
+            kernel = _build_scan_kernel(
+                tuple(block.names), sort_keys, pk_names, template, do_dedup
+            )
+            sorted_cols, _perm, keep, _starts, _kept = kernel(
+                block.columns, lit, block.num_valid
+            )
+            idx = np.nonzero(np.asarray(keep))[0]
+            return {k: np.asarray(v)[idx] for k, v in sorted_cols.items()}
+
+        template, raw_literals = filter_ops.split_literals(predicate)
+        # level 0: filter + merge + dedup per SST chunk (sequential: bounds
+        # peak host+device memory to ~one chunk)
+        level: list[dict[str, np.ndarray]] = []
+        for chunk in greedy_partition(ssts, lambda s: s.meta.num_rows):
+            tables = await asyncio.gather(
+                *(self.read_sst(s, read_names, predicate) for s in chunk)
+            )
+            tables = [t for t in tables if t.num_rows > 0]
+            if not tables:
+                continue
+            table = pa.concat_tables(tables).combine_chunks()
+            arrays = {
+                name: arrow_column_to_numpy(table.column(name).combine_chunks())
+                for name in table.schema.names
+            }
+            out = run_block(arrays, template, raw_literals)
+            if len(out[sort_keys[0]]):
+                level.append(out)
+        # merge tree: combine sorted deduped runs until one remains
+        while len(level) > 1:
+            next_level = []
+            for group in greedy_partition(level, lambda r: len(r[sort_keys[0]])):
+                if len(group) == 1:
+                    next_level.append(group[0])
+                    continue
+                cat = {
+                    k: np.concatenate([g[k] for g in group]) for k in group[0]
+                }
+                next_level.append(run_block(cat, None, ()))
+            if len(next_level) == len(level):
+                # cap smaller than a single run: merge everything in one go
+                cat = {k: np.concatenate([g[k] for g in level]) for k in level[0]}
+                next_level = [run_block(cat, None, ())]
+            level = next_level
+        if not level:
+            return []
+        final = level[0]
+        out_names = self._output_names(read_names, keep_builtin)
+        cols = [
+            _np_to_arrow(final[n], schema.arrow_schema.field(all_names.index(n)).type)
+            for n in out_names
+        ]
+        out_schema = pa.schema(
+            [schema.arrow_schema.field(all_names.index(n)) for n in out_names]
+        )
+        result = pa.RecordBatch.from_arrays(cols, schema=out_schema)
+        return self._slice_batches(result, batch_size)
+
+    # -- shared prologue/epilogue ---------------------------------------------
+    def _resolve_read_names(self, projections: list[int] | None, keep_builtin: bool) -> list[str]:
+        """Columns to fetch: projection + forced pk/__seq__ (types.rs:203-216),
+        plus __reserved__ when builtins are kept."""
+        proj = self._schema.fill_required_projections(projections)
+        all_names = self._schema.arrow_schema.names
+        read_names = list(all_names) if proj is None else [all_names[i] for i in sorted(proj)]
+        if keep_builtin and RESERVED_COLUMN_NAME not in read_names:
+            read_names.append(RESERVED_COLUMN_NAME)
+        return read_names
+
+    @staticmethod
+    def _output_names(read_names: list[str], keep_builtin: bool) -> list[str]:
+        """Output = everything fetched minus builtins unless keep_builtin —
+        matching the reference plan's output schema after MergeExec."""
+        return [n for n in read_names if keep_builtin or not StorageSchema.is_builtin_name(n)]
+
+    @staticmethod
+    def _slice_batches(result: pa.RecordBatch, batch_size: int) -> list[pa.RecordBatch]:
         if result.num_rows == 0:
             return []
         return [result.slice(i, batch_size) for i in range(0, result.num_rows, batch_size)]

@@ -105,7 +105,10 @@ class ObjectBasedStorage(ColumnarStorage):
             start_background_merger=start_background_merger,
         )
         self._path_gen = SstPathGenerator(self._root)
-        self._reader = ParquetReader(store, self._path_gen, self._schema)
+        self._reader = ParquetReader(
+            store, self._path_gen, self._schema,
+            scan_block_rows=config.scan_block_rows,
+        )
         self._scheduler = None
         if enable_compaction_scheduler:
             # imported lazily: compaction depends on this module's writer

@@ -62,6 +62,25 @@ class TestShardedDownsample:
             assert np.isclose(float(out["sum"][s, 0]), sel.sum())
 
 
+class TestShardedDownsamplePredicates:
+    def test_inset_plus_compare_predicate(self, mesh8):
+        """Regression: an InSet preceding a Compare must not collide slots
+        when the template is re-split inside the builder (idempotence of
+        split_literals)."""
+        ts, sid, vals = make_data(1024)
+        pred = F.And(
+            F.InSet("__sid__", (2, 5, 11)),
+            F.Compare("__val__", "gt", 0.0),
+        )
+        (d_ts, d_sid, d_vals), d_valid = shard_rows(mesh8, (ts, sid, vals))
+        out = sharded_downsample(
+            mesh8, d_ts, d_sid, d_vals, d_valid, 0, 1_000_000, 16, 1, predicate=pred
+        )
+        for s in range(16):
+            sel = vals[(sid == s) & np.isin(sid, [2, 5, 11]) & (vals > 0.0)]
+            assert np.isclose(float(out["sum"][s, 0]), sel.sum()), s
+
+
 class TestShardedGroupBy:
     def test_matches_oracle(self, mesh8):
         _, gid, vals = make_data(2048, num_series=32)

@@ -239,6 +239,66 @@ class TestWriteScan:
         await eng2.close()
 
 
+class TestChunkedScan:
+    @async_test
+    async def test_chunked_scan_matches_single_block(self):
+        """Segments above scan_block_rows take the hierarchical path; output
+        must be byte-identical to the single-block pipeline."""
+        rng = np.random.default_rng(7)
+        store = MemStore()
+        big = await new_engine(store)  # default huge scan_block_rows
+        schema = make_schema()
+        for w in range(6):
+            pk1 = rng.integers(0, 40, 500)
+            pk2 = rng.integers(0, 3, 500)
+            vals = rng.normal(size=500)
+            await big.write(
+                WriteRequest(
+                    make_batch(schema, pk1, pk2, np.full(500, 10), vals),
+                    TimeRange(10, 11),
+                )
+            )
+        expect = await collect(
+            big, ScanRequest(range=TimeRange(0, SEGMENT_MS), predicate=F.Compare("value", "gt", 0.0))
+        )
+        # same store, tiny scan block -> forces chunking + merge tree
+        small_cfg = StorageConfig(scan_block_rows=700)
+        small = await ObjectBasedStorage.try_new(
+            root="db", store=store, arrow_schema=schema, num_primary_keys=2,
+            segment_duration_ms=SEGMENT_MS, config=small_cfg,
+            enable_compaction_scheduler=False, start_background_merger=False,
+        )
+        got = await collect(
+            small, ScanRequest(range=TimeRange(0, SEGMENT_MS), predicate=F.Compare("value", "gt", 0.0))
+        )
+        assert got.num_rows == expect.num_rows
+        for name in expect.schema.names:
+            np.testing.assert_array_equal(
+                got.column(name).to_numpy(), expect.column(name).to_numpy()
+            )
+        await big.close()
+        await small.close()
+
+    @async_test
+    async def test_chunked_scan_append_mode_numeric(self):
+        """Append mode (no dedup) through the chunked path keeps duplicates."""
+        store = MemStore()
+        cfg = StorageConfig(update_mode=UpdateMode.APPEND, scan_block_rows=4)
+        eng = await new_engine(store, config=cfg)
+        schema = make_schema()
+        for v in (1.0, 2.0, 3.0):
+            await eng.write(
+                WriteRequest(
+                    make_batch(schema, [1, 2], [0, 0], [10, 10], [v, v * 10]),
+                    TimeRange(10, 11),
+                )
+            )
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.num_rows == 6
+        assert t.column("value").to_pylist() == [1.0, 2.0, 3.0, 10.0, 20.0, 30.0]
+        await eng.close()
+
+
 class TestAppendMode:
     @async_test
     async def test_append_mode_keeps_duplicates(self):
