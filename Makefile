@@ -1,0 +1,25 @@
+# Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
+
+.PHONY: test native bench baselines serve lint clean
+
+test:
+	python -m pytest tests/ -x -q
+
+native:
+	$(MAKE) -C horaedb_tpu/native
+
+bench:
+	python bench.py
+
+baselines:
+	python benchmarks/run_baselines.py --quick
+
+serve:
+	python -m horaedb_tpu.server.main --config docs/example.toml
+
+lint:
+	python -m compileall -q horaedb_tpu tests benchmarks bench.py __graft_entry__.py
+
+clean:
+	$(MAKE) -C horaedb_tpu/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -92,11 +92,13 @@ class SampleManager:
         tsid_col = table.column("tsid").to_numpy()
         uniq, sid_dense = np.unique(tsid_col, return_inverse=True)
         num_buckets = -(-(rng.end - rng.start) // bucket_ms)
-        out = agg_ops.downsample(
+        # scan output is sorted by pk = (metric_id, tsid, field_id, ts) and
+        # np.unique's inverse preserves that order, so the flat cell index is
+        # monotone -> the sorted-segment fast path applies
+        out = agg_ops.downsample_sorted(
             t,
             sid_dense.astype(np.int32),
             v,
-            np.ones(len(v), dtype=bool),
             rng.start,
             bucket_ms,
             num_series=len(uniq),

@@ -15,5 +15,13 @@ runtimes. The TPU-native analogs this package provides (SURVEY §5.8):
 
 from horaedb_tpu.parallel.mesh import make_mesh, mesh_devices
 from horaedb_tpu.parallel.scan import sharded_downsample, sharded_grouped_stats
+from horaedb_tpu.parallel.distributed import global_mesh, initialize
 
-__all__ = ["make_mesh", "mesh_devices", "sharded_downsample", "sharded_grouped_stats"]
+__all__ = [
+    "make_mesh",
+    "mesh_devices",
+    "sharded_downsample",
+    "sharded_grouped_stats",
+    "initialize",
+    "global_mesh",
+]

@@ -1,0 +1,80 @@
+"""Multi-host bootstrap: the distributed communication backend.
+
+The reference snapshot has no inter-node runtime (SURVEY §2.5/§5.8) — its
+"network" is the shared object store. This framework's distributed story is
+jax's: `jax.distributed` + a global Mesh spanning hosts, with XLA inserting
+the collectives (psum/pmin/pmax over ICI within a slice, DCN across hosts).
+The object-store data plane is retained unchanged — every host reads SSTs
+from shared storage, and the mesh axes decide which host scans what.
+
+Usage on a multi-host slice (one process per host):
+
+    from horaedb_tpu.parallel.distributed import initialize, global_mesh
+    initialize()                     # env-driven (TPU pods auto-configure)
+    mesh = global_mesh(series_parallel=4)
+
+Collective layout guidance (the scaling-book recipe): keep the series-axis
+all-reduces inside one host's ICI domain by making `series_parallel` divide
+the per-host device count; the rows axis then spans hosts and its psum
+partial-grid combines are the only DCN traffic — small (grid-sized), not
+row-sized.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.parallel.mesh import make_mesh
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize jax.distributed. On TPU pods all arguments are discovered
+    from the environment; pass them explicitly for manual clusters. Safe to
+    call on single-process deployments (no-op)."""
+    global _initialized
+    if _initialized:
+        return
+    if num_processes is None and coordinator_address is None:
+        import os
+
+        coordinator_address = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"
+        )
+        if coordinator_address is None:
+            logger.info("no coordinator configured; single-process deployment")
+            _initialized = True
+            return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed up: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def global_mesh(series_parallel: int = 1):
+    """Mesh over ALL processes' devices (rows axis spans hosts/DCN; series
+    axis should stay within a host's ICI domain)."""
+    n_local = jax.local_device_count()
+    ensure(
+        series_parallel <= n_local and n_local % series_parallel == 0,
+        f"series_parallel={series_parallel} must divide local device count {n_local} "
+        "so series all-reduces ride ICI, not DCN",
+    )
+    return make_mesh(None, series_parallel=series_parallel)

@@ -135,6 +135,62 @@ def sharded_downsample(
               jnp.asarray(t0, dtype=ts.dtype), jnp.asarray(bucket_ms, dtype=ts.dtype))
 
 
+@lru_cache(maxsize=64)
+def build_multisegment_downsample(
+    mesh: Mesh,
+    num_series: int,
+    num_buckets: int,
+):
+    """3-axis scan step over a ("seg", "rows", "series") mesh — the
+    TPU-native form of the reference's per-segment plan union
+    (UnionExec over time segments, storage.rs:343-369):
+
+    - "seg" shards independent time segments (no collective crosses it —
+      segments are separate LSM windows; the pipeline-parallel analog);
+    - "rows" data-parallels each segment's rows (psum/pmin/pmax combines);
+    - "series" shards the output grids.
+
+    Inputs are [n_segments, rows] arrays sharded P("seg", "rows") plus a
+    per-segment t0 vector sharded P("seg"); output grids are
+    [n_segments, num_series, num_buckets] sharded P("seg", "series", None).
+    """
+    series_par = mesh.shape["series"]
+    ensure(num_series % series_par == 0,
+           f"num_series={num_series} must divide over series axis={series_par}")
+    local_series = num_series // series_par
+
+    def step(ts, sid, vals, valid, t0_seg, bucket_ms):
+        # shard-local shapes: [segs_local, rows_local]; the kernel handles
+        # exactly one segment per seg-shard
+        ensure(
+            ts.shape[0] == 1,
+            f"n_segments must equal the seg mesh axis "
+            f"(got {ts.shape[0]} local segments per shard)",
+        )
+        s_idx = lax.axis_index("series")
+        lo = (s_idx * local_series).astype(sid.dtype)
+        s, c, mn, mx = _local_grids(
+            ts[0], sid[0], vals[0], valid[0], t0_seg[0], bucket_ms,
+            lo, local_series, num_buckets, True,
+        )
+        s = lax.psum(s, "rows")
+        c = lax.psum(c, "rows")
+        mn = lax.pmin(mn, "rows")
+        mx = lax.pmax(mx, "rows")
+        out = {"sum": s, "count": c, "min": mn, "max": mx, "mean": s / c}
+        return {k: v[None] for k, v in out.items()}
+
+    row_spec = P("seg", "rows")
+    grid_spec = P("seg", "series", None)
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(row_spec, row_spec, row_spec, row_spec, P("seg"), P()),
+        out_specs={k: grid_spec for k in ("sum", "count", "min", "max", "mean")},
+    )
+    return jax.jit(mapped)
+
+
 def sharded_grouped_stats(
     mesh: Mesh,
     group_idx,

@@ -191,10 +191,12 @@ class ParquetReader:
         # _resolve_read_names/_output_names/_slice_batches
         total_rows = sum(s.meta.num_rows for s in ssts)
         if total_rows > self._scan_block_rows and len(ssts) > 1:
+            fetched = self._resolve_read_names(projections, keep_builtin)
             has_binary = any(
                 pa.types.is_binary(f.type) or pa.types.is_large_binary(f.type)
                 or pa.types.is_string(f.type)
                 for f in self._schema.arrow_schema
+                if f.name in fetched
             )
             if not has_binary:
                 return await self._scan_segment_chunked(
@@ -346,9 +348,13 @@ class ParquetReader:
                 }
                 next_level.append(run_block(cat, None, ()))
             if len(next_level) == len(level):
-                # cap smaller than a single run: merge everything in one go
-                cat = {k: np.concatenate([g[k] for g in level]) for k in level[0]}
-                next_level = [run_block(cat, None, ())]
+                # every pair exceeds the cap: merge only the two smallest
+                # runs (guaranteed progress with minimal cap overshoot —
+                # merging everything would defeat the memory bound)
+                next_level.sort(key=lambda r: len(r[sort_keys[0]]))
+                a, b = next_level[0], next_level[1]
+                cat = {k: np.concatenate([a[k], b[k]]) for k in a}
+                next_level = [run_block(cat, None, ())] + next_level[2:]
             level = next_level
         if not level:
             return []

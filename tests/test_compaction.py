@@ -131,6 +131,54 @@ class TestExecutor:
         await eng.close()
 
     @async_test
+    async def test_ttl_expiry_end_to_end(self):
+        """Expired SSTs ride along a qualifying pick and get deleted from
+        both manifest and store (picker TTL + executor delete ordering)."""
+        from horaedb_tpu.common.time_ext import now_ms
+
+        store = MemStore()
+        cfg = StorageConfig(
+            scheduler=SchedulerConfig(
+                input_sst_min_num=2,
+                ttl=ReadableDuration.hours(1),
+            )
+        )
+        eng = await ObjectBasedStorage.try_new(
+            "db", store, make_schema(), 2, SEGMENT_MS,
+            config=cfg, start_background_merger=False,
+        )
+        schema = make_schema()
+        # ancient data (epoch ~0): far beyond the 1h TTL
+        await eng.write(
+            WriteRequest(make_batch(schema, [1], [0], [10], [1.0]), TimeRange(10, 11))
+        )
+        # fresh segment with enough files to qualify a pick
+        t = now_ms()
+        seg_start = t - t % SEGMENT_MS
+        for i in range(2):
+            await eng.write(
+                WriteRequest(
+                    make_batch(schema, [i], [0], [t], [float(i)]),
+                    TimeRange(seg_start, seg_start + 1),
+                )
+            )
+        assert len(eng.manifest.all_ssts()) == 3
+        sched = eng.compaction_scheduler
+        assert sched.pick_once()
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if len(eng.manifest.all_ssts()) == 1:
+                break
+        await sched.executor.drain()
+        ssts = eng.manifest.all_ssts()
+        assert len(ssts) == 1  # 2 fresh merged into 1; expired dropped
+        t2 = await collect(eng, ScanRequest(range=TimeRange(0, 2**60)))
+        assert 10 not in t2.column("ts").to_pylist()  # ancient row gone
+        assert t2.num_rows == 2  # both fresh rows survive
+        assert len(await store.list("db/data")) == 1
+        await eng.close()
+
+    @async_test
     async def test_memory_gate_rejects_oversize_task(self):
         from horaedb_tpu.storage.compaction import Task
         from horaedb_tpu.storage.compaction.executor import Executor

@@ -271,6 +271,29 @@ class TestAggregate:
         )
         assert float(out["sum"].sum()) == 1.0
 
+    def test_downsample_sorted_matches_scatter_path(self):
+        """The engine's sorted-scan downsample (Pallas-backed sum/count path)
+        must agree with the general scatter implementation."""
+        rng = np.random.default_rng(8)
+        num_series, num_buckets, bucket_ms = 6, 8, 1000
+        n = 5000
+        sid = np.sort(rng.integers(0, num_series, n).astype(np.int32))
+        ts = np.empty(n, dtype=np.int64)
+        for s in range(num_series):  # ts ascending within each series
+            m = sid == s
+            ts[m] = np.sort(rng.integers(0, num_buckets * bucket_ms, m.sum()))
+        vals = rng.normal(size=n)
+        got = aggregate.downsample_sorted(
+            ts, sid, vals, 0, bucket_ms, num_series, num_buckets
+        )
+        expect = aggregate.downsample(
+            ts, sid, vals, np.ones(n, dtype=bool), 0, bucket_ms, num_series, num_buckets
+        )
+        for k in ("sum", "count", "min", "max"):
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(expect[k]), rtol=1e-4, atol=1e-4
+            )
+
     def test_segment_last_value(self):
         vals = np.array([1.0, 2.0, 3.0, 4.0])
         seq = np.array([10, 30, 20, 5], dtype=np.uint64)

@@ -1,0 +1,60 @@
+"""Tests for the shared fixtures themselves + a scan-through-check_stream
+round trip (test_util.rs usage parity)."""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.storage import ObjectBasedStorage, ScanRequest, TimeRange, WriteRequest
+from tests.conftest import async_test
+from tests.util import DequeBatchStream, check_stream, record_batch
+
+
+class TestRecordBatchBuilder:
+    def test_literal_builder(self):
+        b = record_batch(pk=("i64", [1, 2, 3]), value=("f64", [0.5, 1.5, 2.5]))
+        assert b.num_rows == 3
+        assert b.schema.names == ["pk", "value"]
+        assert b.column("value").to_pylist() == [0.5, 1.5, 2.5]
+
+    def test_binary_column(self):
+        b = record_batch(k=("u64", [1]), payload=("bin", [b"xyz"]))
+        assert b.column("payload").to_pylist() == [b"xyz"]
+
+
+class TestStreams:
+    @async_test
+    async def test_deque_stream_and_check(self):
+        batches = [
+            record_batch(a=("i64", [1, 2])),
+            record_batch(a=("i64", [3])),
+        ]
+        await check_stream(DequeBatchStream(batches), [record_batch(a=("i64", [1, 2, 3]))])
+
+    @async_test
+    async def test_check_stream_mismatch_raises(self):
+        with pytest.raises(AssertionError):
+            await check_stream(
+                DequeBatchStream([record_batch(a=("i64", [1]))]),
+                [record_batch(a=("i64", [2]))],
+            )
+
+    @async_test
+    async def test_check_stream_against_engine_scan(self):
+        store = MemStore()
+        schema = record_batch(pk=("i64", [0]), v=("f64", [0.0])).schema
+        eng = await ObjectBasedStorage.try_new(
+            "db", store, schema, 1, 3_600_000,
+            enable_compaction_scheduler=False, start_background_merger=False,
+        )
+        await eng.write(
+            WriteRequest(
+                record_batch(pk=("i64", [3, 1, 2]), v=("f64", [3.0, 1.0, 2.0])),
+                TimeRange(10, 11),
+            )
+        )
+        await check_stream(
+            eng.scan(ScanRequest(range=TimeRange(0, 100))),
+            [record_batch(pk=("i64", [1, 2, 3]), v=("f64", [1.0, 2.0, 3.0]))],
+        )
+        await eng.close()

@@ -1,0 +1,67 @@
+"""Shared test fixtures (reference: src/columnar_storage/src/test_util.rs —
+record-batch literal builders, the DequeBasedStream fake stream, and the
+check_stream assertion helper)."""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import AsyncIterator
+
+import numpy as np
+import pyarrow as pa
+
+_TYPES = {
+    "i64": pa.int64(),
+    "u64": pa.uint64(),
+    "f64": pa.float64(),
+    "bin": pa.binary(),
+}
+
+
+def record_batch(**columns) -> pa.RecordBatch:
+    """Literal builder (record_batch! macro analog):
+
+        record_batch(pk=("i64", [1, 2]), value=("f64", [0.5, 1.5]))
+    """
+    fields, arrays = [], []
+    for name, (type_code, values) in columns.items():
+        t = _TYPES[type_code]
+        fields.append(pa.field(name, t))
+        arrays.append(pa.array(values, type=t))
+    return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+
+
+class DequeBatchStream:
+    """Fake async record-batch stream (DequeBasedStream analog)."""
+
+    def __init__(self, batches: list[pa.RecordBatch]):
+        self._q = deque(batches)
+
+    def __aiter__(self) -> AsyncIterator[pa.RecordBatch]:
+        return self
+
+    async def __anext__(self) -> pa.RecordBatch:
+        await asyncio.sleep(0)
+        if not self._q:
+            raise StopAsyncIteration
+        return self._q.popleft()
+
+
+async def check_stream(stream, expected: list[pa.RecordBatch]) -> None:
+    """Assert a stream yields exactly `expected` (check_stream analog);
+    compares as one concatenated table so batch boundaries don't matter."""
+    got = [b async for b in stream]
+    got_t = pa.Table.from_batches(got) if got else None
+    exp_t = pa.Table.from_batches(expected) if expected else None
+    if exp_t is None:
+        assert got_t is None or got_t.num_rows == 0
+        return
+    assert got_t is not None, "stream yielded nothing"
+    assert got_t.schema.names == exp_t.schema.names
+    for name in exp_t.schema.names:
+        np.testing.assert_array_equal(
+            got_t.column(name).to_numpy(zero_copy_only=False),
+            exp_t.column(name).to_numpy(zero_copy_only=False),
+            err_msg=f"column {name}",
+        )
