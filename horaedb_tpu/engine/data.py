@@ -20,6 +20,12 @@ from horaedb_tpu.storage.read import ScanRequest, WriteRequest
 from horaedb_tpu.storage.types import TimeRange
 
 
+# Above this series cardinality the dense pushdown grid (num_series x
+# num_buckets x 4 stats) and the device membership probe stop paying off;
+# fall back to materializing + np.unique sizing by the rows actually in range.
+MAX_PUSHDOWN_SERIES = 65_536
+
+
 class SampleManager:
     def __init__(self, storage, segment_duration_ms: int):
         self._storage = storage
@@ -78,30 +84,80 @@ class SampleManager:
     async def query_downsample(
         self,
         metric_id: int,
+        tsids: list[int],
+        rng: TimeRange,
+        bucket_ms: int,
+        filtered: bool = True,
+    ) -> tuple[list[int], dict[str, np.ndarray]] | None:
+        """Per-(series, bucket) sum/count/min/max/mean grids via aggregate
+        PUSHDOWN: each segment reduces on device inside the scan (raw rows
+        never return to host); per-segment partial grids combine trivially
+        because the data-table pk includes the timestamp, so duplicates
+        cannot span segments. Returns (tsid order, grids).
+
+        `filtered=False` means `tsids` is just the metric's full series set
+        (no tag filter): the TSID membership predicate is skipped, and very
+        high cardinalities fall back to the materializing path whose output
+        is sized by the series actually present in range."""
+        ssts = self._storage.manifest.find_ssts(rng)
+        if not ssts or not tsids:
+            return None
+        if len(tsids) > MAX_PUSHDOWN_SERIES:
+            return await self._query_downsample_materialized(
+                metric_id, tsids if filtered else None, rng, bucket_ms
+            )
+        series_ids = np.asarray(sorted(tsids), dtype=np.uint64)
+        num_buckets = int(-(-(rng.end - rng.start) // bucket_ms))
+        pred = self._predicate(
+            metric_id, list(series_ids) if filtered else None, rng
+        )
+        acc: dict[str, np.ndarray] | None = None
+        for seg in self._storage.group_by_segment(ssts):
+            part = await self._storage.parquet_reader.scan_segment_downsample(
+                seg,
+                predicate=pred,
+                ts_column="ts",
+                value_column="value",
+                series_column="tsid",
+                series_ids=series_ids,
+                t0=rng.start,
+                bucket_ms=bucket_ms,
+                num_buckets=num_buckets,
+            )
+            if acc is None:
+                acc = part
+            else:
+                acc["sum"] = acc["sum"] + part["sum"]
+                acc["count"] = acc["count"] + part["count"]
+                acc["min"] = np.minimum(acc["min"], part["min"])
+                acc["max"] = np.maximum(acc["max"], part["max"])
+        if acc is None or acc["count"].sum() == 0:
+            return None
+        with np.errstate(invalid="ignore", divide="ignore"):
+            acc["mean"] = acc["sum"] / acc["count"]
+        return [int(x) for x in series_ids], acc
+
+    async def _query_downsample_materialized(
+        self,
+        metric_id: int,
         tsids: list[int] | None,
         rng: TimeRange,
         bucket_ms: int,
     ) -> tuple[list[int], dict[str, np.ndarray]] | None:
-        """Per-(series, bucket) sum/count/min/max/mean grids, reduced on
-        device from the scanned rows. Returns (tsid order, grids)."""
+        """High-cardinality fallback: materialize rows and size the output
+        grid by np.unique of the series present in range (the sorted-scan
+        fast path still applies: scan output is pk-ordered)."""
+        from horaedb_tpu.ops import aggregate as agg_ops
+
         table = await self.query_raw(metric_id, tsids, rng)
         if table is None or table.num_rows == 0:
             return None
         t = table.column("ts").to_numpy()
         v = table.column("value").to_numpy()
-        tsid_col = table.column("tsid").to_numpy()
-        uniq, sid_dense = np.unique(tsid_col, return_inverse=True)
-        num_buckets = -(-(rng.end - rng.start) // bucket_ms)
-        # scan output is sorted by pk = (metric_id, tsid, field_id, ts) and
-        # np.unique's inverse preserves that order, so the flat cell index is
-        # monotone -> the sorted-segment fast path applies
+        uniq, sid_dense = np.unique(table.column("tsid").to_numpy(), return_inverse=True)
+        num_buckets = int(-(-(rng.end - rng.start) // bucket_ms))
         out = agg_ops.downsample_sorted(
-            t,
-            sid_dense.astype(np.int32),
-            v,
-            rng.start,
-            bucket_ms,
-            num_series=len(uniq),
-            num_buckets=int(num_buckets),
+            t, sid_dense.astype(np.int32), v, rng.start, bucket_ms,
+            num_series=len(uniq), num_buckets=num_buckets,
         )
         return [int(x) for x in uniq], {k: np.asarray(val) for k, val in out.items()}

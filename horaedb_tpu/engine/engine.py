@@ -141,8 +141,11 @@ class MetricEngine:
         rng = TimeRange(req.start_ms, req.end_ms)
         if req.bucket_ms is None:
             return await self.sample_mgr.query_raw(metric_id, tsids, rng)
+        filtered = tsids is not None
+        if tsids is None:  # no tag filter: all series of the metric
+            tsids = self.index_mgr.series_of(metric_id)
         return await self.sample_mgr.query_downsample(
-            metric_id, tsids, rng, req.bucket_ms
+            metric_id, tsids, rng, req.bucket_ms, filtered=filtered
         )
 
     def label_values(self, metric: bytes, key: bytes) -> list[bytes]:

@@ -137,6 +137,10 @@ class IndexManager:
                 return []
         return sorted(result)
 
+    def series_of(self, metric_id: int) -> list[SeriesId]:
+        """All known TSIDs of a metric (the no-tag-filter downsample scope)."""
+        return sorted(t for m, t in self._known if m == metric_id)
+
     def label_values(self, metric_id: int, key: bytes) -> list[bytes]:
         """LabelValues via the inverted index (the RFC's two-step fallback,
         RFC :120-130)."""

@@ -215,39 +215,9 @@ class ParquetReader:
         table = pa.concat_tables(tables).combine_chunks()
 
         pk_names = tuple(schema.primary_key_names)
-        sort_keys = pk_names + (SEQ_COLUMN_NAME,)
-
-        numeric_names, binary_names = [], []
-        for name in table.schema.names:
-            t = table.schema.field(name).type
-            if pa.types.is_binary(t) or pa.types.is_large_binary(t) or pa.types.is_string(t):
-                binary_names.append(name)
-            else:
-                numeric_names.append(name)
-        ensure(
-            all(k in numeric_names for k in sort_keys),
-            "primary key and seq columns must be numeric for the device path",
-        )
-
-        arrays = {
-            name: arrow_column_to_numpy(table.column(name).combine_chunks())
-            for name in numeric_names
-        }
-        block = Block.from_numpy(arrays, pad_keys=sort_keys)
-
-        template, raw_literals = filter_ops.split_literals(predicate)
-        literals = filter_ops.literal_arrays(
-            template, raw_literals, {k: v.dtype for k, v in block.columns.items()}
-        )
-        do_dedup = (
-            schema.update_mode == UpdateMode.OVERWRITE and not binary_names
-        )
-        kernel = _build_scan_kernel(
-            tuple(block.names), sort_keys, pk_names, template, do_dedup
-        )
-        sorted_cols, perm, keep, starts, kept = kernel(
-            block.columns, literals, block.num_valid
-        )
+        (
+            sorted_cols, perm, keep, starts, kept, numeric_names, binary_names,
+        ) = self._fused_pass(table, predicate)
 
         keep_np = np.asarray(keep)
         if schema.update_mode == UpdateMode.OVERWRITE and binary_names:
@@ -272,6 +242,54 @@ class ParquetReader:
         if result.num_rows == 0:
             return []
         return [result.slice(i, batch_size) for i in range(0, result.num_rows, batch_size)]
+
+    def _fused_pass(
+        self,
+        table: pa.Table,
+        predicate: Predicate | None,
+        extra_arrays: dict[str, np.ndarray] | None = None,
+    ):
+        """The shared fused device pass: numeric/binary split, SoA block,
+        literal casting, and the jitted filter->sort->dedup kernel. Used by
+        the single-block scan, the hierarchical merge levels, and aggregate
+        pushdown (`extra_arrays` rides host-computed lanes, e.g. the dense
+        series index, through the same permutation)."""
+        schema = self._schema
+        pk_names = tuple(schema.primary_key_names)
+        sort_keys = pk_names + (SEQ_COLUMN_NAME,)
+
+        numeric_names, binary_names = [], []
+        for name in table.schema.names:
+            t = table.schema.field(name).type
+            if pa.types.is_binary(t) or pa.types.is_large_binary(t) or pa.types.is_string(t):
+                binary_names.append(name)
+            else:
+                numeric_names.append(name)
+        ensure(
+            all(k in numeric_names for k in sort_keys),
+            "primary key and seq columns must be numeric for the device path",
+        )
+
+        arrays = {
+            name: arrow_column_to_numpy(table.column(name).combine_chunks())
+            for name in numeric_names
+        }
+        if extra_arrays:
+            arrays.update(extra_arrays)
+        block = Block.from_numpy(arrays, pad_keys=sort_keys)
+
+        template, raw_literals = filter_ops.split_literals(predicate)
+        literals = filter_ops.literal_arrays(
+            template, raw_literals, {k: v.dtype for k, v in block.columns.items()}
+        )
+        do_dedup = schema.update_mode == UpdateMode.OVERWRITE and not binary_names
+        kernel = _build_scan_kernel(
+            tuple(block.names), sort_keys, pk_names, template, do_dedup
+        )
+        sorted_cols, perm, keep, starts, kept = kernel(
+            block.columns, literals, block.num_valid
+        )
+        return sorted_cols, perm, keep, starts, kept, numeric_names, binary_names
 
     async def _scan_segment_chunked(
         self,
@@ -369,6 +387,112 @@ class ParquetReader:
         )
         result = pa.RecordBatch.from_arrays(cols, schema=out_schema)
         return self._slice_batches(result, batch_size)
+
+    async def scan_segment_downsample(
+        self,
+        ssts: list[SstFile],
+        predicate: Predicate | None,
+        ts_column: str,
+        value_column: str,
+        series_column: str,
+        series_ids: np.ndarray,
+        t0: int,
+        bucket_ms: int,
+        num_buckets: int,
+        with_minmax: bool = True,
+    ) -> dict:
+        """Aggregate pushdown: scan one segment and reduce it to dense
+        [num_series, num_buckets] grids ON DEVICE — raw rows never cross back
+        to host (SURVEY's #1 offload target: scan->filter->aggregate fused).
+
+        `series_ids` is a SORTED array of series keys; dense output row i
+        corresponds to series_ids[i], rows with other keys are dropped.
+        Dedup semantics are preserved: the fused kernel sorts and
+        last-value-dedups before the reduction, exactly like the
+        materializing path. Correct whenever duplicates cannot span segments
+        (true for any schema whose primary key includes the timestamp, e.g.
+        the metric-engine data table).
+
+        Segments above `scan_block_rows` route through the hierarchical scan
+        and aggregate its sorted output run — device memory stays bounded.
+
+        Returns host numpy grids: sum and count, plus min/max when
+        `with_minmax` (no mean — callers derive it after combining partials).
+        """
+        import jax.numpy as jnp
+
+        from horaedb_tpu.ops import aggregate as agg_ops
+
+        num_series = len(series_ids)
+        grids = {
+            "sum": np.zeros((num_series, num_buckets)),
+            "count": np.zeros((num_series, num_buckets)),
+        }
+        if with_minmax:
+            grids["min"] = np.full((num_series, num_buckets), np.inf)
+            grids["max"] = np.full((num_series, num_buckets), -np.inf)
+
+        def dense_sid(col: np.ndarray) -> np.ndarray:
+            pos = np.searchsorted(series_ids, col)
+            pos_c = np.clip(pos, 0, max(0, len(series_ids) - 1))
+            hit = series_ids[pos_c] == col
+            return np.where(hit, pos_c, -1).astype(np.int32)
+
+        def accumulate_sorted(ts_np, sid_np, val_np):
+            """Fold one sorted run into the grids (sorted-segment fast path)."""
+            out = agg_ops.downsample_sorted(
+                ts_np, sid_np, val_np, t0, bucket_ms,
+                num_series=num_series, num_buckets=num_buckets,
+                with_minmax=with_minmax,
+            )
+            grids["sum"] += np.asarray(out["sum"])
+            grids["count"] += np.asarray(out["count"])
+            if with_minmax:
+                grids["min"] = np.minimum(grids["min"], np.asarray(out["min"]))
+                grids["max"] = np.maximum(grids["max"], np.asarray(out["max"]))
+
+        total_rows = sum(s.meta.num_rows for s in ssts)
+        if total_rows > self._scan_block_rows and len(ssts) > 1:
+            # bounded-memory path: hierarchical scan yields merged, deduped,
+            # pk-sorted batches; fold each into the grids
+            batches = await self._scan_segment_chunked(
+                ssts, predicate, None, False, batch_size=self._scan_block_rows
+            )
+            for b in batches:
+                accumulate_sorted(
+                    arrow_column_to_numpy(b.column(ts_column)),
+                    dense_sid(arrow_column_to_numpy(b.column(series_column))),
+                    arrow_column_to_numpy(b.column(value_column)),
+                )
+            return grids
+
+        read_names = self._resolve_read_names(None, False)
+        tables = await asyncio.gather(
+            *(self.read_sst(s, read_names, predicate) for s in ssts)
+        )
+        tables = [t for t in tables if t.num_rows > 0]
+        if not tables:
+            return grids
+        table = pa.concat_tables(tables).combine_chunks()
+        sid = dense_sid(arrow_column_to_numpy(table.column(series_column).combine_chunks()))
+
+        sorted_cols, _perm, keep, _starts, _kept, _num, _bin = self._fused_pass(
+            table, predicate, extra_arrays={"__sid__": sid}
+        )
+        # device-side reduction of the surviving rows (keep is a mask)
+        out = agg_ops.downsample(
+            sorted_cols[ts_column].astype(jnp.int64),
+            sorted_cols["__sid__"],
+            sorted_cols[value_column],
+            keep & (sorted_cols["__sid__"] >= 0),
+            t0,
+            bucket_ms,
+            num_series=num_series,
+            num_buckets=num_buckets,
+        )
+        for k in list(grids):
+            grids[k] = np.asarray(out[k])
+        return grids
 
     # -- shared prologue/epilogue ---------------------------------------------
     def _resolve_read_names(self, projections: list[int] | None, keep_builtin: bool) -> list[str]:

@@ -150,6 +150,48 @@ class TestMetricEngine:
         await eng.close()
 
     @async_test
+    async def test_downsample_pushdown_matches_materializing_path(self):
+        """The pushdown grids must equal aggregating the raw scan output —
+        across segments and with overwritten duplicates."""
+        store = MemStore()
+        eng = await open_engine(store)
+        rng = np.random.default_rng(9)
+        series = [{"__name__": "m", "host": f"h{i}"} for i in range(4)]
+        for _round in range(3):  # overlapping writes create duplicates
+            payload = make_remote_write(
+                [
+                    (
+                        s,
+                        [
+                            (int(t), float(rng.normal()))
+                            for t in rng.integers(0, 2 * HOUR, 25)
+                        ],
+                    )
+                    for s in series
+                ]
+            )
+            await eng.write_parsed(PooledParser.decode(payload))
+        out = await eng.query(
+            QueryRequest(metric=b"m", start_ms=0, end_ms=2 * HOUR, bucket_ms=15 * 60_000)
+        )
+        tsids, grids = out
+        # oracle: raw rows (merged+deduped by the scan) aggregated on host
+        raw = await eng.query(QueryRequest(metric=b"m", start_ms=0, end_ms=2 * HOUR))
+        t = raw.column("ts").to_numpy()
+        v = raw.column("value").to_numpy()
+        tsid_col = raw.column("tsid").to_numpy()
+        buckets = t // (15 * 60_000)
+        for row, tsid in enumerate(tsids):
+            for b in range(grids["mean"].shape[1]):
+                sel = v[(tsid_col == tsid) & (buckets == b)]
+                assert float(grids["count"][row, b]) == len(sel), (row, b)
+                if len(sel):
+                    assert np.isclose(float(grids["sum"][row, b]), sel.sum())
+                    assert np.isclose(float(grids["min"][row, b]), sel.min())
+                    assert np.isclose(float(grids["max"][row, b]), sel.max())
+        await eng.close()
+
+    @async_test
     async def test_multi_segment_write(self):
         """Samples spanning segments split into per-segment storage writes."""
         store = MemStore()
