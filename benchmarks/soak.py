@@ -1,0 +1,148 @@
+"""Server soak: sustained concurrent remote-write + query load against a
+real server process; asserts zero failed requests and consistent counters.
+
+Usage: python benchmarks/soak.py [seconds]   (default 20)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+import aiohttp  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+from horaedb_tpu.pb import remote_write_pb2  # noqa: E402
+
+PORT = 15571
+
+
+def make_payload(worker: int, seq: int) -> bytes:
+    rng = random.Random(worker * 100_000 + seq)
+    req = remote_write_pb2.WriteRequest()
+    now = int(time.time() * 1000)
+    for host in range(5):
+        ts = req.timeseries.add()
+        for k, v in (
+            (b"__name__", b"soak_metric"),
+            (b"host", f"w{worker}-h{host}".encode()),
+        ):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = v
+        for i in range(20):
+            s = ts.samples.add()
+            s.timestamp = now + i
+            s.value = rng.random()
+    return req.SerializeToString()
+
+
+async def run_soak(seconds: int) -> dict:
+    stats = {"writes": 0, "write_errors": 0, "queries": 0, "query_errors": 0,
+             "samples_sent": 0}
+    deadline = time.time() + seconds
+    async with aiohttp.ClientSession() as sess:
+
+        async def writer(worker: int):
+            seq = 0
+            while time.time() < deadline:
+                payload = make_payload(worker, seq)
+                comp = bytes(pa.Codec("snappy").compress(payload))
+                try:
+                    async with sess.post(
+                        f"http://127.0.0.1:{PORT}/api/v1/write",
+                        data=comp,
+                        headers={"Content-Encoding": "snappy"},
+                    ) as r:
+                        body = await r.json()
+                        if r.status == 200:
+                            stats["writes"] += 1
+                            stats["samples_sent"] += body["samples"]
+                        else:
+                            stats["write_errors"] += 1
+                except Exception:  # noqa: BLE001
+                    stats["write_errors"] += 1
+                seq += 1
+                await asyncio.sleep(0.05)
+
+        async def querier():
+            while time.time() < deadline:
+                now = int(time.time() * 1000)
+                q = {
+                    "metric": "soak_metric",
+                    "start_ms": now - 300_000,
+                    "end_ms": now + 10_000,
+                    "bucket_ms": 60_000,
+                }
+                try:
+                    async with sess.post(
+                        f"http://127.0.0.1:{PORT}/api/v1/query", json=q
+                    ) as r:
+                        await r.json()
+                        if r.status == 200:
+                            stats["queries"] += 1
+                        else:
+                            stats["query_errors"] += 1
+                except Exception:  # noqa: BLE001
+                    stats["query_errors"] += 1
+                await asyncio.sleep(0.25)
+
+        await asyncio.gather(*(writer(w) for w in range(4)), querier(), querier())
+        async with sess.get(f"http://127.0.0.1:{PORT}/metrics") as r:
+            metrics_text = await r.text()
+    for line in metrics_text.splitlines():
+        if line.startswith("horaedb_remote_write_samples_total"):
+            stats["samples_ingested"] = float(line.split()[1])
+    return stats
+
+
+def main() -> None:
+    seconds = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    data_dir = tempfile.mkdtemp(prefix="soak_")
+    cfg = os.path.join(data_dir, "cfg.toml")
+    with open(cfg, "w") as f:
+        f.write(
+            f'port = {PORT}\n[test]\nsegment_duration = "2h"\n'
+            f'[metric_engine.storage.object_store]\ntype = "Local"\ndata_dir = "{data_dir}/db"\n'
+        )
+    env = dict(os.environ)
+    env["HORAEDB_JAX_PLATFORM"] = env.get("HORAEDB_JAX_PLATFORM", "cpu")
+    server = subprocess.Popen(
+        [sys.executable, "-m", "horaedb_tpu.server.main", "--config", cfg],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        time.sleep(5)  # server warmup
+        stats = asyncio.run(run_soak(seconds))
+        ok = (
+            stats["write_errors"] == 0
+            and stats["query_errors"] == 0
+            and stats.get("samples_ingested") == stats["samples_sent"]
+        )
+        stats["bench"] = "soak"
+        stats["seconds"] = seconds
+        stats["ok"] = ok
+        print(json.dumps(stats))
+        if not ok:
+            raise SystemExit(1)
+    finally:
+        server.send_signal(signal.SIGINT)
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,11 @@ from horaedb_tpu.storage.types import TimeRange
 # fall back to materializing + np.unique sizing by the rows actually in range.
 MAX_PUSHDOWN_SERIES = 65_536
 
+# Resolution guard: a downsample query must not demand an absurd number of
+# buckets (start=0, bucket=1m would be a ~30M-bucket grid per series) —
+# reject loudly, like Prometheus's max-resolution limit.
+MAX_BUCKETS = 100_000
+
 
 class SampleManager:
     def __init__(self, storage, segment_duration_ms: int):
@@ -99,6 +104,14 @@ class SampleManager:
         (no tag filter): the TSID membership predicate is skipped, and very
         high cardinalities fall back to the materializing path whose output
         is sized by the series actually present in range."""
+        from horaedb_tpu.common.error import ensure
+
+        n_buckets = -(-(rng.end - rng.start) // bucket_ms)
+        ensure(
+            n_buckets <= MAX_BUCKETS,
+            f"downsample resolution too high: {n_buckets} buckets "
+            f"(max {MAX_BUCKETS}); narrow the range or coarsen bucket_ms",
+        )
         ssts = self._storage.manifest.find_ssts(rng)
         if not ssts or not tsids:
             return None

@@ -184,7 +184,10 @@ async def handle_query(request: web.Request) -> web.Response:
     except Exception as e:  # noqa: BLE001
         return web.json_response({"error": f"bad query: {e}"}, status=400)
     METRICS.inc("horaedb_queries_total")
-    out = await state.engine.query(req)
+    try:
+        out = await state.engine.query(req)
+    except HoraeError as e:
+        return web.json_response({"error": str(e)}, status=400)
     if out is None:
         return web.json_response({"series": []})
     if req.bucket_ms is None:

@@ -156,6 +156,8 @@ class TestEndpoints:
     async def test_bad_requests(self, tmp_path):
         client = await make_client(tmp_path)
         try:
+            ok_payload = make_remote_write([({"__name__": "cpu", "h": "x"}, [(1000, 1.0)])])
+            await client.post("/api/v1/write", data=ok_payload)
             r = await client.post(
                 "/api/v1/write", data=b"\xff\xfe", headers={"Content-Encoding": "snappy"}
             )
@@ -166,5 +168,13 @@ class TestEndpoints:
                 "/api/v1/query", json={"metric": "nope", "start_ms": 0, "end_ms": 1}
             )
             assert (await r.json())["series"] == []
+            # absurd resolution (billions of buckets) must 400, not hang
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "cpu", "start_ms": 0,
+                      "end_ms": 1_700_000_000_000, "bucket_ms": 1000},
+            )
+            assert r.status == 400
+            assert "resolution" in (await r.json())["error"]
         finally:
             await client.close()
