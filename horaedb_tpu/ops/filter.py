@@ -310,6 +310,60 @@ def _eval(pred: Predicate, cols: dict[str, jnp.ndarray], literals: tuple = ()) -
     raise HoraeError(f"unknown predicate node: {pred!r}")
 
 
+# -- host-side evaluation (binary-capable) -----------------------------------
+
+def eval_predicate_host(pred: Predicate | None, table) -> np.ndarray:
+    """Vectorized predicate evaluation over a pyarrow Table on host —
+    supports binary/string columns (bytes literals, ordering via arrow
+    compute), used by the binary-primary-key scan path. Returns a boolean
+    numpy mask."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    n = table.num_rows
+    if pred is None:
+        return np.ones(n, dtype=bool)
+
+    def ev(p: Predicate) -> np.ndarray:
+        if isinstance(p, Compare):
+            col = table.column(p.column).combine_chunks()
+            lit = p.literal
+            try:
+                fn = {"eq": pc.equal, "ne": pc.not_equal, "lt": pc.less,
+                      "le": pc.less_equal, "gt": pc.greater, "ge": pc.greater_equal}[p.op]
+                out = fn(col, pa.scalar(lit))
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError) as e:
+                raise HoraeError(
+                    f"predicate literal {lit!r} incompatible with column "
+                    f"{p.column!r} ({col.type})"
+                ) from e
+            return pc.fill_null(out, False).to_numpy(zero_copy_only=False)
+        if isinstance(p, InSet):
+            col = table.column(p.column).combine_chunks()
+            try:
+                out = pc.is_in(col, value_set=pa.array(list(p.values), type=col.type))
+            except (pa.ArrowInvalid, pa.ArrowTypeError, OverflowError) as e:
+                raise HoraeError(
+                    f"InSet values incompatible with column {p.column!r} ({col.type})"
+                ) from e
+            return pc.fill_null(out, False).to_numpy(zero_copy_only=False)
+        if isinstance(p, And):
+            out = ev(p.children[0])
+            for c in p.children[1:]:
+                out = out & ev(c)
+            return out
+        if isinstance(p, Or):
+            out = ev(p.children[0])
+            for c in p.children[1:]:
+                out = out | ev(c)
+            return out
+        if isinstance(p, Not):
+            return ~ev(p.child)
+        raise HoraeError(f"unsupported predicate node on host path: {p!r}")
+
+    return ev(pred)
+
+
 # -- host-side min/max pruning ----------------------------------------------
 
 def prune_range(pred: Predicate | None, stats: dict[str, tuple]) -> bool:
@@ -330,22 +384,28 @@ def _prune(pred: Predicate, stats: dict[str, tuple]) -> bool:
             return True
         lo, hi = stats[pred.column]
         v = pred.literal
-        if pred.op == "eq":
-            return lo <= v <= hi
-        if pred.op == "ne":
-            return not (lo == hi == v)
-        if pred.op == "lt":
-            return lo < v
-        if pred.op == "le":
-            return lo <= v
-        if pred.op == "gt":
-            return hi > v
-        return hi >= v
+        try:
+            if pred.op == "eq":
+                return lo <= v <= hi
+            if pred.op == "ne":
+                return not (lo == hi == v)
+            if pred.op == "lt":
+                return lo < v
+            if pred.op == "le":
+                return lo <= v
+            if pred.op == "gt":
+                return hi > v
+            return hi >= v
+        except TypeError:
+            return True  # mismatched stat/literal types (e.g. bytes stats): keep
     if isinstance(pred, InSet):
         if pred.column not in stats:
             return True
         lo, hi = stats[pred.column]
-        return any(lo <= v <= hi for v in pred.values)
+        try:
+            return any(lo <= v <= hi for v in pred.values)
+        except TypeError:
+            return True
     if isinstance(pred, InSetProbe):
         return True  # membership values are dynamic; stay conservative
     if isinstance(pred, And):

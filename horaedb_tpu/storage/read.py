@@ -58,6 +58,11 @@ from horaedb_tpu.storage.types import (
 DEFAULT_SCAN_BATCH_SIZE = 8192
 
 
+def _is_binary_like(t: pa.DataType) -> bool:
+    """The single definition of 'cannot ride a device lane'."""
+    return pa.types.is_binary(t) or pa.types.is_large_binary(t) or pa.types.is_string(t)
+
+
 @dataclass
 class ScanRequest:
     """Reference: storage.rs ScanRequest — range prunes SSTs (row-exact time
@@ -189,12 +194,21 @@ class ParquetReader:
         """
         # shared prologue/epilogue with the chunked path lives in
         # _resolve_read_names/_output_names/_slice_batches
+        pk_types = [
+            self._schema.arrow_schema.field(n).type
+            for n in self._schema.primary_key_names
+        ]
+        if any(_is_binary_like(t) for t in pk_types):
+            # binary primary keys: sort/dedup on host via arrow compute (the
+            # reference compares binary pks too, macros.rs compare dispatch)
+            return await self._scan_segment_host(
+                ssts, predicate, projections, keep_builtin, batch_size
+            )
         total_rows = sum(s.meta.num_rows for s in ssts)
         if total_rows > self._scan_block_rows and len(ssts) > 1:
             fetched = self._resolve_read_names(projections, keep_builtin)
             has_binary = any(
-                pa.types.is_binary(f.type) or pa.types.is_large_binary(f.type)
-                or pa.types.is_string(f.type)
+                _is_binary_like(f.type)
                 for f in self._schema.arrow_schema
                 if f.name in fetched
             )
@@ -243,6 +257,115 @@ class ParquetReader:
             return []
         return [result.slice(i, batch_size) for i in range(0, result.num_rows, batch_size)]
 
+    async def _scan_segment_host(
+        self,
+        ssts: list[SstFile],
+        predicate: Predicate | None,
+        projections: list[int] | None,
+        keep_builtin: bool,
+        batch_size: int,
+    ) -> list[pa.RecordBatch]:
+        """Host merge/dedup for schemas with binary primary keys: arrow
+        compute sort + vectorized adjacent-row boundary detection. Numeric
+        predicate columns still evaluate through the shared predicate
+        engine."""
+        import pyarrow.compute as pc
+
+        schema = self._schema
+        read_names = self._resolve_read_names(projections, keep_builtin)
+        # Sequential chunked reads with immediate filtering bound peak memory
+        # to (filtered rows so far + one raw chunk); filter BEFORE dedup
+        # (reference plan order).
+        filtered: list[pa.Table] = []
+        chunk: list[SstFile] = []
+        chunk_rows = 0
+
+        async def flush() -> None:
+            nonlocal chunk, chunk_rows
+            if not chunk:
+                return
+            tables = await asyncio.gather(
+                *(self.read_sst(s, read_names, predicate) for s in chunk)
+            )
+            tables = [t for t in tables if t.num_rows > 0]
+            chunk, chunk_rows = [], 0
+            if not tables:
+                return
+            t = pa.concat_tables(tables).combine_chunks()
+            if predicate is not None:
+                mask = filter_ops.eval_predicate_host(predicate, t)
+                t = t.filter(pa.array(mask))
+            if t.num_rows:
+                filtered.append(t)
+
+        for s in ssts:
+            if chunk and chunk_rows + s.meta.num_rows > self._scan_block_rows:
+                await flush()
+            chunk.append(s)
+            chunk_rows += s.meta.num_rows
+        await flush()
+        if not filtered:
+            return []
+        table = pa.concat_tables(filtered).combine_chunks()
+
+        pk_names = schema.primary_key_names
+        sort_keys = [(n, "ascending") for n in pk_names] + [(SEQ_COLUMN_NAME, "ascending")]
+        table = table.sort_by(sort_keys).combine_chunks()
+
+        if schema.update_mode == UpdateMode.OVERWRITE and table.num_rows > 1:
+            n = table.num_rows
+            next_differs = np.zeros(n, dtype=bool)
+            next_differs[-1] = True
+            for name in pk_names:
+                col = table.column(name).combine_chunks()
+                neq = pc.fill_null(
+                    pc.not_equal(col.slice(0, n - 1), col.slice(1, n)), True
+                ).to_numpy(zero_copy_only=False)
+                next_differs[: n - 1] |= neq
+            table = table.filter(pa.array(next_differs))
+        elif schema.update_mode == UpdateMode.APPEND:
+            # binary value columns concat per group (BytesMergeOperator)
+            value_names = {schema.arrow_schema.names[i] for i in schema.value_idxes}
+            has_binary_value = any(
+                _is_binary_like(schema.arrow_schema.field(v).type)
+                for v in value_names
+            )
+            if has_binary_value and table.num_rows > 1:
+                n = table.num_rows
+                starts = np.zeros(n, dtype=bool)
+                starts[0] = True
+                for name in pk_names:
+                    col = table.column(name).combine_chunks()
+                    neq = pc.fill_null(
+                        pc.not_equal(col.slice(1, n), col.slice(0, n - 1)), True
+                    ).to_numpy(zero_copy_only=False)
+                    starts[1:] |= neq
+                start_idx = np.nonzero(starts)[0]
+                ends = np.append(start_idx[1:], n)
+                # resolve value columns BY NAME in the projected table (the
+                # schema-level idxes shift under projection)
+                all_names = schema.arrow_schema.names
+                value_names_ordered = [all_names[i] for i in schema.value_idxes]
+                op = BytesMergeOperator(
+                    [
+                        table.schema.names.index(v)
+                        for v in value_names_ordered
+                        if v in table.schema.names
+                    ]
+                )
+                groups = [
+                    op.merge(table.slice(s, e - s).to_batches()[0])
+                    if e - s > 1
+                    else table.slice(s, 1).to_batches()[0]
+                    for s, e in zip(start_idx, ends)
+                ]
+                table = pa.Table.from_batches(groups)
+
+        out_names = self._output_names(read_names, keep_builtin)
+        result = table.select(out_names).combine_chunks()
+        batches = result.to_batches(max_chunksize=batch_size)
+        return [b for b in batches if b.num_rows > 0]
+
     def _fused_pass(
         self,
         table: pa.Table,
@@ -261,7 +384,7 @@ class ParquetReader:
         numeric_names, binary_names = [], []
         for name in table.schema.names:
             t = table.schema.field(name).type
-            if pa.types.is_binary(t) or pa.types.is_large_binary(t) or pa.types.is_string(t):
+            if _is_binary_like(t):
                 binary_names.append(name)
             else:
                 numeric_names.append(name)

@@ -178,11 +178,24 @@ class ObjectBasedStorage(ColumnarStorage):
 
         The permutation is computed over the numeric pk lanes with one XLA
         lexsort; the gather applies to all columns via pyarrow take so binary
-        payloads never touch the device.
+        payloads never touch the device. Schemas with binary primary keys
+        sort on host via arrow compute (the device path needs numeric lanes).
         """
         if batch.num_rows <= 1:
             return batch
         pk_names = self._schema.primary_key_names
+        pk_types = [batch.schema.field(n).type for n in pk_names]
+        if any(
+            pa.types.is_binary(t) or pa.types.is_large_binary(t) or pa.types.is_string(t)
+            for t in pk_types
+        ):
+            import pyarrow.compute as pc
+
+            perm = pc.sort_indices(
+                pa.Table.from_batches([batch]),
+                sort_keys=[(n, "ascending") for n in pk_names],
+            )
+            return batch.take(perm)
         keys = []
         for name in pk_names:
             keys.append(arrow_column_to_numpy(batch.column(batch.schema.names.index(name))))

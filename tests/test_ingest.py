@@ -145,6 +145,45 @@ class TestDifferential:
         assert out.sample_value[0] == -1.5
 
 
+class TestFuzz:
+    def test_random_bytes_never_crash(self):
+        """Memory-safety fuzz of the C++ parser: arbitrary garbage must
+        either parse (skip-tolerant wire format) or raise HoraeError —
+        never crash or hang."""
+        native = native_parser()
+        rng = random.Random(42)
+        for _ in range(500):
+            n = rng.randint(0, 300)
+            buf = bytes(rng.getrandbits(8) for _ in range(n))
+            try:
+                native.parse(buf)
+            except HoraeError:
+                pass
+
+    def test_mutated_valid_payloads(self):
+        """Bit-flipped real payloads: the nastier fuzz corpus."""
+        native = native_parser()
+        base = make_payload(seed=0, n_series=5)
+        rng = random.Random(7)
+        for _ in range(300):
+            buf = bytearray(base)
+            for _ in range(rng.randint(1, 8)):
+                buf[rng.randrange(len(buf))] = rng.getrandbits(8)
+            try:
+                native.parse(bytes(buf))
+            except HoraeError:
+                pass
+
+    def test_truncations(self):
+        native = native_parser()
+        base = make_payload(seed=1, n_series=3)
+        for cut in range(0, len(base), 37):
+            try:
+                native.parse(base[:cut])
+            except HoraeError:
+                pass
+
+
 class TestPool:
     @async_test
     async def test_concurrent_decode_50_tasks(self):

@@ -339,6 +339,141 @@ class TestAppendMode:
         await eng.close()
 
 
+class TestBinaryPrimaryKeys:
+    """The reference compares binary pks too (macros.rs dispatch); here the
+    host path handles them (sort/dedup via arrow compute)."""
+
+    @async_test
+    async def test_binary_pk_overwrite_roundtrip(self):
+        store = MemStore()
+        schema = pa.schema([("name", pa.binary()), ("v", pa.float64())])
+        eng = await new_engine(store, schema=schema, num_pks=1)
+        b1 = pa.RecordBatch.from_pydict(
+            {"name": [b"zeta", b"alpha"], "v": [1.0, 2.0]}, schema=schema
+        )
+        b2 = pa.RecordBatch.from_pydict(
+            {"name": [b"alpha"], "v": [20.0]}, schema=schema
+        )
+        await eng.write(WriteRequest(b1, TimeRange(10, 11)))
+        await eng.write(WriteRequest(b2, TimeRange(10, 11)))
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.column("name").to_pylist() == [b"alpha", b"zeta"]  # sorted
+        assert t.column("v").to_pylist() == [20.0, 1.0]  # newest alpha wins
+        await eng.close()
+
+    @async_test
+    async def test_binary_pk_with_numeric_predicate(self):
+        store = MemStore()
+        schema = pa.schema([("name", pa.binary()), ("v", pa.float64())])
+        eng = await new_engine(store, schema=schema, num_pks=1)
+        b = pa.RecordBatch.from_pydict(
+            {"name": [b"a", b"b", b"c"], "v": [1.0, 5.0, 9.0]}, schema=schema
+        )
+        await eng.write(WriteRequest(b, TimeRange(10, 11)))
+        t = await collect(
+            eng,
+            ScanRequest(range=TimeRange(0, SEGMENT_MS), predicate=F.Compare("v", "gt", 2.0)),
+        )
+        assert t.column("name").to_pylist() == [b"b", b"c"]
+        await eng.close()
+
+    @async_test
+    async def test_binary_pk_append_mode_concat(self):
+        store = MemStore()
+        schema = pa.schema([("name", pa.binary()), ("payload", pa.binary())])
+        cfg = StorageConfig(update_mode=UpdateMode.APPEND)
+        eng = await new_engine(store, schema=schema, num_pks=1, config=cfg)
+        b1 = pa.RecordBatch.from_pydict(
+            {"name": [b"k"], "payload": [b"aa"]}, schema=schema
+        )
+        b2 = pa.RecordBatch.from_pydict(
+            {"name": [b"k"], "payload": [b"bb"]}, schema=schema
+        )
+        await eng.write(WriteRequest(b1, TimeRange(10, 11)))
+        await eng.write(WriteRequest(b2, TimeRange(10, 11)))
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.column("payload").to_pylist() == [b"aabb"]
+        await eng.close()
+
+
+class TestBinaryPkEdgeCases:
+    @async_test
+    async def test_append_concat_with_projection(self):
+        """Projected scans must resolve append-value columns by NAME (index
+        positions shift under projection)."""
+        store = MemStore()
+        schema = pa.schema(
+            [("name", pa.binary()), ("a", pa.binary()), ("b", pa.binary())]
+        )
+        cfg = StorageConfig(update_mode=UpdateMode.APPEND)
+        eng = await new_engine(store, schema=schema, num_pks=1, config=cfg)
+        for payload in (b"x1", b"x2"):
+            await eng.write(
+                WriteRequest(
+                    pa.RecordBatch.from_pydict(
+                        {"name": [b"k"], "a": [payload], "b": [payload.upper()]},
+                        schema=schema,
+                    ),
+                    TimeRange(10, 11),
+                )
+            )
+        t = await collect(
+            eng, ScanRequest(range=TimeRange(0, SEGMENT_MS), projections=[0, 1])
+        )
+        assert t.column("a").to_pylist() == [b"x1x2"]
+        await eng.close()
+
+    @async_test
+    async def test_large_binary_append_concat(self):
+        store = MemStore()
+        schema = pa.schema([("name", pa.binary()), ("payload", pa.large_binary())])
+        cfg = StorageConfig(update_mode=UpdateMode.APPEND)
+        eng = await new_engine(store, schema=schema, num_pks=1, config=cfg)
+        for p in (b"aa", b"bb"):
+            await eng.write(
+                WriteRequest(
+                    pa.RecordBatch.from_pydict(
+                        {"name": [b"k"], "payload": [p]}, schema=schema
+                    ),
+                    TimeRange(10, 11),
+                )
+            )
+        t = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert t.column("payload").to_pylist() == [b"aabb"]
+        await eng.close()
+
+    @async_test
+    async def test_predicate_on_binary_pk(self):
+        """bytes-literal predicates evaluate on the host path."""
+        store = MemStore()
+        schema = pa.schema([("name", pa.binary()), ("v", pa.float64())])
+        eng = await new_engine(store, schema=schema, num_pks=1)
+        await eng.write(
+            WriteRequest(
+                pa.RecordBatch.from_pydict(
+                    {"name": [b"a", b"b", b"c"], "v": [1.0, 2.0, 3.0]}, schema=schema
+                ),
+                TimeRange(10, 11),
+            )
+        )
+        t = await collect(
+            eng,
+            ScanRequest(
+                range=TimeRange(0, SEGMENT_MS), predicate=F.Compare("name", "eq", b"b")
+            ),
+        )
+        assert t.column("v").to_pylist() == [2.0]
+        # mismatched literal type -> clear HoraeError, not TypeError
+        with pytest.raises(HoraeError):
+            await collect(
+                eng,
+                ScanRequest(
+                    range=TimeRange(0, SEGMENT_MS), predicate=F.Compare("name", "eq", 5)
+                ),
+            )
+        await eng.close()
+
+
 class TestOverwriteBinary:
     @async_test
     async def test_overwrite_with_binary_value(self):
