@@ -113,7 +113,10 @@ class TestExecutor:
         assert len(eng.manifest.all_ssts()) == 4
         sched = eng.compaction_scheduler
         assert sched.pick_once()
-        for _ in range(200):
+        # generous deadline: the task must travel pick -> queue -> recv loop
+        # -> executor before the manifest shrinks (drain() alone can race a
+        # task still sitting in the queue)
+        for _ in range(750):
             await asyncio.sleep(0.02)
             if len(eng.manifest.all_ssts()) == 1:
                 break
