@@ -154,6 +154,23 @@ class MetricEngine:
             return []
         return self.index_mgr.label_values(hit[0], key)
 
+    def metric_names(self) -> list[bytes]:
+        """All registered metric names (the /api/v1/metrics surface)."""
+        return self.metric_mgr.names()
+
+    def series(self, metric: bytes) -> list[dict[str, str]]:
+        """Label sets of every series of a metric (the /api/v1/series
+        surface), including tagless series."""
+        hit = self.metric_mgr.get(metric)
+        if hit is None:
+            return []
+        per_tsid = self.index_mgr.series_labels(hit[0])
+        return [
+            {k.decode(errors="replace"): v.decode(errors="replace")
+             for k, v in labels.items()} | {"__tsid__": str(t)}
+            for t, labels in sorted(per_tsid.items())
+        ]
+
     async def compact(self) -> None:
         """Manual compaction trigger on the data table (the /compact hook)."""
         from horaedb_tpu.storage.read import CompactRequest

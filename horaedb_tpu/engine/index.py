@@ -41,6 +41,8 @@ class IndexManager:
         self._known: set[tuple[int, int]] = set()
         # (metric_id, tag_hash) -> {tsid -> (key, value)} posting lists
         self._postings: dict[tuple[int, int], dict[int, tuple[bytes, bytes]]] = defaultdict(dict)
+        # metric_id -> its posting keys (per-metric scans stay O(one metric))
+        self._metric_postings: dict[int, set[tuple[int, int]]] = defaultdict(set)
 
     async def open(self) -> None:
         async for batch in self._series.scan(ScanRequest(range=_ALL_TIME)):
@@ -57,6 +59,7 @@ class IndexManager:
                 batch.column("tag_value").to_pylist(),
             ):
                 self._postings[(m, h)][t] = (k, v)
+                self._metric_postings[m].add((m, h))
 
     # -- write path ----------------------------------------------------------
     async def populate_series_ids(
@@ -91,6 +94,7 @@ class IndexManager:
                 self._known.add((mid, tsid))
             for mid, h, tsid, k, v in new_index_rows:
                 self._postings[(mid, h)][tsid] = (k, v)
+                self._metric_postings[mid].add((mid, h))
         return tsids
 
     async def _persist(self, series_rows, index_rows, now_ms: int) -> None:
@@ -145,10 +149,20 @@ class IndexManager:
         """LabelValues via the inverted index (the RFC's two-step fallback,
         RFC :120-130)."""
         out = set()
-        for (m, _h), posting in self._postings.items():
-            if m != metric_id:
-                continue
-            for kv in posting.values():
+        for pk in self._metric_postings.get(metric_id, ()):
+            for kv in self._postings[pk].values():
                 if kv[0] == key:
                     out.add(kv[1])
         return sorted(out)
+
+    def series_labels(self, metric_id: int) -> dict[int, dict[bytes, bytes]]:
+        """tsid -> label map for every series of a metric, including series
+        with no tags at all (seeded from the known-series set so tagless
+        series don't vanish from listings)."""
+        per_tsid: dict[int, dict[bytes, bytes]] = {
+            t: {} for m, t in self._known if m == metric_id
+        }
+        for pk in self._metric_postings.get(metric_id, ()):
+            for tsid, (k, v) in self._postings[pk].items():
+                per_tsid.setdefault(tsid, {})[k] = v
+        return per_tsid

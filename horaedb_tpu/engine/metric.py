@@ -40,6 +40,10 @@ class MetricManager:
     def get(self, name: bytes) -> tuple[int, int] | None:
         return self._cache.get(name)
 
+    def names(self) -> list[bytes]:
+        """All registered metric names."""
+        return sorted(self._cache.keys())
+
     async def populate_metric_ids(
         self, names: list[bytes], now_ms: int
     ) -> dict[bytes, MetricId]:

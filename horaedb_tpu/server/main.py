@@ -181,6 +181,9 @@ async def handle_query(request: web.Request) -> web.Response:
             filters=[(k.encode(), v.encode()) for k, v in q.get("filters", {}).items()],
             bucket_ms=q.get("bucket_ms"),
         )
+        limit = min(int(q.get("limit", 100_000)), 1_000_000)
+        if limit < 0:
+            raise ValueError("limit must be >= 0")
     except Exception as e:  # noqa: BLE001
         return web.json_response({"error": f"bad query: {e}"}, status=400)
     METRICS.inc("horaedb_queries_total")
@@ -192,12 +195,16 @@ async def handle_query(request: web.Request) -> web.Response:
         return web.json_response({"series": []})
     if req.bucket_ms is None:
         table = out
+        # bound the JSON response; clients page with narrower time ranges
+        truncated = table.num_rows > limit
+        view = table.slice(0, limit)
         return web.json_response(
             {
-                "rows": table.num_rows,
-                "tsid": [str(x) for x in table.column("tsid").to_pylist()],
-                "ts": table.column("ts").to_pylist(),
-                "value": table.column("value").to_pylist(),
+                "rows": view.num_rows,
+                "truncated": truncated,
+                "tsid": [str(x) for x in view.column("tsid").to_pylist()],
+                "ts": view.column("ts").to_pylist(),
+                "value": view.column("value").to_pylist(),
             }
         )
     tsids, grids = out
@@ -209,6 +216,18 @@ async def handle_query(request: web.Request) -> web.Response:
             "count": grids["count"].tolist(),
         }
     )
+
+
+async def handle_metrics_list(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    names = state.engine.metric_names()
+    return web.json_response({"metrics": [n.decode(errors="replace") for n in names]})
+
+
+async def handle_series(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    metric = request.query.get("metric", "").encode()
+    return web.json_response({"series": state.engine.series(metric)})
 
 
 async def handle_labels(request: web.Request) -> web.Response:
@@ -290,6 +309,8 @@ async def build_app(config: Config) -> web.Application:
             web.post("/api/v1/write", handle_remote_write),
             web.post("/api/v1/query", handle_query),
             web.get("/api/v1/labels", handle_labels),
+            web.get("/api/v1/metrics", handle_metrics_list),
+            web.get("/api/v1/series", handle_series),
         ]
     )
 

@@ -227,6 +227,19 @@ class TestMetricEngine:
         await eng2.close()
 
     @async_test
+    async def test_tagless_series_listed(self):
+        """A series with only __name__ must still appear in listings."""
+        store = MemStore()
+        eng = await open_engine(store)
+        await eng.write_parsed(
+            PooledParser.decode(make_remote_write([({"__name__": "up"}, [(1000, 1.0)])]))
+        )
+        assert eng.metric_names() == [b"up"]
+        series = eng.series(b"up")
+        assert len(series) == 1 and "__tsid__" in series[0]
+        await eng.close()
+
+    @async_test
     async def test_label_values(self):
         store = MemStore()
         eng = await open_engine(store)

@@ -134,6 +134,22 @@ class TestEndpoints:
             # labels
             r = await client.get("/api/v1/labels?metric=cpu&key=host")
             assert (await r.json())["values"] == ["a", "b"]
+
+            # metric + series listings
+            r = await client.get("/api/v1/metrics")
+            assert (await r.json())["metrics"] == ["cpu"]
+            r = await client.get("/api/v1/series?metric=cpu")
+            series = (await r.json())["series"]
+            assert sorted(s["host"] for s in series) == ["a", "b"]
+            assert all("__tsid__" in s for s in series)
+
+            # raw-query row limit
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "cpu", "start_ms": 0, "end_ms": 10_000, "limit": 2},
+            )
+            body = await r.json()
+            assert body["rows"] == 2 and body["truncated"] is True
         finally:
             await client.close()
 
