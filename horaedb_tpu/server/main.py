@@ -208,12 +208,18 @@ async def handle_query(request: web.Request) -> web.Response:
             }
         )
     tsids, grids = out
+    # limit bounds the series dimension of bucketed responses too
+    truncated = len(tsids) > limit
+    tsids = tsids[:limit]
+    mean = grids["mean"][:limit]
+    count = grids["count"][:limit]
     return web.json_response(
         {
             "tsids": [str(t) for t in tsids],
             "buckets": grids["mean"].shape[1],
-            "mean": np.where(np.isnan(grids["mean"]), None, grids["mean"]).tolist(),
-            "count": grids["count"].tolist(),
+            "truncated": truncated,
+            "mean": np.where(np.isnan(mean), None, mean).tolist(),
+            "count": count.tolist(),
         }
     )
 

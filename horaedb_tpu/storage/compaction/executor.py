@@ -147,6 +147,8 @@ class Executor:
 
     async def _delete_ssts(self, ids: list[int]) -> None:
         """Best-effort parallel physical deletes (executor.rs:224-253)."""
+        for i in ids:
+            self._storage.parquet_reader.evict_cached(i)
         paths = [self._storage.parquet_reader._path_gen.generate(i) for i in ids]
         results = await asyncio.gather(
             *(self._storage._store.delete(p) for p in paths), return_exceptions=True

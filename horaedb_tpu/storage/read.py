@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import asyncio
 import io
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from functools import lru_cache
 from typing import AsyncIterator
@@ -149,6 +151,19 @@ class ParquetReader:
         self._path_gen = sst_path_gen
         self._schema = schema
         self._scan_block_rows = scan_block_rows
+        # SSTs are immutable: cache open parquet handles (footer + schema
+        # already parsed) keyed by path — the analog of the reference's
+        # footer-size hint on its ParquetFileReaderFactory (read.rs:78-93).
+        # Entries are (handle, per-handle lock): reads run in worker threads
+        # and a pyarrow handle must not serve two reads at once. Protocol:
+        # readers hold the handle lock for the whole read (the inserting
+        # reader publishes the lock ALREADY ACQUIRED); closers (LRU eviction,
+        # evict_cached) pop under the cache lock then acquire the handle lock
+        # before close, so a handle is never closed mid-read. A busy handle
+        # falls back to a transient open.
+        self._pf_cache: "OrderedDict[str, tuple[pq.ParquetFile, threading.Lock]]" = OrderedDict()
+        self._pf_cache_cap = 128
+        self._pf_cache_lock = threading.Lock()
 
     async def read_sst(
         self,
@@ -160,11 +175,47 @@ class ParquetReader:
         min/max statistics can't satisfy the predicate."""
         path = self._path_gen.generate(sst.id)
 
+        def _close_evicted(evicted) -> None:
+            if evicted is not None:
+                old, old_lock = evicted
+                with old_lock:  # wait out any in-flight read
+                    old.close()
+
         def _read() -> pa.Table:
+            with self._pf_cache_lock:
+                entry = self._pf_cache.get(path)
+                if entry is not None:
+                    self._pf_cache.move_to_end(path)
+            if entry is not None:
+                pf, handle_lock = entry
+                if handle_lock.acquire(blocking=False):
+                    try:
+                        return _read_pruned(pf, columns, predicate)
+                    finally:
+                        handle_lock.release()
+                # handle busy with a concurrent read: open transient
             local = self._store.local_path(path)
             if local is None:
                 raise _NeedBytes()
-            return _read_pruned(pq.ParquetFile(local), columns, predicate)
+            pf = pq.ParquetFile(local)
+            my_lock = threading.Lock()
+            my_lock.acquire()  # published pre-acquired: we read it first
+            inserted = False
+            evicted = None
+            if entry is None:
+                with self._pf_cache_lock:
+                    if path not in self._pf_cache:
+                        self._pf_cache[path] = (pf, my_lock)
+                        inserted = True
+                        if len(self._pf_cache) > self._pf_cache_cap:
+                            _, evicted = self._pf_cache.popitem(last=False)
+            try:
+                return _read_pruned(pf, columns, predicate)
+            finally:
+                my_lock.release()
+                if not inserted:
+                    pf.close()  # transient handle (cache busy or lost race)
+                _close_evicted(evicted)
 
         def _read_bytes(data: bytes) -> pa.Table:
             pf = pq.ParquetFile(io.BytesIO(data))
@@ -175,6 +226,16 @@ class ParquetReader:
         except _NeedBytes:
             data = await self._store.get(path)
             return await asyncio.to_thread(_read_bytes, data)
+
+    def evict_cached(self, file_id: int) -> None:
+        """Drop the cached handle of a deleted SST (compaction calls this
+        before physical deletes so file descriptors don't linger)."""
+        with self._pf_cache_lock:
+            entry = self._pf_cache.pop(self._path_gen.generate(file_id), None)
+        if entry is not None:
+            pf, handle_lock = entry
+            with handle_lock:  # wait out any in-flight read
+                pf.close()
 
     async def scan_segment(
         self,
