@@ -273,10 +273,22 @@ def _eval(pred: Predicate, cols: dict[str, jnp.ndarray], literals: tuple = ()) -
         return c >= lit
     if isinstance(pred, InSetProbe):
         c = cols[pred.column]
-        vals = jnp.asarray(literals[pred.values_slot])
+        vals = jnp.asarray(literals[pred.values_slot]).astype(c.dtype)
         active = jnp.asarray(literals[pred.mask_slot])
-        hit = (c[:, None] == vals[None, :].astype(c.dtype)) & active[None, :]
-        return jnp.any(hit, axis=1)
+        if pred.padded_size <= 128:
+            # small sets: one broadcast compare, O(n*s) but fully vectorized
+            hit = (c[:, None] == vals[None, :]) & active[None, :]
+            return jnp.any(hit, axis=1)
+        # large sets (engine TSID filters go up to 64K): O(n log s) binary
+        # search over the sorted membership array. Padding duplicates a real
+        # value so sortedness and equality stay exact; an all-padding (empty)
+        # set is rejected by the active.any() guard.
+        vals_sorted = jnp.sort(vals)
+        pos = jnp.clip(
+            jnp.searchsorted(vals_sorted, c), 0, pred.padded_size - 1
+        )
+        hit = vals_sorted[pos] == c
+        return hit & jnp.any(active)
     if isinstance(pred, InSet):
         c = cols[pred.column]
         dt = np.dtype(c.dtype)

@@ -112,7 +112,10 @@ class TestExecutor:
             )
         assert len(eng.manifest.all_ssts()) == 4
         sched = eng.compaction_scheduler
-        assert sched.pick_once()
+        # the 50ms background picker may legitimately win the race and mark
+        # the files first — don't assert this manual pick succeeded, just
+        # that SOME pick leads to convergence
+        sched.pick_once()
         # generous deadline: the task must travel pick -> queue -> recv loop
         # -> executor before the manifest shrinks (drain() alone can race a
         # task still sitting in the queue)

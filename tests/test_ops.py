@@ -133,6 +133,30 @@ class TestFilter:
         np.testing.assert_array_equal(m1, [False, True, True, True])
         np.testing.assert_array_equal(m2, [True, False, False, False])
 
+    def test_inset_probe_large_set_binary_search_path(self):
+        """Sets above the broadcast threshold use sorted binary search —
+        results must match exactly, including u64 ids and empty sets."""
+        rng = np.random.default_rng(11)
+        members = np.unique(rng.integers(0, 2**63, 400, dtype=np.uint64))[:300]
+        ids = np.concatenate([members[:50], rng.integers(0, 2**62, 500).astype(np.uint64)])
+        rng.shuffle(ids)
+        pred = filter_ops.InSet("tsid", tuple(int(x) for x in members))
+        t, lits = filter_ops.split_literals(pred)
+        assert t.padded_size > 128
+        arrs = filter_ops.literal_arrays(t, lits, {"tsid": np.dtype(np.uint64)})
+        mask = np.asarray(filter_ops.eval_predicate(t, {"tsid": ids}, arrs))
+        np.testing.assert_array_equal(mask, np.isin(ids, members))
+        # empty set -> all False
+        empty = filter_ops.InSet("tsid", tuple(int(x) for x in members[:0]))
+        # force the large bucket by padding manually via a 200-value set of
+        # out-of-domain (negative) values that all get dropped
+        big_bad = filter_ops.InSet("tsid", tuple(range(-1, -200, -1)))
+        t2, l2 = filter_ops.split_literals(big_bad)
+        a2 = filter_ops.literal_arrays(t2, l2, {"tsid": np.dtype(np.uint64)})
+        m2 = np.asarray(filter_ops.eval_predicate(t2, {"tsid": ids}, a2))
+        assert not m2.any()
+        del empty
+
     def test_compare_out_of_domain_literal_rejected(self):
         from horaedb_tpu.common.error import HoraeError
 
