@@ -79,16 +79,26 @@ class MetricEngine:
         self.data_table = await open_table(
             "data", tables.DATA_SCHEMA, tables.DATA_NUM_PKS, enable_compaction
         )
+        self.exemplars_table = await open_table(
+            "exemplars", tables.EXEMPLARS_SCHEMA, tables.EXEMPLARS_NUM_PKS, False
+        )
 
         self.metric_mgr = MetricManager(self.metrics_table, segment_duration_ms)
         self.index_mgr = IndexManager(self.series_table, self.index_table, segment_duration_ms)
         self.sample_mgr = SampleManager(self.data_table, segment_duration_ms)
+        self.exemplar_mgr = SampleManager(self.exemplars_table, segment_duration_ms)
         await self.metric_mgr.open()
         await self.index_mgr.open()
         return self
 
     async def close(self) -> None:
-        for t in (self.metrics_table, self.series_table, self.index_table, self.data_table):
+        for t in (
+            self.metrics_table,
+            self.series_table,
+            self.index_table,
+            self.data_table,
+            self.exemplars_table,
+        ):
             await t.close()
 
     # -- write path -----------------------------------------------------------
@@ -120,24 +130,71 @@ class MetricEngine:
         )
         # 3. samples -> data rows
         n = req.n_samples
-        if n == 0:
-            return 0
-        series_idx = req.sample_series
-        m_arr = np.asarray(metric_per_series, dtype=np.uint64)[series_idx]
-        t_arr = np.asarray(tsids, dtype=np.uint64)[series_idx]
-        await self.sample_mgr.persist(m_arr, t_arr, req.sample_ts, req.sample_value)
+        metric_arr = np.asarray(metric_per_series, dtype=np.uint64)
+        tsid_arr = np.asarray(tsids, dtype=np.uint64)
+        if n:
+            series_idx = req.sample_series
+            await self.sample_mgr.persist(
+                metric_arr[series_idx], tsid_arr[series_idx],
+                req.sample_ts, req.sample_value,
+            )
+        # 4. exemplars -> exemplars table (with their labels: trace ids are
+        # the entire point of exemplars)
+        if len(req.exemplar_value):
+            await self._persist_exemplars(req, metric_arr, tsid_arr)
         return n
 
+    async def _persist_exemplars(
+        self, req: ParsedWriteRequest, metric_arr, tsid_arr
+    ) -> None:
+        import pyarrow as pa
+
+        from horaedb_tpu.engine.types import series_key_of
+        from horaedb_tpu.storage.read import WriteRequest as StorageWrite
+
+        ex_idx = req.exemplar_series
+        m = metric_arr[ex_idx]
+        t = tsid_arr[ex_idx]
+        ts = req.exemplar_ts
+        vals = req.exemplar_value
+        labels = [
+            series_key_of(req.exemplar_labels(i)) for i in range(len(vals))
+        ]
+        seg = ts - (ts % self._segment_duration)
+        for seg_start in np.unique(seg):
+            msk = seg == seg_start
+            idxs = np.nonzero(msk)[0]
+            batch = pa.RecordBatch.from_pydict(
+                {
+                    "metric_id": m[msk].astype(np.uint64),
+                    "tsid": t[msk].astype(np.uint64),
+                    "ts": ts[msk],
+                    "value": vals[msk],
+                    "labels": [labels[i] for i in idxs],
+                },
+                schema=tables.EXEMPLARS_SCHEMA,
+            )
+            lo, hi = int(ts[msk].min()), int(ts[msk].max()) + 1
+            await self.exemplars_table.write(StorageWrite(batch, TimeRange(lo, hi)))
+
     # -- query path -------------------------------------------------------------
-    async def query(self, req: QueryRequest):
-        """Raw rows (bucket_ms None) or downsample grids per series."""
-        hit = self.metric_mgr.get(req.metric)
+    def _resolve_query(self, metric: bytes, filters) -> tuple[int, list | None] | None:
+        """Shared lookup prologue: metric id + TSID candidates, or None when
+        the metric is unknown / no series matches the filters."""
+        hit = self.metric_mgr.get(metric)
         if hit is None:
             return None
-        metric_id = hit[0]
-        tsids = self.index_mgr.find_tsids(metric_id, req.filters)
+        tsids = self.index_mgr.find_tsids(hit[0], filters)
         if tsids == []:
             return None
+        return hit[0], tsids
+
+    async def query(self, req: QueryRequest):
+        """Raw rows (bucket_ms None) or downsample grids per series."""
+        resolved = self._resolve_query(req.metric, req.filters)
+        if resolved is None:
+            return None
+        metric_id, tsids = resolved
         rng = TimeRange(req.start_ms, req.end_ms)
         if req.bucket_ms is None:
             return await self.sample_mgr.query_raw(metric_id, tsids, rng)
@@ -146,6 +203,16 @@ class MetricEngine:
             tsids = self.index_mgr.series_of(metric_id)
         return await self.sample_mgr.query_downsample(
             metric_id, tsids, rng, req.bucket_ms, filtered=filtered
+        )
+
+    async def query_exemplars(self, req: QueryRequest):
+        """Raw exemplar rows (incl. their labels) for a metric."""
+        resolved = self._resolve_query(req.metric, req.filters)
+        if resolved is None:
+            return None
+        metric_id, tsids = resolved
+        return await self.exemplar_mgr.query_raw(
+            metric_id, tsids, TimeRange(req.start_ms, req.end_ms)
         )
 
     def label_values(self, metric: bytes, key: bytes) -> list[bytes]:

@@ -59,3 +59,16 @@ DATA_SCHEMA = pa.schema(
     ]
 )
 DATA_NUM_PKS = 4
+
+# exemplars: pk (metric_id, tsid, ts); values: sample + serialized labels
+# (length-prefixed KV encoding from engine.types, carrying trace ids etc.)
+EXEMPLARS_SCHEMA = pa.schema(
+    [
+        ("metric_id", pa.uint64()),
+        ("tsid", pa.uint64()),
+        ("ts", pa.int64()),
+        ("value", pa.float64()),
+        ("labels", pa.binary()),
+    ]
+)
+EXEMPLARS_NUM_PKS = 3

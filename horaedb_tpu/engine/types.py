@@ -78,3 +78,23 @@ def series_key_of(labels: list[tuple[bytes, bytes]]) -> bytes:
 def tag_hash_of(key: bytes, value: bytes) -> int:
     """Posting-list key for one tag KV in the inverted index."""
     return seahash(struct.pack("<I", len(key)) + key + value)
+
+
+def decode_series_key(data: bytes) -> list[tuple[bytes, bytes]]:
+    """Inverse of series_key_of (length-prefixed sorted KV pairs)."""
+    out = []
+    i = 0
+    n = len(data)
+    while i + 4 <= n:
+        (kl,) = struct.unpack_from("<I", data, i)
+        i += 4
+        k = data[i : i + kl]
+        i += kl
+        if i + 4 > n:
+            break
+        (vl,) = struct.unpack_from("<I", data, i)
+        i += 4
+        v = data[i : i + vl]
+        i += vl
+        out.append((k, v))
+    return out

@@ -48,6 +48,13 @@ class _RwResult(ctypes.Structure):
         ("exemplar_value", ctypes.POINTER(ctypes.c_double)),
         ("exemplar_ts", ctypes.POINTER(ctypes.c_int64)),
         ("exemplar_series", ctypes.POINTER(ctypes.c_int64)),
+        ("n_ex_labels", ctypes.c_int64),
+        ("exemplar_label_start", ctypes.POINTER(ctypes.c_int64)),
+        ("exemplar_label_count", ctypes.POINTER(ctypes.c_int64)),
+        ("ex_label_name_off", ctypes.POINTER(ctypes.c_int64)),
+        ("ex_label_name_len", ctypes.POINTER(ctypes.c_int64)),
+        ("ex_label_value_off", ctypes.POINTER(ctypes.c_int64)),
+        ("ex_label_value_len", ctypes.POINTER(ctypes.c_int64)),
         ("meta_type", ctypes.POINTER(ctypes.c_int64)),
         ("meta_name_off", ctypes.POINTER(ctypes.c_int64)),
         ("meta_name_len", ctypes.POINTER(ctypes.c_int64)),
@@ -137,6 +144,12 @@ class NativeParser:
             exemplar_value=_as_np(res.exemplar_value, nex, np.float64),
             exemplar_ts=_as_np(res.exemplar_ts, nex, np.int64),
             exemplar_series=_as_np(res.exemplar_series, nex, np.int64),
+            exemplar_label_start=_as_np(res.exemplar_label_start, nex, np.int64),
+            exemplar_label_count=_as_np(res.exemplar_label_count, nex, np.int64),
+            ex_label_name_off=_as_np(res.ex_label_name_off, res.n_ex_labels, np.int64),
+            ex_label_name_len=_as_np(res.ex_label_name_len, res.n_ex_labels, np.int64),
+            ex_label_value_off=_as_np(res.ex_label_value_off, res.n_ex_labels, np.int64),
+            ex_label_value_len=_as_np(res.ex_label_value_len, res.n_ex_labels, np.int64),
             meta_type=_as_np(res.meta_type, nmd, np.int64),
             meta_name_off=_as_np(res.meta_name_off, nmd, np.int64),
             meta_name_len=_as_np(res.meta_name_len, nmd, np.int64),

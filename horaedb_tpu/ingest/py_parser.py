@@ -31,6 +31,8 @@ class PyParser:
         lno, lnl, lvo, lvl = [], [], [], []
         sv, st, ss = [], [], []
         ev, et, es = [], [], []
+        els, elc = [], []
+        eno, enl, evo, evl = [], [], [], []
         mt, mno, mnl = [], [], []
 
         def put(b: bytes) -> tuple[int, int]:
@@ -50,6 +52,11 @@ class PyParser:
                 sv.append(smp.value); st.append(smp.timestamp); ss.append(si)
             for ex in series.exemplars:
                 ev.append(ex.value); et.append(ex.timestamp); es.append(si)
+                els.append(len(eno))
+                for lab in ex.labels:
+                    o, l = put(lab.name); eno.append(o); enl.append(l)
+                    o, l = put(lab.value); evo.append(o); evl.append(l)
+                elc.append(len(eno) - els[-1])
             slc.append(len(lno) - sls[-1])
             ssc.append(len(sv) - sss[-1])
         for md in req.metadata:
@@ -68,5 +75,8 @@ class PyParser:
             sample_ts=i64(st), sample_series=i64(ss),
             exemplar_value=np.asarray(ev, dtype=np.float64),
             exemplar_ts=i64(et), exemplar_series=i64(es),
+            exemplar_label_start=i64(els), exemplar_label_count=i64(elc),
+            ex_label_name_off=i64(eno), ex_label_name_len=i64(enl),
+            ex_label_value_off=i64(evo), ex_label_value_len=i64(evl),
             meta_type=i64(mt), meta_name_off=i64(mno), meta_name_len=i64(mnl),
         )

@@ -38,6 +38,13 @@ class ParsedWriteRequest:
     exemplar_value: np.ndarray
     exemplar_ts: np.ndarray
     exemplar_series: np.ndarray
+    # exemplar labels as (offset, length) into payload, per-exemplar ranges
+    exemplar_label_start: np.ndarray
+    exemplar_label_count: np.ndarray
+    ex_label_name_off: np.ndarray
+    ex_label_name_len: np.ndarray
+    ex_label_value_off: np.ndarray
+    ex_label_value_len: np.ndarray
     # metadata entries
     meta_type: np.ndarray
     meta_name_off: np.ndarray
@@ -63,6 +70,16 @@ class ParsedWriteRequest:
         s = int(self.series_label_start[series])
         c = int(self.series_label_count[series])
         return [(self.label_name(i), self.label_value(i)) for i in range(s, s + c)]
+
+    def exemplar_labels(self, ex: int) -> list[tuple[bytes, bytes]]:
+        s = int(self.exemplar_label_start[ex])
+        c = int(self.exemplar_label_count[ex])
+        out = []
+        for i in range(s, s + c):
+            no, nl = int(self.ex_label_name_off[i]), int(self.ex_label_name_len[i])
+            vo, vl = int(self.ex_label_value_off[i]), int(self.ex_label_value_len[i])
+            out.append((self.payload[no:no + nl], self.payload[vo:vo + vl]))
+        return out
 
     def meta_name(self, i: int) -> bytes:
         o, l = int(self.meta_name_off[i]), int(self.meta_name_len[i])

@@ -116,10 +116,14 @@ struct Parser {
   std::vector<double> sample_value;
   std::vector<int64_t> sample_ts;
   std::vector<int64_t> sample_series;  // owning series index
-  // flattened exemplars (per series, labels not retained)
+  // flattened exemplars (per series)
   std::vector<double> exemplar_value;
   std::vector<int64_t> exemplar_ts;
   std::vector<int64_t> exemplar_series;
+  // exemplar labels: per-exemplar ranges into flat ex-label lanes
+  std::vector<int64_t> exemplar_label_start, exemplar_label_count;
+  std::vector<int64_t> ex_label_name_off, ex_label_name_len;
+  std::vector<int64_t> ex_label_value_off, ex_label_value_len;
   // metadata entries: {type, family name range, help range, unit range}
   std::vector<int64_t> meta_type;
   std::vector<int64_t> meta_name_off, meta_name_len;
@@ -131,6 +135,9 @@ struct Parser {
     label_value_off.clear(); label_value_len.clear();
     sample_value.clear(); sample_ts.clear(); sample_series.clear();
     exemplar_value.clear(); exemplar_ts.clear(); exemplar_series.clear();
+    exemplar_label_start.clear(); exemplar_label_count.clear();
+    ex_label_name_off.clear(); ex_label_name_len.clear();
+    ex_label_value_off.clear(); ex_label_value_len.clear();
     meta_type.clear(); meta_name_off.clear(); meta_name_len.clear();
   }
 };
@@ -189,23 +196,59 @@ bool parse_sample(Parser& ps, Reader r, int64_t series_idx) {
   return true;
 }
 
-bool parse_exemplar(Parser& ps, Reader r, int64_t series_idx) {
-  double value = 0;
-  int64_t ts = 0;
+bool parse_exemplar_label(Parser& ps, Reader r) {
+  int64_t noff = 0, nlen = 0, voff = 0, vlen = 0;
   while (!r.eof()) {
     uint64_t tag;
     if (!read_varint(r, &tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
-    if (field == 2 && wt == 1) {
+    if (field == 1 && wt == 2) {
+      uint64_t len;
+      if (!read_len(r, &len)) return false;
+      noff = off_of(ps, r.p); nlen = static_cast<int64_t>(len);
+      r.p += len;
+    } else if (field == 2 && wt == 2) {
+      uint64_t len;
+      if (!read_len(r, &len)) return false;
+      voff = off_of(ps, r.p); vlen = static_cast<int64_t>(len);
+      r.p += len;
+    } else if (!skip_field(r, wt)) {
+      return false;
+    }
+  }
+  ps.ex_label_name_off.push_back(noff);
+  ps.ex_label_name_len.push_back(nlen);
+  ps.ex_label_value_off.push_back(voff);
+  ps.ex_label_value_len.push_back(vlen);
+  return true;
+}
+
+bool parse_exemplar(Parser& ps, Reader r, int64_t series_idx) {
+  double value = 0;
+  int64_t ts = 0;
+  ps.exemplar_label_start.push_back(
+      static_cast<int64_t>(ps.ex_label_name_off.size()));
+  while (!r.eof()) {
+    uint64_t tag;
+    if (!read_varint(r, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 1 && wt == 2) {  // exemplar labels
+      uint64_t len;
+      if (!read_len(r, &len)) return false;
+      if (!parse_exemplar_label(ps, Reader{r.p, r.p + len})) return false;
+      r.p += len;
+    } else if (field == 2 && wt == 1) {
       if (!read_fixed64_as_double(r, &value)) return false;
     } else if (field == 3 && wt == 0) {
       uint64_t v;
       if (!read_varint(r, &v)) return false;
       ts = static_cast<int64_t>(v);
-    } else if (!skip_field(r, wt)) {  // exemplar labels (1) skipped
+    } else if (!skip_field(r, wt)) {
       return false;
     }
   }
+  ps.exemplar_label_count.push_back(
+      static_cast<int64_t>(ps.ex_label_name_off.size()) - ps.exemplar_label_start.back());
   ps.exemplar_value.push_back(value);
   ps.exemplar_ts.push_back(ts);
   ps.exemplar_series.push_back(series_idx);
@@ -327,6 +370,13 @@ struct RwResult {
   const double* exemplar_value;
   const int64_t* exemplar_ts;
   const int64_t* exemplar_series;
+  int64_t n_ex_labels;
+  const int64_t* exemplar_label_start;
+  const int64_t* exemplar_label_count;
+  const int64_t* ex_label_name_off;
+  const int64_t* ex_label_name_len;
+  const int64_t* ex_label_value_off;
+  const int64_t* ex_label_value_len;
   const int64_t* meta_type;
   const int64_t* meta_name_off;
   const int64_t* meta_name_len;
@@ -362,6 +412,13 @@ int rw_parse(void* h, const uint8_t* buf, uint64_t len, RwResult* out) {
   out->exemplar_value = ps.exemplar_value.data();
   out->exemplar_ts = ps.exemplar_ts.data();
   out->exemplar_series = ps.exemplar_series.data();
+  out->n_ex_labels = static_cast<int64_t>(ps.ex_label_name_off.size());
+  out->exemplar_label_start = ps.exemplar_label_start.data();
+  out->exemplar_label_count = ps.exemplar_label_count.data();
+  out->ex_label_name_off = ps.ex_label_name_off.data();
+  out->ex_label_name_len = ps.ex_label_name_len.data();
+  out->ex_label_value_off = ps.ex_label_value_off.data();
+  out->ex_label_value_len = ps.ex_label_value_len.data();
   out->meta_type = ps.meta_type.data();
   out->meta_name_off = ps.meta_name_off.data();
   out->meta_name_len = ps.meta_name_len.data();

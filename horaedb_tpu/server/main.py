@@ -170,6 +170,31 @@ async def handle_remote_write(request: web.Request) -> web.Response:
     return web.json_response({"samples": n}, status=200)
 
 
+def _raw_table_response(table, limit: int) -> web.Response:
+    """Shared raw-row serialization (samples and exemplars): bounded by
+    `limit` with a truncated flag; exemplar label blobs decode to dicts."""
+    from horaedb_tpu.engine.types import decode_series_key
+
+    truncated = table.num_rows > limit
+    view = table.slice(0, limit)
+    body = {
+        "rows": view.num_rows,
+        "truncated": truncated,
+        "tsid": [str(x) for x in view.column("tsid").to_pylist()],
+        "ts": view.column("ts").to_pylist(),
+        "value": view.column("value").to_pylist(),
+    }
+    if "labels" in view.schema.names:
+        body["labels"] = [
+            {
+                k.decode(errors="replace"): v.decode(errors="replace")
+                for k, v in decode_series_key(blob or b"")
+            }
+            for blob in view.column("labels").to_pylist()
+        ]
+    return web.json_response(body)
+
+
 async def handle_query(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     try:
@@ -188,25 +213,18 @@ async def handle_query(request: web.Request) -> web.Response:
         return web.json_response({"error": f"bad query: {e}"}, status=400)
     METRICS.inc("horaedb_queries_total")
     try:
+        if q.get("exemplars"):
+            table = await state.engine.query_exemplars(req)
+            if table is None:
+                return web.json_response({"series": []})
+            return _raw_table_response(table, limit)
         out = await state.engine.query(req)
     except HoraeError as e:
         return web.json_response({"error": str(e)}, status=400)
     if out is None:
         return web.json_response({"series": []})
     if req.bucket_ms is None:
-        table = out
-        # bound the JSON response; clients page with narrower time ranges
-        truncated = table.num_rows > limit
-        view = table.slice(0, limit)
-        return web.json_response(
-            {
-                "rows": view.num_rows,
-                "truncated": truncated,
-                "tsid": [str(x) for x in view.column("tsid").to_pylist()],
-                "ts": view.column("ts").to_pylist(),
-                "value": view.column("value").to_pylist(),
-            }
-        )
+        return _raw_table_response(out, limit)
     tsids, grids = out
     # limit bounds the series dimension of bucketed responses too
     truncated = len(tsids) > limit

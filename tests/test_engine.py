@@ -227,6 +227,35 @@ class TestMetricEngine:
         await eng2.close()
 
     @async_test
+    async def test_exemplars_persisted_and_queryable(self):
+        store = MemStore()
+        eng = await open_engine(store)
+        req = remote_write_pb2.WriteRequest()
+        ts = req.timeseries.add()
+        for k, v in ((b"__name__", b"lat"), (b"host", b"a")):
+            lab = ts.labels.add(); lab.name = k; lab.value = v
+        s = ts.samples.add(); s.timestamp = 1000; s.value = 0.2
+        ex = ts.exemplars.add(); ex.value = 0.99; ex.timestamp = 1500
+        lab = ex.labels.add(); lab.name = b"trace_id"; lab.value = b"abc"
+        await eng.write_parsed(PooledParser.decode(req.SerializeToString()))
+
+        out = await eng.query_exemplars(
+            QueryRequest(metric=b"lat", start_ms=0, end_ms=10_000)
+        )
+        assert out.num_rows == 1
+        assert out.column("value").to_pylist() == [0.99]
+        assert out.column("ts").to_pylist() == [1500]
+        # the exemplar's labels (the trace link) survive the round trip
+        from horaedb_tpu.engine.types import decode_series_key
+
+        labels = decode_series_key(out.column("labels").to_pylist()[0])
+        assert labels == [(b"trace_id", b"abc")]
+        # samples unaffected
+        t = await eng.query(QueryRequest(metric=b"lat", start_ms=0, end_ms=10_000))
+        assert t.column("value").to_pylist() == [0.2]
+        await eng.close()
+
+    @async_test
     async def test_tagless_series_listed(self):
         """A series with only __name__ must still appear in listings."""
         store = MemStore()

@@ -74,6 +74,9 @@ def assert_equivalent(a: ParsedWriteRequest, b: ParsedWriteRequest):
         assert a.series_labels(s) == b.series_labels(s)
     np.testing.assert_array_equal(a.exemplar_value, b.exemplar_value)
     np.testing.assert_array_equal(a.exemplar_ts, b.exemplar_ts)
+    np.testing.assert_array_equal(a.exemplar_label_count, b.exemplar_label_count)
+    for e in range(len(a.exemplar_value)):
+        assert a.exemplar_labels(e) == b.exemplar_labels(e)
     np.testing.assert_array_equal(a.meta_type, b.meta_type)
     for i in range(len(a.meta_type)):
         assert a.meta_name(i) == b.meta_name(i)

@@ -154,6 +154,34 @@ class TestEndpoints:
             await client.close()
 
     @async_test
+    async def test_exemplars_roundtrip(self, tmp_path):
+        from horaedb_tpu.pb import remote_write_pb2
+
+        client = await make_client(tmp_path)
+        try:
+            req = remote_write_pb2.WriteRequest()
+            ts = req.timeseries.add()
+            for k, v in ((b"__name__", b"lat"), (b"host", b"a")):
+                lab = ts.labels.add(); lab.name = k; lab.value = v
+            s = ts.samples.add(); s.timestamp = 1000; s.value = 0.5
+            ex = ts.exemplars.add(); ex.value = 0.93; ex.timestamp = 1200
+            lab = ex.labels.add(); lab.name = b"trace_id"; lab.value = b"t-42"
+            r = await client.post("/api/v1/write", data=req.SerializeToString())
+            assert r.status == 200
+
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "lat", "start_ms": 0, "end_ms": 10_000,
+                      "exemplars": True},
+            )
+            body = await r.json()
+            assert body["rows"] == 1
+            assert body["value"] == [0.93]
+            assert body["labels"] == [{"trace_id": "t-42"}]
+        finally:
+            await client.close()
+
+    @async_test
     async def test_remote_write_snappy(self, tmp_path):
         client = await make_client(tmp_path)
         try:
