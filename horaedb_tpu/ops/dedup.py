@@ -23,9 +23,12 @@ import jax.numpy as jnp
 
 
 def run_starts(key_cols: list[jax.Array], valid: jax.Array) -> jax.Array:
-    """Boolean mask: row i begins a new primary-key group."""
+    """Boolean mask: row i begins a new primary-key group.
+
+    Pure mask algebra (an iota compare, not `.at[0].set` — which lowers to a
+    scatter and the scan kernel's plan-shape contract is scatter-free)."""
     n = key_cols[0].shape[0]
-    diff = jnp.zeros(n, dtype=bool).at[0].set(True)
+    diff = jnp.arange(n) == 0
     for col in key_cols:
         prev = jnp.concatenate([col[:1], col[:-1]])
         diff = diff | (col != prev)

@@ -1,0 +1,84 @@
+"""HLO plan-shape golden tests — the XLA analog of the reference's
+DataFusion plan-display regression net (read.rs:575-617 asserts the indent
+string of ParquetExec->FilterExec->SPM->MergeExec; SURVEY §4 calls this 'a
+cheap, high-value regression net worth replicating for XLA/HLO plans').
+
+Exact HLO text is compiler-version brittle; these assert the structural
+invariants instead: which ops the lowered module must (and must not)
+contain.
+"""
+
+import numpy as np
+
+from horaedb_tpu.ops import filter as filter_ops
+from horaedb_tpu.storage.read import _build_scan_kernel
+
+
+def lower_scan_kernel(template=None, do_dedup=True, n=1024):
+    import jax.numpy as jnp
+
+    cols = {
+        "pk": jnp.zeros(n, jnp.int64),
+        "__seq__": jnp.zeros(n, jnp.uint64),
+        "value": jnp.zeros(n, jnp.float64),
+    }
+    kernel = _build_scan_kernel(
+        ("pk", "__seq__", "value"), ("pk", "__seq__"), ("pk",), template, do_dedup
+    )
+    lits = ()
+    if template is not None:
+        _, raw = filter_ops.split_literals(filter_ops.Compare("value", "gt", 0.0))
+        lits = filter_ops.literal_arrays(
+            template, raw, {k: np.dtype(v.dtype) for k, v in cols.items()}
+        )
+    return kernel.lower(cols, lits, 10).as_text()
+
+
+class TestScanKernelPlanShape:
+    def test_contains_one_fused_sort_and_no_scatter(self):
+        """The scan is a sort-based merge: exactly one sort over the block,
+        and NO scatter ops (scatters are the serial op the design avoids on
+        the scan path)."""
+        hlo = lower_scan_kernel()
+        assert hlo.count("stablehlo.sort") == 1, hlo.count("stablehlo.sort")
+        assert "stablehlo.scatter" not in hlo
+        # dedup mask algebra compiles to compares/selects, not loops
+        assert "while" not in hlo
+
+    def test_predicate_fuses_into_the_same_module(self):
+        template, _ = filter_ops.split_literals(filter_ops.Compare("value", "gt", 0.0))
+        hlo = lower_scan_kernel(template=template)
+        assert hlo.count("stablehlo.sort") == 1
+        assert "stablehlo.compare" in hlo
+        assert "stablehlo.scatter" not in hlo
+
+    def test_append_mode_skips_dedup_ops(self):
+        hlo_dedup = lower_scan_kernel(do_dedup=True)
+        hlo_plain = lower_scan_kernel(do_dedup=False)
+        # append mode (no dedup) lowers to strictly less work
+        assert len(hlo_plain) < len(hlo_dedup)
+
+
+class TestAggregatePlanShape:
+    def test_downsample_uses_exactly_two_scatters_without_minmax(self):
+        """The mean-downsample kernel pays exactly 2 scatter-adds (sum,
+        count); min/max add two more — the scatter budget IS the perf model
+        (scatters ~9ns/row on v5e, everything else is bandwidth)."""
+        import jax
+        from jax.sharding import Mesh
+
+        from horaedb_tpu.parallel.scan import build_sharded_downsample
+
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("rows", "series"))
+        n = 4096
+        args = (
+            np.zeros(n, np.int32), np.zeros(n, np.int32),
+            np.zeros(n, np.float32), np.ones(n, bool),
+            (), np.int32(0), np.int32(1000),
+        )
+        lean = build_sharded_downsample(mesh, 8, 4, None, False).lower(*args).as_text()
+        full = build_sharded_downsample(mesh, 8, 4, None, True).lower(*args).as_text()
+        # count the op uses ('"stablehlo.scatter"('): the attribute
+        # #stablehlo.scatter<...> would double-count each op
+        assert lean.count('"stablehlo.scatter"') == 2, lean.count('"stablehlo.scatter"')
+        assert full.count('"stablehlo.scatter"') == 4, full.count('"stablehlo.scatter"')
