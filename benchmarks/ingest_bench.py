@@ -1,0 +1,90 @@
+"""End-to-end ingest throughput: remote-write payload -> parse -> id
+resolution -> sorted SST writes, through the full MetricEngine.
+
+Usage: python benchmarks/ingest_bench.py [n_payloads]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    import jax
+
+    import os
+    want = os.environ.get("HORAEDB_JAX_PLATFORM") or os.environ.get("JAX_PLATFORMS")
+    if want and "," not in want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:  # noqa: BLE001
+            pass
+
+    import random
+
+    from horaedb_tpu.engine import MetricEngine
+    from horaedb_tpu.ingest import ParserPool
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.pb import remote_write_pb2
+
+    n_payloads = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+
+    def make_payload(seed: int) -> bytes:
+        """Realistic remote-write shape: timestamps cluster near 'now' (a
+        scrape interval apart), all landing in one or two segments."""
+        rng = random.Random(seed)
+        base = 1_700_000_000_000 + seed * 10_000
+        req = remote_write_pb2.WriteRequest()
+        for s in range(200):
+            ts = req.timeseries.add()
+            for k, v in (
+                (b"__name__", f"metric_{s % 20}".encode()),
+                (b"host", f"host-{s:04d}".encode()),
+                (b"region", b"us-east-1"),
+            ):
+                lab = ts.labels.add()
+                lab.name = k
+                lab.value = v
+            for i in range(10):
+                smp = ts.samples.add()
+                smp.value = rng.normalvariate(0, 100)
+                smp.timestamp = base + i * 1000
+        return req.SerializeToString()
+
+    async def run() -> dict:
+        store = LocalStore(tempfile.mkdtemp(prefix="ingest_"))
+        eng = await MetricEngine.open("db", store, enable_compaction=False)
+        pool = ParserPool()
+        payloads = [make_payload(s) for s in range(n_payloads)]
+        # warm (registers series, compiles the write-path sort)
+        await eng.write_parsed(await pool.decode(payloads[0]))
+
+        samples = 0
+        start = time.perf_counter()
+        for p in payloads:
+            parsed = await pool.decode(p)
+            samples += await eng.write_parsed(parsed)
+        elapsed = time.perf_counter() - start
+        await eng.close()
+        return {
+            "bench": "engine_ingest",
+            "payloads": n_payloads,
+            "payload_bytes": len(payloads[0]),
+            "samples": samples,
+            "seconds": round(elapsed, 3),
+            "samples_per_sec": round(samples / elapsed),
+            "platform": jax.devices()[0].platform,
+        }
+
+    print(json.dumps(asyncio.run(run())))
+
+
+if __name__ == "__main__":
+    main()
