@@ -82,6 +82,7 @@ class CompactionScheduler:
             )
             for t in pending:
                 t.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
             self.pick_once()
 
     def pick_once(self) -> bool:

@@ -112,6 +112,7 @@ class ManifestMerger:
             )
             for t in pending:
                 t.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
             for t in done:
                 with_exc = t.exception()
                 if with_exc is not None and not isinstance(with_exc, asyncio.CancelledError):
