@@ -36,6 +36,9 @@ class QueryRequest:
     start_ms: int
     end_ms: int
     filters: list[tuple[bytes, bytes]] = field(default_factory=list)
+    # Prometheus-style extended matchers: (key, op, pattern) with op in
+    # "ne" (!=), "re" (=~ full match), "nre" (!~)
+    matchers: list[tuple[bytes, str, bytes]] = field(default_factory=list)
     bucket_ms: int | None = None  # None -> raw rows
 
 
@@ -178,20 +181,33 @@ class MetricEngine:
             await self.exemplars_table.write(StorageWrite(batch, TimeRange(lo, hi)))
 
     # -- query path -------------------------------------------------------------
-    def _resolve_query(self, metric: bytes, filters) -> tuple[int, list | None] | None:
+    def _resolve_query(
+        self, metric: bytes, filters, matchers=None
+    ) -> tuple[int, list | None] | None:
         """Shared lookup prologue: metric id + TSID candidates, or None when
         the metric is unknown / no series matches the filters."""
         hit = self.metric_mgr.get(metric)
         if hit is None:
             return None
-        tsids = self.index_mgr.find_tsids(hit[0], filters)
+        tsids = self.index_mgr.find_tsids(hit[0], filters, matchers)
         if tsids == []:
             return None
         return hit[0], tsids
 
+    async def _resolve_query_async(self, req: QueryRequest):
+        """Regex matchers evaluate in a worker thread: Python re has no
+        linear-time guarantee and must not stall the event loop."""
+        import asyncio
+
+        if req.matchers:
+            return await asyncio.to_thread(
+                self._resolve_query, req.metric, req.filters, req.matchers
+            )
+        return self._resolve_query(req.metric, req.filters, req.matchers)
+
     async def query(self, req: QueryRequest):
         """Raw rows (bucket_ms None) or downsample grids per series."""
-        resolved = self._resolve_query(req.metric, req.filters)
+        resolved = await self._resolve_query_async(req)
         if resolved is None:
             return None
         metric_id, tsids = resolved
@@ -207,7 +223,7 @@ class MetricEngine:
 
     async def query_exemplars(self, req: QueryRequest):
         """Raw exemplar rows (incl. their labels) for a metric."""
-        resolved = self._resolve_query(req.metric, req.filters)
+        resolved = await self._resolve_query_async(req)
         if resolved is None:
             return None
         metric_id, tsids = resolved

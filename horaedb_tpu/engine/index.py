@@ -31,6 +31,11 @@ from horaedb_tpu.storage.types import TimeRange
 
 _ALL_TIME = TimeRange(-(2**62), 2**62)
 
+# Python `re` lacks RE2's linear-time guarantee; bounding pattern size limits
+# the blast radius of untrusted matcher patterns (the evaluation also runs
+# off the event loop, engine.py::_resolve_query_async).
+MAX_REGEX_LEN = 512
+
 
 class IndexManager:
     def __init__(self, series_storage, index_storage, segment_duration_ms: int):
@@ -124,20 +129,69 @@ class IndexManager:
 
     # -- query path ------------------------------------------------------------
     def find_tsids(
-        self, metric_id: int, filters: list[tuple[bytes, bytes]]
+        self,
+        metric_id: int,
+        filters: list[tuple[bytes, bytes]],
+        matchers: "list[tuple[bytes, str, bytes]] | None" = None,
     ) -> list[SeriesId] | None:
-        """TSIDs matching ALL tag filters; None means 'no tag filter' (caller
+        """TSIDs matching ALL tag filters; None means 'no constraint' (caller
         scans the whole metric). Posting lists verify raw bytes to reject
-        hash collisions."""
-        if not filters:
+        hash collisions.
+
+        `matchers` extends equality with Prometheus-style ops per
+        (key, op, pattern): "ne" (!=), "re" (=~ full-match), "nre" (!~).
+        Non-equality matchers evaluate against the metric's own postings
+        (O(one metric), the RFC's two-step fallback shape)."""
+        if not filters and not matchers:
             return None
         result: set[int] | None = None
+
+        def intersect(matched: set[int]) -> bool:
+            nonlocal result
+            result = matched if result is None else (result & matched)
+            return bool(result)
+
         for k, v in filters:
             h = tag_hash_of(k, v)
             posting = self._postings.get((metric_id, h), {})
-            matched = {t for t, kv in posting.items() if kv == (k, v)}
-            result = matched if result is None else (result & matched)
-            if not result:
+            if not intersect({t for t, kv in posting.items() if kv == (k, v)}):
+                return []
+        all_tsids: set[int] | None = None
+        if matchers:
+            all_tsids = set(self.series_of(metric_id))
+        for k, op, pattern in matchers or ():
+            # value per tsid for this key; Prometheus semantics: an absent
+            # label reads as the empty string for both =~ and !~
+            values: dict[int, bytes] = {}
+            for pk in self._metric_postings.get(metric_id, ()):
+                for tsid, (kk, vv) in self._postings[pk].items():
+                    if kk == k:
+                        values[tsid] = vv
+            if op == "ne":
+                matched = {t for t in all_tsids if values.get(t, b"") != pattern}
+            elif op in ("re", "nre"):
+                import re as _re
+
+                from horaedb_tpu.common.error import HoraeError
+
+                if len(pattern) > MAX_REGEX_LEN:
+                    raise HoraeError(
+                        f"regex matcher too long ({len(pattern)} > {MAX_REGEX_LEN})"
+                    )
+                try:
+                    rx = _re.compile(pattern.decode(errors="replace"))
+                except _re.error as e:
+                    raise HoraeError(f"bad regex matcher {pattern!r}: {e}") from e
+                hit = {
+                    t for t in all_tsids
+                    if rx.fullmatch(values.get(t, b"").decode(errors="replace"))
+                }
+                matched = hit if op == "re" else (all_tsids - hit)
+            else:
+                from horaedb_tpu.common.error import HoraeError
+
+                raise HoraeError(f"unknown matcher op: {op!r}")
+            if not intersect(matched):
                 return []
         return sorted(result)
 

@@ -199,11 +199,26 @@ async def handle_query(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     try:
         q = await request.json()
+        matchers = []
+        raw_matchers = q.get("matchers", [])
+        if isinstance(raw_matchers, dict):
+            # convenience form {"host": {"op": "re", "pattern": "web.*"}} —
+            # one matcher per key only
+            raw_matchers = [
+                {"key": k, **spec} for k, spec in raw_matchers.items()
+            ]
+        for spec in raw_matchers:
+            # canonical list form supports several matchers on one label:
+            # [{"key": "host", "op": "re", "pattern": "web.*"}, ...]
+            matchers.append(
+                (spec["key"].encode(), spec["op"], spec["pattern"].encode())
+            )
         req = QueryRequest(
             metric=q["metric"].encode(),
             start_ms=int(q["start_ms"]),
             end_ms=int(q["end_ms"]),
             filters=[(k.encode(), v.encode()) for k, v in q.get("filters", {}).items()],
+            matchers=matchers,
             bucket_ms=q.get("bucket_ms"),
         )
         limit = min(int(q.get("limit", 100_000)), 1_000_000)

@@ -227,6 +227,46 @@ class TestMetricEngine:
         await eng2.close()
 
     @async_test
+    async def test_extended_matchers(self):
+        """!=, =~, !~ matchers over the inverted index."""
+        store = MemStore()
+        eng = await open_engine(store)
+        payload = make_remote_write(
+            [
+                ({"__name__": "m", "host": f"web{i}", "dc": "a" if i < 2 else "b"},
+                 [(1000, float(i))])
+                for i in range(4)
+            ]
+        )
+        await eng.write_parsed(PooledParser.decode(payload))
+
+        async def values(**kw):
+            t = await eng.query(QueryRequest(metric=b"m", start_ms=0, end_ms=10_000, **kw))
+            return sorted(t.column("value").to_pylist()) if t is not None else []
+
+        assert await values(matchers=[(b"host", "re", b"web[01]")]) == [0.0, 1.0]
+        assert await values(matchers=[(b"host", "nre", b"web[01]")]) == [2.0, 3.0]
+        assert await values(matchers=[(b"dc", "ne", b"a")]) == [2.0, 3.0]
+        # combined with equality filter
+        assert await values(
+            filters=[(b"dc", b"b")], matchers=[(b"host", "re", b"web2")]
+        ) == [2.0]
+        # bad regex -> clear error
+        from horaedb_tpu.common.error import HoraeError
+
+        with pytest.raises(HoraeError, match="bad regex"):
+            await values(matchers=[(b"host", "re", b"([")])
+        # oversized pattern rejected (no-RE2 mitigation)
+        with pytest.raises(HoraeError, match="too long"):
+            await values(matchers=[(b"host", "re", b"a" * 1000)])
+        # absent label reads as empty string for =~ and !~ (Prometheus
+        # semantics): match-empty patterns include series lacking the key
+        assert await values(matchers=[(b"nope", "re", b".*")]) == [0.0, 1.0, 2.0, 3.0]
+        assert await values(matchers=[(b"nope", "re", b".+")]) == []
+        assert await values(matchers=[(b"nope", "nre", b".+")]) == [0.0, 1.0, 2.0, 3.0]
+        await eng.close()
+
+    @async_test
     async def test_exemplars_persisted_and_queryable(self):
         store = MemStore()
         eng = await open_engine(store)
