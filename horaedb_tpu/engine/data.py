@@ -32,9 +32,35 @@ MAX_BUCKETS = 100_000
 
 
 class SampleManager:
-    def __init__(self, storage, segment_duration_ms: int):
+    def __init__(self, storage, segment_duration_ms: int, buffer_rows: int = 0):
         self._storage = storage
         self._segment_duration = segment_duration_ms
+        # Opt-in ingest buffering (the RFC's own data-table design batches
+        # many samples per stored row, docs/rfcs/20240827-metric-engine.md
+        # :218-232): rows accumulate per segment and flush as ONE storage
+        # write when the total reaches buffer_rows. 0 = unbuffered — every
+        # persist() is immediately durable, matching the reference's
+        # write==SST contract (storage.rs:307-333). Buffered rows are NOT
+        # durable until flush; queries flush first so reads stay consistent.
+        self._buffer_rows = buffer_rows
+        self._buf: dict[int, list[tuple[np.ndarray, ...]]] = {}
+        # Dense-id chunk buffer: (metric_id, tsid) -> small dense int, plus
+        # per-request (dense-per-sample, ts, value) lanes. Flush
+        # counting-sorts by the pk rank of each dense id — O(n + k) — and
+        # emits batches already in pk order so the storage write's
+        # sortedness fast path skips its sort.
+        self._dense: dict[tuple[int, int], int] = {}
+        self._dense_keys: list[tuple[int, int]] = []
+        self._chunks: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self._buffered = 0
+        # Serializes flushes AND makes flush-before-query sound: a query's
+        # flush() awaits any in-flight flush (whose snapshot is not yet
+        # durable) before flushing the remainder.
+        self._flush_lock: "asyncio.Lock | None" = None
+
+    @property
+    def buffering(self) -> bool:
+        return self._buffer_rows > 0
 
     async def persist(
         self,
@@ -44,25 +70,155 @@ class SampleManager:
         values: np.ndarray,      # f64 per sample
     ) -> None:
         """One storage write per touched segment, rows sorted on device by
-        the write path."""
+        the write path (or buffered, see __init__)."""
         if len(ts) == 0:
             return
         seg = ts - (ts % self._segment_duration)
-        for seg_start in np.unique(seg):
-            m = seg == seg_start
-            batch = pa.RecordBatch.from_pydict(
-                {
-                    "metric_id": metric_ids[m].astype(np.uint64),
-                    "tsid": tsids[m].astype(np.uint64),
-                    "field_id": np.zeros(int(m.sum()), dtype=np.uint64),
-                    "ts": ts[m],
-                    "value": values[m],
-                },
-                schema=DATA_SCHEMA,
+        uniq = np.unique(seg)
+        for seg_start in uniq:
+            m = seg == seg_start if len(uniq) > 1 else slice(None)
+            if self._buffer_rows > 0:
+                chunk = (metric_ids[m], tsids[m], ts[m], values[m])
+                self._buf.setdefault(int(seg_start), []).append(chunk)
+                self._buffered += len(chunk[2])
+            else:
+                await self._write_segment(
+                    metric_ids[m], tsids[m], ts[m], values[m]
+                )
+        if self._buffer_rows > 0 and self._buffered >= self._buffer_rows:
+            await self.flush()
+
+    async def buffer_request(self, metric_arr, tsid_arr, req) -> None:
+        """Hash-lane buffered ingest: one dense-id dict probe per series,
+        then whole-request lanes append (no per-series slicing)."""
+        dense = self._dense
+        keys = self._dense_keys
+        mids = metric_arr.tolist()
+        tids = tsid_arr.tolist()
+        per_series = np.empty(len(mids), dtype=np.int64)
+        for s in range(len(mids)):
+            k = (mids[s], tids[s])
+            d = dense.get(k)
+            if d is None:
+                d = len(keys)
+                dense[k] = d
+                keys.append(k)
+            per_series[s] = d
+        ts = req.sample_ts
+        self._chunks.append((per_series[req.sample_series], ts, req.sample_value))
+        self._buffered += len(ts)
+        if self._buffered >= self._buffer_rows:
+            await self.flush()
+
+    async def flush(self) -> None:
+        """Write out all buffered segments (one storage write each).
+
+        Concurrency contract: buffers are snapshot-detached up front so rows
+        appended by other coroutines during the awaited writes land in fresh
+        buffers and are never dropped; on ANY write failure the snapshot is
+        merged back (dense ids remapped) before the error propagates, so
+        already-acked samples survive for a retrying flush. Partial
+        double-writes are safe: the storage merge dedups by pk + seq. The
+        flush lock serializes flushes, which also makes flush-before-query
+        sound (a query awaits in-flight, not-yet-durable snapshots)."""
+        import asyncio
+
+        if self._flush_lock is None:
+            self._flush_lock = asyncio.Lock()
+        async with self._flush_lock:
+            buf, self._buf = self._buf, {}
+            chunks, self._chunks = self._chunks, []
+            keys, self._dense_keys = self._dense_keys, []
+            self._dense = {}
+            snapshot_rows = sum(len(c[1]) for c in chunks) + sum(
+                len(c[2]) for lst in buf.values() for c in lst
             )
-            lo = int(ts[m].min())
-            hi = int(ts[m].max()) + 1
-            await self._storage.write(WriteRequest(batch, TimeRange(lo, hi)))
+            self._buffered -= snapshot_rows
+            try:
+                for _seg_start, cols_list in sorted(buf.items()):
+                    cols = [
+                        np.concatenate([c[i] for c in cols_list]) for i in range(4)
+                    ]
+                    await self._write_segment(*cols)
+                if chunks:
+                    await self._flush_chunks(chunks, keys)
+            except BaseException:
+                self._restore_snapshot(buf, chunks, keys, snapshot_rows)
+                raise
+
+    def _restore_snapshot(self, buf, chunks, keys, snapshot_rows: int) -> None:
+        """Merge a failed flush's snapshot back into the live buffers."""
+        for seg_start, lst in buf.items():
+            self._buf.setdefault(seg_start, []).extend(lst)
+        if chunks:
+            # dense ids in the snapshot refer to `keys`; remap them into the
+            # (possibly repopulated) live dense table
+            remap = np.empty(len(keys), dtype=np.int64)
+            for old_d, k in enumerate(keys):
+                new_d = self._dense.get(k)
+                if new_d is None:
+                    new_d = len(self._dense_keys)
+                    self._dense[k] = new_d
+                    self._dense_keys.append(k)
+                remap[old_d] = new_d
+            for dense_ps, ts, vals in chunks:
+                self._chunks.append((remap[dense_ps], ts, vals))
+        self._buffered += snapshot_rows
+
+    async def _flush_chunks(self, chunks, keys) -> None:
+        """Counting-sort the buffered lanes into pk order: rank the (few)
+        unique series keys, gather rank per sample, one stable O(n + k)
+        counting sort. Scrapes arrive in time order, so within a series the
+        chunk order already sorts ts — verified in O(n); only genuinely
+        out-of-order data pays a full lexsort."""
+        dense_ps = np.concatenate([c[0] for c in chunks])
+        ts = np.concatenate([c[1] for c in chunks])
+        vals = np.concatenate([c[2] for c in chunks])
+        k = len(keys)
+        key_arr = np.empty((k, 2), dtype=np.uint64)
+        for i, (m, t) in enumerate(keys):
+            key_arr[i, 0] = m
+            key_arr[i, 1] = t
+        order = np.lexsort((key_arr[:, 1], key_arr[:, 0]))  # rank over k keys
+        rank_of_dense = np.empty(k, dtype=np.int64)
+        rank_of_dense[order] = np.arange(k)
+        rank_ps = rank_of_dense[dense_ps].astype(np.int32)
+        # stable radix argsort over small int ranks (numpy uses radix for
+        # integer stable sorts — effectively linear, far cheaper than a
+        # 3-key u64 lexsort)
+        perm = np.argsort(rank_ps, kind="stable")
+        counts = np.bincount(rank_ps, minlength=k)  # indexed by rank
+        mid = key_arr[order, 0].repeat(counts)
+        tsid = key_arr[order, 1].repeat(counts)
+        ts = ts[perm]
+        vals = vals[perm]
+        # ts must be nondecreasing within each series group; a decrease is
+        # only legal exactly at a group boundary
+        dips = np.flatnonzero(np.diff(ts) < 0)
+        boundaries = np.cumsum(counts)[:-1] - 1
+        if np.setdiff1d(dips, boundaries).size:
+            perm2 = np.lexsort((ts, tsid, mid))
+            mid, tsid, ts, vals = mid[perm2], tsid[perm2], ts[perm2], vals[perm2]
+        seg = ts - (ts % self._segment_duration)
+        uniq = np.unique(seg)
+        for seg_start in uniq:
+            m = seg == seg_start if len(uniq) > 1 else slice(None)
+            await self._write_segment(mid[m], tsid[m], ts[m], vals[m])
+
+    async def _write_segment(self, metric_ids, tsids, ts, values) -> None:
+        batch = pa.RecordBatch.from_pydict(
+            {
+                "metric_id": np.ascontiguousarray(metric_ids, dtype=np.uint64),
+                "tsid": np.ascontiguousarray(tsids, dtype=np.uint64),
+                "field_id": np.zeros(len(ts), dtype=np.uint64),
+                "ts": np.ascontiguousarray(ts),
+                "value": np.ascontiguousarray(values),
+            },
+            schema=DATA_SCHEMA,
+        )
+        lo = int(ts.min())
+        hi = int(ts.max()) + 1
+        await self._storage.write(WriteRequest(batch, TimeRange(lo, hi)))
 
     # -- queries ---------------------------------------------------------------
     def _predicate(self, metric_id: int, tsids: list[int] | None, rng: TimeRange):
@@ -79,6 +235,8 @@ class SampleManager:
         self, metric_id: int, tsids: list[int] | None, rng: TimeRange
     ) -> pa.Table | None:
         """Materialized (merged, deduped) sample rows."""
+        if self._buffered:
+            await self.flush()
         batches = []
         async for b in self._storage.scan(
             ScanRequest(range=rng, predicate=self._predicate(metric_id, tsids, rng))
@@ -106,6 +264,8 @@ class SampleManager:
         is sized by the series actually present in range."""
         from horaedb_tpu.common.error import ensure
 
+        if self._buffered:
+            await self.flush()
         n_buckets = -(-(rng.end - rng.start) // bucket_ms)
         ensure(
             n_buckets <= MAX_BUCKETS,

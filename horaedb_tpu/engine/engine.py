@@ -54,7 +54,11 @@ class MetricEngine:
         segment_duration_ms: int = DEFAULT_SEGMENT_MS,
         config: StorageConfig | None = None,
         enable_compaction: bool = True,
+        ingest_buffer_rows: int = 0,
     ) -> "MetricEngine":
+        """`ingest_buffer_rows` > 0 buffers data-table rows across writes
+        and flushes as one SST per segment when the threshold is reached
+        (see SampleManager.__init__ for the durability trade-off)."""
         self = object.__new__(cls)
         self._store = store
         self._segment_duration = segment_duration_ms
@@ -88,13 +92,20 @@ class MetricEngine:
 
         self.metric_mgr = MetricManager(self.metrics_table, segment_duration_ms)
         self.index_mgr = IndexManager(self.series_table, self.index_table, segment_duration_ms)
-        self.sample_mgr = SampleManager(self.data_table, segment_duration_ms)
+        self.sample_mgr = SampleManager(
+            self.data_table, segment_duration_ms, buffer_rows=ingest_buffer_rows
+        )
         self.exemplar_mgr = SampleManager(self.exemplars_table, segment_duration_ms)
         await self.metric_mgr.open()
         await self.index_mgr.open()
         return self
 
+    async def flush(self) -> None:
+        """Flush any buffered ingest rows to durable SSTs."""
+        await self.sample_mgr.flush()
+
     async def close(self) -> None:
+        await self.flush()
         for t in (
             self.metrics_table,
             self.series_table,
@@ -106,9 +117,16 @@ class MetricEngine:
 
     # -- write path -----------------------------------------------------------
     async def write_parsed(self, req: ParsedWriteRequest) -> int:
-        """Ingest one decoded remote-write request; returns sample count."""
+        """Ingest one decoded remote-write request; returns sample count.
+
+        When the native parser supplied metric-id/tsid hash lanes
+        (ingest/types.py), id resolution is pure numpy + set probes — no
+        per-series label slicing or Python seahash (the reference hash
+        contract lives in C++, src/metric_engine/src/types.rs:18-41)."""
         if req.n_series == 0:
             return 0
+        if req.series_tsid is not None:
+            return await self._write_parsed_fast(req)
         ts_now = now_ms()
         # 1. metric names from __name__ labels
         names: list[bytes] = []
@@ -143,6 +161,47 @@ class MetricEngine:
             )
         # 4. exemplars -> exemplars table (with their labels: trace ids are
         # the entire point of exemplars)
+        if len(req.exemplar_value):
+            await self._persist_exemplars(req, metric_arr, tsid_arr)
+        return n
+
+    async def _write_parsed_fast(self, req: ParsedWriteRequest) -> int:
+        """Hash-lane write path: per-series ids come from the C++ parser."""
+        ts_now = now_ms()
+        name_len = req.series_name_len
+        if np.any(name_len < 0):
+            s = int(np.argmax(name_len < 0))
+            ensure(False, f"series {s} missing __name__ label")
+        metric_arr = req.series_metric_id
+        tsid_arr = req.series_tsid
+        # 1. register unseen metrics (rare after warmup)
+        new_ids = self.metric_mgr.unknown_ids(metric_arr)
+        if len(new_ids):
+            new_set = set(new_ids.tolist())
+            seen: dict[int, bytes] = {}
+            for s in range(req.n_series):
+                m = int(metric_arr[s])
+                if m in new_set and m not in seen:
+                    seen[m] = req.series_name(s)
+            ensure(all(seen.values()), "series missing __name__ label")
+            await self.metric_mgr.register_named(
+                list(seen.values()), list(seen.keys()), ts_now
+            )
+        # 2. register unseen series
+        await self.index_mgr.ensure_series_fast(
+            metric_arr, tsid_arr, req.series_key, ts_now
+        )
+        # 3. samples
+        n = req.n_samples
+        if n:
+            if self.sample_mgr.buffering:
+                await self.sample_mgr.buffer_request(metric_arr, tsid_arr, req)
+            else:
+                series_idx = req.sample_series
+                await self.sample_mgr.persist(
+                    metric_arr[series_idx], tsid_arr[series_idx],
+                    req.sample_ts, req.sample_value,
+                )
         if len(req.exemplar_value):
             await self._persist_exemplars(req, metric_arr, tsid_arr)
         return n

@@ -14,6 +14,7 @@ verifying the stored raw tag bytes.
 
 from __future__ import annotations
 
+import threading
 from collections import defaultdict
 
 import numpy as np
@@ -22,6 +23,7 @@ import pyarrow as pa
 from horaedb_tpu.engine.tables import INDEX_SCHEMA, SERIES_SCHEMA
 from horaedb_tpu.engine.types import (
     SeriesId,
+    decode_series_key,
     series_id_of,
     series_key_of,
     tag_hash_of,
@@ -35,6 +37,51 @@ _ALL_TIME = TimeRange(-(2**62), 2**62)
 # the blast radius of untrusted matcher patterns (the evaluation also runs
 # off the event loop, engine.py::_resolve_query_async).
 MAX_REGEX_LEN = 512
+# A regex matcher that would run against a label value longer than this
+# raises instead (never silently truncates — wrong matches are worse than a
+# loud error): sre backtracking cost grows with subject length and runs in C
+# holding the GIL, so a thread offload alone cannot contain it.
+MAX_REGEX_SUBJECT_LEN = 4096
+
+
+def _reject_catastrophic(pattern: str) -> None:
+    """Reject patterns with nested unbounded repeats (the `(a+)+b` shape):
+    sre backtracks exponentially on them while holding the GIL, freezing the
+    whole process, not just the worker thread. A parse-tree walk catches the
+    common catastrophic shapes; the length caps bound what slips through."""
+    import re._parser as sre_parse
+
+    from horaedb_tpu.common.error import HoraeError
+
+    def walk(items, in_repeat: bool) -> None:
+        for op, arg in items:
+            name = str(op)
+            if name in ("MAX_REPEAT", "MIN_REPEAT"):
+                _lo, hi, sub = arg
+                unbounded = hi is sre_parse.MAXREPEAT or hi >= 1 << 16
+                # a counted outer repeat like (a+){2,100} backtracks
+                # combinatorially too: any repeat wider than a few counts
+                # as repeat context
+                repeatish = unbounded or hi > 10
+                if in_repeat and repeatish:
+                    raise HoraeError(
+                        "regex matcher rejected: nested wide repetition "
+                        "(catastrophic backtracking risk)"
+                    )
+                walk(sub, in_repeat or repeatish)
+            elif name == "SUBPATTERN":
+                walk(arg[3], in_repeat)
+            elif name == "BRANCH":
+                for alt in arg[1]:
+                    walk(alt, in_repeat)
+            elif name in ("ASSERT", "ASSERT_NOT"):
+                walk(arg[1], in_repeat)
+
+    try:
+        tree = sre_parse.parse(pattern)
+    except Exception:  # noqa: BLE001 — compile() will surface the real error
+        return
+    walk(tree, False)
 
 
 class IndexManager:
@@ -48,6 +95,11 @@ class IndexManager:
         self._postings: dict[tuple[int, int], dict[int, tuple[bytes, bytes]]] = defaultdict(dict)
         # metric_id -> its posting keys (per-metric scans stay O(one metric))
         self._metric_postings: dict[int, set[tuple[int, int]]] = defaultdict(set)
+        # Guards the three structures above: queries run in worker threads
+        # (engine.py::_resolve_query_async) while ingest mutates on the event
+        # loop; iterating a mutating set/dict raises RuntimeError. Held only
+        # for in-memory access — never across awaits or regex evaluation.
+        self._mu = threading.Lock()
 
     async def open(self) -> None:
         async for batch in self._series.scan(ScanRequest(range=_ALL_TIME)):
@@ -95,12 +147,53 @@ class IndexManager:
             # index rows never land, silently dropping it from tag queries
             # after the client's retry (and from recovery after restart).
             await self._persist(new_series_rows, new_index_rows, now_ms)
-            for mid, tsid, _key in new_series_rows:
+            self._commit_rows(new_series_rows, new_index_rows)
+        return tsids
+
+    def _commit_rows(self, series_rows, index_rows) -> None:
+        """Apply persisted rows to the in-memory caches (under the lock —
+        queries read these structures from worker threads)."""
+        with self._mu:
+            for mid, tsid, _key in series_rows:
                 self._known.add((mid, tsid))
-            for mid, h, tsid, k, v in new_index_rows:
+            for mid, h, tsid, k, v in index_rows:
                 self._postings[(mid, h)][tsid] = (k, v)
                 self._metric_postings[mid].add((mid, h))
-        return tsids
+
+    async def ensure_series_fast(
+        self,
+        metric_ids: np.ndarray,  # u64 per series (native hash lanes)
+        tsids: np.ndarray,       # u64 per series
+        key_of,                  # series index -> canonical key bytes
+        now_ms: int,
+    ) -> None:
+        """Hash-lane fast path: ids and canonical keys were computed by the
+        native parser; only genuinely new series pay Python-object costs
+        (key decode + posting rows). The Python seahash remains the
+        differential oracle in tests, per the reference hash contract
+        (src/metric_engine/src/types.rs:18-41)."""
+        known = self._known
+        new_idx: list[int] = []
+        staged: set[tuple[int, int]] = set()
+        for i, (m, t) in enumerate(zip(metric_ids.tolist(), tsids.tolist())):
+            if (m, t) in known or (m, t) in staged:
+                continue
+            staged.add((m, t))
+            new_idx.append(i)
+        if not new_idx:
+            return
+        mids = metric_ids.tolist()
+        tids = tsids.tolist()
+        new_series_rows: list[tuple[int, int, bytes]] = []
+        new_index_rows: list[tuple[int, int, int, bytes, bytes]] = []
+        for i in new_idx:
+            key = key_of(i)
+            new_series_rows.append((mids[i], tids[i], key))
+            for k, v in decode_series_key(key):
+                new_index_rows.append((mids[i], tag_hash_of(k, v), tids[i], k, v))
+        # persist-before-cache, same reasoning as populate_series_ids
+        await self._persist(new_series_rows, new_index_rows, now_ms)
+        self._commit_rows(new_series_rows, new_index_rows)
 
     async def _persist(self, series_rows, index_rows, now_ms: int) -> None:
         seg_start = now_ms - now_ms % self._segment_duration
@@ -151,22 +244,33 @@ class IndexManager:
             result = matched if result is None else (result & matched)
             return bool(result)
 
-        for k, v in filters:
-            h = tag_hash_of(k, v)
-            posting = self._postings.get((metric_id, h), {})
-            if not intersect({t for t, kv in posting.items() if kv == (k, v)}):
-                return []
-        all_tsids: set[int] | None = None
-        if matchers:
-            all_tsids = set(self.series_of(metric_id))
-        for k, op, pattern in matchers or ():
-            # value per tsid for this key; Prometheus semantics: an absent
-            # label reads as the empty string for both =~ and !~
-            values: dict[int, bytes] = {}
-            for pk in self._metric_postings.get(metric_id, ()):
-                for tsid, (kk, vv) in self._postings[pk].items():
-                    if kk == k:
-                        values[tsid] = vv
+        # Structure access happens under the lock (this runs in a worker
+        # thread while ingest mutates on the event loop); regex evaluation
+        # happens on snapshots after release.
+        matcher_values: list[dict[int, bytes]] = []
+        with self._mu:
+            for k, v in filters:
+                h = tag_hash_of(k, v)
+                posting = self._postings.get((metric_id, h), {})
+                if not intersect({t for t, kv in posting.items() if kv == (k, v)}):
+                    return []
+            all_tsids: set[int] | None = None
+            if matchers:
+                all_tsids = {t for m, t in self._known if m == metric_id}
+                # one O(postings) pass collects values for every matcher key
+                # (the lock blocks event-loop ingest while held — don't
+                # re-walk the postings per matcher). Prometheus semantics:
+                # an absent label reads as empty for both =~ and !~.
+                wanted = {k for k, _op, _p in matchers}
+                values_by_key: dict[bytes, dict[int, bytes]] = {
+                    k: {} for k in wanted
+                }
+                for pk in self._metric_postings.get(metric_id, ()):
+                    for tsid, (kk, vv) in self._postings[pk].items():
+                        if kk in wanted:
+                            values_by_key[kk][tsid] = vv
+                matcher_values = [values_by_key[k] for k, _op, _p in matchers]
+        for (k, op, pattern), values in zip(matchers or (), matcher_values):
             if op == "ne":
                 matched = {t for t in all_tsids if values.get(t, b"") != pattern}
             elif op in ("re", "nre"):
@@ -178,14 +282,24 @@ class IndexManager:
                     raise HoraeError(
                         f"regex matcher too long ({len(pattern)} > {MAX_REGEX_LEN})"
                     )
+                decoded = pattern.decode(errors="replace")
+                _reject_catastrophic(decoded)
                 try:
-                    rx = _re.compile(pattern.decode(errors="replace"))
+                    rx = _re.compile(decoded)
                 except _re.error as e:
                     raise HoraeError(f"bad regex matcher {pattern!r}: {e}") from e
-                hit = {
-                    t for t in all_tsids
-                    if rx.fullmatch(values.get(t, b"").decode(errors="replace"))
-                }
+
+                def subject(t: int) -> str:
+                    raw = values.get(t, b"")
+                    if len(raw) > MAX_REGEX_SUBJECT_LEN:
+                        raise HoraeError(
+                            f"label value too long for regex matcher "
+                            f"({len(raw)} > {MAX_REGEX_SUBJECT_LEN} bytes); "
+                            f"use equality filters for this label"
+                        )
+                    return raw.decode(errors="replace")
+
+                hit = {t for t in all_tsids if rx.fullmatch(subject(t))}
                 matched = hit if op == "re" else (all_tsids - hit)
             else:
                 from horaedb_tpu.common.error import HoraeError
@@ -197,26 +311,29 @@ class IndexManager:
 
     def series_of(self, metric_id: int) -> list[SeriesId]:
         """All known TSIDs of a metric (the no-tag-filter downsample scope)."""
-        return sorted(t for m, t in self._known if m == metric_id)
+        with self._mu:
+            return sorted(t for m, t in self._known if m == metric_id)
 
     def label_values(self, metric_id: int, key: bytes) -> list[bytes]:
         """LabelValues via the inverted index (the RFC's two-step fallback,
         RFC :120-130)."""
         out = set()
-        for pk in self._metric_postings.get(metric_id, ()):
-            for kv in self._postings[pk].values():
-                if kv[0] == key:
-                    out.add(kv[1])
+        with self._mu:
+            for pk in self._metric_postings.get(metric_id, ()):
+                for kv in self._postings[pk].values():
+                    if kv[0] == key:
+                        out.add(kv[1])
         return sorted(out)
 
     def series_labels(self, metric_id: int) -> dict[int, dict[bytes, bytes]]:
         """tsid -> label map for every series of a metric, including series
         with no tags at all (seeded from the known-series set so tagless
         series don't vanish from listings)."""
-        per_tsid: dict[int, dict[bytes, bytes]] = {
-            t: {} for m, t in self._known if m == metric_id
-        }
-        for pk in self._metric_postings.get(metric_id, ()):
-            for tsid, (k, v) in self._postings[pk].items():
-                per_tsid.setdefault(tsid, {})[k] = v
+        with self._mu:
+            per_tsid: dict[int, dict[bytes, bytes]] = {
+                t: {} for m, t in self._known if m == metric_id
+            }
+            for pk in self._metric_postings.get(metric_id, ()):
+                for tsid, (k, v) in self._postings[pk].items():
+                    per_tsid.setdefault(tsid, {})[k] = v
         return per_tsid

@@ -26,6 +26,8 @@ class MetricManager:
         self._segment_duration = segment_duration_ms
         # name -> (metric_id, field_id); write-through cache over the table
         self._cache: dict[bytes, tuple[int, int]] = {}
+        # id-keyed view of the same cache for the hash-lane fast path
+        self._known_ids: set[int] = set()
 
     async def open(self) -> None:
         async for batch in self._storage.scan(
@@ -36,6 +38,7 @@ class MetricManager:
             fids = batch.column("field_id").to_pylist()
             for n, m, f in zip(names, mids, fids):
                 self._cache[n] = (m, f)
+                self._known_ids.add(m)
 
     def get(self, name: bytes) -> tuple[int, int] | None:
         return self._cache.get(name)
@@ -61,6 +64,20 @@ class MetricManager:
             await self._persist(sorted(set(new)), out, now_ms)
         return out
 
+    def unknown_ids(self, metric_ids) -> "np.ndarray":
+        """Unique metric ids not yet registered (hash-lane fast path: the
+        ids were already seahashed by the native parser)."""
+        uniq = np.unique(np.asarray(metric_ids, dtype=np.uint64))
+        known = self._known_ids
+        return np.asarray([m for m in uniq.tolist() if m not in known], dtype=np.uint64)
+
+    async def register_named(self, names: list[bytes], ids: list[int], now_ms: int) -> None:
+        """Register metrics whose ids are precomputed (native hash lanes);
+        id == seahash(name) is the contract both sides share."""
+        fresh = sorted({n for n in names if n not in self._cache})
+        if fresh:
+            await self._persist(fresh, dict(zip(names, ids)), now_ms)
+
     async def _persist(self, new_names: list[bytes], ids: dict[bytes, int], now_ms: int) -> None:
         n = len(new_names)
         field_id = 0
@@ -80,3 +97,4 @@ class MetricManager:
         )
         for name in new_names:
             self._cache[name] = (ids[name], field_id)
+            self._known_ids.add(ids[name])

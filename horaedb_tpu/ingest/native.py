@@ -27,6 +27,11 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+# Must match rw_abi_version() in remote_write_parser.cc; a stale committed
+# or leftover .so is rebuilt instead of silently shadowing the source.
+_ABI_VERSION = 2
+
+
 class _RwResult(ctypes.Structure):
     _fields_ = [
         ("n_series", ctypes.c_int64),
@@ -61,48 +66,104 @@ class _RwResult(ctypes.Structure):
     ]
 
 
-def _build() -> bool:
+class _RwHashResult(ctypes.Structure):
+    _fields_ = [
+        ("series_metric_id", ctypes.POINTER(ctypes.c_uint64)),
+        ("series_tsid", ctypes.POINTER(ctypes.c_uint64)),
+        ("series_name_off", ctypes.POINTER(ctypes.c_int64)),
+        ("series_name_len", ctypes.POINTER(ctypes.c_int64)),
+        ("series_key_off", ctypes.POINTER(ctypes.c_int64)),
+        ("series_key_len", ctypes.POINTER(ctypes.c_int64)),
+        ("key_arena", ctypes.POINTER(ctypes.c_uint8)),
+        ("key_arena_len", ctypes.c_int64),
+    ]
+
+
+def _build(force: bool = False) -> bool:
     try:
-        subprocess.run(
-            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        cmd = ["make", "-C", os.path.abspath(_NATIVE_DIR)]
+        if force:
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR), "clean"],
+                check=True, capture_output=True, timeout=30,
+            )
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return os.path.exists(_SO_PATH)
     except Exception as e:  # noqa: BLE001
         logger.warning("native parser build failed: %s", e)
         return False
 
 
+def _try_load():
+    lib = ctypes.CDLL(_SO_PATH)
+    try:
+        lib.rw_abi_version.restype = ctypes.c_int
+        version = lib.rw_abi_version()
+    except AttributeError:
+        version = 0
+    if version != _ABI_VERSION:
+        logger.warning(
+            "native parser .so has ABI v%s, want v%s — rebuilding", version, _ABI_VERSION
+        )
+        return None
+    lib.rw_parser_new.restype = ctypes.c_void_p
+    lib.rw_parser_free.argtypes = [ctypes.c_void_p]
+    lib.rw_parse.restype = ctypes.c_int
+    lib.rw_parse.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(_RwResult),
+    ]
+    lib.rw_parse_hashed.restype = ctypes.c_int
+    lib.rw_parse_hashed.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(_RwResult),
+        ctypes.POINTER(_RwHashResult),
+    ]
+    return lib
+
+
 def load():
-    """Load (building if needed) the native library; None if unavailable."""
+    """Load (building if needed) the native library; None if unavailable.
+
+    The .so is never committed (supply-chain hygiene): it auto-builds from
+    remote_write_parser.cc, and an existing binary whose `rw_abi_version`
+    mismatches this binding is discarded and rebuilt from source.
+    """
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
         if not os.path.exists(_SO_PATH) and not _build():
             return None
-        lib = ctypes.CDLL(_SO_PATH)
-        lib.rw_parser_new.restype = ctypes.c_void_p
-        lib.rw_parser_free.argtypes = [ctypes.c_void_p]
-        lib.rw_parse.restype = ctypes.c_int
-        lib.rw_parse.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char_p,
-            ctypes.c_uint64,
-            ctypes.POINTER(_RwResult),
-        ]
+        lib = None
+        try:
+            lib = _try_load()
+        except OSError as e:
+            logger.warning("native parser load failed: %s", e)
+        if lib is None:
+            if not _build(force=True):
+                return None
+            try:
+                lib = _try_load()
+            except OSError as e:
+                logger.warning("native parser load failed after rebuild: %s", e)
+                return None
         _lib = lib
         return _lib
 
 
 def _as_np(ptr, n: int, dtype) -> np.ndarray:
     """Copy an arena lane out into a standalone numpy array (the arena is
-    reused by the next parse on the same handle)."""
+    reused by the next parse on the same handle). string_at is one C memcpy;
+    frombuffer wraps it zero-copy (readonly, which downstream respects)."""
     if n == 0:
         return np.empty(0, dtype=dtype)
-    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+    dt = np.dtype(dtype)
+    return np.frombuffer(ctypes.string_at(ptr, n * dt.itemsize), dtype=dt)
 
 
 class NativeParser:
@@ -123,7 +184,10 @@ class NativeParser:
 
     def parse(self, payload: bytes) -> ParsedWriteRequest:
         res = _RwResult()
-        rc = self._lib.rw_parse(self._h, payload, len(payload), ctypes.byref(res))
+        hres = _RwHashResult()
+        rc = self._lib.rw_parse_hashed(
+            self._h, payload, len(payload), ctypes.byref(res), ctypes.byref(hres)
+        )
         if rc != 0:
             raise HoraeError("malformed remote-write payload")
         ns, nl = res.n_series, res.n_labels
@@ -153,4 +217,13 @@ class NativeParser:
             meta_type=_as_np(res.meta_type, nmd, np.int64),
             meta_name_off=_as_np(res.meta_name_off, nmd, np.int64),
             meta_name_len=_as_np(res.meta_name_len, nmd, np.int64),
+            series_metric_id=_as_np(hres.series_metric_id, ns, np.uint64),
+            series_tsid=_as_np(hres.series_tsid, ns, np.uint64),
+            series_name_off=_as_np(hres.series_name_off, ns, np.int64),
+            series_name_len=_as_np(hres.series_name_len, ns, np.int64),
+            series_key_off=_as_np(hres.series_key_off, ns, np.int64),
+            series_key_len=_as_np(hres.series_key_len, ns, np.int64),
+            key_arena=ctypes.string_at(hres.key_arena, hres.key_arena_len)
+            if hres.key_arena_len
+            else b"",
         )

@@ -50,6 +50,18 @@ class ParsedWriteRequest:
     meta_name_off: np.ndarray
     meta_name_len: np.ndarray
 
+    # Metric-engine id lanes (native parser only; None from the pure-Python
+    # fallback): per-series seahash ids + the canonical sorted series key
+    # materialized into key_arena (reference hash contract:
+    # src/metric_engine/src/types.rs:18-41).
+    series_metric_id: np.ndarray | None = None  # uint64 [n_series]
+    series_tsid: np.ndarray | None = None       # uint64 [n_series]
+    series_name_off: np.ndarray | None = None   # __name__ value slice
+    series_name_len: np.ndarray | None = None   # -1 = missing __name__
+    series_key_off: np.ndarray | None = None    # into key_arena
+    series_key_len: np.ndarray | None = None
+    key_arena: bytes = b""
+
     @property
     def n_series(self) -> int:
         return len(self.series_label_start)
@@ -84,3 +96,16 @@ class ParsedWriteRequest:
     def meta_name(self, i: int) -> bytes:
         o, l = int(self.meta_name_off[i]), int(self.meta_name_len[i])
         return self.payload[o : o + l]
+
+    def series_name(self, s: int) -> bytes:
+        """__name__ label value of series `s` (hash-lane fast path only)."""
+        n = int(self.series_name_len[s])
+        if n < 0:
+            return b""
+        o = int(self.series_name_off[s])
+        return self.payload[o : o + n]
+
+    def series_key(self, s: int) -> bytes:
+        """Canonical sorted series key of series `s` (hash-lane fast path)."""
+        o, l = int(self.series_key_off[s]), int(self.series_key_len[s])
+        return self.key_arena[o : o + l]

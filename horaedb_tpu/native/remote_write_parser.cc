@@ -19,11 +19,58 @@
 //
 // Build: make -C horaedb_tpu/native   (g++ -O3 -shared -fPIC)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// SeaHash (the metric-engine id hash, reference
+// src/metric_engine/src/types.rs:18-41: name_id = hash(name), series_id =
+// hash(sorted labels), hash = seahash). Port of the public portable
+// algorithm; conformance is pinned against the Python oracle
+// (horaedb_tpu/engine/types.py::seahash, itself pinned to the crate's
+// documented test vector) by tests/test_ingest.py.
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t kSeaP = 0x6EED0E9DA4D94A4FULL;
+constexpr uint64_t kSeaSeeds[4] = {
+    0x16F11FE89B0D677CULL, 0xB480A793D8E6C86CULL,
+    0x6FE2E5AAF078EBC9ULL, 0x14F994A4C5259381ULL};
+
+inline uint64_t sea_diffuse(uint64_t x) {
+  x *= kSeaP;
+  x ^= (x >> 32) >> (x >> 60);
+  x *= kSeaP;
+  return x;
+}
+
+uint64_t seahash(const uint8_t* data, size_t n) {
+  uint64_t lanes[4] = {kSeaSeeds[0], kSeaSeeds[1], kSeaSeeds[2], kSeaSeeds[3]};
+  size_t full = n & ~static_cast<size_t>(7);
+  size_t i = 0;
+  int lane = 0;
+  for (; i < full; i += 8) {
+    uint64_t chunk;
+    std::memcpy(&chunk, data + i, 8);  // little-endian hosts only
+    lanes[lane] = sea_diffuse(lanes[lane] ^ chunk);
+    lane = (lane + 1) & 3;
+  }
+  if (i < n) {
+    uint8_t tail[8] = {0};
+    std::memcpy(tail, data + i, n - i);
+    uint64_t chunk;
+    std::memcpy(&chunk, tail, 8);
+    lanes[lane] = sea_diffuse(lanes[lane] ^ chunk);
+  }
+  uint64_t a = lanes[0] ^ lanes[1];
+  uint64_t c = lanes[2] ^ lanes[3];
+  a ^= c;
+  a ^= static_cast<uint64_t>(n);
+  return sea_diffuse(a);
+}
 
 // ---------------------------------------------------------------------------
 // wire reading
@@ -128,6 +175,15 @@ struct Parser {
   std::vector<int64_t> meta_type;
   std::vector<int64_t> meta_name_off, meta_name_len;
 
+  // metric-engine id lanes (filled by compute_hashes, not the wire parse):
+  // per-series metric_id/tsid seahashes, the __name__ value slice, and the
+  // canonical sorted series key materialized into key_arena
+  std::vector<uint64_t> series_metric_id, series_tsid;
+  std::vector<int64_t> series_name_off, series_name_len;  // -1 len = missing
+  std::vector<int64_t> series_key_off, series_key_len;    // into key_arena
+  std::vector<uint8_t> key_arena;
+  std::vector<int32_t> sort_buf;  // scratch: label indices being sorted
+
   void clear() {  // keeps capacity: the pooled-reuse contract
     series_label_start.clear(); series_label_count.clear();
     series_sample_start.clear(); series_sample_count.clear();
@@ -139,8 +195,99 @@ struct Parser {
     ex_label_name_off.clear(); ex_label_name_len.clear();
     ex_label_value_off.clear(); ex_label_value_len.clear();
     meta_type.clear(); meta_name_off.clear(); meta_name_len.clear();
+    series_metric_id.clear(); series_tsid.clear();
+    series_name_off.clear(); series_name_len.clear();
+    series_key_off.clear(); series_key_len.clear();
+    key_arena.clear();
   }
 };
+
+// bytes-compare with Python `sorted()` semantics: memcmp on the common
+// prefix, shorter wins ties
+inline int bytes_cmp(const uint8_t* a, int64_t alen, const uint8_t* b,
+                     int64_t blen) {
+  int64_t n = alen < blen ? alen : blen;
+  int c = n ? std::memcmp(a, b, static_cast<size_t>(n)) : 0;
+  if (c != 0) return c;
+  return alen < blen ? -1 : (alen > blen ? 1 : 0);
+}
+
+inline void arena_put_u32le(std::vector<uint8_t>& arena, uint32_t v) {
+  uint8_t b[4] = {static_cast<uint8_t>(v), static_cast<uint8_t>(v >> 8),
+                  static_cast<uint8_t>(v >> 16), static_cast<uint8_t>(v >> 24)};
+  arena.insert(arena.end(), b, b + 4);
+}
+
+constexpr char kNameLabel[] = "__name__";
+constexpr int64_t kNameLabelLen = 8;
+
+// Fill the metric-engine id lanes: metric_id = seahash(__name__ value),
+// series key = sorted non-name labels length-prefixed
+// (engine/types.py::series_key_of contract), tsid = seahash(series key).
+void compute_hashes(Parser& ps, const uint8_t* buf) {
+  size_t n_series = ps.series_label_start.size();
+  ps.series_metric_id.resize(n_series);
+  ps.series_tsid.resize(n_series);
+  ps.series_name_off.resize(n_series);
+  ps.series_name_len.resize(n_series);
+  ps.series_key_off.resize(n_series);
+  ps.series_key_len.resize(n_series);
+  for (size_t s = 0; s < n_series; ++s) {
+    int64_t lstart = ps.series_label_start[s];
+    int64_t lcount = ps.series_label_count[s];
+    // find __name__, collect the rest
+    int64_t name_off = 0, name_len = -1;
+    ps.sort_buf.clear();
+    for (int64_t i = lstart; i < lstart + lcount; ++i) {
+      if (ps.label_name_len[i] == kNameLabelLen &&
+          std::memcmp(buf + ps.label_name_off[i], kNameLabel,
+                      kNameLabelLen) == 0) {
+        name_off = ps.label_value_off[i];
+        name_len = ps.label_value_len[i];
+      } else {
+        ps.sort_buf.push_back(static_cast<int32_t>(i));
+      }
+    }
+    ps.series_name_off[s] = name_off;
+    ps.series_name_len[s] = name_len;
+    ps.series_metric_id[s] =
+        name_len >= 0 ? seahash(buf + name_off, static_cast<size_t>(name_len))
+                      : 0;
+    // sort remaining labels by (key bytes, value bytes)
+    std::sort(ps.sort_buf.begin(), ps.sort_buf.end(),
+              [&ps, buf](int32_t a, int32_t b) {
+                int c = bytes_cmp(buf + ps.label_name_off[a],
+                                  ps.label_name_len[a],
+                                  buf + ps.label_name_off[b],
+                                  ps.label_name_len[b]);
+                if (c != 0) return c < 0;
+                return bytes_cmp(buf + ps.label_value_off[a],
+                                 ps.label_value_len[a],
+                                 buf + ps.label_value_off[b],
+                                 ps.label_value_len[b]) < 0;
+              });
+    // materialize the canonical key: <u32 klen> k <u32 vlen> v per pair
+    int64_t key_off = static_cast<int64_t>(ps.key_arena.size());
+    for (int32_t i : ps.sort_buf) {
+      arena_put_u32le(ps.key_arena,
+                      static_cast<uint32_t>(ps.label_name_len[i]));
+      ps.key_arena.insert(ps.key_arena.end(), buf + ps.label_name_off[i],
+                          buf + ps.label_name_off[i] + ps.label_name_len[i]);
+      arena_put_u32le(ps.key_arena,
+                      static_cast<uint32_t>(ps.label_value_len[i]));
+      ps.key_arena.insert(ps.key_arena.end(), buf + ps.label_value_off[i],
+                          buf + ps.label_value_off[i] + ps.label_value_len[i]);
+    }
+    ps.series_key_off[s] = key_off;
+    ps.series_key_len[s] = static_cast<int64_t>(ps.key_arena.size()) - key_off;
+  }
+  // hash pass after arena building: insertions above may reallocate the arena
+  for (size_t s = 0; s < n_series; ++s) {
+    ps.series_tsid[s] =
+        seahash(ps.key_arena.data() + ps.series_key_off[s],
+                static_cast<size_t>(ps.series_key_len[s]));
+  }
+}
 
 inline int64_t off_of(const Parser& ps, const uint8_t* p) {
   return static_cast<int64_t>(p - ps.base);
@@ -382,6 +529,23 @@ struct RwResult {
   const int64_t* meta_name_len;
 };
 
+// Metric-engine id lanes (see compute_hashes); valid until the next
+// rw_parse*/rw_parser_free on the same handle.
+struct RwHashResult {
+  const uint64_t* series_metric_id;
+  const uint64_t* series_tsid;
+  const int64_t* series_name_off;
+  const int64_t* series_name_len;  // -1 = series had no __name__ label
+  const int64_t* series_key_off;
+  const int64_t* series_key_len;
+  const uint8_t* key_arena;
+  int64_t key_arena_len;
+};
+
+// Bumped whenever the ABI of any struct/function here changes; the Python
+// binding refuses (and rebuilds) a stale .so whose version mismatches.
+int rw_abi_version() { return 2; }
+
 void* rw_parser_new() { return new Parser(); }
 
 void rw_parser_free(void* h) { delete static_cast<Parser*>(h); }
@@ -422,6 +586,25 @@ int rw_parse(void* h, const uint8_t* buf, uint64_t len, RwResult* out) {
   out->meta_type = ps.meta_type.data();
   out->meta_name_off = ps.meta_name_off.data();
   out->meta_name_len = ps.meta_name_len.data();
+  return 0;
+}
+
+// Parse + metric-engine id lanes in one pass over the arena. Same return
+// contract as rw_parse; `hashes` is only valid when 0 is returned.
+int rw_parse_hashed(void* h, const uint8_t* buf, uint64_t len, RwResult* out,
+                    RwHashResult* hashes) {
+  int rc = rw_parse(h, buf, len, out);
+  if (rc != 0) return rc;
+  Parser& ps = *static_cast<Parser*>(h);
+  compute_hashes(ps, buf);
+  hashes->series_metric_id = ps.series_metric_id.data();
+  hashes->series_tsid = ps.series_tsid.data();
+  hashes->series_name_off = ps.series_name_off.data();
+  hashes->series_name_len = ps.series_name_len.data();
+  hashes->series_key_off = ps.series_key_off.data();
+  hashes->series_key_len = ps.series_key_len.data();
+  hashes->key_arena = ps.key_arena.data();
+  hashes->key_arena_len = static_cast<int64_t>(ps.key_arena.size());
   return 0;
 }
 

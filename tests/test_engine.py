@@ -322,3 +322,153 @@ class TestMetricEngine:
         assert eng.label_values(b"cpu", b"host") == [b"h0", b"h1", b"h2", b"h3", b"h4"]
         assert eng.label_values(b"cpu", b"nope") == []
         await eng.close()
+
+
+class TestFastSlowPathEquivalence:
+    """The hash-lane fast write path (_write_parsed_fast, C++ ids) and the
+    Python slow path (PyParser decode, Python seahash) must produce the same
+    engine state: same TSIDs, same index rows, same query results."""
+
+    PAYLOAD = [
+        ({"__name__": "cpu", "host": "a", "dc": "x"}, [(1000, 1.0), (2000, 2.0)]),
+        ({"__name__": "cpu", "host": "b"}, [(1500, 5.0)]),
+        ({"__name__": "mem", "host": "a"}, [(1000, 9.0)]),
+        ({"__name__": "up"}, [(1000, 1.0)]),  # tagless
+    ]
+
+    @async_test
+    async def test_same_state_and_results(self):
+        from horaedb_tpu.ingest import native as native_mod
+        from horaedb_tpu.ingest.py_parser import PyParser
+
+        if native_mod.load() is None:
+            pytest.skip("native parser not available")
+        payload = make_remote_write(self.PAYLOAD)
+        fast = native_mod.NativeParser().parse(payload)
+        slow = PyParser().parse(payload)
+        assert fast.series_tsid is not None and slow.series_tsid is None
+
+        results = []
+        for parsed in (fast, slow):
+            store = MemStore()
+            eng = await open_engine(store)
+            n = await eng.write_parsed(parsed)
+            assert n == 5
+            rows = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000))
+            filtered = await eng.query(
+                QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000,
+                             filters=[(b"host", b"a")])
+            )
+            results.append(
+                (
+                    sorted(eng.index_mgr.series_of(eng.metric_mgr.get(b"cpu")[0])),
+                    sorted(eng.metric_names()),
+                    rows.column("tsid").to_pylist(),
+                    rows.column("value").to_pylist(),
+                    filtered.column("value").to_pylist(),
+                    eng.series(b"cpu"),
+                )
+            )
+            await eng.close()
+        assert results[0] == results[1]
+
+    @async_test
+    async def test_buffered_matches_unbuffered(self):
+        """ingest_buffer_rows must not change query results (flush-on-query
+        consistency + the counting-sort flush ordering)."""
+        payload = make_remote_write(self.PAYLOAD)
+        outs = []
+        for buffer_rows in (0, 10_000):
+            store = MemStore()
+            eng = await MetricEngine.open(
+                "db", store, segment_duration_ms=HOUR,
+                enable_compaction=False, ingest_buffer_rows=buffer_rows,
+            )
+            await eng.write_parsed(PooledParser.decode(payload))
+            t = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000))
+            outs.append((t.column("tsid").to_pylist(), t.column("value").to_pylist(),
+                         t.column("ts").to_pylist()))
+            await eng.close()
+        assert outs[0] == outs[1]
+
+    @async_test
+    async def test_missing_name_rejected_on_both_paths(self):
+        from horaedb_tpu.common.error import HoraeError
+        from horaedb_tpu.ingest import native as native_mod
+        from horaedb_tpu.ingest.py_parser import PyParser
+
+        req = remote_write_pb2.WriteRequest()
+        ts = req.timeseries.add()
+        lab = ts.labels.add(); lab.name = b"host"; lab.value = b"a"
+        s = ts.samples.add(); s.timestamp = 1000; s.value = 1.0
+        payload = req.SerializeToString()
+        parsers = [PyParser()]
+        if native_mod.load() is not None:
+            parsers.append(native_mod.NativeParser())
+        for parser in parsers:
+            store = MemStore()
+            eng = await open_engine(store)
+            with pytest.raises(HoraeError):
+                await eng.write_parsed(parser.parse(payload))
+            await eng.close()
+
+
+class TestRegexGuard:
+    """_reject_catastrophic: hostile patterns must be refused before they
+    reach sre (which backtracks in C holding the GIL)."""
+
+    def test_catastrophic_patterns_rejected(self):
+        from horaedb_tpu.common.error import HoraeError
+        from horaedb_tpu.engine.index import _reject_catastrophic
+
+        for pat in ("(a+)+b", "(a*)*b", "(a+){2,100}b", "((a|aa)+)+$",
+                    "(?:x(a+)*y)+"):
+            with pytest.raises(HoraeError):
+                _reject_catastrophic(pat)
+
+    def test_benign_patterns_accepted(self):
+        from horaedb_tpu.engine.index import _reject_catastrophic
+
+        for pat in ("host-[0-9]+", "us-(east|west)-1", "a{1,5}b{1,5}",
+                    ".*", "cpu_(usage|idle)", "(ab)+c"):
+            _reject_catastrophic(pat)
+
+
+class TestBufferedFlushFailure:
+    @async_test
+    async def test_failed_flush_restores_buffer(self):
+        """A failing storage write must not drop acked buffered samples:
+        the snapshot merges back and a retrying flush persists everything
+        (data.py::flush concurrency contract)."""
+        from horaedb_tpu.common.error import HoraeError
+
+        store = MemStore()
+        eng = await MetricEngine.open(
+            "db", store, segment_duration_ms=HOUR,
+            enable_compaction=False, ingest_buffer_rows=10_000,
+        )
+        payload = make_remote_write(
+            [({"__name__": "cpu", "host": "a"}, [(1000, 1.0), (2000, 2.0)])]
+        )
+        await eng.write_parsed(PooledParser.decode(payload))
+        orig = eng.sample_mgr._write_segment
+        calls = {"n": 0}
+
+        async def failing(*a, **kw):
+            calls["n"] += 1
+            raise HoraeError("injected object-store failure")
+
+        eng.sample_mgr._write_segment = failing
+        with pytest.raises(HoraeError):
+            await eng.flush()
+        assert calls["n"] == 1
+        assert eng.sample_mgr._buffered == 2  # restored, not dropped
+        # more data lands in the restored buffer, then a successful retry
+        payload2 = make_remote_write(
+            [({"__name__": "cpu", "host": "a"}, [(3000, 3.0)])]
+        )
+        await eng.write_parsed(PooledParser.decode(payload2))
+        eng.sample_mgr._write_segment = orig
+        t = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000))
+        assert sorted(t.column("value").to_pylist()) == [1.0, 2.0, 3.0]
+        await eng.close()
