@@ -235,7 +235,11 @@ class SampleManager:
         self, metric_id: int, tsids: list[int] | None, rng: TimeRange
     ) -> pa.Table | None:
         """Materialized (merged, deduped) sample rows."""
-        if self._buffered:
+        if self._buffer_rows:
+            # always flush (not just when _buffered > 0): an in-flight flush
+            # has already detached the buffers but its SSTs may not be
+            # durable yet — flush() waits on the lock, keeping reads
+            # consistent with acked writes
             await self.flush()
         batches = []
         async for b in self._storage.scan(
@@ -264,8 +268,8 @@ class SampleManager:
         is sized by the series actually present in range."""
         from horaedb_tpu.common.error import ensure
 
-        if self._buffered:
-            await self.flush()
+        if self._buffer_rows:
+            await self.flush()  # see query_raw: waits out in-flight flushes
         n_buckets = -(-(rng.end - rng.start) // bucket_ms)
         ensure(
             n_buckets <= MAX_BUCKETS,

@@ -107,16 +107,23 @@ def _build_phase1(block: int, ranks: int, interpret: bool):
     return jax.jit(run)
 
 
-def distinct_cells_per_block_max(k_sorted: jax.Array, block: int = DEFAULT_BLOCK) -> int:
-    """Cheap dense pre-check: max distinct cells in any row block (counts a
-    cell continuing from the previous block as new, matching the kernel)."""
+def _distinct_max(k_sorted: jax.Array, block: int) -> jax.Array:
+    """Traced form of the pre-check: max distinct cells in any row block as
+    a device scalar (usable inside jit/shard_map)."""
     n = k_sorted.shape[0]
     nb = n // block
     if nb == 0:
-        return 0
+        return jnp.zeros((), jnp.int32)
     k2 = k_sorted[: nb * block].reshape(nb, block)
     prev = jnp.concatenate([jnp.full((nb, 1), -1, k2.dtype), k2[:, :-1]], axis=1)
-    return int(jnp.max(jnp.sum(k2 != prev, axis=1)))
+    return jnp.max(jnp.sum(k2 != prev, axis=1)).astype(jnp.int32)
+
+
+def distinct_cells_per_block_max(k_sorted: jax.Array, block: int = DEFAULT_BLOCK) -> int:
+    """Cheap dense pre-check: max distinct cells in any row block (counts a
+    cell continuing from the previous block as new, matching the kernel).
+    Concrete inputs only — inside jit use _distinct_max."""
+    return int(_distinct_max(k_sorted, block))
 
 
 @partial(jax.jit, static_argnames=("num_cells", "block", "ranks", "interpret"))
@@ -142,6 +149,96 @@ def _fast_path(k_sorted, v, num_cells, block, ranks, interpret):
     return grid_sum, grid_cnt
 
 
+# Row blocks per lax.map step in the pure-XLA path: bounds the materialized
+# one-hot to chunk*block*ranks f32 (64*2048*256*4 = 128 MB HBM peak).
+XLA_CHUNK = 64
+
+
+@partial(jax.jit, static_argnames=("num_cells", "block", "ranks"))
+def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks):
+    """Pure-XLA form of the block-rank compaction (same algorithm as the
+    Pallas phase 1, expressed as chunked one-hot matmuls): the per-row
+    scatter becomes an MXU contraction per row-block plus ONE scatter over
+    nb*ranks partials — block/ranks-fold fewer scatter rows than scattering
+    raw samples. Unlike the mosaic kernel this compiles everywhere,
+    including remoted-TPU paths where custom-kernel compilation stalls."""
+    n = k_sorted.shape[0]
+    nb = n // block
+    k2 = k_sorted[: nb * block].reshape(nb, block).astype(jnp.int32)
+    v2 = v[: nb * block].reshape(nb, block).astype(jnp.float32)
+    pad = (-nb) % XLA_CHUNK
+    if pad:
+        k2 = jnp.concatenate(
+            [k2, jnp.full((pad, block), num_cells, jnp.int32)]
+        )
+        v2 = jnp.concatenate([v2, jnp.zeros((pad, block), jnp.float32)])
+    nsteps = k2.shape[0] // XLA_CHUNK
+    k3 = k2.reshape(nsteps, XLA_CHUNK, block)
+    v3 = v2.reshape(nsteps, XLA_CHUNK, block)
+
+    def step(xs):
+        k, vv = xs  # [chunk, block]
+        prev = jnp.concatenate(
+            [jnp.full((XLA_CHUNK, 1), -1, jnp.int32), k[:, :-1]], axis=1
+        )
+        boundary = k != prev
+        rank = jnp.cumsum(boundary.astype(jnp.int32), axis=1) - 1
+        in_rank = rank < ranks
+        oh = (
+            (rank[..., None]
+             == jax.lax.broadcasted_iota(jnp.int32, (XLA_CHUNK, block, ranks), 2))
+            & in_rank[..., None]
+        ).astype(jnp.float32)
+        feats = jnp.stack([vv, jnp.ones_like(vv)], axis=-1)  # [chunk, block, 2]
+        # Precision.HIGHEST keeps f32 operands on the MXU: the default bf16
+        # multiply would corrupt recovered cell ids above ~2^8 (each rank
+        # sums exactly one nonzero term, so f32 recovery is exact < 2^24)
+        # and erode value sums.
+        sums = jnp.einsum(
+            "cbr,cbf->crf", oh, feats, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        cell_src = (k * boundary).astype(jnp.float32)[..., None]
+        cells = jnp.einsum(
+            "cbr,cbf->crf", oh, cell_src, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )[..., 0]
+        # unused ranks carry (0, 0) partials into cell 0 — harmless adds
+        return sums, jnp.round(cells).astype(jnp.int32)
+
+    sums, cells = jax.lax.map(step, (k3, v3))  # [nsteps, chunk, ranks, ...]
+    flat = sums.reshape(-1, 2)
+    flat_cells = cells.reshape(-1)
+    grid_sum = jax.ops.segment_sum(flat[:, 0], flat_cells, num_cells + 1)[:-1]
+    grid_cnt = jax.ops.segment_sum(flat[:, 1], flat_cells, num_cells + 1)[:-1]
+    if nb * block < n:
+        kt = jnp.clip(k_sorted[nb * block:], 0, num_cells).astype(jnp.int32)
+        vt = v[nb * block:].astype(jnp.float32)
+        grid_sum = grid_sum + jax.ops.segment_sum(vt, kt, num_cells + 1)[:-1]
+        grid_cnt = grid_cnt + jax.ops.segment_sum(
+            jnp.ones_like(vt), kt, num_cells + 1
+        )[:-1]
+    return grid_sum, grid_cnt
+
+
+def _scatter_sum_count(k_sorted, v, num_cells):
+    k = jnp.clip(k_sorted, 0, num_cells).astype(jnp.int32)
+    vf = v.astype(jnp.float32)
+    s = jax.ops.segment_sum(vf, k, num_cells + 1)[:-1]
+    c = jax.ops.segment_sum(jnp.ones_like(vf), k, num_cells + 1)[:-1]
+    return s, c
+
+
+def _sorted_impl() -> str:
+    """Strategy override: HORAEDB_SORTED_IMPL in {auto, scatter, block,
+    pallas, lanes}. auto = pallas when HORAEDB_PALLAS=1, else the pure-XLA
+    block compaction on accelerators, plain scatter on CPU (where XLA's
+    scatter is not the bottleneck)."""
+    import os
+
+    return os.environ.get("HORAEDB_SORTED_IMPL", "auto")
+
+
 def sorted_segment_sum_count(
     k_sorted,
     v,
@@ -152,23 +249,35 @@ def sorted_segment_sum_count(
 ):
     """(sum, count) per cell for SORTED cell ids (invalid rows must carry
     id >= num_cells). Adaptive: falls back to plain segment_sum when any
-    block holds more than `ranks` distinct cells."""
+    block holds more than `ranks` distinct cells (the rank compaction would
+    drop rows). Trace-safe: under jit/shard_map the adaptive check becomes
+    a lax.cond between the compacted and scatter paths."""
     ensure(num_cells < _F32_EXACT, f"num_cells {num_cells} exceeds f32-exact range")
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
-    if not _mosaic_enabled() and not interpret:
-        # Mosaic compilation is gated: some TPU access paths (e.g. remoted
-        # compile tunnels) stall on custom kernels. Set HORAEDB_PALLAS=1 on
-        # hardware with a local libtpu to enable the fast path.
-        k = jnp.clip(k_sorted, 0, num_cells).astype(jnp.int32)
-        vf = v.astype(jnp.float32)
-        s = jax.ops.segment_sum(vf, k, num_cells + 1)[:-1]
-        c = jax.ops.segment_sum(jnp.ones_like(vf), k, num_cells + 1)[:-1]
-        return s, c
+    impl = _sorted_impl()
+    if impl == "scatter" or (impl == "auto" and interpret and not _mosaic_enabled()):
+        return _scatter_sum_count(k_sorted, v, num_cells)
+    if impl == "lanes":
+        from horaedb_tpu.ops.aggregate import lane_segment_sum_count
+
+        return lane_segment_sum_count(k_sorted, v, num_cells)
+    use_pallas = impl == "pallas" or (impl == "auto" and (_mosaic_enabled() or interpret))
+
+    def fast(k, vv):
+        if use_pallas:
+            return _fast_path(k, vv, num_cells, block, ranks, interpret)
+        return _block_sum_count_xla(k, vv, num_cells, block, ranks)
+
+    if isinstance(k_sorted, jax.core.Tracer):
+        # inside jit: runtime branch (int() on the pre-check would raise
+        # ConcretizationTypeError; both branches compile, one executes)
+        return jax.lax.cond(
+            _distinct_max(k_sorted, block) > ranks,
+            lambda k, vv: _scatter_sum_count(k, vv, num_cells),
+            fast,
+            k_sorted, v,
+        )
     if distinct_cells_per_block_max(k_sorted, block) > ranks:
-        idx = jnp.clip(k_sorted, 0, num_cells).astype(jnp.int32)
-        vf = v.astype(jnp.float32)
-        s = jax.ops.segment_sum(vf, idx, num_cells + 1)[:-1]
-        c = jax.ops.segment_sum(jnp.ones_like(vf), idx, num_cells + 1)[:-1]
-        return s, c
-    return _fast_path(k_sorted, v, num_cells, block, ranks, interpret)
+        return _scatter_sum_count(k_sorted, v, num_cells)
+    return fast(k_sorted, v)

@@ -27,7 +27,7 @@ from horaedb_tpu.ops.filter import Predicate
 
 
 def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
-                 num_buckets, with_minmax):
+                 num_buckets, with_minmax, sorted_input=False):
     """Partial grids for this shard's rows, restricted to the series slice
     [series_lo, series_lo + local_series).
 
@@ -35,6 +35,12 @@ def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
     are the expensive op on TPU (random-index updates don't vectorize), so
     the kernel issues as few as possible; min/max add two more and are only
     computed when requested.
+
+    `sorted_input=True` declares rows ordered by (sid, ts) — the engine's
+    natural scan-output order. The sum/count reduction then dispatches to
+    the sorted-segment compaction (ops/pallas_kernels.py: block-rank one-hot
+    matmuls on the MXU instead of per-row scatters, with adaptive fallback);
+    results are identical either way, sortedness only affects speed.
     """
     local_sid = sid - series_lo
     bucket = ((ts - t0) // bucket_ms).astype(jnp.int32)
@@ -45,6 +51,31 @@ def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
     )
     num_cells = local_series * num_buckets
     flat = jnp.where(ok, local_sid.astype(jnp.int32) * num_buckets + bucket, num_cells)
+    if sorted_input:
+        from horaedb_tpu.ops.pallas_kernels import (
+            _F32_EXACT,
+            sorted_segment_sum_count,
+        )
+
+        if num_cells < _F32_EXACT:
+            s, c = sorted_segment_sum_count(
+                flat, jnp.where(ok, vals, 0.0), num_cells
+            )
+            mn = mx = None
+            if with_minmax:
+                # direct segment_min/max: going through masked_segment_stats
+                # would also emit the sum/count scatters this path replaces
+                mn = jax.ops.segment_min(
+                    jnp.where(ok, vals, jnp.inf), flat, num_cells + 1
+                )[:-1]
+                mx = jax.ops.segment_max(
+                    jnp.where(ok, vals, -jnp.inf), flat, num_cells + 1
+                )[:-1]
+            shape = (local_series, num_buckets)
+            if not with_minmax:
+                return s.reshape(shape), c.reshape(shape), None, None
+            return (s.reshape(shape), c.reshape(shape),
+                    mn.reshape(shape), mx.reshape(shape))
     s, c, mn, mx = aggregate.masked_segment_stats(
         vals, flat, ok, num_cells, with_minmax=with_minmax
     )
@@ -61,6 +92,7 @@ def build_sharded_downsample(
     num_buckets: int,
     predicate: Predicate | None = None,
     with_minmax: bool = True,
+    sorted_input: bool = False,
 ):
     """Compile the sharded downsample step for a fixed grid shape.
 
@@ -88,7 +120,7 @@ def build_sharded_downsample(
         lo = (s_idx * local_series).astype(sid.dtype)
         s, c, mn, mx = _local_grids(
             ts, sid, vals, valid, t0, bucket_ms, lo, local_series, num_buckets,
-            with_minmax,
+            with_minmax, sorted_input=sorted_input,
         )
         # combine partials across the row shards (ICI all-reduce)
         s = lax.psum(s, "rows")
@@ -122,11 +154,14 @@ def sharded_downsample(
     num_buckets: int,
     predicate: Predicate | None = None,
     with_minmax: bool = True,
+    sorted_input: bool = False,
 ):
     """One-shot wrapper: splits predicate literals so repeat queries with new
     constants reuse the memoized executable."""
     template, literals = filter_ops.split_literals(predicate)
-    fn = build_sharded_downsample(mesh, num_series, num_buckets, template, with_minmax)
+    fn = build_sharded_downsample(
+        mesh, num_series, num_buckets, template, with_minmax, sorted_input
+    )
     lit_arrays = filter_ops.literal_arrays(
         template, literals,
         {"__ts__": ts.dtype, "__sid__": sid.dtype, "__val__": vals.dtype},

@@ -1,5 +1,12 @@
-"""Pallas sorted-segment-reduction kernel vs numpy oracle (interpret mode on
-CPU; the same code path compiles with mosaic on TPU)."""
+"""Sorted-segment-reduction strategies vs numpy oracle.
+
+Every test runs against all three implementations: the plain scatter, the
+pure-XLA block-rank compaction, and the Pallas kernel (interpret mode on
+CPU; the same kernel compiles with mosaic on TPU). A TPU-only non-interpret
+test at the bottom exercises the real mosaic compile when hardware allows.
+"""
+
+import os
 
 import numpy as np
 import pytest
@@ -10,6 +17,14 @@ from horaedb_tpu.ops.pallas_kernels import (
     sorted_segment_sum_count,
 )
 
+IMPLS = ("scatter", "block", "pallas", "lanes")
+
+
+@pytest.fixture(params=IMPLS)
+def impl(request, monkeypatch):
+    monkeypatch.setenv("HORAEDB_SORTED_IMPL", request.param)
+    return request.param
+
 
 def oracle(k, v, cells):
     s = np.bincount(k, weights=v.astype(np.float64), minlength=cells)
@@ -18,7 +33,7 @@ def oracle(k, v, cells):
 
 
 class TestSortedSegmentSumCount:
-    def test_dense_sorted_matches_oracle(self):
+    def test_dense_sorted_matches_oracle(self, impl):
         rng = np.random.default_rng(0)
         n, cells = 60_000, 3_000  # ~20 rows/cell -> fast path
         k = np.sort(rng.integers(0, cells, n).astype(np.int32))
@@ -29,7 +44,7 @@ class TestSortedSegmentSumCount:
         np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
         np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-3)
 
-    def test_sentinel_rows_dropped(self):
+    def test_sentinel_rows_dropped(self, impl):
         rng = np.random.default_rng(1)
         n, cells = 20_000, 1_000
         k = np.sort(rng.integers(0, cells, n).astype(np.int32))
@@ -40,7 +55,7 @@ class TestSortedSegmentSumCount:
         assert float(np.asarray(c).sum()) == n
         assert float(np.asarray(s).sum()) == pytest.approx(n)
 
-    def test_sparse_falls_back_to_scatter(self):
+    def test_sparse_falls_back_to_scatter(self, impl):
         """>256 distinct cells per block -> adaptive fallback, still exact."""
         rng = np.random.default_rng(2)
         n = 10_000
@@ -53,7 +68,7 @@ class TestSortedSegmentSumCount:
         np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
         np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-3)
 
-    def test_tail_rows_handled(self):
+    def test_tail_rows_handled(self, impl):
         """Rows beyond the last full block go through the tail path."""
         n = DEFAULT_BLOCK * 8 + 123
         cells = 50
@@ -62,7 +77,7 @@ class TestSortedSegmentSumCount:
         s, c = sorted_segment_sum_count(k, v, cells)
         assert float(np.asarray(c).sum()) == n
 
-    def test_single_cell(self):
+    def test_single_cell(self, impl):
         n = DEFAULT_BLOCK * 8
         k = np.zeros(n, dtype=np.int32)
         v = np.full(n, 2.0, dtype=np.float32)
@@ -70,3 +85,53 @@ class TestSortedSegmentSumCount:
         assert float(np.asarray(c)[0]) == n
         assert float(np.asarray(s)[0]) == pytest.approx(2.0 * n)
         assert float(np.asarray(c)[1:].sum()) == 0
+
+    def test_trace_safe_under_jit(self, impl):
+        """The adaptive dispatch must work on tracers (jit / shard_map):
+        the sharded downsample calls this inside a compiled step."""
+        import jax
+
+        rng = np.random.default_rng(4)
+        n, cells = 30_000, 1_500
+        k = np.sort(rng.integers(0, cells, n).astype(np.int32))
+        v = rng.normal(size=n).astype(np.float32)
+        f = jax.jit(lambda kk, vv: sorted_segment_sum_count(kk, vv, cells))
+        s, c = f(k, v)
+        es, ec = oracle(k, v, cells)
+        np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
+        np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-3)
+
+    def test_block_run_spanning_chunk_boundaries(self, impl):
+        """One cell's run crossing block AND chunk boundaries merges
+        correctly in the final partial-scatter."""
+        n = DEFAULT_BLOCK * 72  # > XLA_CHUNK blocks
+        k = np.sort(np.arange(n) // (n // 7)).astype(np.int32)[:n]
+        v = np.ones(n, dtype=np.float32)
+        cells = 8
+        s, c = sorted_segment_sum_count(k, v, cells)
+        es, ec = oracle(k, v, cells)
+        np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
+        np.testing.assert_allclose(np.asarray(s), es, rtol=1e-4)
+
+
+@pytest.mark.skipif(
+    os.environ.get("HORAEDB_TPU_TESTS", "0") != "1",
+    reason="real-TPU mosaic test (set HORAEDB_TPU_TESTS=1 on hardware with local libtpu)",
+)
+class TestMosaicOnTpu:
+    def test_pallas_non_interpret_matches_oracle(self, monkeypatch):
+        """The real mosaic compile path (interpret=False) — only meaningful
+        on TPU hardware where custom-kernel compilation works."""
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            pytest.skip("no TPU device")
+        monkeypatch.setenv("HORAEDB_SORTED_IMPL", "pallas")
+        rng = np.random.default_rng(3)
+        n, cells = 1 << 20, 4_096
+        k = np.sort(rng.integers(0, cells, n).astype(np.int32))
+        v = rng.normal(size=n).astype(np.float32)
+        s, c = sorted_segment_sum_count(k, v, cells, interpret=False)
+        es, ec = oracle(k, v, cells)
+        np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
+        np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-2)
