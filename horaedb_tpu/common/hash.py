@@ -1,0 +1,56 @@
+"""SeaHash — the framework's shared byte-hash.
+
+Reference: src/metric_engine/src/types.rs:18-41 pins seahash as the id hash;
+the storage layer reuses it for SST bloom-filter probes so the same function
+serves both. From-scratch implementation of the public portable algorithm
+(seed-fixed variant of the seahash crate's `hash()`); conformance is pinned
+by the crate's documented test vector in tests/test_engine.py, and the C++
+port in native/remote_write_parser.cc is differentially tested against this
+one.
+"""
+
+from __future__ import annotations
+
+import struct
+
+_MASK = (1 << 64) - 1
+_P = 0x6EED_0E9D_A4D9_4A4F
+# Default seeds of seahash::hash (crate src: lib.rs).
+_SEEDS = (
+    0x16F1_1FE8_9B0D_677C,
+    0xB480_A793_D8E6_C86C,
+    0x6FE2_E5AA_F078_EBC9,
+    0x14F9_94A4_C525_9381,
+)
+
+
+def _diffuse(x: int) -> int:
+    x = (x * _P) & _MASK
+    x ^= (x >> 32) >> (x >> 60)
+    return (x * _P) & _MASK
+
+
+def seahash(data: bytes) -> int:
+    """SeaHash of `data` with the default seeds."""
+    a, b, c, d = _SEEDS
+    n = len(data)
+    # full 8-byte little-endian chunks, round-robin over the four lanes
+    full = n & ~7
+    lanes = [a, b, c, d]
+    i = 0
+    lane = 0
+    while i < full:
+        (chunk,) = struct.unpack_from("<Q", data, i)
+        lanes[lane] = _diffuse(lanes[lane] ^ chunk)
+        lane = (lane + 1) & 3
+        i += 8
+    if i < n:
+        tail = data[i:] + b"\x00" * (8 - (n - i))
+        (chunk,) = struct.unpack_from("<Q", tail, 0)
+        lanes[lane] = _diffuse(lanes[lane] ^ chunk)
+    a, b, c, d = lanes
+    a ^= b
+    c ^= d
+    a ^= c
+    a ^= n
+    return _diffuse(a)

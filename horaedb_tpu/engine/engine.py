@@ -55,10 +55,14 @@ class MetricEngine:
         config: StorageConfig | None = None,
         enable_compaction: bool = True,
         ingest_buffer_rows: int = 0,
+        sst_executor=None,
+        manifest_executor=None,
     ) -> "MetricEngine":
         """`ingest_buffer_rows` > 0 buffers data-table rows across writes
         and flushes as one SST per segment when the threshold is reached
-        (see SampleManager.__init__ for the durability trade-off)."""
+        (see SampleManager.__init__ for the durability trade-off).
+        `sst_executor`/`manifest_executor` size CPU-heavy storage work
+        (ThreadConfig, see ObjectBasedStorage.try_new)."""
         self = object.__new__(cls)
         self._store = store
         self._segment_duration = segment_duration_ms
@@ -72,6 +76,8 @@ class MetricEngine:
                 segment_duration_ms=segment_duration_ms,
                 config=config,
                 enable_compaction_scheduler=compaction,
+                sst_executor=sst_executor,
+                manifest_executor=manifest_executor,
             )
 
         self.metrics_table = await open_table(

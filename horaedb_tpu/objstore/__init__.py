@@ -55,6 +55,17 @@ class ObjectStore(ABC):
     def local_path(self, path: str) -> str | None:
         return None
 
+    async def put_stream(self, path: str, chunks) -> int:
+        """Streaming put from an async iterator of bytes chunks. The default
+        accumulates then puts (fine for in-memory fakes); stores with real
+        backends override to bound memory at chunk granularity."""
+        parts = []
+        async for c in chunks:
+            parts.append(c)
+        data = b"".join(parts)
+        await self.put(path, data)
+        return len(data)
+
 
 class MemStore(ObjectStore):
     """In-memory store for tests (the reference uses tmpdir+LocalFileSystem as
@@ -125,6 +136,36 @@ class LocalStore(ObjectStore):
             os.replace(tmp, fs)
 
         await asyncio.to_thread(_put)
+
+    async def put_stream(self, path: str, chunks) -> int:
+        """Streaming put from an async iterator of bytes chunks (the
+        multipart-upload analog: the reference streams SST encodes straight
+        to the store via AsyncArrowWriter, storage.rs:192-224). Atomic: the
+        object appears only after the final rename; an aborted stream leaves
+        nothing at `path`. Returns total bytes written."""
+        fs = self._fs_path(path)
+        os.makedirs(os.path.dirname(fs), exist_ok=True)
+        tmp = fs + ".tmp"
+        total = 0
+        f = await asyncio.to_thread(open, tmp, "wb")
+        try:
+            async for chunk in chunks:
+                await asyncio.to_thread(f.write, chunk)
+                total += len(chunk)
+            await asyncio.to_thread(f.flush)
+            await asyncio.to_thread(os.fsync, f.fileno())
+            await asyncio.to_thread(f.close)
+            await asyncio.to_thread(os.replace, tmp, fs)
+        except BaseException:
+            try:
+                f.close()
+            finally:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            raise
+        return total
 
     async def get(self, path: str) -> bytes:
         def _get() -> bytes:

@@ -314,9 +314,23 @@ async def bench_write_worker(state: ServerState, worker_id: int) -> None:
 
 
 async def build_app(config: Config) -> web.Application:
+    from concurrent.futures import ThreadPoolExecutor
+
     config.validate()
     store = LocalStore(config.metric_engine.storage.object_store.data_dir)
     segment_ms = config.test.segment_duration.as_millis()
+    # ThreadConfig sizes the dedicated executor for CPU-heavy SST work —
+    # the analog of the reference's named multi-thread runtimes
+    # (main.rs:102-119): heavy compaction encodes no longer compete with
+    # ingest for the event loop's default pool.
+    sst_executor = ThreadPoolExecutor(
+        max_workers=config.metric_engine.threads.sst_thread_num,
+        thread_name_prefix="sst",
+    )
+    manifest_executor = ThreadPoolExecutor(
+        max_workers=config.metric_engine.threads.manifest_thread_num,
+        thread_name_prefix="manifest",
+    )
     storage = await ObjectBasedStorage.try_new(
         root="demo",
         store=store,
@@ -324,10 +338,14 @@ async def build_app(config: Config) -> web.Application:
         num_primary_keys=3,
         segment_duration_ms=segment_ms,
         config=config.metric_engine.storage.time_merge_storage,
+        sst_executor=sst_executor,
+        manifest_executor=manifest_executor,
     )
     engine = await MetricEngine.open(
         "metrics", store, segment_duration_ms=segment_ms,
         config=config.metric_engine.storage.time_merge_storage,
+        sst_executor=sst_executor,
+        manifest_executor=manifest_executor,
     )
     state = ServerState(config, storage, engine)
     if config.test.enable_write:

@@ -1,0 +1,215 @@
+"""Per-SST column bloom filters.
+
+Reference: `build_write_props` applies per-column bloom-filter options to the
+parquet writer (src/columnar_storage/src/storage.rs:258-298). pyarrow (25.x)
+cannot WRITE parquet bloom filters, so the same capability ships as a
+sidecar object `{prefix}/data/{id}.bloom` holding one bloom per enabled
+column. The reader consults it for conjunctive equality / set-membership
+predicates and skips SSTs that definitely lack every probed value — an
+object-store GET saved per pruned SST.
+
+Format (little-endian):
+    magic u32 = 0xB100F11E | version u8 | n_cols u8
+    per column: name_len u16 | name | type_tag u8 | k u8 | m_bits u64
+                | ceil(m/8) bytes
+
+The per-column type tag (int / float / bytes) drives value canonicalization
+on BOTH sides: a probe literal is coerced to the column's domain before
+hashing, so `Compare("v", "eq", 5)` against a float column hashes the same
+bytes the build side hashed for 5.0 (and an unrepresentable literal like
+5.5 against an int column soundly proves absence). Probing hashes the
+canonical bytes with seahash under two seeds and derives k indexes by
+double hashing (Kirsch-Mitzenmacher).
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.common.hash import seahash
+
+MAGIC = 0xB100F11E
+VERSION = 2
+DEFAULT_FPP = 0.01
+
+TAG_INT = 0     # canonical: 8-byte LE of the u64 bit pattern
+TAG_FLOAT = 1   # canonical: 8-byte LE IEEE f64
+TAG_BYTES = 2   # canonical: raw bytes (str encodes UTF-8)
+
+_UNREPRESENTABLE = object()  # probe literal outside the column's domain
+
+
+def tag_of_arrow_type(t: pa.DataType) -> int:
+    if pa.types.is_integer(t) or pa.types.is_boolean(t):
+        return TAG_INT
+    if pa.types.is_floating(t):
+        return TAG_FLOAT
+    if (pa.types.is_binary(t) or pa.types.is_large_binary(t)
+            or pa.types.is_string(t) or pa.types.is_large_string(t)):
+        return TAG_BYTES
+    raise HoraeError(f"unsupported bloom column type: {t}")
+
+
+def _canonical(v, tag: int):
+    """Coerce a value into the column's domain; returns the hashable bytes
+    or _UNREPRESENTABLE when the value cannot equal any column value."""
+    if tag == TAG_INT:
+        if isinstance(v, float):
+            if not v.is_integer():
+                return _UNREPRESENTABLE
+            v = int(v)
+        if isinstance(v, (bool, int, np.integer)):
+            return struct.pack("<Q", int(v) & (1 << 64) - 1)
+        return _UNREPRESENTABLE
+    if tag == TAG_FLOAT:
+        if isinstance(v, (bool, int, float, np.integer, np.floating)):
+            return struct.pack("<d", float(v))
+        return _UNREPRESENTABLE
+    if tag == TAG_BYTES:
+        if isinstance(v, str):
+            return v.encode()
+        if isinstance(v, (bytes, bytearray)):
+            return bytes(v)
+        return _UNREPRESENTABLE
+    raise HoraeError(f"unknown bloom type tag: {tag}")
+
+
+def _h2(data: bytes) -> tuple[int, int]:
+    h1 = seahash(data)
+    h2 = seahash(b"\x9e" + data)
+    return h1, h2 | 1  # odd second hash: full-period double hashing
+
+
+class BloomFilter:
+    """One column's bloom: m bits, k hash probes, a domain type tag."""
+
+    def __init__(self, bits: np.ndarray, k: int, tag: int):
+        self.bits = bits  # uint8 array, len ceil(m/8)
+        self.k = k
+        self.m = len(bits) * 8
+        self.tag = tag
+
+    @classmethod
+    def build(cls, values, tag: int, fpp: float = DEFAULT_FPP) -> "BloomFilter":
+        uniq = {v for v in values if v is not None}  # nulls never probe-match
+        n = max(1, len(uniq))
+        m = max(64, int(-n * math.log(fpp) / (math.log(2) ** 2)))
+        m = (m + 7) // 8 * 8
+        k = max(1, round(m / n * math.log(2)))
+        bits = np.zeros(m // 8, dtype=np.uint8)
+        bf = cls(bits, k, tag)
+        for v in uniq:
+            data = _canonical(v, tag)
+            if data is _UNREPRESENTABLE:
+                raise HoraeError(
+                    f"bloom build: value {v!r} outside column domain (tag {tag})"
+                )
+            bf._add(data)
+        return bf
+
+    def _add(self, data: bytes) -> None:
+        h1, h2 = _h2(data)
+        for i in range(self.k):
+            idx = (h1 + i * h2) % self.m
+            self.bits[idx >> 3] |= 1 << (idx & 7)
+
+    def may_contain(self, v) -> bool:
+        data = _canonical(v, self.tag)
+        if data is _UNREPRESENTABLE:
+            return False  # cannot equal any stored value
+        h1, h2 = _h2(data)
+        for i in range(self.k):
+            idx = (h1 + i * h2) % self.m
+            if not (self.bits[idx >> 3] >> (idx & 7)) & 1:
+                return False
+        return True
+
+
+def build_blooms(
+    table: pa.Table, columns: list[str], fpp: float = DEFAULT_FPP
+) -> dict[str, BloomFilter]:
+    out = {}
+    for name in columns:
+        col = table.column(name)
+        tag = tag_of_arrow_type(col.type)
+        out[name] = BloomFilter.build(col.to_pylist(), tag, fpp)
+    return out
+
+
+def encode_blooms(blooms: dict[str, BloomFilter]) -> bytes:
+    parts = [struct.pack("<IBB", MAGIC, VERSION, len(blooms))]
+    for name, bf in sorted(blooms.items()):
+        nb = name.encode()
+        parts.append(struct.pack("<H", len(nb)))
+        parts.append(nb)
+        parts.append(struct.pack("<BBQ", bf.tag, bf.k, bf.m))
+        parts.append(bf.bits.tobytes())
+    return b"".join(parts)
+
+
+def decode_blooms(data: bytes) -> dict[str, BloomFilter]:
+    if len(data) < 6:
+        raise HoraeError("bloom sidecar truncated")
+    magic, version, n_cols = struct.unpack_from("<IBB", data, 0)
+    if magic != MAGIC:
+        raise HoraeError(f"bad bloom magic {magic:#x}")
+    if version != VERSION:
+        raise HoraeError(f"unsupported bloom version {version}")
+    off = 6
+    out = {}
+    for _ in range(n_cols):
+        (name_len,) = struct.unpack_from("<H", data, off)
+        off += 2
+        name = data[off : off + name_len].decode()
+        off += name_len
+        tag, k, m = struct.unpack_from("<BBQ", data, off)
+        off += 10
+        nbytes = m // 8
+        bits = np.frombuffer(data[off : off + nbytes], dtype=np.uint8)
+        if len(bits) != nbytes:
+            raise HoraeError("bloom sidecar truncated")
+        off += nbytes
+        out[name] = BloomFilter(bits.copy(), k, tag)
+    return out
+
+
+def eq_constraints(predicate) -> dict[str, set]:
+    """Extract conjunctive equality constraints: {column: candidate values}.
+    A row can only match the predicate if, for each returned column, its
+    value is one of the candidates — the sound condition for bloom pruning.
+    Or/Not subtrees contribute nothing (conservative)."""
+    from horaedb_tpu.ops import filter as F
+
+    out: dict[str, set] = {}
+
+    def walk(p) -> None:
+        if isinstance(p, F.And):
+            for c in p.children:
+                walk(c)
+        elif isinstance(p, F.Compare) and p.op == "eq":
+            s = out.setdefault(p.column, set())
+            s.add(p.literal)
+        elif isinstance(p, F.InSet):
+            out.setdefault(p.column, set()).update(p.values)
+
+    if predicate is not None:
+        walk(predicate)
+    # A column constrained twice keeps all candidates (superset = sound).
+    return out
+
+
+def can_skip(blooms: dict[str, BloomFilter], constraints: dict[str, set]) -> bool:
+    """True when some constrained+bloomed column contains NONE of its
+    candidate values — the SST cannot produce a matching row."""
+    for col, values in constraints.items():
+        bf = blooms.get(col)
+        if bf is None or len(values) > 256:  # cap probe work per SST
+            continue
+        if not any(bf.may_contain(v) for v in values):
+            return True
+    return False

@@ -146,13 +146,23 @@ class Executor:
         await self._delete_ssts(to_deletes)
 
     async def _delete_ssts(self, ids: list[int]) -> None:
-        """Best-effort parallel physical deletes (executor.rs:224-253)."""
+        """Best-effort parallel physical deletes (executor.rs:224-253),
+        including bloom sidecars (missing ones are expected: sidecars only
+        exist when bloom filters were enabled at write time)."""
+        path_gen = self._storage.parquet_reader._path_gen
         for i in ids:
             self._storage.parquet_reader.evict_cached(i)
-        paths = [self._storage.parquet_reader._path_gen.generate(i) for i in ids]
+        paths = [path_gen.generate(i) for i in ids]
+        bloom_paths = [path_gen.generate_bloom(i) for i in ids]
         results = await asyncio.gather(
-            *(self._storage._store.delete(p) for p in paths), return_exceptions=True
+            *(self._storage._store.delete(p) for p in paths),
+            *(self._storage._store.delete(p) for p in bloom_paths),
+            return_exceptions=True,
         )
-        for p, r in zip(paths, results):
+        from horaedb_tpu.objstore import NotFound
+
+        for p, r in zip(paths + bloom_paths, results):
+            if isinstance(r, NotFound):
+                continue
             if isinstance(r, BaseException):
-                logger.error("Failed to delete sst %s: %s", p, r)
+                logger.error("Failed to delete sst object %s: %s", p, r)

@@ -63,6 +63,10 @@ def _from_dict(cls, d: dict):
         default = getattr(cls(), name)
         if name == "ttl" and value is not None:
             kwargs[name] = ReadableDuration.parse(value)
+        elif name == "column_options" and value is not None:
+            kwargs[name] = {
+                col: _from_dict(ColumnOptions, opts) for col, opts in value.items()
+            }
         elif hasattr(type(default), "parse") and not isinstance(value, dict):
             kwargs[name] = type(default).parse(value)
         elif hasattr(default, "__dataclass_fields__"):

@@ -51,10 +51,17 @@ def delta_path(root: str, file_id: int) -> str:
 class ManifestMerger:
     """Background delta→snapshot folder (mod.rs:178-333)."""
 
-    def __init__(self, root: str, store: ObjectStore, config: ManifestConfig):
+    def __init__(
+        self, root: str, store: ObjectStore, config: ManifestConfig, executor=None
+    ):
         self._root = root
         self._store = store
         self._config = config
+        # Optional dedicated executor for the CPU-bound fold (decode deltas +
+        # rebuild snapshot bytes), sized by the server's ThreadConfig — the
+        # manifest-compact runtime analog (main.rs:102-119). None = fold
+        # inline on the event loop (fine at test scale).
+        self._executor = executor
         self._deltas_num = 0
         self._merge_signal: asyncio.Queue[None] = asyncio.Queue(maxsize=config.channel_size)
         self._task: asyncio.Task | None = None
@@ -134,18 +141,27 @@ class ManifestMerger:
             blobs = await asyncio.gather(*(self._store.get(p) for p in paths))
 
             snapshot = await read_snapshot(self._store, snapshot_path(self._root))
-            all_adds: list[SstFile] = []
-            all_deletes: list[int] = []
-            for blob in blobs:
-                adds, deletes = decode_update(blob)
-                all_adds.extend(adds)
-                all_deletes.extend(deletes)
-            # Adds before deletes: deltas arrive unsorted (mod.rs:289-299).
-            snapshot.add_records(all_adds)
-            snapshot.delete_records(all_deletes)
 
+            def fold() -> bytes:
+                all_adds: list[SstFile] = []
+                all_deletes: list[int] = []
+                for blob in blobs:
+                    adds, deletes = decode_update(blob)
+                    all_adds.extend(adds)
+                    all_deletes.extend(deletes)
+                # Adds before deletes: deltas arrive unsorted (mod.rs:289-299).
+                snapshot.add_records(all_adds)
+                snapshot.delete_records(all_deletes)
+                return snapshot.to_bytes()
+
+            if self._executor is None:
+                data = fold()
+            else:
+                data = await asyncio.get_running_loop().run_in_executor(
+                    self._executor, fold
+                )
             with context("write manifest snapshot"):
-                await self._store.put(snapshot_path(self._root), snapshot.to_bytes())
+                await self._store.put(snapshot_path(self._root), data)
             # Commit point passed: delta deletions are best-effort (mod.rs:310-330).
             results = await asyncio.gather(
                 *(self._store.delete(p) for p in paths), return_exceptions=True
@@ -169,12 +185,14 @@ async def read_snapshot(store: ObjectStore, path: str) -> Snapshot:
 class Manifest:
     """Live-SST registry (mod.rs:66-176)."""
 
-    def __init__(self, root: str, store: ObjectStore, config: ManifestConfig):
+    def __init__(
+        self, root: str, store: ObjectStore, config: ManifestConfig, executor=None
+    ):
         self._root = root
         self._store = store
         self._config = config
         self._ssts: list[SstFile] = []
-        self._merger = ManifestMerger(root, store, config)
+        self._merger = ManifestMerger(root, store, config, executor=executor)
 
     @classmethod
     async def try_new(
@@ -183,8 +201,9 @@ class Manifest:
         store: ObjectStore,
         config: ManifestConfig | None = None,
         start_background_merger: bool = True,
+        executor=None,
     ) -> "Manifest":
-        m = cls(root, store, config or ManifestConfig())
+        m = cls(root, store, config or ManifestConfig(), executor=executor)
         await m._merger.bootstrap()
         snapshot = await read_snapshot(store, snapshot_path(root))
         m._ssts = snapshot.into_ssts()

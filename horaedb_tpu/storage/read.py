@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import io
+import logging
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -56,6 +57,8 @@ from horaedb_tpu.storage.types import (
     StorageSchema,
     TimeRange,
 )
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_SCAN_BATCH_SIZE = 8192
 
@@ -164,6 +167,39 @@ class ParquetReader:
         self._pf_cache: "OrderedDict[str, tuple[pq.ParquetFile, threading.Lock]]" = OrderedDict()
         self._pf_cache_cap = 128
         self._pf_cache_lock = threading.Lock()
+        # file_id -> decoded bloom sidecar (None = probed, no sidecar).
+        # SSTs are immutable so entries never go stale; deletes evict.
+        self._bloom_cache: dict[int, "dict | None"] = {}
+        self._bloom_lock = threading.Lock()
+
+    async def _bloom_skip(self, sst: SstFile, predicate) -> bool:
+        """True when the SST's bloom sidecar proves no row can satisfy the
+        predicate's conjunctive equality constraints (storage/bloom.py).
+        Sound under the engine's filter-BEFORE-dedup plan order."""
+        from horaedb_tpu.storage import bloom as bloom_mod
+
+        constraints = bloom_mod.eq_constraints(predicate)
+        if not constraints:
+            return False
+        with self._bloom_lock:
+            probed = sst.id in self._bloom_cache
+            blooms = self._bloom_cache.get(sst.id)
+        if not probed:
+            from horaedb_tpu.objstore import NotFound
+
+            try:
+                data = await self._store.get(self._path_gen.generate_bloom(sst.id))
+                blooms = bloom_mod.decode_blooms(data)
+            except NotFound:
+                blooms = None
+            except Exception:  # noqa: BLE001 — corrupt sidecar: never prune
+                logger.warning("unreadable bloom sidecar for sst %d", sst.id)
+                blooms = None
+            with self._bloom_lock:
+                self._bloom_cache[sst.id] = blooms
+        if blooms is None:
+            return False
+        return bloom_mod.can_skip(blooms, constraints)
 
     async def read_sst(
         self,
@@ -172,8 +208,15 @@ class ParquetReader:
         predicate: Predicate | None,
     ) -> pa.Table:
         """Read one SST's projected columns, skipping row groups whose
-        min/max statistics can't satisfy the predicate."""
+        min/max statistics can't satisfy the predicate (and whole SSTs whose
+        bloom sidecar rules the predicate out)."""
         path = self._path_gen.generate(sst.id)
+        if predicate is not None and await self._bloom_skip(sst, predicate):
+            fields = [
+                f for f in self._schema.arrow_schema
+                if columns is None or f.name in columns
+            ]
+            return pa.schema(fields).empty_table()
 
         def _close_evicted(evicted) -> None:
             if evicted is not None:
@@ -232,6 +275,8 @@ class ParquetReader:
         before physical deletes so file descriptors don't linger)."""
         with self._pf_cache_lock:
             entry = self._pf_cache.pop(self._path_gen.generate(file_id), None)
+        with self._bloom_lock:
+            self._bloom_cache.pop(file_id, None)
         if entry is not None:
             pf, handle_lock = entry
             with handle_lock:  # wait out any in-flight read

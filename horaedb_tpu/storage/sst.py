@@ -41,12 +41,26 @@ class _IdAllocator:
         with self._lock:
             return next(self._counter) & _U64_MASK
 
+    def ensure_above(self, floor: int) -> None:
+        """Advance past `floor` if the clock seed fell at or below it — the
+        startup guard against a clock moved backwards (or another process's
+        ids already in the manifest): allocating an id <= an existing SST id
+        would silently overwrite data, since the id doubles as the dedup
+        sequence."""
+        with self._lock:
+            current = next(self._counter)
+            self._counter = itertools.count(max(current, floor + 1) & _U64_MASK)
+
 
 _ALLOCATOR = _IdAllocator()
 
 
 def allocate_id() -> int:
     return _ALLOCATOR.allocate()
+
+
+def ensure_id_above(floor: int) -> None:
+    _ALLOCATOR.ensure_above(floor)
 
 
 @dataclass(frozen=True)
@@ -117,3 +131,8 @@ class SstPathGenerator:
 
     def generate(self, file_id: int) -> str:
         return f"{self.prefix}/{PREFIX_PATH}/{file_id}.sst"
+
+    def generate_bloom(self, file_id: int) -> str:
+        """Sidecar bloom-filter object (pyarrow cannot write parquet blooms;
+        see storage/bloom.py)."""
+        return f"{self.prefix}/{PREFIX_PATH}/{file_id}.bloom"

@@ -113,12 +113,20 @@ class ObjectBasedStorage(ColumnarStorage):
         config: StorageConfig | None = None,
         enable_compaction_scheduler: bool = True,
         start_background_merger: bool = True,
+        sst_executor=None,
+        manifest_executor=None,
     ) -> "ObjectBasedStorage":
+        """`sst_executor` / `manifest_executor`: optional
+        concurrent.futures.Executors for CPU-heavy SST work (sort, parquet
+        encode, bloom build) and manifest snapshot folds. Sized from the
+        server's ThreadConfig (the analog of the reference's dedicated
+        runtimes, main.rs:102-119); None = default pool / inline."""
         self = object.__new__(cls)
         config = config or StorageConfig()
         self._root = root.strip("/")
         self._store = store
         self._config = config
+        self._sst_executor = sst_executor
         self._segment_duration = segment_duration_ms
         self._schema = StorageSchema.try_new(
             arrow_schema, num_primary_keys, config.update_mode
@@ -128,7 +136,16 @@ class ObjectBasedStorage(ColumnarStorage):
             store,
             config.manifest,
             start_background_merger=start_background_merger,
+            executor=manifest_executor,
         )
+        # Startup id-collision guard: never allocate at or below an id the
+        # manifest already holds (clock moved backwards across restarts, or
+        # ids minted by another process against this store root).
+        existing = self._manifest.all_ssts()
+        if existing:
+            from horaedb_tpu.storage.sst import ensure_id_above
+
+            ensure_id_above(max(s.id for s in existing))
         self._path_gen = SstPathGenerator(self._root)
         self._reader = ParquetReader(
             store, self._path_gen, self._schema,
@@ -189,9 +206,19 @@ class ObjectBasedStorage(ColumnarStorage):
         )
         await self._manifest.add_file(result.id, meta)
 
+    async def _run_sst(self, fn, *args):
+        """Run CPU-heavy SST work on the configured executor (ThreadConfig
+        sizing) or the default thread pool."""
+        if self._sst_executor is None:
+            return await asyncio.to_thread(fn, *args)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._sst_executor, lambda: fn(*args)
+        )
+
     async def write_batch(self, batch: pa.RecordBatch) -> WriteResult:
         file_id = allocate_id()
-        sorted_batch = await asyncio.to_thread(self._sort_batch, batch)
+        sorted_batch = await self._run_sst(self._sort_batch, batch)
         # file ids are increasing, so the id doubles as the sequence
         with_builtin = self._schema.fill_builtin_columns(sorted_batch, file_id)
         table = pa.Table.from_batches([with_builtin])
@@ -238,43 +265,181 @@ class ObjectBasedStorage(ColumnarStorage):
             perm = np.asarray(sort_ops.sort_permutation(keys))
         return batch.take(pa.array(perm))
 
+    def _writer_kwargs(self) -> dict:
+        """ParquetWriter options from WriteConfig, per-column overrides
+        applied (the analog of build_write_props, storage.rs:258-298)."""
+        cfg = self._config.write
+        names = self._schema.arrow_schema.names
+        col_opts = cfg.column_options or {}
+
+        def opt(n: str, attr: str):
+            per = col_opts.get(n)
+            return getattr(per, attr, None) if per is not None else None
+
+        # dictionary: global bool, upgraded to a column list when any
+        # per-column override exists
+        if any(opt(n, "enable_dict") is not None for n in names):
+            use_dictionary: bool | list = [
+                n for n in names
+                if (opt(n, "enable_dict")
+                    if opt(n, "enable_dict") is not None else cfg.enable_dict)
+            ]
+        else:
+            use_dictionary = cfg.enable_dict
+        global_comp = cfg.compression.value if cfg.compression.value != "none" else "NONE"
+        if any(opt(n, "compression") for n in names):
+            compression: str | dict = {
+                n: (opt(n, "compression") or global_comp) for n in names
+            }
+        else:
+            compression = global_comp
+        column_encoding = {
+            n: opt(n, "encoding") for n in names if opt(n, "encoding")
+        } or None
+        sorting = [
+            pq.SortingColumn(i) for i in range(self._schema.num_primary_keys)
+        ] + [pq.SortingColumn(self._schema.seq_idx)]
+        return dict(
+            compression=compression,
+            use_dictionary=use_dictionary,
+            write_statistics=True,
+            write_batch_size=cfg.write_batch_size,
+            column_encoding=column_encoding,
+            sorting_columns=sorting if cfg.enable_sorting_columns else None,
+        )
+
+    def _bloom_columns(self) -> list[str]:
+        """Columns with bloom filters enabled (global flag or per-column).
+        Builtin columns never get blooms — equality probes on them make no
+        sense and `__reserved__` is null-filled."""
+        from horaedb_tpu.storage.types import RESERVED_COLUMN_NAME, SEQ_COLUMN_NAME
+
+        cfg = self._config.write
+        col_opts = cfg.column_options or {}
+        out = []
+        for n in self._schema.arrow_schema.names:
+            if n in (SEQ_COLUMN_NAME, RESERVED_COLUMN_NAME):
+                continue
+            per = getattr(col_opts.get(n), "enable_bloom_filter", None) if n in col_opts else None
+            if per is True or (per is None and cfg.enable_bloom_filter):
+                out.append(n)
+        return out
+
     async def write_sst(self, file_id: int, table: pa.Table) -> int:
-        """Encode a (sorted, builtin-filled) table as one parquet SST and put
-        it to the object store; returns the object size."""
+        """Encode a (sorted, builtin-filled) table as one parquet SST,
+        STREAMED to the object store at chunk granularity — host memory
+        stays O(row group + chunk), not O(table), matching the reference's
+        AsyncArrowWriter streaming (storage.rs:192-224). Returns object size.
+
+        When bloom filters are enabled, a sidecar `{id}.bloom` lands after
+        the SST but before the file is registrable in the manifest, so
+        readers never observe a registered SST without its sidecar."""
+        import queue as _queue
+        import threading as _threading
+
         path = self._path_gen.generate(file_id)
         cfg = self._config.write
-
-        def _encode() -> bytes:
-            sink = io.BytesIO()
-            sorting = [
-                pq.SortingColumn(i)
-                for i in range(self._schema.num_primary_keys)
-            ] + [pq.SortingColumn(self._schema.seq_idx)]
-            writer = pq.ParquetWriter(
-                sink,
-                table.schema,
-                compression=cfg.compression.value if cfg.compression.value != "none" else "NONE",
-                use_dictionary=cfg.enable_dict,
-                write_statistics=True,
-                sorting_columns=sorting if cfg.enable_sorting_columns else None,
-            )
-            for start in range(0, table.num_rows, cfg.max_row_group_size):
-                writer.write_table(
-                    table.slice(start, cfg.max_row_group_size),
-                    row_group_size=cfg.max_row_group_size,
-                )
-            writer.close()
-            return sink.getvalue()
-
-        data = await asyncio.to_thread(_encode)
-        # The manifest wire format carries size/num_rows as u32 (sst.proto,
-        # encoding.py); reject before paying the upload so an unregistrable
-        # SST is never orphaned in the store.
-        ensure(len(data) < 2**32, f"sst too large for manifest format: {len(data)}")
+        # The manifest wire format carries num_rows as u32 (sst.proto,
+        # encoding.py); reject before paying any upload.
         ensure(table.num_rows < 2**32, f"sst row count too large: {table.num_rows}")
-        with context(f"write sst {path}"):
-            await self._store.put(path, data)
-        return len(data)
+
+        CHUNK = 4 << 20
+        q: _queue.Queue = _queue.Queue(maxsize=4)
+        cancel = _threading.Event()
+        done = _threading.Event()
+        kwargs = self._writer_kwargs()
+
+        class _Sink(io.RawIOBase):
+            def __init__(self):
+                self.buf = bytearray()
+
+            def writable(self):
+                return True
+
+            def write(self, b):
+                if cancel.is_set():
+                    raise IOError("sst stream cancelled")
+                self.buf += b
+                while len(self.buf) >= CHUNK:
+                    q.put(bytes(self.buf[:CHUNK]))
+                    del self.buf[:CHUNK]
+                return len(b)
+
+        def _produce() -> None:
+            try:
+                sink = _Sink()
+                writer = pq.ParquetWriter(sink, table.schema, **kwargs)
+                for start in range(0, table.num_rows, cfg.max_row_group_size):
+                    writer.write_table(
+                        table.slice(start, cfg.max_row_group_size),
+                        row_group_size=cfg.max_row_group_size,
+                    )
+                writer.close()
+                if sink.buf:
+                    q.put(bytes(sink.buf))
+                q.put(None)  # EOF
+            except BaseException as e:  # noqa: BLE001 — relayed to consumer
+                q.put(e)
+            finally:
+                done.set()
+
+        # The CPU-heavy encode runs on the sized SST executor when one is
+        # configured (ThreadConfig) — ad-hoc threads would bypass exactly
+        # the contention bound the executor exists for.
+        if self._sst_executor is not None:
+            self._sst_executor.submit(_produce)
+        else:
+            _threading.Thread(target=_produce, daemon=True).start()
+
+        async def chunks():
+            total = 0
+            while True:
+                item = await asyncio.to_thread(q.get)
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                total += len(item)
+                # size is u32 in the manifest format: abort mid-stream
+                # (put_stream discards the partial object)
+                ensure(total < 2**32, f"sst too large for manifest format: {total}")
+                yield item
+
+        try:
+            with context(f"write sst {path}"):
+                size = await self._store.put_stream(path, chunks())
+        finally:
+            cancel.set()
+            while not done.is_set():
+                try:  # unblock a producer stuck on a full queue
+                    q.get_nowait()
+                except _queue.Empty:
+                    pass
+                done.wait(timeout=0.05)
+
+        # Bloom sidecar AFTER the SST lands: readers only learn ids via the
+        # manifest (updated after this returns), so ordering is safe, and a
+        # failed stream can't orphan a sidecar. If the sidecar put itself
+        # fails, the SST object is reclaimed best-effort before raising.
+        bloom_cols = self._bloom_columns()
+        if bloom_cols:
+            from horaedb_tpu.storage import bloom as bloom_mod
+
+            try:
+                blooms = await self._run_sst(
+                    bloom_mod.build_blooms, table, bloom_cols
+                )
+                await self._store.put(
+                    self._path_gen.generate_bloom(file_id),
+                    bloom_mod.encode_blooms(blooms),
+                )
+            except BaseException:
+                try:
+                    await self._store.delete(path)
+                except Exception:  # noqa: BLE001 — orphan cleanup best-effort
+                    logger.warning("orphaned sst object %s after bloom failure", path)
+                raise
+        return size
 
     # -- scan path (storage.rs:335-370) --------------------------------------
     async def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]:

@@ -556,3 +556,19 @@ class TestOverwriteBinary:
         assert t.column("pk").to_pylist() == [1, 2]
         assert t.column("payload").to_pylist() == [b"old1", b"new2"]
         await eng.close()
+
+
+class TestIdCollisionGuard:
+    def test_allocator_advances_past_manifest_max(self):
+        """A clock moved backwards (or foreign ids in the manifest) must not
+        let the allocator re-issue an existing SST id — the id doubles as the
+        dedup sequence, so a collision silently overwrites data."""
+        from horaedb_tpu.storage.sst import _ALLOCATOR, allocate_id, ensure_id_above
+
+        current = allocate_id()
+        ensure_id_above(current + 1_000_000)
+        nxt = allocate_id()
+        assert nxt > current + 1_000_000
+        # floor below current: no-op
+        ensure_id_above(nxt - 10)
+        assert allocate_id() > nxt
