@@ -1,0 +1,145 @@
+"""Index scale benchmark: open a synthetic 1M-series index and probe it.
+
+VERDICT target: 1M-series index opens in seconds; find_tsids latency flat
+per metric. Usage: python benchmarks/index_bench.py [n_series]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from horaedb_tpu.engine import tables
+    from horaedb_tpu.engine.index import IndexManager
+    from horaedb_tpu.engine.types import series_id_of, series_key_of, tag_hash_of
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.storage.read import WriteRequest
+    from horaedb_tpu.storage.storage import ObjectBasedStorage
+    from horaedb_tpu.storage.types import TimeRange
+
+    import pyarrow as pa
+
+    n_series = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n_metrics = 100
+    HOUR = 3_600_000
+
+    async def run() -> dict:
+        store = LocalStore(tempfile.mkdtemp(prefix="idx_"))
+
+        async def open_table(name, schema, pks):
+            return await ObjectBasedStorage.try_new(
+                root=name, store=store, arrow_schema=schema,
+                num_primary_keys=pks, segment_duration_ms=HOUR,
+                enable_compaction_scheduler=False,
+            )
+
+        series_t = await open_table("series", tables.SERIES_SCHEMA, tables.SERIES_NUM_PKS)
+        index_t = await open_table("index", tables.INDEX_SCHEMA, tables.INDEX_NUM_PKS)
+
+        # synthesize: n_series across n_metrics, 3 tags each (host/region/dc)
+        build_start = time.perf_counter()
+        rng = np.random.default_rng(0)
+        batch_size = 200_000
+        sample_tsid_by_metric: dict[int, int] = {}
+        hosts_per_metric = n_series // n_metrics
+        for start in range(0, n_series, batch_size):
+            cnt = min(batch_size, n_series - start)
+            mids = np.empty(cnt, np.uint64)
+            tsids = np.empty(cnt, np.uint64)
+            keys = []
+            i_rows = {"metric_id": [], "tag_hash": [], "tsid": [], "tag_key": [], "tag_value": []}
+            for j in range(cnt):
+                s = start + j
+                metric = s % n_metrics
+                mid = np.uint64(0x9E3779B97F4A7C15 * (metric + 1) & (2**64 - 1))
+                labels = [
+                    (b"dc", f"dc{s % 4}".encode()),
+                    (b"host", f"host-{s // n_metrics:07d}".encode()),
+                    (b"region", [b"us-east-1", b"eu-west-1"][s % 2]),
+                ]
+                key = series_key_of(labels)
+                tsid = series_id_of(key)
+                mids[j] = mid
+                tsids[j] = tsid
+                keys.append(key)
+                if int(mid) not in sample_tsid_by_metric:
+                    sample_tsid_by_metric[int(mid)] = s // n_metrics
+                for k, v in labels:
+                    i_rows["metric_id"].append(mid)
+                    i_rows["tag_hash"].append(tag_hash_of(k, v))
+                    i_rows["tsid"].append(tsid)
+                    i_rows["tag_key"].append(k)
+                    i_rows["tag_value"].append(v)
+            s_batch = pa.RecordBatch.from_pydict(
+                {"metric_id": mids, "tsid": tsids, "series_key": keys},
+                schema=tables.SERIES_SCHEMA,
+            )
+            await series_t.write(WriteRequest(s_batch, TimeRange(0, 1)))
+            i_batch = pa.RecordBatch.from_pydict(
+                {
+                    "metric_id": np.asarray(i_rows["metric_id"], np.uint64),
+                    "tag_hash": np.asarray(i_rows["tag_hash"], np.uint64),
+                    "tsid": np.asarray(i_rows["tsid"], np.uint64),
+                    "tag_key": i_rows["tag_key"],
+                    "tag_value": i_rows["tag_value"],
+                },
+                schema=tables.INDEX_SCHEMA,
+            )
+            await index_t.write(WriteRequest(i_batch, TimeRange(0, 1)))
+        build_s = time.perf_counter() - build_start
+
+        mgr = IndexManager(series_t, index_t, HOUR)
+        open_start = time.perf_counter()
+        await mgr.open()
+        open_s = time.perf_counter() - open_start
+
+        mid0 = sorted(mgr._base.keys())[0]
+        host = f"host-{sample_tsid_by_metric[mid0]:07d}".encode()
+        q_start = time.perf_counter()
+        Q = 100
+        for _ in range(Q):
+            hits = mgr.find_tsids(mid0, [(b"host", host)])
+        eq_us = (time.perf_counter() - q_start) / Q * 1e6
+        assert hits, "equality probe found nothing"
+
+        m_start = time.perf_counter()
+        MQ = 5
+        for _ in range(MQ):
+            rx_hits = mgr.find_tsids(
+                mid0, [], matchers=[(b"region", "re", b"us-.*")]
+            )
+        rx_ms = (time.perf_counter() - m_start) / MQ * 1e3
+        assert rx_hits
+
+        await series_t.close()
+        await index_t.close()
+        return {
+            "bench": "index_scale",
+            "n_series": n_series,
+            "n_metrics": n_metrics,
+            "series_per_metric": hosts_per_metric,
+            "build_s": round(build_s, 1),
+            "open_s": round(open_s, 2),
+            "eq_probe_us": round(eq_us, 1),
+            "regex_matcher_ms": round(rx_ms, 2),
+            "regex_hits": len(rx_hits),
+        }
+
+    print(json.dumps(asyncio.run(run())))
+
+
+if __name__ == "__main__":
+    main()

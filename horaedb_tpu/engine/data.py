@@ -232,9 +232,18 @@ class SampleManager:
         return F.And(*parts)
 
     async def query_raw(
-        self, metric_id: int, tsids: list[int] | None, rng: TimeRange
+        self,
+        metric_id: int,
+        tsids: list[int] | None,
+        rng: TimeRange,
+        limit: int | None = None,
     ) -> pa.Table | None:
-        """Materialized (merged, deduped) sample rows."""
+        """Materialized (merged, deduped) sample rows.
+
+        `limit` pushes down into the scan: the per-segment async generator
+        stops being driven once enough rows accumulated, so later segments
+        are never read (the reference's scan-stream laziness,
+        storage.rs:335-370)."""
         if self._buffer_rows:
             # always flush (not just when _buffered > 0): an in-flight flush
             # has already detached the buffers but its SSTs may not be
@@ -242,10 +251,16 @@ class SampleManager:
             # consistent with acked writes
             await self.flush()
         batches = []
+        total = 0
         async for b in self._storage.scan(
             ScanRequest(range=rng, predicate=self._predicate(metric_id, tsids, rng))
         ):
+            if limit is not None and total + b.num_rows >= limit:
+                batches.append(b.slice(0, limit - total))
+                total = limit
+                break
             batches.append(b)
+            total += b.num_rows
         return pa.Table.from_batches(batches) if batches else None
 
     async def query_downsample(

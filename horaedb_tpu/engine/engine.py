@@ -40,6 +40,11 @@ class QueryRequest:
     # "ne" (!=), "re" (=~ full match), "nre" (!~)
     matchers: list[tuple[bytes, str, bytes]] = field(default_factory=list)
     bucket_ms: int | None = None  # None -> raw rows
+    # Raw-row limit PUSHED INTO the scan: segments stop being read once
+    # `limit` merged rows have accumulated (segments scan old->new), so a
+    # 100M-row table queried with limit=100k pays ~100k rows of work, not
+    # full materialization. None = unbounded. Ignored for bucketed queries.
+    limit: int | None = None
 
 
 class MetricEngine:
@@ -278,7 +283,9 @@ class MetricEngine:
         metric_id, tsids = resolved
         rng = TimeRange(req.start_ms, req.end_ms)
         if req.bucket_ms is None:
-            return await self.sample_mgr.query_raw(metric_id, tsids, rng)
+            return await self.sample_mgr.query_raw(
+                metric_id, tsids, rng, limit=req.limit
+            )
         filtered = tsids is not None
         if tsids is None:  # no tag filter: all series of the metric
             tsids = self.index_mgr.series_of(metric_id)
@@ -293,7 +300,7 @@ class MetricEngine:
             return None
         metric_id, tsids = resolved
         return await self.exemplar_mgr.query_raw(
-            metric_id, tsids, TimeRange(req.start_ms, req.end_ms)
+            metric_id, tsids, TimeRange(req.start_ms, req.end_ms), limit=req.limit
         )
 
     def label_values(self, metric: bytes, key: bytes) -> list[bytes]:

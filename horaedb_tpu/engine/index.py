@@ -6,6 +6,17 @@ the RFC: a `series` table mapping (metric_id, tsid) -> canonical series key,
 and an inverted `index` table mapping (metric_id, tag KV) -> posting list of
 TSIDs (RFC :114-136).
 
+Scale design (RFC's 10M-series design point): the index is TWO-TIER.
+
+- BASE: immutable numpy/arrow arrays per metric, built vectorized at open
+  (no per-row Python objects) — sorted tsid arrays for membership via
+  searchsorted, posting rows sorted by tag_hash for range lookup, tag
+  key/value kept as arrow binary arrays. Regex matchers evaluate once per
+  UNIQUE value via dictionary encoding, then fan out to series by code —
+  latency scales with distinct values, not series.
+- DELTA: plain dicts holding series registered since open; merged into a
+  fresh base (atomic swap) when it grows past a threshold.
+
 Query side: `find_tsids` intersects posting lists for the given tag filters
 — the host-side index probe whose result feeds the device-side TSID
 set-membership filter (SURVEY §7.7). Hash collisions are handled by
@@ -16,9 +27,11 @@ from __future__ import annotations
 
 import threading
 from collections import defaultdict
+from dataclasses import dataclass
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute as pc
 
 from horaedb_tpu.engine.tables import INDEX_SCHEMA, SERIES_SCHEMA
 from horaedb_tpu.engine.types import (
@@ -43,12 +56,17 @@ MAX_REGEX_LEN = 512
 # holding the GIL, so a thread offload alone cannot contain it.
 MAX_REGEX_SUBJECT_LEN = 4096
 
+# Delta series count that triggers a merge into the base arrays.
+DELTA_COMPACT_THRESHOLD = 65_536
+
 
 def _reject_catastrophic(pattern: str) -> None:
     """Reject patterns with nested unbounded repeats (the `(a+)+b` shape):
     sre backtracks exponentially on them while holding the GIL, freezing the
     whole process, not just the worker thread. A parse-tree walk catches the
-    common catastrophic shapes; the length caps bound what slips through."""
+    common catastrophic shapes; the length caps bound what slips through.
+    Deliberately strict: `([a-z]+\\.)+` -style selectors are refused too —
+    they are the textbook ReDoS shape on failing subjects."""
     import re._parser as sre_parse
 
     from horaedb_tpu.common.error import HoraeError
@@ -84,41 +102,184 @@ def _reject_catastrophic(pattern: str) -> None:
     walk(tree, False)
 
 
+def _compile_matcher(pattern: bytes):
+    import re as _re
+
+    from horaedb_tpu.common.error import HoraeError
+
+    if len(pattern) > MAX_REGEX_LEN:
+        raise HoraeError(
+            f"regex matcher too long ({len(pattern)} > {MAX_REGEX_LEN})"
+        )
+    decoded = pattern.decode(errors="replace")
+    _reject_catastrophic(decoded)
+    try:
+        return _re.compile(decoded)
+    except _re.error as e:
+        raise HoraeError(f"bad regex matcher {pattern!r}: {e}") from e
+
+
+def _subject_of(raw: bytes) -> str:
+    from horaedb_tpu.common.error import HoraeError
+
+    if len(raw) > MAX_REGEX_SUBJECT_LEN:
+        raise HoraeError(
+            f"label value too long for regex matcher "
+            f"({len(raw)} > {MAX_REGEX_SUBJECT_LEN} bytes); "
+            f"use equality filters for this label"
+        )
+    return raw.decode(errors="replace")
+
+
+@dataclass
+class _MetricIndex:
+    """One metric's immutable base arrays."""
+
+    tsids: np.ndarray       # u64, sorted — the series set
+    p_hash: np.ndarray      # u64 posting tag_hash, sorted
+    p_tsid: np.ndarray      # u64 aligned with p_hash
+    p_key: pa.Array         # binary aligned
+    p_value: pa.Array       # binary aligned
+
+    def has_tsid(self, tsid: int) -> bool:
+        i = np.searchsorted(self.tsids, np.uint64(tsid))
+        return i < len(self.tsids) and int(self.tsids[i]) == tsid
+
+    def posting(self, h: int, k: bytes, v: bytes) -> np.ndarray:
+        """TSIDs whose (k, v) posting matches — raw bytes verified."""
+        lo = np.searchsorted(self.p_hash, np.uint64(h), side="left")
+        hi = np.searchsorted(self.p_hash, np.uint64(h), side="right")
+        if lo == hi:
+            return self.p_tsid[0:0]
+        keys = self.p_key.slice(lo, hi - lo)
+        vals = self.p_value.slice(lo, hi - lo)
+        ok = pc.and_(pc.equal(keys, k), pc.equal(vals, v))
+        return self.p_tsid[lo:hi][np.asarray(ok.to_numpy(zero_copy_only=False))]
+
+    def key_rows(self, k: bytes) -> tuple[np.ndarray, pa.Array]:
+        """(tsids, values) of every posting row whose key == k."""
+        ok = np.asarray(pc.equal(self.p_key, k).to_numpy(zero_copy_only=False))
+        idx = np.flatnonzero(ok)
+        return self.p_tsid[idx], self.p_value.take(pa.array(idx))
+
+
+_EMPTY = _MetricIndex(
+    tsids=np.empty(0, np.uint64),
+    p_hash=np.empty(0, np.uint64),
+    p_tsid=np.empty(0, np.uint64),
+    p_key=pa.array([], pa.binary()),
+    p_value=pa.array([], pa.binary()),
+)
+
+
+def _build_base(
+    s_mid: np.ndarray, s_tsid: np.ndarray,
+    i_mid: np.ndarray, i_hash: np.ndarray, i_tsid: np.ndarray,
+    i_key: pa.Array, i_value: pa.Array,
+) -> dict[int, _MetricIndex]:
+    """Group flat table arrays into per-metric sorted bases — vectorized,
+    no per-row Python."""
+    out: dict[int, _MetricIndex] = {}
+    if len(s_mid):
+        order = np.lexsort((s_tsid, s_mid))
+        s_mid, s_tsid = s_mid[order], s_tsid[order]
+        mids, starts = np.unique(s_mid, return_index=True)
+        bounds = np.append(starts, len(s_mid))
+        for j, m in enumerate(mids.tolist()):
+            ts = np.unique(s_tsid[bounds[j]:bounds[j + 1]])
+            out[m] = _MetricIndex(
+                tsids=ts,
+                p_hash=_EMPTY.p_hash, p_tsid=_EMPTY.p_tsid,
+                p_key=_EMPTY.p_key, p_value=_EMPTY.p_value,
+            )
+    if len(i_mid):
+        order = np.lexsort((i_hash, i_mid))
+        i_mid, i_hash, i_tsid = i_mid[order], i_hash[order], i_tsid[order]
+        take = pa.array(order)
+        i_key = i_key.take(take)
+        i_value = i_value.take(take)
+        mids, starts = np.unique(i_mid, return_index=True)
+        bounds = np.append(starts, len(i_mid))
+        for j, m in enumerate(mids.tolist()):
+            lo, hi = int(bounds[j]), int(bounds[j + 1])
+            prev = out.get(m, _EMPTY)
+            out[m] = _MetricIndex(
+                tsids=prev.tsids,
+                p_hash=i_hash[lo:hi],
+                p_tsid=i_tsid[lo:hi],
+                p_key=i_key.slice(lo, hi - lo).combine_chunks()
+                if isinstance(i_key, pa.ChunkedArray) else i_key.slice(lo, hi - lo),
+                p_value=i_value.slice(lo, hi - lo).combine_chunks()
+                if isinstance(i_value, pa.ChunkedArray) else i_value.slice(lo, hi - lo),
+            )
+    return out
+
+
 class IndexManager:
     def __init__(self, series_storage, index_storage, segment_duration_ms: int):
         self._series = series_storage
         self._index = index_storage
         self._segment_duration = segment_duration_ms
-        # (metric_id, tsid) set of known series — write-through cache
-        self._known: set[tuple[int, int]] = set()
+        # BASE tier: metric_id -> immutable arrays (atomic reference swap)
+        self._base: dict[int, _MetricIndex] = {}
+        # DELTA tier (series registered since open/compact):
+        # metric_id -> tsids registered since the base was built
+        self._metric_known: dict[int, set[int]] = defaultdict(set)
+        self._delta_series = 0
         # (metric_id, tag_hash) -> {tsid -> (key, value)} posting lists
         self._postings: dict[tuple[int, int], dict[int, tuple[bytes, bytes]]] = defaultdict(dict)
         # metric_id -> its posting keys (per-metric scans stay O(one metric))
         self._metric_postings: dict[int, set[tuple[int, int]]] = defaultdict(set)
-        # Guards the three structures above: queries run in worker threads
-        # (engine.py::_resolve_query_async) while ingest mutates on the event
-        # loop; iterating a mutating set/dict raises RuntimeError. Held only
-        # for in-memory access — never across awaits or regex evaluation.
+        # Guards the delta structures + the base reference: queries run in
+        # worker threads (engine.py::_resolve_query_async) while ingest
+        # mutates on the event loop; iterating a mutating set/dict raises
+        # RuntimeError. Held only for in-memory access — never across
+        # awaits or regex evaluation (base arrays are immutable, so readers
+        # use them lock-free after grabbing the reference). Lock sections
+        # copy ONLY what the query needs (per-hash postings, one metric's
+        # delta) — never the whole delta.
         self._mu = threading.Lock()
+        # Serializes delta->base compactions (run in a worker thread).
+        self._compact_lock: "asyncio.Lock | None" = None
 
     async def open(self) -> None:
+        s_mid, s_tsid = [], []
         async for batch in self._series.scan(ScanRequest(range=_ALL_TIME)):
-            for m, t in zip(
-                batch.column("metric_id").to_pylist(), batch.column("tsid").to_pylist()
-            ):
-                self._known.add((m, t))
+            s_mid.append(batch.column("metric_id").to_numpy(zero_copy_only=False))
+            s_tsid.append(batch.column("tsid").to_numpy(zero_copy_only=False))
+        i_mid, i_hash, i_tsid, i_key, i_val = [], [], [], [], []
         async for batch in self._index.scan(ScanRequest(range=_ALL_TIME)):
-            for m, h, t, k, v in zip(
-                batch.column("metric_id").to_pylist(),
-                batch.column("tag_hash").to_pylist(),
-                batch.column("tsid").to_pylist(),
-                batch.column("tag_key").to_pylist(),
-                batch.column("tag_value").to_pylist(),
-            ):
-                self._postings[(m, h)][t] = (k, v)
-                self._metric_postings[m].add((m, h))
+            i_mid.append(batch.column("metric_id").to_numpy(zero_copy_only=False))
+            i_hash.append(batch.column("tag_hash").to_numpy(zero_copy_only=False))
+            i_tsid.append(batch.column("tsid").to_numpy(zero_copy_only=False))
+            i_key.append(batch.column("tag_key"))
+            i_val.append(batch.column("tag_value"))
+
+        def cat(parts, dtype):
+            return (np.concatenate(parts).astype(dtype, copy=False)
+                    if parts else np.empty(0, dtype))
+
+        def cat_arrow(parts):
+            if not parts:
+                return _EMPTY.p_key
+            return pa.concat_arrays(
+                [c for p in parts for c in (p.chunks if isinstance(p, pa.ChunkedArray) else [p])]
+            )
+
+        self._base = _build_base(
+            cat(s_mid, np.uint64), cat(s_tsid, np.uint64),
+            cat(i_mid, np.uint64), cat(i_hash, np.uint64), cat(i_tsid, np.uint64),
+            cat_arrow(i_key), cat_arrow(i_val),
+        )
 
     # -- write path ----------------------------------------------------------
+    def _is_known(self, mid: int, tsid: int) -> bool:
+        base = self._base.get(mid)
+        if base is not None and base.has_tsid(tsid):
+            return True
+        delta = self._metric_known.get(mid)
+        return delta is not None and tsid in delta
+
     async def populate_series_ids(
         self,
         metric_ids: list[int],
@@ -135,7 +296,7 @@ class IndexManager:
             key = series_key_of(labels)
             tsid = series_id_of(key)
             tsids.append(tsid)
-            if (mid, tsid) in self._known or (mid, tsid) in staged:
+            if self._is_known(mid, tsid) or (mid, tsid) in staged:
                 continue
             staged.add((mid, tsid))
             new_series_rows.append((mid, tsid, key))
@@ -147,18 +308,116 @@ class IndexManager:
             # index rows never land, silently dropping it from tag queries
             # after the client's retry (and from recovery after restart).
             await self._persist(new_series_rows, new_index_rows, now_ms)
-            self._commit_rows(new_series_rows, new_index_rows)
+            if self._commit_rows(new_series_rows, new_index_rows):
+                await self._compact_delta()
         return tsids
 
-    def _commit_rows(self, series_rows, index_rows) -> None:
-        """Apply persisted rows to the in-memory caches (under the lock —
-        queries read these structures from worker threads)."""
+    def _commit_rows(self, series_rows, index_rows) -> bool:
+        """Apply persisted rows to the in-memory delta (under the lock —
+        queries read these structures from worker threads). Returns True
+        when the delta is due for compaction."""
         with self._mu:
             for mid, tsid, _key in series_rows:
-                self._known.add((mid, tsid))
+                s = self._metric_known[mid]
+                if tsid not in s:
+                    s.add(tsid)
+                    self._delta_series += 1
             for mid, h, tsid, k, v in index_rows:
                 self._postings[(mid, h)][tsid] = (k, v)
                 self._metric_postings[mid].add((mid, h))
+            return self._delta_series >= DELTA_COMPACT_THRESHOLD
+
+    async def _compact_delta(self) -> None:
+        """Merge the delta dicts into fresh base arrays (atomic swap).
+
+        The heavy merge runs in a worker thread — the base is immutable, so
+        the event loop only pays the two short lock sections. Registrations
+        that land WHILE merging survive: the swap subtracts exactly the
+        snapshot that was merged instead of clearing the delta."""
+        import asyncio
+
+        if self._compact_lock is None:
+            self._compact_lock = asyncio.Lock()
+        async with self._compact_lock:
+            with self._mu:
+                known = {m: set(s) for m, s in self._metric_known.items()}
+                postings = {k: dict(v) for k, v in self._postings.items()}
+                base = self._base
+            merged = await asyncio.to_thread(
+                self._merge_delta_into_base, base, known, postings
+            )
+            with self._mu:
+                self._base = merged
+                for m, s in known.items():
+                    live = self._metric_known.get(m)
+                    if live is not None:
+                        live -= s
+                        self._delta_series -= len(s)
+                        if not live:
+                            del self._metric_known[m]
+                for pk, rows in postings.items():
+                    live_rows = self._postings.get(pk)
+                    if live_rows is None:
+                        continue
+                    for t in rows:
+                        live_rows.pop(t, None)
+                    if not live_rows:
+                        del self._postings[pk]
+                        mp = self._metric_postings.get(pk[0])
+                        if mp is not None:
+                            mp.discard(pk)
+                            if not mp:
+                                del self._metric_postings[pk[0]]
+
+    @staticmethod
+    def _merge_delta_into_base(
+        base: dict[int, _MetricIndex], known, postings
+    ) -> dict[int, _MetricIndex]:
+        s_mid_l, s_tsid_l = [], []
+        for m, s in known.items():
+            s_mid_l.extend([m] * len(s))
+            s_tsid_l.extend(s)
+        i_mid, i_hash, i_tsid, i_key, i_val = [], [], [], [], []
+        for (m, h), rows in postings.items():
+            for t, (k, v) in rows.items():
+                i_mid.append(m)
+                i_hash.append(h)
+                i_tsid.append(t)
+                i_key.append(k)
+                i_val.append(v)
+        delta_base = _build_base(
+            np.asarray(s_mid_l, dtype=np.uint64),
+            np.asarray(s_tsid_l, dtype=np.uint64),
+            np.asarray(i_mid, dtype=np.uint64),
+            np.asarray(i_hash, dtype=np.uint64),
+            np.asarray(i_tsid, dtype=np.uint64),
+            pa.array(i_key, pa.binary()),
+            pa.array(i_val, pa.binary()),
+        )
+        merged: dict[int, _MetricIndex] = dict(base)
+        for m, d in delta_base.items():
+            b = merged.get(m)
+            if b is None:
+                merged[m] = d
+                continue
+            order = np.argsort(
+                np.concatenate([b.p_hash, d.p_hash]), kind="stable"
+            )
+            ph = np.concatenate([b.p_hash, d.p_hash])[order]
+            pt = np.concatenate([b.p_tsid, d.p_tsid])[order]
+            keys = pa.concat_arrays([
+                *(b.p_key.chunks if isinstance(b.p_key, pa.ChunkedArray) else [b.p_key]),
+                *(d.p_key.chunks if isinstance(d.p_key, pa.ChunkedArray) else [d.p_key]),
+            ]).take(pa.array(order))
+            vals = pa.concat_arrays([
+                *(b.p_value.chunks if isinstance(b.p_value, pa.ChunkedArray) else [b.p_value]),
+                *(d.p_value.chunks if isinstance(d.p_value, pa.ChunkedArray) else [d.p_value]),
+            ]).take(pa.array(order))
+            merged[m] = _MetricIndex(
+                tsids=np.unique(np.concatenate([b.tsids, d.tsids])),
+                p_hash=ph, p_tsid=pt, p_key=keys, p_value=vals,
+            )
+        return merged
 
     async def ensure_series_fast(
         self,
@@ -172,18 +431,17 @@ class IndexManager:
         (key decode + posting rows). The Python seahash remains the
         differential oracle in tests, per the reference hash contract
         (src/metric_engine/src/types.rs:18-41)."""
-        known = self._known
         new_idx: list[int] = []
         staged: set[tuple[int, int]] = set()
-        for i, (m, t) in enumerate(zip(metric_ids.tolist(), tsids.tolist())):
-            if (m, t) in known or (m, t) in staged:
+        mids = metric_ids.tolist()
+        tids = tsids.tolist()
+        for i, (m, t) in enumerate(zip(mids, tids)):
+            if (m, t) in staged or self._is_known(m, t):
                 continue
             staged.add((m, t))
             new_idx.append(i)
         if not new_idx:
             return
-        mids = metric_ids.tolist()
-        tids = tsids.tolist()
         new_series_rows: list[tuple[int, int, bytes]] = []
         new_index_rows: list[tuple[int, int, int, bytes, bytes]] = []
         for i in new_idx:
@@ -193,7 +451,8 @@ class IndexManager:
                 new_index_rows.append((mids[i], tag_hash_of(k, v), tids[i], k, v))
         # persist-before-cache, same reasoning as populate_series_ids
         await self._persist(new_series_rows, new_index_rows, now_ms)
-        self._commit_rows(new_series_rows, new_index_rows)
+        if self._commit_rows(new_series_rows, new_index_rows):
+            await self._compact_delta()
 
     async def _persist(self, series_rows, index_rows, now_ms: int) -> None:
         seg_start = now_ms - now_ms % self._segment_duration
@@ -221,6 +480,16 @@ class IndexManager:
             await self._index.write(WriteRequest(i_batch, rng))
 
     # -- query path ------------------------------------------------------------
+    def _metric_delta(self, metric_id: int):
+        """Copy ONE metric's delta (postings + tsids) under the lock — used
+        by matcher/listing paths; equality filters copy per-hash instead."""
+        with self._mu:
+            base = self._base.get(metric_id, _EMPTY)
+            delta_keys = list(self._metric_postings.get(metric_id, ()))
+            delta_postings = {pk: dict(self._postings[pk]) for pk in delta_keys}
+            delta_tsids = set(self._metric_known.get(metric_id, ()))
+        return base, delta_postings, delta_tsids
+
     def find_tsids(
         self,
         metric_id: int,
@@ -233,8 +502,8 @@ class IndexManager:
 
         `matchers` extends equality with Prometheus-style ops per
         (key, op, pattern): "ne" (!=), "re" (=~ full-match), "nre" (!~).
-        Non-equality matchers evaluate against the metric's own postings
-        (O(one metric), the RFC's two-step fallback shape)."""
+        Base postings evaluate regexes once per unique value (arrow
+        dictionary encoding); only matching series materialize Python ints."""
         if not filters and not matchers:
             return None
         result: set[int] | None = None
@@ -244,96 +513,112 @@ class IndexManager:
             result = matched if result is None else (result & matched)
             return bool(result)
 
-        # Structure access happens under the lock (this runs in a worker
-        # thread while ingest mutates on the event loop); regex evaluation
-        # happens on snapshots after release.
-        matcher_values: list[dict[int, bytes]] = []
-        with self._mu:
-            for k, v in filters:
-                h = tag_hash_of(k, v)
-                posting = self._postings.get((metric_id, h), {})
-                if not intersect({t for t, kv in posting.items() if kv == (k, v)}):
+        if filters:
+            hashes = [tag_hash_of(k, v) for k, v in filters]
+            with self._mu:
+                base = self._base.get(metric_id, _EMPTY)
+                flt_delta = [
+                    dict(self._postings.get((metric_id, h), {})) for h in hashes
+                ]
+            for (k, v), h, drows in zip(filters, hashes, flt_delta):
+                matched = set(base.posting(h, k, v).tolist())
+                for t, kv in drows.items():
+                    if kv == (k, v):
+                        matched.add(t)
+                if not intersect(matched):
                     return []
-            all_tsids: set[int] | None = None
-            if matchers:
-                all_tsids = {t for m, t in self._known if m == metric_id}
-                # one O(postings) pass collects values for every matcher key
-                # (the lock blocks event-loop ingest while held — don't
-                # re-walk the postings per matcher). Prometheus semantics:
-                # an absent label reads as empty for both =~ and !~.
-                wanted = {k for k, _op, _p in matchers}
-                values_by_key: dict[bytes, dict[int, bytes]] = {
-                    k: {} for k in wanted
-                }
-                for pk in self._metric_postings.get(metric_id, ()):
-                    for tsid, (kk, vv) in self._postings[pk].items():
-                        if kk in wanted:
-                            values_by_key[kk][tsid] = vv
-                matcher_values = [values_by_key[k] for k, _op, _p in matchers]
-        for (k, op, pattern), values in zip(matchers or (), matcher_values):
-            if op == "ne":
-                matched = {t for t in all_tsids if values.get(t, b"") != pattern}
-            elif op in ("re", "nre"):
-                import re as _re
-
-                from horaedb_tpu.common.error import HoraeError
-
-                if len(pattern) > MAX_REGEX_LEN:
-                    raise HoraeError(
-                        f"regex matcher too long ({len(pattern)} > {MAX_REGEX_LEN})"
+        if matchers:
+            base, delta_postings, delta_tsids = self._metric_delta(metric_id)
+            all_tsids = set(base.tsids.tolist()) | delta_tsids
+            for k, op, pattern in matchers:
+                # base rows for this key, dictionary-encoded: the predicate
+                # evaluates once per UNIQUE value, series fan out by code
+                b_tsids, b_values = base.key_rows(k)
+                enc = b_values.dictionary_encode()
+                uniq_vals = enc.dictionary.to_pylist()
+                codes = np.asarray(enc.indices.to_numpy(zero_copy_only=False))
+                # delta overlay (small): tsid -> value for this key; delta
+                # wins over base on duplicates
+                delta_vals: dict[int, bytes] = {}
+                for _pk, rows in delta_postings.items():
+                    for t, (kk, vv) in rows.items():
+                        if kk == k:
+                            delta_vals[t] = vv
+                if op == "ne":
+                    # absent label reads as b"": it matches != pattern
+                    # unless the pattern is itself empty
+                    ok_uniq = np.asarray([v != pattern for v in uniq_vals], bool)
+                elif op in ("re", "nre"):
+                    rx = _compile_matcher(pattern)
+                    ok_uniq = np.asarray(
+                        [rx.fullmatch(_subject_of(v)) is not None for v in uniq_vals],
+                        bool,
                     )
-                decoded = pattern.decode(errors="replace")
-                _reject_catastrophic(decoded)
-                try:
-                    rx = _re.compile(decoded)
-                except _re.error as e:
-                    raise HoraeError(f"bad regex matcher {pattern!r}: {e}") from e
+                else:
+                    from horaedb_tpu.common.error import HoraeError
 
-                def subject(t: int) -> str:
-                    raw = values.get(t, b"")
-                    if len(raw) > MAX_REGEX_SUBJECT_LEN:
-                        raise HoraeError(
-                            f"label value too long for regex matcher "
-                            f"({len(raw)} > {MAX_REGEX_SUBJECT_LEN} bytes); "
-                            f"use equality filters for this label"
-                        )
-                    return raw.decode(errors="replace")
-
-                hit = {t for t in all_tsids if rx.fullmatch(subject(t))}
-                matched = hit if op == "re" else (all_tsids - hit)
-            else:
-                from horaedb_tpu.common.error import HoraeError
-
-                raise HoraeError(f"unknown matcher op: {op!r}")
-            if not intersect(matched):
-                return []
+                    raise HoraeError(f"unknown matcher op: {op!r}")
+                hit = (
+                    set(b_tsids[ok_uniq[codes]].tolist())
+                    if len(b_tsids) else set()
+                )
+                present = set(b_tsids.tolist()) | set(delta_vals)
+                # delta overlay corrections
+                for t, v in delta_vals.items():
+                    if op == "ne":
+                        v_ok = v != pattern
+                    else:
+                        v_ok = rx.fullmatch(_subject_of(v)) is not None
+                    (hit.add if v_ok else hit.discard)(t)
+                # absent-label semantics: value reads as b""
+                if op == "ne":
+                    if pattern != b"":
+                        hit |= all_tsids - present
+                    matched = hit
+                else:
+                    if rx.fullmatch(""):
+                        hit |= all_tsids - present
+                    matched = hit if op == "re" else (all_tsids - hit)
+                if not intersect(matched):
+                    return []
         return sorted(result)
 
     def series_of(self, metric_id: int) -> list[SeriesId]:
         """All known TSIDs of a metric (the no-tag-filter downsample scope)."""
         with self._mu:
-            return sorted(t for m, t in self._known if m == metric_id)
+            base = self._base.get(metric_id, _EMPTY)
+            delta_tsids = set(self._metric_known.get(metric_id, ()))
+        return sorted(set(base.tsids.tolist()) | delta_tsids)
 
     def label_values(self, metric_id: int, key: bytes) -> list[bytes]:
         """LabelValues via the inverted index (the RFC's two-step fallback,
-        RFC :120-130)."""
-        out = set()
-        with self._mu:
-            for pk in self._metric_postings.get(metric_id, ()):
-                for kv in self._postings[pk].values():
-                    if kv[0] == key:
-                        out.add(kv[1])
+        RFC :120-130). Unique values come straight from the dictionary —
+        no per-series materialization."""
+        base, delta_postings, _dt = self._metric_delta(metric_id)
+        _tsids, b_values = base.key_rows(key)
+        out = set(b_values.dictionary_encode().dictionary.to_pylist())
+        for _pk, rows in delta_postings.items():
+            for _t, (kk, vv) in rows.items():
+                if kk == key:
+                    out.add(vv)
         return sorted(out)
 
     def series_labels(self, metric_id: int) -> dict[int, dict[bytes, bytes]]:
         """tsid -> label map for every series of a metric, including series
         with no tags at all (seeded from the known-series set so tagless
-        series don't vanish from listings)."""
-        with self._mu:
-            per_tsid: dict[int, dict[bytes, bytes]] = {
-                t: {} for m, t in self._known if m == metric_id
-            }
-            for pk in self._metric_postings.get(metric_id, ()):
-                for tsid, (k, v) in self._postings[pk].items():
-                    per_tsid.setdefault(tsid, {})[k] = v
+        series don't vanish from listings). Materializes Python objects —
+        an admin/listing surface, not a hot path."""
+        base, delta_postings, delta_tsids = self._metric_delta(metric_id)
+        per_tsid: dict[int, dict[bytes, bytes]] = {
+            int(t): {} for t in base.tsids
+        }
+        for t in delta_tsids:
+            per_tsid.setdefault(t, {})
+        kcol = base.p_key.to_pylist()
+        vcol = base.p_value.to_pylist()
+        for t, k, v in zip(base.p_tsid.tolist(), kcol, vcol):
+            per_tsid.setdefault(t, {})[k] = v
+        for _pk, rows in delta_postings.items():
+            for tsid, (k, v) in rows.items():
+                per_tsid.setdefault(tsid, {})[k] = v
         return per_tsid

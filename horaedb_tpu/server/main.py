@@ -213,6 +213,9 @@ async def handle_query(request: web.Request) -> web.Response:
             matchers.append(
                 (spec["key"].encode(), spec["op"], spec["pattern"].encode())
             )
+        limit = min(int(q.get("limit", 100_000)), 1_000_000)
+        if limit < 0:
+            raise ValueError("limit must be >= 0")
         req = QueryRequest(
             metric=q["metric"].encode(),
             start_ms=int(q["start_ms"]),
@@ -220,10 +223,10 @@ async def handle_query(request: web.Request) -> web.Response:
             filters=[(k.encode(), v.encode()) for k, v in q.get("filters", {}).items()],
             matchers=matchers,
             bucket_ms=q.get("bucket_ms"),
+            # +1 so the response can report `truncated` without paying for
+            # unbounded materialization
+            limit=limit + 1,
         )
-        limit = min(int(q.get("limit", 100_000)), 1_000_000)
-        if limit < 0:
-            raise ValueError("limit must be >= 0")
     except Exception as e:  # noqa: BLE001
         return web.json_response({"error": f"bad query: {e}"}, status=400)
     METRICS.inc("horaedb_queries_total")

@@ -472,3 +472,85 @@ class TestBufferedFlushFailure:
         t = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000))
         assert sorted(t.column("value").to_pylist()) == [1.0, 2.0, 3.0]
         await eng.close()
+
+
+class TestLimitPushdown:
+    @async_test
+    async def test_limit_stops_reading_later_segments(self):
+        """limit pushes into the scan: once enough merged rows accumulated,
+        later segments' SSTs are never read (reference scan-stream laziness,
+        storage.rs:335-370)."""
+        store = MemStore()
+        eng = await open_engine(store)
+        # 5 segments (1h each), 10 rows apiece, oldest first
+        payloads = []
+        for seg in range(5):
+            base = seg * HOUR + 1000
+            payloads.append(make_remote_write(
+                [({"__name__": "cpu", "host": "a"},
+                  [(base + i, float(seg * 100 + i)) for i in range(10)])]
+            ))
+        for p in payloads:
+            await eng.write_parsed(PooledParser.decode(p))
+
+        reader = eng.data_table.parquet_reader
+        orig = reader.read_sst
+        touched = []
+
+        async def spy(sst, columns, predicate):
+            touched.append(sst.id)
+            return await orig(sst, columns, predicate)
+
+        reader.read_sst = spy
+        t = await eng.query(
+            QueryRequest(metric=b"cpu", start_ms=0, end_ms=10 * HOUR, limit=12)
+        )
+        assert t.num_rows == 12
+        # rows come oldest-first; 12 rows need exactly 2 of the 5 segments
+        assert len(touched) == 2, touched
+        # values are the oldest 12
+        assert t.column("value").to_pylist() == [float(i) for i in range(10)] + [100.0, 101.0]
+        reader.read_sst = orig
+        # unlimited query still sees everything
+        t_all = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=10 * HOUR))
+        assert t_all.num_rows == 50
+        await eng.close()
+
+
+class TestIndexDeltaCompaction:
+    @async_test
+    async def test_compaction_preserves_queries(self, monkeypatch):
+        """Delta->base merges must be invisible to queries: register past
+        the threshold, then every lookup still sees every series."""
+        import horaedb_tpu.engine.index as index_mod
+
+        monkeypatch.setattr(index_mod, "DELTA_COMPACT_THRESHOLD", 10)
+        store = MemStore()
+        eng = await open_engine(store)
+        for batch in range(4):
+            payload = make_remote_write(
+                [
+                    ({"__name__": "cpu", "host": f"h{batch}-{i}",
+                      "region": ["us", "eu"][i % 2]}, [(1000 + i, 1.0)])
+                    for i in range(6)
+                ]
+            )
+            await eng.write_parsed(PooledParser.decode(payload))
+        mgr = eng.index_mgr
+        mid = eng.metric_mgr.get(b"cpu")[0]
+        # base tier must now hold compacted series; delta below threshold
+        assert mgr._delta_series < 24
+        assert len(mgr.series_of(mid)) == 24
+        hits = mgr.find_tsids(mid, [(b"host", b"h2-3")])
+        assert len(hits) == 1
+        us = mgr.find_tsids(mid, [], matchers=[(b"region", "re", b"us")])
+        assert len(us) == 12
+        assert mgr.label_values(mid, b"region") == [b"eu", b"us"]
+        labels = mgr.series_labels(mid)
+        assert len(labels) == 24
+        # restart: storage-backed recovery equals in-memory state
+        await eng.close()
+        eng2 = await open_engine(store)
+        mid2 = eng2.metric_mgr.get(b"cpu")[0]
+        assert eng2.index_mgr.series_of(mid2) == mgr.series_of(mid)
+        await eng2.close()
