@@ -250,17 +250,23 @@ class SampleManager:
             # durable yet — flush() waits on the lock, keeping reads
             # consistent with acked writes
             await self.flush()
+        from contextlib import aclosing
+
         batches = []
         total = 0
-        async for b in self._storage.scan(
+        # aclosing: an early break must run the generator's finally NOW so
+        # the prefetched next-segment read is cancelled deterministically
+        # (asyncgen GC finalization would let it issue the wasted I/O first)
+        async with aclosing(self._storage.scan(
             ScanRequest(range=rng, predicate=self._predicate(metric_id, tsids, rng))
-        ):
-            if limit is not None and total + b.num_rows >= limit:
-                batches.append(b.slice(0, limit - total))
-                total = limit
-                break
-            batches.append(b)
-            total += b.num_rows
+        )) as gen:
+            async for b in gen:
+                if limit is not None and total + b.num_rows >= limit:
+                    batches.append(b.slice(0, limit - total))
+                    total = limit
+                    break
+                batches.append(b)
+                total += b.num_rows
         return pa.Table.from_batches(batches) if batches else None
 
     async def query_downsample(

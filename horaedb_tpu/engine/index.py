@@ -340,6 +340,10 @@ class IndexManager:
             self._compact_lock = asyncio.Lock()
         async with self._compact_lock:
             with self._mu:
+                # re-check: writers queued behind an in-flight merge must
+                # not each repeat a full-base merge on a near-empty delta
+                if self._delta_series < DELTA_COMPACT_THRESHOLD:
+                    return
                 known = {m: set(s) for m, s in self._metric_known.items()}
                 postings = {k: dict(v) for k, v in self._postings.items()}
                 base = self._base
@@ -529,7 +533,16 @@ class IndexManager:
                     return []
         if matchers:
             base, delta_postings, delta_tsids = self._metric_delta(metric_id)
-            all_tsids = set(base.tsids.tolist()) | delta_tsids
+            # all_tsids/present materialize O(series) Python ints — computed
+            # lazily, only in the branches that actually union over absent
+            # series ('nre', '!= non-empty', or a regex matching empty)
+            _all: list[set] = []
+
+            def all_tsids() -> set:
+                if not _all:
+                    _all.append(set(base.tsids.tolist()) | delta_tsids)
+                return _all[0]
+
             for k, op, pattern in matchers:
                 # base rows for this key, dictionary-encoded: the predicate
                 # evaluates once per UNIQUE value, series fan out by code
@@ -562,7 +575,6 @@ class IndexManager:
                     set(b_tsids[ok_uniq[codes]].tolist())
                     if len(b_tsids) else set()
                 )
-                present = set(b_tsids.tolist()) | set(delta_vals)
                 # delta overlay corrections
                 for t, v in delta_vals.items():
                     if op == "ne":
@@ -570,15 +582,19 @@ class IndexManager:
                     else:
                         v_ok = rx.fullmatch(_subject_of(v)) is not None
                     (hit.add if v_ok else hit.discard)(t)
-                # absent-label semantics: value reads as b""
+
+                def absent() -> set:
+                    # absent-label semantics: value reads as b""
+                    return all_tsids() - (set(b_tsids.tolist()) | set(delta_vals))
+
                 if op == "ne":
                     if pattern != b"":
-                        hit |= all_tsids - present
+                        hit |= absent()
                     matched = hit
                 else:
                     if rx.fullmatch(""):
-                        hit |= all_tsids - present
-                    matched = hit if op == "re" else (all_tsids - hit)
+                        hit |= absent()
+                    matched = hit if op == "re" else (all_tsids() - hit)
                 if not intersect(matched):
                     return []
         return sorted(result)

@@ -19,6 +19,25 @@ from jax.sharding import Mesh
 from horaedb_tpu.common.error import ensure
 
 
+# Ambient mesh: the engine's storage paths (e.g. aggregate pushdown in
+# storage/read.py) dispatch through the sharded kernels whenever an active
+# mesh with >1 device is installed — the single-device paths stay the
+# default so laptop CPU and one-chip runs never pay sharding overhead.
+_ACTIVE: Mesh | None = None
+
+
+def set_active_mesh(mesh: "Mesh | None") -> None:
+    global _ACTIVE
+    _ACTIVE = mesh
+
+
+def active_mesh() -> "Mesh | None":
+    """The installed mesh, or None when absent/degenerate (size 1)."""
+    if _ACTIVE is None or _ACTIVE.size <= 1:
+        return None
+    return _ACTIVE
+
+
 def mesh_devices(n: int | None = None) -> list:
     devs = jax.devices()
     if n is None:

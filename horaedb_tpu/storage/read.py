@@ -667,13 +667,27 @@ class ParquetReader:
             hit = series_ids[pos_c] == col
             return np.where(hit, pos_c, -1).astype(np.int32)
 
+        from horaedb_tpu.parallel.mesh import active_mesh
+
+        mesh = active_mesh()
+
         def accumulate_sorted(ts_np, sid_np, val_np):
-            """Fold one sorted run into the grids (sorted-segment fast path)."""
-            out = agg_ops.downsample_sorted(
-                ts_np, sid_np, val_np, t0, bucket_ms,
-                num_series=num_series, num_buckets=num_buckets,
-                with_minmax=with_minmax,
-            )
+            """Fold one sorted run into the grids (sorted-segment fast path).
+            With an ambient multi-device mesh installed, rows shard over
+            "rows" and the output grid over "series" (SURVEY §2.5's
+            shard_map-over-SST-partitions); partials combine via psum/pmin/
+            pmax over ICI. Single device: the local sorted kernel."""
+            if mesh is not None:
+                out = self._sharded_accumulate(
+                    mesh, ts_np, sid_np, val_np, t0, bucket_ms,
+                    num_series, num_buckets, with_minmax,
+                )
+            else:
+                out = agg_ops.downsample_sorted(
+                    ts_np, sid_np, val_np, t0, bucket_ms,
+                    num_series=num_series, num_buckets=num_buckets,
+                    with_minmax=with_minmax,
+                )
             grids["sum"] += np.asarray(out["sum"])
             grids["count"] += np.asarray(out["count"])
             if with_minmax:
@@ -708,6 +722,16 @@ class ParquetReader:
         sorted_cols, _perm, keep, _starts, _kept, _num, _bin = self._fused_pass(
             table, predicate, extra_arrays={"__sid__": sid}
         )
+        if mesh is not None:
+            # mesh path: the merged/deduped rows leave the fused pass and
+            # shard over the mesh for the reduction
+            keep_np = np.asarray(keep)
+            accumulate_sorted(
+                np.asarray(sorted_cols[ts_column]).astype(np.int64),
+                np.where(keep_np, np.asarray(sorted_cols["__sid__"]), -1).astype(np.int32),
+                np.asarray(sorted_cols[value_column]),
+            )
+            return grids
         # device-side reduction of the surviving rows (keep is a mask)
         out = agg_ops.downsample(
             sorted_cols[ts_column].astype(jnp.int64),
@@ -722,6 +746,39 @@ class ParquetReader:
         for k in list(grids):
             grids[k] = np.asarray(out[k])
         return grids
+
+    @staticmethod
+    def _sharded_accumulate(
+        mesh, ts_np, sid_np, val_np, t0, bucket_ms,
+        num_series: int, num_buckets: int, with_minmax: bool,
+    ) -> dict:
+        """One sorted run reduced over the ambient mesh: rows shard over
+        "rows" (psum/pmin/pmax combine the partial grids over ICI), the
+        output grid shards over "series" (padded up to the axis size)."""
+        from horaedb_tpu.parallel.scan import shard_rows, sharded_downsample
+
+        series_par = mesh.shape["series"]
+        padded_series = num_series + (-num_series % series_par)
+        (ts_d, sid_d, val_d), valid = shard_rows(
+            mesh,
+            (
+                np.ascontiguousarray(ts_np, dtype=np.int64),
+                np.ascontiguousarray(sid_np, dtype=np.int32),
+                np.ascontiguousarray(val_np, dtype=np.float64).astype(np.float32),
+            ),
+            pad_value=0,
+        )
+        out = sharded_downsample(
+            mesh, ts_d, sid_d, val_d, valid,
+            t0=t0, bucket_ms=bucket_ms,
+            num_series=padded_series, num_buckets=num_buckets,
+            with_minmax=with_minmax, sorted_input=True,
+        )
+        return {
+            k: np.asarray(v)[:num_series]
+            for k, v in out.items()
+            if k in ("sum", "count", "min", "max")
+        }
 
     # -- shared prologue/epilogue ---------------------------------------------
     def _resolve_read_names(self, projections: list[int] | None, keep_builtin: bool) -> list[str]:

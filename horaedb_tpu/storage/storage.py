@@ -443,18 +443,38 @@ class ObjectBasedStorage(ColumnarStorage):
 
     # -- scan path (storage.rs:335-370) --------------------------------------
     async def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]:
+        """Per-segment scans, old segments first. The NEXT segment's
+        read+kernel overlaps with the consumer draining the current one
+        (bounded one-segment prefetch — the async analog of the reference's
+        UnionExec driving per-segment plans concurrently); an early consumer
+        break (limit pushdown) cancels the prefetch."""
         ssts = self._manifest.find_ssts(req.range)
         if not ssts:
             return
-        for segment_ssts in self.group_by_segment(ssts):
-            batches = await self._reader.scan_segment(
-                segment_ssts,
+        segments = self.group_by_segment(ssts)
+
+        def start(seg):
+            return asyncio.ensure_future(self._reader.scan_segment(
+                seg,
                 predicate=req.predicate,
                 projections=req.projections,
                 keep_builtin=False,
-            )
-            for b in batches:
-                yield b
+            ))
+
+        pending = start(segments[0])
+        try:
+            for i in range(len(segments)):
+                batches = await pending
+                pending = start(segments[i + 1]) if i + 1 < len(segments) else None
+                for b in batches:
+                    yield b
+        finally:
+            if pending is not None:
+                pending.cancel()
+                try:
+                    await pending
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
 
     def group_by_segment(self, ssts: list[SstFile]) -> list[list[SstFile]]:
         """Bucket SSTs by segment start, ordered old->new (storage.rs:343-345)."""
