@@ -63,17 +63,15 @@ def main() -> None:
         eng = await MetricEngine.open(
             "db", store, enable_compaction=False, ingest_buffer_rows=256 * 1024
         )
-        pool = ParserPool()
         payloads = [make_payload(s) for s in range(n_payloads)]
         # warm (registers series, compiles the write-path sort)
-        await eng.write_parsed(await pool.decode(payloads[0]))
+        await eng.write_payload(payloads[0])
         await eng.flush()
 
         samples = 0
         start = time.perf_counter()
         for p in payloads:
-            parsed = await pool.decode(p)
-            samples += await eng.write_parsed(parsed)
+            samples += await eng.write_payload(p)
         await eng.flush()  # timed: buffered rows must be durable to count
         elapsed = time.perf_counter() - start
         await eng.close()

@@ -53,6 +53,18 @@ class SampleManager:
         self._dense_keys: list[tuple[int, int]] = []
         self._chunks: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         self._buffered = 0
+        # Native C++ accumulator (ingest/native.py NativeAccum): samples go
+        # straight from the parser arena into C++ lanes, flushed pk-sorted.
+        # None when the native library is unavailable (Python chunk buffer
+        # serves instead).
+        self._accum = None
+        if buffer_rows > 0:
+            try:
+                from horaedb_tpu.ingest.native import NativeAccum
+
+                self._accum = NativeAccum()
+            except Exception:  # noqa: BLE001 — fall back to Python buffering
+                self._accum = None
         # Serializes flushes AND makes flush-before-query sound: a query's
         # flush() awaits any in-flight flush (whose snapshot is not yet
         # durable) before flushing the remainder.
@@ -61,6 +73,19 @@ class SampleManager:
     @property
     def buffering(self) -> bool:
         return self._buffer_rows > 0
+
+    @property
+    def native_accum_active(self) -> bool:
+        return self._accum is not None
+
+    def buffer_native_add(self, parser) -> int:
+        """Append the parser's current parse into the C++ accumulator
+        (engine.write_payload holds the parser borrowed). Returns total
+        buffered rows."""
+        return self._accum.add(parser)
+
+    def should_flush(self, rows: int) -> bool:
+        return rows >= self._buffer_rows
 
     async def persist(
         self,
@@ -145,6 +170,33 @@ class SampleManager:
             except BaseException:
                 self._restore_snapshot(buf, chunks, keys, snapshot_rows)
                 raise
+            if self._accum is not None and self._accum.rows:
+                await self._flush_accum()
+
+    async def _flush_accum(self) -> None:
+        """Drain the C++ accumulator: take the pk-sorted lanes (which also
+        CLEARS it, so rows buffered during the awaited writes are never
+        lost), split by segment, write. On failure the taken lanes re-buffer
+        into the Python chunk store so acked samples survive for a retry."""
+        mid, tsid, ts, vals = self._accum.take_sorted()
+        if not len(ts):
+            return
+        seg = ts - (ts % self._segment_duration)
+        uniq = np.unique(seg)
+        try:
+            for seg_start in uniq:
+                m = seg == seg_start if len(uniq) > 1 else slice(None)
+                await self._write_segment(mid[m], tsid[m], ts[m], vals[m])
+        except BaseException:
+            # re-buffer PER SEGMENT: the Python buffer's flush writes one
+            # batch per key and a batch must not cross a segment
+            for seg_start in uniq:
+                m = seg == seg_start if len(uniq) > 1 else slice(None)
+                self._buf.setdefault(int(seg_start), []).append(
+                    (mid[m], tsid[m], ts[m], vals[m])
+                )
+            self._buffered += len(ts)
+            raise
 
     def _restore_snapshot(self, buf, chunks, keys, snapshot_rows: int) -> None:
         """Merge a failed flush's snapshot back into the live buffers."""

@@ -62,15 +62,19 @@ class MetricEngine:
         ingest_buffer_rows: int = 0,
         sst_executor=None,
         manifest_executor=None,
+        parser_pool=None,
     ) -> "MetricEngine":
         """`ingest_buffer_rows` > 0 buffers data-table rows across writes
         and flushes as one SST per segment when the threshold is reached
         (see SampleManager.__init__ for the durability trade-off).
         `sst_executor`/`manifest_executor` size CPU-heavy storage work
-        (ThreadConfig, see ObjectBasedStorage.try_new)."""
+        (ThreadConfig, see ObjectBasedStorage.try_new). `parser_pool` shares
+        the caller's ParserPool (so e.g. the server's pool telemetry covers
+        engine ingest); None = engine creates its own on first use."""
         self = object.__new__(cls)
         self._store = store
         self._segment_duration = segment_duration_ms
+        self._pool = parser_pool
 
         async def open_table(name, schema, num_pks, compaction):
             return await ObjectBasedStorage.try_new(
@@ -176,8 +180,9 @@ class MetricEngine:
             await self._persist_exemplars(req, metric_arr, tsid_arr)
         return n
 
-    async def _write_parsed_fast(self, req: ParsedWriteRequest) -> int:
-        """Hash-lane write path: per-series ids come from the C++ parser."""
+    async def _resolve_ids_fast(self, req: ParsedWriteRequest):
+        """Hash-lane id resolution: validate names, register unseen metrics
+        and series. Returns (metric_arr, tsid_arr) u64 per series."""
         ts_now = now_ms()
         name_len = req.series_name_len
         if np.any(name_len < 0):
@@ -202,6 +207,57 @@ class MetricEngine:
         await self.index_mgr.ensure_series_fast(
             metric_arr, tsid_arr, req.series_key, ts_now
         )
+        return metric_arr, tsid_arr
+
+    async def write_payload(self, payload: bytes) -> int:
+        """Parse + ingest one wire payload end-to-end. With native buffering
+        active (ingest_buffer_rows > 0 and the C++ library available),
+        samples move straight from the parser arena into the C++
+        accumulator — no Python-side sample materialization at all.
+
+        Borrow discipline: the pool slot is held only for the arena-touching
+        steps (parse, id resolution, accum add). Steady-state resolution has
+        no awaits; only new-series registration persists while borrowed
+        (series keys/names must come from the arena, and they are
+        materialized to owned bytes before the await). Exemplar persistence
+        and threshold flushes use owned copies and run after release."""
+        import asyncio
+
+        from horaedb_tpu.ingest import ParserPool
+
+        if self._pool is None:
+            self._pool = ParserPool()
+        if not self.sample_mgr.native_accum_active:
+            parsed = await self._pool.decode(payload)
+            return await self.write_parsed(parsed)
+        from horaedb_tpu.ingest.native import NativeParser
+
+        total = 0
+        async with self._pool.borrow() as parser:
+            if not isinstance(parser, NativeParser):
+                parsed = await asyncio.to_thread(parser.parse, payload)
+                return await self.write_parsed(parsed)
+            # small payloads parse inline: the native parse runs ~1 GB/s, so
+            # a sub-256KB payload blocks the loop far less than a thread
+            # handoff costs (~100us)
+            if len(payload) <= 256 * 1024:
+                req = parser.parse_light(payload)
+            else:
+                req = await asyncio.to_thread(parser.parse_light, payload)
+            if req.n_series == 0:
+                return 0
+            metric_arr, tsid_arr = await self._resolve_ids_fast(req)
+            if req.n_samples:
+                total = self.sample_mgr.buffer_native_add(parser)
+        if len(req.exemplar_value):
+            await self._persist_exemplars(req, metric_arr, tsid_arr)
+        if total and self.sample_mgr.should_flush(total):
+            await self.sample_mgr.flush()
+        return req.n_samples
+
+    async def _write_parsed_fast(self, req: ParsedWriteRequest) -> int:
+        """Hash-lane write path: per-series ids come from the C++ parser."""
+        metric_arr, tsid_arr = await self._resolve_ids_fast(req)
         # 3. samples
         n = req.n_samples
         if n:

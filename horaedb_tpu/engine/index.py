@@ -58,6 +58,10 @@ MAX_REGEX_SUBJECT_LEN = 4096
 
 # Delta series count that triggers a merge into the base arrays.
 DELTA_COMPACT_THRESHOLD = 65_536
+# Recently-seen (metric_id, tsid) cache bound: O(1) steady-state ingest
+# probes; cleared wholesale when full (cold probes fall through to the
+# base/delta tiers, so correctness never depends on it).
+SEEN_CACHE_MAX = 1 << 20
 
 
 def _reject_catastrophic(pattern: str) -> None:
@@ -226,6 +230,8 @@ class IndexManager:
         # metric_id -> tsids registered since the base was built
         self._metric_known: dict[int, set[int]] = defaultdict(set)
         self._delta_series = 0
+        # recently-seen ingest probe cache (see SEEN_CACHE_MAX)
+        self._seen_cache: set[tuple[int, int]] = set()
         # (metric_id, tag_hash) -> {tsid -> (key, value)} posting lists
         self._postings: dict[tuple[int, int], dict[int, tuple[bytes, bytes]]] = defaultdict(dict)
         # metric_id -> its posting keys (per-metric scans stay O(one metric))
@@ -434,17 +440,35 @@ class IndexManager:
         native parser; only genuinely new series pay Python-object costs
         (key decode + posting rows). The Python seahash remains the
         differential oracle in tests, per the reference hash contract
-        (src/metric_engine/src/types.rs:18-41)."""
-        new_idx: list[int] = []
-        staged: set[tuple[int, int]] = set()
+        (src/metric_engine/src/types.rs:18-41).
+
+        Steady-state probes hit a bounded recently-seen cache (O(1) per
+        series); only cache misses consult the base/delta tiers."""
+        cache = self._seen_cache
         mids = metric_ids.tolist()
         tids = tsids.tolist()
-        for i, (m, t) in enumerate(zip(mids, tids)):
+        pairs = list(zip(mids, tids))
+        miss = [i for i, p in enumerate(pairs) if p not in cache]
+        if not miss:
+            return
+        new_idx: list[int] = []
+        staged: set[tuple[int, int]] = set()
+        for i in miss:
+            m, t = pairs[i]
             if (m, t) in staged or self._is_known(m, t):
                 continue
             staged.add((m, t))
             new_idx.append(i)
+
+        def cache_all() -> None:
+            # only after the new series are DURABLE: caching unpersisted
+            # pairs would mark them known while the index rows never landed
+            if len(cache) > SEEN_CACHE_MAX:
+                cache.clear()
+            cache.update(pairs)
+
         if not new_idx:
+            cache_all()
             return
         new_series_rows: list[tuple[int, int, bytes]] = []
         new_index_rows: list[tuple[int, int, int, bytes, bytes]] = []
@@ -455,7 +479,9 @@ class IndexManager:
                 new_index_rows.append((mids[i], tag_hash_of(k, v), tids[i], k, v))
         # persist-before-cache, same reasoning as populate_series_ids
         await self._persist(new_series_rows, new_index_rows, now_ms)
-        if self._commit_rows(new_series_rows, new_index_rows):
+        oversized = self._commit_rows(new_series_rows, new_index_rows)
+        cache_all()
+        if oversized:
             await self._compact_delta()
 
     async def _persist(self, series_rows, index_rows, now_ms: int) -> None:

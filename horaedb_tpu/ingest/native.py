@@ -29,7 +29,7 @@ _lib_lock = threading.Lock()
 
 # Must match rw_abi_version() in remote_write_parser.cc; a stale committed
 # or leftover .so is rebuilt instead of silently shadowing the source.
-_ABI_VERSION = 2
+_ABI_VERSION = 4
 
 
 class _RwResult(ctypes.Structure):
@@ -79,6 +79,16 @@ class _RwHashResult(ctypes.Structure):
     ]
 
 
+class _RwFlushResult(ctypes.Structure):
+    _fields_ = [
+        ("n", ctypes.c_int64),
+        ("mid", ctypes.POINTER(ctypes.c_uint64)),
+        ("tsid", ctypes.POINTER(ctypes.c_uint64)),
+        ("ts", ctypes.POINTER(ctypes.c_int64)),
+        ("val", ctypes.POINTER(ctypes.c_double)),
+    ]
+
+
 def _build(force: bool = False) -> bool:
     try:
         cmd = ["make", "-C", os.path.abspath(_NATIVE_DIR)]
@@ -123,6 +133,18 @@ def _try_load():
         ctypes.POINTER(_RwResult),
         ctypes.POINTER(_RwHashResult),
     ]
+    lib.rw_accum_new.restype = ctypes.c_void_p
+    lib.rw_accum_free.argtypes = [ctypes.c_void_p]
+    lib.rw_accum_clear.argtypes = [ctypes.c_void_p]
+    lib.rw_accum_rows.restype = ctypes.c_int64
+    lib.rw_accum_rows.argtypes = [ctypes.c_void_p]
+    lib.rw_accum_add.restype = ctypes.c_int64
+    lib.rw_accum_add.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.rw_accum_flush.restype = ctypes.c_int
+    lib.rw_accum_flush.argtypes = [ctypes.c_void_p, ctypes.POINTER(_RwFlushResult)]
+    lib.rw_copy_id_lanes.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p
+    ]
     return lib
 
 
@@ -156,6 +178,10 @@ def load():
         return _lib
 
 
+_EMPTY_I64 = np.empty(0, np.int64)
+_EMPTY_F64 = np.empty(0, np.float64)
+
+
 def _as_np(ptr, n: int, dtype) -> np.ndarray:
     """Copy an arena lane out into a standalone numpy array (the arena is
     reused by the next parse on the same handle). string_at is one C memcpy;
@@ -181,6 +207,62 @@ class NativeParser:
         if h:
             self._lib.rw_parser_free(h)
             self._h = None
+
+    def parse_light(self, payload: bytes) -> ParsedWriteRequest:
+        """Parse WITHOUT copying the sample lanes out of the arena — the
+        native-accum ingest path reads them directly via rw_accum_add, which
+        must run on this parser before its next parse. Only the id lanes the
+        hot resolution touches are copied (metric_id/tsid/name_len, plus
+        exemplars when present); name/key bytes resolve LAZILY through the
+        held arena pointers, so the returned request is only valid while the
+        parser stays borrowed and unreused."""
+        res = _RwResult()
+        hres = _RwHashResult()
+        rc = self._lib.rw_parse_hashed(
+            self._h, payload, len(payload), ctypes.byref(res), ctypes.byref(hres)
+        )
+        if rc != 0:
+            raise HoraeError("malformed remote-write payload")
+        ns, nex = res.n_series, res.n_exemplars
+        empty64 = _EMPTY_I64
+        nexl = res.n_ex_labels if nex else 0
+        # one FFI crossing copies the three hot id lanes into owned memory
+        mid = np.empty(ns, np.uint64)
+        tsid = np.empty(ns, np.uint64)
+        nlen = np.empty(ns, np.int64)
+        if ns:
+            self._lib.rw_copy_id_lanes(
+                self._h,
+                mid.ctypes.data, tsid.ctypes.data, nlen.ctypes.data,
+            )
+        return ParsedWriteRequest(
+            payload=payload,
+            series_label_start=empty64,
+            series_label_count=empty64,
+            series_sample_start=empty64,
+            series_sample_count=empty64,
+            label_name_off=empty64, label_name_len=empty64,
+            label_value_off=empty64, label_value_len=empty64,
+            sample_value=_EMPTY_F64,
+            sample_ts=empty64,
+            sample_series=empty64,
+            exemplar_value=_as_np(res.exemplar_value, nex, np.float64),
+            exemplar_ts=_as_np(res.exemplar_ts, nex, np.int64),
+            exemplar_series=_as_np(res.exemplar_series, nex, np.int64),
+            exemplar_label_start=_as_np(res.exemplar_label_start, nex, np.int64),
+            exemplar_label_count=_as_np(res.exemplar_label_count, nex, np.int64),
+            ex_label_name_off=_as_np(res.ex_label_name_off, nexl, np.int64),
+            ex_label_name_len=_as_np(res.ex_label_name_len, nexl, np.int64),
+            ex_label_value_off=_as_np(res.ex_label_value_off, nexl, np.int64),
+            ex_label_value_len=_as_np(res.ex_label_value_len, nexl, np.int64),
+            meta_type=empty64, meta_name_off=empty64, meta_name_len=empty64,
+            series_metric_id=mid,
+            series_tsid=tsid,
+            series_name_len=nlen,
+            n_samples_hint=int(res.n_samples),
+            lazy_hres=hres,
+            n_series_hint=int(ns),
+        )
 
     def parse(self, payload: bytes) -> ParsedWriteRequest:
         res = _RwResult()
@@ -227,3 +309,51 @@ class NativeParser:
             if hres.key_arena_len
             else b"",
         )
+
+
+class NativeAccum:
+    """C++ ingest accumulator handle (the metric engine's native write
+    buffer): (metric_id, tsid) -> dense-id map + flat sample lanes, flushed
+    as pk-sorted output lanes. Not thread-safe; owned by one SampleManager.
+    """
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise HoraeError("native remote-write parser unavailable")
+        self._lib = lib
+        self._h = lib.rw_accum_new()
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.rw_accum_free(h)
+            self._h = None
+
+    @property
+    def rows(self) -> int:
+        return int(self._lib.rw_accum_rows(self._h))
+
+    def add(self, parser: NativeParser) -> int:
+        """Append the parser's current parse (must directly follow a
+        parse/parse_light on that handle). Returns total buffered rows."""
+        n = int(self._lib.rw_accum_add(parser._h, self._h))
+        if n < 0:
+            raise HoraeError("accum_add: parser holds no hash lanes")
+        return n
+
+    def take_sorted(self):
+        """(mid, tsid, ts, val) numpy lanes sorted by (mid, tsid, ts), then
+        CLEAR the accumulator. The returned arrays are independent copies —
+        callers own them (and re-buffer them on a failed write)."""
+        res = _RwFlushResult()
+        self._lib.rw_accum_flush(self._h, ctypes.byref(res))
+        n = int(res.n)
+        out = (
+            _as_np(res.mid, n, np.uint64),
+            _as_np(res.tsid, n, np.uint64),
+            _as_np(res.ts, n, np.int64),
+            _as_np(res.val, n, np.float64),
+        )
+        self._lib.rw_accum_clear(self._h)
+        return out

@@ -39,28 +39,17 @@ class ParserPool:
         self._waiting = 0
 
     async def decode(self, payload: bytes) -> ParsedWriteRequest:
-        self._waiting += 1
-        try:
-            await self._sem.acquire()
-        finally:
-            self._waiting -= 1
-        self._in_use += 1
-        parser = self._free.pop() if self._free else _new_backend()
-        try:
+        async with self.borrow() as parser:
             # native parse releases no GIL-bound state we await on; run in a
             # thread so large payloads don't stall the event loop
-            result = await asyncio.to_thread(parser.parse, payload)
-        except asyncio.CancelledError:
-            # the worker thread may still be mutating this arena: never
-            # return it to the pool (a fresh one is allocated on demand)
-            parser = None
-            raise
-        finally:
-            if parser is not None:
-                self._free.append(parser)
-            self._in_use -= 1
-            self._sem.release()
-        return result
+            return await asyncio.to_thread(parser.parse, payload)
+
+    def borrow(self):
+        """Async context manager lending a parser backend for multi-call use
+        (parse_light + accum-add must run on one arena before its next
+        parse). The borrowed parser returns to the pool on exit unless the
+        body was cancelled mid-parse."""
+        return _Borrow(self)
 
     @property
     def status(self) -> dict:
@@ -70,6 +59,32 @@ class ParserPool:
             "available": self._size - self._in_use,
             "waiting": self._waiting,
         }
+
+
+class _Borrow:
+    def __init__(self, pool: ParserPool):
+        self._pool = pool
+        self._parser = None
+
+    async def __aenter__(self):
+        pool = self._pool
+        pool._waiting += 1
+        try:
+            await pool._sem.acquire()
+        finally:
+            pool._waiting -= 1
+        pool._in_use += 1
+        self._parser = pool._free.pop() if pool._free else _new_backend()
+        return self._parser
+
+    async def __aexit__(self, exc_type, exc, tb):
+        pool = self._pool
+        if self._parser is not None and exc_type is not asyncio.CancelledError:
+            pool._free.append(self._parser)
+        self._parser = None
+        pool._in_use -= 1
+        pool._sem.release()
+        return False
 
 
 _DEFAULT_POOL = None

@@ -61,13 +61,25 @@ class ParsedWriteRequest:
     series_key_off: np.ndarray | None = None    # into key_arena
     series_key_len: np.ndarray | None = None
     key_arena: bytes = b""
+    # set by parse_light (sample lanes stay in the parser arena for the
+    # native accumulator); None -> count the materialized lane
+    n_samples_hint: int | None = None
+    n_series_hint: int | None = None
+    # parse_light: held _RwHashResult whose pointers reach into the parser
+    # arena — name/key accessors below resolve through it lazily. ONLY valid
+    # while the producing parser stays borrowed and unreused.
+    lazy_hres: object | None = None
 
     @property
     def n_series(self) -> int:
+        if self.n_series_hint is not None:
+            return self.n_series_hint
         return len(self.series_label_start)
 
     @property
     def n_samples(self) -> int:
+        if self.n_samples_hint is not None:
+            return self.n_samples_hint
         return len(self.sample_value)
 
     def label_name(self, i: int) -> bytes:
@@ -102,10 +114,22 @@ class ParsedWriteRequest:
         n = int(self.series_name_len[s])
         if n < 0:
             return b""
-        o = int(self.series_name_off[s])
+        if self.series_name_off is not None:
+            o = int(self.series_name_off[s])
+        else:  # lazy: offsets live in the held arena pointers
+            o = int(self.lazy_hres.series_name_off[s])
         return self.payload[o : o + n]
 
     def series_key(self, s: int) -> bytes:
         """Canonical sorted series key of series `s` (hash-lane fast path)."""
-        o, l = int(self.series_key_off[s]), int(self.series_key_len[s])
-        return self.key_arena[o : o + l]
+        if self.series_key_off is not None:
+            o, l = int(self.series_key_off[s]), int(self.series_key_len[s])
+            return self.key_arena[o : o + l]
+        import ctypes
+
+        h = self.lazy_hres
+        o, l = int(h.series_key_off[s]), int(h.series_key_len[s])
+        if l == 0:
+            return b""
+        base = ctypes.cast(h.key_arena, ctypes.c_void_p).value
+        return ctypes.string_at(base + o, l)

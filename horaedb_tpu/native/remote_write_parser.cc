@@ -22,6 +22,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -487,6 +488,138 @@ bool parse_write_request(Parser& ps, Reader r) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// Ingest accumulator: the native write buffer behind the metric engine's
+// buffered ingest (engine/data.py). Replaces the reference's write-side
+// batching intent (the RFC's data table batches many samples per stored
+// row, docs/rfcs/20240827-metric-engine.md:218-232) with a C++ structure:
+// a (metric_id, tsid) -> dense-id hash map plus flat per-sample lanes.
+// Flush emits lanes already sorted by (metric_id, tsid, ts) — series keys
+// std::sort'ed (k log k over UNIQUE series), samples placed by stable
+// counting sort (O(n + k)), per-series time order verified and locally
+// repaired — so the storage write's sortedness fast path skips its sort.
+// ---------------------------------------------------------------------------
+
+struct SeriesKey {
+  uint64_t mid, tsid;
+  bool operator==(const SeriesKey& o) const {
+    return mid == o.mid && tsid == o.tsid;
+  }
+};
+
+struct SeriesKeyHash {
+  size_t operator()(const SeriesKey& k) const {
+    // ids are already seahash outputs (uniform); fold them
+    return static_cast<size_t>(k.mid ^ (k.tsid * 0x9E3779B97F4A7C15ULL));
+  }
+};
+
+struct Accum {
+  std::unordered_map<SeriesKey, int32_t, SeriesKeyHash> dense;
+  std::vector<SeriesKey> keys;          // dense id -> key
+  std::vector<int32_t> sample_dense;
+  std::vector<int64_t> sample_ts;
+  std::vector<double> sample_val;
+  // flush output lanes (valid until clear/free)
+  std::vector<uint64_t> out_mid, out_tsid;
+  std::vector<int64_t> out_ts;
+  std::vector<double> out_val;
+
+  void clear() {  // keeps capacity
+    dense.clear();
+    keys.clear();
+    sample_dense.clear();
+    sample_ts.clear();
+    sample_val.clear();
+  }
+};
+
+// Append one parsed request's samples (parser arena must still hold the
+// parse, i.e. call between rw_parse_hashed and the next parse).
+int64_t accum_add(Accum& ac, const Parser& ps) {
+  size_t n_series = ps.series_label_start.size();
+  std::vector<int32_t> dense_of(n_series);
+  for (size_t s = 0; s < n_series; ++s) {
+    SeriesKey k{ps.series_metric_id[s], ps.series_tsid[s]};
+    auto it = ac.dense.find(k);
+    if (it == ac.dense.end()) {
+      int32_t d = static_cast<int32_t>(ac.keys.size());
+      ac.dense.emplace(k, d);
+      ac.keys.push_back(k);
+      dense_of[s] = d;
+    } else {
+      dense_of[s] = it->second;
+    }
+  }
+  size_t n = ps.sample_value.size();
+  size_t base = ac.sample_dense.size();
+  ac.sample_dense.resize(base + n);
+  ac.sample_ts.resize(base + n);
+  ac.sample_val.resize(base + n);
+  for (size_t i = 0; i < n; ++i) {
+    ac.sample_dense[base + i] = dense_of[ps.sample_series[i]];
+  }
+  std::memcpy(ac.sample_ts.data() + base, ps.sample_ts.data(), n * 8);
+  std::memcpy(ac.sample_val.data() + base, ps.sample_value.data(), n * 8);
+  return static_cast<int64_t>(ac.sample_dense.size());
+}
+
+void accum_flush_sorted(Accum& ac) {
+  size_t k = ac.keys.size();
+  size_t n = ac.sample_dense.size();
+  // rank the unique keys by (mid, tsid)
+  std::vector<int32_t> order(k);
+  for (size_t i = 0; i < k; ++i) order[i] = static_cast<int32_t>(i);
+  std::sort(order.begin(), order.end(), [&ac](int32_t a, int32_t b) {
+    const SeriesKey &ka = ac.keys[a], &kb = ac.keys[b];
+    if (ka.mid != kb.mid) return ka.mid < kb.mid;
+    return ka.tsid < kb.tsid;
+  });
+  std::vector<int32_t> rank_of(k);
+  for (size_t r = 0; r < k; ++r) rank_of[order[r]] = static_cast<int32_t>(r);
+  // stable counting sort of samples by rank (arrival order kept per series)
+  std::vector<int64_t> counts(k + 1, 0);
+  for (size_t i = 0; i < n; ++i) counts[rank_of[ac.sample_dense[i]] + 1]++;
+  for (size_t r = 1; r <= k; ++r) counts[r] += counts[r - 1];
+  ac.out_mid.resize(n);
+  ac.out_tsid.resize(n);
+  ac.out_ts.resize(n);
+  ac.out_val.resize(n);
+  std::vector<int64_t> cursor(counts.begin(), counts.end() - 1);
+  for (size_t i = 0; i < n; ++i) {
+    int32_t r = rank_of[ac.sample_dense[i]];
+    int64_t pos = cursor[r]++;
+    const SeriesKey& key = ac.keys[order[r]];
+    ac.out_mid[pos] = key.mid;
+    ac.out_tsid[pos] = key.tsid;
+    ac.out_ts[pos] = ac.sample_ts[i];
+    ac.out_val[pos] = ac.sample_val[i];
+  }
+  // scrapes normally arrive in time order; repair any series whose ts
+  // dips (stable, local to the group)
+  for (size_t r = 0; r < k; ++r) {
+    int64_t lo = counts[r], hi = counts[r + 1];
+    bool sorted = true;
+    for (int64_t i = lo + 1; i < hi; ++i) {
+      if (ac.out_ts[i] < ac.out_ts[i - 1]) { sorted = false; break; }
+    }
+    if (sorted) continue;
+    std::vector<int32_t> idx(hi - lo);
+    for (int64_t i = 0; i < hi - lo; ++i) idx[i] = static_cast<int32_t>(i);
+    std::stable_sort(idx.begin(), idx.end(), [&ac, lo](int32_t a, int32_t b) {
+      return ac.out_ts[lo + a] < ac.out_ts[lo + b];
+    });
+    std::vector<int64_t> ts2(hi - lo);
+    std::vector<double> v2(hi - lo);
+    for (int64_t i = 0; i < hi - lo; ++i) {
+      ts2[i] = ac.out_ts[lo + idx[i]];
+      v2[i] = ac.out_val[lo + idx[i]];
+    }
+    std::memcpy(ac.out_ts.data() + lo, ts2.data(), ts2.size() * 8);
+    std::memcpy(ac.out_val.data() + lo, v2.data(), v2.size() * 8);
+  }
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -542,9 +675,65 @@ struct RwHashResult {
   int64_t key_arena_len;
 };
 
+// Sorted flush lanes; valid until the next rw_accum_clear/free.
+struct RwFlushResult {
+  int64_t n;
+  const uint64_t* mid;
+  const uint64_t* tsid;
+  const int64_t* ts;
+  const double* val;
+};
+
 // Bumped whenever the ABI of any struct/function here changes; the Python
 // binding refuses (and rebuilds) a stale .so whose version mismatches.
-int rw_abi_version() { return 2; }
+int rw_abi_version() { return 4; }
+
+// One-FFI-call copy of the hot per-series id lanes into caller buffers
+// (each ctypes string_at crossing costs ~10us; three lanes per request add
+// up at millions of samples/s). Caller sizes buffers to n_series.
+void rw_copy_id_lanes(void* h, uint64_t* mid, uint64_t* tsid, int64_t* nlen) {
+  Parser& ps = *static_cast<Parser*>(h);
+  size_t n = ps.series_metric_id.size();
+  std::memcpy(mid, ps.series_metric_id.data(), n * 8);
+  std::memcpy(tsid, ps.series_tsid.data(), n * 8);
+  std::memcpy(nlen, ps.series_name_len.data(), n * 8);
+}
+
+void* rw_accum_new() { return new Accum(); }
+
+void rw_accum_free(void* h) { delete static_cast<Accum*>(h); }
+
+void rw_accum_clear(void* h) { static_cast<Accum*>(h)->clear(); }
+
+int64_t rw_accum_rows(void* h) {
+  return static_cast<int64_t>(static_cast<Accum*>(h)->sample_dense.size());
+}
+
+// Append the parser's CURRENT parse (must follow rw_parse_hashed on the
+// same parser handle, before its next parse). Returns total buffered rows,
+// or -1 if the parser holds no hash lanes.
+int64_t rw_accum_add(void* parser, void* accum) {
+  Parser& ps = *static_cast<Parser*>(parser);
+  if (ps.series_metric_id.size() != ps.series_label_start.size()) return -1;
+  return accum_add(*static_cast<Accum*>(accum), ps);
+}
+
+// Sort the buffered samples into pk order and expose the lanes. Does NOT
+// clear itself — but the Python caller (NativeAccum.take_sorted) copies the
+// lanes and clears IMMEDIATELY, so rows arriving during subsequent awaited
+// writes are never lost; write-failure retry is provided by the Python-side
+// re-buffering of those copies (SampleManager._flush_accum), NOT by data
+// lingering here.
+int rw_accum_flush(void* h, RwFlushResult* out) {
+  Accum& ac = *static_cast<Accum*>(h);
+  accum_flush_sorted(ac);
+  out->n = static_cast<int64_t>(ac.out_mid.size());
+  out->mid = ac.out_mid.data();
+  out->tsid = ac.out_tsid.data();
+  out->ts = ac.out_ts.data();
+  out->val = ac.out_val.data();
+  return 0;
+}
 
 void* rw_parser_new() { return new Parser(); }
 

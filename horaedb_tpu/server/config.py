@@ -98,6 +98,15 @@ class EngineStorageConfig:
 class MetricEngineConfig:
     threads: ThreadConfig = field(default_factory=ThreadConfig)
     storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
+    # Ingest buffering (engine/data.py SampleManager): 0 = every write is
+    # immediately durable (reference write==SST semantics); > 0 buffers up
+    # to that many rows (flushed at the threshold, on the flush interval,
+    # before every query, and on shutdown). Higher throughput, bounded
+    # data-loss window on crash.
+    ingest_buffer_rows: int = 0
+    ingest_flush_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(1)
+    )
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "MetricEngineConfig":

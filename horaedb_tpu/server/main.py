@@ -149,17 +149,15 @@ async def handle_remote_write(request: web.Request) -> web.Response:
         except Exception:  # noqa: BLE001
             return web.json_response({"error": "bad snappy payload"}, status=400)
     try:
-        parsed = await state.parser_pool.decode(body)
-    except Exception as e:  # noqa: BLE001
-        return web.json_response({"error": f"bad payload: {e}"}, status=400)
-    try:
-        n = await state.engine.write_parsed(parsed)
+        n = await state.engine.write_payload(body)
     except HoraeError as e:
-        # client-shaped errors (e.g. missing __name__) stay 4xx
-        if "missing __name__" in str(e):
-            return web.json_response({"error": str(e)}, status=400)
+        # client-shaped errors (malformed wire bytes, missing __name__)
+        # stay 4xx
+        msg = str(e)
+        if "missing __name__" in msg or "malformed" in msg:
+            return web.json_response({"error": msg}, status=400)
         logger.exception("remote write failed")
-        return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"error": msg}, status=500)
     except Exception as e:  # noqa: BLE001
         # internal failures must be 5xx: remote-write senders retry 5xx but
         # permanently DROP the batch on 4xx
@@ -349,13 +347,32 @@ async def build_app(config: Config) -> web.Application:
         config=config.metric_engine.storage.time_merge_storage,
         sst_executor=sst_executor,
         manifest_executor=manifest_executor,
+        ingest_buffer_rows=config.metric_engine.ingest_buffer_rows,
     )
     state = ServerState(config, storage, engine)
+    # one shared parser pool: the /metrics pool telemetry must reflect the
+    # pool the engine's ingest actually borrows from
+    engine._pool = state.parser_pool
     if config.test.enable_write:
         state.write_enabled.set()
     for i in range(config.test.write_worker_num):
         state.write_workers.append(
             asyncio.create_task(bench_write_worker(state, i), name=f"bench-write-{i}")
+        )
+    if config.metric_engine.ingest_buffer_rows > 0:
+        # periodic flush bounds the buffered-ingest data-loss window
+        interval = config.metric_engine.ingest_flush_interval.seconds
+
+        async def flush_loop():
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    await engine.flush()
+                except Exception:  # noqa: BLE001 — keep flushing; writes retry
+                    logger.exception("periodic ingest flush failed")
+
+        state.write_workers.append(
+            asyncio.create_task(flush_loop(), name="ingest-flush")
         )
 
     app = web.Application(client_max_size=64 * 1024 * 1024)
