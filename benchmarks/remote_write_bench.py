@@ -86,15 +86,50 @@ async def bench_concurrent(payload: bytes, tasks: int, iters: int) -> None:
 
 
 def main() -> None:
-    payload = make_payload()
-    from horaedb_tpu.ingest import native
+    """Four decoders, like the reference's prost/pooled/quick-protobuf/
+    rust-protobuf comparison (bench.rs:60-162): the C++ pooled parser (full
+    and light variants), the protobuf runtime (upb C backend), and the
+    hand-rolled pure-Python wire decoder. Plus the real captured corpus."""
+    import glob
+    import os
 
-    if native.load() is not None:
+    from horaedb_tpu.ingest import native
+    from horaedb_tpu.ingest.wire_parser import WireParser
+
+    payload = make_payload()
+    have_native = native.load() is not None
+    if have_native:
         parser = native.NativeParser()
         bench_sequential("native_cpp", parser.parse, payload, 300)
+        bench_sequential("native_cpp_light", parser.parse_light, payload, 300)
+    # key stays "python_protobuf" for round-over-round continuity (and to
+    # match parser_mem.py); the runtime backend is noted separately
     bench_sequential("python_protobuf", PyParser().parse, payload, 50)
+    bench_sequential("python_wire", WireParser().parse, payload, 5)
     for tasks in (4, 16, 64):
         asyncio.run(bench_concurrent(payload, tasks, 10))
+
+    # real captured corpus (equivalence_test.rs workloads), reported in MB/s
+    corpus = sorted(
+        glob.glob("/root/reference/src/remote_write/tests/workloads/*.data")
+    )
+    if corpus and have_native:
+        data = [open(p, "rb").read() for p in corpus]
+        total_mb = sum(len(d) for d in data) / 1e6
+        parser = native.NativeParser()
+        iters = 50
+        start = time.perf_counter()
+        for _ in range(iters):
+            for d in data:
+                parser.parse(d)
+        elapsed = time.perf_counter() - start
+        print(json.dumps({
+            "bench": "remote_write_corpus",
+            "parser": "native_cpp",
+            "files": [os.path.basename(p) for p in corpus],
+            "iters": iters,
+            "mb_per_sec": round(total_mb * iters / elapsed, 1),
+        }))
 
 
 if __name__ == "__main__":

@@ -109,9 +109,14 @@ def main() -> None:
     seconds = int(sys.argv[1]) if len(sys.argv) > 1 else 20
     data_dir = tempfile.mkdtemp(prefix="soak_")
     cfg = os.path.join(data_dir, "cfg.toml")
+    # SOAK_BUFFER_ROWS > 0 soaks the native buffered-ingest path (periodic
+    # flush + flush-before-query consistency under concurrent load)
+    buffer_rows = int(os.environ.get("SOAK_BUFFER_ROWS", "0"))
     with open(cfg, "w") as f:
         f.write(
             f'port = {PORT}\n[test]\nsegment_duration = "2h"\n'
+            f"[metric_engine]\ningest_buffer_rows = {buffer_rows}\n"
+            f'ingest_flush_interval = "250ms"\n'
             f'[metric_engine.storage.object_store]\ntype = "Local"\ndata_dir = "{data_dir}/db"\n'
         )
     env = dict(os.environ)

@@ -18,14 +18,23 @@ POOL_SIZE = 64
 
 
 def _new_backend():
+    """Backend chain: C++ parser -> protobuf-runtime PyParser -> hand-rolled
+    pure-Python WireParser (no native code, no protoc codegen; lacks the
+    hash lanes, so the engine takes its slow path)."""
     from horaedb_tpu.ingest import native
 
     if native.load() is not None:
         return native.NativeParser()
-    from horaedb_tpu.ingest.py_parser import PyParser
+    try:
+        from horaedb_tpu.ingest.py_parser import PyParser
 
-    logger.warning("native remote-write parser unavailable; using Python fallback")
-    return PyParser()
+        logger.warning("native remote-write parser unavailable; using protobuf runtime")
+        return PyParser()
+    except ImportError:
+        from horaedb_tpu.ingest.wire_parser import WireParser
+
+        logger.warning("protobuf runtime unavailable; using pure-Python wire decoder")
+        return WireParser()
 
 
 class ParserPool:

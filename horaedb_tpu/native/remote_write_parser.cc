@@ -300,6 +300,7 @@ bool parse_label(Parser& ps, Reader r) {
     uint64_t tag;
     if (!read_varint(r, &tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 0) return false;  // proto spec: field 0 is malformed
     if (field == 1 && wt == 2) {
       uint64_t len;
       if (!read_len(r, &len)) return false;
@@ -328,6 +329,7 @@ bool parse_sample(Parser& ps, Reader r, int64_t series_idx) {
     uint64_t tag;
     if (!read_varint(r, &tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 0) return false;  // proto spec: field 0 is malformed
     if (field == 1 && wt == 1) {
       if (!read_fixed64_as_double(r, &value)) return false;
     } else if (field == 2 && wt == 0) {
@@ -350,6 +352,7 @@ bool parse_exemplar_label(Parser& ps, Reader r) {
     uint64_t tag;
     if (!read_varint(r, &tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 0) return false;  // proto spec: field 0 is malformed
     if (field == 1 && wt == 2) {
       uint64_t len;
       if (!read_len(r, &len)) return false;
@@ -380,6 +383,7 @@ bool parse_exemplar(Parser& ps, Reader r, int64_t series_idx) {
     uint64_t tag;
     if (!read_varint(r, &tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 0) return false;  // proto spec: field 0 is malformed
     if (field == 1 && wt == 2) {  // exemplar labels
       uint64_t len;
       if (!read_len(r, &len)) return false;
@@ -411,6 +415,7 @@ bool parse_timeseries(Parser& ps, Reader r) {
     uint64_t tag;
     if (!read_varint(r, &tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 0) return false;  // proto spec: field 0 is malformed
     uint64_t len;
     switch (field) {
       case 1:  // labels
@@ -445,6 +450,7 @@ bool parse_metadata(Parser& ps, Reader r) {
     uint64_t tag;
     if (!read_varint(r, &tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 0) return false;  // proto spec: field 0 is malformed
     if (field == 1 && wt == 0) {
       uint64_t v;
       if (!read_varint(r, &v)) return false;
@@ -469,6 +475,7 @@ bool parse_write_request(Parser& ps, Reader r) {
     uint64_t tag;
     if (!read_varint(r, &tag)) return false;
     uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
+    if (field == 0) return false;  // proto spec: field 0 is malformed
     uint64_t len;
     switch (field) {
       case 1:  // timeseries

@@ -217,6 +217,59 @@ def assert_hash_lanes_match_oracle(out: ParsedWriteRequest):
         assert int(out.series_tsid[s]) == series_id_of(key)
 
 
+class TestWireParser:
+    """The pure-Python hand-rolled decoder must match the protobuf-runtime
+    oracle (same differential bar as the native parser)."""
+
+    def test_matches_oracle(self):
+        from horaedb_tpu.ingest.wire_parser import WireParser
+
+        oracle = PyParser()
+        wire = WireParser()
+        for seed in range(5):
+            payload = make_payload(seed=seed, n_series=25)
+            assert_equivalent(wire.parse(payload), oracle.parse(payload))
+
+    def test_corpus(self):
+        if not corpus_files():
+            pytest.skip("reference corpus not mounted")
+        from horaedb_tpu.ingest.wire_parser import WireParser
+
+        oracle = PyParser()
+        wire = WireParser()
+        for path in corpus_files():
+            with open(path, "rb") as f:
+                payload = f.read()
+            assert_equivalent(wire.parse(payload), oracle.parse(payload))
+
+    def test_negative_ts_and_malformed(self):
+        from horaedb_tpu.ingest.wire_parser import WireParser
+
+        req = remote_write_pb2.WriteRequest()
+        ts = req.timeseries.add()
+        lab = ts.labels.add(); lab.name = b"n"; lab.value = b"v"
+        s = ts.samples.add(); s.value = -1.5; s.timestamp = -12345
+        out = WireParser().parse(req.SerializeToString())
+        assert out.sample_ts[0] == -12345 and out.sample_value[0] == -1.5
+        with pytest.raises(HoraeError):
+            WireParser().parse(b"\x0a\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+
+    def test_fuzz_never_crashes(self):
+        from horaedb_tpu.ingest.wire_parser import WireParser
+
+        wire = WireParser()
+        rng = random.Random(11)
+        base = make_payload(seed=0, n_series=4)
+        for _ in range(200):
+            buf = bytearray(base)
+            for _ in range(rng.randint(1, 6)):
+                buf[rng.randrange(len(buf))] = rng.getrandbits(8)
+            try:
+                wire.parse(bytes(buf))
+            except HoraeError:
+                pass
+
+
 class TestHashLanes:
     def test_synthetic_payloads_match_oracle(self):
         native = native_parser()
@@ -332,3 +385,19 @@ class TestPool:
         payload = make_payload(seed=3)
         out = PooledParser.decode(payload)
         assert out.n_series == 50
+
+
+def test_wire_parser_rejects_field_zero():
+    """Field number 0 is malformed per the proto spec; the runtime oracle
+    rejects it, so the hand-rolled decoder must too (differential parity)."""
+    from horaedb_tpu.ingest.wire_parser import WireParser
+
+    with pytest.raises(HoraeError):
+        WireParser().parse(b"\x00\x00")
+    with pytest.raises(HoraeError):
+        PyParser().parse(b"\x00\x00")
+    from horaedb_tpu.ingest import native as native_mod
+
+    if native_mod.load() is not None:
+        with pytest.raises(HoraeError):
+            native_mod.NativeParser().parse(b"\x00\x00")
