@@ -9,17 +9,23 @@ benches four decoders, bench.rs:60-162).
 
 Zero-copy like the native parser: label values land as (offset, length)
 slices into the caller's buffer.
+
+Strictness contract: this decoder matches the NATIVE parser's acceptance
+rules (groups rejected, overlong 10th varint byte rejected, field 0
+rejected) — intentionally stricter than the protobuf runtime on some
+malformed/legacy constructs, exactly like the reference's hand-rolled
+decoder (pb_reader.rs skips no groups either). Differential parity with
+the runtime oracle is asserted over VALID payloads.
 """
 
 from __future__ import annotations
+
+import struct
 
 import numpy as np
 
 from horaedb_tpu.common.error import HoraeError
 from horaedb_tpu.ingest.types import ParsedWriteRequest
-
-_F64 = np.dtype("<f8")
-
 
 def _varint(buf: bytes, i: int, end: int) -> tuple[int, int]:
     """(value, next_index); raises on truncation/overlong."""
@@ -115,7 +121,7 @@ class WireParser:
                 if field == 1 and wt == 1:
                     if i + 8 > end:
                         raise HoraeError("malformed remote-write payload")
-                    value = float(np.frombuffer(payload[i:i + 8], _F64)[0])
+                    value = struct.unpack_from("<d", payload, i)[0]
                     i += 8
                 elif field == 2 and wt == 0:
                     raw, i = _varint(payload, i, end)
@@ -138,7 +144,7 @@ class WireParser:
                 elif field == 2 and wt == 1:
                     if i + 8 > end:
                         raise HoraeError("malformed remote-write payload")
-                    value = float(np.frombuffer(payload[i:i + 8], _F64)[0])
+                    value = struct.unpack_from("<d", payload, i)[0]
                     i += 8
                 elif field == 3 and wt == 0:
                     raw, i = _varint(payload, i, end)

@@ -110,6 +110,16 @@ inline bool read_varint(Reader& r, uint64_t* out) {
   return true;
 }
 
+// Tag = field number + wire type; field 0 is malformed per the proto spec
+// (single enforcement point for every parse loop).
+inline bool read_tag(Reader& r, uint32_t* field, uint32_t* wt) {
+  uint64_t tag;
+  if (!read_varint(r, &tag)) return false;
+  *field = static_cast<uint32_t>(tag >> 3);
+  *wt = tag & 7;
+  return *field != 0;
+}
+
 inline bool read_fixed64_as_double(Reader& r, double* out) {
   if (r.remaining() < 8) return false;
   std::memcpy(out, r.p, 8);
@@ -297,10 +307,8 @@ inline int64_t off_of(const Parser& ps, const uint8_t* p) {
 bool parse_label(Parser& ps, Reader r) {
   int64_t noff = 0, nlen = 0, voff = 0, vlen = 0;
   while (!r.eof()) {
-    uint64_t tag;
-    if (!read_varint(r, &tag)) return false;
-    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
-    if (field == 0) return false;  // proto spec: field 0 is malformed
+    uint32_t field, wt;
+    if (!read_tag(r, &field, &wt)) return false;
     if (field == 1 && wt == 2) {
       uint64_t len;
       if (!read_len(r, &len)) return false;
@@ -326,10 +334,8 @@ bool parse_sample(Parser& ps, Reader r, int64_t series_idx) {
   double value = 0;
   int64_t ts = 0;
   while (!r.eof()) {
-    uint64_t tag;
-    if (!read_varint(r, &tag)) return false;
-    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
-    if (field == 0) return false;  // proto spec: field 0 is malformed
+    uint32_t field, wt;
+    if (!read_tag(r, &field, &wt)) return false;
     if (field == 1 && wt == 1) {
       if (!read_fixed64_as_double(r, &value)) return false;
     } else if (field == 2 && wt == 0) {
@@ -349,10 +355,8 @@ bool parse_sample(Parser& ps, Reader r, int64_t series_idx) {
 bool parse_exemplar_label(Parser& ps, Reader r) {
   int64_t noff = 0, nlen = 0, voff = 0, vlen = 0;
   while (!r.eof()) {
-    uint64_t tag;
-    if (!read_varint(r, &tag)) return false;
-    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
-    if (field == 0) return false;  // proto spec: field 0 is malformed
+    uint32_t field, wt;
+    if (!read_tag(r, &field, &wt)) return false;
     if (field == 1 && wt == 2) {
       uint64_t len;
       if (!read_len(r, &len)) return false;
@@ -380,10 +384,8 @@ bool parse_exemplar(Parser& ps, Reader r, int64_t series_idx) {
   ps.exemplar_label_start.push_back(
       static_cast<int64_t>(ps.ex_label_name_off.size()));
   while (!r.eof()) {
-    uint64_t tag;
-    if (!read_varint(r, &tag)) return false;
-    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
-    if (field == 0) return false;  // proto spec: field 0 is malformed
+    uint32_t field, wt;
+    if (!read_tag(r, &field, &wt)) return false;
     if (field == 1 && wt == 2) {  // exemplar labels
       uint64_t len;
       if (!read_len(r, &len)) return false;
@@ -412,10 +414,8 @@ bool parse_timeseries(Parser& ps, Reader r) {
   ps.series_label_start.push_back(static_cast<int64_t>(ps.label_name_off.size()));
   ps.series_sample_start.push_back(static_cast<int64_t>(ps.sample_value.size()));
   while (!r.eof()) {
-    uint64_t tag;
-    if (!read_varint(r, &tag)) return false;
-    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
-    if (field == 0) return false;  // proto spec: field 0 is malformed
+    uint32_t field, wt;
+    if (!read_tag(r, &field, &wt)) return false;
     uint64_t len;
     switch (field) {
       case 1:  // labels
@@ -447,10 +447,8 @@ bool parse_timeseries(Parser& ps, Reader r) {
 bool parse_metadata(Parser& ps, Reader r) {
   int64_t type = 0, noff = 0, nlen = 0;
   while (!r.eof()) {
-    uint64_t tag;
-    if (!read_varint(r, &tag)) return false;
-    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
-    if (field == 0) return false;  // proto spec: field 0 is malformed
+    uint32_t field, wt;
+    if (!read_tag(r, &field, &wt)) return false;
     if (field == 1 && wt == 0) {
       uint64_t v;
       if (!read_varint(r, &v)) return false;
@@ -472,10 +470,8 @@ bool parse_metadata(Parser& ps, Reader r) {
 
 bool parse_write_request(Parser& ps, Reader r) {
   while (!r.eof()) {
-    uint64_t tag;
-    if (!read_varint(r, &tag)) return false;
-    uint32_t field = static_cast<uint32_t>(tag >> 3), wt = tag & 7;
-    if (field == 0) return false;  // proto spec: field 0 is malformed
+    uint32_t field, wt;
+    if (!read_tag(r, &field, &wt)) return false;
     uint64_t len;
     switch (field) {
       case 1:  // timeseries

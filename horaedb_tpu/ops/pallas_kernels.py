@@ -246,16 +246,22 @@ def sorted_segment_sum_count(
     block: int = DEFAULT_BLOCK,
     ranks: int = DEFAULT_RANKS,
     interpret: bool | None = None,
+    impl: str | None = None,
 ):
     """(sum, count) per cell for SORTED cell ids (invalid rows must carry
     id >= num_cells). Adaptive: falls back to plain segment_sum when any
     block holds more than `ranks` distinct cells (the rank compaction would
     drop rows). Trace-safe: under jit/shard_map the adaptive check becomes
-    a lax.cond between the compacted and scatter paths."""
+    a lax.cond between the compacted and scatter paths.
+
+    `impl` overrides the strategy explicitly (A/B harnesses); None reads
+    HORAEDB_SORTED_IMPL at trace time — note that jitted callers bake the
+    strategy into their compiled executable, so flipping the env var
+    mid-process does not retrace existing caches."""
     ensure(num_cells < _F32_EXACT, f"num_cells {num_cells} exceeds f32-exact range")
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
-    impl = _sorted_impl()
+    impl = impl or _sorted_impl()
     if impl == "scatter" or (impl == "auto" and interpret and not _mosaic_enabled()):
         return _scatter_sum_count(k_sorted, v, num_cells)
     if impl == "lanes":

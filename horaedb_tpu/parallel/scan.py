@@ -27,7 +27,7 @@ from horaedb_tpu.ops.filter import Predicate
 
 
 def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
-                 num_buckets, with_minmax, sorted_input=False):
+                 num_buckets, with_minmax, sorted_input=False, sorted_impl=None):
     """Partial grids for this shard's rows, restricted to the series slice
     [series_lo, series_lo + local_series).
 
@@ -59,7 +59,7 @@ def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
 
         if num_cells < _F32_EXACT:
             s, c = sorted_segment_sum_count(
-                flat, jnp.where(ok, vals, 0.0), num_cells
+                flat, jnp.where(ok, vals, 0.0), num_cells, impl=sorted_impl
             )
             mn = mx = None
             if with_minmax:
@@ -93,8 +93,13 @@ def build_sharded_downsample(
     predicate: Predicate | None = None,
     with_minmax: bool = True,
     sorted_input: bool = False,
+    sorted_impl: str | None = None,
 ):
     """Compile the sharded downsample step for a fixed grid shape.
+
+    `sorted_impl` pins the sorted-reduction strategy into this executable
+    (part of the memo key — required for in-process A/B, since the env
+    default is read once at trace time).
 
     Returns fn(ts, sid, vals, valid, literals, t0, bucket_ms) -> dict of
     [num_series, num_buckets] grids sharded P("series", None). Inputs are
@@ -120,7 +125,7 @@ def build_sharded_downsample(
         lo = (s_idx * local_series).astype(sid.dtype)
         s, c, mn, mx = _local_grids(
             ts, sid, vals, valid, t0, bucket_ms, lo, local_series, num_buckets,
-            with_minmax, sorted_input=sorted_input,
+            with_minmax, sorted_input=sorted_input, sorted_impl=sorted_impl,
         )
         # combine partials across the row shards (ICI all-reduce)
         s = lax.psum(s, "rows")

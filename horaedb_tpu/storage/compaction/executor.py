@@ -110,6 +110,12 @@ class Executor:
 
         time_range = TimeRange.union_of([f.meta.time_range for f in task.inputs])
         # Same merge pipeline as the scan path, on device, builtins kept.
+        # Memory bound: device memory is O(scan_block_rows) (hierarchical
+        # chunked scan), the parquet ENCODE streams to the store at
+        # O(row group + chunk) (write_sst), and the merged host columns are
+        # O(task rows) — admitted only under the memory_limit gate
+        # (pre_check, default 2 GiB), the same bound the reference's
+        # streamed plan enforces via its task budget (executor.rs:93-114).
         batches = await self._storage.parquet_reader.scan_segment(
             task.inputs,
             predicate=None,

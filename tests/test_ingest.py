@@ -270,6 +270,21 @@ class TestWireParser:
                 pass
 
 
+    def test_rejects_field_zero(self):
+        """Field number 0 is malformed per the proto spec; every backend
+        (runtime oracle, hand-rolled Python, native C++) must reject it."""
+        from horaedb_tpu.ingest import native as native_mod
+        from horaedb_tpu.ingest.wire_parser import WireParser
+
+        with pytest.raises(HoraeError):
+            WireParser().parse(b"\x00\x00")
+        with pytest.raises(HoraeError):
+            PyParser().parse(b"\x00\x00")
+        if native_mod.load() is not None:
+            with pytest.raises(HoraeError):
+                native_mod.NativeParser().parse(b"\x00\x00")
+
+
 class TestHashLanes:
     def test_synthetic_payloads_match_oracle(self):
         native = native_parser()
@@ -385,19 +400,3 @@ class TestPool:
         payload = make_payload(seed=3)
         out = PooledParser.decode(payload)
         assert out.n_series == 50
-
-
-def test_wire_parser_rejects_field_zero():
-    """Field number 0 is malformed per the proto spec; the runtime oracle
-    rejects it, so the hand-rolled decoder must too (differential parity)."""
-    from horaedb_tpu.ingest.wire_parser import WireParser
-
-    with pytest.raises(HoraeError):
-        WireParser().parse(b"\x00\x00")
-    with pytest.raises(HoraeError):
-        PyParser().parse(b"\x00\x00")
-    from horaedb_tpu.ingest import native as native_mod
-
-    if native_mod.load() is not None:
-        with pytest.raises(HoraeError):
-            native_mod.NativeParser().parse(b"\x00\x00")
