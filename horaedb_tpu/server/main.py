@@ -137,6 +137,31 @@ async def handle_metrics(request: web.Request) -> web.Response:
     pool = state.parser_pool.status
     METRICS.set("horaedb_parser_pool_size", pool["size"])
     METRICS.set("horaedb_parser_pool_available", pool["available"])
+    # storage/engine gauges: live SSTs and un-merged manifest deltas per
+    # table (the backpressure signals, manifest/mod.rs:248-262), buffered
+    # ingest rows awaiting flush
+    eng = state.engine
+    tables = {
+        "demo": state.storage,
+        "metrics": eng.metrics_table,
+        "series": eng.series_table,
+        "index": eng.index_table,
+        "data": eng.data_table,
+        "exemplars": eng.exemplars_table,
+    }
+    for name, table in tables.items():
+        METRICS.set(
+            f'horaedb_ssts_live{{table="{name}"}}', len(table.manifest.all_ssts())
+        )
+        METRICS.set(
+            f'horaedb_manifest_deltas{{table="{name}"}}',
+            table.manifest.deltas_num,
+        )
+    accum = eng.sample_mgr._accum
+    METRICS.set(
+        "horaedb_ingest_buffered_rows",
+        (accum.rows if accum is not None else 0) + eng.sample_mgr._buffered,
+    )
     return web.Response(text=METRICS.render(), content_type="text/plain")
 
 

@@ -84,6 +84,9 @@ class TestEndpoints:
             text = await r.text()
             assert "horaedb_uptime_seconds" in text
             assert "horaedb_parser_pool_size" in text
+            assert 'horaedb_ssts_live{table="data"}' in text
+            assert 'horaedb_manifest_deltas{table="series"}' in text
+            assert "horaedb_ingest_buffered_rows" in text
         finally:
             await client.close()
 
