@@ -69,6 +69,9 @@ class SampleManager:
         # flush() awaits any in-flight flush (whose snapshot is not yet
         # durable) before flushing the remainder.
         self._flush_lock: "asyncio.Lock | None" = None
+        # Bounded background flush (one in flight): threshold flushes run as
+        # a task so the encode threads overlap continued ingest.
+        self._flush_task: "asyncio.Task | None" = None
 
     @property
     def buffering(self) -> bool:
@@ -86,6 +89,60 @@ class SampleManager:
 
     def should_flush(self, rows: int) -> bool:
         return rows >= self._buffer_rows
+
+    @property
+    def buffered_rows(self) -> int:
+        """Total rows awaiting durability (native accumulator + the Python
+        re-buffer that holds failed-flush snapshots)."""
+        accum = self._accum.rows if self._accum is not None else 0
+        return accum + self._buffered
+
+    # Backlog hard cap, as a multiple of buffer_rows: past it, ingest stops
+    # deferring to the background flush and AWAITS one — restoring
+    # backpressure and surfacing persistent storage failures to the writer
+    # (a remote-write 5xx makes senders retry) instead of acking rows into
+    # an unbounded buffer.
+    BACKLOG_FACTOR = 4
+
+    @property
+    def backlogged(self) -> bool:
+        return self.buffered_rows >= self.BACKLOG_FACTOR * self._buffer_rows
+
+    @property
+    def flush_in_flight(self) -> bool:
+        return self._flush_task is not None and not self._flush_task.done()
+
+    def flush_soon(self) -> None:
+        """Fire a background flush (at most one in flight): the CPU-heavy
+        sort/encode runs on worker threads and overlaps continued ingest.
+        Errors are logged, not raised — the failed snapshot re-buffers (see
+        flush) and the next flush retries it; queries stay consistent
+        because their flush() waits on the same flush lock. The `backlogged`
+        cap bounds how long writers may keep deferring to this path."""
+        import asyncio
+        import logging
+
+        if self.flush_in_flight:
+            return
+
+        async def _bg() -> None:
+            try:
+                await self.flush()
+            except Exception:  # noqa: BLE001 — rows re-buffered for retry
+                logging.getLogger(__name__).exception(
+                    "background ingest flush failed; rows re-buffered"
+                )
+
+        self._flush_task = asyncio.create_task(_bg(), name="ingest-flush")
+
+    async def drain(self) -> None:
+        """Await any background flush, then flush the remainder (shutdown)."""
+        import asyncio
+
+        task = self._flush_task
+        if task is not None:
+            await asyncio.gather(task, return_exceptions=True)
+        await self.flush()
 
     async def persist(
         self,

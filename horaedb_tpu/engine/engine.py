@@ -116,8 +116,9 @@ class MetricEngine:
         return self
 
     async def flush(self) -> None:
-        """Flush any buffered ingest rows to durable SSTs."""
-        await self.sample_mgr.flush()
+        """Flush any buffered ingest rows to durable SSTs (waits out any
+        in-flight background flush first)."""
+        await self.sample_mgr.drain()
 
     async def close(self) -> None:
         await self.flush()
@@ -252,7 +253,20 @@ class MetricEngine:
         if len(req.exemplar_value):
             await self._persist_exemplars(req, metric_arr, tsid_arr)
         if total and self.sample_mgr.should_flush(total):
-            await self.sample_mgr.flush()
+            if self.sample_mgr.backlogged:
+                # backlog cap: stop acking into an unbounded buffer — await
+                # the flush so storage failures surface as 5xx (senders
+                # retry) and ingest feels the backpressure
+                await self.sample_mgr.flush()
+            else:
+                # background flush: encode threads overlap continued ingest
+                self.sample_mgr.flush_soon()
+        if self.sample_mgr.flush_in_flight:
+            # cooperative yield: the steady write path never suspends, so a
+            # driver hammering write_payload back-to-back would starve the
+            # flush task; one loop turn per payload lets its thread-offload
+            # completions schedule (a real server yields at socket reads)
+            await asyncio.sleep(0)
         return req.n_samples
 
     async def _write_parsed_fast(self, req: ParsedWriteRequest) -> int:

@@ -157,11 +157,7 @@ async def handle_metrics(request: web.Request) -> web.Response:
             f'horaedb_manifest_deltas{{table="{name}"}}',
             table.manifest.deltas_num,
         )
-    accum = eng.sample_mgr._accum
-    METRICS.set(
-        "horaedb_ingest_buffered_rows",
-        (accum.rows if accum is not None else 0) + eng.sample_mgr._buffered,
-    )
+    METRICS.set("horaedb_ingest_buffered_rows", eng.sample_mgr.buffered_rows)
     return web.Response(text=METRICS.render(), content_type="text/plain")
 
 

@@ -554,3 +554,44 @@ class TestIndexDeltaCompaction:
         mid2 = eng2.metric_mgr.get(b"cpu")[0]
         assert eng2.index_mgr.series_of(mid2) == mgr.series_of(mid)
         await eng2.close()
+
+
+class TestBackgroundFlushBackpressure:
+    @async_test
+    async def test_backlog_cap_forces_synchronous_flush(self):
+        """Past BACKLOG_FACTOR x buffer_rows the write path must AWAIT the
+        flush (propagating storage errors) instead of acking into an
+        unbounded buffer."""
+        from horaedb_tpu.common.error import HoraeError
+
+        store = MemStore()
+        eng = await MetricEngine.open(
+            "db", store, segment_duration_ms=HOUR,
+            enable_compaction=False, ingest_buffer_rows=10,
+        )
+        if not eng.sample_mgr.native_accum_active:
+            pytest.skip("native accumulator unavailable")
+        # break the storage so every flush fails
+        calls = {"n": 0}
+
+        async def failing(*a, **kw):
+            calls["n"] += 1
+            raise HoraeError("injected store failure")
+
+        eng.sample_mgr._write_segment = failing
+        payload = make_remote_write(
+            [({"__name__": "cpu", "host": f"h{i}"}, [(1000 + j, 1.0) for j in range(10)])
+             for i in range(4)]
+        )  # 40 rows/payload, threshold 10, backlog cap 40
+        saw_error = False
+        for _ in range(8):
+            try:
+                await eng.write_payload(payload)
+            except HoraeError:
+                saw_error = True
+                break
+            await asyncio.sleep(0.01)  # let background flushes run
+        assert saw_error, "backlogged ingest never surfaced the storage failure"
+        assert eng.sample_mgr.buffered_rows <= eng.sample_mgr.BACKLOG_FACTOR * 10 + 80
+        eng.sample_mgr._write_segment = type(eng.sample_mgr)._write_segment.__get__(eng.sample_mgr)
+        await eng.close()
