@@ -69,8 +69,10 @@ async def run_soak(seconds: int) -> dict:
                             stats["samples_sent"] += body["samples"]
                         else:
                             stats["write_errors"] += 1
-                except Exception:  # noqa: BLE001
+                            stats.setdefault("first_write_error", f"{r.status}: {body}")
+                except Exception as e:  # noqa: BLE001
                     stats["write_errors"] += 1
+                    stats.setdefault("first_write_error", repr(e))
                 seq += 1
                 await asyncio.sleep(0.05)
 
@@ -87,13 +89,15 @@ async def run_soak(seconds: int) -> dict:
                     async with sess.post(
                         f"http://127.0.0.1:{PORT}/api/v1/query", json=q
                     ) as r:
-                        await r.json()
+                        body = await r.json()
                         if r.status == 200:
                             stats["queries"] += 1
                         else:
                             stats["query_errors"] += 1
-                except Exception:  # noqa: BLE001
+                            stats.setdefault("first_query_error", f"{r.status}: {body}")
+                except Exception as e:  # noqa: BLE001
                     stats["query_errors"] += 1
+                    stats.setdefault("first_query_error", repr(e))
                 await asyncio.sleep(0.25)
 
         await asyncio.gather(*(writer(w) for w in range(4)), querier(), querier())
@@ -121,11 +125,13 @@ def main() -> None:
         )
     env = dict(os.environ)
     env["HORAEDB_JAX_PLATFORM"] = env.get("HORAEDB_JAX_PLATFORM", "cpu")
+    log_path = os.environ.get("SOAK_SERVER_LOG")
+    log_f = open(log_path, "wb") if log_path else subprocess.DEVNULL
     server = subprocess.Popen(
         [sys.executable, "-m", "horaedb_tpu.server.main", "--config", cfg],
         env=env,
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
+        stdout=log_f,
+        stderr=subprocess.STDOUT if log_path else subprocess.DEVNULL,
     )
     try:
         time.sleep(5)  # server warmup
@@ -147,6 +153,8 @@ def main() -> None:
             server.wait(timeout=10)
         except subprocess.TimeoutExpired:
             server.kill()
+        if log_path:
+            log_f.close()
 
 
 if __name__ == "__main__":

@@ -136,13 +136,19 @@ class SampleManager:
         self._flush_task = asyncio.create_task(_bg(), name="ingest-flush")
 
     async def drain(self) -> None:
-        """Await any background flush, then flush the remainder (shutdown)."""
+        """Await background flushes, then flush the remainder (shutdown).
+        Loops: a concurrent writer may schedule a new background task while
+        we await — exit only when none appeared, so no pending task (or its
+        re-buffered rows) is abandoned at loop teardown."""
         import asyncio
 
-        task = self._flush_task
-        if task is not None:
-            await asyncio.gather(task, return_exceptions=True)
-        await self.flush()
+        while True:
+            task = self._flush_task
+            if task is not None:
+                await asyncio.gather(task, return_exceptions=True)
+            await self.flush()
+            if self._flush_task is task:
+                return
 
     async def persist(
         self,
@@ -426,17 +432,24 @@ class SampleManager:
         )
         acc: dict[str, np.ndarray] | None = None
         for seg in self._storage.group_by_segment(ssts):
-            part = await self._storage.parquet_reader.scan_segment_downsample(
-                seg,
-                predicate=pred,
-                ts_column="ts",
-                value_column="value",
-                series_column="tsid",
-                series_ids=series_ids,
-                t0=rng.start,
-                bucket_ms=bucket_ms,
-                num_buckets=num_buckets,
+            # retry wrapper: a compaction may delete this snapshot's files
+            # mid-query; the refresh re-reads the segment's live SSTs
+            part = await self._storage.scan_segment_retrying(
+                seg, rng,
+                lambda fresh: self._storage.parquet_reader.scan_segment_downsample(
+                    fresh,
+                    predicate=pred,
+                    ts_column="ts",
+                    value_column="value",
+                    series_column="tsid",
+                    series_ids=series_ids,
+                    t0=rng.start,
+                    bucket_ms=bucket_ms,
+                    num_buckets=num_buckets,
+                ),
             )
+            if part is None:  # segment vanished entirely (TTL)
+                continue
             if acc is None:
                 acc = part
             else:

@@ -248,19 +248,19 @@ class MetricEngine:
             if req.n_series == 0:
                 return 0
             metric_arr, tsid_arr = await self._resolve_ids_fast(req)
+            if req.n_samples and self.sample_mgr.backlogged:
+                # backlog cap BEFORE buffering: drain synchronously so a
+                # storage outage rejects this payload un-buffered (5xx ->
+                # sender retries later) instead of acking rows into an
+                # unbounded buffer on every retry
+                await self.sample_mgr.flush()
             if req.n_samples:
                 total = self.sample_mgr.buffer_native_add(parser)
         if len(req.exemplar_value):
             await self._persist_exemplars(req, metric_arr, tsid_arr)
         if total and self.sample_mgr.should_flush(total):
-            if self.sample_mgr.backlogged:
-                # backlog cap: stop acking into an unbounded buffer — await
-                # the flush so storage failures surface as 5xx (senders
-                # retry) and ingest feels the backpressure
-                await self.sample_mgr.flush()
-            else:
-                # background flush: encode threads overlap continued ingest
-                self.sample_mgr.flush_soon()
+            # background flush: encode threads overlap continued ingest
+            self.sample_mgr.flush_soon()
         if self.sample_mgr.flush_in_flight:
             # cooperative yield: the steady write path never suspends, so a
             # driver hammering write_payload back-to-back would starve the

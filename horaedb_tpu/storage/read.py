@@ -264,11 +264,17 @@ class ParquetReader:
             pf = pq.ParquetFile(io.BytesIO(data))
             return _read_pruned(pf, columns, predicate)
 
+        from horaedb_tpu.objstore import NotFound
+
         try:
             return await asyncio.to_thread(_read)
         except _NeedBytes:
             data = await self._store.get(path)
             return await asyncio.to_thread(_read_bytes, data)
+        except FileNotFoundError as e:
+            # compaction deleted the file after the caller's manifest
+            # snapshot; normalized so scan layers can refresh + retry
+            raise NotFound(f"sst object vanished: {path}") from e
 
     def evict_cached(self, file_id: int) -> None:
         """Drop the cached handle of a deleted SST (compaction calls this

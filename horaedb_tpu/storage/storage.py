@@ -454,11 +454,15 @@ class ObjectBasedStorage(ColumnarStorage):
         segments = self.group_by_segment(ssts)
 
         def start(seg):
-            return asyncio.ensure_future(self._reader.scan_segment(
-                seg,
-                predicate=req.predicate,
-                projections=req.projections,
-                keep_builtin=False,
+            return asyncio.ensure_future(self.scan_segment_retrying(
+                seg, req.range,
+                lambda fresh: self._reader.scan_segment(
+                    fresh,
+                    predicate=req.predicate,
+                    projections=req.projections,
+                    keep_builtin=False,
+                ),
+                empty_result=[],
             ))
 
         pending = start(segments[0])
@@ -475,6 +479,37 @@ class ObjectBasedStorage(ColumnarStorage):
                     await pending
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
+
+    async def scan_segment_retrying(self, seg_ssts, time_range, op, empty_result=None):
+        """Run a per-segment scan `op`, refreshing the segment's SST list
+        from the manifest on NotFound: a compaction may physically delete
+        input files between the caller's manifest snapshot and the read.
+        Sound because compaction is segment-local (picker groups by
+        segment), so the replacement SST lives in the same segment; an
+        empty refresh means the data was TTL-expired."""
+        from horaedb_tpu.objstore import NotFound
+
+        seg_key = Timestamp(seg_ssts[0].meta.time_range.start).truncate_by(
+            self._segment_duration
+        ).value
+        for _attempt in range(3):
+            try:
+                return await op(seg_ssts)
+            except NotFound:
+                fresh = [
+                    s for s in self._manifest.find_ssts(time_range)
+                    if Timestamp(s.meta.time_range.start).truncate_by(
+                        self._segment_duration
+                    ).value == seg_key
+                ]
+                if not fresh:
+                    return empty_result
+                logger.info(
+                    "segment scan raced a compaction; retrying with %d fresh ssts",
+                    len(fresh),
+                )
+                seg_ssts = fresh
+        return await op(seg_ssts)  # last attempt: let NotFound propagate
 
     def group_by_segment(self, ssts: list[SstFile]) -> list[list[SstFile]]:
         """Bucket SSTs by segment start, ordered old->new (storage.rs:343-345)."""
