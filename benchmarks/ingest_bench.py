@@ -60,8 +60,9 @@ def main() -> None:
 
     async def run() -> dict:
         store = LocalStore(tempfile.mkdtemp(prefix="ingest_"))
+        buffer_rows = int(os.environ.get("INGEST_BUFFER_ROWS", str(256 * 1024)))
         eng = await MetricEngine.open(
-            "db", store, enable_compaction=False, ingest_buffer_rows=256 * 1024
+            "db", store, enable_compaction=False, ingest_buffer_rows=buffer_rows
         )
         payloads = [make_payload(s) for s in range(n_payloads)]
         # warm (registers series, compiles the write-path sort)

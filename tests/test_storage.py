@@ -627,3 +627,69 @@ class TestScanCompactionRace:
             got.append(b)
         assert sum(b.num_rows for b in got) == 6
         await eng.close()
+
+
+class TestCrashArtifacts:
+    @async_test
+    async def test_leftover_tmp_files_ignored_on_recovery(self):
+        """A crash mid-put_stream leaves only a `.tmp` staging file; reopen
+        must ignore it (never list it as an object) and writes must still
+        succeed over it."""
+        import os
+        import tempfile
+
+        import numpy as np
+        import pyarrow as pa
+
+        from horaedb_tpu.objstore import LocalStore
+        from horaedb_tpu.storage.read import ScanRequest, WriteRequest
+        from horaedb_tpu.storage.storage import ObjectBasedStorage
+        from horaedb_tpu.storage.types import TimeRange
+
+        HOUR = 3_600_000
+        root = tempfile.mkdtemp(prefix="crash_")
+        store = LocalStore(root)
+        schema = pa.schema([("pk", pa.int64()), ("v", pa.float64())])
+        eng = await ObjectBasedStorage.try_new(
+            root="db", store=store, arrow_schema=schema, num_primary_keys=1,
+            segment_duration_ms=HOUR, enable_compaction_scheduler=False,
+        )
+        batch = pa.RecordBatch.from_pydict(
+            {"pk": np.arange(3), "v": np.zeros(3)}, schema=schema
+        )
+        await eng.write(WriteRequest(batch, TimeRange(0, 10)))
+        await eng.close()
+        # simulate a crashed stream: truncated staging files in data/ and
+        # manifest/
+        data_dir = os.path.join(root, "db", "data")
+        with open(os.path.join(data_dir, "999.sst.tmp"), "wb") as f:
+            f.write(b"partial")
+        with open(os.path.join(root, "db", "manifest", "snapshot.tmp"), "wb") as f:
+            f.write(b"partial")
+        listed = {m.path for m in await store.list("db/data")}
+        # staging artifacts must never surface as objects
+        assert not any(p.endswith(".tmp") for p in listed), listed
+        # recovery: open, scan, write again
+        eng2 = await ObjectBasedStorage.try_new(
+            root="db", store=store, arrow_schema=schema, num_primary_keys=1,
+            segment_duration_ms=HOUR, enable_compaction_scheduler=False,
+        )
+        rows = 0
+        async for b in eng2.scan(ScanRequest(range=TimeRange(0, 100))):
+            rows += b.num_rows
+        assert rows == 3
+        batch2 = pa.RecordBatch.from_pydict(
+            {"pk": np.arange(10, 13), "v": np.ones(3)}, schema=schema
+        )
+        await eng2.write(WriteRequest(batch2, TimeRange(10, 20)))
+        rows2 = 0
+        async for b in eng2.scan(ScanRequest(range=TimeRange(0, 100))):
+            rows2 += b.num_rows
+        assert rows2 == 6
+        # post-recovery listing is equally .tmp-free
+        listed_after = {m.path for m in await store.list("db/data")}
+        assert not any(p.endswith(".tmp") for p in listed_after), listed_after
+        await eng2.close()
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
