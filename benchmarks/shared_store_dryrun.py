@@ -1,0 +1,146 @@
+"""Two-process shared-object-store dry run: the reference's distributed data
+plane is shared object storage with single-writer-per-region and readers
+bootstrapping from the manifest (RFC :28-76; object store as the inter-node
+"network", SURVEY §5.8). This validates that model across REAL process
+boundaries: a writer process ingests remote-write payloads through the full
+engine into a LocalStore root; a separate reader process opens independent
+engine instances over the same root and must see exactly the committed
+state — twice, across two write rounds, proving snapshot+delta recovery
+carries cross-process.
+
+Usage: python benchmarks/shared_store_dryrun.py
+(self-orchestrating: runs writer and reader phases in child processes)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT_ENV = "SHARED_STORE_ROOT"
+SERIES = 40
+SAMPLES_PER_SERIES = 25
+
+
+def _engine_env() -> dict:
+    env = dict(os.environ)
+    env["HORAEDB_JAX_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def writer(round_no: int) -> None:
+    import asyncio
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from horaedb_tpu.engine import MetricEngine
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.pb import remote_write_pb2
+
+    def payload() -> bytes:
+        req = remote_write_pb2.WriteRequest()
+        base = 1_700_000_000_000 + round_no * 60_000
+        for s in range(SERIES):
+            ts = req.timeseries.add()
+            for k, v in (
+                (b"__name__", b"shared_metric"),
+                (b"host", f"r{round_no}-h{s:03d}".encode()),
+            ):
+                lab = ts.labels.add()
+                lab.name = k
+                lab.value = v
+            for i in range(SAMPLES_PER_SERIES):
+                smp = ts.samples.add()
+                smp.timestamp = base + i * 1000
+                smp.value = float(round_no * 1000 + s)
+        return req.SerializeToString()
+
+    async def run() -> None:
+        store = LocalStore(os.environ[ROOT_ENV])
+        eng = await MetricEngine.open(
+            "db", store, enable_compaction=False, ingest_buffer_rows=4096
+        )
+        n = await eng.write_payload(payload())
+        await eng.close()  # flush + durable
+        print(json.dumps({"role": "writer", "round": round_no, "samples": n}))
+
+    asyncio.run(run())
+
+
+def reader(expect_rounds: int) -> None:
+    import asyncio
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.objstore import LocalStore
+
+    async def run() -> None:
+        store = LocalStore(os.environ[ROOT_ENV])
+        eng = await MetricEngine.open("db", store, enable_compaction=False)
+        t = await eng.query(
+            QueryRequest(metric=b"shared_metric", start_ms=0, end_ms=1 << 60)
+        )
+        rows = 0 if t is None else t.num_rows
+        hit = eng.metric_mgr.get(b"shared_metric")
+        series = 0 if hit is None else len(eng.index_mgr.series_of(hit[0]))
+        # one round's tag filter still resolves through the recovered index
+        t1 = await eng.query(
+            QueryRequest(
+                metric=b"shared_metric", start_ms=0, end_ms=1 << 60,
+                filters=[(b"host", b"r0-h001")],
+            )
+        )
+        filtered = 0 if t1 is None else t1.num_rows
+        await eng.close()
+        expect_rows = expect_rounds * SERIES * SAMPLES_PER_SERIES
+        ok = (
+            rows == expect_rows
+            and series == expect_rounds * SERIES
+            and filtered == SAMPLES_PER_SERIES
+        )
+        print(json.dumps({
+            "role": "reader", "rounds_seen": expect_rounds, "rows": rows,
+            "series": series, "filtered_rows": filtered, "ok": ok,
+        }))
+        if not ok:
+            raise SystemExit(1)
+
+    asyncio.run(run())
+
+
+def main() -> None:
+    root = tempfile.mkdtemp(prefix="shared_store_")
+    env = _engine_env()
+    env[ROOT_ENV] = root
+    me = os.path.abspath(__file__)
+
+    def child(args: list[str]) -> None:
+        r = subprocess.run(
+            [sys.executable, me, *args], env=env, timeout=300
+        )
+        if r.returncode != 0:
+            raise SystemExit(r.returncode)
+
+    child(["writer", "0"])
+    child(["reader", "1"])   # sees round 0 exactly
+    child(["writer", "1"])
+    child(["reader", "2"])   # a fresh reader sees both rounds
+    print(json.dumps({"bench": "shared_store_dryrun", "ok": True, "root": root}))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "writer":
+        writer(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "reader":
+        reader(int(sys.argv[2]))
+    else:
+        main()

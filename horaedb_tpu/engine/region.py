@@ -1,0 +1,223 @@
+"""Region partitioning: the RFC's distributed design, implemented.
+
+Reference: docs/rfcs/20240827-metric-engine.md:28-76 — one `root`
+super-table partitioned by hash into Regions, routed by a meta service,
+single writer per region over shared object storage. The snapshot ships no
+implementation (SURVEY §2.5 "inter-node: ABSENT"); this module provides a
+working one:
+
+- `RegionRouter`: deterministic metric -> region assignment by seahash
+  range (metric granularity, so every query resolves in exactly ONE region
+  — no cross-region merge on the read path; the RFC's series-hash
+  partitioning is a sharper-grained variant of the same scheme).
+- `RegionedEngine`: N independent `MetricEngine` instances over sub-roots
+  `{root}/region-{i}` of one shared object store. Writes split per region
+  (vectorized on the parser's hash lanes); queries route. Each region is a
+  separate LSM with its own manifest — the single-writer-per-region
+  invariant the reference states at types.rs:135.
+
+Multi-node deployment shape: run each region's engine in its own process
+(or host) against the same object store — benchmarks/shared_store_dryrun.py
+validates the cross-process story; this module adds the routing fabric.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horaedb_tpu.common.hash import seahash
+from horaedb_tpu.engine.engine import MetricEngine, QueryRequest
+from horaedb_tpu.ingest.types import ParsedWriteRequest
+
+
+class RegionRouter:
+    """Deterministic metric->region map: regions own equal slices of the
+    64-bit seahash space (range partition, RFC :28-76)."""
+
+    def __init__(self, num_regions: int):
+        self.num_regions = num_regions
+
+    def region_of_name(self, metric_name: bytes) -> int:
+        return self.region_of_id(seahash(metric_name))
+
+    def region_of_id(self, metric_id: int) -> int:
+        # multiply-shift over the TOP 32 id bits: identical math in the
+        # scalar and vectorized paths (u64-safe in numpy — a full 128-bit
+        # product is not), so writes and queries can never disagree on a
+        # metric's region
+        return ((metric_id >> 32) * self.num_regions) >> 32
+
+    def regions_of_ids(self, metric_ids: np.ndarray) -> np.ndarray:
+        """Vectorized routing over a u64 id lane (same formula as
+        region_of_id, element-wise)."""
+        ids = metric_ids.astype(np.uint64, copy=False)
+        return (
+            ((ids >> np.uint64(32)) * np.uint64(self.num_regions))
+            >> np.uint64(32)
+        ).astype(np.int64)
+
+
+def _subset_request(req: ParsedWriteRequest, series_idx: np.ndarray) -> ParsedWriteRequest:
+    """Build a per-region view of a FULLY-PARSED request containing only
+    `series_idx` (sorted), with sample/exemplar lanes filtered and their
+    series indices remapped to the subset's ordering."""
+    remap = np.full(req.n_series, -1, dtype=np.int64)
+    remap[series_idx] = np.arange(len(series_idx))
+    smask = remap[req.sample_series] >= 0
+    emask = (
+        remap[req.exemplar_series] >= 0
+        if len(req.exemplar_series)
+        else np.zeros(0, dtype=bool)
+    )
+    # samples stay grouped by (remapped, ascending) series, so the subset's
+    # per-series sample ranges are the cumsum of the filtered counts — the
+    # buffered ingest path consumes these
+    sub_counts = req.series_sample_count[series_idx]
+    sub_starts = np.concatenate(([0], np.cumsum(sub_counts)[:-1])).astype(np.int64)
+    # exemplar labels stay aligned with the (filtered) exemplar rows via
+    # their per-exemplar start/count ranges — keep the flat ex-label lanes
+    # whole and only filter the per-exemplar rows (ranges still index into
+    # the shared flat lanes).
+    return ParsedWriteRequest(
+        payload=req.payload,
+        series_label_start=req.series_label_start[series_idx],
+        series_label_count=req.series_label_count[series_idx],
+        series_sample_start=sub_starts,
+        series_sample_count=sub_counts,
+        label_name_off=req.label_name_off,
+        label_name_len=req.label_name_len,
+        label_value_off=req.label_value_off,
+        label_value_len=req.label_value_len,
+        sample_value=req.sample_value[smask],
+        sample_ts=req.sample_ts[smask],
+        sample_series=remap[req.sample_series[smask]],
+        exemplar_value=req.exemplar_value[emask],
+        exemplar_ts=req.exemplar_ts[emask],
+        exemplar_series=remap[req.exemplar_series[emask]]
+        if len(req.exemplar_series) else req.exemplar_series,
+        exemplar_label_start=req.exemplar_label_start[emask],
+        exemplar_label_count=req.exemplar_label_count[emask],
+        ex_label_name_off=req.ex_label_name_off,
+        ex_label_name_len=req.ex_label_name_len,
+        ex_label_value_off=req.ex_label_value_off,
+        ex_label_value_len=req.ex_label_value_len,
+        meta_type=req.meta_type,
+        meta_name_off=req.meta_name_off,
+        meta_name_len=req.meta_name_len,
+        series_metric_id=None if req.series_metric_id is None
+        else req.series_metric_id[series_idx],
+        series_tsid=None if req.series_tsid is None else req.series_tsid[series_idx],
+        series_name_off=None if req.series_name_off is None
+        else req.series_name_off[series_idx],
+        series_name_len=None if req.series_name_len is None
+        else req.series_name_len[series_idx],
+        series_key_off=None if req.series_key_off is None
+        else req.series_key_off[series_idx],
+        series_key_len=None if req.series_key_len is None
+        else req.series_key_len[series_idx],
+        key_arena=req.key_arena,
+    )
+
+
+class RegionedEngine:
+    """N region engines over one shared object store + the router."""
+
+    def __init__(self) -> None:
+        raise RuntimeError("use RegionedEngine.open")
+
+    @classmethod
+    async def open(
+        cls, root: str, store, num_regions: int, **engine_kwargs
+    ) -> "RegionedEngine":
+        import asyncio
+
+        self = object.__new__(cls)
+        self.router = RegionRouter(num_regions)
+        self.engines = []
+        try:
+            for i in range(num_regions):
+                self.engines.append(
+                    await MetricEngine.open(
+                        f"{root}/region-{i}", store, **engine_kwargs
+                    )
+                )
+        except BaseException:
+            # close the regions that did open — a retry loop must not leak
+            # their tables/flush state
+            await asyncio.gather(
+                *(e.close() for e in self.engines), return_exceptions=True
+            )
+            raise
+        return self
+
+    async def close(self) -> None:
+        import asyncio
+
+        await asyncio.gather(*(e.close() for e in self.engines))
+
+    async def flush(self) -> None:
+        import asyncio
+
+        # regions are isolated engines over disjoint sub-roots: fan out
+        await asyncio.gather(*(e.flush() for e in self.engines))
+
+    # -- write path ----------------------------------------------------------
+    async def write_parsed(self, req: ParsedWriteRequest) -> int:
+        """Split per region on the hash lanes and delegate. Requests whose
+        series all route to one region (the common scrape shape) delegate
+        without any copying."""
+        if req.n_series == 0:
+            return 0
+        if req.series_metric_id is not None:
+            regions = self.router.regions_of_ids(req.series_metric_id)
+        else:
+            from horaedb_tpu.engine.engine import NAME_LABEL
+
+            ids = np.empty(req.n_series, dtype=np.uint64)
+            for s in range(req.n_series):
+                name = b""
+                for k, v in req.series_labels(s):
+                    if k == NAME_LABEL:
+                        name = v
+                ids[s] = seahash(name)
+            regions = self.router.regions_of_ids(ids)
+        uniq = np.unique(regions)
+        if len(uniq) == 1:
+            return await self.engines[int(uniq[0])].write_parsed(req)
+        import asyncio
+
+        counts = await asyncio.gather(*(
+            self.engines[r].write_parsed(
+                _subset_request(req, np.flatnonzero(regions == r))
+            )
+            for r in uniq.tolist()
+        ))
+        return sum(counts)
+
+    # -- read path -------------------------------------------------------------
+    def _engine_for(self, metric: bytes) -> MetricEngine:
+        return self.engines[self.router.region_of_name(metric)]
+
+    async def query(self, req: QueryRequest):
+        return await self._engine_for(req.metric).query(req)
+
+    async def query_exemplars(self, req: QueryRequest):
+        return await self._engine_for(req.metric).query_exemplars(req)
+
+    def label_values(self, metric: bytes, key: bytes) -> list[bytes]:
+        return self._engine_for(metric).label_values(metric, key)
+
+    def series(self, metric: bytes):
+        return self._engine_for(metric).series(metric)
+
+    def metric_names(self) -> list[bytes]:
+        """Fan-out union (the one cross-region read surface)."""
+        out: list[bytes] = []
+        for e in self.engines:
+            out.extend(e.metric_names())
+        return sorted(set(out))
+
+    async def compact(self) -> None:
+        import asyncio
+
+        await asyncio.gather(*(e.compact() for e in self.engines))

@@ -1,0 +1,144 @@
+"""Region partitioning tests (RFC :28-76 — implemented here, design-only in
+the reference)."""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.engine import MetricEngine, QueryRequest
+from horaedb_tpu.engine.region import RegionedEngine, RegionRouter
+from horaedb_tpu.ingest import PooledParser
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.pb import remote_write_pb2
+from tests.conftest import async_test
+
+HOUR = 3_600_000
+
+
+def make_payload(metrics, samples_per_series=4):
+    req = remote_write_pb2.WriteRequest()
+    for m, hosts in metrics:
+        for h in hosts:
+            ts = req.timeseries.add()
+            for k, v in ((b"__name__", m), (b"host", h)):
+                lab = ts.labels.add()
+                lab.name = k
+                lab.value = v
+            for i in range(samples_per_series):
+                s = ts.samples.add()
+                s.timestamp = 1000 + i * 1000
+                s.value = float(i)
+            ex = ts.exemplars.add()
+            ex.value = 0.5
+            ex.timestamp = 1500
+            lab = ex.labels.add()
+            lab.name = b"trace_id"
+            lab.value = b"t-" + h
+    return req.SerializeToString()
+
+
+class TestRouter:
+    def test_scalar_vector_consistency(self):
+        """Writes (vectorized routing) and queries (scalar routing) must
+        agree for every id — boundary ids included."""
+        r = RegionRouter(7)
+        rng = np.random.default_rng(0)
+        ids = np.concatenate([
+            rng.integers(0, 1 << 63, 5000, dtype=np.int64).astype(np.uint64),
+            np.asarray([0, 1, (1 << 64) - 1, 1 << 63, (1 << 32) - 1], np.uint64),
+        ])
+        vec = r.regions_of_ids(ids)
+        for i, rid in zip(ids.tolist(), vec.tolist()):
+            assert r.region_of_id(i) == rid
+        assert vec.min() >= 0 and vec.max() < 7
+
+    def test_spread(self):
+        r = RegionRouter(4)
+        names = [f"metric_{i}".encode() for i in range(400)]
+        counts = np.bincount([r.region_of_name(n) for n in names], minlength=4)
+        assert (counts > 40).all(), counts  # roughly balanced
+
+
+METRICS = [
+    (b"cpu", [b"a", b"b"]),
+    (b"mem", [b"a"]),
+    (b"disk_io", [b"a", b"b", b"c"]),
+    (b"net_rx", [b"a"]),
+    (b"load1", [b"a", b"b"]),
+]
+
+
+class TestRegionedEngine:
+    @async_test
+    async def test_write_query_across_regions(self):
+        store = MemStore()
+        eng = await RegionedEngine.open(
+            "db", store, num_regions=3,
+            segment_duration_ms=HOUR, enable_compaction=False,
+        )
+        payload = make_payload(METRICS)
+        parsed = PooledParser.decode(payload)
+        n = await eng.write_parsed(parsed)
+        assert n == 9 * 4
+        # regions actually split the metrics
+        owners = {m: eng.router.region_of_name(m) for m, _ in METRICS}
+        assert len(set(owners.values())) > 1, owners
+        for m, hosts in METRICS:
+            t = await eng.query(QueryRequest(metric=m, start_ms=0, end_ms=10_000))
+            assert t.num_rows == len(hosts) * 4, m
+            t1 = await eng.query(
+                QueryRequest(metric=m, start_ms=0, end_ms=10_000,
+                             filters=[(b"host", b"a")])
+            )
+            assert t1.num_rows == 4
+            ex = await eng.query_exemplars(
+                QueryRequest(metric=m, start_ms=0, end_ms=10_000)
+            )
+            assert ex.num_rows == len(hosts)
+            assert eng.label_values(m, b"host") == sorted(hosts)
+        assert eng.metric_names() == sorted(m for m, _ in METRICS)
+        await eng.close()
+
+    @async_test
+    async def test_matches_single_engine_results(self):
+        """Region splitting must be invisible: same queries, same answers
+        as one unpartitioned engine."""
+        payload = make_payload(METRICS)
+        store1, store2 = MemStore(), MemStore()
+        single = await MetricEngine.open(
+            "db", store1, segment_duration_ms=HOUR, enable_compaction=False
+        )
+        regioned = await RegionedEngine.open(
+            "db", store2, num_regions=4,
+            segment_duration_ms=HOUR, enable_compaction=False,
+        )
+        await single.write_parsed(PooledParser.decode(payload))
+        await regioned.write_parsed(PooledParser.decode(payload))
+        for m, _hosts in METRICS:
+            q = QueryRequest(metric=m, start_ms=0, end_ms=10_000)
+            ts1 = (await single.query(q)).sort_by("tsid").to_pydict()
+            ts2 = (await regioned.query(q)).sort_by("tsid").to_pydict()
+            assert ts1 == ts2, m
+        await single.close()
+        await regioned.close()
+
+    @async_test
+    async def test_buffered_regions_and_restart(self):
+        """Buffered ingest + restart recovery work per region."""
+        store = MemStore()
+        eng = await RegionedEngine.open(
+            "db", store, num_regions=2,
+            segment_duration_ms=HOUR, enable_compaction=False,
+            ingest_buffer_rows=1000,
+        )
+        await eng.write_parsed(PooledParser.decode(make_payload(METRICS)))
+        t = await eng.query(QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000))
+        assert t.num_rows == 8  # flush-before-query inside the region
+        await eng.close()
+        eng2 = await RegionedEngine.open(
+            "db", store, num_regions=2,
+            segment_duration_ms=HOUR, enable_compaction=False,
+        )
+        for m, hosts in METRICS:
+            t = await eng2.query(QueryRequest(metric=m, start_ms=0, end_ms=10_000))
+            assert t.num_rows == len(hosts) * 4
+        await eng2.close()
