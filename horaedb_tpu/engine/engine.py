@@ -115,6 +115,11 @@ class MetricEngine:
         await self.index_mgr.open()
         return self
 
+    def sub_engines(self) -> "dict[str, MetricEngine]":
+        """Uniform enumeration for observability surfaces — one unpartitioned
+        engine; RegionedEngine returns one entry per region."""
+        return {"": self}
+
     async def flush(self) -> None:
         """Flush any buffered ingest rows to durable SSTs (waits out any
         in-flight background flush first)."""

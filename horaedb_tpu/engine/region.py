@@ -127,12 +127,36 @@ class RegionedEngine:
 
     @classmethod
     async def open(
-        cls, root: str, store, num_regions: int, **engine_kwargs
+        cls, root: str, store, num_regions: int, parser_pool=None, **engine_kwargs
     ) -> "RegionedEngine":
         import asyncio
+        import json
+
+        from horaedb_tpu.common.error import ensure
+        from horaedb_tpu.objstore import NotFound
+
+        # The region count is part of the on-disk layout: the router maps
+        # metrics by it, so reopening with a different N would silently make
+        # existing data invisible (or never open some regions at all). A
+        # REGIONS descriptor pins it; mismatches fail loudly.
+        desc_path = f"{root}/REGIONS"
+        try:
+            desc = json.loads((await store.get(desc_path)).decode())
+            ensure(
+                desc.get("num_regions") == num_regions,
+                f"store at {root!r} was created with "
+                f"num_regions={desc.get('num_regions')}; reopening with "
+                f"{num_regions} would strand data — repartitioning requires "
+                f"a rewrite, not a config change",
+            )
+        except NotFound:
+            await store.put(
+                desc_path, json.dumps({"num_regions": num_regions}).encode()
+            )
 
         self = object.__new__(cls)
         self.router = RegionRouter(num_regions)
+        self._pool = parser_pool
         self.engines = []
         try:
             for i in range(num_regions):
@@ -150,6 +174,11 @@ class RegionedEngine:
             raise
         return self
 
+    def sub_engines(self) -> dict[str, MetricEngine]:
+        """Uniform enumeration for observability surfaces (prefix -> engine);
+        MetricEngine exposes the same shape."""
+        return {f"region-{i}/": e for i, e in enumerate(self.engines)}
+
     async def close(self) -> None:
         import asyncio
 
@@ -162,6 +191,17 @@ class RegionedEngine:
         await asyncio.gather(*(e.flush() for e in self.engines))
 
     # -- write path ----------------------------------------------------------
+    async def write_payload(self, payload: bytes) -> int:
+        """Parse + route one wire payload. Regioned ingest always uses the
+        full parse (hash lanes included): the zero-copy accumulator light
+        path is single-engine-only since its samples bypass Python."""
+        from horaedb_tpu.ingest import ParserPool
+
+        if self._pool is None:
+            self._pool = ParserPool()
+        parsed = await self._pool.decode(payload)
+        return await self.write_parsed(parsed)
+
     async def write_parsed(self, req: ParsedWriteRequest) -> int:
         """Split per region on the hash lanes and delegate. Requests whose
         series all route to one region (the common scrape shape) delegate

@@ -107,6 +107,10 @@ class MetricEngineConfig:
     ingest_flush_interval: ReadableDuration = field(
         default_factory=lambda: ReadableDuration.secs(1)
     )
+    # Region partitioning (RFC :28-76): > 1 runs N independent region
+    # engines over the shared store, metrics routed by seahash range
+    # (engine/region.py). 1 = a single unpartitioned engine.
+    num_regions: int = 1
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "MetricEngineConfig":

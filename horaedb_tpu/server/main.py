@@ -95,11 +95,11 @@ def snappy_decompress(buf: bytes) -> bytes:
 
 
 class ServerState:
-    def __init__(self, config: Config, storage, engine: MetricEngine):
+    def __init__(self, config: Config, storage, engine: MetricEngine, parser_pool=None):
         self.config = config
         self.storage = storage       # demo ColumnarStorage (reference parity)
         self.engine = engine         # metric engine (remote-write path)
-        self.parser_pool = ParserPool()
+        self.parser_pool = parser_pool or ParserPool()
         self.write_enabled = asyncio.Event()
         self.write_workers: list[asyncio.Task] = []
 
@@ -140,15 +140,17 @@ async def handle_metrics(request: web.Request) -> web.Response:
     # storage/engine gauges: live SSTs and un-merged manifest deltas per
     # table (the backpressure signals, manifest/mod.rs:248-262), buffered
     # ingest rows awaiting flush
-    eng = state.engine
-    tables = {
-        "demo": state.storage,
-        "metrics": eng.metrics_table,
-        "series": eng.series_table,
-        "index": eng.index_table,
-        "data": eng.data_table,
-        "exemplars": eng.exemplars_table,
-    }
+    tables: dict = {"demo": state.storage}
+    buffered = 0
+    for prefix, e in state.engine.sub_engines().items():
+        tables.update({
+            f"{prefix}metrics": e.metrics_table,
+            f"{prefix}series": e.series_table,
+            f"{prefix}index": e.index_table,
+            f"{prefix}data": e.data_table,
+            f"{prefix}exemplars": e.exemplars_table,
+        })
+        buffered += e.sample_mgr.buffered_rows
     for name, table in tables.items():
         METRICS.set(
             f'horaedb_ssts_live{{table="{name}"}}', len(table.manifest.all_ssts())
@@ -157,7 +159,7 @@ async def handle_metrics(request: web.Request) -> web.Response:
             f'horaedb_manifest_deltas{{table="{name}"}}',
             table.manifest.deltas_num,
         )
-    METRICS.set("horaedb_ingest_buffered_rows", eng.sample_mgr.buffered_rows)
+    METRICS.set("horaedb_ingest_buffered_rows", buffered)
     return web.Response(text=METRICS.render(), content_type="text/plain")
 
 
@@ -363,17 +365,26 @@ async def build_app(config: Config) -> web.Application:
         sst_executor=sst_executor,
         manifest_executor=manifest_executor,
     )
-    engine = await MetricEngine.open(
-        "metrics", store, segment_duration_ms=segment_ms,
+    # one shared parser pool: the /metrics pool telemetry must reflect the
+    # pool the engine's ingest actually borrows from
+    pool = ParserPool()
+    engine_kwargs = dict(
+        segment_duration_ms=segment_ms,
         config=config.metric_engine.storage.time_merge_storage,
         sst_executor=sst_executor,
         manifest_executor=manifest_executor,
         ingest_buffer_rows=config.metric_engine.ingest_buffer_rows,
+        parser_pool=pool,
     )
-    state = ServerState(config, storage, engine)
-    # one shared parser pool: the /metrics pool telemetry must reflect the
-    # pool the engine's ingest actually borrows from
-    engine._pool = state.parser_pool
+    if config.metric_engine.num_regions > 1:
+        from horaedb_tpu.engine.region import RegionedEngine
+
+        engine = await RegionedEngine.open(
+            "metrics", store, config.metric_engine.num_regions, **engine_kwargs
+        )
+    else:
+        engine = await MetricEngine.open("metrics", store, **engine_kwargs)
+    state = ServerState(config, storage, engine, parser_pool=pool)
     if config.test.enable_write:
         state.write_enabled.set()
     for i in range(config.test.write_worker_num):

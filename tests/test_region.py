@@ -142,3 +142,29 @@ class TestRegionedEngine:
             t = await eng2.query(QueryRequest(metric=m, start_ms=0, end_ms=10_000))
             assert t.num_rows == len(hosts) * 4
         await eng2.close()
+
+
+class TestRegionDescriptor:
+    @async_test
+    async def test_num_regions_change_rejected(self):
+        """The region count is part of the on-disk layout: reopening with a
+        different N must fail loudly, not strand data."""
+        from horaedb_tpu.common.error import HoraeError
+
+        store = MemStore()
+        eng = await RegionedEngine.open(
+            "db", store, num_regions=3,
+            segment_duration_ms=HOUR, enable_compaction=False,
+        )
+        await eng.close()
+        with pytest.raises(HoraeError, match="num_regions"):
+            await RegionedEngine.open(
+                "db", store, num_regions=4,
+                segment_duration_ms=HOUR, enable_compaction=False,
+            )
+        # same N reopens fine
+        eng2 = await RegionedEngine.open(
+            "db", store, num_regions=3,
+            segment_duration_ms=HOUR, enable_compaction=False,
+        )
+        await eng2.close()

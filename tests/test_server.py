@@ -225,3 +225,49 @@ class TestEndpoints:
             assert "resolution" in (await r.json())["error"]
         finally:
             await client.close()
+
+
+class TestRegionedServer:
+    @async_test
+    async def test_regioned_write_query_metrics(self, tmp_path):
+        """num_regions > 1: the full HTTP surface works over the region
+        router (write splits, queries route, /metrics shows per-region
+        tables)."""
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import build_app
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cfg = Config.from_dict({
+            "metric_engine": {
+                "num_regions": 3,
+                "storage": {"object_store": {"type": "Local",
+                                             "data_dir": str(tmp_path)}},
+            }
+        })
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            payload = make_remote_write(
+                [
+                    ({"__name__": f"m{i}", "host": "a"}, [(1000, float(i))])
+                    for i in range(8)
+                ]
+            )
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200 and (await r.json())["samples"] == 8
+            for i in range(8):
+                r = await client.post(
+                    "/api/v1/query",
+                    json={"metric": f"m{i}", "start_ms": 0, "end_ms": 10_000},
+                )
+                body = await r.json()
+                assert r.status == 200 and body["rows"] == 1, body
+            r = await client.get("/api/v1/metrics")
+            assert (await r.json())["metrics"] == [f"m{i}" for i in range(8)]
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert 'horaedb_ssts_live{table="region-0/data"}' in text
+            assert 'horaedb_ssts_live{table="region-2/data"}' in text
+        finally:
+            await client.close()
