@@ -1,0 +1,130 @@
+"""Query-path latencies through the full engine: raw scans (limit on/off),
+downsample pushdown, tag-filtered scans with and without bloom sidecars.
+
+Usage: python benchmarks/query_bench.py [n_rows]
+Prints one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.storage.config import StorageConfig, WriteConfig
+
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    n_series = 1000
+    HOUR = 3_600_000
+
+    def emit(name: str, seconds: float, extra: dict | None = None) -> None:
+        out = {"bench": f"query_{name}", "ms": round(seconds * 1e3, 2)}
+        out.update(extra or {})
+        print(json.dumps(out))
+
+    async def timed(name: str, coro_fn, iters: int = 5, extra=None):
+        await coro_fn()  # warm (compile)
+        start = time.perf_counter()
+        for _ in range(iters):
+            result = await coro_fn()
+        emit(name, (time.perf_counter() - start) / iters, extra)
+        return result
+
+    async def build(root: str, store, bloom: bool) -> MetricEngine:
+        cfg = StorageConfig(write=WriteConfig(enable_bloom_filter=bloom))
+        eng = await MetricEngine.open(
+            root, store, segment_duration_ms=HOUR, enable_compaction=False,
+            config=cfg, ingest_buffer_rows=512 * 1024,
+        )
+        rng = np.random.default_rng(0)
+        # synthetic: n_series series, timestamps spread over 2 segments,
+        # written via the manager directly (bench focuses on reads)
+        per_chunk = 512 * 1024
+        written = 0
+        from horaedb_tpu.engine.types import metric_id_of, series_id_of, series_key_of
+
+        mid = metric_id_of(b"qm")
+        keys = [series_key_of([(b"host", f"h{i:04d}".encode())]) for i in range(n_series)]
+        all_tsids = np.asarray([series_id_of(k) for k in keys], dtype=np.uint64)
+        # register series once through the index manager
+        await eng.metric_mgr.populate_metric_ids([b"qm"], 0)
+        await eng.index_mgr.populate_series_ids(
+            [mid] * n_series,
+            [[(b"host", f"h{i:04d}".encode())] for i in range(n_series)],
+            0,
+        )
+        while written < n_rows:
+            c = min(per_chunk, n_rows - written)
+            sel = rng.integers(0, n_series, c)
+            ts = rng.integers(0, 2 * HOUR, c).astype(np.int64)
+            await eng.sample_mgr.persist(
+                np.full(c, mid, dtype=np.uint64), all_tsids[sel], ts,
+                rng.normal(size=c),
+            )
+            written += c
+        await eng.flush()
+        return eng
+
+    async def run() -> None:
+        store = LocalStore(tempfile.mkdtemp(prefix="qb_"))
+        eng = await build("db", store, bloom=True)
+
+        q_all = QueryRequest(metric=b"qm", start_ms=0, end_ms=2 * HOUR, bucket_ms=300_000)
+        out = await timed(
+            "downsample_pushdown_all_series",
+            lambda: eng.query(q_all),
+            extra={"n_rows": n_rows, "n_series": n_series},
+        )
+        assert out is not None
+
+        q_filtered = QueryRequest(
+            metric=b"qm", start_ms=0, end_ms=2 * HOUR, bucket_ms=300_000,
+            filters=[(b"host", b"h0007")],
+        )
+        await timed("downsample_one_series", lambda: eng.query(q_filtered))
+
+        q_raw_lim = QueryRequest(
+            metric=b"qm", start_ms=0, end_ms=2 * HOUR,
+            filters=[(b"host", b"h0007")], limit=1000,
+        )
+        await timed("raw_one_series_limit1k", lambda: eng.query(q_raw_lim))
+
+        # bloom A/B: a tsid that exists in no SST — with sidecars the scan
+        # skips every SST outright; without, it reads + filters them all
+        from horaedb_tpu.engine.types import metric_id_of
+        from horaedb_tpu.storage.types import TimeRange
+
+        mid = metric_id_of(b"qm")
+        ghost = [12345]  # never written
+        await timed(
+            "raw_ghost_tsid_bloom_on",
+            lambda: eng.sample_mgr.query_raw(mid, ghost, TimeRange(0, 2 * HOUR)),
+        )
+        await eng.close()
+
+        store2 = LocalStore(tempfile.mkdtemp(prefix="qb_nobloom_"))
+        eng2 = await build("db", store2, bloom=False)
+        await timed(
+            "raw_ghost_tsid_bloom_off",
+            lambda: eng2.sample_mgr.query_raw(mid, ghost, TimeRange(0, 2 * HOUR)),
+        )
+        await eng2.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()
