@@ -118,3 +118,83 @@ async def test_random_operations_match_oracle(seed):
     await eng.compaction_scheduler.executor.drain()
     await check_matches_model(eng, model)
     await eng.close()
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+@async_test
+async def test_buffered_engine_matches_oracle(seed):
+    """Randomized interleavings of buffered ingest (write_payload through
+    the native accumulator), background/threshold/explicit flushes, raw
+    queries, and restarts vs a dict oracle. Every query must observe every
+    previously-acked sample (flush-before-query + drain-on-close)."""
+    import random
+
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.pb import remote_write_pb2
+
+    rng = random.Random(seed)
+    store = MemStore()
+
+    async def open_engine():
+        return await MetricEngine.open(
+            "db", store, segment_duration_ms=SEGMENT_MS,
+            enable_compaction=False, ingest_buffer_rows=64,
+        )
+
+    eng = await open_engine()
+    # oracle: (host, ts) -> value  (one metric, overwrite semantics)
+    model: dict[tuple[bytes, int], float] = {}
+    next_ts = [1000]
+
+    def payload() -> bytes:
+        req = remote_write_pb2.WriteRequest()
+        for _ in range(rng.randint(1, 4)):
+            host = f"h{rng.randint(0, 5)}".encode()
+            ts = req.timeseries.add()
+            for k, v in ((b"__name__", b"mb"), (b"host", host)):
+                lab = ts.labels.add(); lab.name = k; lab.value = v
+            for _ in range(rng.randint(1, 12)):
+                # mix fresh and overwritten timestamps
+                if model and rng.random() < 0.25:
+                    _h, t = rng.choice(list(model.keys()))
+                else:
+                    t = next_ts[0]
+                    next_ts[0] += rng.randint(1, 900_000)
+                s = ts.samples.add()
+                s.timestamp = t
+                s.value = rng.random()
+                model[(host, t)] = s.value
+        return req.SerializeToString()
+
+    async def check():
+        t = await eng.query(QueryRequest(metric=b"mb", start_ms=0, end_ms=2**60))
+        got = {}
+        if t is not None:
+            per_tsid = eng.index_mgr.series_labels(eng.metric_mgr.get(b"mb")[0])
+            host_of = {tsid: labels[b"host"] for tsid, labels in per_tsid.items()}
+            for tsid, ts_, v in zip(
+                t.column("tsid").to_pylist(), t.column("ts").to_pylist(),
+                t.column("value").to_pylist(),
+            ):
+                key = (host_of[tsid], ts_)
+                assert key not in got, f"duplicate {key}"
+                got[key] = v
+        assert got == model, (
+            f"divergence: engine {len(got)} rows vs model {len(model)}; "
+            f"missing={set(model) - set(got)} extra={set(got) - set(model)}"
+        )
+
+    for _step in range(40):
+        op = rng.random()
+        if op < 0.6:
+            await eng.write_payload(payload())
+        elif op < 0.7:
+            await eng.flush()
+        elif op < 0.85:
+            await check()
+        else:  # restart: close (drains) and recover from the store
+            await eng.close()
+            eng = await open_engine()
+            await check()
+    await check()
+    await eng.close()
