@@ -22,5 +22,9 @@ first-N-columns primary key + seq-based dedup contracts are preserved.
 
 from horaedb_tpu.engine.types import MetricId, SeriesId, seahash
 from horaedb_tpu.engine.engine import MetricEngine, QueryRequest
+from horaedb_tpu.engine.region import RegionedEngine, RegionRouter
 
-__all__ = ["MetricEngine", "QueryRequest", "MetricId", "SeriesId", "seahash"]
+__all__ = [
+    "MetricEngine", "QueryRequest", "MetricId", "SeriesId", "seahash",
+    "RegionedEngine", "RegionRouter",
+]
