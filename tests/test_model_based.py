@@ -198,3 +198,87 @@ async def test_buffered_engine_matches_oracle(seed):
             await check()
     await check()
     await eng.close()
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+@async_test
+async def test_buffered_engine_with_compaction_matches_oracle(seed):
+    """Buffered ingest + LIVE COMPACTION + queries + restarts vs the
+    oracle: compactions rewrite SSTs under in-flight query snapshots (the
+    scan-vs-compaction retry path), and recovery must still converge."""
+    import random
+
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.pb import remote_write_pb2
+    from horaedb_tpu.storage.config import SchedulerConfig, StorageConfig
+
+    rng = random.Random(seed)
+    store = MemStore()
+    cfg = StorageConfig(scheduler=SchedulerConfig(input_sst_min_num=2))
+
+    async def open_engine():
+        return await MetricEngine.open(
+            "db", store, segment_duration_ms=SEGMENT_MS,
+            enable_compaction=True, ingest_buffer_rows=32, config=cfg,
+        )
+
+    eng = await open_engine()
+    model: dict[tuple[bytes, int], float] = {}
+    next_ts = [1000]
+
+    def payload() -> bytes:
+        req = remote_write_pb2.WriteRequest()
+        for _ in range(rng.randint(1, 3)):
+            host = f"h{rng.randint(0, 3)}".encode()
+            ts = req.timeseries.add()
+            for k, v in ((b"__name__", b"mc"), (b"host", host)):
+                lab = ts.labels.add(); lab.name = k; lab.value = v
+            for _ in range(rng.randint(1, 8)):
+                if model and rng.random() < 0.3:
+                    _h, t = rng.choice(list(model.keys()))
+                else:
+                    t = next_ts[0]
+                    next_ts[0] += rng.randint(1, 400_000)
+                s = ts.samples.add()
+                s.timestamp = t
+                s.value = rng.random()
+                model[(host, t)] = s.value
+        return req.SerializeToString()
+
+    async def check():
+        t = await eng.query(QueryRequest(metric=b"mc", start_ms=0, end_ms=2**60))
+        got = {}
+        if t is not None:
+            per_tsid = eng.index_mgr.series_labels(eng.metric_mgr.get(b"mc")[0])
+            host_of = {tsid: labels[b"host"] for tsid, labels in per_tsid.items()}
+            for tsid, ts_, v in zip(
+                t.column("tsid").to_pylist(), t.column("ts").to_pylist(),
+                t.column("value").to_pylist(),
+            ):
+                got[(host_of[tsid], ts_)] = v
+        assert got == model, (
+            f"divergence: {len(got)} vs {len(model)}; "
+            f"missing={set(model) - set(got)} extra={set(got) - set(model)}"
+        )
+
+    import asyncio
+
+    for _step in range(30):
+        op = rng.random()
+        if op < 0.55:
+            await eng.write_payload(payload())
+        elif op < 0.65:
+            await eng.flush()
+        elif op < 0.75:
+            eng.data_table.compaction_scheduler.pick_once()
+            await asyncio.sleep(0.01)  # let submit/executor run
+        elif op < 0.9:
+            await check()
+        else:
+            await eng.data_table.compaction_scheduler.executor.drain()
+            await eng.close()
+            eng = await open_engine()
+            await check()
+    await eng.data_table.compaction_scheduler.executor.drain()
+    await check()
+    await eng.close()
