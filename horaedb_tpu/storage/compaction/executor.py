@@ -121,6 +121,9 @@ class Executor:
             predicate=None,
             projections=None,
             keep_builtin=True,
+            # a compaction reads every row group of soon-deleted inputs
+            # exactly once — caching them would evict the hot query entries
+            use_block_cache=False,
         )
         if not batches:
             # All inputs were empty SSTs: commit a delete-only update instead

@@ -151,6 +151,11 @@ class StorageConfig:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     update_mode: UpdateMode = UpdateMode.OVERWRITE
     scan_block_rows: int = 32 * 1024 * 1024
+    # TPU-build extension: LRU cache of decoded SST column tables (the block
+    # cache the reference lacks — repeated dashboard queries skip parquet
+    # decode + object-store IO entirely; SSTs are immutable so entries never
+    # go stale, deletes evict). ReadableSize string or bytes; 0 disables.
+    scan_cache: ReadableSize = field(default_factory=lambda: ReadableSize.mb(64))
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "StorageConfig":

@@ -149,6 +149,7 @@ class ParquetReader:
         sst_path_gen: SstPathGenerator,
         schema: StorageSchema,
         scan_block_rows: int = 32 * 1024 * 1024,
+        scan_cache_bytes: int = 0,
     ):
         self._store = store
         self._path_gen = sst_path_gen
@@ -171,6 +172,72 @@ class ParquetReader:
         # SSTs are immutable so entries never go stale; deletes evict.
         self._bloom_cache: dict[int, "dict | None"] = {}
         self._bloom_lock = threading.Lock()
+        # Block cache at ROW-GROUP granularity, keyed (sst_id, row_group,
+        # columns): pruning still decides which groups a query touches (the
+        # selective-query win stays intact), and repeat reads of the hot
+        # groups skip object-store IO + parquet decode. Immutable SSTs keep
+        # entries fresh; deletes evict; LRU by decoded bytes.
+        self._blk_cache: "OrderedDict[tuple[int, int, tuple], pa.Table]" = OrderedDict()
+        self._blk_cache_bytes = 0
+        self._blk_cache_cap = scan_cache_bytes
+        self._blk_lock = threading.Lock()
+        # sst_id -> (parquet FileMetaData, arrow schema): lets a read whose
+        # pruned row groups are ALL cached skip the store entirely (footers
+        # are tiny; evicted with the sst)
+        self._meta_cache: dict[int, tuple] = {}
+        # Tombstones for evicted sst ids: an in-flight read racing a delete
+        # must not repopulate the caches after eviction (the entry would
+        # leak forever). Bounded FIFO — old ids' reads are long finished.
+        self._evicted_ids: "OrderedDict[int, None]" = OrderedDict()
+
+    def _tombstoned(self, sst_id: int) -> bool:
+        return sst_id in self._evicted_ids
+
+    def _assemble_cached(self, sst_id: int, get, predicate):
+        """Serve a read purely from cache when the footer is known and every
+        pruned row group is resident; None = fall through to IO."""
+        with self._blk_lock:
+            entry = self._meta_cache.get(sst_id)
+        if entry is None:
+            return None
+        meta, arrow_schema = entry
+        keep = _select_row_groups(meta, arrow_schema, predicate)
+        if not keep:
+            return arrow_schema.empty_table()
+        parts = []
+        for rg in keep:
+            t = get(rg)
+            if t is None:
+                return None
+            parts.append(t)
+        return pa.concat_tables(parts)
+
+    def _rg_cache_hooks(self, sst_id: int, cols_key: tuple):
+        """(get, put) closures for _read_pruned, or None when disabled."""
+        if self._blk_cache_cap <= 0:
+            return None
+
+        def get(rg: int):
+            with self._blk_lock:
+                t = self._blk_cache.get((sst_id, rg, cols_key))
+                if t is not None:
+                    self._blk_cache.move_to_end((sst_id, rg, cols_key))
+                return t
+
+        def put(rg: int, table: pa.Table) -> None:
+            size = table.nbytes
+            if size > self._blk_cache_cap // 4:
+                return  # one entry must not dominate the cache
+            with self._blk_lock:
+                if self._tombstoned(sst_id) or (sst_id, rg, cols_key) in self._blk_cache:
+                    return
+                self._blk_cache[(sst_id, rg, cols_key)] = table
+                self._blk_cache_bytes += size
+                while self._blk_cache_bytes > self._blk_cache_cap and self._blk_cache:
+                    _k, old = self._blk_cache.popitem(last=False)
+                    self._blk_cache_bytes -= old.nbytes
+
+        return get, put
 
     async def _bloom_skip(self, sst: SstFile, predicate) -> bool:
         """True when the SST's bloom sidecar proves no row can satisfy the
@@ -206,6 +273,7 @@ class ParquetReader:
         sst: SstFile,
         columns: list[str] | None,
         predicate: Predicate | None,
+        use_block_cache: bool = True,
     ) -> pa.Table:
         """Read one SST's projected columns, skipping row groups whose
         min/max statistics can't satisfy the predicate (and whole SSTs whose
@@ -217,6 +285,17 @@ class ParquetReader:
                 if columns is None or f.name in columns
             ]
             return pa.schema(fields).empty_table()
+        cols_key = tuple(sorted(columns)) if columns is not None else ("*",)
+        rg_cache = self._rg_cache_hooks(sst.id, cols_key) if use_block_cache else None
+        if rg_cache is not None:
+            cached = self._assemble_cached(sst.id, rg_cache[0], predicate)
+            if cached is not None:
+                return cached
+
+        def meta_sink(meta, arrow_schema) -> None:
+            with self._blk_lock:
+                if not self._tombstoned(sst.id):
+                    self._meta_cache.setdefault(sst.id, (meta, arrow_schema))
 
         def _close_evicted(evicted) -> None:
             if evicted is not None:
@@ -233,7 +312,8 @@ class ParquetReader:
                 pf, handle_lock = entry
                 if handle_lock.acquire(blocking=False):
                     try:
-                        return _read_pruned(pf, columns, predicate)
+                        return _read_pruned(pf, columns, predicate, rg_cache,
+                                            meta_sink if rg_cache else None)
                     finally:
                         handle_lock.release()
                 # handle busy with a concurrent read: open transient
@@ -253,7 +333,8 @@ class ParquetReader:
                         if len(self._pf_cache) > self._pf_cache_cap:
                             _, evicted = self._pf_cache.popitem(last=False)
             try:
-                return _read_pruned(pf, columns, predicate)
+                return _read_pruned(pf, columns, predicate, rg_cache,
+                                            meta_sink if rg_cache else None)
             finally:
                 my_lock.release()
                 if not inserted:
@@ -262,7 +343,8 @@ class ParquetReader:
 
         def _read_bytes(data: bytes) -> pa.Table:
             pf = pq.ParquetFile(io.BytesIO(data))
-            return _read_pruned(pf, columns, predicate)
+            return _read_pruned(pf, columns, predicate, rg_cache,
+                                            meta_sink if rg_cache else None)
 
         from horaedb_tpu.objstore import NotFound
 
@@ -283,6 +365,13 @@ class ParquetReader:
             entry = self._pf_cache.pop(self._path_gen.generate(file_id), None)
         with self._bloom_lock:
             self._bloom_cache.pop(file_id, None)
+        with self._blk_lock:
+            self._meta_cache.pop(file_id, None)
+            for key in [k for k in self._blk_cache if k[0] == file_id]:
+                self._blk_cache_bytes -= self._blk_cache.pop(key).nbytes
+            self._evicted_ids[file_id] = None
+            while len(self._evicted_ids) > 65536:
+                self._evicted_ids.popitem(last=False)
         if entry is not None:
             pf, handle_lock = entry
             with handle_lock:  # wait out any in-flight read
@@ -295,6 +384,7 @@ class ParquetReader:
         projections: list[int] | None,
         keep_builtin: bool,
         batch_size: int = DEFAULT_SCAN_BATCH_SIZE,
+        use_block_cache: bool = True,
     ) -> list[pa.RecordBatch]:
         """The fused device pipeline for one time segment.
 
@@ -314,7 +404,8 @@ class ParquetReader:
             # binary primary keys: sort/dedup on host via arrow compute (the
             # reference compares binary pks too, macros.rs compare dispatch)
             return await self._scan_segment_host(
-                ssts, predicate, projections, keep_builtin, batch_size
+                ssts, predicate, projections, keep_builtin, batch_size,
+                use_block_cache=use_block_cache,
             )
         total_rows = sum(s.meta.num_rows for s in ssts)
         if total_rows > self._scan_block_rows and len(ssts) > 1:
@@ -326,14 +417,16 @@ class ParquetReader:
             )
             if not has_binary:
                 return await self._scan_segment_chunked(
-                    ssts, predicate, projections, keep_builtin, batch_size
+                    ssts, predicate, projections, keep_builtin, batch_size,
+                    use_block_cache=use_block_cache,
                 )
             # binary columns keep the single-block hybrid path
         schema = self._schema
         read_names = self._resolve_read_names(projections, keep_builtin)
 
         tables = await asyncio.gather(
-            *(self.read_sst(s, read_names, predicate) for s in ssts)
+            *(self.read_sst(s, read_names, predicate,
+               use_block_cache=use_block_cache) for s in ssts)
         )
         tables = [t for t in tables if t.num_rows > 0]
         if not tables:
@@ -376,6 +469,7 @@ class ParquetReader:
         projections: list[int] | None,
         keep_builtin: bool,
         batch_size: int,
+        use_block_cache: bool = True,
     ) -> list[pa.RecordBatch]:
         """Host merge/dedup for schemas with binary primary keys: arrow
         compute sort + vectorized adjacent-row boundary detection. Numeric
@@ -397,7 +491,8 @@ class ParquetReader:
             if not chunk:
                 return
             tables = await asyncio.gather(
-                *(self.read_sst(s, read_names, predicate) for s in chunk)
+                *(self.read_sst(s, read_names, predicate,
+               use_block_cache=use_block_cache) for s in chunk)
             )
             tables = [t for t in tables if t.num_rows > 0]
             chunk, chunk_rows = [], 0
@@ -533,6 +628,7 @@ class ParquetReader:
         projections: list[int] | None,
         keep_builtin: bool,
         batch_size: int,
+        use_block_cache: bool = True,
     ) -> list[pa.RecordBatch]:
         """Hierarchical scan: chunked device passes + a device merge tree."""
         schema = self._schema
@@ -576,7 +672,8 @@ class ParquetReader:
         level: list[dict[str, np.ndarray]] = []
         for chunk in greedy_partition(ssts, lambda s: s.meta.num_rows):
             tables = await asyncio.gather(
-                *(self.read_sst(s, read_names, predicate) for s in chunk)
+                *(self.read_sst(s, read_names, predicate,
+               use_block_cache=use_block_cache) for s in chunk)
             )
             tables = [t for t in tables if t.num_rows > 0]
             if not tables:
@@ -635,6 +732,7 @@ class ParquetReader:
         bucket_ms: int,
         num_buckets: int,
         with_minmax: bool = True,
+        use_block_cache: bool = True,
     ) -> dict:
         """Aggregate pushdown: scan one segment and reduce it to dense
         [num_series, num_buckets] grids ON DEVICE — raw rows never cross back
@@ -717,7 +815,8 @@ class ParquetReader:
 
         read_names = self._resolve_read_names(None, False)
         tables = await asyncio.gather(
-            *(self.read_sst(s, read_names, predicate) for s in ssts)
+            *(self.read_sst(s, read_names, predicate,
+               use_block_cache=use_block_cache) for s in ssts)
         )
         tables = [t for t in tables if t.num_rows > 0]
         if not tables:
@@ -884,14 +983,9 @@ class _NeedBytes(Exception):
     pass
 
 
-def _read_pruned(
-    pf: pq.ParquetFile,
-    columns: list[str] | None,
-    predicate: Predicate | None,
-) -> pa.Table:
+def _select_row_groups(meta, arrow_schema, predicate) -> list[int]:
+    """Row groups whose min/max statistics can satisfy the predicate."""
     keep_groups = []
-    meta = pf.metadata
-    arrow_schema = pf.schema_arrow
     unsigned = {
         name
         for name in arrow_schema.names
@@ -912,8 +1006,33 @@ def _read_pruned(
                 stats[name] = (lo, hi)
         if filter_ops.prune_range(predicate, stats):
             keep_groups.append(rg)
+    return keep_groups
+
+
+def _read_pruned(
+    pf: pq.ParquetFile,
+    columns: list[str] | None,
+    predicate: Predicate | None,
+    rg_cache=None,   # optional (get(rg), put(rg, table)) hooks
+    meta_sink=None,  # optional callback stashing (metadata, schema_arrow)
+) -> pa.Table:
+    keep_groups = _select_row_groups(pf.metadata, pf.schema_arrow, predicate)
+    if meta_sink is not None:
+        meta_sink(pf.metadata, pf.schema_arrow)
     if not keep_groups:
         return pf.schema_arrow.empty_table()
+    if rg_cache is not None:
+        # per-row-group block cache: pruning still applies (keys are
+        # individual row groups), repeat reads of the hot groups skip decode
+        get, put = rg_cache
+        parts = []
+        for rg in keep_groups:
+            t = get(rg)
+            if t is None:
+                t = pf.read_row_group(rg, columns=columns, use_threads=True)
+                put(rg, t)
+            parts.append(t)
+        return pa.concat_tables(parts)
     return pf.read_row_groups(keep_groups, columns=columns, use_threads=True)
 
 

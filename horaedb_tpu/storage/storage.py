@@ -150,6 +150,7 @@ class ObjectBasedStorage(ColumnarStorage):
         self._reader = ParquetReader(
             store, self._path_gen, self._schema,
             scan_block_rows=config.scan_block_rows,
+            scan_cache_bytes=config.scan_cache.as_bytes(),
         )
         self._scheduler = None
         if enable_compaction_scheduler:

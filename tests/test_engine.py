@@ -497,9 +497,9 @@ class TestLimitPushdown:
         orig = reader.read_sst
         touched = []
 
-        async def spy(sst, columns, predicate):
+        async def spy(sst, columns, predicate, **kw):
             touched.append(sst.id)
-            return await orig(sst, columns, predicate)
+            return await orig(sst, columns, predicate, **kw)
 
         reader.read_sst = spy
         t = await eng.query(

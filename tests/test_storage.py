@@ -693,3 +693,102 @@ class TestCrashArtifacts:
         import shutil
 
         shutil.rmtree(root, ignore_errors=True)
+
+
+class TestBlockCache:
+    @async_test
+    async def test_cache_hits_and_correctness_under_new_predicates(self):
+        """A cached full-column table must serve DIFFERENT predicates
+        correctly (the device mask is the correctness filter) and repeat
+        reads must skip the store entirely."""
+        import numpy as np
+        import pyarrow as pa
+
+        from horaedb_tpu.objstore import MemStore
+        from horaedb_tpu.ops import filter as F
+        from horaedb_tpu.storage.read import ScanRequest, WriteRequest
+        from horaedb_tpu.storage.storage import ObjectBasedStorage
+        from horaedb_tpu.storage.types import TimeRange
+
+        HOUR = 3_600_000
+        schema = pa.schema([("pk", pa.int64()), ("v", pa.float64())])
+        store = MemStore()
+        eng = await ObjectBasedStorage.try_new(
+            root="db", store=store, arrow_schema=schema, num_primary_keys=1,
+            segment_duration_ms=HOUR, enable_compaction_scheduler=False,
+        )
+        batch = pa.RecordBatch.from_pydict(
+            {"pk": np.arange(100), "v": np.arange(100).astype(np.float64)},
+            schema=schema,
+        )
+        await eng.write(WriteRequest(batch, TimeRange(0, 10)))
+
+        async def rows(pred):
+            out = 0
+            async for b in eng.scan(ScanRequest(range=TimeRange(0, 100), predicate=pred)):
+                out += b.num_rows
+            return out
+
+        assert await rows(F.Compare("pk", "lt", 10)) == 10
+        assert len(eng.parquet_reader._blk_cache) == 1
+        # different predicate against the cached entry; then prove the
+        # store is no longer consulted at all
+        orig_get = store.get
+        calls = {"n": 0}
+
+        async def counting_get(path):
+            calls["n"] += 1
+            return await orig_get(path)
+
+        store.get = counting_get
+        assert await rows(F.Compare("pk", "ge", 90)) == 10
+        assert await rows(None) == 100
+        assert calls["n"] == 0, "cache hit still touched the object store"
+        store.get = orig_get
+        # deletes evict
+        sst_id = eng.manifest.all_ssts()[0].id
+        eng.parquet_reader.evict_cached(sst_id)
+        assert len(eng.parquet_reader._blk_cache) == 0
+        await eng.close()
+
+    @async_test
+    async def test_cache_cap_evicts_lru(self):
+        import numpy as np
+        import pyarrow as pa
+
+        from horaedb_tpu.objstore import MemStore
+        from horaedb_tpu.storage.config import StorageConfig
+        from horaedb_tpu.storage.read import ScanRequest, WriteRequest
+        from horaedb_tpu.storage.storage import ObjectBasedStorage
+        from horaedb_tpu.storage.types import TimeRange
+        from horaedb_tpu.common.size_ext import ReadableSize
+
+        HOUR = 3_600_000
+        schema = pa.schema([("pk", pa.int64()), ("v", pa.float64())])
+        cfg = StorageConfig(scan_cache=ReadableSize.kb(16))
+        store = MemStore()
+        eng = await ObjectBasedStorage.try_new(
+            root="db", store=store, arrow_schema=schema, num_primary_keys=1,
+            segment_duration_ms=HOUR, config=cfg,
+            enable_compaction_scheduler=False,
+        )
+        for i in range(8):
+            batch = pa.RecordBatch.from_pydict(
+                {"pk": np.arange(i * 100, i * 100 + 100),
+                 "v": np.zeros(100)},
+                schema=schema,
+            )
+            await eng.write(WriteRequest(batch, TimeRange(0, 10)))
+        total = 0
+        async for b in eng.scan(ScanRequest(range=TimeRange(0, 100))):
+            total += b.num_rows
+        assert total == 800
+        reader = eng.parquet_reader
+        # the 8 decoded row groups exceed 16KB, so the LRU must have evicted
+        assert reader._blk_cache_bytes <= 16 * 1024
+        assert 0 < len(reader._blk_cache) < 8, len(reader._blk_cache)
+        # byte accounting never goes negative and matches the live entries
+        assert reader._blk_cache_bytes == sum(
+            t.nbytes for t in reader._blk_cache.values()
+        )
+        await eng.close()
