@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot TPU measurement suite: run when the accelerator tunnel is healthy
+# (probe first!). Appends JSON lines to benchmarks/results_tpu.jsonl.
+#
+#   bash benchmarks/run_tpu_suite.sh
+#
+# Captures: headline bench (scatter vs sorted A/B incl. block/lanes impls),
+# the five BASELINE configs at full size, engine ingest, query latencies.
+# HORAEDB_PALLAS=1 additionally A/Bs the mosaic kernel (only set it on
+# hardware with a local libtpu — remoted compile tunnels stall on it).
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results_tpu.jsonl
+stamp() { python -c "import time; print(time.strftime('%Y-%m-%dT%H:%M:%S'))"; }
+echo "{\"suite_start\": \"$(stamp)\"}" >> "$OUT"
+
+run() {
+  echo "== $*" >&2
+  timeout "${STEP_TIMEOUT:-1800}" "$@" | tee -a "$OUT"
+}
+
+run python bench.py
+run python benchmarks/run_baselines.py
+run python benchmarks/ingest_bench.py 2000
+run python benchmarks/query_bench.py 8000000
+run python benchmarks/remote_write_bench.py
+echo "{\"suite_end\": \"$(stamp)\"}" >> "$OUT"
