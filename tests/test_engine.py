@@ -600,3 +600,51 @@ class TestBackgroundFlushBackpressure:
         assert calls["n"] >= 2  # background flushes ran (and failed) before the cap
         eng.sample_mgr._write_segment = type(eng.sample_mgr)._write_segment.__get__(eng.sample_mgr)
         await eng.close()
+
+
+class TestEngineRetention:
+    @async_test
+    async def test_ttl_expiry_through_engine_queries(self):
+        """Retention end-to-end at the ENGINE level: after a TTL compaction,
+        expired samples vanish from queries while fresh ones survive."""
+        import asyncio
+
+        from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
+        from horaedb_tpu.storage.config import SchedulerConfig, StorageConfig
+
+        cfg = StorageConfig(
+            scheduler=SchedulerConfig(
+                ttl=ReadableDuration.hours(1), input_sst_min_num=2
+            )
+        )
+        store = MemStore()
+        eng = await MetricEngine.open(
+            "db", store, segment_duration_ms=HOUR,
+            enable_compaction=True, config=cfg,
+        )
+        now = now_ms()
+        old_ts = now - 3 * HOUR
+        fresh_ts = now - 60_000
+        for ts_base, tag in ((old_ts, "old"), (fresh_ts, "new")):
+            for i in range(3):  # several SSTs so the picker engages
+                await eng.write_parsed(PooledParser.decode(make_remote_write(
+                    [({"__name__": "ret", "host": tag},
+                      [(ts_base + i, float(i))])]
+                )))
+        t = await eng.query(QueryRequest(metric=b"ret", start_ms=0, end_ms=2**60))
+        assert t.num_rows == 6
+        eng.data_table.compaction_scheduler.pick_once()
+        for _ in range(200):
+            ssts = eng.data_table.manifest.all_ssts()
+            if all(s.meta.time_range.start >= now - 2 * HOUR for s in ssts):
+                break
+            await asyncio.sleep(0.02)
+        await eng.data_table.compaction_scheduler.executor.drain()
+        t2 = await eng.query(QueryRequest(metric=b"ret", start_ms=0, end_ms=2**60))
+        assert t2.num_rows == 3, t2.num_rows
+        hosts = set()
+        per_tsid = eng.index_mgr.series_labels(eng.metric_mgr.get(b"ret")[0])
+        for tsid in t2.column("tsid").to_pylist():
+            hosts.add(per_tsid[tsid][b"host"])
+        assert hosts == {b"new"}
+        await eng.close()
