@@ -23,14 +23,19 @@ def rss_kb() -> int:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 
 
-def measure(name: str, make_parser, payload: bytes, iters: int = 50) -> None:
+def measure(
+    name: str, make_parser, payload: bytes, iters: int = 50, method: str = "parse"
+) -> None:
     parser = make_parser()
-    parser.parse(payload)  # allocate arena once
+    fn = getattr(parser, method)
+    fn(payload)  # allocate arena once
     tracemalloc.start()
     rss_before = rss_kb()
     snap_before = tracemalloc.take_snapshot()
+    tracemalloc.reset_peak()
     for _ in range(iters):
-        out = parser.parse(payload)
+        out = fn(payload)
+    _cur, peak = tracemalloc.get_traced_memory()
     snap_after = tracemalloc.take_snapshot()
     tracemalloc.stop()
     py_delta = sum(s.size_diff for s in snap_after.compare_to(snap_before, "filename"))
@@ -42,6 +47,7 @@ def measure(name: str, make_parser, payload: bytes, iters: int = 50) -> None:
                 "iters": iters,
                 "payload_bytes": len(payload),
                 "py_alloc_delta_bytes": py_delta,
+                "py_peak_bytes": peak,
                 "rss_delta_kb": rss_kb() - rss_before,
                 "samples_parsed": int(out.n_samples) * iters,
             }
@@ -50,12 +56,19 @@ def measure(name: str, make_parser, payload: bytes, iters: int = 50) -> None:
 
 
 def main() -> None:
+    """All four decoders, like the reference's 4-parser jemalloc diff
+    (parser_mem.rs); py_peak_bytes approximates its thread-active metric."""
     payload = make_payload()
     from horaedb_tpu.ingest import native
+    from horaedb_tpu.ingest.wire_parser import WireParser
 
     if native.load() is not None:
         measure("native_cpp_pooled", native.NativeParser, payload)
+        measure(
+            "native_cpp_light", native.NativeParser, payload, method="parse_light"
+        )
     measure("python_protobuf", PyParser, payload)
+    measure("python_wire", WireParser, payload, iters=5)
 
 
 if __name__ == "__main__":
