@@ -82,3 +82,44 @@ class TestAggregatePlanShape:
         # #stablehlo.scatter<...> would double-count each op
         assert lean.count('"stablehlo.scatter"') == 2, lean.count('"stablehlo.scatter"')
         assert full.count('"stablehlo.scatter"') == 4, full.count('"stablehlo.scatter"')
+
+
+class TestSortedBlockPlanShape:
+    def test_block_compaction_scatters_over_partials_not_rows(self):
+        """The block-rank compaction's perf property, pinned in the HLO:
+        its scatter operands are the (blocks x ranks) PARTIALS — 8x fewer
+        rows than the raw input at the default block/ranks — while the
+        plain sorted path scatters all n rows. Both still pay exactly 2
+        scatters (sum, count)."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from horaedb_tpu.parallel.scan import build_sharded_downsample
+
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("rows", "series"))
+        n = 64 * 2048  # 64 blocks of the default 2048
+        args = (
+            np.zeros(n, np.int32), np.zeros(n, np.int32),
+            np.zeros(n, np.float32), np.ones(n, bool),
+            (), np.int32(0), np.int32(1000),
+        )
+        block = build_sharded_downsample(
+            mesh, 8, 4, None, False, sorted_input=True, sorted_impl="block"
+        ).lower(*args).as_text()
+        plain = build_sharded_downsample(
+            mesh, 8, 4, None, False, sorted_input=True, sorted_impl="scatter"
+        ).lower(*args).as_text()
+        assert plain.count('"stablehlo.scatter"') == 2
+        # block path: 2 partial scatters inside the fast branch + 2 in the
+        # lax.cond fallback branch (compiled, not executed when dense)
+        assert block.count('"stablehlo.scatter"') == 4, block.count(
+            '"stablehlo.scatter"'
+        )
+        # the fast branch's scatter operands are the compacted partials:
+        # 64 blocks x 256 ranks = 16384 rows, 8x fewer than n=131072 — the
+        # shape must appear as a scatter update operand, and the MXU
+        # contraction (dot_general over the one-hot) must be present
+        assert "tensor<16384x" in block or "tensor<16384>" in block, "partials shape missing"
+        assert "stablehlo.dot_general" in block
+        assert "stablehlo.dot_general" not in plain
