@@ -219,7 +219,37 @@ def _raw_table_response(table, limit: int) -> web.Response:
 async def handle_query(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     try:
-        q = await request.json()
+        if request.method == "GET":
+            # curl/Grafana-style convenience: scalar params in the query
+            # string (metric, start_ms, end_ms, bucket_ms, limit,
+            # exemplars); tag filters as every remaining key. Matchers need
+            # the JSON POST form.
+            qs = dict(request.query)
+            if len(request.query) != len(qs):
+                # a duplicated key (e.g. &host=a&host=b) would silently drop
+                # values; two equality filters on one key can never both
+                # match — the caller wants the JSON matcher form
+                raise ValueError(
+                    "duplicate query parameter; use POST with matchers for "
+                    "multiple constraints on one label"
+                )
+            q = {
+                k: qs.pop(k)
+                for k in ("metric", "start_ms", "end_ms", "bucket_ms",
+                          "limit", "exemplars")
+                if k in qs
+            }
+            if "bucket_ms" in q:
+                q["bucket_ms"] = int(q["bucket_ms"])
+            if "exemplars" in q:
+                q["exemplars"] = q["exemplars"].lower() not in (
+                    "0", "false", "no", "off", ""
+                )
+            q["filters"] = qs
+        else:
+            q = await request.json()
+        if q.get("bucket_ms") is not None and int(q["bucket_ms"]) <= 0:
+            raise ValueError("bucket_ms must be > 0")
         matchers = []
         raw_matchers = q.get("matchers", [])
         if isinstance(raw_matchers, dict):
@@ -417,6 +447,7 @@ async def build_app(config: Config) -> web.Application:
             web.get("/metrics", handle_metrics),
             web.post("/api/v1/write", handle_remote_write),
             web.post("/api/v1/query", handle_query),
+            web.get("/api/v1/query", handle_query),
             web.get("/api/v1/labels", handle_labels),
             web.get("/api/v1/metrics", handle_metrics_list),
             web.get("/api/v1/series", handle_series),

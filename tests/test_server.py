@@ -271,3 +271,64 @@ class TestRegionedServer:
             assert 'horaedb_ssts_live{table="region-2/data"}' in text
         finally:
             await client.close()
+
+
+class TestGetQuery:
+    @async_test
+    async def test_get_query_with_filters(self, tmp_path):
+        """GET /api/v1/query: scalar params in the query string, leftover
+        keys are tag filters."""
+        client = await make_client(tmp_path)
+        try:
+            payload = make_remote_write(
+                [
+                    ({"__name__": "cpu", "host": "a"}, [(1000, 1.0), (2000, 2.0)]),
+                    ({"__name__": "cpu", "host": "b"}, [(1500, 7.0)]),
+                ]
+            )
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200
+            r = await client.get(
+                "/api/v1/query?metric=cpu&start_ms=0&end_ms=10000&host=a"
+            )
+            body = await r.json()
+            assert r.status == 200 and body["rows"] == 2, body
+            r = await client.get(
+                "/api/v1/query?metric=cpu&start_ms=0&end_ms=10000&bucket_ms=2000&limit=5"
+            )
+            body = await r.json()
+            assert r.status == 200 and body["buckets"] == 5 and len(body["tsids"]) == 2
+            r = await client.get("/api/v1/query?metric=cpu")  # missing range
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    @async_test
+    async def test_get_query_rejections(self, tmp_path):
+        client = await make_client(tmp_path)
+        try:
+            payload = make_remote_write([({"__name__": "cpu", "host": "a"}, [(1000, 1.0)])])
+            await client.post("/api/v1/write", data=payload)
+            # bucket_ms=0 must be a 400, not a ZeroDivisionError 500
+            r = await client.get(
+                "/api/v1/query?metric=cpu&start_ms=0&end_ms=10000&bucket_ms=0"
+            )
+            assert r.status == 400
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "cpu", "start_ms": 0, "end_ms": 1000, "bucket_ms": 0},
+            )
+            assert r.status == 400
+            # duplicated tag key: loud 400, not a silently dropped filter
+            r = await client.get(
+                "/api/v1/query?metric=cpu&start_ms=0&end_ms=10000&host=a&host=b"
+            )
+            assert r.status == 400
+            # falsy exemplar spellings stay sample queries
+            r = await client.get(
+                "/api/v1/query?metric=cpu&start_ms=0&end_ms=10000&exemplars=False"
+            )
+            body = await r.json()
+            assert r.status == 200 and body["rows"] == 1
+        finally:
+            await client.close()
