@@ -26,6 +26,15 @@ from horaedb_tpu.pb import remote_write_pb2  # noqa: E402
 PORT = 15571
 
 
+# SOAK_METRICS > 1 spreads series over that many metric names — with
+# SOAK_REGIONS > 1 this exercises concurrent cross-region write splitting.
+N_METRICS = max(1, int(os.environ.get("SOAK_METRICS", "1")))
+
+
+def metric_name(i: int) -> bytes:
+    return b"soak_metric" if N_METRICS == 1 else f"soak_metric_{i}".encode()
+
+
 def make_payload(worker: int, seq: int) -> bytes:
     rng = random.Random(worker * 100_000 + seq)
     req = remote_write_pb2.WriteRequest()
@@ -33,7 +42,7 @@ def make_payload(worker: int, seq: int) -> bytes:
     for host in range(5):
         ts = req.timeseries.add()
         for k, v in (
-            (b"__name__", b"soak_metric"),
+            (b"__name__", metric_name((worker * 5 + host) % N_METRICS)),
             (b"host", f"w{worker}-h{host}".encode()),
         ):
             lab = ts.labels.add()
@@ -80,7 +89,9 @@ async def run_soak(seconds: int) -> dict:
             while time.time() < deadline:
                 now = int(time.time() * 1000)
                 q = {
-                    "metric": "soak_metric",
+                    "metric": metric_name(
+                        random.randrange(N_METRICS)
+                    ).decode(),
                     "start_ms": now - 300_000,
                     "end_ms": now + 10_000,
                     "bucket_ms": 60_000,
@@ -116,10 +127,12 @@ def main() -> None:
     # SOAK_BUFFER_ROWS > 0 soaks the native buffered-ingest path (periodic
     # flush + flush-before-query consistency under concurrent load)
     buffer_rows = int(os.environ.get("SOAK_BUFFER_ROWS", "0"))
+    num_regions = int(os.environ.get("SOAK_REGIONS", "1"))
     with open(cfg, "w") as f:
         f.write(
             f'port = {PORT}\n[test]\nsegment_duration = "2h"\n'
             f"[metric_engine]\ningest_buffer_rows = {buffer_rows}\n"
+            f"num_regions = {num_regions}\n"
             f'ingest_flush_interval = "250ms"\n'
             f'[metric_engine.storage.object_store]\ntype = "Local"\ndata_dir = "{data_dir}/db"\n'
         )
