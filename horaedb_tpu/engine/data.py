@@ -430,24 +430,38 @@ class SampleManager:
         pred = self._predicate(
             metric_id, list(series_ids) if filtered else None, rng
         )
+        import asyncio
+
+        # per-segment pushdown passes run CONCURRENTLY (bounded): reads of
+        # one segment overlap another's device kernel — the engine-side
+        # analog of the reference's UnionExec driving per-segment plans.
+        # Partial grids combine associatively, so completion order is free.
+        sem = asyncio.Semaphore(4)
+
+        async def one_segment(seg):
+            async with sem:
+                # retry wrapper: a compaction may delete this snapshot's
+                # files mid-query; the refresh re-reads the live SSTs
+                return await self._storage.scan_segment_retrying(
+                    seg, rng,
+                    lambda fresh: self._storage.parquet_reader.scan_segment_downsample(
+                        fresh,
+                        predicate=pred,
+                        ts_column="ts",
+                        value_column="value",
+                        series_column="tsid",
+                        series_ids=series_ids,
+                        t0=rng.start,
+                        bucket_ms=bucket_ms,
+                        num_buckets=num_buckets,
+                    ),
+                )
+
+        parts = await asyncio.gather(
+            *(one_segment(seg) for seg in self._storage.group_by_segment(ssts))
+        )
         acc: dict[str, np.ndarray] | None = None
-        for seg in self._storage.group_by_segment(ssts):
-            # retry wrapper: a compaction may delete this snapshot's files
-            # mid-query; the refresh re-reads the segment's live SSTs
-            part = await self._storage.scan_segment_retrying(
-                seg, rng,
-                lambda fresh: self._storage.parquet_reader.scan_segment_downsample(
-                    fresh,
-                    predicate=pred,
-                    ts_column="ts",
-                    value_column="value",
-                    series_column="tsid",
-                    series_ids=series_ids,
-                    t0=rng.start,
-                    bucket_ms=bucket_ms,
-                    num_buckets=num_buckets,
-                ),
-            )
+        for part in parts:
             if part is None:  # segment vanished entirely (TTL)
                 continue
             if acc is None:
