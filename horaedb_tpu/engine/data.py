@@ -30,6 +30,10 @@ MAX_PUSHDOWN_SERIES = 65_536
 # reject loudly, like Prometheus's max-resolution limit.
 MAX_BUCKETS = 100_000
 
+# In-flight per-segment pushdown scans PER SampleManager (shared across
+# concurrent queries — a dashboard burst cannot multiply it).
+SEGMENT_SCAN_CONCURRENCY = 4
+
 
 class SampleManager:
     def __init__(self, storage, segment_duration_ms: int, buffer_rows: int = 0):
@@ -72,6 +76,9 @@ class SampleManager:
         # Bounded background flush (one in flight): threshold flushes run as
         # a task so the encode threads overlap continued ingest.
         self._flush_task: "asyncio.Task | None" = None
+        # shared bound for concurrent segment-pushdown scans (lazy: binds
+        # the running loop)
+        self._scan_sem: "asyncio.Semaphore | None" = None
 
     @property
     def buffering(self) -> bool:
@@ -426,23 +433,31 @@ class SampleManager:
                 metric_id, tsids if filtered else None, rng, bucket_ms
             )
         series_ids = np.asarray(sorted(tsids), dtype=np.uint64)
-        num_buckets = int(-(-(rng.end - rng.start) // bucket_ms))
+        num_buckets = int(n_buckets)  # validated against MAX_BUCKETS above
         pred = self._predicate(
             metric_id, list(series_ids) if filtered else None, rng
         )
         import asyncio
 
-        # per-segment pushdown passes run CONCURRENTLY (bounded): reads of
-        # one segment overlap another's device kernel — the engine-side
-        # analog of the reference's UnionExec driving per-segment plans.
-        # Partial grids combine associatively, so completion order is free.
-        sem = asyncio.Semaphore(4)
+        # Per-segment pushdown passes run CONCURRENTLY: reads of one
+        # segment overlap another's device kernel — the engine-side analog
+        # of the reference's UnionExec driving per-segment plans. The
+        # semaphore is SHARED across queries (one per manager) so a
+        # dashboard burst cannot multiply the bound; each task folds its
+        # partial into the accumulator as it finishes (combination is
+        # associative), so peak memory is the in-flight parts, not one grid
+        # per segment. TaskGroup cancels + awaits siblings on first error —
+        # no detached scans survive a failed query.
+        if self._scan_sem is None:
+            self._scan_sem = asyncio.Semaphore(SEGMENT_SCAN_CONCURRENCY)
+        acc: dict[str, np.ndarray] | None = None
 
         async def one_segment(seg):
-            async with sem:
+            nonlocal acc
+            async with self._scan_sem:
                 # retry wrapper: a compaction may delete this snapshot's
                 # files mid-query; the refresh re-reads the live SSTs
-                return await self._storage.scan_segment_retrying(
+                part = await self._storage.scan_segment_retrying(
                     seg, rng,
                     lambda fresh: self._storage.parquet_reader.scan_segment_downsample(
                         fresh,
@@ -456,14 +471,9 @@ class SampleManager:
                         num_buckets=num_buckets,
                     ),
                 )
-
-        parts = await asyncio.gather(
-            *(one_segment(seg) for seg in self._storage.group_by_segment(ssts))
-        )
-        acc: dict[str, np.ndarray] | None = None
-        for part in parts:
             if part is None:  # segment vanished entirely (TTL)
-                continue
+                return
+            # the fold is synchronous (no awaits): safe on the event loop
             if acc is None:
                 acc = part
             else:
@@ -471,6 +481,10 @@ class SampleManager:
                 acc["count"] = acc["count"] + part["count"]
                 acc["min"] = np.minimum(acc["min"], part["min"])
                 acc["max"] = np.maximum(acc["max"], part["max"])
+
+        async with asyncio.TaskGroup() as tg:
+            for seg in self._storage.group_by_segment(ssts):
+                tg.create_task(one_segment(seg))
         if acc is None or acc["count"].sum() == 0:
             return None
         with np.errstate(invalid="ignore", divide="ignore"):
