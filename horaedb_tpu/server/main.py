@@ -16,7 +16,10 @@ plus the ingest/query endpoints the reference defines but never wired
 
     POST /api/v1/write     Prometheus remote-write (snappy or raw protobuf)
     POST /api/v1/query     JSON query -> rows or downsample grids
+    GET  /api/v1/query     query-string form (filters = leftover params)
     GET  /api/v1/labels    label values via the inverted index
+    GET  /api/v1/metrics   metric-name listing
+    GET  /api/v1/series    per-metric series listing
 
 Run: python -m horaedb_tpu.server.main --config docs/example.toml
 """
