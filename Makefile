@@ -1,6 +1,6 @@
 # Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
 
-.PHONY: test native bench baselines serve lint clean
+.PHONY: test native bench baselines serve lint clean soak dryruns tpu-suite
 
 test:
 	python -m pytest tests/ -x -q
@@ -19,6 +19,19 @@ serve:
 
 lint:
 	python -m compileall -q horaedb_tpu tests benchmarks bench.py __graft_entry__.py
+
+soak:
+	SOAK_REGIONS=3 SOAK_METRICS=8 SOAK_BUFFER_ROWS=30000 python benchmarks/soak.py 60
+
+dryruns:
+	python benchmarks/shared_store_dryrun.py
+	python benchmarks/multihost_dryrun.py
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	  import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+tpu-suite:
+	bash benchmarks/run_tpu_suite.sh
 
 clean:
 	$(MAKE) -C horaedb_tpu/native clean
