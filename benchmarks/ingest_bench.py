@@ -36,6 +36,11 @@ def main() -> None:
 
     n_payloads = int(sys.argv[1]) if len(sys.argv) > 1 else 50
 
+    # INGEST_CHURN=1: every payload carries brand-new series (the
+    # series-churn worst case — id registration + inverted-index writes +
+    # delta compactions dominate instead of the steady-state probe path)
+    churn = os.environ.get("INGEST_CHURN", "0") == "1"
+
     def make_payload(seed: int) -> bytes:
         """Realistic remote-write shape: timestamps cluster near 'now' (a
         scrape interval apart), all landing in one or two segments."""
@@ -44,9 +49,10 @@ def main() -> None:
         req = remote_write_pb2.WriteRequest()
         for s in range(200):
             ts = req.timeseries.add()
+            host = (f"host-{seed:05d}-{s:04d}" if churn else f"host-{s:04d}").encode()
             for k, v in (
                 (b"__name__", f"metric_{s % 20}".encode()),
-                (b"host", f"host-{s:04d}".encode()),
+                (b"host", host),
                 (b"region", b"us-east-1"),
             ):
                 lab = ts.labels.add()
@@ -83,6 +89,7 @@ def main() -> None:
             "samples": samples,
             "seconds": round(elapsed, 3),
             "samples_per_sec": round(samples / elapsed),
+            "churn": churn,
             "platform": jax.devices()[0].platform,
         }
 
