@@ -69,13 +69,13 @@ class SampleManager:
                 self._accum = NativeAccum()
             except Exception:  # noqa: BLE001 — fall back to Python buffering
                 self._accum = None
-        # Serializes flushes AND makes flush-before-query sound: a query's
-        # flush() awaits any in-flight flush (whose snapshot is not yet
-        # durable) before flushing the remainder.
-        self._flush_lock: "asyncio.Lock | None" = None
-        # Bounded background flush (one in flight): threshold flushes run as
-        # a task so the encode threads overlap continued ingest.
-        self._flush_task: "asyncio.Task | None" = None
+        # Bounded background write-outs: threshold flushes run as tasks so
+        # encode threads + fsyncs overlap continued ingest, and up to
+        # MAX_CONCURRENT_FLUSHES snapshots may be in flight at once (each
+        # write-out detaches its snapshot atomically on the event loop, so
+        # snapshots are disjoint; pk+seq dedup makes any retry overlap
+        # harmless). flush() remains the strong barrier queries use.
+        self._inflight: "set[asyncio.Task]" = set()
         # shared bound for concurrent segment-pushdown scans (lazy: binds
         # the running loop)
         self._scan_sem: "asyncio.Semaphore | None" = None
@@ -115,47 +115,71 @@ class SampleManager:
     def backlogged(self) -> bool:
         return self.buffered_rows >= self.BACKLOG_FACTOR * self._buffer_rows
 
+    # Concurrent background write-outs: two snapshots encode/fsync in
+    # parallel, roughly doubling sustained flush bandwidth. Each holds
+    # O(buffer_rows) host memory, so keep this small.
+    MAX_CONCURRENT_FLUSHES = 2
+
     @property
     def flush_in_flight(self) -> bool:
-        return self._flush_task is not None and not self._flush_task.done()
+        return any(not t.done() for t in self._inflight)
 
-    def flush_soon(self) -> None:
-        """Fire a background flush (at most one in flight): the CPU-heavy
-        sort/encode runs on worker threads and overlaps continued ingest.
-        Errors are logged, not raised — the failed snapshot re-buffers (see
-        flush) and the next flush retries it; queries stay consistent
-        because their flush() waits on the same flush lock. The `backlogged`
-        cap bounds how long writers may keep deferring to this path."""
+    def _live_flushes(self) -> "list[asyncio.Task]":
+        return [t for t in self._inflight if not t.done()]
+
+    def _start_writeout(self) -> "asyncio.Task":
+        """Start a write-out task, registered in ``_inflight`` so EVERY
+        concurrent flush barrier can see and await it. The done-callback
+        retrieves + logs the exception (the failed snapshot re-buffers, see
+        _writeout_once), so an unawaited task never warns; barriers that DO
+        gather it still observe the exception object."""
         import asyncio
         import logging
 
-        if self.flush_in_flight:
-            return
+        t = asyncio.create_task(self._writeout_once(), name="ingest-flush")
+        self._inflight.add(t)
 
-        async def _bg() -> None:
-            try:
-                await self.flush()
-            except Exception:  # noqa: BLE001 — rows re-buffered for retry
-                logging.getLogger(__name__).exception(
-                    "background ingest flush failed; rows re-buffered"
+        def _done(task: "asyncio.Task") -> None:
+            self._inflight.discard(task)
+            if not task.cancelled() and task.exception() is not None:
+                logging.getLogger(__name__).error(
+                    "ingest write-out failed; rows re-buffered",
+                    exc_info=task.exception(),
                 )
 
-        self._flush_task = asyncio.create_task(_bg(), name="ingest-flush")
+        t.add_done_callback(_done)
+        return t
+
+    def flush_soon(self) -> None:
+        """Fire a background write-out (bounded fan-out): the CPU-heavy
+        sort/encode runs on worker threads and overlaps continued ingest.
+        Errors are logged, not raised — the failed snapshot re-buffers and a
+        later flush retries it; queries stay consistent because their
+        flush() awaits every in-flight write-out. The `backlogged` cap
+        bounds how long writers may keep deferring to this path."""
+        if len(self._live_flushes()) < self.MAX_CONCURRENT_FLUSHES:
+            self._start_writeout()
 
     async def drain(self) -> None:
-        """Await background flushes, then flush the remainder (shutdown).
-        Loops: a concurrent writer may schedule a new background task while
-        we await — exit only when none appeared, so no pending task (or its
-        re-buffered rows) is abandoned at loop teardown."""
+        """Await background write-outs, then flush the remainder
+        (shutdown). Loops: a concurrent writer may schedule new work while
+        we await — exit only once no write-out is live and no row is
+        buffered, so nothing is abandoned at loop teardown."""
         import asyncio
 
         while True:
-            task = self._flush_task
-            if task is not None:
-                await asyncio.gather(task, return_exceptions=True)
+            live = self._live_flushes()
+            if live:
+                await asyncio.gather(*live, return_exceptions=True)
             await self.flush()
-            if self._flush_task is task:
+            if not self._live_flushes() and not self._has_pending_rows:
                 return
+
+    @property
+    def _has_pending_rows(self) -> bool:
+        return bool(
+            self._buffered or (self._accum is not None and self._accum.rows)
+        )
 
     async def persist(
         self,
@@ -206,67 +230,169 @@ class SampleManager:
             await self.flush()
 
     async def flush(self) -> None:
-        """Write out all buffered segments (one storage write each).
+        """Strong flush barrier: every row buffered (acked) at entry is
+        durable — or an error raised — by return.
 
-        Concurrency contract: buffers are snapshot-detached up front so rows
-        appended by other coroutines during the awaited writes land in fresh
-        buffers and are never dropped; on ANY write failure the snapshot is
-        merged back (dense ids remapped) before the error propagates, so
-        already-acked samples survive for a retrying flush. Partial
-        double-writes are safe: the storage merge dedups by pk + seq. The
-        flush lock serializes flushes, which also makes flush-before-query
-        sound (a query awaits in-flight, not-yet-durable snapshots)."""
+        All write-outs, including the one this barrier starts, register in
+        ``_inflight``, so concurrent flush() callers see each other's
+        in-flight snapshots (the old flush lock's guarantee). Pre-entry
+        rows are either (a) still buffered — covered by our own write-out,
+        or (b) detached into some registered task — covered by the gather.
+        A failed write-out re-buffers its snapshot; the retry loop then
+        drains it, surfacing persistent storage errors here rather than in
+        a log line."""
         import asyncio
 
-        if self._flush_lock is None:
-            self._flush_lock = asyncio.Lock()
-        async with self._flush_lock:
-            buf, self._buf = self._buf, {}
-            chunks, self._chunks = self._chunks, []
-            keys, self._dense_keys = self._dense_keys, []
-            self._dense = {}
-            snapshot_rows = sum(len(c[1]) for c in chunks) + sum(
-                len(c[2]) for lst in buf.values() for c in lst
-            )
-            self._buffered -= snapshot_rows
-            try:
-                for _seg_start, cols_list in sorted(buf.items()):
-                    cols = [
-                        np.concatenate([c[i] for c in cols_list]) for i in range(4)
-                    ]
-                    await self._write_segment(*cols)
-                if chunks:
-                    await self._flush_chunks(chunks, keys)
-            except BaseException:
-                self._restore_snapshot(buf, chunks, keys, snapshot_rows)
-                raise
-            if self._accum is not None and self._accum.rows:
-                await self._flush_accum()
+        live = self._live_flushes()
+        if self._has_pending_rows:
+            live.append(self._start_writeout())
+        if not live:
+            return
+        results = await asyncio.gather(*live, return_exceptions=True)
+        failed = [r for r in results if isinstance(r, BaseException)]
+        while failed and self._has_pending_rows:
+            # rows re-buffered by a failure: retry inline; a persistent
+            # storage error raises out of this call
+            await self._writeout_once()
+            failed = []
 
-    async def _flush_accum(self) -> None:
-        """Drain the C++ accumulator: take the pk-sorted lanes (which also
-        CLEARS it, so rows buffered during the awaited writes are never
-        lost), split by segment, write. On failure the taken lanes re-buffer
-        into the Python chunk store so acked samples survive for a retry."""
-        mid, tsid, ts, vals = self._accum.take_sorted()
+    async def _writeout_once(self) -> None:
+        """Write out one snapshot of the buffers (one storage write per
+        segment shard).
+
+        Concurrency contract: buffers are snapshot-detached atomically (no
+        await between detach and the accumulator take) so concurrent
+        write-outs hold disjoint snapshots and rows appended by other
+        coroutines land in fresh buffers, never dropped; on ANY write
+        failure the snapshot is merged back (dense ids remapped) before the
+        error propagates, so already-acked samples survive for a retrying
+        flush. Partial double-writes are safe: the storage merge dedups by
+        pk + seq."""
+        from horaedb_tpu.storage.sst import allocate_id
+
+        buf, self._buf = self._buf, {}
+        chunks, self._chunks = self._chunks, []
+        keys, self._dense_keys = self._dense_keys, []
+        self._dense = {}
+        snapshot_rows = sum(len(c[1]) for c in chunks) + sum(
+            len(c[2]) for lst in buf.values() for c in lst
+        )
+        self._buffered -= snapshot_rows
+        # accumulator drain is synchronous C++ (atomic on the event loop):
+        # detach it as part of the same snapshot, before any await
+        accum_lanes = (
+            self._accum.take_sorted()
+            if self._accum is not None and self._accum.rows
+            else None
+        )
+        # The snapshot's dedup sequence is pinned NOW, so last-value dedup
+        # follows buffering order even if a later snapshot's encode lands
+        # its SSTs (with higher file ids) first.
+        snap_seq = allocate_id()
+        try:
+            for _seg_start, cols_list in sorted(buf.items()):
+                cols = [
+                    np.concatenate([c[i] for c in cols_list]) for i in range(4)
+                ]
+                await self._write_segment(*cols, seq=snap_seq)
+            if chunks:
+                await self._flush_chunks(chunks, keys, seq=snap_seq)
+        except BaseException:
+            self._restore_snapshot(buf, chunks, keys, snapshot_rows)
+            if accum_lanes is not None:
+                self._rebuffer_lanes(*accum_lanes)
+            raise
+        if accum_lanes is not None:
+            await self._flush_accum_lanes(*accum_lanes, seq=snap_seq)
+
+    # A flush larger than this splits into contiguous pk-range shards
+    # written as independent SSTs concurrently: parquet encode (GIL-free)
+    # and the per-object fsync are the flush bottleneck, and both overlap
+    # across shards. More SSTs per segment is native LSM currency —
+    # compaction folds them. MAX_FLUSH_SHARDS bounds thread/file fan-out.
+    FLUSH_SHARD_ROWS = 128 * 1024
+    MAX_FLUSH_SHARDS = 8
+
+    async def _flush_accum_lanes(self, mid, tsid, ts, vals, seq=None) -> None:
+        """Write out pk-sorted lanes taken from the C++ accumulator (the
+        take CLEARED it, so rows buffered during the awaited writes are
+        never lost), split by segment (and by shard within large segments),
+        write concurrently. On failure the lanes re-buffer into the Python
+        chunk store so acked samples survive for a retry."""
+        import asyncio
+
         if not len(ts):
             return
         seg = ts - (ts % self._segment_duration)
         uniq = np.unique(seg)
+        # Per-segment lanes (the lanes sort by (mid, tsid, ts), so segment
+        # rows are scattered — a mask gather per segment; the overwhelmingly
+        # common single-segment scrape keeps the zero-copy fast path).
+        # Each per-segment lane set stays pk-sorted (mask gather preserves
+        # order), so contiguous shard slices of it are pk-sorted too.
+        per_seg: list[tuple[int, tuple]] = []
+        if len(uniq) == 1:
+            per_seg.append((int(uniq[0]), (mid, tsid, ts, vals)))
+        else:
+            for seg_start in uniq.tolist():
+                m = seg == seg_start
+                per_seg.append((int(seg_start), (mid[m], tsid[m], ts[m], vals[m])))
+        work: list[tuple] = []
+        for _seg_start, lanes in per_seg:
+            smid, stsid, sts = lanes[0], lanes[1], lanes[2]
+            n = len(sts)
+            shards = min(max(1, -(-n // self.FLUSH_SHARD_ROWS)),
+                         self.MAX_FLUSH_SHARDS)
+            step = -(-n // shards)
+            lo = 0
+            while lo < n:
+                hi = min(lo + step, n)
+                # never split a run of identical (mid, tsid, ts) rows across
+                # shards: all shards share one seq, and same-pk-same-seq
+                # duplicates must stay inside one SST so the in-file row
+                # order resolves them deterministically
+                while hi < n and (
+                    smid[hi] == smid[hi - 1]
+                    and stsid[hi] == stsid[hi - 1]
+                    and sts[hi] == sts[hi - 1]
+                ):
+                    hi += 1
+                sl = slice(lo, hi)
+                work.append(tuple(a[sl] for a in lanes))
+                lo = hi
         try:
-            for seg_start in uniq:
-                m = seg == seg_start if len(uniq) > 1 else slice(None)
-                await self._write_segment(mid[m], tsid[m], ts[m], vals[m])
+            if len(work) == 1:
+                await self._write_segment(*work[0], presorted=True, seq=seq)
+            else:
+                async with asyncio.TaskGroup() as tg:
+                    for lanes in work:
+                        tg.create_task(
+                            self._write_segment(*lanes, presorted=True, seq=seq)
+                        )
         except BaseException:
-            # re-buffer PER SEGMENT: the Python buffer's flush writes one
-            # batch per key and a batch must not cross a segment
-            for seg_start in uniq:
-                m = seg == seg_start if len(uniq) > 1 else slice(None)
-                self._buf.setdefault(int(seg_start), []).append(
-                    (mid[m], tsid[m], ts[m], vals[m])
-                )
-            self._buffered += len(ts)
+            self._rebuffer_lanes(mid, tsid, ts, vals, per_seg)
             raise
+
+    def _rebuffer_lanes(self, mid, tsid, ts, vals, per_seg=None) -> None:
+        """Re-buffer failed accumulator lanes PER SEGMENT: the Python
+        buffer's write-out emits one batch per key and a batch must not
+        cross a segment. Shards that did land before the failure are
+        harmless to re-write: storage dedups by pk + seq."""
+        if not len(ts):
+            return
+        if per_seg is None:
+            seg = ts - (ts % self._segment_duration)
+            uniq = np.unique(seg)
+            if len(uniq) == 1:
+                per_seg = [(int(uniq[0]), (mid, tsid, ts, vals))]
+            else:
+                per_seg = [
+                    (int(s), tuple(a[seg == s] for a in (mid, tsid, ts, vals)))
+                    for s in uniq.tolist()
+                ]
+        for seg_start, lanes in per_seg:
+            self._buf.setdefault(seg_start, []).append(lanes)
+        self._buffered += len(ts)
 
     def _restore_snapshot(self, buf, chunks, keys, snapshot_rows: int) -> None:
         """Merge a failed flush's snapshot back into the live buffers."""
@@ -287,7 +413,7 @@ class SampleManager:
                 self._chunks.append((remap[dense_ps], ts, vals))
         self._buffered += snapshot_rows
 
-    async def _flush_chunks(self, chunks, keys) -> None:
+    async def _flush_chunks(self, chunks, keys, seq=None) -> None:
         """Counting-sort the buffered lanes into pk order: rank the (few)
         unique series keys, gather rank per sample, one stable O(n + k)
         counting sort. Scrapes arrive in time order, so within a series the
@@ -327,7 +453,10 @@ class SampleManager:
             m = seg == seg_start if len(uniq) > 1 else slice(None)
             await self._write_segment(mid[m], tsid[m], ts[m], vals[m])
 
-    async def _write_segment(self, metric_ids, tsids, ts, values) -> None:
+    async def _write_segment(
+        self, metric_ids, tsids, ts, values,
+        presorted: bool = False, seq: "int | None" = None,
+    ) -> None:
         batch = pa.RecordBatch.from_pydict(
             {
                 "metric_id": np.ascontiguousarray(metric_ids, dtype=np.uint64),
@@ -340,7 +469,9 @@ class SampleManager:
         )
         lo = int(ts.min())
         hi = int(ts.max()) + 1
-        await self._storage.write(WriteRequest(batch, TimeRange(lo, hi)))
+        await self._storage.write(
+            WriteRequest(batch, TimeRange(lo, hi), presorted=presorted, seq=seq)
+        )
 
     # -- queries ---------------------------------------------------------------
     def _predicate(self, metric_id: int, tsids: list[int] | None, rng: TimeRange):

@@ -89,6 +89,15 @@ class WriteRequest:
     time_range: TimeRange
     # Whether to check the batch is within the same segment (storage.rs:307-316).
     enable_check: bool = True
+    # Caller guarantees the batch is already pk-sorted (e.g. the metric
+    # engine's accumulator flush): the write path skips the sort AND the
+    # O(n) sortedness verification.
+    presorted: bool = False
+    # Explicit sequence for the __seq__ column / FileMeta (defaults to the
+    # SST's file id). Concurrent flush snapshots allocate their sequence at
+    # snapshot-detach time so last-value dedup follows buffering order even
+    # when a later snapshot's encode finishes first.
+    seq: int | None = None
 
 
 # ---------------------------------------------------------------------------

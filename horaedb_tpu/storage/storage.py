@@ -198,7 +198,9 @@ class ObjectBasedStorage(ColumnarStorage):
                 f"time range of one write must fall in one segment, "
                 f"range: [{req.time_range.start}, {req.time_range.end})",
             )
-        result = await self.write_batch(req.batch)
+        result = await self.write_batch(
+            req.batch, presorted=req.presorted, seq=req.seq
+        )
         meta = FileMeta(
             max_sequence=result.seq,
             num_rows=req.batch.num_rows,
@@ -217,14 +219,26 @@ class ObjectBasedStorage(ColumnarStorage):
             self._sst_executor, lambda: fn(*args)
         )
 
-    async def write_batch(self, batch: pa.RecordBatch) -> WriteResult:
+    async def write_batch(
+        self,
+        batch: pa.RecordBatch,
+        presorted: bool = False,
+        seq: int | None = None,
+    ) -> WriteResult:
         file_id = allocate_id()
-        sorted_batch = await self._run_sst(self._sort_batch, batch)
-        # file ids are increasing, so the id doubles as the sequence
-        with_builtin = self._schema.fill_builtin_columns(sorted_batch, file_id)
+        if presorted:
+            sorted_batch = batch
+        else:
+            sorted_batch = await self._run_sst(self._sort_batch, batch)
+        # file ids are increasing, so the id doubles as the sequence unless
+        # the caller pinned one at snapshot time (same allocator, so the
+        # combined seq stream stays monotonic with unbuffered writes)
+        if seq is None:
+            seq = file_id
+        with_builtin = self._schema.fill_builtin_columns(sorted_batch, seq)
         table = pa.Table.from_batches([with_builtin])
         size = await self.write_sst(file_id, table)
-        return WriteResult(id=file_id, seq=file_id, size=size)
+        return WriteResult(id=file_id, seq=seq, size=size)
 
     def _sort_batch(self, batch: pa.RecordBatch) -> pa.RecordBatch:
         """Primary-key sort on device (replaces SortExec, storage.rs:244-256).
@@ -352,7 +366,8 @@ class ObjectBasedStorage(ColumnarStorage):
 
         class _Sink(io.RawIOBase):
             def __init__(self):
-                self.buf = bytearray()
+                self.parts: list[bytes] = []
+                self.pending = 0
 
             def writable(self):
                 return True
@@ -360,24 +375,34 @@ class ObjectBasedStorage(ColumnarStorage):
             def write(self, b):
                 if cancel.is_set():
                     raise IOError("sst stream cancelled")
-                self.buf += b
-                while len(self.buf) >= CHUNK:
-                    q.put(bytes(self.buf[:CHUNK]))
-                    del self.buf[:CHUNK]
+                # accumulate whole chunks in a list (O(1) append) instead of
+                # a bytearray whose head-slicing memmoves the tail each emit
+                self.parts.append(bytes(b))
+                self.pending += len(b)
+                while self.pending >= CHUNK:
+                    blob = b"".join(self.parts)
+                    q.put(blob[:CHUNK])
+                    rest = blob[CHUNK:]
+                    self.parts = [rest] if rest else []
+                    self.pending = len(rest)
                 return len(b)
+
+            def flush_tail(self):
+                if self.pending:
+                    q.put(b"".join(self.parts))
+                    self.parts = []
+                    self.pending = 0
 
         def _produce() -> None:
             try:
                 sink = _Sink()
                 writer = pq.ParquetWriter(sink, table.schema, **kwargs)
-                for start in range(0, table.num_rows, cfg.max_row_group_size):
-                    writer.write_table(
-                        table.slice(start, cfg.max_row_group_size),
-                        row_group_size=cfg.max_row_group_size,
-                    )
+                # one call: pyarrow splits into max_row_group_size row
+                # groups in C++ (same file layout as a Python slice loop,
+                # without per-group Python/GIL overhead)
+                writer.write_table(table, row_group_size=cfg.max_row_group_size)
                 writer.close()
-                if sink.buf:
-                    q.put(bytes(sink.buf))
+                sink.flush_tail()
                 q.put(None)  # EOF
             except BaseException as e:  # noqa: BLE001 — relayed to consumer
                 q.put(e)

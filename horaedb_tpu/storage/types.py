@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import numpy as np
 import pyarrow as pa
 
 from horaedb_tpu.common.error import ensure
@@ -159,6 +160,6 @@ class StorageSchema:
         """Append __seq__=sequence and all-null __reserved__ (types.rs:219-239)."""
         n = batch.num_rows
         cols = list(batch.columns)
-        cols.append(pa.array([sequence] * n, type=pa.uint64()))
+        cols.append(pa.array(np.full(n, sequence, dtype=np.uint64)))
         cols.append(pa.nulls(n, type=pa.uint64()))
         return pa.RecordBatch.from_arrays(cols, schema=self.arrow_schema)
