@@ -536,11 +536,14 @@ class SampleManager:
         because the data-table pk includes the timestamp, so duplicates
         cannot span segments. Returns (tsid order, grids).
 
-        Precision: on-device accumulation is float32 (TPU-native lane
-        width). Per-cell relative error is ~2^-24 * samples_per_cell —
-        counter-style values above 2^24 (~16.7M) or cells with millions of
-        samples lose low bits vs an f64 oracle. The materializing fallback
-        (high cardinality) accumulates in f64 on host.
+        Precision: on-device accumulation is float32 ONLY on real
+        accelerators (TPU-native lane width); CPU/XLA-fallback meshes and
+        the single-device path accumulate in f64 (x64 enabled), matching
+        the reference's f64 aggregation exactly. On TPU the per-cell
+        relative error is ~2^-24 * samples_per_cell — counter-style values
+        above 2^24 (~16.7M) or cells with millions of samples lose low
+        bits vs an f64 oracle. The materializing fallback (high
+        cardinality) accumulates in f64 on host.
 
         `filtered=False` means `tsids` is just the metric's full series set
         (no tag filter): the TSID membership predicate is skipped, and very

@@ -873,12 +873,18 @@ class ParquetReader:
 
         series_par = mesh.shape["series"]
         padded_series = num_series + (-num_series % series_par)
+        # f32 accumulation only on real accelerators (native lane width,
+        # the documented precision trade-off); CPU/XLA-fallback meshes keep
+        # the storage f64 so query results match the reference's f64
+        # aggregation exactly (advisor round-1, pallas_kernels precision).
+        accel = mesh.devices.flat[0].platform not in ("cpu",)
+        val_dtype = np.float32 if accel else np.float64
         (ts_d, sid_d, val_d), valid = shard_rows(
             mesh,
             (
                 np.ascontiguousarray(ts_np, dtype=np.int64),
                 np.ascontiguousarray(sid_np, dtype=np.int32),
-                np.ascontiguousarray(val_np, dtype=np.float64).astype(np.float32),
+                np.ascontiguousarray(val_np, dtype=val_dtype),
             ),
             pad_value=0,
         )

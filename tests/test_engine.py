@@ -192,6 +192,28 @@ class TestMetricEngine:
         await eng.close()
 
     @async_test
+    async def test_downsample_f64_exact_on_cpu(self):
+        """CPU/XLA-fallback aggregation accumulates in f64: values whose low
+        bits vanish in f32 (counter-style, > 2^24) must sum EXACTLY like the
+        reference's f64 aggregation (advisor round-1, data.py precision
+        contract)."""
+        store = MemStore()
+        eng = await open_engine(store)
+        # 2^24 + k: in f32, (2**24 + 1) == 2**24 exactly — any f32
+        # accumulation of these sums visibly wrong
+        samples = [(i * 1000, float(2**24 + i)) for i in range(64)]
+        payload = make_remote_write([({"__name__": "ctr", "host": "a"}, samples)])
+        await eng.write_parsed(PooledParser.decode(payload))
+        out = await eng.query(
+            QueryRequest(metric=b"ctr", start_ms=0, end_ms=64_000, bucket_ms=64_000)
+        )
+        _tsids, grids = out
+        exact = float(sum(v for _t, v in samples))
+        assert float(grids["sum"][0, 0]) == exact
+        assert float(grids["count"][0, 0]) == 64.0
+        await eng.close()
+
+    @async_test
     async def test_multi_segment_write(self):
         """Samples spanning segments split into per-segment storage writes."""
         store = MemStore()
