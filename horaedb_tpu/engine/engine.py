@@ -211,7 +211,8 @@ class MetricEngine:
             )
         # 2. register unseen series
         await self.index_mgr.ensure_series_fast(
-            metric_arr, tsid_arr, req.series_key, ts_now
+            metric_arr, tsid_arr, req.series_key, ts_now,
+            tag_rows_of=req.series_tag_rows,
         )
         return metric_arr, tsid_arr
 

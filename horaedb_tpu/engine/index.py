@@ -435,12 +435,14 @@ class IndexManager:
         tsids: np.ndarray,       # u64 per series
         key_of,                  # series index -> canonical key bytes
         now_ms: int,
+        tag_rows_of=None,        # series index -> [(hash, k, v)] | None
     ) -> None:
         """Hash-lane fast path: ids and canonical keys were computed by the
         native parser; only genuinely new series pay Python-object costs
-        (key decode + posting rows). The Python seahash remains the
-        differential oracle in tests, per the reference hash contract
-        (src/metric_engine/src/types.rs:18-41).
+        (key decode + posting rows — and with `tag_rows_of` the posting
+        hashes too come precomputed from the C++ tag lanes). The Python
+        seahash remains the differential oracle in tests, per the reference
+        hash contract (src/metric_engine/src/types.rs:18-41).
 
         Steady-state probes hit a bounded recently-seen cache (O(1) per
         series); only cache misses consult the base/delta tiers."""
@@ -475,8 +477,19 @@ class IndexManager:
         for i in new_idx:
             key = key_of(i)
             new_series_rows.append((mids[i], tids[i], key))
-            for k, v in decode_series_key(key):
-                new_index_rows.append((mids[i], tag_hash_of(k, v), tids[i], k, v))
+            rows = tag_rows_of(i) if tag_rows_of is not None else None
+            if rows is not None:
+                # native lanes: posting hashes precomputed in C++, k/v
+                # sliced zero-copy from the payload (same sorted order as
+                # the canonical key) — the Python seahash survives only as
+                # the differential oracle (tests/test_ingest.py)
+                for h, k, v in rows:
+                    new_index_rows.append((mids[i], h, tids[i], k, v))
+            else:
+                for k, v in decode_series_key(key):
+                    new_index_rows.append(
+                        (mids[i], tag_hash_of(k, v), tids[i], k, v)
+                    )
         # persist-before-cache, same reasoning as populate_series_ids
         await self._persist(new_series_rows, new_index_rows, now_ms)
         oversized = self._commit_rows(new_series_rows, new_index_rows)
@@ -485,6 +498,8 @@ class IndexManager:
             await self._compact_delta()
 
     async def _persist(self, series_rows, index_rows, now_ms: int) -> None:
+        import asyncio
+
         seg_start = now_ms - now_ms % self._segment_duration
         rng = TimeRange(seg_start, seg_start + 1)
         s_batch = pa.RecordBatch.from_pydict(
@@ -495,19 +510,25 @@ class IndexManager:
             },
             schema=SERIES_SCHEMA,
         )
+        if not index_rows:
+            await self._series.write(WriteRequest(s_batch, rng))
+            return
+        i_batch = pa.RecordBatch.from_pydict(
+            {
+                "metric_id": np.asarray([r[0] for r in index_rows], dtype=np.uint64),
+                "tag_hash": np.asarray([r[1] for r in index_rows], dtype=np.uint64),
+                "tsid": np.asarray([r[2] for r in index_rows], dtype=np.uint64),
+                "tag_key": [r[3] for r in index_rows],
+                "tag_value": [r[4] for r in index_rows],
+            },
+            schema=INDEX_SCHEMA,
+        )
+        # series BEFORE index: a crash between the two leaves a series with
+        # no postings (harmless: unfiltered queries still see it) — never a
+        # posting whose tsid is missing from the series table, which would
+        # make tag-filtered and unfiltered results disagree after recovery
         await self._series.write(WriteRequest(s_batch, rng))
-        if index_rows:
-            i_batch = pa.RecordBatch.from_pydict(
-                {
-                    "metric_id": np.asarray([r[0] for r in index_rows], dtype=np.uint64),
-                    "tag_hash": np.asarray([r[1] for r in index_rows], dtype=np.uint64),
-                    "tsid": np.asarray([r[2] for r in index_rows], dtype=np.uint64),
-                    "tag_key": [r[3] for r in index_rows],
-                    "tag_value": [r[4] for r in index_rows],
-                },
-                schema=INDEX_SCHEMA,
-            )
-            await self._index.write(WriteRequest(i_batch, rng))
+        await self._index.write(WriteRequest(i_batch, rng))
 
     # -- query path ------------------------------------------------------------
     def _metric_delta(self, metric_id: int):

@@ -29,7 +29,7 @@ _lib_lock = threading.Lock()
 
 # Must match rw_abi_version() in remote_write_parser.cc; a stale committed
 # or leftover .so is rebuilt instead of silently shadowing the source.
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 
 
 class _RwResult(ctypes.Structure):
@@ -76,6 +76,14 @@ class _RwHashResult(ctypes.Structure):
         ("series_key_len", ctypes.POINTER(ctypes.c_int64)),
         ("key_arena", ctypes.POINTER(ctypes.c_uint8)),
         ("key_arena_len", ctypes.c_int64),
+        # ABI v5: inverted-index lanes per sorted non-name label pair
+        ("tag_hash", ctypes.POINTER(ctypes.c_uint64)),
+        ("tag_k_off", ctypes.POINTER(ctypes.c_int64)),
+        ("tag_k_len", ctypes.POINTER(ctypes.c_int64)),
+        ("tag_v_off", ctypes.POINTER(ctypes.c_int64)),
+        ("tag_v_len", ctypes.POINTER(ctypes.c_int64)),
+        ("series_tag_start", ctypes.POINTER(ctypes.c_int64)),
+        ("n_tags", ctypes.c_int64),
     ]
 
 
@@ -308,6 +316,14 @@ class NativeParser:
             key_arena=ctypes.string_at(hres.key_arena, hres.key_arena_len)
             if hres.key_arena_len
             else b"",
+            tag_hash=_as_np(hres.tag_hash, hres.n_tags, np.uint64),
+            tag_k_off=_as_np(hres.tag_k_off, hres.n_tags, np.int64),
+            tag_k_len=_as_np(hres.tag_k_len, hres.n_tags, np.int64),
+            tag_v_off=_as_np(hres.tag_v_off, hres.n_tags, np.int64),
+            tag_v_len=_as_np(hres.tag_v_len, hres.n_tags, np.int64),
+            series_tag_start=_as_np(hres.series_tag_start, ns + 1, np.int64)
+            if ns
+            else None,
         )
 
 

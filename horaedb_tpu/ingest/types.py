@@ -61,6 +61,16 @@ class ParsedWriteRequest:
     series_key_off: np.ndarray | None = None    # into key_arena
     series_key_len: np.ndarray | None = None
     key_arena: bytes = b""
+    # Inverted-index lanes (native parser ABI v5): per sorted non-name
+    # label pair — posting hash (tag_hash_of contract) + payload slices;
+    # series s owns [series_tag_start[s], series_tag_start[s+1]). None from
+    # the pure-Python fallback (or resolved lazily via lazy_hres).
+    tag_hash: np.ndarray | None = None          # uint64 [n_tags]
+    tag_k_off: np.ndarray | None = None         # int64 into payload
+    tag_k_len: np.ndarray | None = None
+    tag_v_off: np.ndarray | None = None
+    tag_v_len: np.ndarray | None = None
+    series_tag_start: np.ndarray | None = None  # int64 [n_series + 1]
     # set by parse_light (sample lanes stay in the parser arena for the
     # native accumulator); None -> count the materialized lane
     n_samples_hint: int | None = None
@@ -119,6 +129,30 @@ class ParsedWriteRequest:
         else:  # lazy: offsets live in the held arena pointers
             o = int(self.lazy_hres.series_name_off[s])
         return self.payload[o : o + n]
+
+    def series_tag_rows(self, s: int) -> "list[tuple[int, bytes, bytes]] | None":
+        """Inverted-index rows of series `s` as (posting_hash, key, value),
+        in canonical sorted order — hashes precomputed by the native parser
+        (the tag_hash_of contract), key/value sliced zero-copy from the
+        payload. None when the producing parser exposed no tag lanes (pure
+        Python fallback): callers then derive rows from the series key."""
+        if self.series_tag_start is not None:
+            src = self  # copied numpy lanes (full parse)
+        else:
+            src = self.lazy_hres  # held arena pointers (parse_light)
+            if src is None or not src.tag_hash:
+                return None
+        lo = int(src.series_tag_start[s])
+        hi = int(src.series_tag_start[s + 1])
+        p = self.payload
+        return [
+            (
+                int(src.tag_hash[i]),
+                p[int(src.tag_k_off[i]):int(src.tag_k_off[i]) + int(src.tag_k_len[i])],
+                p[int(src.tag_v_off[i]):int(src.tag_v_off[i]) + int(src.tag_v_len[i])],
+            )
+            for i in range(lo, hi)
+        ]
 
     def series_key(self, s: int) -> bytes:
         """Canonical sorted series key of series `s` (hash-lane fast path)."""

@@ -194,6 +194,14 @@ struct Parser {
   std::vector<int64_t> series_key_off, series_key_len;    // into key_arena
   std::vector<uint8_t> key_arena;
   std::vector<int32_t> sort_buf;  // scratch: label indices being sorted
+  // inverted-index lanes, one entry per sorted non-name label pair
+  // (tag_hash_of contract, engine/types.py:43): posting hash + payload
+  // slices. Series s owns [series_tag_start[s], series_tag_start[s+1]) —
+  // series_tag_start has n_series+1 entries (last = total pair count).
+  std::vector<uint64_t> tag_hash;
+  std::vector<int64_t> tag_k_off, tag_k_len, tag_v_off, tag_v_len;
+  std::vector<int64_t> series_tag_start;
+  std::vector<uint8_t> hash_scratch;  // scratch: one (u32 klen)+k+v image
 
   void clear() {  // keeps capacity: the pooled-reuse contract
     series_label_start.clear(); series_label_count.clear();
@@ -210,6 +218,10 @@ struct Parser {
     series_name_off.clear(); series_name_len.clear();
     series_key_off.clear(); series_key_len.clear();
     key_arena.clear();
+    tag_hash.clear();
+    tag_k_off.clear(); tag_k_len.clear();
+    tag_v_off.clear(); tag_v_len.clear();
+    series_tag_start.clear();
   }
 };
 
@@ -277,8 +289,11 @@ void compute_hashes(Parser& ps, const uint8_t* buf) {
                                  buf + ps.label_value_off[b],
                                  ps.label_value_len[b]) < 0;
               });
-    // materialize the canonical key: <u32 klen> k <u32 vlen> v per pair
+    // materialize the canonical key: <u32 klen> k <u32 vlen> v per pair;
+    // the same walk fills the inverted-index lanes (posting hash over
+    // <u32 klen> k v — the tag_hash_of contract — plus payload slices)
     int64_t key_off = static_cast<int64_t>(ps.key_arena.size());
+    ps.series_tag_start.push_back(static_cast<int64_t>(ps.tag_hash.size()));
     for (int32_t i : ps.sort_buf) {
       arena_put_u32le(ps.key_arena,
                       static_cast<uint32_t>(ps.label_name_len[i]));
@@ -288,10 +303,25 @@ void compute_hashes(Parser& ps, const uint8_t* buf) {
                       static_cast<uint32_t>(ps.label_value_len[i]));
       ps.key_arena.insert(ps.key_arena.end(), buf + ps.label_value_off[i],
                           buf + ps.label_value_off[i] + ps.label_value_len[i]);
+      ps.hash_scratch.clear();
+      arena_put_u32le(ps.hash_scratch,
+                      static_cast<uint32_t>(ps.label_name_len[i]));
+      ps.hash_scratch.insert(ps.hash_scratch.end(), buf + ps.label_name_off[i],
+                             buf + ps.label_name_off[i] + ps.label_name_len[i]);
+      ps.hash_scratch.insert(ps.hash_scratch.end(),
+                             buf + ps.label_value_off[i],
+                             buf + ps.label_value_off[i] + ps.label_value_len[i]);
+      ps.tag_hash.push_back(seahash(ps.hash_scratch.data(),
+                                    ps.hash_scratch.size()));
+      ps.tag_k_off.push_back(ps.label_name_off[i]);
+      ps.tag_k_len.push_back(ps.label_name_len[i]);
+      ps.tag_v_off.push_back(ps.label_value_off[i]);
+      ps.tag_v_len.push_back(ps.label_value_len[i]);
     }
     ps.series_key_off[s] = key_off;
     ps.series_key_len[s] = static_cast<int64_t>(ps.key_arena.size()) - key_off;
   }
+  ps.series_tag_start.push_back(static_cast<int64_t>(ps.tag_hash.size()));
   // hash pass after arena building: insertions above may reallocate the arena
   for (size_t s = 0; s < n_series; ++s) {
     ps.series_tsid[s] =
@@ -676,6 +706,16 @@ struct RwHashResult {
   const int64_t* series_key_len;
   const uint8_t* key_arena;
   int64_t key_arena_len;
+  // inverted-index lanes (ABI v5): per sorted non-name label pair —
+  // posting hash + payload slices; series s owns
+  // [series_tag_start[s], series_tag_start[s+1]).
+  const uint64_t* tag_hash;
+  const int64_t* tag_k_off;
+  const int64_t* tag_k_len;
+  const int64_t* tag_v_off;
+  const int64_t* tag_v_len;
+  const int64_t* series_tag_start;  // n_series + 1 entries
+  int64_t n_tags;
 };
 
 // Sorted flush lanes; valid until the next rw_accum_clear/free.
@@ -689,7 +729,7 @@ struct RwFlushResult {
 
 // Bumped whenever the ABI of any struct/function here changes; the Python
 // binding refuses (and rebuilds) a stale .so whose version mismatches.
-int rw_abi_version() { return 4; }
+int rw_abi_version() { return 5; }
 
 // One-FFI-call copy of the hot per-series id lanes into caller buffers
 // (each ctypes string_at crossing costs ~10us; three lanes per request add
@@ -797,6 +837,13 @@ int rw_parse_hashed(void* h, const uint8_t* buf, uint64_t len, RwResult* out,
   hashes->series_key_len = ps.series_key_len.data();
   hashes->key_arena = ps.key_arena.data();
   hashes->key_arena_len = static_cast<int64_t>(ps.key_arena.size());
+  hashes->tag_hash = ps.tag_hash.data();
+  hashes->tag_k_off = ps.tag_k_off.data();
+  hashes->tag_k_len = ps.tag_k_len.data();
+  hashes->tag_v_off = ps.tag_v_off.data();
+  hashes->tag_v_len = ps.tag_v_len.data();
+  hashes->series_tag_start = ps.series_tag_start.data();
+  hashes->n_tags = static_cast<int64_t>(ps.tag_hash.size());
   return 0;
 }
 

@@ -359,10 +359,31 @@ class ObjectBasedStorage(ColumnarStorage):
         ensure(table.num_rows < 2**32, f"sst row count too large: {table.num_rows}")
 
         CHUNK = 4 << 20
+        kwargs = self._writer_kwargs()
+
+        # Small tables (registration batches, tiny flush shards) skip the
+        # producer-thread/queue streaming machinery: one worker-thread
+        # encode into memory + one put. The streaming path exists to bound
+        # host memory for LARGE tables; below one chunk it only adds
+        # loop<->thread ping-pong (~ms per write, dominating tiny writes).
+        if table.nbytes <= CHUNK:
+            def _encode_small() -> bytes:
+                sink = io.BytesIO()
+                writer = pq.ParquetWriter(sink, table.schema, **kwargs)
+                writer.write_table(table, row_group_size=cfg.max_row_group_size)
+                writer.close()
+                return sink.getvalue()
+
+            blob = await self._run_sst(_encode_small)
+            ensure(len(blob) < 2**32, f"sst too large for manifest format: {len(blob)}")
+            with context(f"write sst {path}"):
+                await self._store.put(path, blob)
+            await self._write_bloom_sidecar(file_id, path, table)
+            return len(blob)
+
         q: _queue.Queue = _queue.Queue(maxsize=4)
         cancel = _threading.Event()
         done = _threading.Event()
-        kwargs = self._writer_kwargs()
 
         class _Sink(io.RawIOBase):
             def __init__(self):
@@ -443,29 +464,33 @@ class ObjectBasedStorage(ColumnarStorage):
                     pass
                 done.wait(timeout=0.05)
 
-        # Bloom sidecar AFTER the SST lands: readers only learn ids via the
-        # manifest (updated after this returns), so ordering is safe, and a
-        # failed stream can't orphan a sidecar. If the sidecar put itself
-        # fails, the SST object is reclaimed best-effort before raising.
-        bloom_cols = self._bloom_columns()
-        if bloom_cols:
-            from horaedb_tpu.storage import bloom as bloom_mod
-
-            try:
-                blooms = await self._run_sst(
-                    bloom_mod.build_blooms, table, bloom_cols
-                )
-                await self._store.put(
-                    self._path_gen.generate_bloom(file_id),
-                    bloom_mod.encode_blooms(blooms),
-                )
-            except BaseException:
-                try:
-                    await self._store.delete(path)
-                except Exception:  # noqa: BLE001 — orphan cleanup best-effort
-                    logger.warning("orphaned sst object %s after bloom failure", path)
-                raise
+        await self._write_bloom_sidecar(file_id, path, table)
         return size
+
+    async def _write_bloom_sidecar(self, file_id: int, path: str, table) -> None:
+        """Bloom sidecar AFTER the SST lands: readers only learn ids via the
+        manifest (updated after this returns), so ordering is safe, and a
+        failed stream can't orphan a sidecar. If the sidecar put itself
+        fails, the SST object is reclaimed best-effort before raising."""
+        bloom_cols = self._bloom_columns()
+        if not bloom_cols:
+            return
+        from horaedb_tpu.storage import bloom as bloom_mod
+
+        try:
+            blooms = await self._run_sst(
+                bloom_mod.build_blooms, table, bloom_cols
+            )
+            await self._store.put(
+                self._path_gen.generate_bloom(file_id),
+                bloom_mod.encode_blooms(blooms),
+            )
+        except BaseException:
+            try:
+                await self._store.delete(path)
+            except Exception:  # noqa: BLE001 — orphan cleanup best-effort
+                logger.warning("orphaned sst object %s after bloom failure", path)
+            raise
 
     # -- scan path (storage.rs:335-370) --------------------------------------
     async def scan(self, req: ScanRequest) -> AsyncIterator[pa.RecordBatch]:

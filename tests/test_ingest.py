@@ -323,6 +323,44 @@ class TestHashLanes:
                 out = native.parse(f.read())
             assert_hash_lanes_match_oracle(out)
 
+    def test_tag_lanes_match_oracle(self):
+        """ABI v5 inverted-index lanes: per-pair posting hashes and payload
+        slices must equal the Python tag_hash_of/decode_series_key oracle,
+        on both the copying parse and the lazy parse_light paths."""
+        from horaedb_tpu.engine.types import decode_series_key, tag_hash_of
+
+        native = native_parser()
+        payload = make_payload(seed=3, n_series=25)
+        for out in (native.parse(payload), native.parse_light(payload)):
+            for s in range(out.n_series):
+                rows = out.series_tag_rows(s)
+                oracle = [
+                    (tag_hash_of(k, v), k, v)
+                    for k, v in decode_series_key(out.series_key(s))
+                ]
+                assert rows == oracle, s
+
+    def test_tag_lanes_edge_cases(self):
+        """Duplicate keys, binary bytes, empty values, no non-name labels."""
+        from horaedb_tpu.engine.types import decode_series_key, tag_hash_of
+
+        native = native_parser()
+        req = remote_write_pb2.WriteRequest()
+        ts = req.timeseries.add()
+        for k, v in ((b"z", b""), (b"a", b"\xff\x00"), (b"a", b"\x00"),
+                     (b"__name__", b"m"), (b"aa", b"x")):
+            lab = ts.labels.add(); lab.name = k; lab.value = v
+        ts = req.timeseries.add()  # __name__ only: zero tag rows
+        lab = ts.labels.add(); lab.name = b"__name__"; lab.value = b"solo"
+        out = native.parse(req.SerializeToString())
+        for s in range(out.n_series):
+            oracle = [
+                (tag_hash_of(k, v), k, v)
+                for k, v in decode_series_key(out.series_key(s))
+            ]
+            assert out.series_tag_rows(s) == oracle
+        assert out.series_tag_rows(1) == []
+
 
 WORKLOAD_DIR = "/root/reference/src/remote_write/tests/workloads"
 
