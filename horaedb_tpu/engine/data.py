@@ -603,6 +603,10 @@ class SampleManager:
                         t0=rng.start,
                         bucket_ms=bucket_ms,
                         num_buckets=num_buckets,
+                        # data-table pk is (metric_id, tsid, field_id, ts):
+                        # metric_id is eq-pinned in `pred`, field_id is
+                        # constant 0 — the packed (sid, ts) dedup is exact
+                        packed_ok=True,
                     ),
                 )
             if part is None:  # segment vanished entirely (TTL)

@@ -498,8 +498,6 @@ class IndexManager:
             await self._compact_delta()
 
     async def _persist(self, series_rows, index_rows, now_ms: int) -> None:
-        import asyncio
-
         seg_start = now_ms - now_ms % self._segment_duration
         rng = TimeRange(seg_start, seg_start + 1)
         s_batch = pa.RecordBatch.from_pydict(

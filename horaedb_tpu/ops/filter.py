@@ -343,8 +343,12 @@ def eval_predicate_host(pred: Predicate | None, table) -> np.ndarray:
             try:
                 fn = {"eq": pc.equal, "ne": pc.not_equal, "lt": pc.less,
                       "le": pc.less_equal, "gt": pc.greater, "ge": pc.greater_equal}[p.op]
-                out = fn(col, pa.scalar(lit))
-            except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError) as e:
+                # pin the scalar to the column type: untyped inference maps
+                # a large u64 id (>= 2^63) to int64 and overflows
+                out = fn(col, pa.scalar(lit, type=col.type)
+                         if not isinstance(lit, (bytes, str)) else pa.scalar(lit))
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                    pa.ArrowTypeError, OverflowError) as e:
                 raise HoraeError(
                     f"predicate literal {lit!r} incompatible with column "
                     f"{p.column!r} ({col.type})"

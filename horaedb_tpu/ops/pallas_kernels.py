@@ -223,7 +223,9 @@ def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks):
 
 def _scatter_sum_count(k_sorted, v, num_cells):
     k = jnp.clip(k_sorted, 0, num_cells).astype(jnp.int32)
-    vf = v.astype(jnp.float32)
+    # dtype-preserving: the CPU/XLA fallback accumulates f64 inputs in f64
+    # (the engine's precision contract, data.py); f32 stays the TPU trade-off
+    vf = v if jnp.issubdtype(v.dtype, jnp.floating) else v.astype(jnp.float32)
     s = jax.ops.segment_sum(vf, k, num_cells + 1)[:-1]
     c = jax.ops.segment_sum(jnp.ones_like(vf), k, num_cells + 1)[:-1]
     return s, c
