@@ -76,6 +76,12 @@ class SampleManager:
         # snapshots are disjoint; pk+seq dedup makes any retry overlap
         # harmless). flush() remains the strong barrier queries use.
         self._inflight: "set[asyncio.Task]" = set()
+        # Failed-snapshot re-buffer: (seq, seg_start, lanes, presorted)
+        # groups carrying their ORIGINAL snapshot sequence. Replaying under
+        # a fresh (newer) seq would let a stale overwritten value beat a
+        # newer acked write that flushed successfully in between.
+        self._rebuf: "list[tuple[int, int, tuple, bool]]" = []
+        self._rebuf_rows = 0
         # shared bound for concurrent segment-pushdown scans (lazy: binds
         # the running loop)
         self._scan_sem: "asyncio.Semaphore | None" = None
@@ -99,10 +105,10 @@ class SampleManager:
 
     @property
     def buffered_rows(self) -> int:
-        """Total rows awaiting durability (native accumulator + the Python
-        re-buffer that holds failed-flush snapshots)."""
+        """Total rows awaiting durability (native accumulator + Python
+        buffers + the failed-snapshot re-buffer)."""
         accum = self._accum.rows if self._accum is not None else 0
-        return accum + self._buffered
+        return accum + self._buffered + self._rebuf_rows
 
     # Backlog hard cap, as a multiple of buffer_rows: past it, ingest stops
     # deferring to the background flush and AWAITS one — restoring
@@ -178,7 +184,9 @@ class SampleManager:
     @property
     def _has_pending_rows(self) -> bool:
         return bool(
-            self._buffered or (self._accum is not None and self._accum.rows)
+            self._buffered
+            or self._rebuf
+            or (self._accum is not None and self._accum.rows)
         )
 
     async def persist(
@@ -263,17 +271,20 @@ class SampleManager:
         Concurrency contract: buffers are snapshot-detached atomically (no
         await between detach and the accumulator take) so concurrent
         write-outs hold disjoint snapshots and rows appended by other
-        coroutines land in fresh buffers, never dropped; on ANY write
-        failure the snapshot is merged back (dense ids remapped) before the
-        error propagates, so already-acked samples survive for a retrying
-        flush. Partial double-writes are safe: the storage merge dedups by
-        pk + seq."""
+        coroutines land in fresh buffers, never dropped. On ANY write
+        failure the snapshot converts into pinned-seq re-buffer groups
+        (keeping THIS snapshot's sequence) before the error propagates, so
+        already-acked samples survive for a retrying flush and a later
+        replay can never beat writes acked after them. Partial
+        double-writes are safe: the storage merge dedups by pk + seq."""
         from horaedb_tpu.storage.sst import allocate_id
 
         buf, self._buf = self._buf, {}
         chunks, self._chunks = self._chunks, []
         keys, self._dense_keys = self._dense_keys, []
         self._dense = {}
+        rebuf, self._rebuf = self._rebuf, []
+        rebuf_rows, self._rebuf_rows = self._rebuf_rows, 0
         snapshot_rows = sum(len(c[1]) for c in chunks) + sum(
             len(c[2]) for lst in buf.values() for c in lst
         )
@@ -289,6 +300,41 @@ class SampleManager:
         # follows buffering order even if a later snapshot's encode lands
         # its SSTs (with higher file ids) first.
         snap_seq = allocate_id()
+
+        def _rebuffer_fresh() -> None:
+            self._rebuffer_snapshot(buf, chunks, keys, snap_seq)
+            if accum_lanes is not None:
+                self._rebuffer_lanes(*accum_lanes, seq=snap_seq)
+
+        del rebuf_rows  # detached with the groups; recomputed on re-buffer
+        # 1) replay previously-failed groups under their ORIGINAL seqs,
+        # coalesced per (seq, segment) so a failed snapshot of many small
+        # requests replays as one SST per segment, not one per request
+        # (already-landed shards of those snapshots dedup by pk+seq)
+        merged: "dict[tuple[int, int], list]" = {}
+        for seq0, seg0, lanes0, presorted0 in rebuf:
+            merged.setdefault((seq0, seg0), []).append((lanes0, presorted0))
+        replay = list(merged.items())
+        for i, ((seq0, _seg0), group) in enumerate(replay):
+            if len(group) == 1:
+                lanes0, presorted0 = group[0]
+            else:
+                lanes0 = tuple(
+                    np.concatenate([g[0][j] for g in group]) for j in range(4)
+                )
+                presorted0 = False  # concatenation breaks per-group order
+            try:
+                await self._write_segment(
+                    *lanes0, presorted=presorted0, seq=seq0
+                )
+            except BaseException:
+                for (sq, sg), grp in replay[i:]:
+                    for lanes1, presorted1 in grp:
+                        self._rebuf.append((sq, sg, lanes1, presorted1))
+                        self._rebuf_rows += len(lanes1[2])
+                _rebuffer_fresh()
+                raise
+        # 2) this snapshot's fresh rows
         try:
             for _seg_start, cols_list in sorted(buf.items()):
                 cols = [
@@ -298,9 +344,7 @@ class SampleManager:
             if chunks:
                 await self._flush_chunks(chunks, keys, seq=snap_seq)
         except BaseException:
-            self._restore_snapshot(buf, chunks, keys, snapshot_rows)
-            if accum_lanes is not None:
-                self._rebuffer_lanes(*accum_lanes)
+            _rebuffer_fresh()
             raise
         if accum_lanes is not None:
             await self._flush_accum_lanes(*accum_lanes, seq=snap_seq)
@@ -370,14 +414,15 @@ class SampleManager:
                             self._write_segment(*lanes, presorted=True, seq=seq)
                         )
         except BaseException:
-            self._rebuffer_lanes(mid, tsid, ts, vals, per_seg)
+            self._rebuffer_lanes(mid, tsid, ts, vals, per_seg, seq=seq)
             raise
 
-    def _rebuffer_lanes(self, mid, tsid, ts, vals, per_seg=None) -> None:
-        """Re-buffer failed accumulator lanes PER SEGMENT: the Python
-        buffer's write-out emits one batch per key and a batch must not
-        cross a segment. Shards that did land before the failure are
-        harmless to re-write: storage dedups by pk + seq."""
+    def _rebuffer_lanes(self, mid, tsid, ts, vals, per_seg=None, seq=None) -> None:
+        """Re-buffer failed accumulator lanes PER SEGMENT into the pinned-seq
+        re-buffer (a batch must not cross a segment). The lanes keep their
+        snapshot's sequence so a later replay cannot beat writes acked after
+        them. Shards that did land before the failure are harmless to
+        re-write: storage dedups by pk + seq."""
         if not len(ts):
             return
         if per_seg is None:
@@ -391,27 +436,34 @@ class SampleManager:
                     for s in uniq.tolist()
                 ]
         for seg_start, lanes in per_seg:
-            self._buf.setdefault(seg_start, []).append(lanes)
-        self._buffered += len(ts)
+            # accum lanes are pk-sorted; segment mask-gathers preserve that
+            self._rebuf.append((seq, seg_start, lanes, True))
+        self._rebuf_rows += len(ts)
 
-    def _restore_snapshot(self, buf, chunks, keys, snapshot_rows: int) -> None:
-        """Merge a failed flush's snapshot back into the live buffers."""
+    def _rebuffer_snapshot(self, buf, chunks, keys, seq: int) -> None:
+        """Convert a failed snapshot's Python buffers into pinned-seq
+        re-buffer groups (per segment, original sequence preserved)."""
+        rows = 0
         for seg_start, lst in buf.items():
-            self._buf.setdefault(seg_start, []).extend(lst)
+            for lanes in lst:
+                self._rebuf.append((seq, int(seg_start), lanes, False))
+                rows += len(lanes[2])
         if chunks:
-            # dense ids in the snapshot refer to `keys`; remap them into the
-            # (possibly repopulated) live dense table
-            remap = np.empty(len(keys), dtype=np.int64)
-            for old_d, k in enumerate(keys):
-                new_d = self._dense.get(k)
-                if new_d is None:
-                    new_d = len(self._dense_keys)
-                    self._dense[k] = new_d
-                    self._dense_keys.append(k)
-                remap[old_d] = new_d
-            for dense_ps, ts, vals in chunks:
-                self._chunks.append((remap[dense_ps], ts, vals))
-        self._buffered += snapshot_rows
+            dense_ps = np.concatenate([c[0] for c in chunks])
+            ts = np.concatenate([c[1] for c in chunks])
+            vals = np.concatenate([c[2] for c in chunks])
+            key_mid = np.fromiter((k[0] for k in keys), np.uint64, len(keys))
+            key_tsid = np.fromiter((k[1] for k in keys), np.uint64, len(keys))
+            mid = key_mid[dense_ps]
+            tsid = key_tsid[dense_ps]
+            seg = ts - (ts % self._segment_duration)
+            for s in np.unique(seg).tolist():
+                m = seg == s
+                self._rebuf.append(
+                    (seq, int(s), (mid[m], tsid[m], ts[m], vals[m]), False)
+                )
+            rows += len(ts)
+        self._rebuf_rows += rows
 
     async def _flush_chunks(self, chunks, keys, seq=None) -> None:
         """Counting-sort the buffered lanes into pk order: rank the (few)
@@ -451,7 +503,7 @@ class SampleManager:
         uniq = np.unique(seg)
         for seg_start in uniq:
             m = seg == seg_start if len(uniq) > 1 else slice(None)
-            await self._write_segment(mid[m], tsid[m], ts[m], vals[m])
+            await self._write_segment(mid[m], tsid[m], ts[m], vals[m], seq=seq)
 
     async def _write_segment(
         self, metric_ids, tsids, ts, values,

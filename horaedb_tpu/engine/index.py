@@ -521,12 +521,15 @@ class IndexManager:
             },
             schema=INDEX_SCHEMA,
         )
-        # series BEFORE index: a crash between the two leaves a series with
-        # no postings (harmless: unfiltered queries still see it) — never a
-        # posting whose tsid is missing from the series table, which would
-        # make tag-filtered and unfiltered results disagree after recovery
-        await self._series.write(WriteRequest(s_batch, rng))
+        # index BEFORE series: "known" (series-ack) derives from the SERIES
+        # table (_is_known), so the recoverable half-state must be
+        # postings-without-series — a benign ghost (no samples can have been
+        # acked for it; a retry rewrites both batches idempotently, pk+seq
+        # dedup). The inverse order would leave a series marked known with
+        # its postings missing FOREVER: tag-filtered queries would silently
+        # skip it while its samples keep landing.
         await self._index.write(WriteRequest(i_batch, rng))
+        await self._series.write(WriteRequest(s_batch, rng))
 
     # -- query path ------------------------------------------------------------
     def _metric_delta(self, metric_id: int):

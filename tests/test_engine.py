@@ -486,7 +486,7 @@ class TestBufferedFlushFailure:
         # the barrier attempts the write-out, re-buffers, and retries once
         # inline before surfacing the persistent error
         assert calls["n"] == 2
-        assert eng.sample_mgr._buffered == 2  # restored, not dropped
+        assert eng.sample_mgr.buffered_rows == 2  # re-buffered, not dropped
         # more data lands in the restored buffer, then a successful retry
         payload2 = make_remote_write(
             [({"__name__": "cpu", "host": "a"}, [(3000, 3.0)])]

@@ -282,3 +282,161 @@ async def test_buffered_engine_with_compaction_matches_oracle(seed):
     await eng.data_table.compaction_scheduler.executor.drain()
     await check()
     await eng.close()
+
+
+class _FlakyStore(MemStore):
+    """MemStore whose puts fail with a controllable probability — drives the
+    failed-snapshot re-buffer/replay machinery (data.py pinned-seq rebuf)."""
+
+    def __init__(self, rng, fail_rate: float = 0.0):
+        super().__init__()
+        self._rng = rng
+        self.fail_rate = fail_rate
+
+    def _maybe_fail(self) -> None:
+        if self.fail_rate and self._rng.random() < self.fail_rate:
+            from horaedb_tpu.common.error import HoraeError
+
+            raise HoraeError("injected flaky-store failure")
+
+    async def put(self, path, data):
+        self._maybe_fail()
+        return await super().put(path, data)
+
+    async def put_stream(self, path, chunks):
+        self._maybe_fail()
+        return await super().put_stream(path, chunks)
+
+
+@async_test
+async def test_failed_snapshot_replay_keeps_original_seq():
+    """Resurrection regression: v1's snapshot fails and re-buffers; v2 (same
+    pk) flushes successfully afterwards; the later replay of v1 must NOT
+    beat v2 — re-buffered groups carry their original snapshot sequence."""
+    import random
+
+    from horaedb_tpu.common.error import HoraeError
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.pb import remote_write_pb2
+
+    def payload(value: float) -> bytes:
+        req = remote_write_pb2.WriteRequest()
+        ts = req.timeseries.add()
+        for k, v in ((b"__name__", b"rs"), (b"host", b"a")):
+            lab = ts.labels.add(); lab.name = k; lab.value = v
+        s = ts.samples.add(); s.timestamp = 5_000; s.value = value
+        return req.SerializeToString()
+
+    store = _FlakyStore(random.Random(0), fail_rate=0.0)
+    eng = await MetricEngine.open(
+        "db", store, segment_duration_ms=SEGMENT_MS,
+        enable_compaction=False, ingest_buffer_rows=8,
+    )
+    await eng.write_payload(payload(1.0))
+    store.fail_rate = 1.0
+    with pytest.raises(HoraeError):
+        await eng.flush()            # v1's snapshot fails -> pinned-seq rebuf
+    store.fail_rate = 0.0
+    await eng.write_payload(payload(2.0))   # newer ack, fresh snapshot
+    await eng.flush()                # replays v1 (old seq) + writes v2 (new seq)
+    t = await eng.query(QueryRequest(metric=b"rs", start_ms=0, end_ms=10_000))
+    assert t.column("value").to_pylist() == [2.0]
+    await eng.close()
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33])
+@async_test
+async def test_buffered_engine_with_flaky_store_matches_oracle(seed):
+    """Randomized interleavings of buffered ingest + CONCURRENT background
+    write-outs + transient storage failures vs the oracle. Acked samples
+    must survive any failure pattern (pinned-seq replay), and after the
+    store heals a drain converges exactly to the model — including
+    overwrites whose first snapshot failed."""
+    import random
+
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.pb import remote_write_pb2
+
+    rng = random.Random(seed)
+    store = _FlakyStore(rng, fail_rate=0.0)
+
+    async def open_engine():
+        return await MetricEngine.open(
+            "db", store, segment_duration_ms=SEGMENT_MS,
+            enable_compaction=False, ingest_buffer_rows=48,
+        )
+
+    eng = await open_engine()
+    model: dict[tuple[bytes, int], float] = {}
+    next_ts = [1000]
+
+    def payload() -> bytes:
+        req = remote_write_pb2.WriteRequest()
+        staged = []
+        for _ in range(rng.randint(1, 3)):
+            host = f"h{rng.randint(0, 4)}".encode()
+            ts = req.timeseries.add()
+            for k, v in ((b"__name__", b"fk"), (b"host", host)):
+                lab = ts.labels.add(); lab.name = k; lab.value = v
+            for _ in range(rng.randint(1, 10)):
+                if model and rng.random() < 0.35:  # heavy overwrite mix
+                    _h, t = rng.choice(list(model.keys()))
+                else:
+                    t = next_ts[0]
+                    next_ts[0] += rng.randint(1, 400_000)
+                s = ts.samples.add()
+                s.timestamp = t
+                s.value = rng.random()
+                staged.append((host, t, s.value))
+        return req.SerializeToString(), staged
+
+    async def check():
+        prev, store.fail_rate = store.fail_rate, 0.0
+        try:
+            t = await eng.query(QueryRequest(metric=b"fk", start_ms=0, end_ms=2**60))
+        finally:
+            store.fail_rate = prev
+        got = {}
+        if t is not None:
+            per_tsid = eng.index_mgr.series_labels(eng.metric_mgr.get(b"fk")[0])
+            host_of = {tsid: labels[b"host"] for tsid, labels in per_tsid.items()}
+            for tsid, ts_, v in zip(
+                t.column("tsid").to_pylist(), t.column("ts").to_pylist(),
+                t.column("value").to_pylist(),
+            ):
+                got[(host_of[tsid], ts_)] = v
+        assert got == model, (
+            f"divergence: {len(got)} vs {len(model)}; "
+            f"missing={set(model) - set(got)} extra={set(got) - set(model)}"
+        )
+
+    for _step in range(60):
+        op = rng.random()
+        # storage health flips over time: bursts of failures then recovery
+        if rng.random() < 0.15:
+            store.fail_rate = rng.choice([0.0, 0.0, 0.4, 1.0])
+        if op < 0.65:
+            p, staged = payload()
+            try:
+                # registration writes may hit the flaky store: series/index
+                # tables share it. Only model samples the engine ACKED.
+                await eng.write_payload(p)
+            except Exception:
+                continue  # rejected payload: not acked, not modeled
+            for host, t, v in staged:
+                model[(host, t)] = v
+        elif op < 0.75:
+            try:
+                await eng.flush()
+            except Exception:
+                pass  # transient; rows re-buffered
+        elif op < 0.9:
+            await check()
+        else:
+            store.fail_rate = 0.0
+            await eng.close()
+            eng = await open_engine()
+            await check()
+    store.fail_rate = 0.0
+    await check()
+    await eng.close()
