@@ -212,6 +212,45 @@ def main() -> None:
         "sorted_ab": {k: round(v) for k, v in sorted_results.items()},
         "probe": probe_reason,
     }
+
+    # Last-chance accelerator retry, ONLY on the wedged-tunnel fallback
+    # path (`not responsive`): the CPU fallback run itself took minutes —
+    # if the tunnel recovered in that window, one fresh subprocess (new
+    # backend) measures on the real chip and its result replaces the
+    # fallback. Bounded: one 120 s probe + one child run; the child skips
+    # this path (env guard) so there is no recursion.
+    import os
+
+    if not responsive and os.environ.get("HORAEDB_BENCH_CHILD") != "1":
+        recovered, _ = _device_responsive((120,))
+        if recovered:
+            import subprocess
+            import sys
+
+            env = dict(os.environ, HORAEDB_BENCH_CHILD="1")
+            try:
+                out = subprocess.run(
+                    [sys.executable, __file__], capture_output=True,
+                    timeout=2400, env=env,
+                )
+                for line in reversed(out.stdout.decode().splitlines()):
+                    try:
+                        child = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (
+                        isinstance(child, dict)
+                        and child.get("metric") == "downsample_rows_per_sec"
+                    ):
+                        if child.get("platform") not in (None, "cpu"):
+                            child["probe"] = (
+                                probe_reason + "; recovered, re-ran on accelerator"
+                            )
+                            print(json.dumps(child))
+                            return
+                        break
+            except Exception:  # noqa: BLE001 — fallback result stands
+                pass
     print(json.dumps(result))
 
 
