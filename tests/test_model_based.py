@@ -363,7 +363,7 @@ async def test_buffered_engine_with_flaky_store_matches_oracle(seed):
     async def open_engine():
         return await MetricEngine.open(
             "db", store, segment_duration_ms=SEGMENT_MS,
-            enable_compaction=False, ingest_buffer_rows=48,
+            enable_compaction=True, ingest_buffer_rows=48,
         )
 
     eng = await open_engine()
@@ -425,12 +425,21 @@ async def test_buffered_engine_with_flaky_store_matches_oracle(seed):
                 continue  # rejected payload: not acked, not modeled
             for host, t, v in staged:
                 model[(host, t)] = v
-        elif op < 0.75:
+        elif op < 0.72:
             try:
                 await eng.flush()
             except Exception:
                 pass  # transient; rows re-buffered
-        elif op < 0.9:
+        elif op < 0.82:
+            # live compaction over the flaky store: failures must unmark
+            # inputs for re-pick, never lose or duplicate rows
+            for sched in (eng.data_table.compaction_scheduler,):
+                if sched is not None:
+                    sched.pick_once()
+                    import asyncio as _a
+                    await _a.sleep(0)
+                    await sched.executor.drain()
+        elif op < 0.92:
             await check()
         else:
             store.fail_rate = 0.0
