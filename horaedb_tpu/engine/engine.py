@@ -137,6 +137,18 @@ class MetricEngine:
             await t.close()
 
     # -- write path -----------------------------------------------------------
+    def metadata(self) -> dict[bytes, str]:
+        """Metric-family metadata (family name -> prom type string)."""
+        return dict(self.metric_mgr.metadata)
+
+    def _record_metadata(self, req: ParsedWriteRequest) -> None:
+        """Fold remote-write METADATA records (family name -> prom type)
+        into the advisory metadata cache (served at /api/v1/metadata)."""
+        for i in range(len(req.meta_type)):
+            self.metric_mgr.record_metadata(
+                req.meta_name(i), int(req.meta_type[i])
+            )
+
     async def write_parsed(self, req: ParsedWriteRequest) -> int:
         """Ingest one decoded remote-write request; returns sample count.
 
@@ -144,6 +156,8 @@ class MetricEngine:
         (ingest/types.py), id resolution is pure numpy + set probes — no
         per-series label slicing or Python seahash (the reference hash
         contract lives in C++, src/metric_engine/src/types.rs:18-41)."""
+        if len(req.meta_type):
+            self._record_metadata(req)
         if req.n_series == 0:
             return 0
         if req.series_tsid is not None:
@@ -251,6 +265,8 @@ class MetricEngine:
                 req = parser.parse_light(payload)
             else:
                 req = await asyncio.to_thread(parser.parse_light, payload)
+            if len(req.meta_type):
+                self._record_metadata(req)
             if req.n_series == 0:
                 return 0
             metric_arr, tsid_arr = await self._resolve_ids_fast(req)

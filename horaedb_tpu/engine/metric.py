@@ -28,6 +28,21 @@ class MetricManager:
         self._cache: dict[bytes, tuple[int, int]] = {}
         # id-keyed view of the same cache for the hash-lane fast path
         self._known_ids: set[int] = set()
+        # Prometheus metric-family metadata (remote-write METADATA records,
+        # prompb MetricMetadata.type). Advisory and in-memory only, like
+        # Prometheus itself: clients re-send it on a slow clock.
+        self.metadata: dict[bytes, str] = {}
+
+    # prompb MetricMetadata.MetricType enum
+    _PROM_TYPES = (
+        "unknown", "counter", "gauge", "histogram",
+        "gaugehistogram", "summary", "info", "stateset",
+    )
+
+    def record_metadata(self, name: bytes, type_code: int) -> None:
+        t = self._PROM_TYPES[type_code] if 0 <= type_code < len(self._PROM_TYPES) \
+            else "unknown"
+        self.metadata[bytes(name)] = t
 
     async def open(self) -> None:
         async for batch in self._storage.scan(

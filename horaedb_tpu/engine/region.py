@@ -101,9 +101,13 @@ def _subset_request(req: ParsedWriteRequest, series_idx: np.ndarray) -> ParsedWr
         ex_label_name_len=req.ex_label_name_len,
         ex_label_value_off=req.ex_label_value_off,
         ex_label_value_len=req.ex_label_value_len,
-        meta_type=req.meta_type,
-        meta_name_off=req.meta_name_off,
-        meta_name_len=req.meta_name_len,
+        # meta lanes deliberately STRIPPED: RegionedEngine.write_parsed
+        # routes metadata by family name exactly once; letting delegated
+        # engines re-record it would duplicate entries across regions and
+        # let stale copies mask later updates in the metadata() union
+        meta_type=req.meta_type[:0],
+        meta_name_off=req.meta_name_off[:0],
+        meta_name_len=req.meta_name_len[:0],
         series_metric_id=None if req.series_metric_id is None
         else req.series_metric_id[series_idx],
         series_tsid=None if req.series_tsid is None else req.series_tsid[series_idx],
@@ -206,6 +210,11 @@ class RegionedEngine:
         """Split per region on the hash lanes and delegate. Requests whose
         series all route to one region (the common scrape shape) delegate
         without any copying."""
+        # metadata records route by family name (advisory, in-memory)
+        for i in range(len(req.meta_type)):
+            name = req.meta_name(i)
+            self.engines[self.router.region_of_name(name)] \
+                .metric_mgr.record_metadata(name, int(req.meta_type[i]))
         if req.n_series == 0:
             return 0
         if req.series_metric_id is not None:
@@ -223,6 +232,17 @@ class RegionedEngine:
             regions = self.router.regions_of_ids(ids)
         uniq = np.unique(regions)
         if len(uniq) == 1:
+            if len(req.meta_type):
+                # strip meta lanes: recorded above by family routing (see
+                # _subset_request for the same rule on the split path)
+                import dataclasses
+
+                req = dataclasses.replace(
+                    req,
+                    meta_type=req.meta_type[:0],
+                    meta_name_off=req.meta_name_off[:0],
+                    meta_name_len=req.meta_name_len[:0],
+                )
             return await self.engines[int(uniq[0])].write_parsed(req)
         import asyncio
 
@@ -256,6 +276,13 @@ class RegionedEngine:
         for e in self.engines:
             out.extend(e.metric_names())
         return sorted(set(out))
+
+    def metadata(self) -> "dict[bytes, str]":
+        """Fan-out union of per-region metric-family metadata."""
+        out: dict[bytes, str] = {}
+        for e in self.engines:
+            out.update(e.metadata())
+        return out
 
     async def compact(self) -> None:
         import asyncio

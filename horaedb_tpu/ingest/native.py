@@ -263,7 +263,11 @@ class NativeParser:
             ex_label_name_len=_as_np(res.ex_label_name_len, nexl, np.int64),
             ex_label_value_off=_as_np(res.ex_label_value_off, nexl, np.int64),
             ex_label_value_len=_as_np(res.ex_label_value_len, nexl, np.int64),
-            meta_type=empty64, meta_name_off=empty64, meta_name_len=empty64,
+            # metadata records are rare (clients send them on a slow clock,
+            # usually in dedicated payloads): copy only when present
+            meta_type=_as_np(res.meta_type, res.n_metadata, np.int64),
+            meta_name_off=_as_np(res.meta_name_off, res.n_metadata, np.int64),
+            meta_name_len=_as_np(res.meta_name_len, res.n_metadata, np.int64),
             series_metric_id=mid,
             series_tsid=tsid,
             series_name_len=nlen,

@@ -334,6 +334,20 @@ async def handle_labels(request: web.Request) -> web.Response:
     return web.json_response({"values": [v.decode(errors="replace") for v in vals]})
 
 
+async def handle_metadata(request: web.Request) -> web.Response:
+    """Prometheus-shaped /api/v1/metadata: metric family -> [{"type": t}],
+    from remote-write METADATA records (advisory, in-memory)."""
+    state: ServerState = request.app[STATE_KEY]
+    meta = state.engine.metadata()
+    return web.json_response({
+        "status": "success",
+        "data": {
+            name.decode(errors="replace"): [{"type": t}]
+            for name, t in sorted(meta.items())
+        },
+    })
+
+
 # ---------------------------------------------------------------------------
 # self-write load generator (main.rs:187-233)
 # ---------------------------------------------------------------------------
@@ -454,6 +468,7 @@ async def build_app(config: Config) -> web.Application:
             web.get("/api/v1/labels", handle_labels),
             web.get("/api/v1/metrics", handle_metrics_list),
             web.get("/api/v1/series", handle_series),
+            web.get("/api/v1/metadata", handle_metadata),
         ]
     )
 

@@ -168,3 +168,37 @@ class TestRegionDescriptor:
             segment_duration_ms=HOUR, enable_compaction=False,
         )
         await eng2.close()
+
+
+@async_test
+async def test_regioned_metadata_routes_by_family_and_updates():
+    """Metadata records route to exactly ONE region (by family name), so a
+    later type update is never masked by a stale copy in another region."""
+    from horaedb_tpu.engine.region import RegionedEngine
+    from horaedb_tpu.objstore import MemStore
+    from horaedb_tpu.pb import remote_write_pb2
+
+    store = MemStore()
+    eng = await RegionedEngine.open("db", store, num_regions=4,
+                                    enable_compaction=False)
+
+    def meta_payload(t: int) -> bytes:
+        req = remote_write_pb2.WriteRequest()
+        md = req.metadata.add()
+        md.type = t
+        md.metric_family_name = b"fam_x"
+        # plus a series routed by ITS OWN name (may differ from fam_x's
+        # region) so the mixed payload exercises the delegation path
+        ts = req.timeseries.add()
+        for k, v in ((b"__name__", b"other_metric"), (b"host", b"a")):
+            lab = ts.labels.add(); lab.name = k; lab.value = v
+        s = ts.samples.add(); s.timestamp = 1000; s.value = 1.0
+        return req.SerializeToString()
+
+    await eng.write_payload(meta_payload(1))  # counter
+    assert eng.metadata()[b"fam_x"] == "counter"
+    owners = [i for i, e in enumerate(eng.engines) if b"fam_x" in e.metric_mgr.metadata]
+    assert len(owners) == 1, f"metadata duplicated across regions: {owners}"
+    await eng.write_payload(meta_payload(2))  # update -> gauge
+    assert eng.metadata()[b"fam_x"] == "gauge"
+    await eng.close()

@@ -332,3 +332,41 @@ class TestGetQuery:
             assert r.status == 200 and body["rows"] == 1
         finally:
             await client.close()
+
+
+class TestMetadata:
+    @async_test
+    async def test_metadata_roundtrip(self, tmp_path):
+        """Remote-write METADATA records surface at /api/v1/metadata
+        (Prometheus response shape; advisory, in-memory)."""
+        from horaedb_tpu.pb import remote_write_pb2
+
+        client = await make_client(tmp_path)
+        try:
+            req = remote_write_pb2.WriteRequest()
+            for name, t in ((b"cpu_seconds_total", 1), (b"mem_bytes", 2)):
+                md = req.metadata.add()
+                md.type = t
+                md.metric_family_name = name
+            # metadata-only payload (no series): must still be recorded
+            r = await client.post("/api/v1/write", data=req.SerializeToString())
+            assert r.status == 200
+
+            r = await client.get("/api/v1/metadata")
+            assert r.status == 200
+            body = await r.json()
+            assert body["status"] == "success"
+            assert body["data"]["cpu_seconds_total"] == [{"type": "counter"}]
+            assert body["data"]["mem_bytes"] == [{"type": "gauge"}]
+
+            # out-of-range enum values clamp to "unknown"
+            req2 = remote_write_pb2.WriteRequest()
+            md = req2.metadata.add()
+            md.type = 99
+            md.metric_family_name = b"mystery"
+            r = await client.post("/api/v1/write", data=req2.SerializeToString())
+            assert r.status == 200
+            body = await (await client.get("/api/v1/metadata")).json()
+            assert body["data"]["mystery"] == [{"type": "unknown"}]
+        finally:
+            await client.close()
