@@ -20,6 +20,7 @@ plus the ingest/query endpoints the reference defines but never wired
     GET  /api/v1/labels    label values via the inverted index
     GET  /api/v1/metrics   metric-name listing
     GET  /api/v1/series    per-metric series listing
+    GET  /api/v1/metadata  metric-family metadata (Prometheus shape)
 
 Run: python -m horaedb_tpu.server.main --config docs/example.toml
 """
