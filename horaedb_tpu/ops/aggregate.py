@@ -28,6 +28,40 @@ def _masked_index(index: jax.Array, valid: jax.Array, num_segments: int) -> jax.
     return jnp.where(valid, index, num_segments).astype(jnp.int32)
 
 
+def masked_cell_keys(series_idx, bucket, ok, num_series: int, num_buckets: int):
+    """Cell-id construction shared by every downsample path: returns
+    (safe, flat) where `safe` keeps masked rows at an IN-RANGE clipped id
+    (their contribution rides the weight column) and `flat` routes them to
+    the num_cells sentinel (scatter drop semantics, for min/max).
+
+    Masked rows must NOT get sentinel keys on the sum/count path: sentinel
+    interleaving breaks the sorted runs the block compaction exploits and
+    trips its adaptive scatter fallback whenever a predicate is active.
+    Both the sid and the bucket are clipped BEFORE forming the flat id —
+    an out-of-window ts would otherwise spill into the neighbouring
+    series' id range and destroy monotonicity. With the clip, keys stay
+    monotone in (sid, ts) for any series-slice/time-window masking."""
+    safe = jnp.clip(series_idx.astype(jnp.int32), 0, num_series - 1) \
+        * num_buckets + jnp.clip(bucket, 0, num_buckets - 1)
+    flat = jnp.where(ok, safe, num_series * num_buckets)
+    return safe, flat
+
+
+def masked_minmax(values, idx, valid, num_segments: int):
+    """min/max per segment with sentinel-index drop semantics (`idx` must
+    route invalid rows to num_segments; invalid values fill +/-inf). The
+    one helper behind every aggregation path's min/max: order statistics
+    cannot ride the compaction's weight column (no identity weight exists),
+    so they always scatter on sentinel keys."""
+    mn = jax.ops.segment_min(
+        jnp.where(valid, values, jnp.inf), idx, num_segments + 1
+    )[:-1]
+    mx = jax.ops.segment_max(
+        jnp.where(valid, values, -jnp.inf), idx, num_segments + 1
+    )[:-1]
+    return mn, mx
+
+
 def masked_segment_stats(
     values: jax.Array,
     idx: jax.Array,
@@ -47,8 +81,7 @@ def masked_segment_stats(
     c = jax.ops.segment_sum(valid.astype(values.dtype), idx, num_segments + 1)[:-1]
     if not with_minmax:
         return s, c, None, None
-    mn = jax.ops.segment_min(jnp.where(valid, values, jnp.inf), idx, num_segments + 1)[:-1]
-    mx = jax.ops.segment_max(jnp.where(valid, values, -jnp.inf), idx, num_segments + 1)[:-1]
+    mn, mx = masked_minmax(values, idx, valid, num_segments)
     return s, c, mn, mx
 
 
@@ -62,9 +95,27 @@ def grouped_stats(
     """sum / count / min / max / mean per segment, one fused pass.
 
     Empty segments report count 0, sum 0, min +inf, max -inf, mean NaN.
+    Out-of-range indices are DROPPED regardless of `valid` (scatter
+    out-of-bounds drop semantics, the pre-dispatch contract). sum/count go
+    through the unsorted strategy dispatcher (device-sort + block compaction
+    on accelerators when the grid is f32-exact, scatter on CPU); min/max
+    always scatter (order statistics have no compaction identity).
     """
+    from horaedb_tpu.ops.pallas_kernels import _F32_EXACT, segment_sum_count
+
+    # the dispatcher's sort path clips indices into range, so out-of-range
+    # rows must be folded into the mask here to keep the drop semantics;
+    # integer values keep the exact dtype-preserving scatter (the block
+    # compaction accumulates f32, which would round int sums above 2^24)
+    valid = valid & (index >= 0) & (index < num_segments)
     idx = _masked_index(index, valid, num_segments)
-    s, c, mn, mx = masked_segment_stats(values, idx, valid, num_segments)
+    if num_segments < _F32_EXACT and jnp.issubdtype(
+        jnp.asarray(values).dtype, jnp.floating
+    ):
+        s, c = segment_sum_count(idx, jnp.where(valid, values, 0), num_segments)
+        mn, mx = masked_minmax(values, idx, valid, num_segments)
+    else:
+        s, c, mn, mx = masked_segment_stats(values, idx, valid, num_segments)
     return {"sum": s, "count": c, "min": mn, "max": mx, "mean": s / c}
 
 
@@ -82,20 +133,29 @@ def downsample_sorted(
     num_series: int,
     num_buckets: int,
     with_minmax: bool = True,
+    valid=None,
 ) -> dict:
     """Downsample over rows SORTED by (series, ts) — the engine's natural
     scan-output order (pk = ids + timestamp), which makes the flat cell index
     monotone. sum/count dispatch to the Pallas sorted-segment kernel
     (ops/pallas_kernels.py; MXU one-hot matmuls instead of a scatter, with
     an automatic XLA fallback); min/max, when requested, still scatter.
+
+    `valid` (optional bool) excludes rows (predicate / set-membership miss)
+    WITHOUT breaking the sorted runs: excluded rows must keep a monotone
+    series_idx (e.g. the searchsorted position, not -1) and are zeroed via
+    the compaction's weight column.
     """
     from horaedb_tpu.ops.pallas_kernels import _F32_EXACT, sorted_segment_sum_count
 
     num_cells = num_series * num_buckets
     if num_cells >= _F32_EXACT:
         # grid too large for exact f32 cell-id recovery; use the scatter path
-        valid = jnp.ones(jnp.asarray(values).shape[0], dtype=bool)
-        out = downsample(ts, series_idx, values, valid, t0, bucket_ms,
+        v_mask = (
+            jnp.ones(jnp.asarray(values).shape[0], dtype=bool)
+            if valid is None else jnp.asarray(valid)
+        )
+        out = downsample(ts, series_idx, values, v_mask, t0, bucket_ms,
                          num_series=num_series, num_buckets=num_buckets)
         if not with_minmax:
             out = {k: out[k] for k in ("sum", "count", "mean")}
@@ -108,8 +168,13 @@ def downsample_sorted(
         (bucket >= 0) & (bucket < num_buckets)
         & (series_idx >= 0) & (series_idx < num_series)
     )
-    flat = jnp.where(ok, series_idx.astype(jnp.int32) * num_buckets + bucket, num_cells)
-    s, c = sorted_segment_sum_count(flat, jnp.where(ok, values, 0.0), num_cells)
+    if valid is not None:
+        ok = ok & jnp.asarray(valid)
+    safe, flat = masked_cell_keys(series_idx, bucket, ok, num_series, num_buckets)
+    s, c = sorted_segment_sum_count(
+        safe, jnp.where(ok, values, 0.0), num_cells,
+        weights=ok.astype(values.dtype),
+    )
     shape = (num_series, num_buckets)
     out = {
         "sum": s.reshape(shape),
@@ -117,43 +182,44 @@ def downsample_sorted(
         "mean": (s / c).reshape(shape),
     }
     if with_minmax:
-        mn = jax.ops.segment_min(
-            jnp.where(ok, values, jnp.inf), flat, num_cells + 1
-        )[:-1]
-        mx = jax.ops.segment_max(
-            jnp.where(ok, values, -jnp.inf), flat, num_cells + 1
-        )[:-1]
+        mn, mx = masked_minmax(values, flat, ok, num_cells)
         out["min"] = mn.reshape(shape)
         out["max"] = mx.reshape(shape)
     return out
 
 
 @partial(jax.jit, static_argnames=("num_cells", "lanes"))
-def lane_segment_sum_count(k, v, num_cells: int, lanes: int = 8):
+def lane_segment_sum_count(k, v, num_cells: int, lanes: int = 8, w=None):
     """Experimental lane-parallel scatter: rows reshape to [lanes, n/lanes]
     and each lane scatter-adds into its OWN partial grid (vmap batches the
     scatters), then the lanes tree-reduce. If XLA vectorizes the batched
     scatter across lanes, this trades lanes x grid memory for lanes-fold
     scatter parallelism — an A/B candidate against the block compaction on
     real hardware (queued from round-1 profiling). Works for unsorted input.
+    `w` (optional) is each row's count contribution (predicate weights).
     """
     n = k.shape[0]
     m = n - n % lanes
     k2 = jnp.clip(k[:m], 0, num_cells).astype(jnp.int32).reshape(lanes, -1)
     v2 = v[:m].astype(jnp.float32).reshape(lanes, -1)
+    w2 = (
+        jnp.ones_like(v2) if w is None
+        else w[:m].astype(jnp.float32).reshape(lanes, -1)
+    )
 
-    def one(kl, vl):
+    def one(kl, vl, wl):
         s = jax.ops.segment_sum(vl, kl, num_cells + 1)[:-1]
-        c = jax.ops.segment_sum(jnp.ones_like(vl), kl, num_cells + 1)[:-1]
+        c = jax.ops.segment_sum(wl, kl, num_cells + 1)[:-1]
         return s, c
 
-    s, c = jax.vmap(one)(k2, v2)
+    s, c = jax.vmap(one)(k2, v2, w2)
     s, c = s.sum(axis=0), c.sum(axis=0)
     if m < n:
         kt = jnp.clip(k[m:], 0, num_cells).astype(jnp.int32)
         vt = v[m:].astype(jnp.float32)
+        wt = jnp.ones_like(vt) if w is None else w[m:].astype(jnp.float32)
         s = s + jax.ops.segment_sum(vt, kt, num_cells + 1)[:-1]
-        c = c + jax.ops.segment_sum(jnp.ones_like(vt), kt, num_cells + 1)[:-1]
+        c = c + jax.ops.segment_sum(wt, kt, num_cells + 1)[:-1]
     return s, c
 
 

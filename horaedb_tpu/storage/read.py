@@ -834,32 +834,38 @@ class ParquetReader:
             grids["min"] = np.full((num_series, num_buckets), np.inf)
             grids["max"] = np.full((num_series, num_buckets), -np.inf)
 
-        def dense_sid(col: np.ndarray) -> np.ndarray:
+        def dense_sid(col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+            """(dense position, hit mask). Misses keep their MONOTONE
+            searchsorted position (not -1): the sorted-segment compaction
+            needs monotone keys, and misses are excluded via the reduction's
+            weight column instead of a key sentinel."""
             pos = np.searchsorted(series_ids, col)
             pos_c = np.clip(pos, 0, max(0, len(series_ids) - 1))
             hit = series_ids[pos_c] == col
-            return np.where(hit, pos_c, -1).astype(np.int32)
+            return pos_c.astype(np.int32), hit
 
         from horaedb_tpu.parallel.mesh import active_mesh
 
         mesh = active_mesh()
 
-        def accumulate_sorted(ts_np, sid_np, val_np):
+        def accumulate_sorted(ts_np, sid_np, val_np, valid_np=None):
             """Fold one sorted run into the grids (sorted-segment fast path).
             With an ambient multi-device mesh installed, rows shard over
             "rows" and the output grid over "series" (SURVEY §2.5's
             shard_map-over-SST-partitions); partials combine via psum/pmin/
-            pmax over ICI. Single device: the local sorted kernel."""
+            pmax over ICI. Single device: the local sorted kernel.
+            `valid_np` excludes rows via the reduction's weight column
+            (sid_np must stay monotone for excluded rows too)."""
             if mesh is not None:
                 out = self._sharded_accumulate(
                     mesh, ts_np, sid_np, val_np, t0, bucket_ms,
-                    num_series, num_buckets, with_minmax,
+                    num_series, num_buckets, with_minmax, valid_np=valid_np,
                 )
             else:
                 out = agg_ops.downsample_sorted(
                     ts_np, sid_np, val_np, t0, bucket_ms,
                     num_series=num_series, num_buckets=num_buckets,
-                    with_minmax=with_minmax,
+                    with_minmax=with_minmax, valid=valid_np,
                 )
             grids["sum"] += np.asarray(out["sum"])
             grids["count"] += np.asarray(out["count"])
@@ -875,10 +881,12 @@ class ParquetReader:
                 ssts, predicate, None, False, batch_size=self._scan_block_rows
             )
             for b in batches:
+                sp, hit = dense_sid(arrow_column_to_numpy(b.column(series_column)))
                 accumulate_sorted(
                     arrow_column_to_numpy(b.column(ts_column)),
-                    dense_sid(arrow_column_to_numpy(b.column(series_column))),
+                    sp,
                     arrow_column_to_numpy(b.column(value_column)),
+                    valid_np=hit if not hit.all() else None,
                 )
             return grids
 
@@ -895,11 +903,13 @@ class ParquetReader:
             tuple(self._schema.primary_key_names) + (SEQ_COLUMN_NAME,),
         )
         table = pa.concat_tables(tables).combine_chunks()
-        sid = dense_sid(arrow_column_to_numpy(table.column(series_column).combine_chunks()))
+        sid, sid_hit = dense_sid(
+            arrow_column_to_numpy(table.column(series_column).combine_chunks())
+        )
 
         fast = (
-            self._packed_downsample_pass(table, predicate, sid, ts_column,
-                                         value_column, num_series)
+            self._packed_downsample_pass(table, predicate, sid, sid_hit,
+                                         ts_column, value_column, num_series)
             if packed_ok else None
         )
         if fast is not None:
@@ -908,25 +918,34 @@ class ParquetReader:
                 accumulate_sorted(ts_s, sid_s, val_s)
             return grids
 
+        # the hit mask rides the fused pass's permutation as an int lane so
+        # set-membership misses stay excludable after the device sort; the
+        # lane is skipped on the common all-hit query (no series subset)
+        extra = {"__sid__": sid}
+        all_hit = bool(sid_hit.all())
+        if not all_hit:
+            extra["__sidok__"] = sid_hit.astype(np.int32)
         sorted_cols, _perm, keep, _starts, _kept, _num, _bin = self._fused_pass(
-            table, predicate, extra_arrays={"__sid__": sid}
+            table, predicate, extra_arrays=extra
         )
+        row_ok = keep if all_hit else keep & (sorted_cols["__sidok__"] != 0)
         if mesh is not None:
             # mesh path: the merged/deduped rows leave the fused pass and
-            # shard over the mesh for the reduction
-            keep_np = np.asarray(keep)
+            # shard over the mesh for the reduction; misses keep their
+            # monotone position and are zeroed via the weight column
             accumulate_sorted(
                 np.asarray(sorted_cols[ts_column]).astype(np.int64),
-                np.where(keep_np, np.asarray(sorted_cols["__sid__"]), -1).astype(np.int32),
+                np.asarray(sorted_cols["__sid__"]).astype(np.int32),
                 np.asarray(sorted_cols[value_column]),
+                valid_np=np.asarray(row_ok),
             )
             return grids
-        # device-side reduction of the surviving rows (keep is a mask)
+        # device-side reduction of the surviving rows (row_ok is a mask)
         out = agg_ops.downsample(
             sorted_cols[ts_column].astype(jnp.int64),
             sorted_cols["__sid__"],
             sorted_cols[value_column],
-            keep & (sorted_cols["__sid__"] >= 0),
+            row_ok,
             t0,
             bucket_ms,
             num_series=num_series,
@@ -943,7 +962,7 @@ class ParquetReader:
     _PACK_SEQ_BITS = 12  # distinct write sequences per segment
 
     def _packed_downsample_pass(
-        self, table, predicate, sid, ts_column, value_column, num_series
+        self, table, predicate, sid, sid_valid, ts_column, value_column, num_series
     ):
         """Single-key replacement for the fused kernel's 6-lane lexsort on
         the downsample pushdown path: (dense sid, ts, seq-rank) pack into
@@ -985,7 +1004,7 @@ class ParquetReader:
         span = int(ts_np.max()) - ts_min
         if span >= (1 << self._PACK_TS_BITS):
             return None
-        mask = (sid >= 0)
+        mask = sid_valid.copy()
         if predicate is not None:
             mask = mask & filter_ops.eval_predicate_host(predicate, table)
         srank = (
@@ -1024,10 +1043,13 @@ class ParquetReader:
     def _sharded_accumulate(
         mesh, ts_np, sid_np, val_np, t0, bucket_ms,
         num_series: int, num_buckets: int, with_minmax: bool,
+        valid_np=None,
     ) -> dict:
         """One sorted run reduced over the ambient mesh: rows shard over
         "rows" (psum/pmin/pmax combine the partial grids over ICI), the
-        output grid shards over "series" (padded up to the axis size)."""
+        output grid shards over "series" (padded up to the axis size).
+        `valid_np` excludes rows (set-membership misses) via the kernel's
+        weight column — their sid must stay monotone."""
         from horaedb_tpu.parallel.scan import shard_rows, sharded_downsample
 
         series_par = mesh.shape["series"]
@@ -1038,17 +1060,24 @@ class ParquetReader:
         # aggregation exactly (advisor round-1, pallas_kernels precision).
         accel = mesh.devices.flat[0].platform not in ("cpu",)
         val_dtype = np.float32 if accel else np.float64
-        (ts_d, sid_d, val_d), valid = shard_rows(
+        row_ok = (
+            np.ones(len(ts_np), dtype=bool) if valid_np is None
+            else np.ascontiguousarray(valid_np, dtype=bool)
+        )
+        (ts_d, sid_d, val_d, ok_d), _pad_valid = shard_rows(
             mesh,
             (
                 np.ascontiguousarray(ts_np, dtype=np.int64),
                 np.ascontiguousarray(sid_np, dtype=np.int32),
                 np.ascontiguousarray(val_np, dtype=val_dtype),
+                row_ok,
             ),
             pad_value=0,
         )
+        # pad rows carry ok=False (pad_value 0 on the bool lane), so ok_d
+        # alone is the full validity mask
         out = sharded_downsample(
-            mesh, ts_d, sid_d, val_d, valid,
+            mesh, ts_d, sid_d, val_d, ok_d,
             t0=t0, bucket_ms=bucket_ms,
             num_series=padded_series, num_buckets=num_buckets,
             with_minmax=with_minmax, sorted_input=True,

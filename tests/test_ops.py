@@ -266,6 +266,18 @@ class TestAggregate:
                 assert np.isclose(float(out["max"][gi]), sel.max())
                 assert np.isclose(float(out["mean"][gi]), sel.mean())
 
+    def test_grouped_stats_out_of_range_dropped(self):
+        """Out-of-range indices are dropped even when marked valid (the
+        pre-dispatch scatter-OOB contract) — and ALL stats agree on it."""
+        vals = np.array([10.0, 20.0, 30.0, 40.0])
+        idx = np.array([-1, 0, 1, 2], dtype=np.int32)  # -1 and 2 OOB for g=2
+        valid = np.ones(4, dtype=bool)
+        out = aggregate.grouped_stats(vals, idx, valid, 2)
+        np.testing.assert_allclose(np.asarray(out["sum"]), [20.0, 30.0])
+        np.testing.assert_allclose(np.asarray(out["count"]), [1.0, 1.0])
+        np.testing.assert_allclose(np.asarray(out["min"]), [20.0, 30.0])
+        np.testing.assert_allclose(np.asarray(out["max"]), [20.0, 30.0])
+
     def test_downsample_oracle(self):
         rng = np.random.default_rng(6)
         n, num_series, num_buckets = 2000, 4, 10

@@ -114,6 +114,107 @@ class TestSortedSegmentSumCount:
         np.testing.assert_allclose(np.asarray(s), es, rtol=1e-4)
 
 
+class TestWeightedReduction:
+    """Predicate masks ride the weight column: masked rows keep their TRUE
+    sorted cell id (no sentinel interleaving) and contribute (0, 0)."""
+
+    @pytest.mark.parametrize("impl", ("scatter", "block", "pallas", "lanes"))
+    def test_weighted_matches_filtered_oracle(self, impl):
+        rng = np.random.default_rng(11)
+        n, cells = 60_000, 3_000
+        k = np.sort(rng.integers(0, cells, n).astype(np.int32))
+        v = rng.normal(size=n).astype(np.float32)
+        keep = v > -0.5  # ~70% survive, masked rows interleave everywhere
+        s, c = sorted_segment_sum_count(
+            k, np.where(keep, v, 0.0).astype(np.float32), cells, impl=impl,
+            weights=keep.astype(np.float32),
+        )
+        es, ec = oracle(k[keep], v[keep], cells)
+        np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
+        np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-3)
+
+    def test_weighted_stays_compactable(self):
+        """The point of weights: interleaved masking must NOT push the
+        stream over the distinct-cells budget (sentinel keys would)."""
+        rng = np.random.default_rng(12)
+        n, cells = 40_000, 2_000  # ~20 rows/cell
+        k = np.sort(rng.integers(0, cells, n).astype(np.int32))
+        assert distinct_cells_per_block_max(k) <= 64  # fast path eligible
+        # with sentinels every other row, distinct count would explode:
+        sent = np.where(np.arange(n) % 2 == 0, k, cells).astype(np.int32)
+        assert distinct_cells_per_block_max(sent) > 64
+
+    def test_weighted_under_jit(self):
+        import jax
+
+        rng = np.random.default_rng(13)
+        n, cells = 30_000, 1_500
+        k = np.sort(rng.integers(0, cells, n).astype(np.int32))
+        v = rng.normal(size=n).astype(np.float32)
+        keep = (v < 1.0).astype(np.float32)
+
+        f = jax.jit(
+            lambda kk, vv, ww: sorted_segment_sum_count(
+                kk, vv * ww, cells, impl="block", weights=ww
+            )
+        )
+        s, c = f(k, v, keep)
+        mask = keep.astype(bool)
+        es, ec = oracle(k[mask], v[mask], cells)
+        np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
+        np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-3)
+
+
+class TestUnsortedSegmentSumCount:
+    """The UNSORTED dispatcher: scatter vs device-sort + block compaction."""
+
+    @pytest.mark.parametrize("u_impl", ("scatter", "sort", "auto"))
+    def test_unsorted_matches_oracle(self, u_impl):
+        from horaedb_tpu.ops.pallas_kernels import segment_sum_count
+
+        rng = np.random.default_rng(7)
+        n, cells = 60_000, 3_000
+        k = rng.integers(0, cells, n).astype(np.int32)  # NOT sorted
+        v = rng.normal(size=n).astype(np.float32)
+        s, c = segment_sum_count(k, v, cells, impl=u_impl)
+        es, ec = oracle(k, v, cells)
+        np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
+        np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.parametrize("u_impl", ("scatter", "sort"))
+    def test_unsorted_sentinels_dropped(self, u_impl):
+        from horaedb_tpu.ops.pallas_kernels import segment_sum_count
+
+        rng = np.random.default_rng(8)
+        n, cells = 20_000, 500
+        k = rng.integers(0, cells, n).astype(np.int32)
+        v = np.ones(n, dtype=np.float32)
+        # invalid rows: id == cells, values pre-masked to 0 (the scan
+        # kernel's contract)
+        k2 = np.concatenate([k, np.full(777, cells, dtype=np.int32)])
+        v2 = np.concatenate([v, np.zeros(777, dtype=np.float32)])
+        perm = rng.permutation(len(k2))
+        s, c = segment_sum_count(k2[perm], v2[perm], cells, impl=u_impl)
+        assert float(np.asarray(c).sum()) == n
+        assert float(np.asarray(s).sum()) == pytest.approx(n)
+
+    def test_unsorted_under_jit_and_env(self, monkeypatch):
+        import jax
+
+        from horaedb_tpu.ops.pallas_kernels import segment_sum_count
+
+        monkeypatch.setenv("HORAEDB_UNSORTED_IMPL", "sort")
+        rng = np.random.default_rng(9)
+        n, cells = 30_000, 1_000
+        k = rng.integers(0, cells, n).astype(np.int32)
+        v = rng.normal(size=n).astype(np.float32)
+        f = jax.jit(lambda kk, vv: segment_sum_count(kk, vv, cells))
+        s, c = f(k, v)
+        es, ec = oracle(k, v, cells)
+        np.testing.assert_array_equal(np.asarray(c).astype(np.int64), ec)
+        np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-3)
+
+
 @pytest.mark.skipif(
     os.environ.get("HORAEDB_TPU_TESTS", "0") != "1",
     reason="real-TPU mosaic test (set HORAEDB_TPU_TESTS=1 on hardware with local libtpu)",
