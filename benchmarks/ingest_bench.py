@@ -66,7 +66,7 @@ def main() -> None:
 
     async def run() -> dict:
         store = LocalStore(tempfile.mkdtemp(prefix="ingest_"))
-        buffer_rows = int(os.environ.get("INGEST_BUFFER_ROWS", str(256 * 1024)))
+        buffer_rows = int(os.environ.get("INGEST_BUFFER_ROWS", str(512 * 1024)))
         eng = await MetricEngine.open(
             "db", store, enable_compaction=False, ingest_buffer_rows=buffer_rows
         )

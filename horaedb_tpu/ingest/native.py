@@ -190,13 +190,19 @@ _EMPTY_I64 = np.empty(0, np.int64)
 _EMPTY_F64 = np.empty(0, np.float64)
 
 
+_EMPTY = {np.dtype(np.int64): _EMPTY_I64, np.dtype(np.float64): _EMPTY_F64,
+          np.dtype(np.uint64): np.empty(0, np.uint64)}
+
+
 def _as_np(ptr, n: int, dtype) -> np.ndarray:
     """Copy an arena lane out into a standalone numpy array (the arena is
     reused by the next parse on the same handle). string_at is one C memcpy;
-    frombuffer wraps it zero-copy (readonly, which downstream respects)."""
-    if n == 0:
-        return np.empty(0, dtype=dtype)
+    frombuffer wraps it zero-copy (readonly, which downstream respects).
+    Empty lanes share module-level immutables — parse_light returns ~12 of
+    them per call on the hot path."""
     dt = np.dtype(dtype)
+    if n == 0:
+        return _EMPTY.get(dt) if dt in _EMPTY else np.empty(0, dtype=dt)
     return np.frombuffer(ctypes.string_at(ptr, n * dt.itemsize), dtype=dt)
 
 
@@ -209,6 +215,11 @@ class NativeParser:
             raise HoraeError("native remote-write parser unavailable")
         self._lib = lib
         self._h = lib.rw_parser_new()
+        # Reused per-handle result structs: C overwrites them on every
+        # parse, which matches the borrow discipline — a request from this
+        # handle is only valid until the handle's next parse anyway.
+        self._res = _RwResult()
+        self._hres = _RwHashResult()
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -224,8 +235,8 @@ class NativeParser:
         exemplars when present); name/key bytes resolve LAZILY through the
         held arena pointers, so the returned request is only valid while the
         parser stays borrowed and unreused."""
-        res = _RwResult()
-        hres = _RwHashResult()
+        res = self._res
+        hres = self._hres
         rc = self._lib.rw_parse_hashed(
             self._h, payload, len(payload), ctypes.byref(res), ctypes.byref(hres)
         )

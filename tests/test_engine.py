@@ -414,6 +414,39 @@ class TestFastSlowPathEquivalence:
         assert outs[0] == outs[1]
 
     @async_test
+    async def test_lane_fingerprint_cache_still_registers_new_series(self):
+        """The steady-state payload-shape fingerprint must only short-cut
+        EXACTLY repeated (metric_id, tsid) lanes: a later payload adding a
+        new series has different lane bytes and must register it."""
+        from horaedb_tpu.ingest import native as native_mod
+
+        if native_mod.load() is None:
+            pytest.skip("native parser not available")
+        base = self.PAYLOAD
+        extended = base + [({"__name__": "cpu", "host": "NEW"}, [(3000, 7.0)])]
+        store = MemStore()
+        eng = await MetricEngine.open(
+            "db", store, segment_duration_ms=HOUR,
+            enable_compaction=False, ingest_buffer_rows=10_000,
+        )
+        parser = native_mod.NativeParser()
+        # same payload three times: second+third hit the fingerprint cache
+        p1 = make_remote_write(base)
+        for _ in range(3):
+            await eng.write_parsed(parser.parse(p1))
+        assert len(eng._lanes_fp) == 1
+        await eng.write_parsed(parser.parse(make_remote_write(extended)))
+        assert len(eng._lanes_fp) == 2
+        hosts = {s.get("host") for s in eng.series(b"cpu")}
+        assert "NEW" in hosts and "a" in hosts and "b" in hosts
+        t = await eng.query(
+            QueryRequest(metric=b"cpu", start_ms=0, end_ms=10_000,
+                         filters=[(b"host", b"NEW")])
+        )
+        assert t.column("value").to_pylist() == [7.0]
+        await eng.close()
+
+    @async_test
     async def test_missing_name_rejected_on_both_paths(self):
         from horaedb_tpu.common.error import HoraeError
         from horaedb_tpu.ingest import native as native_mod
