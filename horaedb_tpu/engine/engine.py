@@ -9,6 +9,7 @@ scan with device predicate -> device aggregation.
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -222,8 +223,6 @@ class MetricEngine:
         # steady-state fast path: the exact lane bytes were seen (and their
         # series durably registered) before — one set probe, no per-series
         # Python work
-        import hashlib
-
         h = hashlib.blake2b(metric_arr.tobytes(), digest_size=16)
         h.update(tsid_arr.tobytes())
         fp = h.digest()

@@ -48,11 +48,11 @@ def masked_cell_keys(series_idx, bucket, ok, num_series: int, num_buckets: int):
 
 
 def masked_minmax(values, idx, valid, num_segments: int):
-    """min/max per segment with sentinel-index drop semantics (`idx` must
-    route invalid rows to num_segments; invalid values fill +/-inf). The
-    one helper behind every aggregation path's min/max: order statistics
-    cannot ride the compaction's weight column (no identity weight exists),
-    so they always scatter on sentinel keys."""
+    """Scatter-based min/max per segment with sentinel-index drop semantics
+    (`idx` must route invalid rows to num_segments; invalid values fill
+    +/-inf). The SCATTER-path helper: compaction-eligible paths use
+    pallas_kernels.sorted_segment_min_max (masked-reduce block compaction)
+    instead."""
     mn = jax.ops.segment_min(
         jnp.where(valid, values, jnp.inf), idx, num_segments + 1
     )[:-1]
@@ -96,12 +96,19 @@ def grouped_stats(
 
     Empty segments report count 0, sum 0, min +inf, max -inf, mean NaN.
     Out-of-range indices are DROPPED regardless of `valid` (scatter
-    out-of-bounds drop semantics, the pre-dispatch contract). sum/count go
-    through the unsorted strategy dispatcher (device-sort + block compaction
-    on accelerators when the grid is f32-exact, scatter on CPU); min/max
-    always scatter (order statistics have no compaction identity).
+    out-of-bounds drop semantics, the pre-dispatch contract). On the
+    accelerator sort path ONE device sort feeds all four stats: sum/count
+    via the block-rank compaction, min/max via the masked-reduce
+    compaction. Otherwise (CPU, sparse grids, non-f32) everything
+    scatters, dtype-preserving.
     """
-    from horaedb_tpu.ops.pallas_kernels import _F32_EXACT, segment_sum_count
+    from horaedb_tpu.ops.pallas_kernels import (
+        _F32_EXACT,
+        segment_sum_count,
+        sorted_segment_min_max,
+        sorted_segment_sum_count,
+        unsorted_strategy,
+    )
 
     # the dispatcher's sort path clips indices into range, so out-of-range
     # rows must be folded into the mask here to keep the drop semantics;
@@ -109,11 +116,18 @@ def grouped_stats(
     # compaction accumulates f32, which would round int sums above 2^24)
     valid = valid & (index >= 0) & (index < num_segments)
     idx = _masked_index(index, valid, num_segments)
-    if num_segments < _F32_EXACT and jnp.issubdtype(
-        jnp.asarray(values).dtype, jnp.floating
-    ):
-        s, c = segment_sum_count(idx, jnp.where(valid, values, 0), num_segments)
-        mn, mx = masked_minmax(values, idx, valid, num_segments)
+    vals_j = jnp.asarray(values)
+    if num_segments < _F32_EXACT and jnp.issubdtype(vals_j.dtype, jnp.floating):
+        masked = jnp.where(valid, vals_j, 0)
+        if unsorted_strategy(idx.shape[0], num_segments, masked.dtype) == "sort":
+            # one device sort feeds all four stats (sentinels drop at the
+            # tail bucket); min/max use the masked-reduce compaction
+            k2, v2 = jax.lax.sort((idx, masked), num_keys=1)
+            s, c = sorted_segment_sum_count(k2, v2, num_segments, impl="block")
+            mn, mx = sorted_segment_min_max(k2, v2, num_segments, impl="block")
+        else:
+            s, c = segment_sum_count(idx, masked, num_segments, impl="scatter")
+            mn, mx = masked_minmax(values, idx, valid, num_segments)
     else:
         s, c, mn, mx = masked_segment_stats(values, idx, valid, num_segments)
     return {"sum": s, "count": c, "min": mn, "max": mx, "mean": s / c}
@@ -137,9 +151,10 @@ def downsample_sorted(
 ) -> dict:
     """Downsample over rows SORTED by (series, ts) — the engine's natural
     scan-output order (pk = ids + timestamp), which makes the flat cell index
-    monotone. sum/count dispatch to the Pallas sorted-segment kernel
+    monotone. sum/count dispatch to the sorted-segment compaction
     (ops/pallas_kernels.py; MXU one-hot matmuls instead of a scatter, with
-    an automatic XLA fallback); min/max, when requested, still scatter.
+    an automatic XLA fallback); min/max, when requested, use the
+    masked-reduce compaction (sorted_segment_min_max, scatter fallback).
 
     `valid` (optional bool) excludes rows (predicate / set-membership miss)
     WITHOUT breaking the sorted runs: excluded rows must keep a monotone
@@ -182,7 +197,9 @@ def downsample_sorted(
         "mean": (s / c).reshape(shape),
     }
     if with_minmax:
-        mn, mx = masked_minmax(values, flat, ok, num_cells)
+        from horaedb_tpu.ops.pallas_kernels import sorted_segment_min_max
+
+        mn, mx = sorted_segment_min_max(safe, values, num_cells, valid=ok)
         out["min"] = mn.reshape(shape)
         out["max"] = mx.reshape(shape)
     return out

@@ -253,6 +253,142 @@ def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks, w=None):
     return grid_sum, grid_cnt
 
 
+@partial(jax.jit, static_argnames=("num_cells", "block", "ranks"))
+def _block_min_max_xla(k_sorted, v, num_cells, block, ranks, valid=None):
+    """min/max companion of _block_sum_count_xla: per-block rank masking +
+    a fused masked-reduce over the block axis (XLA fuses the where into the
+    reduction — no matmul, no materialized one-hot), then ONE scatter-min/
+    max over nb*ranks partials. Measured 360M rows/s vs 57M for the raw
+    scatter on a v5e chip (100M rows, 1K cells).
+
+    `valid` (optional bool) excludes rows that keep in-range sorted keys
+    (the weights contract of the sum/count path); rows with sentinel keys
+    >= num_cells are dropped via the final scatter either way."""
+    n = k_sorted.shape[0]
+    nb = n // block
+    k2 = k_sorted[: nb * block].reshape(nb, block).astype(jnp.int32)
+    v2 = v[: nb * block].reshape(nb, block).astype(jnp.float32)
+    ones = valid is None
+    ok2 = None if ones else valid[: nb * block].reshape(nb, block)
+    pad = (-nb) % XLA_CHUNK
+    if pad:
+        k2 = jnp.concatenate([k2, jnp.full((pad, block), num_cells, jnp.int32)])
+        v2 = jnp.concatenate([v2, jnp.zeros((pad, block), jnp.float32)])
+        if not ones:
+            ok2 = jnp.concatenate([ok2, jnp.zeros((pad, block), bool)])
+    nsteps = k2.shape[0] // XLA_CHUNK
+    k3 = k2.reshape(nsteps, XLA_CHUNK, block)
+    v3 = v2.reshape(nsteps, XLA_CHUNK, block)
+    ok3 = None if ones else ok2.reshape(nsteps, XLA_CHUNK, block)
+
+    def step(xs):
+        if ones:
+            kk, vv = xs
+            mask_extra = None
+        else:
+            kk, vv, mask_extra = xs
+        prev = jnp.concatenate(
+            [jnp.full((XLA_CHUNK, 1), -1, jnp.int32), kk[:, :-1]], axis=1
+        )
+        boundary = kk != prev
+        rank = jnp.cumsum(boundary.astype(jnp.int32), axis=1) - 1
+        in_rank = rank < ranks
+        oh = (
+            (rank[..., None]
+             == jax.lax.broadcasted_iota(jnp.int32, (XLA_CHUNK, block, ranks), 2))
+            & in_rank[..., None]
+        )
+        ohv = oh if mask_extra is None else oh & mask_extra[..., None]
+        mn = jnp.min(jnp.where(ohv, vv[..., None], jnp.inf), axis=1)
+        mx = jnp.max(jnp.where(ohv, vv[..., None], -jnp.inf), axis=1)
+        # rank -> cell id via a max-reduce over the boundary row (exact int,
+        # no f32 recovery needed); unused ranks yield -1
+        cells = jnp.max(
+            jnp.where(oh & boundary[..., None], kk[..., None], -1), axis=1
+        )
+        return mn, mx, cells
+
+    args = (k3, v3) if ones else (k3, v3, ok3)
+    mn, mx, cells = jax.lax.map(step, args)
+    flat_cells = jnp.where(cells < 0, num_cells, cells).reshape(-1)
+    flat_cells = jnp.minimum(flat_cells, num_cells)
+    g_mn = jax.ops.segment_min(mn.reshape(-1), flat_cells, num_cells + 1)[:-1]
+    g_mx = jax.ops.segment_max(mx.reshape(-1), flat_cells, num_cells + 1)[:-1]
+    if nb * block < n:
+        kt = k_sorted[nb * block:]
+        vt = v[nb * block:].astype(jnp.float32)
+        okt = None if ones else valid[nb * block:]
+        idx = jnp.clip(kt, 0, num_cells).astype(jnp.int32)
+        if okt is not None:
+            idx = jnp.where(okt, idx, num_cells)
+        g_mn = jnp.minimum(
+            g_mn, jax.ops.segment_min(vt, idx, num_cells + 1)[:-1]
+        )
+        g_mx = jnp.maximum(
+            g_mx, jax.ops.segment_max(vt, idx, num_cells + 1)[:-1]
+        )
+    return g_mn, g_mx
+
+
+def _scatter_min_max(k, v, num_cells, valid=None):
+    idx = jnp.clip(k, 0, num_cells).astype(jnp.int32)
+    if valid is not None:
+        idx = jnp.where(valid, idx, num_cells)
+    mn = jax.ops.segment_min(v, idx, num_cells + 1)[:-1]
+    mx = jax.ops.segment_max(v, idx, num_cells + 1)[:-1]
+    return mn, mx
+
+
+def sorted_segment_min_max(
+    k_sorted,
+    v,
+    num_cells: int,
+    block: int = DEFAULT_BLOCK,
+    ranks: int = DEFAULT_RANKS,
+    impl: str | None = None,
+    valid=None,
+):
+    """(min, max) per cell for SORTED cell ids. Same adaptive structure as
+    sorted_segment_sum_count: block-rank compaction (masked reduces, no
+    matmul) with a scatter fallback when any block exceeds the rank budget.
+    `impl` maps 'scatter' to the plain scatter; every other strategy name
+    uses the block compaction (there is no matmul/Pallas variant — the
+    reduce already fuses). Rows excluded via `valid` must keep in-range
+    sorted keys; +/-inf fills mark empty cells.
+
+    Non-f32 floats always take the dtype-preserving scatter: the block
+    path computes in f32, and a lax.cond joining f32/f64 branches would
+    be a trace-time type error anyway."""
+    ensure(num_cells < _F32_EXACT, f"num_cells {num_cells} exceeds f32-exact range")
+    impl = impl or _sorted_impl()
+    interpret = jax.devices()[0].platform == "cpu"
+    if jnp.asarray(v).dtype != jnp.float32:
+        impl = "scatter"
+    if impl == "scatter" or (impl == "auto" and interpret and not _mosaic_enabled()):
+        return _scatter_min_max(k_sorted, v, num_cells, valid=valid)
+
+    def fast(k, vv, ok=None):
+        return _block_min_max_xla(k, vv, num_cells, block, ranks, valid=ok)
+
+    if isinstance(k_sorted, jax.core.Tracer):
+        if valid is None:
+            return jax.lax.cond(
+                _distinct_max(k_sorted, block) > ranks,
+                lambda k, vv: _scatter_min_max(k, vv, num_cells),
+                lambda k, vv: fast(k, vv),
+                k_sorted, v,
+            )
+        return jax.lax.cond(
+            _distinct_max(k_sorted, block) > ranks,
+            lambda k, vv, ok: _scatter_min_max(k, vv, num_cells, valid=ok),
+            fast,
+            k_sorted, v, valid,
+        )
+    if distinct_cells_per_block_max(k_sorted, block) > ranks:
+        return _scatter_min_max(k_sorted, v, num_cells, valid=valid)
+    return fast(k_sorted, v, valid)
+
+
 def _scatter_sum_count(k_sorted, v, num_cells, w=None):
     k = jnp.clip(k_sorted, 0, num_cells).astype(jnp.int32)
     # dtype-preserving: the CPU/XLA fallback accumulates f64 inputs in f64
@@ -273,6 +409,29 @@ def _unsorted_impl() -> str:
     return os.environ.get("HORAEDB_UNSORTED_IMPL", "auto")
 
 
+def unsorted_strategy(n: int, num_cells: int, dtype, impl: str | None = None) -> str:
+    """Resolve the unsorted-reduction strategy to 'sort' or 'scatter'.
+
+    auto gates: density (below ~8 rows/cell the post-sort stream fails the
+    distinct-per-block check — block=512/ranks=64 needs >= block/ranks rows
+    per cell — and the compaction would fall back to scatter anyway, making
+    the device sort pure waste), backend (CPU scatter is not the
+    bottleneck), f32-exact grid size, and dtype (wider floats keep the
+    dtype-preserving scatter; the block compaction accumulates f32). All
+    static at trace time, so the choice compiles away."""
+    impl = impl or _unsorted_impl()
+    if impl != "auto":
+        return impl
+    return (
+        "sort"
+        if n >= 8 * num_cells
+        and jax.default_backend() != "cpu"
+        and num_cells < _F32_EXACT
+        and dtype == jnp.float32
+        else "scatter"
+    )
+
+
 def segment_sum_count(k, v, num_cells: int, impl: str | None = None, weights=None):
     """(sum, count) per cell for UNSORTED cell ids (invalid rows must carry
     id >= num_cells; their values must be pre-masked to 0). `weights`
@@ -284,24 +443,7 @@ def segment_sum_count(k, v, num_cells: int, impl: str | None = None, weights=Non
     sorted block compaction: measured 2.1x the raw double-scatter on a v5e
     chip (64M rows, 2.88M cells). 'auto' reads HORAEDB_UNSORTED_IMPL at
     trace time; jitted callers bake the choice into the executable."""
-    impl = impl or _unsorted_impl()
-    if impl == "auto":
-        # density gate: below ~8 rows/cell the post-sort stream fails the
-        # distinct-per-block check (block=512/ranks=64 needs >= block/ranks
-        # rows per cell) and the compaction would fall back to scatter
-        # anyway — the device sort would be pure waste. The f32 gate keeps
-        # wider dtypes (f64 under x64) on the dtype-preserving scatter: the
-        # block compaction accumulates f32. All gates are static at trace
-        # time, so the choice compiles away.
-        dense_enough = k.shape[0] >= 8 * num_cells
-        impl = (
-            "sort"
-            if dense_enough
-            and jax.default_backend() != "cpu"
-            and num_cells < _F32_EXACT
-            and jnp.asarray(v).dtype == jnp.float32
-            else "scatter"
-        )
+    impl = unsorted_strategy(k.shape[0], num_cells, jnp.asarray(v).dtype, impl)
     if impl == "scatter":
         return _scatter_sum_count(k, v, num_cells, w=weights)
     ensure(impl == "sort", f"unknown unsorted impl {impl!r}")
@@ -353,6 +495,10 @@ def sorted_segment_sum_count(
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     impl = impl or _sorted_impl()
+    if jnp.asarray(v).dtype not in (jnp.float32, jnp.int32):
+        # wider floats keep the dtype-preserving scatter (the compaction
+        # accumulates f32; a cond joining f32/f64 branches cannot trace)
+        impl = "scatter"
     if impl == "scatter" or (impl == "auto" and interpret and not _mosaic_enabled()):
         return _scatter_sum_count(k_sorted, v, num_cells, w=weights)
     if impl == "lanes":

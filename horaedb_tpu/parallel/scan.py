@@ -59,25 +59,42 @@ def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
     from horaedb_tpu.ops.pallas_kernels import (
         _F32_EXACT,
         segment_sum_count,
+        sorted_segment_min_max,
         sorted_segment_sum_count,
+        unsorted_strategy,
     )
 
+    mn = mx = None
     if sorted_input and num_cells < _F32_EXACT:
         s, c = sorted_segment_sum_count(
             safe, vals_masked, num_cells, impl=sorted_impl,
             weights=ok.astype(vals.dtype),
         )
+        if with_minmax:
+            mn, mx = sorted_segment_min_max(
+                safe, vals_masked, num_cells, impl=sorted_impl, valid=ok
+            )
+    elif (
+        num_cells < _F32_EXACT
+        and unsorted_strategy(
+            safe.shape[0], num_cells, vals_masked.dtype, unsorted_impl
+        ) == "sort"
+    ):
+        # Unsorted rows, compaction-eligible: ONE device sort feeds both
+        # reductions (sort ~4 ns/row replaces up to four 9 ns/row scatters).
+        # Post-sort, sentinel keys are contiguous at the tail, so no weight
+        # column is needed — invalid rows drop via the sentinel bucket.
+        k2, v2 = lax.sort((flat, vals_masked), num_keys=1)
+        s, c = sorted_segment_sum_count(k2, v2, num_cells, impl="block")
+        if with_minmax:
+            mn, mx = sorted_segment_min_max(k2, v2, num_cells, impl="block")
     else:
-        # Unsorted rows: strategy dispatcher (auto = device-sort + block
-        # compaction on accelerators — sort costs ~4 ns/row and replaces two
-        # 9 ns/row scatters; scatter on CPU).
         s, c = segment_sum_count(
-            safe, vals_masked, num_cells, impl=unsorted_impl,
+            safe, vals_masked, num_cells, impl="scatter",
             weights=ok.astype(vals.dtype),
         )
-    mn = mx = None
-    if with_minmax:
-        mn, mx = masked_minmax(vals, flat, ok, num_cells)
+        if with_minmax:
+            mn, mx = masked_minmax(vals, flat, ok, num_cells)
     shape = (local_series, num_buckets)
     if not with_minmax:
         return s.reshape(shape), c.reshape(shape), None, None

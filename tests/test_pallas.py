@@ -165,6 +165,62 @@ class TestWeightedReduction:
         np.testing.assert_allclose(np.asarray(s), es, rtol=1e-3, atol=1e-3)
 
 
+class TestSortedSegmentMinMax:
+    """Block-compacted min/max (masked reduces, no matmul) vs numpy oracle."""
+
+    def _oracle(self, k, v, cells):
+        mn = np.full(cells, np.inf)
+        mx = np.full(cells, -np.inf)
+        np.minimum.at(mn, k, v)
+        np.maximum.at(mx, k, v)
+        return mn, mx
+
+    @pytest.mark.parametrize("impl", ("scatter", "block"))
+    def test_matches_oracle(self, impl):
+        from horaedb_tpu.ops.pallas_kernels import sorted_segment_min_max
+
+        rng = np.random.default_rng(21)
+        n, cells = 60_000, 3_000
+        k = np.sort(rng.integers(0, cells, n).astype(np.int32))
+        v = rng.normal(size=n).astype(np.float32)
+        mn, mx = sorted_segment_min_max(k, v, cells, impl=impl)
+        emn, emx = self._oracle(k, v, cells)
+        np.testing.assert_allclose(np.asarray(mn), emn, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(mx), emx, rtol=1e-6)
+
+    @pytest.mark.parametrize("impl", ("scatter", "block"))
+    def test_valid_mask_and_empty_cells(self, impl):
+        from horaedb_tpu.ops.pallas_kernels import sorted_segment_min_max
+
+        rng = np.random.default_rng(22)
+        n, cells = 40_000, 2_000
+        k = np.sort(rng.integers(0, cells // 2, n).astype(np.int32))  # half empty
+        v = rng.normal(size=n).astype(np.float32)
+        keep = v > 0
+        mn, mx = sorted_segment_min_max(
+            k, v, cells, impl=impl, valid=keep
+        )
+        emn, emx = self._oracle(k[keep], v[keep], cells)
+        np.testing.assert_allclose(np.asarray(mn), emn, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(mx), emx, rtol=1e-6)
+        assert np.isinf(np.asarray(mn)[cells // 2 + 1:]).all()  # empty cells
+
+    def test_sparse_fallback_and_jit(self):
+        import jax
+
+        from horaedb_tpu.ops.pallas_kernels import sorted_segment_min_max
+
+        rng = np.random.default_rng(23)
+        n, cells = 5_000, 1_000_000
+        k = np.sort(rng.choice(cells, n, replace=False)).astype(np.int32)
+        v = rng.normal(size=n).astype(np.float32)
+        f = jax.jit(lambda kk, vv: sorted_segment_min_max(kk, vv, cells, impl="block"))
+        mn, mx = f(k, v)
+        emn, emx = self._oracle(k, v, cells)
+        np.testing.assert_allclose(np.asarray(mn), emn, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(mx), emx, rtol=1e-6)
+
+
 class TestUnsortedSegmentSumCount:
     """The UNSORTED dispatcher: scatter vs device-sort + block compaction."""
 

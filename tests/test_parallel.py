@@ -154,3 +154,89 @@ class TestShardedSortedDispatch:
         np.testing.assert_allclose(
             np.asarray(out["sum"]).reshape(-1), es, rtol=1e-3, atol=1e-3
         )
+
+    @staticmethod
+    def _grid_oracle(sid, ts, vals, keep, num_series, num_buckets, bucket_ms):
+        flat = sid.astype(np.int64) * num_buckets + ts // bucket_ms
+        C = num_series * num_buckets
+        ec = np.bincount(flat[keep], minlength=C)
+        es = np.bincount(flat[keep], weights=vals[keep].astype(np.float64),
+                         minlength=C)
+        emn = np.full(C, np.inf)
+        emx = np.full(C, -np.inf)
+        np.minimum.at(emn, flat[keep], vals[keep])
+        np.maximum.at(emx, flat[keep], vals[keep])
+        return es, ec, emn, emx
+
+    @pytest.mark.parametrize("sorted_input", (False, True))
+    def test_sort_dispatch_full_stats_with_predicate(self, sorted_input):
+        """Force the compaction branches (unsorted_impl='sort' runs the
+        one-sort-feeds-all-stats path even on CPU, where auto would pick
+        scatter): sum/count/min/max must all match the filtered oracle —
+        this is the only CPU coverage the accelerator-default path gets."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from horaedb_tpu.ops import filter as F
+        from horaedb_tpu.parallel import make_mesh
+        from horaedb_tpu.parallel.scan import build_sharded_downsample
+
+        mesh = make_mesh(8, series_parallel=2)
+        num_series, num_buckets, bucket_ms = 64, 16, 1000
+        n = 8 * 4096
+        rng = np.random.default_rng(7)
+        sid = rng.integers(0, num_series, n).astype(np.int32)
+        ts = rng.integers(0, 16_000, n).astype(np.int32)
+        if sorted_input:
+            order = np.lexsort((ts, sid))
+            sid, ts = sid[order], ts[order]
+        vals = rng.normal(size=n).astype(np.float32)
+        keep = vals > -0.4
+
+        pred = F.Compare("__val__", "gt", -0.4)
+        fn = build_sharded_downsample(
+            mesh, num_series, num_buckets, predicate=pred, with_minmax=True,
+            sorted_input=sorted_input,
+            unsorted_impl=None if sorted_input else "sort",
+            sorted_impl="block" if sorted_input else None,
+        )
+        sh = NamedSharding(mesh, P("rows"))
+        lits = (jnp.asarray(-0.4, jnp.float32),)
+        out = fn(
+            jax.device_put(ts, sh), jax.device_put(sid, sh),
+            jax.device_put(vals, sh), jax.device_put(np.ones(n, bool), sh),
+            lits, jnp.asarray(0, jnp.int32), jnp.asarray(bucket_ms, jnp.int32),
+        )
+        es, ec, emn, emx = self._grid_oracle(
+            sid, ts, vals, keep, num_series, num_buckets, bucket_ms
+        )
+        np.testing.assert_array_equal(np.asarray(out["count"]).reshape(-1), ec)
+        np.testing.assert_allclose(
+            np.asarray(out["sum"]).reshape(-1), es, rtol=1e-3, atol=1e-3
+        )
+        np.testing.assert_allclose(np.asarray(out["min"]).reshape(-1), emn, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["max"]).reshape(-1), emx, rtol=1e-6)
+
+    def test_grouped_stats_sort_branch_matches_oracle(self, monkeypatch):
+        """HORAEDB_UNSORTED_IMPL=sort drives grouped_stats' one-sort branch
+        on CPU; all four stats must match, OOB indices must still drop."""
+        monkeypatch.setenv("HORAEDB_UNSORTED_IMPL", "sort")
+        from horaedb_tpu.ops import aggregate
+
+        rng = np.random.default_rng(8)
+        n, g = 40_000, 50
+        idx = rng.integers(-1, g + 1, n).astype(np.int32)  # includes OOB
+        vals = rng.normal(size=n).astype(np.float32)
+        valid = rng.random(n) < 0.9
+        out = aggregate.grouped_stats(vals, idx, valid, g)
+        keep = valid & (idx >= 0) & (idx < g)
+        es = np.bincount(idx[keep], weights=vals[keep].astype(np.float64), minlength=g)
+        ec = np.bincount(idx[keep], minlength=g)
+        emn = np.full(g, np.inf); emx = np.full(g, -np.inf)
+        np.minimum.at(emn, idx[keep], vals[keep])
+        np.maximum.at(emx, idx[keep], vals[keep])
+        np.testing.assert_array_equal(np.asarray(out["count"]).astype(np.int64), ec)
+        np.testing.assert_allclose(np.asarray(out["sum"]), es, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(out["min"]), emn, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["max"]), emx, rtol=1e-6)
