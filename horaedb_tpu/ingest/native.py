@@ -186,12 +186,20 @@ def load():
         return _lib
 
 
-_EMPTY_I64 = np.empty(0, np.int64)
-_EMPTY_F64 = np.empty(0, np.float64)
+def _frozen_empty(dtype) -> np.ndarray:
+    # readonly, matching the frombuffer views the non-empty path returns —
+    # an in-place op on a shared empty must raise, not mutate a singleton
+    a = np.empty(0, dtype)
+    a.setflags(write=False)
+    return a
+
+
+_EMPTY_I64 = _frozen_empty(np.int64)
+_EMPTY_F64 = _frozen_empty(np.float64)
 
 
 _EMPTY = {np.dtype(np.int64): _EMPTY_I64, np.dtype(np.float64): _EMPTY_F64,
-          np.dtype(np.uint64): np.empty(0, np.uint64)}
+          np.dtype(np.uint64): _frozen_empty(np.uint64)}
 
 
 def _as_np(ptr, n: int, dtype) -> np.ndarray:

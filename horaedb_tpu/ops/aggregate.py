@@ -77,8 +77,16 @@ def masked_segment_stats(
     requested, and values/ones stay flat 1-D (stacking features breaks the
     (8,128) tile layout and measures ~4x slower).
     """
-    s = jax.ops.segment_sum(jnp.where(valid, values, 0), idx, num_segments + 1)[:-1]
-    c = jax.ops.segment_sum(valid.astype(values.dtype), idx, num_segments + 1)[:-1]
+    # integers widen to 64-bit accumulation (exact, wrap-proof for narrow
+    # int sums), matching pallas_kernels._scatter_sum_count; floats keep
+    # their own width (the engine's precision contract, data.py)
+    vals = jnp.asarray(values)
+    if jnp.issubdtype(vals.dtype, jnp.unsignedinteger):
+        vals = vals.astype(jnp.uint64)
+    elif not jnp.issubdtype(vals.dtype, jnp.floating):
+        vals = vals.astype(jnp.int64)  # bool included
+    s = jax.ops.segment_sum(jnp.where(valid, vals, 0), idx, num_segments + 1)[:-1]
+    c = jax.ops.segment_sum(valid.astype(vals.dtype), idx, num_segments + 1)[:-1]
     if not with_minmax:
         return s, c, None, None
     mn, mx = masked_minmax(values, idx, valid, num_segments)
@@ -186,8 +194,10 @@ def downsample_sorted(
     if valid is not None:
         ok = ok & jnp.asarray(valid)
     safe, flat = masked_cell_keys(series_idx, bucket, ok, num_series, num_buckets)
+    # typed zero fill: a weak 0.0 would promote integer values to float and
+    # bypass the dtype-preserving integer scatter route
     s, c = sorted_segment_sum_count(
-        safe, jnp.where(ok, values, 0.0), num_cells,
+        safe, jnp.where(ok, values, jnp.zeros((), values.dtype)), num_cells,
         weights=ok.astype(values.dtype),
     )
     shape = (num_series, num_buckets)

@@ -354,7 +354,9 @@ def sorted_segment_min_max(
     `impl` maps 'scatter' to the plain scatter; every other strategy name
     uses the block compaction (there is no matmul/Pallas variant — the
     reduce already fuses). Rows excluded via `valid` must keep in-range
-    sorted keys; +/-inf fills mark empty cells.
+    sorted keys; rows may also carry sentinel keys >= num_cells (dropped by
+    every impl's final scatter/clip) provided sentinel runs stay contiguous
+    in the stream. +/-inf fills mark empty cells.
 
     Non-f32 floats always take the dtype-preserving scatter: the block
     path computes in f32, and a lax.cond joining f32/f64 branches would
@@ -391,9 +393,16 @@ def sorted_segment_min_max(
 
 def _scatter_sum_count(k_sorted, v, num_cells, w=None):
     k = jnp.clip(k_sorted, 0, num_cells).astype(jnp.int32)
-    # dtype-preserving: the CPU/XLA fallback accumulates f64 inputs in f64
-    # (the engine's precision contract, data.py); f32 stays the TPU trade-off
-    vf = v if jnp.issubdtype(v.dtype, jnp.floating) else v.astype(jnp.float32)
+    # dtype-preserving for floats (f64 stays f64 — the engine's precision
+    # contract, data.py; f32 stays the TPU trade-off). Integer inputs widen
+    # to 64-bit accumulation: exact (the reason ints route here instead of
+    # the f32 block compaction) and wrap-proof for narrow int sums.
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        vf = v
+    elif jnp.issubdtype(v.dtype, jnp.unsignedinteger):
+        vf = v.astype(jnp.uint64)
+    else:
+        vf = v.astype(jnp.int64)  # bool included
     cw = jnp.ones_like(vf) if w is None else w.astype(vf.dtype)
     s = jax.ops.segment_sum(vf, k, num_cells + 1)[:-1]
     c = jax.ops.segment_sum(cw, k, num_cells + 1)[:-1]
@@ -495,9 +504,11 @@ def sorted_segment_sum_count(
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     impl = impl or _sorted_impl()
-    if jnp.asarray(v).dtype not in (jnp.float32, jnp.int32):
-        # wider floats keep the dtype-preserving scatter (the compaction
-        # accumulates f32; a cond joining f32/f64 branches cannot trace)
+    if jnp.asarray(v).dtype != jnp.float32:
+        # non-f32 inputs take the scatter route: the compaction accumulates
+        # f32, which loses exactness for integer sums above 2^24 (the
+        # scatter widens ints to 64-bit instead — exact), and a cond
+        # joining f32/f64 branches cannot trace
         impl = "scatter"
     if impl == "scatter" or (impl == "auto" and interpret and not _mosaic_enabled()):
         return _scatter_sum_count(k_sorted, v, num_cells, w=weights)

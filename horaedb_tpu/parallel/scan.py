@@ -44,18 +44,25 @@ def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
     """
     local_sid = sid - series_lo
     bucket = ((ts - t0) // bucket_ms).astype(jnp.int32)
-    ok = (
-        valid
-        & (local_sid >= 0) & (local_sid < local_series)
-        & (bucket >= 0) & (bucket < num_buckets)
-    )
+    in_slice = (local_sid >= 0) & (local_sid < local_series)
+    ok = valid & in_slice & (bucket >= 0) & (bucket < num_buckets)
     num_cells = local_series * num_buckets
     from horaedb_tpu.ops.aggregate import masked_cell_keys, masked_minmax
 
     # `safe` (in-range, mask rides the weight column) feeds sum/count;
     # `flat` (sentinel drop) feeds min/max — see masked_cell_keys.
     safe, flat = masked_cell_keys(local_sid, bucket, ok, local_series, num_buckets)
-    vals_masked = jnp.where(ok, vals, 0.0)
+    # Rows OUTSIDE this shard's contiguous series slice go to the sentinel
+    # key instead of a clipped in-range key: in (sid, ts) order they form a
+    # contiguous prefix/suffix, so sentinel runs stay whole — clipping them
+    # to local_sid 0/local_series-1 would fragment them into one run per
+    # (foreign series x bucket) and trip the block compaction's
+    # distinct-per-block check on sparse shards. Predicate/bucket misses
+    # keep clipped keys (their mask rides the weight column).
+    safe = jnp.where(in_slice, safe, num_cells)
+    # typed zero fill: a weak 0.0 would promote integer vals to f32 and
+    # bypass the dtype-preserving integer scatter route
+    vals_masked = jnp.where(ok, vals, jnp.zeros((), vals.dtype))
     from horaedb_tpu.ops.pallas_kernels import (
         _F32_EXACT,
         segment_sum_count,
