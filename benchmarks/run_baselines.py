@@ -86,28 +86,36 @@ async def config_1_and_2(quick: bool) -> None:
             total += b.num_rows
         return total
 
+    from horaedb_tpu.storage.scanstats import scan_stats
+
     # config 1: single series, 1h, sum over 5m buckets
     pred1 = F.Compare("series", "eq", 7)
     await scan_rows(pred1)  # warm/compile
-    start = time.perf_counter()
-    got = 0
-    async for b in eng.scan(ScanRequest(range=TimeRange(0, hour_ms), predicate=pred1)):
-        ts = b.column("ts").to_numpy()
-        v = b.column("value").to_numpy()
-        buckets = ts // 300_000
-        _ = np.bincount(buckets, weights=v, minlength=12)  # final 12-bucket sum
-        got += b.num_rows
-    _emit(1, "tsbs_single_groupby_1", n_rows, time.perf_counter() - start,
-          {"matched_rows": got, "note": "rows/sec = engine rows scanned over wall time"})
+    with scan_stats() as st:
+        start = time.perf_counter()
+        got = 0
+        async for b in eng.scan(ScanRequest(range=TimeRange(0, hour_ms), predicate=pred1)):
+            ts = b.column("ts").to_numpy()
+            v = b.column("value").to_numpy()
+            buckets = ts // 300_000
+            _ = np.bincount(buckets, weights=v, minlength=12)  # final 12-bucket sum
+            got += b.num_rows
+        elapsed = time.perf_counter() - start
+    _emit(1, "tsbs_single_groupby_1", n_rows, elapsed,
+          {"matched_rows": got, "stages": st.as_dict(),
+           "note": "rows/sec = engine rows scanned over wall time"})
 
     # config 2: tag-equality (series membership) + range scan
     tsids = tuple(range(0, n_series, 10))
     pred2 = F.InSet("series", tsids)
     await scan_rows(pred2)  # warm
-    start = time.perf_counter()
-    got = await scan_rows(pred2)
-    _emit(2, "tag_predicate_range_scan", n_rows, time.perf_counter() - start,
-          {"matched_rows": got, "series_selected": len(tsids)})
+    with scan_stats() as st:
+        start = time.perf_counter()
+        got = await scan_rows(pred2)
+        elapsed = time.perf_counter() - start
+    _emit(2, "tag_predicate_range_scan", n_rows, elapsed,
+          {"matched_rows": got, "series_selected": len(tsids),
+           "stages": st.as_dict()})
     await eng.close()
 
 

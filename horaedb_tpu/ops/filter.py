@@ -109,6 +109,19 @@ def iter_nodes(pred: Predicate):
         yield from iter_nodes(pred.child)
 
 
+def pred_columns(pred: Predicate | None) -> set[str]:
+    """Column names a predicate references (scan planners use this to decide
+    which columns must reach the evaluation site)."""
+    if pred is None:
+        return set()
+    out: set[str] = set()
+    for node in iter_nodes(pred):
+        c = getattr(node, "column", None)
+        if c:
+            out.add(c)
+    return out
+
+
 def _pad_bucket(n: int) -> int:
     """Next power of two (min 1): membership arrays pad to size buckets so
     compiled-kernel reuse is per bucket, not per exact set size."""
@@ -167,6 +180,23 @@ def split_literals(pred: Predicate | None) -> tuple[Predicate | None, tuple]:
     return walk(pred), tuple(literals)
 
 
+def _representable_values(vals, dt: np.dtype) -> list:
+    """Membership-set values representable in a column dtype. For integer
+    columns, equality can never hold for out-of-range or fractional values,
+    so they drop from the set — the SINGLE definition shared by the device
+    (_eval), numpy (eval_predicate_np), and template (literal_arrays)
+    evaluators, keeping set semantics identical across all three."""
+    vals_list = list(vals)
+    if np.issubdtype(dt, np.integer):
+        info = np.iinfo(dt)
+        vals_list = [
+            int(v) for v in vals_list
+            if (not isinstance(v, float) or v.is_integer())
+            and info.min <= v <= info.max
+        ]
+    return vals_list
+
+
 def _checked_cast(v, dt: np.dtype, column: str):
     """Cast a literal to a column dtype, rejecting values the dtype cannot
     represent (silent wrapping or float truncation would silently change
@@ -207,14 +237,7 @@ def literal_arrays(
         if i in inset_nodes:
             node = inset_nodes[i]
             dt = np.dtype(dtypes.get(node.column, np.int64))
-            vals_list = list(v)
-            if np.issubdtype(dt, np.integer):
-                info = np.iinfo(dt)
-                vals_list = [
-                    int(x) for x in vals_list
-                    if (not isinstance(x, float) or x.is_integer())
-                    and info.min <= x <= info.max
-                ]
+            vals_list = _representable_values(v, dt)
             k = len(vals_list)
             pad_val = vals_list[0] if k else 0
             padded = vals_list + [pad_val] * (node.padded_size - k)
@@ -292,15 +315,7 @@ def _eval(pred: Predicate, cols: dict[str, jnp.ndarray], literals: tuple = ()) -
     if isinstance(pred, InSet):
         c = cols[pred.column]
         dt = np.dtype(c.dtype)
-        vals_list = list(pred.values)
-        if np.issubdtype(dt, np.integer):
-            # equality can never hold for values the dtype can't represent
-            info = np.iinfo(dt)
-            vals_list = [
-                int(v) for v in vals_list
-                if (not isinstance(v, float) or v.is_integer())
-                and info.min <= v <= info.max
-            ]
+        vals_list = _representable_values(pred.values, dt)
         if not vals_list:
             return jnp.zeros(c.shape[0], dtype=bool)
         # Build with the column dtype directly: np.asarray on a mixed-magnitude
@@ -376,6 +391,54 @@ def eval_predicate_host(pred: Predicate | None, table) -> np.ndarray:
         if isinstance(p, Not):
             return ~ev(p.child)
         raise HoraeError(f"unsupported predicate node on host path: {p!r}")
+
+    return ev(pred)
+
+
+def eval_predicate_np(pred: Predicate | None, cols: dict[str, np.ndarray]) -> np.ndarray:
+    """Vectorized predicate evaluation over numpy host lanes (numeric
+    columns only; binary/string predicates go through eval_predicate_host).
+    Raw predicates only — Slot/InSetProbe templates are device-side forms."""
+    n = len(next(iter(cols.values())))
+    if pred is None:
+        return np.ones(n, dtype=bool)
+
+    def ev(p: Predicate) -> np.ndarray:
+        if isinstance(p, Compare):
+            c = cols[p.column]
+            if isinstance(p.literal, Slot):
+                raise HoraeError("Slot template unsupported on the numpy path")
+            lit = _checked_cast(p.literal, c.dtype, p.column)
+            if p.op == "eq":
+                return c == lit
+            if p.op == "ne":
+                return c != lit
+            if p.op == "lt":
+                return c < lit
+            if p.op == "le":
+                return c <= lit
+            if p.op == "gt":
+                return c > lit
+            return c >= lit
+        if isinstance(p, InSet):
+            c = cols[p.column]
+            vals_list = _representable_values(p.values, c.dtype)
+            if not vals_list:
+                return np.zeros(len(c), dtype=bool)
+            return np.isin(c, np.asarray(vals_list, dtype=c.dtype))
+        if isinstance(p, And):
+            out = ev(p.children[0])
+            for ch in p.children[1:]:
+                out = out & ev(ch)
+            return out
+        if isinstance(p, Or):
+            out = ev(p.children[0])
+            for ch in p.children[1:]:
+                out = out | ev(ch)
+            return out
+        if isinstance(p, Not):
+            return ~ev(p.child)
+        raise HoraeError(f"unsupported predicate node on numpy path: {p!r}")
 
     return ev(pred)
 

@@ -30,7 +30,9 @@ from __future__ import annotations
 import asyncio
 import io
 import logging
+import os
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from functools import lru_cache
@@ -48,8 +50,9 @@ from horaedb_tpu.ops import dedup as dedup_ops
 from horaedb_tpu.ops import filter as filter_ops
 from horaedb_tpu.ops.blocks import Block, arrow_column_to_numpy
 from horaedb_tpu.ops.filter import Predicate
+from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.config import UpdateMode
-from horaedb_tpu.storage.operator import BytesMergeOperator, LastValueOperator
+from horaedb_tpu.storage.operator import BytesMergeOperator
 from horaedb_tpu.storage.sst import SstFile, SstPathGenerator
 from horaedb_tpu.storage.types import (
     RESERVED_COLUMN_NAME,
@@ -98,6 +101,358 @@ class WriteRequest:
     # snapshot-detach time so last-value dedup follows buffering order even
     # when a later snapshot's encode finishes first.
     seq: int | None = None
+
+
+# ---------------------------------------------------------------------------
+# host<->device link profile + scan-path cost model
+# ---------------------------------------------------------------------------
+
+
+class _LinkProfile:
+    """Measured host<->device transfer characteristics (module singleton).
+
+    The materializing-scan planner needs real numbers, not assumptions: on a
+    production TPU host H2D rides PCIe (GB/s) and the device merge wins for
+    any sizable scan, while a tunneled dev chip can move ~50 MB/s with
+    ~50 ms dispatch latency, where host SIMD wins far longer. One lazy 8 MB
+    probe per process keeps the planner honest on both (VERDICT r02 #1: the
+    end-to-end configs were transfer-bound, not kernel-bound)."""
+
+    _cached: dict | None = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> dict:
+        if cls._cached is None:
+            with cls._lock:
+                if cls._cached is None:
+                    cls._cached = cls._measure()
+        return cls._cached
+
+    @staticmethod
+    def _measure() -> dict:
+        try:
+            dev = jax.devices()[0]
+            if dev.platform == "cpu":
+                # same memory space ("transfer" is a memcpy), but the XLA
+                # multi-key stable sort is single-core and ~1.6 us/row —
+                # an order slower than numpy's packed argsort (measured on
+                # the quick-baseline shape), so it must carry its real cost
+                return {"h2d_bw": 8e9, "d2h_bw": 8e9, "dispatch_s": 1e-4,
+                        "sort_s_per_row": 1.2e-6}
+            warm = jax.jit(lambda x: x.sum())
+            small = jax.device_put(np.arange(128, dtype=np.float32))
+            warm(small).block_until_ready()  # compile outside the clock
+            t0 = time.perf_counter()
+            warm(small).block_until_ready()
+            dispatch = max(time.perf_counter() - t0, 1e-5)
+            probe = np.empty(8 << 20, np.uint8)
+            t0 = time.perf_counter()
+            d = jax.device_put(probe)
+            d.block_until_ready()
+            h2d = len(probe) / max(time.perf_counter() - t0 - dispatch, 1e-6)
+            t0 = time.perf_counter()
+            np.asarray(d)
+            d2h = len(probe) / max(time.perf_counter() - t0 - dispatch, 1e-6)
+            # accelerator multi-key sort throughput (v5e measured ~4 ns/row
+            # per key lane; 6 lanes on the scan shape)
+            return {"h2d_bw": h2d, "d2h_bw": d2h, "dispatch_s": dispatch,
+                    "sort_s_per_row": 25e-9}
+        except Exception:  # noqa: BLE001 — no device: plan as if local
+            return {"h2d_bw": 8e9, "d2h_bw": 8e9, "dispatch_s": 1e-4,
+                    "sort_s_per_row": 1.2e-6}
+
+
+# host merge calibration (measured microbench on the CI shape): stable u64
+# argsort + pack + dedup ≈ 150-250 ns per SURVIVING row; vectorized
+# predicate eval ≈ 2 ns/row per term. These only steer the host/device
+# choice — being 2x off moves the crossover, not correctness.
+_HOST_SORT_S_PER_ROW = 200e-9
+_HOST_EVAL_S_PER_ROW = 2e-9
+
+
+def _host_merge_indices(
+    col_of,
+    n_rows: int,
+    sort_keys: tuple[str, ...],
+    num_pk: int,
+    mask: np.ndarray | None,
+    do_dedup: bool,
+) -> np.ndarray:
+    """Vectorized host merge: filter -> stable sort by (pk..., __seq__) ->
+    last-value dedup. Returns row indices (into the unfiltered input) in
+    output order.
+
+    `col_of(name)` returns the full numpy lane for a sort-key column. Rows
+    are compacted through `mask` FIRST, so the O(n log n) sort runs on
+    surviving rows only — the reason this path demolishes the device round
+    trip on selective scans over slow links.
+
+    Sort strategy: pack all sort keys into one u64 (pk columns offset to
+    their min, __seq__ replaced by its dense rank — sequences are ns-clock
+    file ids, ranking costs one np.unique and saves ~50 bits) and run ONE
+    stable argsort; fall back to np.lexsort when the packed widths exceed
+    63 bits or a key is floating-point. Dedup = keep-last per pk group,
+    matching the reference MergeExec's LastValueOperator (operator.rs:36-44).
+    """
+    if mask is not None:
+        base = np.nonzero(mask)[0]
+        n = len(base)
+    else:
+        base = None
+        n = n_rows
+    if n == 0:
+        return np.empty(0, np.int64)
+
+    def col(name: str) -> np.ndarray:
+        a = np.asarray(col_of(name))
+        return a[base] if base is not None else a
+
+    encs: list[tuple[np.ndarray, int]] = []
+    packable = True
+    for name in sort_keys:
+        a = col(name)
+        if not np.issubdtype(a.dtype, np.integer):
+            packable = False
+            break
+        if name == SEQ_COLUMN_NAME:
+            uniq = np.unique(a)
+            enc = np.searchsorted(uniq, a).astype(np.uint64)
+            width = max(1, int(len(uniq) - 1).bit_length())
+        else:
+            lo, hi = int(a.min()), int(a.max())
+            span = hi - lo  # python ints: no overflow on u64/i64 extremes
+            if span >= (1 << 63):
+                packable = False
+                break
+            if a.dtype == np.uint64:
+                enc = a - np.uint64(lo)
+            else:
+                enc = (a.astype(np.int64) - lo).astype(np.uint64)
+            width = max(1, span.bit_length())
+        encs.append((enc, width))
+    packable = packable and sum(w for _, w in encs) <= 63
+
+    if packable:
+        packed = np.zeros(n, np.uint64)
+        for enc, width in encs:
+            packed = (packed << np.uint64(width)) | enc
+        order = np.argsort(packed, kind="stable")
+        if do_dedup:
+            seq_width = np.uint64(encs[-1][1])
+            group = packed[order] >> seq_width
+            keep = np.empty(n, dtype=bool)
+            keep[:-1] = group[:-1] != group[1:]
+            keep[-1] = True
+        else:
+            keep = None
+    else:
+        order = np.lexsort(tuple(col(k) for k in reversed(sort_keys)))
+        if do_dedup:
+            keep = np.zeros(n, dtype=bool)
+            keep[-1] = True
+            for name in sort_keys[:num_pk]:
+                a = col(name)[order]
+                keep[:-1] |= a[:-1] != a[1:]
+        else:
+            keep = None
+
+    final = base[order] if base is not None else order
+    return final[keep] if keep is not None else final
+
+
+@lru_cache(maxsize=256)
+def _build_index_kernel(
+    key_names: tuple[str, ...],
+    sort_keys: tuple[str, ...],
+    pk_names: tuple[str, ...],
+    template: Predicate | None,
+    use_mask: bool,
+    do_dedup: bool,
+    presorted: bool,
+):
+    """Index-only scan kernel: mask -> sort -> dedup -> COMPACTED surviving
+    row indices. The device sees only the sort-key (+ predicate) lanes and
+    returns kept_count + int32 indices — 4 bytes per surviving row across
+    the link instead of every column in both directions. The host then
+    materializes any column type (incl. binary) with one arrow take.
+
+    `use_mask=True` takes a precomputed host mask as a lane (predicates
+    referencing binary columns, or masks the planner already paid for);
+    otherwise the predicate template evaluates on device.
+    """
+
+    def core(cols: dict, mask, num_valid):
+        n = cols[sort_keys[0]].shape[0]
+        valid = jnp.arange(n) < num_valid
+        mask = mask & valid
+        kept = jnp.sum(mask)
+        if presorted:
+            pos = jnp.where(mask, jnp.cumsum(mask) - 1,
+                            kept + jnp.cumsum(~mask) - 1)
+            perm = jnp.zeros(n, dtype=jnp.int32).at[pos].set(
+                jnp.arange(n, dtype=jnp.int32)
+            )
+        else:
+            keys = [cols[k] for k in sort_keys]
+            perm = jax.lax.sort(
+                ((~mask).astype(jnp.int32), *keys,
+                 jnp.arange(n, dtype=jnp.int32)),
+                num_keys=1 + len(keys), is_stable=True,
+            )[-1]
+        if do_dedup:
+            sorted_pk = {k: jnp.take(cols[k], perm, axis=0) for k in pk_names}
+            keep = dedup_ops.dedup_last_value(sorted_pk, list(pk_names), kept)
+        else:
+            keep = jnp.arange(n) < kept
+        kcnt = jnp.sum(keep)
+        pos2 = jnp.where(keep, jnp.cumsum(keep) - 1,
+                         kcnt + jnp.cumsum(~keep) - 1)
+        out_idx = jnp.zeros(n, dtype=jnp.int32).at[pos2].set(perm.astype(jnp.int32))
+        return out_idx, kcnt
+
+    if use_mask:
+
+        @jax.jit
+        def kernel(cols: dict, ext_mask, num_valid):
+            return core(cols, ext_mask != 0, num_valid)
+
+    else:
+
+        @jax.jit
+        def kernel(cols: dict, literals: tuple, num_valid):
+            n = cols[sort_keys[0]].shape[0]
+            mask = filter_ops.eval_predicate(template, cols, literals)
+            del n
+            return core(cols, mask, num_valid)
+
+    del key_names  # cache key only
+    return kernel
+
+
+def _plan_and_merge(
+    schema: StorageSchema,
+    n: int,
+    col_of,
+    predicate: Predicate | None,
+    host_mask_fn,
+    binary_pred: bool,
+    itemsize_of,
+) -> np.ndarray:
+    """Decide host-SIMD vs index-only-device for one materializing merge and
+    run it; returns surviving row indices in output order.
+
+    Cost model (all terms measured, see _LinkProfile): the device pays
+    key-lane H2D + 4 B/survivor D2H + dispatch latency; the host pays a
+    vectorized predicate eval over all rows plus sort/dedup/take over
+    SURVIVING rows only. The host mask is evaluated lazily — when the device
+    wins even at worst-case selectivity, the predicate ships as a template
+    and evaluates on device (no host pass at all).
+
+    `HORAEDB_SCAN_PATH` in {auto, host, device} overrides (A/B harnesses,
+    tests). Binary-column predicates always evaluate on host (the device has
+    no byte lanes) but may still merge on device via the mask lane.
+    """
+    pk_names = tuple(schema.primary_key_names)
+    sort_keys = pk_names + (SEQ_COLUMN_NAME,)
+    do_dedup = schema.update_mode == UpdateMode.OVERWRITE
+    if n == 0:
+        return np.empty(0, np.int64)
+
+    pred_cols = filter_ops.pred_columns(predicate)
+    mode = os.environ.get("HORAEDB_SCAN_PATH", "auto")
+    link = _LinkProfile.get()
+    dispatch = link["dispatch_s"]
+
+    def host_merge(mask: np.ndarray | None) -> np.ndarray:
+        scanstats.note("path_host_merge")
+        with scanstats.stage("host_merge"):
+            return _host_merge_indices(
+                col_of, n, sort_keys, len(pk_names), mask, do_dedup
+            )
+
+    def device_merge(mask: np.ndarray | None) -> np.ndarray:
+        scanstats.note("path_device_merge")
+        need = list(sort_keys)
+        if mask is None:
+            need += [c for c in sorted(pred_cols) if c not in need]
+        arrays = {name: col_of(name) for name in need}
+        with scanstats.stage("host_prep"):
+            presorted = _rows_presorted(arrays, sort_keys)
+            if mask is not None:
+                arrays = dict(arrays)
+                arrays["__mask__"] = mask.astype(np.uint8)
+        with scanstats.stage("h2d"):
+            block = Block.from_numpy(arrays, pad_keys=sort_keys)
+            jax.block_until_ready(list(block.columns.values()))
+        with scanstats.stage("device_merge"):
+            if mask is not None:
+                kernel = _build_index_kernel(
+                    tuple(block.names), sort_keys, pk_names, None, True,
+                    do_dedup, presorted,
+                )
+                cols = {k: v for k, v in block.columns.items() if k != "__mask__"}
+                out_idx, kcnt = kernel(cols, block.columns["__mask__"], block.num_valid)
+            else:
+                template, raw = filter_ops.split_literals(predicate)
+                literals = filter_ops.literal_arrays(
+                    template, raw, {k: v.dtype for k, v in block.columns.items()}
+                )
+                kernel = _build_index_kernel(
+                    tuple(block.names), sort_keys, pk_names, template, False,
+                    do_dedup, presorted,
+                )
+                out_idx, kcnt = kernel(block.columns, literals, block.num_valid)
+            k = int(kcnt)
+        if k == 0:
+            return np.empty(0, np.int64)
+        with scanstats.stage("d2h"):
+            return np.asarray(out_idx[:k]).astype(np.int64)
+
+    key_bytes = sum(itemsize_of(name) for name in sort_keys)
+    tmpl_bytes = key_bytes + sum(
+        itemsize_of(c) for c in pred_cols if c not in sort_keys
+    )
+
+    def dev_cost(lane_bytes: int, sel: int) -> float:
+        return (
+            n * lane_bytes / link["h2d_bw"]
+            + n * link["sort_s_per_row"]
+            + sel * 4 / link["d2h_bw"]
+            + 8 * dispatch
+        )
+
+    def host_cost(sel: int) -> float:
+        # the arrow take that materializes survivors is paid identically by
+        # both paths (the caller runs it on the returned indices), so it
+        # appears in neither cost
+        return sel * _HOST_SORT_S_PER_ROW
+
+    if mode == "device":
+        if binary_pred:
+            with scanstats.stage("host_filter"):
+                mask = host_mask_fn()
+            return device_merge(mask)
+        return device_merge(None)
+    if mode == "host" or predicate is None:
+        if mode == "auto" and dev_cost(key_bytes, n) < host_cost(n):
+            return device_merge(None)
+        return host_merge(None)
+
+    # auto with a predicate: if the device wins even at worst-case
+    # selectivity, skip the host eval entirely
+    n_terms = max(1, len(list(filter_ops.iter_nodes(predicate))))
+    eval_cost = n * _HOST_EVAL_S_PER_ROW * n_terms
+    if not binary_pred and dev_cost(tmpl_bytes, n) < eval_cost:
+        return device_merge(None)
+    with scanstats.stage("host_filter"):
+        mask = host_mask_fn()
+        sel = int(np.count_nonzero(mask))
+    if sel == 0:
+        return np.empty(0, np.int64)
+    if host_cost(sel) <= dev_cost(key_bytes + 1, sel):
+        return host_merge(mask)
+    return device_merge(mask)
 
 
 # ---------------------------------------------------------------------------
@@ -490,46 +845,86 @@ class ParquetReader:
         schema = self._schema
         read_names = self._resolve_read_names(projections, keep_builtin)
 
-        tables = await asyncio.gather(
-            *(self.read_sst(s, read_names, predicate,
-               use_block_cache=use_block_cache) for s in ssts)
-        )
+        with scanstats.stage("io_decode"):
+            tables = await asyncio.gather(
+                *(self.read_sst(s, read_names, predicate,
+                   use_block_cache=use_block_cache) for s in ssts)
+            )
         tables = [t for t in tables if t.num_rows > 0]
         if not tables:
             return []
-        tables = _order_tables_by_first_key(
-            tables, tuple(schema.primary_key_names) + (SEQ_COLUMN_NAME,)
-        )
-        table = pa.concat_tables(tables).combine_chunks()
-
-        pk_names = tuple(schema.primary_key_names)
-        (
-            sorted_cols, perm, keep, starts, kept, numeric_names, binary_names,
-        ) = self._fused_pass(table, predicate)
-
-        keep_np = np.asarray(keep)
-        if schema.update_mode == UpdateMode.OVERWRITE and binary_names:
-            # hybrid path: device picked the surviving rows; host gathers
-            # binary columns through the same permutation.
-            keep_np = np.asarray(
-                dedup_ops.dedup_last_value(sorted_cols, list(pk_names), kept)
+        with scanstats.stage("host_prep"):
+            tables = _order_tables_by_first_key(
+                tables, tuple(schema.primary_key_names) + (SEQ_COLUMN_NAME,)
             )
-
+            table = pa.concat_tables(tables).combine_chunks()
         out_names = self._output_names(read_names, keep_builtin)
 
-        if schema.update_mode == UpdateMode.APPEND and binary_names:
+        # append mode with binary VALUE columns concatenates group bytes on
+        # host and keeps the fused-kernel path (group starts come from the
+        # device run-boundary mask)
+        value_names = {schema.arrow_schema.names[i] for i in schema.value_idxes}
+        has_binary_value = any(
+            _is_binary_like(table.schema.field(v).type)
+            for v in value_names if v in table.schema.names
+        )
+        if schema.update_mode == UpdateMode.APPEND and has_binary_value:
+            (
+                sorted_cols, perm, _keep, starts, kept, numeric_names, binary_names,
+            ) = self._fused_pass(table, predicate)
             result = self._materialize_append_mode(
                 table, sorted_cols, np.asarray(perm), np.asarray(starts),
                 int(kept), numeric_names, binary_names, out_names,
             )
-        else:
-            result = self._materialize(
-                table, sorted_cols, np.asarray(perm), keep_np,
-                numeric_names, binary_names, out_names,
-            )
-        if result.num_rows == 0:
+            return self._slice_batches(result, batch_size)
+
+        # unified materializing merge: the planner picks host SIMD or the
+        # index-only device kernel; either way the output is a row-index
+        # vector and ONE arrow take materializes every column type
+        idx = self._merge_table(table, predicate)
+        if len(idx) == 0:
             return []
-        return [result.slice(i, batch_size) for i in range(0, result.num_rows, batch_size)]
+        with scanstats.stage("materialize"):
+            result = table.select(out_names).take(pa.array(idx)).combine_chunks()
+        batches = result.to_batches(max_chunksize=batch_size)
+        return [b for b in batches if b.num_rows > 0]
+
+    def _merge_table(self, table: pa.Table, predicate: Predicate | None) -> np.ndarray:
+        """_plan_and_merge over a decoded arrow table (column lanes convert
+        lazily and are cached across the planner's probes)."""
+        cache: dict[str, np.ndarray] = {}
+
+        def col_of(name: str) -> np.ndarray:
+            a = cache.get(name)
+            if a is None:
+                a = arrow_column_to_numpy(table.column(name).combine_chunks())
+                cache[name] = a
+            return a
+
+        pred_cols = filter_ops.pred_columns(predicate)
+        binary_pred = any(
+            _is_binary_like(table.schema.field(c).type)
+            for c in pred_cols if c in table.schema.names
+        )
+
+        def host_mask_fn() -> np.ndarray:
+            if binary_pred:
+                return filter_ops.eval_predicate_host(predicate, table)
+            return filter_ops.eval_predicate_np(
+                predicate, {c: col_of(c) for c in pred_cols}
+            )
+
+        def itemsize_of(name: str) -> int:
+            t = table.schema.field(name).type
+            try:
+                return max(1, t.bit_width // 8)
+            except (ValueError, AttributeError):
+                return 16  # variable-width: rough planning estimate
+
+        return _plan_and_merge(
+            self._schema, table.num_rows, col_of, predicate, host_mask_fn,
+            binary_pred, itemsize_of,
+        )
 
     async def _scan_segment_host(
         self,
@@ -706,7 +1101,6 @@ class ParquetReader:
         read_names = self._resolve_read_names(projections, keep_builtin)
         pk_names = tuple(schema.primary_key_names)
         sort_keys = pk_names + (SEQ_COLUMN_NAME,)
-        do_dedup = schema.update_mode == UpdateMode.OVERWRITE
         cap = self._scan_block_rows
 
         def greedy_partition(items: list, rows_of) -> list[list]:
@@ -722,42 +1116,69 @@ class ParquetReader:
                 out.append(cur)
             return out
 
-        def run_block(arrays: dict[str, np.ndarray], template, literals) -> dict[str, np.ndarray]:
-            block = Block.from_numpy(arrays, pad_keys=sort_keys)
-            lit = filter_ops.literal_arrays(
-                template, literals, {k: v.dtype for k, v in block.columns.items()}
-            )
-            kernel = _build_scan_kernel(
-                tuple(block.names), sort_keys, pk_names, template, do_dedup,
-                presorted=_rows_presorted(arrays, sort_keys),
-            )
-            sorted_cols, _perm, keep, _starts, _kept = kernel(
-                block.columns, lit, block.num_valid
-            )
-            idx = np.nonzero(np.asarray(keep))[0]
-            return {k: np.asarray(v)[idx] for k, v in sorted_cols.items()}
+        def run_block(arrays: dict[str, np.ndarray], pred) -> dict[str, np.ndarray]:
+            """Merge one in-memory block: the planner routes host SIMD vs the
+            index-only device kernel (only key/predicate lanes ever cross the
+            link; survivors gather from the HOST arrays)."""
+            n = len(arrays[sort_keys[0]])
+            p_cols = filter_ops.pred_columns(pred)
 
-        template, raw_literals = filter_ops.split_literals(predicate)
-        # level 0: filter + merge + dedup per SST chunk (sequential: bounds
-        # peak host+device memory to ~one chunk)
-        level: list[dict[str, np.ndarray]] = []
-        for chunk in greedy_partition(ssts, lambda s: s.meta.num_rows):
-            tables = await asyncio.gather(
-                *(self.read_sst(s, read_names, predicate,
-               use_block_cache=use_block_cache) for s in chunk)
+            def host_mask_fn() -> np.ndarray:
+                return filter_ops.eval_predicate_np(
+                    pred, {c: arrays[c] for c in p_cols}
+                )
+
+            idx = _plan_and_merge(
+                schema, n, arrays.__getitem__, pred, host_mask_fn, False,
+                lambda name: arrays[name].dtype.itemsize,
             )
-            tables = [t for t in tables if t.num_rows > 0]
-            if not tables:
-                continue
-            tables = _order_tables_by_first_key(tables, sort_keys)
-            table = pa.concat_tables(tables).combine_chunks()
-            arrays = {
-                name: arrow_column_to_numpy(table.column(name).combine_chunks())
-                for name in table.schema.names
-            }
-            out = run_block(arrays, template, raw_literals)
-            if len(out[sort_keys[0]]):
-                level.append(out)
+            return {k: a[idx] for k, a in arrays.items()}
+
+        # level 0: filter + merge + dedup per SST chunk, with the NEXT
+        # chunk's parquet decode prefetching on worker threads while this
+        # chunk merges (the decode/compute overlap of SURVEY §7 risk (c))
+        level: list[dict[str, np.ndarray]] = []
+        chunks = greedy_partition(ssts, lambda s: s.meta.num_rows)
+
+        async def read_chunk(chunk: list[SstFile]) -> list[pa.Table]:
+            with scanstats.stage("io_decode"):
+                tables = await asyncio.gather(
+                    *(self.read_sst(s, read_names, predicate,
+                       use_block_cache=use_block_cache) for s in chunk)
+                )
+            return [t for t in tables if t.num_rows > 0]
+
+        next_task = asyncio.ensure_future(read_chunk(chunks[0])) if chunks else None
+        try:
+            for i in range(len(chunks)):
+                tables = await next_task
+                next_task = None
+                if i + 1 < len(chunks):
+                    next_task = asyncio.ensure_future(read_chunk(chunks[i + 1]))
+                    await asyncio.sleep(0)  # let the prefetch reach its threads
+                if not tables:
+                    continue
+                with scanstats.stage("host_prep"):
+                    tables = _order_tables_by_first_key(tables, sort_keys)
+                    table = pa.concat_tables(tables).combine_chunks()
+                    arrays = {
+                        name: arrow_column_to_numpy(table.column(name).combine_chunks())
+                        for name in table.schema.names
+                    }
+                out = run_block(arrays, predicate)
+                if len(out[sort_keys[0]]):
+                    level.append(out)
+        except BaseException:
+            # a failed merge must not abandon the in-flight prefetch (its
+            # reads would race a subsequent evict/close and its exception
+            # would be logged as never-retrieved)
+            if next_task is not None:
+                next_task.cancel()
+                try:
+                    await next_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            raise
         # merge tree: combine sorted deduped runs until one remains
         while len(level) > 1:
             next_level = []
@@ -768,7 +1189,7 @@ class ParquetReader:
                 cat = {
                     k: np.concatenate([g[k] for g in group]) for k in group[0]
                 }
-                next_level.append(run_block(cat, None, ()))
+                next_level.append(run_block(cat, None))
             if len(next_level) == len(level):
                 # every pair exceeds the cap: merge only the two smallest
                 # runs (guaranteed progress with minimal cap overshoot —
@@ -776,7 +1197,7 @@ class ParquetReader:
                 next_level.sort(key=lambda r: len(r[sort_keys[0]]))
                 a, b = next_level[0], next_level[1]
                 cat = {k: np.concatenate([a[k], b[k]]) for k in a}
-                next_level = [run_block(cat, None, ())] + next_level[2:]
+                next_level = [run_block(cat, None)] + next_level[2:]
             level = next_level
         if not level:
             return []
@@ -1116,32 +1537,6 @@ class ParquetReader:
         return [result.slice(i, batch_size) for i in range(0, result.num_rows, batch_size)]
 
     # -- host materialization ------------------------------------------------
-    def _materialize(
-        self,
-        table: pa.Table,
-        sorted_cols: dict[str, jax.Array],
-        perm: np.ndarray,
-        keep: np.ndarray,
-        numeric_names: list[str],
-        binary_names: list[str],
-        out_names: list[str],
-    ) -> pa.RecordBatch:
-        keep_idx = np.nonzero(keep)[0]
-        cols = []
-        for name in out_names:
-            f = table.schema.field(name)
-            if name in binary_names:
-                row_idx = perm[keep_idx]
-                row_idx = row_idx[row_idx < table.num_rows]
-                arr = table.column(name).combine_chunks().take(pa.array(row_idx))
-                cols.append(arr)
-            else:
-                np_col = np.asarray(sorted_cols[name])[keep_idx]
-                cols.append(_np_to_arrow(np_col, f.type))
-        return pa.RecordBatch.from_arrays(
-            cols, schema=pa.schema([table.schema.field(n) for n in out_names])
-        )
-
     def _materialize_append_mode(
         self,
         table: pa.Table,

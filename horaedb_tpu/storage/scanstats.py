@@ -1,0 +1,80 @@
+"""Per-stage scan timing attribution.
+
+The reference accepts DataFusion's ExecutionPlanMetricsSet but never reads it
+(read.rs:84); here stage timing is first-class because the engine's perf
+story spans three very different lanes — object-store IO + parquet decode
+(host), host<->device transfer (PCIe or, in dev environments, a network
+tunnel), and the XLA kernel itself — and optimizing the wrong lane is the
+classic failure mode (VERDICT r02: configs 1-2 were assumed kernel-bound,
+measured 95% transfer-bound).
+
+Usage:
+    with scan_stats() as st:
+        ... run scans ...
+    st.as_dict()  # {"io_decode_s": ..., "host_prep_s": ..., ...}
+
+The collector is a contextvar, so concurrent asyncio tasks spawned inside the
+block attribute into the same collector without threading it through every
+call. Overhead when no collector is active: one contextvar get per stage.
+Stage sums can exceed wall clock (stages from concurrent SST reads overlap).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ScanStats:
+    seconds: dict[str, float] = field(default_factory=dict)
+    counts: dict[str, int] = field(default_factory=dict)
+
+    def add(self, stage: str, secs: float) -> None:
+        self.seconds[stage] = self.seconds.get(stage, 0.0) + secs
+        self.counts[stage] = self.counts.get(stage, 0) + 1
+
+    def count(self, stage: str, n: int = 1) -> None:
+        self.counts[stage] = self.counts.get(stage, 0) + n
+
+    def as_dict(self) -> dict:
+        out = {f"{k}_s": round(v, 4) for k, v in self.seconds.items()}
+        out.update({k: v for k, v in self.counts.items() if k not in self.seconds})
+        return out
+
+
+_ACTIVE: ContextVar[ScanStats | None] = ContextVar("horaedb_scan_stats", default=None)
+
+
+@contextmanager
+def scan_stats():
+    """Collect stage timings for every scan inside the block."""
+    st = ScanStats()
+    token = _ACTIVE.set(st)
+    try:
+        yield st
+    finally:
+        _ACTIVE.reset(token)
+
+
+@contextmanager
+def stage(name: str):
+    """Time one stage into the active collector (no-op when none)."""
+    st = _ACTIVE.get()
+    if st is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        st.add(name, time.perf_counter() - t0)
+
+
+def note(name: str, n: int = 1) -> None:
+    """Bump a counter (e.g. rows decoded, path taken) on the active collector."""
+    st = _ACTIVE.get()
+    if st is not None:
+        st.count(name, n)
