@@ -22,6 +22,7 @@ import logging
 import pyarrow as pa
 
 from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.compaction import Task
 from horaedb_tpu.storage.sst import FileMeta, SstFile, allocate_id
 from horaedb_tpu.storage.types import TimeRange
@@ -135,22 +136,56 @@ class Executor:
             return
         table = pa.Table.from_batches(batches)
 
-        file_id = allocate_id()
-        size = await self._storage.write_sst(file_id, table)
-        file_meta = FileMeta(
-            max_sequence=file_id,
-            num_rows=table.num_rows,
-            size=size,
-            time_range=time_range,
+        # Output sharding (divergence from the reference's single output,
+        # executor.rs:173-191, shared with the flush path's shard design):
+        # a large merged output splits into pk-contiguous slices whose
+        # parquet encodes run CONCURRENTLY on worker threads — the encode
+        # was the pipeline's serial tail (VERDICT r02 #3). Shard count is
+        # capped below the picker's input_sst_min_num so a fully-compacted
+        # segment can never re-pick its own output in a churn loop; each
+        # shard is a sorted, pk-disjoint run, so later scans take the
+        # presorted O(n) merge path instead of re-sorting.
+        cfg = self._storage._config.scheduler
+        max_shards = max(1, cfg.input_sst_min_num - 1)
+        shard_rows = max(1, cfg.output_shard_rows)
+        n_shards = min(max_shards, -(-table.num_rows // shard_rows))
+        per = -(-table.num_rows // n_shards)
+        slices = [table.slice(i * per, per) for i in range(n_shards)]
+        slices = [s for s in slices if s.num_rows > 0]
+        ids = [allocate_id() for _ in slices]
+        with scanstats.stage("encode"):
+            # all-settle semantics: a failed shard encode must not leave its
+            # siblings running detached (they would race close/teardown);
+            # gather with return_exceptions, then re-raise the first failure
+            results = await asyncio.gather(
+                *(self._storage.write_sst(fid, s) for fid, s in zip(ids, slices)),
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
+            sizes = results
+        new_files = [
+            SstFile(
+                id=fid,
+                meta=FileMeta(
+                    max_sequence=fid,
+                    num_rows=s.num_rows,
+                    size=size,
+                    time_range=time_range,
+                ),
+            )
+            for fid, s, size in zip(ids, slices, sizes)
+        ]
+        logger.debug(
+            "Compact output %d sst shard(s): ids=%s rows=%d",
+            len(new_files), ids, table.num_rows,
         )
-        logger.debug("Compact output new sst: id=%d rows=%d size=%d", file_id, table.num_rows, size)
 
         # Commit point: add new THEN delete inputs+expireds, atomically in one
         # manifest delta (executor.rs:206-216).
         to_deletes = [f.id for f in task.expireds] + [f.id for f in task.inputs]
-        await self._manifest.update(
-            [SstFile(id=file_id, meta=file_meta)], to_deletes
-        )
+        await self._manifest.update(new_files, to_deletes)
         # From now on, no error should be returned (executor.rs:218-219).
         await self._delete_ssts(to_deletes)
 

@@ -131,6 +131,13 @@ class SchedulerConfig:
     new_sst_max_size: ReadableSize = field(default_factory=lambda: ReadableSize.gb(1))
     input_sst_max_num: int = 30
     input_sst_min_num: int = 5
+    # TPU-build extension: compaction outputs above this row count split
+    # into up to (input_sst_min_num - 1) pk-contiguous shard SSTs whose
+    # parquet encodes run CONCURRENTLY (the encode was the compaction
+    # pipeline's serial tail). The shard cap keeps a fully-compacted
+    # segment below the picker's min file count, so shards never re-pick
+    # themselves in a churn loop.
+    output_shard_rows: int = 8_000_000
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "SchedulerConfig":

@@ -171,6 +171,80 @@ _HOST_SORT_S_PER_ROW = 200e-9
 _HOST_EVAL_S_PER_ROW = 2e-9
 
 
+def _pack_sort_keys(
+    col, sort_keys: tuple[str, ...], n: int
+) -> tuple[np.ndarray, int] | None:
+    """Pack the (pk..., __seq__) sort keys into ONE u64 per row: pk columns
+    offset to their min, __seq__ replaced by its dense rank (sequences are
+    ns-clock file ids — ranking costs one np.unique and saves ~50 bits).
+    Returns (packed, seq_width) or None when a key is non-integer or the
+    widths exceed 63 bits (bit 63 stays free as the reject/padding
+    sentinel). Shared by the host argsort merge and the packed device
+    kernel, so both orderings are definitionally identical."""
+    if n == 0:
+        return None
+    encs: list[tuple[np.ndarray, int]] = []
+    for name in sort_keys:
+        a = col(name)
+        if not np.issubdtype(a.dtype, np.integer):
+            return None
+        if name == SEQ_COLUMN_NAME:
+            uniq = np.unique(a)
+            enc = np.searchsorted(uniq, a).astype(np.uint64)
+            width = max(1, int(len(uniq) - 1).bit_length())
+        else:
+            lo, hi = int(a.min()), int(a.max())
+            span = hi - lo  # python ints: no overflow on u64/i64 extremes
+            if span >= (1 << 63):
+                return None
+            if a.dtype == np.uint64:
+                enc = a - np.uint64(lo)
+            else:
+                enc = (a.astype(np.int64) - lo).astype(np.uint64)
+            width = max(1, span.bit_length())
+        encs.append((enc, width))
+    if sum(w for _, w in encs) > 63:
+        return None
+    packed = np.zeros(n, np.uint64)
+    for enc, width in encs:
+        packed = (packed << np.uint64(width)) | enc
+    return packed, encs[-1][1]
+
+
+_PACK_SENTINEL = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+
+
+@lru_cache(maxsize=64)
+def _build_packed_index_kernel(seq_width: int, do_dedup: bool):
+    """Single-lane merge kernel: the whole (pk..., seq-rank) ordering rides
+    one u64 (rejected rows pre-sunk to the all-ones sentinel on host), so
+    the device sorts TWO operands (key + iota) instead of mask + every key
+    lane + iota — and only 8 bytes/row ever cross the link inbound, 4
+    bytes/survivor outbound. Dedup needs no pk gathers: the group id is
+    packed >> seq_width."""
+
+    @jax.jit
+    def kernel(packed, num_valid):
+        n = packed.shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        sp, perm = jax.lax.sort((packed, iota), num_keys=1, is_stable=True)
+        # valid rows (63-bit keys) sort strictly before sentinel rows
+        inb = jnp.arange(n) < num_valid
+        if do_dedup:
+            grp = sp >> np.uint64(seq_width)
+            nxt = jnp.concatenate([grp[1:], grp[-1:]])
+            keep = inb & ((jnp.arange(n) == num_valid - 1) | (nxt != grp))
+        else:
+            keep = inb
+        kcnt = jnp.sum(keep)
+        pos = jnp.where(keep, jnp.cumsum(keep) - 1,
+                        kcnt + jnp.cumsum(~keep) - 1)
+        out_idx = jnp.zeros(n, dtype=jnp.int32).at[pos].set(perm)
+        return out_idx, kcnt
+
+    return kernel
+
+
 def _host_merge_indices(
     col_of,
     n_rows: int,
@@ -208,39 +282,28 @@ def _host_merge_indices(
         a = np.asarray(col_of(name))
         return a[base] if base is not None else a
 
-    encs: list[tuple[np.ndarray, int]] = []
-    packable = True
-    for name in sort_keys:
-        a = col(name)
-        if not np.issubdtype(a.dtype, np.integer):
-            packable = False
-            break
-        if name == SEQ_COLUMN_NAME:
-            uniq = np.unique(a)
-            enc = np.searchsorted(uniq, a).astype(np.uint64)
-            width = max(1, int(len(uniq) - 1).bit_length())
+    # presorted shortcut: a compacted segment (or one flush's disjoint
+    # shards, pre-ordered by _order_tables_by_first_key) is already in
+    # (pk..., seq) order — survivors keep input order and dedup is one
+    # adjacent compare: O(n) total, no sort
+    if _rows_presorted({k: np.asarray(col_of(k)) for k in sort_keys}, sort_keys):
+        if do_dedup:
+            keep = np.zeros(n, dtype=bool)
+            keep[-1] = True
+            for name in sort_keys[:num_pk]:
+                a = col(name)
+                keep[:-1] |= a[:-1] != a[1:]
+            final = base[keep] if base is not None else np.nonzero(keep)[0]
         else:
-            lo, hi = int(a.min()), int(a.max())
-            span = hi - lo  # python ints: no overflow on u64/i64 extremes
-            if span >= (1 << 63):
-                packable = False
-                break
-            if a.dtype == np.uint64:
-                enc = a - np.uint64(lo)
-            else:
-                enc = (a.astype(np.int64) - lo).astype(np.uint64)
-            width = max(1, span.bit_length())
-        encs.append((enc, width))
-    packable = packable and sum(w for _, w in encs) <= 63
+            final = base if base is not None else np.arange(n)
+        return final
 
-    if packable:
-        packed = np.zeros(n, np.uint64)
-        for enc, width in encs:
-            packed = (packed << np.uint64(width)) | enc
+    packres = _pack_sort_keys(col, sort_keys, n)
+    if packres is not None:
+        packed, seq_width = packres
         order = np.argsort(packed, kind="stable")
         if do_dedup:
-            seq_width = np.uint64(encs[-1][1])
-            group = packed[order] >> seq_width
+            group = packed[order] >> np.uint64(seq_width)
             keep = np.empty(n, dtype=bool)
             keep[:-1] = group[:-1] != group[1:]
             keep[-1] = True
@@ -371,7 +434,43 @@ def _plan_and_merge(
                 col_of, n, sort_keys, len(pk_names), mask, do_dedup
             )
 
+    key_bytes = sum(itemsize_of(name) for name in sort_keys)
+
+    def device_merge_packed(mask: np.ndarray | None) -> np.ndarray | None:
+        """Single-u64-lane device merge; None when keys don't pack. Worth the
+        ~30 ns/row host pack only when it saves more link time than it
+        costs — i.e. slow links, exactly where the device path's H2D hurts."""
+        if (key_bytes - 8) / link["h2d_bw"] < 30e-9:
+            return None
+        with scanstats.stage("host_prep"):
+            packres = _pack_sort_keys(col_of, sort_keys, n)
+            if packres is None:
+                return None
+            packed, seq_width = packres
+            if mask is not None:
+                packed = np.where(mask, packed, _PACK_SENTINEL)
+                nv = int(np.count_nonzero(mask))
+            else:
+                nv = n
+        scanstats.note("path_device_merge_packed")
+        with scanstats.stage("h2d"):
+            block = Block.from_numpy({"__packed__": packed},
+                                     pad_keys=("__packed__",))
+            jax.block_until_ready(list(block.columns.values()))
+        with scanstats.stage("device_merge"):
+            kernel = _build_packed_index_kernel(seq_width, do_dedup)
+            out_idx, kcnt = kernel(block.columns["__packed__"], nv)
+            k = int(kcnt)
+        if k == 0:
+            return np.empty(0, np.int64)
+        with scanstats.stage("d2h"):
+            return np.asarray(out_idx[:k]).astype(np.int64)
+
     def device_merge(mask: np.ndarray | None) -> np.ndarray:
+        if mask is not None or predicate is None:
+            packed_res = device_merge_packed(mask)
+            if packed_res is not None:
+                return packed_res
         scanstats.note("path_device_merge")
         need = list(sort_keys)
         if mask is None:
@@ -409,7 +508,6 @@ def _plan_and_merge(
         with scanstats.stage("d2h"):
             return np.asarray(out_idx[:k]).astype(np.int64)
 
-    key_bytes = sum(itemsize_of(name) for name in sort_keys)
     tmpl_bytes = key_bytes + sum(
         itemsize_of(c) for c in pred_cols if c not in sort_keys
     )
@@ -428,14 +526,33 @@ def _plan_and_merge(
         # appears in neither cost
         return sel * _HOST_SORT_S_PER_ROW
 
+    _presorted: list[bool] = []
+
+    def keys_presorted() -> bool:
+        """Lazily-computed-once: already in (pk..., seq) order? A compacted
+        segment is; the host path then skips its sort entirely (O(n)
+        adjacent compares, zero transfer), which no device route can beat."""
+        if not _presorted:
+            with scanstats.stage("host_prep"):
+                _presorted.append(_rows_presorted(
+                    {k: np.asarray(col_of(k)) for k in sort_keys}, sort_keys
+                ))
+        return _presorted[0]
+
+    def eval_mask() -> np.ndarray | None:
+        if predicate is None:
+            return None
+        with scanstats.stage("host_filter"):
+            return host_mask_fn()
+
     if mode == "device":
         if binary_pred:
-            with scanstats.stage("host_filter"):
-                mask = host_mask_fn()
-            return device_merge(mask)
+            return device_merge(eval_mask())
         return device_merge(None)
-    if mode == "host" or predicate is None:
-        if mode == "auto" and dev_cost(key_bytes, n) < host_cost(n):
+    if mode == "host":
+        return host_merge(eval_mask())
+    if predicate is None:
+        if not keys_presorted() and dev_cost(key_bytes, n) < host_cost(n):
             return device_merge(None)
         return host_merge(None)
 
@@ -443,14 +560,15 @@ def _plan_and_merge(
     # selectivity, skip the host eval entirely
     n_terms = max(1, len(list(filter_ops.iter_nodes(predicate))))
     eval_cost = n * _HOST_EVAL_S_PER_ROW * n_terms
-    if not binary_pred and dev_cost(tmpl_bytes, n) < eval_cost:
+    if not binary_pred and dev_cost(tmpl_bytes, n) < eval_cost \
+            and not keys_presorted():
         return device_merge(None)
     with scanstats.stage("host_filter"):
         mask = host_mask_fn()
         sel = int(np.count_nonzero(mask))
     if sel == 0:
         return np.empty(0, np.int64)
-    if host_cost(sel) <= dev_cost(key_bytes + 1, sel):
+    if keys_presorted() or host_cost(sel) <= dev_cost(key_bytes + 1, sel):
         return host_merge(mask)
     return device_merge(mask)
 

@@ -216,3 +216,78 @@ class TestExecutor:
         ex.on_failure(task)
         assert ex._inused_memory == 0
         assert not any(f.is_compaction() for f in files)
+
+
+class TestShardedOutput:
+    @async_test
+    async def test_large_output_shards_and_scans_identically(self):
+        """Outputs above output_shard_rows split into pk-contiguous shard
+        SSTs (concurrent encodes); scans return the same rows, and the
+        shard count stays below input_sst_min_num so a fully-compacted
+        segment never re-picks its own output."""
+        store = MemStore()
+        cfg = StorageConfig(
+            scheduler=SchedulerConfig(
+                schedule_interval=ReadableDuration.secs(3600),
+                input_sst_min_num=3,
+                output_shard_rows=100,  # tiny: force sharding
+            )
+        )
+        eng = await ObjectBasedStorage.try_new(
+            "db", store, make_schema(), 2, SEGMENT_MS,
+            config=cfg, start_background_merger=False,
+            enable_compaction_scheduler=True,
+        )
+        schema = make_schema()
+        rng = np.random.default_rng(7)
+        for i in range(4):
+            pk1 = np.sort(rng.integers(0, 500, 200))
+            await eng.write(
+                WriteRequest(
+                    pa.RecordBatch.from_pydict(
+                        {
+                            "pk1": pk1,
+                            "pk2": np.zeros(200, dtype=np.int64),
+                            "ts": np.full(200, 10, dtype=np.int64),
+                            "value": rng.normal(size=200),
+                        },
+                        schema=schema,
+                    ),
+                    TimeRange(10, 11),
+                )
+            )
+        before = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        sched = eng.compaction_scheduler
+        sched.pick_once()
+        for _ in range(500):
+            await asyncio.sleep(0.02)
+            if len(eng.manifest.all_ssts()) < 4:
+                break
+        await sched.executor.drain()
+        ssts = eng.manifest.all_ssts()
+        # sharded: more than one output, but under the re-pick threshold
+        assert 1 < len(ssts) < cfg.scheduler.input_sst_min_num
+        # each shard is pk-disjoint from the next (contiguous slices of the
+        # sorted merged output): last pk of shard i < first pk of shard i+1
+        ordered = sorted(ssts, key=lambda s: s.id)
+        bounds = []
+        for s in ordered:
+            t = await eng.parquet_reader.read_sst(s, ["pk1", "pk2"], None)
+            pks = list(zip(t.column("pk1").to_pylist(), t.column("pk2").to_pylist()))
+            assert pks == sorted(pks)
+            bounds.append((pks[0], pks[-1]))
+        for (_, last), (first, _) in zip(bounds, bounds[1:]):
+            assert last < first
+        total_rows = sum(s.meta.num_rows for s in ssts)
+        after = await collect(eng, ScanRequest(range=TimeRange(0, SEGMENT_MS)))
+        assert after.equals(before)
+        assert total_rows == after.num_rows
+        # re-pick must find nothing (shard count below min)
+        picks = TimeWindowCompactionStrategy(
+            segment_duration_ms=SEGMENT_MS,
+            new_sst_max_size=cfg.scheduler.new_sst_max_size.as_bytes(),
+            input_sst_max_num=cfg.scheduler.input_sst_max_num,
+            input_sst_min_num=cfg.scheduler.input_sst_min_num,
+        ).pick_candidate(ssts, expire_before_ms=None)
+        assert picks is None or not picks.inputs
+        await eng.close()
