@@ -61,7 +61,14 @@ def _device_responsive(timeouts=(120, 180, 300)) -> tuple[bool, str]:
                 f"{out.stderr.decode(errors='replace')[-200:]}"
             )
         except subprocess.TimeoutExpired:
-            reasons.append(f"attempt {attempt + 1}: timeout after {timeout_s}s")
+            # the probe is a 128x128 matmul — worst-case legitimate cost is
+            # one cold compile (~40 s); a 120 s+ timeout is the TUNNEL
+            # wedged, not a slow kernel (VERDICT r03 #1: the distinction
+            # decides whether to re-try the chip or trust the CPU number)
+            reasons.append(
+                f"attempt {attempt + 1}: tunnel wedged "
+                f"(tiny-matmul probe timed out after {timeout_s}s)"
+            )
         if attempt + 1 < len(timeouts):
             _time.sleep(20)
     return False, "; ".join(reasons)

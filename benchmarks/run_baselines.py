@@ -259,13 +259,17 @@ def config_5(quick: bool) -> None:
     t0 = time.perf_counter()
     packed, seq_width = _pack_sort_keys(host_cols.__getitem__, ("pk", "__seq__"), total)
     pack_s = time.perf_counter() - t0
+    host_values = np.concatenate(
+        [np.asarray(b.columns["value"][: rows_per_sst]) for b in blocks]
+    )
+    # H2D covers BOTH inbound lanes — the packed keys and the value lane
+    # the gather permutes; leaving values untimed would hide half the
+    # transfer on a slow link
     t0 = time.perf_counter()
     packed_d = jax.device_put(packed)
-    packed_d.block_until_ready()
+    values_d = jax.device_put(host_values)
+    jax.block_until_ready((packed_d, values_d))
     h2d_s = time.perf_counter() - t0
-    values_d = jax.device_put(np.concatenate(
-        [np.asarray(b.columns["value"][: rows_per_sst]) for b in blocks]
-    ))
 
     import jax.numpy as jnp
 
@@ -282,13 +286,26 @@ def config_5(quick: bool) -> None:
     merged_v, kcnt = packed_merge(packed_d, values_d)
     float(np.asarray(probe(merged_v, kcnt)))
     dev_s = time.perf_counter() - t0
-    _emit(5, "compaction_100way_merge_dedup", total, dev_s,
-          {"ways": ways, "impl": "packed",
-           "mb_per_sec": round(bytes_total / dev_s / 1e6, 1),
+    # survivors must come back to the host for the parquet encode — the
+    # D2H leg is part of the job, not an externality (warm once so the
+    # slice compile isn't billed as transfer)
+    k = int(np.asarray(kcnt))
+    np.asarray(merged_v[:k])
+    t0 = time.perf_counter()
+    np.asarray(merged_v[:k])
+    d2h_s = time.perf_counter() - t0
+    # headline = WALL CLOCK of the whole merge (pack + H2D + kernel + D2H);
+    # the kernel-only number flattered the packed path on slow links
+    # (VERDICT r03 weak #4) — it now lives in `stages` where it belongs
+    wall_s = pack_s + h2d_s + dev_s + d2h_s
+    _emit(5, "compaction_100way_merge_dedup", total, wall_s,
+          {"ways": ways, "impl": "packed", "survivors": k,
+           "mb_per_sec": round(bytes_total / wall_s / 1e6, 1),
            "lanes_seconds": round(lanes_s, 4),
            "lanes_mb_per_sec": round(bytes_total / lanes_s / 1e6, 1),
            "stages": {"pack_s": round(pack_s, 4), "h2d_s": round(h2d_s, 4),
-                      "device_s": round(dev_s, 4)}})
+                      "device_s": round(dev_s, 4),
+                      "d2h_s": round(d2h_s, 4)}})
 
 
 def main() -> None:
