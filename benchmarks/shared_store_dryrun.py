@@ -21,8 +21,31 @@ import sys
 import tempfile
 
 ROOT_ENV = "SHARED_STORE_ROOT"
+S3_URL_ENV = "SHARED_STORE_S3_URL"
 SERIES = 40
 SAMPLES_PER_SERIES = 25
+
+
+def _open_store():
+    """LocalStore root, or an S3 client when the parent exported a fake-S3
+    endpoint (SHARED_STORE_S3=1): same shared-medium model, real HTTP hops."""
+    url = os.environ.get(S3_URL_ENV)
+    if url:
+        from horaedb_tpu.objstore.s3 import S3LikeConfig, S3LikeStore
+
+        return S3LikeStore(S3LikeConfig(
+            endpoint=url, bucket="shared", region="local",
+            key_id="dryrun-id", key_secret="dryrun-secret", prefix="db",
+        ))
+    from horaedb_tpu.objstore import LocalStore
+
+    return LocalStore(os.environ[ROOT_ENV])
+
+
+async def _close_store(store) -> None:
+    closer = getattr(store, "close", None)
+    if closer is not None:
+        await closer()
 
 
 def _engine_env() -> dict:
@@ -40,7 +63,6 @@ def writer(round_no: int) -> None:
     jax.config.update("jax_platforms", "cpu")
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from horaedb_tpu.engine import MetricEngine
-    from horaedb_tpu.objstore import LocalStore
     from horaedb_tpu.pb import remote_write_pb2
 
     def payload() -> bytes:
@@ -62,12 +84,13 @@ def writer(round_no: int) -> None:
         return req.SerializeToString()
 
     async def run() -> None:
-        store = LocalStore(os.environ[ROOT_ENV])
+        store = _open_store()
         eng = await MetricEngine.open(
             "db", store, enable_compaction=False, ingest_buffer_rows=4096
         )
         n = await eng.write_payload(payload())
         await eng.close()  # flush + durable
+        await _close_store(store)
         print(json.dumps({"role": "writer", "round": round_no, "samples": n}))
 
     asyncio.run(run())
@@ -81,10 +104,9 @@ def reader(expect_rounds: int) -> None:
     jax.config.update("jax_platforms", "cpu")
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from horaedb_tpu.engine import MetricEngine, QueryRequest
-    from horaedb_tpu.objstore import LocalStore
 
     async def run() -> None:
-        store = LocalStore(os.environ[ROOT_ENV])
+        store = _open_store()
         eng = await MetricEngine.open("db", store, enable_compaction=False)
         t = await eng.query(
             QueryRequest(metric=b"shared_metric", start_ms=0, end_ms=1 << 60)
@@ -101,6 +123,7 @@ def reader(expect_rounds: int) -> None:
         )
         filtered = 0 if t1 is None else t1.num_rows
         await eng.close()
+        await _close_store(store)
         expect_rows = expect_rounds * SERIES * SAMPLES_PER_SERIES
         ok = (
             rows == expect_rows
@@ -122,6 +145,13 @@ def main() -> None:
     env = _engine_env()
     env[ROOT_ENV] = root
     me = os.path.abspath(__file__)
+    stop_s3 = None
+    if os.environ.get("SHARED_STORE_S3") == "1":
+        sys.path.insert(0, os.path.dirname(me))
+        from soak import start_fake_s3
+
+        url, stop_s3 = start_fake_s3(bucket="shared")
+        env[S3_URL_ENV] = url
 
     def child(args: list[str]) -> None:
         r = subprocess.run(
@@ -130,11 +160,18 @@ def main() -> None:
         if r.returncode != 0:
             raise SystemExit(r.returncode)
 
-    child(["writer", "0"])
-    child(["reader", "1"])   # sees round 0 exactly
-    child(["writer", "1"])
-    child(["reader", "2"])   # a fresh reader sees both rounds
-    print(json.dumps({"bench": "shared_store_dryrun", "ok": True, "root": root}))
+    try:
+        child(["writer", "0"])
+        child(["reader", "1"])   # sees round 0 exactly
+        child(["writer", "1"])
+        child(["reader", "2"])   # a fresh reader sees both rounds
+    finally:
+        if stop_s3 is not None:
+            stop_s3()
+    print(json.dumps({
+        "bench": "shared_store_dryrun", "ok": True, "root": root,
+        "store": "S3Like" if os.environ.get("SHARED_STORE_S3") == "1" else "Local",
+    }))
 
 
 if __name__ == "__main__":

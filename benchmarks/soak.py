@@ -120,6 +120,36 @@ async def run_soak(seconds: int) -> dict:
     return stats
 
 
+def start_fake_s3(bucket: str = "soak") -> tuple[str, "object"]:
+    """Host a FakeS3 on a dedicated thread/loop; returns (url, stop_fn).
+    SOAK_S3=1 points the server subprocess at it so the whole soak runs with
+    S3 as the only durability layer."""
+    import threading
+
+    from horaedb_tpu.objstore.fake_s3 import FakeS3
+
+    fake = FakeS3(bucket=bucket)
+    loop = asyncio.new_event_loop()
+    box: dict = {}
+    started = threading.Event()
+
+    def run() -> None:
+        asyncio.set_event_loop(loop)
+        box["url"] = loop.run_until_complete(fake.start())
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, name="fake-s3", daemon=True).start()
+    if not started.wait(10):
+        raise RuntimeError("fake S3 failed to start")
+
+    def stop() -> None:
+        asyncio.run_coroutine_threadsafe(fake.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+
+    return box["url"], stop
+
+
 def main() -> None:
     seconds = int(sys.argv[1]) if len(sys.argv) > 1 else 20
     data_dir = tempfile.mkdtemp(prefix="soak_")
@@ -128,13 +158,26 @@ def main() -> None:
     # flush + flush-before-query consistency under concurrent load)
     buffer_rows = int(os.environ.get("SOAK_BUFFER_ROWS", "0"))
     num_regions = int(os.environ.get("SOAK_REGIONS", "1"))
+    stop_s3 = None
+    if os.environ.get("SOAK_S3") == "1":
+        s3_url, stop_s3 = start_fake_s3()
+        store_toml = (
+            '[metric_engine.storage.object_store]\ntype = "S3Like"\n'
+            f'region = "local"\nendpoint = "{s3_url}"\nbucket = "soak"\n'
+            'key_id = "soak-id"\nkey_secret = "soak-secret"\nprefix = "db"\n'
+        )
+    else:
+        store_toml = (
+            '[metric_engine.storage.object_store]\ntype = "Local"\n'
+            f'data_dir = "{data_dir}/db"\n'
+        )
     with open(cfg, "w") as f:
         f.write(
             f'port = {PORT}\n[test]\nsegment_duration = "2h"\n'
             f"[metric_engine]\ningest_buffer_rows = {buffer_rows}\n"
             f"num_regions = {num_regions}\n"
             f'ingest_flush_interval = "250ms"\n'
-            f'[metric_engine.storage.object_store]\ntype = "Local"\ndata_dir = "{data_dir}/db"\n'
+            + store_toml
         )
     env = dict(os.environ)
     env["HORAEDB_JAX_PLATFORM"] = env.get("HORAEDB_JAX_PLATFORM", "cpu")
@@ -156,6 +199,7 @@ def main() -> None:
         )
         stats["bench"] = "soak"
         stats["seconds"] = seconds
+        stats["store"] = "S3Like" if stop_s3 else "Local"
         stats["ok"] = ok
         print(json.dumps(stats))
         if not ok:
@@ -166,6 +210,8 @@ def main() -> None:
             server.wait(timeout=10)
         except subprocess.TimeoutExpired:
             server.kill()
+        if stop_s3 is not None:
+            stop_s3()
         if log_path:
             log_f.close()
 

@@ -10,6 +10,7 @@ scan with device predicate -> device aggregation.
 from __future__ import annotations
 
 import hashlib
+import logging
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -25,6 +26,8 @@ from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.storage import ObjectBasedStorage
 from horaedb_tpu.storage.types import TimeRange
+
+logger = logging.getLogger(__name__)
 
 NAME_LABEL = b"__name__"
 
@@ -107,7 +110,13 @@ class MetricEngine:
         )
 
         self.metric_mgr = MetricManager(self.metrics_table, segment_duration_ms)
-        self.index_mgr = IndexManager(self.series_table, self.index_table, segment_duration_ms)
+        self.index_mgr = IndexManager(
+            self.series_table, self.index_table, segment_duration_ms,
+            # base sidecar lives beside the two tables it caches, in a
+            # namespace neither table's manifest/data layout touches
+            sidecar_store=store,
+            sidecar_path=f"{root}/index_sidecar/base.arrow",
+        )
         # Payload-shape fingerprint cache: scrapers resend the same series
         # set every interval, so the (metric_id, tsid) lane BYTES repeat
         # exactly payload-over-payload. A hit proves this exact lane-set was
@@ -137,6 +146,14 @@ class MetricEngine:
 
     async def close(self) -> None:
         await self.flush()
+        # quiesced now: fold the index into its sidecar so the next open
+        # replays nothing (best-effort — open rebuilds from the tables if
+        # this never lands)
+        try:
+            await self.index_mgr.dump_sidecar()
+        except Exception:  # noqa: BLE001
+            logger.warning("index sidecar dump failed; next open will rebuild",
+                           exc_info=True)
         for t in (
             self.metrics_table,
             self.series_table,

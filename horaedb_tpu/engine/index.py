@@ -25,6 +25,7 @@ verifying the stored raw tag bytes.
 
 from __future__ import annotations
 
+import logging
 import threading
 from collections import defaultdict
 from dataclasses import dataclass
@@ -43,6 +44,8 @@ from horaedb_tpu.engine.types import (
 )
 from horaedb_tpu.storage.read import ScanRequest, WriteRequest
 from horaedb_tpu.storage.types import TimeRange
+
+logger = logging.getLogger(__name__)
 
 _ALL_TIME = TimeRange(-(2**62), 2**62)
 
@@ -220,10 +223,25 @@ def _build_base(
 
 
 class IndexManager:
-    def __init__(self, series_storage, index_storage, segment_duration_ms: int):
+    def __init__(
+        self,
+        series_storage,
+        index_storage,
+        segment_duration_ms: int,
+        sidecar_store=None,
+        sidecar_path: str = "",
+    ):
         self._series = series_storage
         self._index = index_storage
         self._segment_duration = segment_duration_ms
+        # Arrow-IPC base sidecar (VERDICT r03 #7): open used to be O(full
+        # rebuild) — a scan of the whole series+index tables (~10 s at 1M
+        # series, ~100 s at the RFC's 10M design point). The sidecar dumps
+        # the folded base at close (and after a cold rebuild), stamped with
+        # the max SST id it covers; open loads it and replays only the SSTs
+        # that landed after the watermark.
+        self._sidecar_store = sidecar_store
+        self._sidecar_path = sidecar_path
         # BASE tier: metric_id -> immutable arrays (atomic reference swap)
         self._base: dict[int, _MetricIndex] = {}
         # DELTA tier (series registered since open/compact):
@@ -249,12 +267,28 @@ class IndexManager:
         self._compact_lock: "asyncio.Lock | None" = None
 
     async def open(self) -> None:
+        watermark = await self._load_sidecar()
+        if watermark is not None:
+            await self._replay_since(watermark)
+            return
+        await self._rebuild_from_tables()
+        # make the NEXT open fast even if this process never closes cleanly;
+        # best-effort — the sidecar is a cache, a failed put must not abort
+        # an open whose rebuild just succeeded
+        try:
+            await self.dump_sidecar()
+        except Exception:  # noqa: BLE001
+            logger.warning("index sidecar write failed at open; next open "
+                           "will rebuild", exc_info=True)
+
+    async def _rebuild_from_tables(self) -> None:
         s_mid, s_tsid = [], []
-        async for batch in self._series.scan(ScanRequest(range=_ALL_TIME)):
+        req = ScanRequest(range=_ALL_TIME)
+        async for batch in self._series.scan(req):
             s_mid.append(batch.column("metric_id").to_numpy(zero_copy_only=False))
             s_tsid.append(batch.column("tsid").to_numpy(zero_copy_only=False))
         i_mid, i_hash, i_tsid, i_key, i_val = [], [], [], [], []
-        async for batch in self._index.scan(ScanRequest(range=_ALL_TIME)):
+        async for batch in self._index.scan(req):
             i_mid.append(batch.column("metric_id").to_numpy(zero_copy_only=False))
             i_hash.append(batch.column("tag_hash").to_numpy(zero_copy_only=False))
             i_tsid.append(batch.column("tsid").to_numpy(zero_copy_only=False))
@@ -277,6 +311,257 @@ class IndexManager:
             cat(i_mid, np.uint64), cat(i_hash, np.uint64), cat(i_tsid, np.uint64),
             cat_arrow(i_key), cat_arrow(i_val),
         )
+
+    # -- base sidecar ---------------------------------------------------------
+    # Layout: b"HIDX" + u32 version + u64 watermark + u64 len(bounds ipc) +
+    # u64 len(series ipc), then three Arrow IPC streams:
+    #   bounds:   metric_id / s_start / s_count / p_start / p_count
+    #   series:   metric_id / tsid            (sorted by (metric_id, tsid))
+    #   postings: metric_id / tag_hash / tsid / tag_key / tag_value
+    #                                         (sorted by (metric_id, hash))
+    # The arrays are dumped PRE-SORTED with per-metric boundaries, so load
+    # skips every sort: per metric it takes O(1) numpy views / arrow slices
+    # of the (possibly memory-mapped) buffers — open cost is O(#metrics),
+    # not O(#series log #series). Loaded with a blanket try/except: a
+    # corrupt or version-skewed sidecar falls back to the full rebuild — it
+    # is a CACHE of the tables, never the source of truth.
+
+    _SIDECAR_MAGIC = b"HIDX"
+    _SIDECAR_VERSION = 2
+
+    def _watermark(self) -> int:
+        ids = [s.id for s in self._series._manifest.all_ssts()]
+        ids += [s.id for s in self._index._manifest.all_ssts()]
+        return max(ids, default=0)
+
+    async def dump_sidecar(self) -> None:
+        """Write the folded base+delta as the sidecar. Callers must be
+        quiesced (open/close): with registrations in flight, a row can be
+        durable in an SST <= watermark but not yet committed to the delta,
+        and the dump would lose it."""
+        if self._sidecar_store is None:
+            return
+        with self._mu:
+            base = dict(self._base)
+            known = {m: set(s) for m, s in self._metric_known.items()}
+            postings = {k: dict(v) for k, v in self._postings.items()}
+        watermark = self._watermark()
+
+        def build() -> bytes:
+            # flatten base + delta, one global sort each, then per-metric
+            # boundaries — the LOAD side never sorts
+            s_mid_l: list[np.ndarray] = []
+            s_tsid_l: list[np.ndarray] = []
+            for m, b in base.items():
+                s_mid_l.append(np.full(len(b.tsids), m, np.uint64))
+                s_tsid_l.append(np.asarray(b.tsids, np.uint64))
+            for m, s in known.items():
+                arr = np.fromiter(s, np.uint64, len(s))
+                s_mid_l.append(np.full(len(arr), m, np.uint64))
+                s_tsid_l.append(arr)
+            s_mid = (np.concatenate(s_mid_l) if s_mid_l
+                     else np.empty(0, np.uint64))
+            s_tsid = (np.concatenate(s_tsid_l) if s_tsid_l
+                      else np.empty(0, np.uint64))
+            order = np.lexsort((s_tsid, s_mid))
+            s_mid, s_tsid = s_mid[order], s_tsid[order]
+            if len(s_mid):  # dedup (mid, tsid) pairs — base invariant
+                keep = np.ones(len(s_mid), bool)
+                keep[1:] = (s_mid[1:] != s_mid[:-1]) | (s_tsid[1:] != s_tsid[:-1])
+                s_mid, s_tsid = s_mid[keep], s_tsid[keep]
+
+            i_mid_l = [np.full(len(b.p_hash), m, np.uint64)
+                       for m, b in base.items() if len(b.p_hash)]
+            i_hash_l = [np.asarray(b.p_hash) for b in base.values()
+                        if len(b.p_hash)]
+            i_tsid_l = [np.asarray(b.p_tsid) for b in base.values()
+                        if len(b.p_hash)]
+            i_kv_l = [(b.p_key, b.p_value) for b in base.values()
+                      if len(b.p_hash)]
+            d_mid, d_hash, d_tsid, d_k, d_v = [], [], [], [], []
+            for (m, h), rows in postings.items():
+                for t, (k, v) in rows.items():
+                    d_mid.append(m)
+                    d_hash.append(h)
+                    d_tsid.append(t)
+                    d_k.append(k)
+                    d_v.append(v)
+            if d_mid:
+                i_mid_l.append(np.asarray(d_mid, np.uint64))
+                i_hash_l.append(np.asarray(d_hash, np.uint64))
+                i_tsid_l.append(np.asarray(d_tsid, np.uint64))
+                i_kv_l.append((pa.array(d_k, pa.binary()),
+                               pa.array(d_v, pa.binary())))
+            if i_mid_l:
+                i_mid = np.concatenate(i_mid_l)
+                i_hash = np.concatenate(i_hash_l)
+                i_tsid = np.concatenate(i_tsid_l)
+                i_key = pa.concat_arrays([
+                    c for k, _ in i_kv_l
+                    for c in (k.chunks if isinstance(k, pa.ChunkedArray) else [k])
+                ])
+                i_val = pa.concat_arrays([
+                    c for _, v in i_kv_l
+                    for c in (v.chunks if isinstance(v, pa.ChunkedArray) else [v])
+                ])
+                iorder = np.lexsort((i_hash, i_mid))
+                i_mid, i_hash, i_tsid = (
+                    i_mid[iorder], i_hash[iorder], i_tsid[iorder]
+                )
+                take = pa.array(iorder)
+                i_key, i_val = i_key.take(take), i_val.take(take)
+            else:
+                i_mid = i_hash = i_tsid = np.empty(0, np.uint64)
+                i_key = i_val = pa.array([], pa.binary())
+
+            # per-metric boundaries over BOTH sorted tables
+            mids = np.union1d(np.unique(s_mid), np.unique(i_mid))
+            s_start = np.searchsorted(s_mid, mids, side="left")
+            s_end = np.searchsorted(s_mid, mids, side="right")
+            p_start = np.searchsorted(i_mid, mids, side="left")
+            p_end = np.searchsorted(i_mid, mids, side="right")
+            bounds = pa.table({
+                "metric_id": mids.astype(np.uint64),
+                "s_start": s_start.astype(np.int64),
+                "s_count": (s_end - s_start).astype(np.int64),
+                "p_start": p_start.astype(np.int64),
+                "p_count": (p_end - p_start).astype(np.int64),
+            })
+            s_table = pa.table({"metric_id": s_mid, "tsid": s_tsid})
+            i_table = pa.table({
+                "metric_id": i_mid, "tag_hash": i_hash, "tsid": i_tsid,
+                "tag_key": i_key, "tag_value": i_val,
+            })
+
+            def ipc(table: pa.Table) -> bytes:
+                sink = pa.BufferOutputStream()
+                with pa.ipc.new_stream(sink, table.schema) as w:
+                    w.write_table(table)
+                return sink.getvalue().to_pybytes()
+
+            b_ipc, s_ipc, i_ipc = ipc(bounds), ipc(s_table), ipc(i_table)
+            import struct
+
+            header = self._SIDECAR_MAGIC + struct.pack(
+                "<IQQQ", self._SIDECAR_VERSION, watermark,
+                len(b_ipc), len(s_ipc),
+            )
+            return header + b_ipc + s_ipc + i_ipc
+
+        import asyncio
+
+        payload = await asyncio.to_thread(build)
+        await self._sidecar_store.put(self._sidecar_path, payload)
+
+    async def _load_sidecar(self) -> int | None:
+        """Load the base from the sidecar; returns its watermark, or None
+        (absent/corrupt/stale-version) meaning: do the full rebuild.
+
+        Zero-sort load: the payload is pre-sorted with per-metric
+        boundaries, so this is one buffer read (memory-mapped when the
+        store has a local path) + O(#metrics) numpy views / arrow slices."""
+        if self._sidecar_store is None:
+            return None
+        import struct
+
+        from horaedb_tpu.objstore import NotFound
+
+        local = self._sidecar_store.local_path(self._sidecar_path)
+        try:
+            if local is not None:
+                try:
+                    buf = pa.memory_map(local).read_buffer()
+                except (OSError, pa.ArrowInvalid):
+                    return None
+                payload = memoryview(buf)
+            else:
+                payload = memoryview(await self._sidecar_store.get(
+                    self._sidecar_path
+                ))
+        except NotFound:
+            return None
+        try:
+            if bytes(payload[:4]) != self._SIDECAR_MAGIC:
+                return None
+            version, watermark, b_len, s_len = struct.unpack(
+                "<IQQQ", payload[4:32]
+            )
+            if version != self._SIDECAR_VERSION:
+                return None
+            body = payload[32:]
+            bounds = pa.ipc.open_stream(body[:b_len]).read_all()
+            s_table = pa.ipc.open_stream(body[b_len:b_len + s_len]).read_all()
+            i_table = pa.ipc.open_stream(body[b_len + s_len:]).read_all()
+
+            def flat(table, name) -> np.ndarray:
+                col = table.column(name)
+                return col.to_numpy(zero_copy_only=False)
+
+            s_tsid = flat(s_table, "tsid").astype(np.uint64, copy=False)
+            i_hash = flat(i_table, "tag_hash").astype(np.uint64, copy=False)
+            i_tsid = flat(i_table, "tsid").astype(np.uint64, copy=False)
+
+            def bin_col(table, name) -> pa.Array:
+                col = table.column(name)
+                return (col.combine_chunks()
+                        if isinstance(col, pa.ChunkedArray) else col)
+
+            i_key = bin_col(i_table, "tag_key")
+            i_val = bin_col(i_table, "tag_value")
+
+            base: dict[int, _MetricIndex] = {}
+            for m, ss, sc, ps, pc in zip(
+                flat(bounds, "metric_id").tolist(),
+                flat(bounds, "s_start").tolist(),
+                flat(bounds, "s_count").tolist(),
+                flat(bounds, "p_start").tolist(),
+                flat(bounds, "p_count").tolist(),
+            ):
+                base[m] = _MetricIndex(
+                    tsids=s_tsid[ss:ss + sc],
+                    p_hash=i_hash[ps:ps + pc],
+                    p_tsid=i_tsid[ps:ps + pc],
+                    p_key=i_key.slice(ps, pc),
+                    p_value=i_val.slice(ps, pc),
+                )
+            self._base = base
+            return int(watermark)
+        except Exception:  # noqa: BLE001 — cache corrupt: rebuild from truth
+            self._base = {}
+            return None
+
+    async def _replay_since(self, watermark: int) -> None:
+        """Fold SSTs newer than the sidecar watermark into the delta.
+        Idempotent by construction: compaction outputs carry fresh file ids,
+        so already-based rows can reappear — the known-series filter drops
+        them (series and their postings are always persisted together)."""
+        req = ScanRequest(range=_ALL_TIME, min_sst_id=watermark)
+        new_pairs: set[tuple[int, int]] = set()
+        series_rows: list[tuple[int, int, bytes]] = []
+        async for batch in self._series.scan(req):
+            mids = batch.column("metric_id").to_pylist()
+            tsids = batch.column("tsid").to_pylist()
+            keys = batch.column("series_key").to_pylist()
+            for m, t, k in zip(mids, tsids, keys):
+                if (m, t) not in new_pairs and not self._is_known(m, t):
+                    new_pairs.add((m, t))
+                    series_rows.append((m, t, k))
+        index_rows: list[tuple[int, int, int, bytes, bytes]] = []
+        if new_pairs:
+            async for batch in self._index.scan(req):
+                mids = batch.column("metric_id").to_pylist()
+                hashes = batch.column("tag_hash").to_pylist()
+                tsids = batch.column("tsid").to_pylist()
+                ks = batch.column("tag_key").to_pylist()
+                vs = batch.column("tag_value").to_pylist()
+                for m, h, t, k, v in zip(mids, hashes, tsids, ks, vs):
+                    if (m, t) in new_pairs:
+                        index_rows.append((m, h, t, k, v))
+        if series_rows:
+            # a large crash replay can overfill the delta tier — honor the
+            # compaction signal exactly like the live registration paths
+            if self._commit_rows(series_rows, index_rows):
+                await self._compact_delta()
 
     # -- write path ----------------------------------------------------------
     def _is_known(self, mid: int, tsid: int) -> bool:

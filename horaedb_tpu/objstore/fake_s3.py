@@ -1,0 +1,163 @@
+"""In-process fake S3 endpoint for tests, soak runs, and dev.
+
+The reference tests its store layer against tmpdir LocalFileSystem
+(storage.rs:394-396) because the `object_store` crate is assumed correct;
+this repo's S3 client is first-party, so it gets a real HTTP counterparty:
+an aiohttp server speaking the subset of the S3 API the client uses —
+GET/PUT/HEAD/DELETE on objects and ListObjectsV2 with continuation tokens.
+
+Fault injection for retry tests: `fail_next(n, status)` makes the next n
+object requests fail with the given status. Every request's Authorization
+header is recorded so tests can assert SigV4 signing happened (full
+signature VERIFICATION also supported via `verify_signatures`, using the
+same public algorithm from the client module — a differential check, both
+sides computing independently from the raw request).
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from xml.sax.saxutils import escape
+
+from aiohttp import web
+
+from horaedb_tpu.objstore.s3 import sign_v4
+
+_LIST_PAGE = 1000
+
+
+class FakeS3:
+    """One bucket namespace held in a dict; start()/stop() manage the site."""
+
+    def __init__(self, bucket: str = "test-bucket",
+                 verify_signatures: tuple[str, str, str] | None = None,
+                 list_page: int = _LIST_PAGE) -> None:
+        self.bucket = bucket
+        self.objects: dict[str, bytes] = {}
+        self.auth_headers: list[str] = []
+        self.requests: list[tuple[str, str]] = []
+        self.list_page = list_page
+        self._fail_budget = 0
+        self._fail_status = 500
+        # (key_id, key_secret, region) -> reject bad signatures with 403
+        self._verify = verify_signatures
+        self._runner: web.AppRunner | None = None
+        self.port: int | None = None
+
+    # -- fault injection -----------------------------------------------------
+
+    def fail_next(self, n: int, status: int = 500) -> None:
+        self._fail_budget = n
+        self._fail_status = status
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_route("GET", "/{bucket}", self._list)
+        app.router.add_route("*", "/{bucket}/{key:.*}", self._object)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- request handling ----------------------------------------------------
+
+    def _gate(self, request: web.Request) -> web.Response | None:
+        self.requests.append((request.method, request.path_qs))
+        auth = request.headers.get("Authorization", "")
+        self.auth_headers.append(auth)
+        if self._fail_budget > 0:
+            self._fail_budget -= 1
+            return web.Response(status=self._fail_status, text="injected fault")
+        if self._verify is not None:
+            resp = self._check_signature(request, auth)
+            if resp is not None:
+                return resp
+        return None
+
+    def _check_signature(self, request: web.Request, auth: str) -> web.Response | None:
+        key_id, key_secret, region = self._verify
+        try:
+            signed = dict(
+                part.split("=", 1)
+                for part in auth.removeprefix("AWS4-HMAC-SHA256 ").split(", ")
+            )
+            signed_names = signed["SignedHeaders"].split(";")
+        except (ValueError, KeyError):
+            return web.Response(status=403, text="malformed Authorization")
+        headers = {n: request.headers.get(n, "") for n in signed_names}
+        expect = sign_v4(
+            request.method,
+            urllib.parse.quote(request.path, safe="/-_.~"),
+            [(k, v) for k, v in request.query.items()],
+            headers,
+            request.headers.get("x-amz-content-sha256", ""),
+            key_id, key_secret, region,
+            request.headers.get("x-amz-date", ""),
+        )
+        if expect != auth:
+            return web.Response(status=403, text="SignatureDoesNotMatch")
+        return None
+
+    async def _object(self, request: web.Request) -> web.Response:
+        gated = self._gate(request)
+        if gated is not None:
+            return gated
+        if request.match_info["bucket"] != self.bucket:
+            return web.Response(status=404, text="NoSuchBucket")
+        key = request.match_info["key"]
+        if request.method == "PUT":
+            self.objects[key] = await request.read()
+            return web.Response(status=200)
+        if key not in self.objects:
+            return web.Response(status=404, text="NoSuchKey")
+        if request.method == "GET":
+            return web.Response(body=self.objects[key])
+        if request.method == "HEAD":
+            return web.Response(
+                headers={"Content-Length": str(len(self.objects[key]))}
+            )
+        if request.method == "DELETE":
+            del self.objects[key]
+            return web.Response(status=204)
+        return web.Response(status=405)
+
+    async def _list(self, request: web.Request) -> web.Response:
+        gated = self._gate(request)
+        if gated is not None:
+            return gated
+        if request.match_info["bucket"] != self.bucket:
+            return web.Response(status=404, text="NoSuchBucket")
+        if request.query.get("list-type") != "2":
+            return web.Response(status=400, text="only ListObjectsV2")
+        prefix = request.query.get("prefix", "")
+        token = request.query.get("continuation-token", "")
+        keys = sorted(k for k in self.objects if k.startswith(prefix))
+        if token:
+            keys = [k for k in keys if k > token]
+        page, rest = keys[: self.list_page], keys[self.list_page:]
+        items = "".join(
+            f"<Contents><Key>{escape(k)}</Key>"
+            f"<Size>{len(self.objects[k])}</Size></Contents>"
+            for k in page
+        )
+        trunc = "true" if rest else "false"
+        nxt = (
+            f"<NextContinuationToken>{escape(page[-1])}</NextContinuationToken>"
+            if rest else ""
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"<IsTruncated>{trunc}</IsTruncated>{nxt}{items}"
+            "</ListBucketResult>"
+        )
+        return web.Response(text=xml, content_type="application/xml")

@@ -1,0 +1,321 @@
+"""S3-compatible object store backend.
+
+The reference's "cloud native" data plane IS the object store: its server
+config defines the full S3 knob tree (region/keys/endpoint/bucket/prefix/
+max_retries/http/timeout — src/server/src/config.rs:104-170) in front of the
+`object_store` crate. This is the TPU framework's equivalent: the same five
+verbs (put/get/list/delete/head) over any S3-compatible HTTP endpoint
+(AWS, minio, GCS-interop, the in-repo fake), signed with AWS Signature v4,
+with bounded retries and the reference's two-tier timeout split (metadata ops
+vs data IO).
+
+Design notes:
+- Path-style addressing (`{endpoint}/{bucket}/{key}`) because the endpoint is
+  always explicit in the config — virtual-hosted style needs DNS wildcards
+  that self-hosted S3s rarely have.
+- `delete` HEADs first so a missing object raises NotFound: S3's DELETE is
+  idempotent (204 for absent keys) but the engine contract distinguishes
+  missing-from-present (manifest recovery, manifest/mod.rs:336-354).
+- Retries: idempotent verbs retry on 5xx/429 and transport errors with
+  exponential backoff (50 ms * 2^n, capped 2 s), `max_retries` total attempts.
+  PUT is retried too — S3 PUT is atomic-replace, so a duplicate is harmless.
+- ListObjectsV2 with continuation tokens; keys are returned RELATIVE to the
+  configured prefix so the engine sees the same namespace as LocalStore.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import hashlib
+import hmac
+import logging
+import urllib.parse
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.common.time_ext import ReadableDuration
+from horaedb_tpu.objstore import NotFound, ObjectMeta, ObjectStore
+
+logger = logging.getLogger(__name__)
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+@dataclass
+class HttpOptions:
+    """Connection-pool knobs (reference config.rs:135-151, same defaults)."""
+
+    pool_max_idle_per_host: int = 1024
+    timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(15)
+    )
+    keep_alive_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(10)
+    )
+    keep_alive_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(2)
+    )
+
+
+@dataclass
+class TimeoutOptions:
+    """Two-tier timeouts (reference config.rs:153-170): `timeout` bounds
+    single-object metadata ops (head/delete/list page), `io_timeout` bounds
+    data-moving ops (get/put)."""
+
+    timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(10)
+    )
+    io_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(10)
+    )
+
+
+@dataclass
+class S3LikeConfig:
+    """Mirror of the reference's S3LikeStorageConfig (config.rs:104-130)."""
+
+    region: str = ""
+    key_id: str = ""
+    key_secret: str = ""
+    endpoint: str = ""
+    bucket: str = ""
+    prefix: str = ""
+    max_retries: int = 3
+    http: HttpOptions = field(default_factory=HttpOptions)
+    timeout: TimeoutOptions = field(default_factory=TimeoutOptions)
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sign_v4(
+    method: str,
+    canonical_uri: str,
+    query: list[tuple[str, str]],
+    headers: dict[str, str],
+    payload_hash: str,
+    key_id: str,
+    key_secret: str,
+    region: str,
+    amz_date: str,
+) -> str:
+    """AWS Signature Version 4 for service "s3" — returns the Authorization
+    header value. Public algorithm (AWS docs "Signature Calculations for the
+    Authorization Header"); `headers` must already include host and
+    x-amz-date, and every header given is signed."""
+    date = amz_date[:8]
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(query)
+    )
+    lower = {k.lower().strip(): " ".join(v.split()) for k, v in headers.items()}
+    signed_names = ";".join(sorted(lower))
+    canonical_headers = "".join(f"{k}:{lower[k]}\n" for k in sorted(lower))
+    canonical_request = "\n".join([
+        method, canonical_uri, canonical_query, canonical_headers,
+        signed_names, payload_hash,
+    ])
+    scope = f"{date}/{region}/s3/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest(),
+    ])
+    k = _hmac(("AWS4" + key_secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, "s3")
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    return (
+        f"AWS4-HMAC-SHA256 Credential={key_id}/{scope}, "
+        f"SignedHeaders={signed_names}, Signature={signature}"
+    )
+
+
+class S3Error(HoraeError):
+    """Non-retryable (or retries-exhausted) S3 response."""
+
+
+class S3LikeStore(ObjectStore):
+    """ObjectStore over an S3-compatible endpoint (see module docstring)."""
+
+    def __init__(self, config: S3LikeConfig) -> None:
+        if not config.endpoint or not config.bucket:
+            raise HoraeError("S3Like store requires endpoint and bucket")
+        self.config = config
+        self._endpoint = config.endpoint.rstrip("/")
+        self._host = urllib.parse.urlparse(self._endpoint).netloc
+        self._prefix = config.prefix.strip("/")
+        self._session = None  # created lazily inside the running loop
+
+    # -- key <-> object mapping ---------------------------------------------
+
+    def _key(self, path: str) -> str:
+        p = path.lstrip("/")
+        full = f"{self._prefix}/{p}" if self._prefix else p
+        if ".." in full.split("/"):
+            raise HoraeError(f"path escapes store prefix: {path}")
+        return full
+
+    def _uri(self, key: str) -> str:
+        # sign and request the SAME encoding; '/' stays literal
+        return "/" + urllib.parse.quote(f"{self.config.bucket}/{key}", safe="/-_.~")
+
+    # -- transport ----------------------------------------------------------
+
+    async def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+
+            ka = self.config.http.keep_alive_timeout.seconds
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(
+                    limit_per_host=self.config.http.pool_max_idle_per_host,
+                    keepalive_timeout=ka,
+                ),
+                timeout=aiohttp.ClientTimeout(
+                    connect=self.config.http.timeout.seconds
+                ),
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def _headers(
+        self, method: str, uri: str, query: list[tuple[str, str]], payload: bytes | None
+    ) -> dict[str, str]:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        payload_hash = (
+            hashlib.sha256(payload).hexdigest() if payload else _EMPTY_SHA256
+        )
+        headers = {
+            "host": self._host,
+            "x-amz-date": amz_date,
+            "x-amz-content-sha256": payload_hash,
+        }
+        headers["Authorization"] = sign_v4(
+            method, uri, query, headers, payload_hash,
+            self.config.key_id, self.config.key_secret,
+            self.config.region, amz_date,
+        )
+        return headers
+
+    async def _request(
+        self,
+        method: str,
+        key: str,
+        *,
+        query: list[tuple[str, str]] | None = None,
+        payload: bytes | None = None,
+        io: bool = False,
+        uri: str | None = None,
+    ):
+        """One signed request with bounded retries. Returns (status, body,
+        content_length). 404 surfaces as NotFound; other 4xx raise S3Error
+        immediately; 5xx/429 and transport errors retry."""
+        import aiohttp
+
+        import yarl
+
+        session = await self._ensure_session()
+        query = query or []
+        uri = uri if uri is not None else self._uri(key)
+        # the WIRE query string must be byte-identical to the canonical query
+        # that was signed — build it once and pass pre-encoded so yarl
+        # cannot re-quote it differently
+        qs = "&".join(
+            f"{urllib.parse.quote(k, safe='-_.~')}="
+            f"{urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in sorted(query)
+        )
+        url = yarl.URL(self._endpoint + uri + (f"?{qs}" if qs else ""),
+                       encoded=True)
+        tmo = (self.config.timeout.io_timeout if io else self.config.timeout.timeout)
+        req_timeout = aiohttp.ClientTimeout(total=tmo.seconds)
+        attempts = max(1, self.config.max_retries)
+        last: str = ""
+        for attempt in range(attempts):
+            headers = self._headers(method, uri, query, payload)
+            try:
+                async with session.request(
+                    method,
+                    url,
+                    data=payload,
+                    headers=headers,
+                    timeout=req_timeout,
+                ) as resp:
+                    body = await resp.read()
+                    if resp.status == 404:
+                        raise NotFound(f"object not found: {key}")
+                    if resp.status in (429,) or resp.status >= 500:
+                        last = f"HTTP {resp.status}: {body[:200]!r}"
+                    elif resp.status >= 400:
+                        raise S3Error(
+                            f"{method} {key}: HTTP {resp.status}: {body[:500]!r}"
+                        )
+                    else:
+                        clen = int(resp.headers.get("Content-Length", len(body)))
+                        return resp.status, body, clen
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                last = f"{type(e).__name__}: {e}"
+            if attempt + 1 < attempts:
+                await asyncio.sleep(min(0.05 * (2 ** attempt), 2.0))
+        raise S3Error(f"{method} {key}: retries exhausted ({attempts}): {last}")
+
+    # -- the five verbs -----------------------------------------------------
+
+    async def put(self, path: str, data: bytes) -> None:
+        await self._request("PUT", self._key(path), payload=bytes(data), io=True)
+
+    async def get(self, path: str) -> bytes:
+        _, body, _ = await self._request("GET", self._key(path), io=True)
+        return body
+
+    async def head(self, path: str) -> ObjectMeta:
+        _, _, clen = await self._request("HEAD", self._key(path))
+        return ObjectMeta(path=path, size=clen)
+
+    async def delete(self, path: str) -> None:
+        # HEAD first: the engine contract raises NotFound for absent keys,
+        # S3's DELETE alone cannot tell (idempotent 204)
+        await self._request("HEAD", self._key(path))
+        await self._request("DELETE", self._key(path))
+
+    async def list(self, prefix: str) -> list[ObjectMeta]:
+        want = self._key(prefix.rstrip("/") + "/" if prefix else "")
+        base_uri = "/" + urllib.parse.quote(self.config.bucket, safe="-_.~")
+        strip = len(self._prefix) + 1 if self._prefix else 0
+        out: list[ObjectMeta] = []
+        token: str | None = None
+        while True:
+            query = [("list-type", "2"), ("prefix", want)]
+            if token:
+                query.append(("continuation-token", token))
+            _, body, _ = await self._request(
+                "GET", f"list:{want}", query=query, uri=base_uri
+            )
+            root = ET.fromstring(body)
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag[: root.tag.index("}") + 1]
+            for item in root.iter(f"{ns}Contents"):
+                k = item.find(f"{ns}Key").text or ""
+                size = int(item.find(f"{ns}Size").text or 0)
+                out.append(ObjectMeta(path=k[strip:], size=size))
+            trunc = root.find(f"{ns}IsTruncated")
+            if trunc is not None and (trunc.text or "").lower() == "true":
+                tok = root.find(f"{ns}NextContinuationToken")
+                token = tok.text if tok is not None else None
+                if not token:
+                    break
+            else:
+                break
+        out.sort(key=lambda m: m.path)
+        return out

@@ -389,7 +389,13 @@ async def build_app(config: Config) -> web.Application:
     from concurrent.futures import ThreadPoolExecutor
 
     config.validate()
-    store = LocalStore(config.metric_engine.storage.object_store.data_dir)
+    store_cfg = config.metric_engine.storage.object_store
+    if store_cfg.type.lower() == "s3like":
+        from horaedb_tpu.objstore.s3 import S3LikeStore
+
+        store = S3LikeStore(store_cfg.to_s3_config())
+    else:
+        store = LocalStore(store_cfg.data_dir)
     segment_ms = config.test.segment_duration.as_millis()
     # ThreadConfig sizes the dedicated executor for CPU-heavy SST work —
     # the analog of the reference's named multi-thread runtimes
@@ -480,6 +486,9 @@ async def build_app(config: Config) -> web.Application:
         await asyncio.gather(*state.write_workers, return_exceptions=True)
         await state.storage.close()
         await state.engine.close()
+        closer = getattr(store, "close", None)
+        if closer is not None:  # S3LikeStore owns an HTTP session
+            await closer()
 
     app.on_cleanup.append(on_cleanup)
     return app

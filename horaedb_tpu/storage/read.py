@@ -79,6 +79,11 @@ class ScanRequest:
     range: TimeRange
     predicate: Predicate | None = None
     projections: list[int] | None = None
+    # Skip SST files with id <= min_sst_id (file granularity — an SST's id
+    # IS its write sequence). The index sidecar replay scans only what
+    # landed after its watermark; compacted outputs get fresh (larger) ids,
+    # so their old rows may reappear — callers must replay idempotently.
+    min_sst_id: int | None = None
 
 
 @dataclass

@@ -500,6 +500,8 @@ class ObjectBasedStorage(ColumnarStorage):
         UnionExec driving per-segment plans concurrently); an early consumer
         break (limit pushdown) cancels the prefetch."""
         ssts = self._manifest.find_ssts(req.range)
+        if req.min_sst_id is not None:
+            ssts = [s for s in ssts if s.id > req.min_sst_id]
         if not ssts:
             return
         segments = self.group_by_segment(ssts)
