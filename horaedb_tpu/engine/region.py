@@ -1,20 +1,36 @@
 """Region partitioning: the RFC's distributed design, implemented.
 
 Reference: docs/rfcs/20240827-metric-engine.md:28-76 — one `root`
-super-table partitioned by hash into Regions, routed by a meta service,
-single writer per region over shared object storage. The snapshot ships no
-implementation (SURVEY §2.5 "inter-node: ABSENT"); this module provides a
-working one:
+super-table range-partitioned by `hash(metric + sorted tags)` into
+Regions, routed by a meta plane, single writer per region over shared
+object storage, with split rules. The snapshot ships no implementation
+(SURVEY §2.5 "inter-node: ABSENT"); this module provides a working one:
 
-- `RegionRouter`: deterministic metric -> region assignment by seahash
-  range (metric granularity, so every query resolves in exactly ONE region
-  — no cross-region merge on the read path; the RFC's series-hash
-  partitioning is a sharper-grained variant of the same scheme).
-- `RegionedEngine`: N independent `MetricEngine` instances over sub-roots
-  `{root}/region-{i}` of one shared object store. Writes split per region
-  (vectorized on the parser's hash lanes); queries route. Each region is a
-  separate LSM with its own manifest — the single-writer-per-region
-  invariant the reference states at types.rs:135.
+- `RangeRouter` (descriptor v2, the default): explicit ranges of the
+  64-bit hash space at SERIES granularity — the route hash is the tsid,
+  which IS seahash(canonical series key) = hash(metric + sorted tags),
+  exactly the RFC's partition key. One metric's series spread across
+  regions; reads fan out and merge. `split_region` halves a region's
+  range: the daughter takes ownership of the upper half for new writes
+  (descriptor rewrite = the meta-plane ownership migration); history
+  stays in the parent and the fan-out merge covers it — the
+  HBase-daughter-reference shape, no data rewrite on split.
+- `RegionRouter` (descriptor v1, legacy): metric-granularity multiply-
+  shift assignment; every query resolves in exactly ONE region. Stores
+  created by earlier builds keep working unchanged.
+- `RegionedEngine`: independent `MetricEngine` instances over sub-roots
+  `{root}/region-{id}` of one shared object store. Writes split per
+  region (vectorized on the parser's hash lanes); queries route (v1) or
+  fan out + merge (v2). Each region is a separate LSM with its own
+  manifest — the single-writer-per-region invariant the reference states
+  at types.rs:135.
+
+Known v2 semantics at splits: ownership moves for NEW writes only. A
+re-write of a pre-split timestamp for a migrated series lands in the
+daughter while the original row stays in the parent; the raw read path
+deduplicates (owner region wins), bucketed aggregates do not (grids
+cannot be deduplicated post-hoc) — append-mostly workloads (the
+remote-write shape) never hit this.
 
 Multi-node deployment shape: run each region's engine in its own process
 (or host) against the same object store — benchmarks/shared_store_dryrun.py
@@ -28,6 +44,8 @@ import numpy as np
 from horaedb_tpu.common.hash import seahash
 from horaedb_tpu.engine.engine import MetricEngine, QueryRequest
 from horaedb_tpu.ingest.types import ParsedWriteRequest
+
+_TOP = 1 << 64
 
 
 class RegionRouter:
@@ -55,6 +73,89 @@ class RegionRouter:
             ((ids >> np.uint64(32)) * np.uint64(self.num_regions))
             >> np.uint64(32)
         ).astype(np.int64)
+
+
+class RangeRouter:
+    """Descriptor-v2 routing: region `ids[i]` owns hashes in
+    `[starts[i], starts[i+1])` (last region up to 2^64). Scalar and
+    vectorized paths share the same boundary array, so writes and queries
+    can never disagree."""
+
+    def __init__(self, starts: list[int], ids: list[int], granularity: str):
+        from horaedb_tpu.common.error import ensure
+
+        ensure(len(starts) == len(ids) and starts and starts[0] == 0,
+               "malformed region ranges")
+        ensure(all(a < b for a, b in zip(starts, starts[1:])),
+               "region range starts must be strictly increasing")
+        ensure(granularity in ("series", "metric"),
+               f"unknown region granularity: {granularity!r}")
+        self.starts = list(starts)
+        self._starts_u64 = np.asarray(starts, dtype=np.uint64)
+        self.ids = list(ids)
+        self._ids_arr = np.asarray(ids, dtype=np.int64)
+        self.granularity = granularity
+
+    @property
+    def num_regions(self) -> int:
+        return len(self.ids)
+
+    def slot_of_hash(self, h: int) -> int:
+        return int(np.searchsorted(self._starts_u64, np.uint64(h),
+                                   side="right")) - 1
+
+    def region_of_hash(self, h: int) -> int:
+        return self.ids[self.slot_of_hash(h)]
+
+    def region_of_name(self, metric_name: bytes) -> int:
+        """Owner of the METRIC hash — the metadata/advisory routing surface
+        (at series granularity data routing uses tsids, not this)."""
+        return self.region_of_hash(seahash(metric_name))
+
+    def regions_of_lanes(
+        self, metric_ids: np.ndarray, tsids: np.ndarray
+    ) -> np.ndarray:
+        """Vectorized region-id per series from the parser's hash lanes."""
+        lane = tsids if self.granularity == "series" else metric_ids
+        lane = lane.astype(np.uint64, copy=False)
+        slots = np.searchsorted(self._starts_u64, lane, side="right") - 1
+        return self._ids_arr[slots]
+
+    def split(self, region_id: int) -> "tuple[RangeRouter, int, int]":
+        """Halve `region_id`'s range; returns (new router, daughter id,
+        split point). The daughter id is fresh (max+1) — region ids are
+        never recycled, they name on-disk sub-roots."""
+        from horaedb_tpu.common.error import ensure
+
+        ensure(region_id in self.ids, f"unknown region {region_id}")
+        slot = self.ids.index(region_id)
+        lo = self.starts[slot]
+        hi = self.starts[slot + 1] if slot + 1 < len(self.starts) else _TOP
+        ensure(hi - lo >= 2, f"region {region_id} range too small to split")
+        mid = lo + ((hi - lo) >> 1)
+        new_id = max(self.ids) + 1
+        starts = self.starts[: slot + 1] + [mid] + self.starts[slot + 1:]
+        ids = self.ids[: slot + 1] + [new_id] + self.ids[slot + 1:]
+        return RangeRouter(starts, ids, self.granularity), new_id, mid
+
+    def to_descriptor(self, initial_num_regions: int) -> dict:
+        return {
+            "version": 2,
+            "granularity": self.granularity,
+            "initial_num_regions": initial_num_regions,
+            "regions": [
+                {"id": i, "start": s} for i, s in zip(self.ids, self.starts)
+            ],
+        }
+
+    @classmethod
+    def from_descriptor(cls, desc: dict) -> "RangeRouter":
+        regions = sorted(desc["regions"], key=lambda r: r["start"])
+        return cls(
+            [r["start"] for r in regions],
+            [r["id"] for r in regions],
+            desc.get("granularity", "series"),
+        )
 
 
 def _subset_request(req: ParsedWriteRequest, series_idx: np.ndarray) -> ParsedWriteRequest:
@@ -131,7 +232,13 @@ class RegionedEngine:
 
     @classmethod
     async def open(
-        cls, root: str, store, num_regions: int, parser_pool=None, **engine_kwargs
+        cls,
+        root: str,
+        store,
+        num_regions: int,
+        parser_pool=None,
+        granularity: str = "series",
+        **engine_kwargs,
     ) -> "RegionedEngine":
         import asyncio
         import json
@@ -139,60 +246,133 @@ class RegionedEngine:
         from horaedb_tpu.common.error import ensure
         from horaedb_tpu.objstore import NotFound
 
-        # The region count is part of the on-disk layout: the router maps
-        # metrics by it, so reopening with a different N would silently make
-        # existing data invisible (or never open some regions at all). A
-        # REGIONS descriptor pins it; mismatches fail loudly.
+        # The initial region count and granularity are part of the on-disk
+        # layout: the router maps series by them, so reopening with a
+        # different N would silently make existing data invisible. The
+        # REGIONS descriptor pins them; mismatches fail loudly. Splits grow
+        # the live region set BEYOND the initial count — the descriptor is
+        # the meta plane and always wins on the live set.
         desc_path = f"{root}/REGIONS"
+        self = object.__new__(cls)
+        self._root = root
+        self._store = store
+        self._desc_path = desc_path
+        self._pool = parser_pool
+        self._initial_num_regions = num_regions
         try:
             desc = json.loads((await store.get(desc_path)).decode())
-            ensure(
-                desc.get("num_regions") == num_regions,
-                f"store at {root!r} was created with "
-                f"num_regions={desc.get('num_regions')}; reopening with "
-                f"{num_regions} would strand data — repartitioning requires "
-                f"a rewrite, not a config change",
-            )
+            if desc.get("version") == 2:
+                ensure(
+                    desc.get("initial_num_regions") == num_regions,
+                    f"store at {root!r} was created with "
+                    f"num_regions={desc.get('initial_num_regions')}; "
+                    f"reopening with {num_regions} would strand data — "
+                    f"repartitioning requires a split or a rewrite, not a "
+                    f"config change",
+                )
+                ensure(
+                    desc.get("granularity", "series") == granularity,
+                    f"store at {root!r} was created with granularity="
+                    f"{desc.get('granularity')!r}; reopening with "
+                    f"{granularity!r} would reroute series away from their "
+                    f"data",
+                )
+                self.router = RangeRouter.from_descriptor(desc)
+            else:
+                # v1 legacy store: metric granularity, multiply-shift
+                ensure(
+                    desc.get("num_regions") == num_regions,
+                    f"store at {root!r} was created with "
+                    f"num_regions={desc.get('num_regions')}; reopening with "
+                    f"{num_regions} would strand data — repartitioning "
+                    f"requires a rewrite, not a config change",
+                )
+                self.router = RegionRouter(num_regions)
         except NotFound:
+            self.router = RangeRouter(
+                [i * _TOP // num_regions for i in range(num_regions)],
+                list(range(num_regions)),
+                granularity,
+            )
             await store.put(
-                desc_path, json.dumps({"num_regions": num_regions}).encode()
+                desc_path,
+                json.dumps(self.router.to_descriptor(num_regions)).encode(),
             )
 
-        self = object.__new__(cls)
-        self.router = RegionRouter(num_regions)
-        self._pool = parser_pool
-        self.engines = []
+        self._engine_kwargs = engine_kwargs
+        self._split_lock = asyncio.Lock()
+        region_ids = (self.router.ids if isinstance(self.router, RangeRouter)
+                      else list(range(num_regions)))
+        self.engines: dict[int, MetricEngine] = {}
         try:
-            for i in range(num_regions):
-                self.engines.append(
-                    await MetricEngine.open(
-                        f"{root}/region-{i}", store, **engine_kwargs
-                    )
+            for i in region_ids:
+                self.engines[i] = await MetricEngine.open(
+                    f"{root}/region-{i}", store, **engine_kwargs
                 )
         except BaseException:
             # close the regions that did open — a retry loop must not leak
             # their tables/flush state
             await asyncio.gather(
-                *(e.close() for e in self.engines), return_exceptions=True
+                *(e.close() for e in self.engines.values()),
+                return_exceptions=True,
             )
             raise
         return self
 
+    @property
+    def _legacy(self) -> bool:
+        return not isinstance(self.router, RangeRouter)
+
+    async def split_region(self, region_id: int) -> int:
+        """Halve `region_id`'s hash range; returns the daughter region id.
+
+        The descriptor rewrite IS the ownership migration (meta plane):
+        new writes in the upper half route to the daughter immediately.
+        Existing SSTs stay in the parent's manifests — the fan-out read
+        path merges them, so nothing is rewritten at split time (RFC
+        :28-76 split rules; HBase-daughter-reference shape)."""
+        import json
+
+        from horaedb_tpu.common.error import ensure
+
+        ensure(not self._legacy,
+               "legacy v1 region stores cannot split; recreate with the "
+               "range-partitioned layout")
+        # serialized: concurrent splits reading the same router would mint
+        # the same daughter id and open two engines on one sub-root
+        async with self._split_lock:
+            new_router, new_id, _mid = self.router.split(region_id)
+            self.engines[new_id] = await MetricEngine.open(
+                f"{self._root}/region-{new_id}", self._store,
+                **self._engine_kwargs,
+            )
+            # engine first, descriptor second: a crash between the two
+            # leaves an empty unreferenced sub-root (harmless), never a
+            # referenced region with no engine state
+            await self._store.put(
+                self._desc_path,
+                json.dumps(
+                    new_router.to_descriptor(self._initial_num_regions)
+                ).encode(),
+            )
+            self.router = new_router
+            return new_id
+
     def sub_engines(self) -> dict[str, MetricEngine]:
         """Uniform enumeration for observability surfaces (prefix -> engine);
         MetricEngine exposes the same shape."""
-        return {f"region-{i}/": e for i, e in enumerate(self.engines)}
+        return {f"region-{i}/": e for i, e in self.engines.items()}
 
     async def close(self) -> None:
         import asyncio
 
-        await asyncio.gather(*(e.close() for e in self.engines))
+        await asyncio.gather(*(e.close() for e in self.engines.values()))
 
     async def flush(self) -> None:
         import asyncio
 
         # regions are isolated engines over disjoint sub-roots: fan out
-        await asyncio.gather(*(e.flush() for e in self.engines))
+        await asyncio.gather(*(e.flush() for e in self.engines.values()))
 
     # -- write path ----------------------------------------------------------
     async def write_payload(self, payload: bytes) -> int:
@@ -217,19 +397,23 @@ class RegionedEngine:
                 .metric_mgr.record_metadata(name, int(req.meta_type[i]))
         if req.n_series == 0:
             return 0
-        if req.series_metric_id is not None:
-            regions = self.router.regions_of_ids(req.series_metric_id)
+        if self._legacy:
+            if req.series_metric_id is not None:
+                regions = self.router.regions_of_ids(req.series_metric_id)
+            else:
+                regions = self.router.regions_of_ids(
+                    self._hash_lanes(req, need_tsids=False)[0]
+                )
         else:
-            from horaedb_tpu.engine.engine import NAME_LABEL
-
-            ids = np.empty(req.n_series, dtype=np.uint64)
-            for s in range(req.n_series):
-                name = b""
-                for k, v in req.series_labels(s):
-                    if k == NAME_LABEL:
-                        name = v
-                ids[s] = seahash(name)
-            regions = self.router.regions_of_ids(ids)
+            need_tsids = self.router.granularity == "series"
+            if req.series_metric_id is not None and (
+                not need_tsids or req.series_tsid is not None
+            ):
+                mids = req.series_metric_id
+                tsids = req.series_tsid if need_tsids else mids
+            else:
+                mids, tsids = self._hash_lanes(req, need_tsids)
+            regions = self.router.regions_of_lanes(mids, tsids)
         uniq = np.unique(regions)
         if len(uniq) == 1:
             if len(req.meta_type):
@@ -254,37 +438,174 @@ class RegionedEngine:
         ))
         return sum(counts)
 
+    def _hash_lanes(
+        self, req: ParsedWriteRequest, need_tsids: bool
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Python-parse fallback: recompute the hash lanes the native parser
+        would have supplied (differentially tested against it)."""
+        from horaedb_tpu.engine.engine import NAME_LABEL
+        from horaedb_tpu.engine.types import series_id_of, series_key_of
+
+        mids = np.empty(req.n_series, dtype=np.uint64)
+        tsids = np.empty(req.n_series, dtype=np.uint64)
+        for s in range(req.n_series):
+            labels = list(req.series_labels(s))
+            name = b""
+            for k, v in labels:
+                if k == NAME_LABEL:
+                    name = v
+            mids[s] = seahash(name)
+            if need_tsids:
+                tsids[s] = series_id_of(series_key_of(labels))
+        return mids, (tsids if need_tsids else mids)
+
     # -- read path -------------------------------------------------------------
+    # v1 routes each metric to its single owner region. v2 fans out and
+    # merges: at series granularity a metric's series span regions by
+    # design, and after any split a migrated series' history lives in the
+    # parent while new samples land in the daughter.
+
     def _engine_for(self, metric: bytes) -> MetricEngine:
         return self.engines[self.router.region_of_name(metric)]
 
     async def query(self, req: QueryRequest):
-        return await self._engine_for(req.metric).query(req)
+        if self._legacy:
+            return await self._engine_for(req.metric).query(req)
+        import asyncio
+
+        ids = list(self.engines)
+        results = await asyncio.gather(
+            *(self.engines[i].query(req) for i in ids)
+        )
+        tagged = [(i, r) for i, r in zip(ids, results) if r is not None]
+        if not tagged:
+            return None
+        if req.bucket_ms is None:
+            return _merge_raw_tables(tagged, self.router, req.limit)
+        return _merge_grids([r for _, r in tagged])
 
     async def query_exemplars(self, req: QueryRequest):
-        return await self._engine_for(req.metric).query_exemplars(req)
+        if self._legacy:
+            return await self._engine_for(req.metric).query_exemplars(req)
+        import asyncio
+
+        import pyarrow as pa
+
+        results = await asyncio.gather(
+            *(e.query_exemplars(req) for e in self.engines.values())
+        )
+        results = [r for r in results if r is not None]
+        if not results:
+            return None
+        merged = pa.concat_tables(results)
+        if req.limit is not None:
+            merged = merged.slice(0, req.limit)
+        return merged
 
     def label_values(self, metric: bytes, key: bytes) -> list[bytes]:
-        return self._engine_for(metric).label_values(metric, key)
+        if self._legacy:
+            return self._engine_for(metric).label_values(metric, key)
+        out: set[bytes] = set()
+        for e in self.engines.values():
+            out.update(e.label_values(metric, key))
+        return sorted(out)
 
     def series(self, metric: bytes):
-        return self._engine_for(metric).series(metric)
+        if self._legacy:
+            return self._engine_for(metric).series(metric)
+        # dedup by tsid: a split-migrated series is registered in both the
+        # parent and the daughter
+        by_tsid: dict[str, dict] = {}
+        for e in self.engines.values():
+            for row in e.series(metric):
+                by_tsid.setdefault(row.get("__tsid__", repr(row)), row)
+        # numeric order, matching the single engine's sorted(per_tsid)
+        return [by_tsid[k] for k in sorted(
+            by_tsid, key=lambda k: (0, int(k)) if k.isdigit() else (1, 0, k)
+        )]
 
     def metric_names(self) -> list[bytes]:
-        """Fan-out union (the one cross-region read surface)."""
+        """Fan-out union (cross-region read surface)."""
         out: list[bytes] = []
-        for e in self.engines:
+        for e in self.engines.values():
             out.extend(e.metric_names())
         return sorted(set(out))
 
     def metadata(self) -> "dict[bytes, str]":
         """Fan-out union of per-region metric-family metadata."""
         out: dict[bytes, str] = {}
-        for e in self.engines:
+        for e in self.engines.values():
             out.update(e.metadata())
         return out
 
     async def compact(self) -> None:
         import asyncio
 
-        await asyncio.gather(*(e.compact() for e in self.engines))
+        await asyncio.gather(*(e.compact() for e in self.engines.values()))
+
+
+def _merge_raw_tables(tagged: list, router: RangeRouter, limit: int | None):
+    """Concat per-region raw-row tables, order by (tsid, field_id, ts), and
+    drop cross-region duplicates of one sample key: a pre-split row
+    re-written post-split exists in both parent and daughter — the row from
+    the region that currently OWNS the series' hash wins (it holds the
+    newest write), matching single-engine upsert semantics."""
+    import pyarrow as pa
+
+    parts = []
+    for region_id, table in tagged:
+        lane_col = "tsid" if router.granularity == "series" else "metric_id"
+        if lane_col in table.column_names:
+            lane = table.column(lane_col).to_numpy().astype(np.uint64,
+                                                            copy=False)
+            owner = router._ids_arr[
+                np.searchsorted(router._starts_u64, lane, side="right") - 1
+            ]
+            prio = (owner != region_id).astype(np.int8)
+        else:
+            prio = np.ones(table.num_rows, np.int8)
+        parts.append(table.append_column("__prio__", pa.array(prio)))
+    merged = pa.concat_tables(parts)
+    sort_keys = [(c, "ascending") for c in ("tsid", "field_id", "ts")
+                 if c in merged.column_names]
+    merged = merged.sort_by(sort_keys + [("__prio__", "ascending")])
+    if len(sort_keys) == 3 and len(tagged) > 1 and merged.num_rows:
+        cols = [merged.column(c).to_numpy() for c, _ in sort_keys]
+        keep = np.ones(merged.num_rows, dtype=bool)
+        # owner sorts first within a duplicate run, so keep-first keeps it
+        keep[1:] = ~np.logical_and.reduce(
+            [c[1:] == c[:-1] for c in cols]
+        )
+        if not keep.all():
+            merged = merged.filter(pa.array(keep))
+    merged = merged.drop_columns(["__prio__"])
+    if limit is not None:
+        merged = merged.slice(0, limit)
+    return merged
+
+
+def _merge_grids(results: list):
+    """Combine per-region (tsids, grids) downsample outputs: union the
+    series axis, add sums/counts, min/max elementwise, recompute mean —
+    the same associative fold the per-segment pushdown uses
+    (data.py::one_segment)."""
+    if len(results) == 1:
+        return results[0]
+    all_tsids = sorted({t for tsids, _ in results for t in tsids})
+    pos = {t: i for i, t in enumerate(all_tsids)}
+    n_buckets = next(iter(results[0][1].values())).shape[1]
+    grids = {
+        "sum": np.zeros((len(all_tsids), n_buckets)),
+        "count": np.zeros((len(all_tsids), n_buckets)),
+        "min": np.full((len(all_tsids), n_buckets), np.inf),
+        "max": np.full((len(all_tsids), n_buckets), -np.inf),
+    }
+    for tsids, part in results:
+        idx = np.asarray([pos[t] for t in tsids], dtype=np.int64)
+        np.add.at(grids["sum"], idx, np.asarray(part["sum"]))
+        np.add.at(grids["count"], idx, np.asarray(part["count"]))
+        np.minimum.at(grids["min"], idx, np.asarray(part["min"]))
+        np.maximum.at(grids["max"], idx, np.asarray(part["max"]))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        grids["mean"] = grids["sum"] / grids["count"]
+    return all_tsids, grids

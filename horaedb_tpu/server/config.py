@@ -124,9 +124,13 @@ class MetricEngineConfig:
         default_factory=lambda: ReadableDuration.secs(1)
     )
     # Region partitioning (RFC :28-76): > 1 runs N independent region
-    # engines over the shared store, metrics routed by seahash range
+    # engines over the shared store, series routed by seahash range
     # (engine/region.py). 1 = a single unpartitioned engine.
     num_regions: int = 1
+    # "series" = hash(metric + sorted tags) range partition (the RFC
+    # design; one metric spans regions, reads fan out + merge, regions can
+    # split). "metric" = coarse metric-granularity routing.
+    region_granularity: str = "series"
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "MetricEngineConfig":

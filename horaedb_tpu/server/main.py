@@ -136,6 +136,35 @@ async def handle_compact(request: web.Request) -> web.Response:
     return web.json_response({"compaction": "triggered"})
 
 
+async def handle_split_region(request: web.Request) -> web.Response:
+    """Meta-plane split op (RFC :28-76 split rules): halves a region's hash
+    range; the daughter owns the upper half for new writes. 400 on a
+    non-regioned deployment or an unknown/unsplittable region."""
+    from horaedb_tpu.engine.region import RegionedEngine
+
+    state: ServerState = request.app[STATE_KEY]
+    if not isinstance(state.engine, RegionedEngine):
+        return web.json_response(
+            {"error": "not a regioned deployment"}, status=400
+        )
+    try:
+        region = int(request.query["region"])
+    except (KeyError, ValueError):
+        return web.json_response(
+            {"error": "query param ?region=<id> required"}, status=400
+        )
+    try:
+        daughter = await state.engine.split_region(region)
+    except HoraeError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    METRICS.inc("horaedb_region_splits_total")
+    return web.json_response({
+        "split": region,
+        "daughter": daughter,
+        "regions": sorted(state.engine.engines),
+    })
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     pool = state.parser_pool.status
@@ -434,7 +463,9 @@ async def build_app(config: Config) -> web.Application:
         from horaedb_tpu.engine.region import RegionedEngine
 
         engine = await RegionedEngine.open(
-            "metrics", store, config.metric_engine.num_regions, **engine_kwargs
+            "metrics", store, config.metric_engine.num_regions,
+            granularity=config.metric_engine.region_granularity,
+            **engine_kwargs,
         )
     else:
         engine = await MetricEngine.open("metrics", store, **engine_kwargs)
@@ -468,6 +499,7 @@ async def build_app(config: Config) -> web.Application:
             web.get("/", handle_root),
             web.get("/toggle", handle_toggle),
             web.get("/compact", handle_compact),
+            web.post("/admin/split_region", handle_split_region),
             web.get("/metrics", handle_metrics),
             web.post("/api/v1/write", handle_remote_write),
             web.post("/api/v1/query", handle_query),

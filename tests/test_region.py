@@ -197,7 +197,7 @@ async def test_regioned_metadata_routes_by_family_and_updates():
 
     await eng.write_payload(meta_payload(1))  # counter
     assert eng.metadata()[b"fam_x"] == "counter"
-    owners = [i for i, e in enumerate(eng.engines) if b"fam_x" in e.metric_mgr.metadata]
+    owners = [i for i, e in eng.engines.items() if b"fam_x" in e.metric_mgr.metadata]
     assert len(owners) == 1, f"metadata duplicated across regions: {owners}"
     await eng.write_payload(meta_payload(2))  # update -> gauge
     assert eng.metadata()[b"fam_x"] == "gauge"

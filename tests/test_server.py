@@ -31,6 +31,70 @@ async def make_client(tmp_path) -> TestClient:
     return client
 
 
+class TestSplitEndpoint:
+    @async_test
+    async def test_split_region_endpoint(self, tmp_path):
+        """POST /admin/split_region halves a region; writes before and after
+        the split all remain queryable (fan-out merge)."""
+        cfg = Config.from_toml(
+            f"""
+port = 0
+[test]
+segment_duration = "2h"
+[metric_engine]
+num_regions = 2
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+"""
+        )
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            hosts1 = [f"h{i:02d}" for i in range(10)]
+            payload = make_remote_write([
+                ({"__name__": "splitm", "host": h}, [(1000, 1.0)])
+                for h in hosts1
+            ])
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200
+            r = await client.post("/admin/split_region?region=0")
+            body = await r.json()
+            assert r.status == 200 and body["daughter"] == 2, body
+            assert body["regions"] == [0, 1, 2]
+            hosts2 = [f"g{i:02d}" for i in range(10)]
+            payload2 = make_remote_write([
+                ({"__name__": "splitm", "host": h}, [(2000, 2.0)])
+                for h in hosts2
+            ])
+            r = await client.post("/api/v1/write", data=payload2)
+            assert r.status == 200
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "splitm", "start_ms": 0, "end_ms": 10_000},
+            )
+            body = await r.json()
+            assert r.status == 200 and body["rows"] == 20, body
+            # bad requests fail cleanly
+            r = await client.post("/admin/split_region?region=99")
+            assert r.status == 400
+            r = await client.post("/admin/split_region")
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    @async_test
+    async def test_split_rejected_on_unregioned_deployment(self, tmp_path):
+        client = await make_client(tmp_path)
+        try:
+            r = await client.post("/admin/split_region?region=0")
+            assert r.status == 400
+            assert "not a regioned" in (await r.json())["error"]
+        finally:
+            await client.close()
+
+
 class TestConfigParsing:
     def test_defaults(self):
         c = Config.from_dict(None)
