@@ -17,8 +17,13 @@ baselines:
 serve:
 	python -m horaedb_tpu.server.main --config docs/example.toml
 
+# AST lint gate (tools/lint.py): unused imports, star imports, dup dict
+# keys, mutable defaults, bare except, style — the clippy/rustfmt analog
+# (reference Makefile:37-53); ruff/mypy are not in the image, the linter
+# is stdlib. compileall still guards syntax across every file.
 lint:
 	python -m compileall -q horaedb_tpu tests benchmarks bench.py __graft_entry__.py
+	python tools/lint.py
 
 soak:
 	SOAK_REGIONS=3 SOAK_METRICS=8 SOAK_BUFFER_ROWS=30000 python benchmarks/soak.py 60

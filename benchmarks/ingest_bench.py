@@ -30,7 +30,6 @@ def main() -> None:
     import random
 
     from horaedb_tpu.engine import MetricEngine
-    from horaedb_tpu.ingest import ParserPool
     from horaedb_tpu.objstore import LocalStore
     from horaedb_tpu.pb import remote_write_pb2
 

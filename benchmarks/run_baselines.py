@@ -254,7 +254,9 @@ def config_5(quick: bool) -> None:
 
     host_cols = {
         "pk": np.concatenate([np.asarray(b.columns["pk"][: rows_per_sst]) for b in blocks]),
-        "__seq__": np.concatenate([np.asarray(b.columns["__seq__"][: rows_per_sst]) for b in blocks]),
+        "__seq__": np.concatenate(
+            [np.asarray(b.columns["__seq__"][: rows_per_sst]) for b in blocks]
+        ),
     }
     t0 = time.perf_counter()
     packed, seq_width = _pack_sort_keys(host_cols.__getitem__, ("pk", "__seq__"), total)

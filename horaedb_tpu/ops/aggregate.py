@@ -290,7 +290,9 @@ def segment_last_value(
     # file ids ~1.8e18, so seq*n would overflow int64): find each segment's
     # max seq, then take the latest row achieving it.
     seq_i = seq.astype(jnp.int64)
-    max_seq = jax.ops.segment_max(jnp.where(valid, seq_i, jnp.iinfo(jnp.int64).min), idx, num_segments + 1)
+    max_seq = jax.ops.segment_max(
+        jnp.where(valid, seq_i, jnp.iinfo(jnp.int64).min), idx, num_segments + 1
+    )
     winner = valid & (seq_i == max_seq[idx])
     pos = jnp.arange(n, dtype=jnp.int64)
     best_pos = jax.ops.segment_max(jnp.where(winner, pos, -1), idx, num_segments + 1)[:-1]

@@ -13,7 +13,7 @@ all-numeric by construction (MetricId, TSID, FieldId, Timestamp, Value).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp

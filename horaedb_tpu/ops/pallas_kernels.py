@@ -31,7 +31,6 @@ from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from horaedb_tpu.common.error import ensure
 

@@ -61,6 +61,7 @@ def make_mesh(
     """
     devs = mesh_devices(n_devices)
     n = len(devs)
-    ensure(n % series_parallel == 0, f"{n} devices not divisible by series_parallel={series_parallel}")
+    ensure(n % series_parallel == 0,
+           f"{n} devices not divisible by series_parallel={series_parallel}")
     arr = np.array(devs).reshape(n // series_parallel, series_parallel)
     return Mesh(arr, axis_names)

@@ -12,7 +12,7 @@ leading dim), so a 10M-series group-by never materializes on a single chip.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp

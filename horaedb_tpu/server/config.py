@@ -28,7 +28,7 @@ from __future__ import annotations
 import tomllib
 from dataclasses import dataclass, field
 
-from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.time_ext import ReadableDuration
 from horaedb_tpu.objstore.s3 import HttpOptions, S3LikeConfig, TimeoutOptions
 from horaedb_tpu.storage.config import StorageConfig, _from_dict

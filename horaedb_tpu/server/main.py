@@ -43,9 +43,9 @@ from horaedb_tpu.ingest import ParserPool
 from horaedb_tpu.objstore import LocalStore
 from horaedb_tpu.server.config import Config
 from horaedb_tpu.server.metrics import GLOBAL_METRICS as METRICS
-from horaedb_tpu.storage.read import CompactRequest, ScanRequest, WriteRequest
+from horaedb_tpu.storage.read import CompactRequest, WriteRequest
 from horaedb_tpu.storage.storage import ObjectBasedStorage
-from horaedb_tpu.storage.types import TimeRange, Timestamp
+from horaedb_tpu.storage.types import TimeRange
 
 logger = logging.getLogger("horaedb_tpu.server")
 

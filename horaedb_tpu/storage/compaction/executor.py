@@ -21,7 +21,7 @@ import logging
 
 import pyarrow as pa
 
-from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.common.error import ensure
 from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.compaction import Task
 from horaedb_tpu.storage.sst import FileMeta, SstFile, allocate_id

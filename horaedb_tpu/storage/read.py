@@ -36,15 +36,13 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from functools import lru_cache
-from typing import AsyncIterator
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.common.error import ensure
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import dedup as dedup_ops
 from horaedb_tpu.ops import filter as filter_ops

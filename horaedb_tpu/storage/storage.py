@@ -34,7 +34,7 @@ from horaedb_tpu.common.error import HoraeError, context, ensure
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import sort as sort_ops
 from horaedb_tpu.ops.blocks import arrow_column_to_numpy
-from horaedb_tpu.storage.config import StorageConfig, UpdateMode, WriteConfig
+from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.manifest import Manifest
 from horaedb_tpu.storage.read import (
     CompactRequest,
@@ -43,12 +43,7 @@ from horaedb_tpu.storage.read import (
     WriteRequest,
 )
 from horaedb_tpu.storage.sst import FileMeta, SstFile, SstPathGenerator, allocate_id
-from horaedb_tpu.storage.types import (
-    StorageSchema,
-    TimeRange,
-    Timestamp,
-    WriteResult,
-)
+from horaedb_tpu.storage.types import StorageSchema, Timestamp, WriteResult
 
 logger = logging.getLogger(__name__)
 

@@ -15,7 +15,7 @@ device-side struct-of-arrays layout the kernels consume.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 import pyarrow as pa
@@ -140,7 +140,8 @@ class StorageSchema:
     def user_schema(self) -> pa.Schema:
         """Schema without builtin columns (what scan returns by default)."""
         return pa.schema(
-            [self.arrow_schema.field(i) for i in range(len(self.arrow_schema.names) - BUILTIN_COLUMN_NUM)],
+            [self.arrow_schema.field(i)
+             for i in range(len(self.arrow_schema.names) - BUILTIN_COLUMN_NUM)],
             metadata=self.arrow_schema.metadata,
         )
 

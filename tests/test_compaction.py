@@ -7,7 +7,6 @@ import pyarrow as pa
 import pytest
 
 from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
-from horaedb_tpu.common.size_ext import ReadableSize
 from horaedb_tpu.objstore import MemStore
 from horaedb_tpu.storage import (
     ObjectBasedStorage,
@@ -28,7 +27,8 @@ HOUR = 3_600_000
 def sst(i, start, size=100, rows=10):
     return SstFile(
         id=i,
-        meta=FileMeta(max_sequence=i, num_rows=rows, size=size, time_range=TimeRange(start, start + 10)),
+        meta=FileMeta(max_sequence=i, num_rows=rows, size=size,
+                      time_range=TimeRange(start, start + 10)),
     )
 
 

@@ -2,8 +2,6 @@
 replay only post-watermark SSTs instead of rescanning the whole series+index
 tables (VERDICT r03 #7; design point RFC :114-136 at 10M series)."""
 
-import pytest
-
 from horaedb_tpu.engine import MetricEngine, QueryRequest
 from horaedb_tpu.objstore import MemStore
 from horaedb_tpu.ingest import PooledParser

@@ -143,7 +143,8 @@ class TestDifferential:
         req = remote_write_pb2.WriteRequest()
         ts = req.timeseries.add()
         lab = ts.labels.add(); lab.name = b"n"; lab.value = b"v"
-        s = ts.samples.add(); s.value = -1.5; s.timestamp = -12345  # sint? int64 negative -> 10-byte varint
+        # sint? int64 negative -> 10-byte varint
+        s = ts.samples.add(); s.value = -1.5; s.timestamp = -12345
         payload = req.SerializeToString()
         native = native_parser()
         out = native.parse(payload)

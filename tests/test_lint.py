@@ -1,0 +1,40 @@
+"""The lint gate stays green (reference CI: `clippy -D warnings` + rustfmt,
+Makefile:37-53). tools/lint.py is the stdlib AST linter `make lint` runs;
+this test makes every `pytest` run a CI gate for it."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestLintGate:
+    def test_tree_is_lint_clean(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py")],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        assert r.returncode == 0, f"lint findings:\n{r.stdout}{r.stderr}"
+
+    def test_linter_catches_seeded_defects(self, tmp_path):
+        """The gate is only worth trusting if it actually fires."""
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import os\n"                          # F401
+            "import json\n"
+            "import json\n"                        # F811
+            "from sys import *\n"                  # F403
+            "def f(x={}):\n"                       # B006
+            "    try:\n"
+            "        return {1: 'a', 1: 'b', 'j': json}\n"  # F601
+            "    except:\n"                        # C901
+            "        pass\n"
+        )
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), str(bad)],
+            capture_output=True, text=True, cwd=REPO, timeout=60,
+        )
+        assert r.returncode != 0
+        for code in ("F401", "F403", "F811", "B006", "F601", "C901"):
+            assert code in r.stdout, (code, r.stdout)

@@ -140,7 +140,9 @@ class TestManifest:
         (reference test: manifest/mod.rs:405-508, sleep-then-assert)."""
         store = MemStore()
         cfg = ManifestConfig(
-            merge_interval=__import__("horaedb_tpu.common.time_ext", fromlist=["ReadableDuration"]).ReadableDuration.millis(50),
+            merge_interval=__import__(
+                "horaedb_tpu.common.time_ext", fromlist=["ReadableDuration"]
+            ).ReadableDuration.millis(50),
             min_merge_threshold=0,
         )
         m = await Manifest.try_new("root", store, config=cfg)

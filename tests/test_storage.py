@@ -232,7 +232,8 @@ class TestWriteScan:
         eng = await new_engine(store)
         schema = make_schema()
         await eng.write(
-            WriteRequest(make_batch(schema, [1, 2], [0, 0], [10, 20], [1.0, 2.0]), TimeRange(10, 21))
+            WriteRequest(make_batch(schema, [1, 2], [0, 0], [10, 20], [1.0, 2.0]),
+                         TimeRange(10, 21))
         )
         await eng.close()
         eng2 = await new_engine(store)
@@ -322,7 +323,8 @@ class TestChunkedScan:
                 )
             )
         expect = await collect(
-            big, ScanRequest(range=TimeRange(0, SEGMENT_MS), predicate=F.Compare("value", "gt", 0.0))
+            big, ScanRequest(range=TimeRange(0, SEGMENT_MS),
+                             predicate=F.Compare("value", "gt", 0.0))
         )
         # same store, tiny scan block -> forces chunking + merge tree
         small_cfg = StorageConfig(scan_block_rows=700)
@@ -332,7 +334,8 @@ class TestChunkedScan:
             enable_compaction_scheduler=False, start_background_merger=False,
         )
         got = await collect(
-            small, ScanRequest(range=TimeRange(0, SEGMENT_MS), predicate=F.Compare("value", "gt", 0.0))
+            small, ScanRequest(range=TimeRange(0, SEGMENT_MS),
+                               predicate=F.Compare("value", "gt", 0.0))
         )
         assert got.num_rows == expect.num_rows
         for name in expect.schema.names:

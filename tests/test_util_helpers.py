@@ -1,7 +1,6 @@
 """Tests for the shared fixtures themselves + a scan-through-check_stream
 round trip (test_util.rs usage parity)."""
 
-import numpy as np
 import pytest
 
 from horaedb_tpu.objstore import MemStore
