@@ -38,3 +38,34 @@ class TestLintGate:
         assert r.returncode != 0
         for code in ("F401", "F403", "F811", "B006", "F601", "C901"):
             assert code in r.stdout, (code, r.stdout)
+
+    def test_linter_accepts_standard_idioms(self, tmp_path):
+        """No false positives on: try/except fallback imports, quoted
+        annotations (TYPE_CHECKING), function-local re-imports."""
+        ok = tmp_path / "ok.py"
+        ok.write_text(
+            "from typing import TYPE_CHECKING\n"
+            "try:\n"
+            "    import json\n"
+            "except ImportError:\n"
+            "    import json\n"
+            "if TYPE_CHECKING:\n"
+            "    from os import PathLike\n"
+            "def f(x: \"PathLike\") -> \"PathLike\":\n"
+            "    import json  # local re-import is scoping, not F811\n"
+            "    return json.loads(x)\n"
+        )
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), str(ok)],
+            capture_output=True, text=True, cwd=REPO, timeout=60,
+        )
+        assert r.returncode == 0, r.stdout
+
+    def test_missing_root_fails_loudly(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "no_such_dir_xyz"],
+            capture_output=True, text=True, cwd=REPO, timeout=60,
+        )
+        assert r.returncode != 0
+        assert "does not exist" in r.stdout + r.stderr

@@ -22,10 +22,12 @@ on any regression. Exit code = number of findings (capped 125).
 from __future__ import annotations
 
 import ast
+import re
 import sys
 from pathlib import Path
 
 MAX_LINE = 100
+_IDENT = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
 
 # names a module re-exports on purpose (import kept for its side effect or
 # for package API) — the linter honors `__all__` and `# noqa` instead of a
@@ -41,6 +43,9 @@ def iter_py_files(roots: list[str]) -> list[Path]:
             out.append(p)
         elif p.is_dir():
             out.extend(sorted(p.rglob("*.py")))
+        else:
+            # a vanished root must FAIL the gate, not quietly narrow it
+            raise SystemExit(f"lint: root does not exist: {r}")
     # pb/ holds protoc codegen — machine-formatted, not held to hand-written
     # style (the reference likewise lints source, not generated stubs)
     return [p for p in out
@@ -73,12 +78,19 @@ class ImportVisitor(ast.NodeVisitor):
         self._depth -= 1
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._mark_annotation(node.returns)
         self._scoped(node)
 
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._mark_annotation(node.returns)
         self._scoped(node)
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scoped(node)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        # the try/except ImportError fallback-import idiom re-imports the
+        # same name by design — not an F811 redefinition
         self._scoped(node)
 
     def visit_Import(self, node: ast.Import) -> None:
@@ -104,6 +116,25 @@ class ImportVisitor(ast.NodeVisitor):
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
         # `np.foo` marks `np` used via the Name child; nothing extra needed
+        self.generic_visit(node)
+
+    def _mark_annotation(self, ann: ast.expr | None) -> None:
+        """Quoted annotations (`x: "PathLike"`, the TYPE_CHECKING idiom)
+        are plain strings in the AST; count their identifier tokens as
+        usages so F401 doesn't fire on them. Docstrings deliberately do
+        NOT count — only annotation positions."""
+        if ann is None:
+            return
+        for sub in ast.walk(ann):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                self.used.update(_IDENT.findall(sub.value))
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._mark_annotation(node.annotation)
+        self.generic_visit(node)
+
+    def visit_arg(self, node: ast.arg) -> None:
+        self._mark_annotation(node.annotation)
         self.generic_visit(node)
 
 
