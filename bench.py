@@ -168,18 +168,14 @@ def main() -> None:
 
     # A/B: the engine's natural scan order is SORTED by (series, ts) — the
     # sorted-segment strategies apply there (block = pure-XLA MXU
-    # compaction, lanes = lane-parallel vmap scatter, pallas = mosaic
-    # kernel when HORAEDB_PALLAS=1). Sort once on host (outside timing),
-    # time each strategy's pipeline on the same data.
+    # compaction, lanes = lane-parallel vmap scatter). Sort once on host
+    # (outside timing), time each strategy's pipeline on the same data.
     order = np.lexsort((ts, sid))
     s_ts = jax.device_put(ts[order], sh)
     s_sid = jax.device_put(sid[order], sh)
     s_vals = jax.device_put(vals[order], sh)
-    import os
 
     impls = ["block", "lanes"] if on_accel else ["scatter"]
-    if os.environ.get("HORAEDB_PALLAS") == "1":
-        impls.append("pallas")
     sorted_results: dict[str, float] = {}
     for impl_name in impls:
         fn_sorted = build_sharded_downsample(

@@ -6,8 +6,6 @@
 #
 # Captures: headline bench (scatter vs sorted A/B incl. block/lanes impls),
 # the five BASELINE configs at full size, engine ingest, query latencies.
-# HORAEDB_PALLAS=1 additionally A/Bs the mosaic kernel (only set it on
-# hardware with a local libtpu — remoted compile tunnels stall on it).
 set -u
 cd "$(dirname "$0")/.."
 OUT=benchmarks/results_tpu.jsonl

@@ -51,7 +51,7 @@ def masked_minmax(values, idx, valid, num_segments: int):
     """Scatter-based min/max per segment with sentinel-index drop semantics
     (`idx` must route invalid rows to num_segments; invalid values fill
     +/-inf). The SCATTER-path helper: compaction-eligible paths use
-    pallas_kernels.sorted_segment_min_max (masked-reduce block compaction)
+    blockagg.sorted_segment_min_max (masked-reduce block compaction)
     instead."""
     mn = jax.ops.segment_min(
         jnp.where(valid, values, jnp.inf), idx, num_segments + 1
@@ -78,7 +78,7 @@ def masked_segment_stats(
     (8,128) tile layout and measures ~4x slower).
     """
     # integers widen to 64-bit accumulation (exact, wrap-proof for narrow
-    # int sums), matching pallas_kernels._scatter_sum_count; floats keep
+    # int sums), matching blockagg._scatter_sum_count; floats keep
     # their own width (the engine's precision contract, data.py)
     vals = jnp.asarray(values)
     if jnp.issubdtype(vals.dtype, jnp.unsignedinteger):
@@ -110,7 +110,7 @@ def grouped_stats(
     compaction. Otherwise (CPU, sparse grids, non-f32) everything
     scatters, dtype-preserving.
     """
-    from horaedb_tpu.ops.pallas_kernels import (
+    from horaedb_tpu.ops.blockagg import (
         _F32_EXACT,
         segment_sum_count,
         sorted_segment_min_max,
@@ -160,7 +160,7 @@ def downsample_sorted(
     """Downsample over rows SORTED by (series, ts) — the engine's natural
     scan-output order (pk = ids + timestamp), which makes the flat cell index
     monotone. sum/count dispatch to the sorted-segment compaction
-    (ops/pallas_kernels.py; MXU one-hot matmuls instead of a scatter, with
+    (ops/blockagg.py; MXU one-hot matmuls instead of a scatter, with
     an automatic XLA fallback); min/max, when requested, use the
     masked-reduce compaction (sorted_segment_min_max, scatter fallback).
 
@@ -169,7 +169,7 @@ def downsample_sorted(
     series_idx (e.g. the searchsorted position, not -1) and are zeroed via
     the compaction's weight column.
     """
-    from horaedb_tpu.ops.pallas_kernels import _F32_EXACT, sorted_segment_sum_count
+    from horaedb_tpu.ops.blockagg import _F32_EXACT, sorted_segment_sum_count
 
     num_cells = num_series * num_buckets
     if num_cells >= _F32_EXACT:
@@ -207,7 +207,7 @@ def downsample_sorted(
         "mean": (s / c).reshape(shape),
     }
     if with_minmax:
-        from horaedb_tpu.ops.pallas_kernels import sorted_segment_min_max
+        from horaedb_tpu.ops.blockagg import sorted_segment_min_max
 
         mn, mx = sorted_segment_min_max(safe, values, num_cells, valid=ok)
         out["min"] = mn.reshape(shape)

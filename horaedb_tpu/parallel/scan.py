@@ -38,7 +38,7 @@ def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
 
     `sorted_input=True` declares rows ordered by (sid, ts) — the engine's
     natural scan-output order. The sum/count reduction then dispatches to
-    the sorted-segment compaction (ops/pallas_kernels.py: block-rank one-hot
+    the sorted-segment compaction (ops/blockagg.py: block-rank one-hot
     matmuls on the MXU instead of per-row scatters, with adaptive fallback);
     results are identical either way, sortedness only affects speed.
     """
@@ -63,7 +63,7 @@ def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
     # typed zero fill: a weak 0.0 would promote integer vals to f32 and
     # bypass the dtype-preserving integer scatter route
     vals_masked = jnp.where(ok, vals, jnp.zeros((), vals.dtype))
-    from horaedb_tpu.ops.pallas_kernels import (
+    from horaedb_tpu.ops.blockagg import (
         _F32_EXACT,
         segment_sum_count,
         sorted_segment_min_max,

@@ -1651,7 +1651,7 @@ class ParquetReader:
         # f32 accumulation only on real accelerators (native lane width,
         # the documented precision trade-off); CPU/XLA-fallback meshes keep
         # the storage f64 so query results match the reference's f64
-        # aggregation exactly (advisor round-1, pallas_kernels precision).
+        # aggregation exactly (advisor round-1, blockagg precision).
         accel = mesh.devices.flat[0].platform not in ("cpu",)
         val_dtype = np.float32 if accel else np.float64
         row_ok = (

@@ -308,8 +308,9 @@ class TestAggregate:
         assert float(out["sum"].sum()) == 1.0
 
     def test_downsample_sorted_matches_scatter_path(self):
-        """The engine's sorted-scan downsample (Pallas-backed sum/count path)
-        must agree with the general scatter implementation."""
+        """The engine's sorted-scan downsample (block-compaction sum/count
+        path, ops/blockagg.py) must agree with the general scatter
+        implementation."""
         rng = np.random.default_rng(8)
         num_series, num_buckets, bucket_ms = 6, 8, 1000
         n = 5000
