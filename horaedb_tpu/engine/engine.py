@@ -140,6 +140,9 @@ class MetricEngine:
         self.index_table = await open_table(
             "index", tables.INDEX_SCHEMA, tables.INDEX_NUM_PKS, False
         )
+        self.tags_table = await open_table(
+            "tags", tables.TAGS_SCHEMA, tables.TAGS_NUM_PKS, False
+        )
         self.data_table = await open_table(
             "data", tables.DATA_SCHEMA, tables.DATA_NUM_PKS, enable_compaction
         )
@@ -154,6 +157,7 @@ class MetricEngine:
             # namespace neither table's manifest/data layout touches
             sidecar_store=store,
             sidecar_path=f"{root}/index_sidecar/base.arrow",
+            tags_storage=self.tags_table,
         )
         # Payload-shape fingerprint cache: scrapers resend the same series
         # set every interval, so the (metric_id, tsid) lane BYTES repeat
@@ -196,6 +200,7 @@ class MetricEngine:
             self.metrics_table,
             self.series_table,
             self.index_table,
+            self.tags_table,
             self.data_table,
             self.exemplars_table,
         ):
@@ -478,6 +483,15 @@ class MetricEngine:
         if hit is None:
             return []
         return self.index_mgr.label_values(hit[0], key)
+
+    async def label_values_storage(self, metric: bytes, key: bytes) -> list[bytes]:
+        """LabelValues from the durable tags table (RFC :118-130) — agrees
+        with `label_values` (tested); see IndexManager.label_values_storage
+        for when to prefer which."""
+        hit = self.metric_mgr.get(metric)
+        if hit is None:
+            return []
+        return await self.index_mgr.label_values_storage(hit[0], key)
 
     def metric_names(self) -> list[bytes]:
         """All registered metric names (the /api/v1/metrics surface)."""

@@ -34,7 +34,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-from horaedb_tpu.engine.tables import INDEX_SCHEMA, SERIES_SCHEMA
+from horaedb_tpu.engine.tables import INDEX_SCHEMA, SERIES_SCHEMA, TAGS_SCHEMA
 from horaedb_tpu.engine.types import (
     SeriesId,
     decode_series_key,
@@ -230,10 +230,20 @@ class IndexManager:
         segment_duration_ms: int,
         sidecar_store=None,
         sidecar_path: str = "",
+        tags_storage=None,
     ):
         self._series = series_storage
         self._index = index_storage
         self._segment_duration = segment_duration_ms
+        # RFC :118-130 optional `tags` table: one row per distinct
+        # (metric, key, value) — the storage-backed LabelValues surface.
+        # pk = (metric_id, tag_hash): the engine accepts 64-bit hash
+        # identity here exactly as it does for TSIDs (reference contract,
+        # types.rs:18-41). The seen-set only suppresses duplicate WRITES
+        # (rewrites are idempotent pk overwrites), so it starts empty per
+        # process without any correctness cost.
+        self._tags = tags_storage
+        self._tags_seen: set[tuple[int, int]] = set()
         # Arrow-IPC base sidecar (VERDICT r03 #7): open used to be O(full
         # rebuild) — a scan of the whole series+index tables (~10 s at 1M
         # series, ~100 s at the RFC's 10M design point). The sidecar dumps
@@ -270,8 +280,10 @@ class IndexManager:
         watermark = await self._load_sidecar()
         if watermark is not None:
             await self._replay_since(watermark)
+            await self._backfill_tags()
             return
         await self._rebuild_from_tables()
+        await self._backfill_tags()
         # make the NEXT open fast even if this process never closes cleanly;
         # best-effort — the sidecar is a cache, a failed put must not abort
         # an open whose rebuild just succeeded
@@ -796,6 +808,17 @@ class IndexManager:
         if not index_rows:
             await self._series.write(WriteRequest(s_batch, rng))
             return
+        # optional tags table first: distinct (metric, key, value) rows are
+        # advisory ghosts until the index/series writes land — harmless on
+        # a crash, and writing them last could lose a LabelValues row for
+        # an acked series forever
+        if self._tags is not None:
+            dedup: dict[tuple[int, int], tuple] = {}
+            for m, h, _t, k, v in index_rows:
+                if (m, h) not in self._tags_seen:
+                    dedup.setdefault((m, h), (m, h, k, v))
+            if dedup:
+                await self._write_tags_rows(list(dedup.values()), rng)
         i_batch = pa.RecordBatch.from_pydict(
             {
                 "metric_id": np.asarray([r[0] for r in index_rows], dtype=np.uint64),
@@ -815,6 +838,60 @@ class IndexManager:
         # skip it while its samples keep landing.
         await self._index.write(WriteRequest(i_batch, rng))
         await self._series.write(WriteRequest(s_batch, rng))
+
+    async def _write_tags_rows(
+        self, rows: list[tuple], rng: TimeRange
+    ) -> None:
+        """Write distinct (metric_id, tag_hash, key, value) rows to the
+        tags table and record them in the bounded seen-set (cleared
+        wholesale at the cap, like the series seen-cache — a miss only
+        costs an idempotent pk-overwrite rewrite)."""
+        t_batch = pa.RecordBatch.from_pydict(
+            {
+                "metric_id": np.asarray([r[0] for r in rows], dtype=np.uint64),
+                "tag_hash": np.asarray([r[1] for r in rows], dtype=np.uint64),
+                "tag_key": [r[2] for r in rows],
+                "tag_value": [r[3] for r in rows],
+            },
+            schema=TAGS_SCHEMA,
+        )
+        await self._tags.write(WriteRequest(t_batch, rng))
+        if len(self._tags_seen) > SEEN_CACHE_MAX:
+            self._tags_seen.clear()
+        self._tags_seen.update((r[0], r[1]) for r in rows)
+
+    async def _backfill_tags(self) -> None:
+        """One-time migration: a store written before the tags table
+        existed has series/index rows but no tags rows — backfill distinct
+        pairs from the freshly-opened in-memory index so
+        label_values_storage agrees with label_values on legacy stores."""
+        if self._tags is None or self._tags._manifest.all_ssts():
+            return
+        with self._mu:
+            base = dict(self._base)
+            postings = {k: dict(v) for k, v in self._postings.items()}
+        rows: dict[tuple[int, int], tuple] = {}
+        for m, b in base.items():
+            if not len(b.p_hash):
+                continue
+            keys = b.p_key.to_pylist()
+            vals = b.p_value.to_pylist()
+            for h, k, v in zip(b.p_hash.tolist(), keys, vals):
+                rows.setdefault((m, h), (m, h, k, v))
+        for (m, h), rrows in postings.items():
+            for _t, (k, v) in rrows.items():
+                rows.setdefault((m, h), (m, h, k, v))
+                break
+        if not rows:
+            return
+        from horaedb_tpu.common.time_ext import now_ms as _now_ms
+
+        now = _now_ms()
+        seg_start = now - now % self._segment_duration
+        await self._write_tags_rows(
+            list(rows.values()), TimeRange(seg_start, seg_start + 1)
+        )
+        logger.info("backfilled %d tags rows from the index", len(rows))
 
     # -- query path ------------------------------------------------------------
     def _metric_delta(self, metric_id: int):
@@ -931,6 +1008,30 @@ class IndexManager:
                 if not intersect(matched):
                     return []
         return sorted(result)
+
+    async def label_values_storage(
+        self, metric_id: int, key: bytes
+    ) -> list[bytes]:
+        """LabelValues from the DURABLE tags table (RFC :118-130: the
+        two-step index fallback VM uses, accelerated to one distinct-rows
+        scan). The in-memory index path (`label_values`) is faster when the
+        index is resident; this surface exists for parity and for callers
+        that must not depend on the in-memory tier (e.g. cold tooling over
+        the object store)."""
+        if self._tags is None:
+            return []
+        from horaedb_tpu.ops import filter as F
+
+        out: set[bytes] = set()
+        async for batch in self._tags.scan(ScanRequest(
+            range=_ALL_TIME,
+            predicate=F.And(
+                F.Compare("metric_id", "eq", metric_id),
+                F.Compare("tag_key", "eq", key),
+            ),
+        )):
+            out.update(batch.column("tag_value").to_pylist())
+        return sorted(out)
 
     def series_of(self, metric_id: int) -> list[SeriesId]:
         """All known TSIDs of a metric (the no-tag-filter downsample scope)."""

@@ -60,6 +60,21 @@ DATA_SCHEMA = pa.schema(
 )
 DATA_NUM_PKS = 4
 
+# tags (RFC :118-130, the "optional" table): pk (metric_id, tag_hash);
+# values: the raw tag bytes. One row per DISTINCT (metric, key, value) —
+# the LabelValues acceleration surface that avoids touching per-series
+# posting rows. The hash pk keeps pk comparisons numeric (engine-wide
+# contract); raw bytes disambiguate collisions at read time.
+TAGS_SCHEMA = pa.schema(
+    [
+        ("metric_id", pa.uint64()),
+        ("tag_hash", pa.uint64()),
+        ("tag_key", pa.binary()),
+        ("tag_value", pa.binary()),
+    ]
+)
+TAGS_NUM_PKS = 2
+
 # exemplars: pk (metric_id, tsid, ts); values: sample + serialized labels
 # (length-prefixed KV encoding from engine.types, carrying trace ids etc.)
 EXEMPLARS_SCHEMA = pa.schema(

@@ -180,6 +180,7 @@ async def handle_metrics(request: web.Request) -> web.Response:
             f"{prefix}metrics": e.metrics_table,
             f"{prefix}series": e.series_table,
             f"{prefix}index": e.index_table,
+            f"{prefix}tags": e.tags_table,
             f"{prefix}data": e.data_table,
             f"{prefix}exemplars": e.exemplars_table,
         })
