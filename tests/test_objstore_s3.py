@@ -258,6 +258,82 @@ class TestEngineOnS3:
             await fake.stop()
 
 
+class TestEngineOnFlakyS3:
+    @async_test
+    async def test_transient_fault_bursts_absorbed_by_retries(self):
+        """Injected 5xx bursts during live writes: the client's bounded
+        retries absorb them and every acked sample stays queryable — the
+        §5.3 failure-handling story on the S3 data plane."""
+        from horaedb_tpu.storage import (
+            ObjectBasedStorage,
+            ScanRequest,
+            TimeRange,
+            WriteRequest,
+        )
+
+        fake = FakeS3()
+        url = await fake.start()
+        store = make_store(url, max_retries=4)
+        schema = pa.schema([("pk", pa.int64()), ("v", pa.float64())])
+        eng = await ObjectBasedStorage.try_new(
+            "db", store, schema, num_primary_keys=1,
+            segment_duration_ms=3_600_000,
+            enable_compaction_scheduler=False,
+        )
+        try:
+            acked = 0
+            for i in range(10):
+                if i % 3 == 0:
+                    fake.fail_next(2, status=503)  # burst < retry budget
+                batch = pa.RecordBatch.from_pydict(
+                    {"pk": np.arange(i * 4, i * 4 + 4),
+                     "v": np.full(4, float(i))},
+                    schema=schema,
+                )
+                await eng.write(WriteRequest(batch, TimeRange(1000, 1001)))
+                acked += 4
+            rows = 0
+            async for b in eng.scan(ScanRequest(range=TimeRange(0, 10_000))):
+                rows += b.num_rows
+            assert rows == acked, (rows, acked)
+        finally:
+            await eng.close()
+            await store.close()
+            await fake.stop()
+
+    @async_test
+    async def test_sustained_outage_fails_loudly_not_silently(self):
+        """A burst longer than the retry budget surfaces as an error to the
+        writer — never a silent ack."""
+        from horaedb_tpu.storage import (
+            ObjectBasedStorage,
+            TimeRange,
+            WriteRequest,
+        )
+
+        fake = FakeS3()
+        url = await fake.start()
+        store = make_store(url, max_retries=2)
+        schema = pa.schema([("pk", pa.int64()), ("v", pa.float64())])
+        eng = await ObjectBasedStorage.try_new(
+            "db", store, schema, num_primary_keys=1,
+            segment_duration_ms=3_600_000,
+            enable_compaction_scheduler=False,
+        )
+        try:
+            fake.fail_next(50, status=500)
+            batch = pa.RecordBatch.from_pydict(
+                {"pk": np.arange(4), "v": np.zeros(4)}, schema=schema
+            )
+            with pytest.raises(Exception, match="retries exhausted"):
+                await eng.write(WriteRequest(batch, TimeRange(1000, 1001)))
+        finally:
+            fake.fail_next(0)
+            await eng.close()
+            await store.close()
+            await fake.stop()
+
+
 class TestServerConfig:
     def test_s3like_toml_parses_and_validates(self):
         from horaedb_tpu.server.config import Config
