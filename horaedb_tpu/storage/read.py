@@ -452,9 +452,16 @@ def _plan_and_merge(
     host_mask_fn,
     binary_pred: bool,
     itemsize_of,
-) -> np.ndarray:
+    defer_device: bool = False,
+) -> "np.ndarray | object":
     """Decide host-SIMD vs index-only-device for one materializing merge and
     run it; returns surviving row indices in output order.
+
+    `defer_device=True` splits the device route into issue/collect: the
+    kernel is DISPATCHED (async, device queue) and a zero-arg `collect()`
+    closure comes back instead of indices — the chunked scan uses this to
+    double-buffer: chunk i's kernel runs while chunk i+1 decodes and packs
+    on host (VERDICT r03 #2). Host routes always return indices directly.
 
     Cost model (all terms measured, see _LinkProfile): the device pays
     key-lane H2D + 4 B/survivor D2H + dispatch latency; the host pays a
@@ -487,9 +494,10 @@ def _plan_and_merge(
 
     key_bytes = sum(itemsize_of(name) for name in sort_keys)
 
-    def device_merge_packed(mask: np.ndarray | None) -> np.ndarray | None:
-        """Single-u64-lane device merge; None when keys don't pack. Worth the
-        ~30 ns/row host pack only when it saves more link time than it
+    def device_merge_packed(mask):
+        """Single-u64-lane device merge -> np.ndarray indices, a zero-arg
+        collect closure (defer_device), or None when keys don't pack. Worth
+        the ~30 ns/row host pack only when it saves more link time than it
         costs — i.e. slow links, exactly where the device path's H2D hurts."""
         if (key_bytes - 8) / link["h2d_bw"] < 30e-9:
             return None
@@ -507,17 +515,27 @@ def _plan_and_merge(
         with scanstats.stage("h2d"):
             block = Block.from_numpy({"__packed__": packed},
                                      pad_keys=("__packed__",))
-            jax.block_until_ready(list(block.columns.values()))
+            if scanstats.active():  # fence only for attribution
+                jax.block_until_ready(list(block.columns.values()))
         with scanstats.stage("device_merge"):
             kernel = _build_packed_index_kernel(seq_width, do_dedup)
             out_idx, kcnt = kernel(block.columns["__packed__"], nv)
+        if defer_device:
+            return lambda: _collect_device(out_idx, kcnt)
+        return _collect_device(out_idx, kcnt)
+
+    def _collect_device(out_idx, kcnt) -> np.ndarray:
+        """Sync point of a dispatched device merge (split out so deferred
+        callers can overlap the kernel with the next chunk's host work)."""
+        with scanstats.stage("device_merge"):
             k = int(kcnt)
         if k == 0:
             return np.empty(0, np.int64)
         with scanstats.stage("d2h"):
             return np.asarray(out_idx[:k]).astype(np.int64)
 
-    def device_merge(mask: np.ndarray | None) -> np.ndarray:
+    def device_merge(mask):
+        # -> np.ndarray indices, or a collect closure under defer_device
         if mask is not None or predicate is None:
             packed_res = device_merge_packed(mask)
             if packed_res is not None:
@@ -534,7 +552,8 @@ def _plan_and_merge(
                 arrays["__mask__"] = mask.astype(np.uint8)
         with scanstats.stage("h2d"):
             block = Block.from_numpy(arrays, pad_keys=sort_keys)
-            jax.block_until_ready(list(block.columns.values()))
+            if scanstats.active():  # fence only for attribution
+                jax.block_until_ready(list(block.columns.values()))
         with scanstats.stage("device_merge"):
             if mask is not None:
                 kernel = _build_index_kernel(
@@ -553,11 +572,9 @@ def _plan_and_merge(
                     do_dedup, presorted,
                 )
                 out_idx, kcnt = kernel(block.columns, literals, block.num_valid)
-            k = int(kcnt)
-        if k == 0:
-            return np.empty(0, np.int64)
-        with scanstats.stage("d2h"):
-            return np.asarray(out_idx[:k]).astype(np.int64)
+        if defer_device:
+            return lambda: _collect_device(out_idx, kcnt)
+        return _collect_device(out_idx, kcnt)
 
     tmpl_bytes = key_bytes + sum(
         itemsize_of(c) for c in pred_cols if c not in sort_keys
@@ -1289,10 +1306,14 @@ class ParquetReader:
                 out.append(cur)
             return out
 
-        def run_block(arrays: dict[str, np.ndarray], pred) -> dict[str, np.ndarray]:
+        def run_block(
+            arrays: dict[str, np.ndarray], pred, defer: bool = False
+        ):
             """Merge one in-memory block: the planner routes host SIMD vs the
             index-only device kernel (only key/predicate lanes ever cross the
-            link; survivors gather from the HOST arrays)."""
+            link; survivors gather from the HOST arrays). With `defer`, a
+            device-routed merge returns a zero-arg closure producing the
+            gathered block later (kernel already dispatched)."""
             n = len(arrays[sort_keys[0]])
             p_cols = filter_ops.pred_columns(pred)
 
@@ -1301,11 +1322,17 @@ class ParquetReader:
                     pred, {c: arrays[c] for c in p_cols}
                 )
 
-            idx = _plan_and_merge(
+            res = _plan_and_merge(
                 schema, n, arrays.__getitem__, pred, host_mask_fn, False,
                 lambda name: arrays[name].dtype.itemsize,
+                defer_device=defer,
             )
-            return {k: a[idx] for k, a in arrays.items()}
+            if callable(res):
+                def gather():
+                    idx = res()  # ONE device sync + index D2H per block
+                    return {k: a[idx] for k, a in arrays.items()}
+                return gather
+            return {k: a[res] for k, a in arrays.items()}
 
         # level 0: filter + merge + dedup per SST chunk, with the NEXT
         # chunk's parquet decode prefetching on worker threads while this
@@ -1322,6 +1349,16 @@ class ParquetReader:
             return [t for t in tables if t.num_rows > 0]
 
         next_task = asyncio.ensure_future(read_chunk(chunks[0])) if chunks else None
+        pending = None  # chunk i-1's deferred device merge (double buffer)
+
+        def settle() -> None:
+            nonlocal pending
+            if pending is not None:
+                out = pending()
+                pending = None
+                if len(out[sort_keys[0]]):
+                    level.append(out)
+
         try:
             for i in range(len(chunks)):
                 tables = await next_task
@@ -1338,13 +1375,22 @@ class ParquetReader:
                         name: arrow_column_to_numpy(table.column(name).combine_chunks())
                         for name in table.schema.names
                     }
-                out = run_block(arrays, predicate)
-                if len(out[sort_keys[0]]):
+                # double buffer: chunk i's kernel was dispatched last
+                # iteration and ran WHILE this chunk decoded and packed;
+                # collect it only now, right before dispatching chunk i+1
+                # (at most two chunks of key lanes live on device)
+                out = run_block(arrays, predicate, defer=True)
+                settle()
+                if callable(out):
+                    pending = out
+                elif len(out[sort_keys[0]]):
                     level.append(out)
+            settle()
         except BaseException:
             # a failed merge must not abandon the in-flight prefetch (its
             # reads would race a subsequent evict/close and its exception
-            # would be logged as never-retrieved)
+            # would be logged as never-retrieved); a dispatched device merge
+            # is harmless to drop — device arrays free with their refs
             if next_task is not None:
                 next_task.cancel()
                 try:

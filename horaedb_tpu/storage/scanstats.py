@@ -73,6 +73,14 @@ def stage(name: str):
         st.add(name, time.perf_counter() - t0)
 
 
+def active() -> bool:
+    """True when a collector is attached. Device paths use this to decide
+    whether to fence async transfers for attribution: with no collector,
+    skipping the fence lets H2D overlap kernel dispatch in the device
+    queue (the un-fenced form is the production fast path)."""
+    return _ACTIVE.get() is not None
+
+
 def note(name: str, n: int = 1) -> None:
     """Bump a counter (e.g. rows decoded, path taken) on the active collector."""
     st = _ACTIVE.get()

@@ -18,6 +18,7 @@ from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.config import UpdateMode
 from horaedb_tpu.storage.read import _LinkProfile, _plan_and_merge
 from horaedb_tpu.storage.types import StorageSchema
+from tests.conftest import async_test
 
 FAST_LINK = {"h2d_bw": 1e10, "d2h_bw": 1e10, "dispatch_s": 1e-5,
              "sort_s_per_row": 4e-9}
@@ -86,6 +87,69 @@ class TestPlannerRouting:
         with scanstats.scan_stats() as st:
             _run(schema, n, cols)
         assert _routes(st) == {"path_host_merge"}, st.counts
+
+
+class TestChunkedDeviceDoubleBuffer:
+    @async_test
+    async def test_chunked_scan_device_route_matches_host(self, monkeypatch):
+        """The hierarchical scan's deferred device merges (chunk i's kernel
+        overlapping chunk i+1's decode+pack) must produce exactly the host
+        route's rows — across multiple chunks and a predicate."""
+        import tempfile
+
+        import pyarrow as pa_mod
+
+        from horaedb_tpu.objstore import LocalStore
+        from horaedb_tpu.ops import filter as F
+        from horaedb_tpu.storage import (
+            ObjectBasedStorage,
+            TimeRange,
+            WriteRequest,
+        )
+        from horaedb_tpu.storage.config import StorageConfig
+        from horaedb_tpu.storage.read import ScanRequest
+
+        schema = pa_mod.schema(
+            [("pk", pa_mod.int64()), ("ts", pa_mod.int64()),
+             ("v", pa_mod.float64())]
+        )
+        store = LocalStore(tempfile.mkdtemp())
+        eng = await ObjectBasedStorage.try_new(
+            "db", store, schema, num_primary_keys=2,
+            segment_duration_ms=3_600_000,
+            config=StorageConfig(scan_block_rows=2_000),
+            enable_compaction_scheduler=False,
+            start_background_merger=False,
+        )
+        rng = np.random.default_rng(11)
+        for i in range(6):  # 6 SSTs x 1500 rows -> multiple chunks
+            batch = pa_mod.RecordBatch.from_pydict({
+                "pk": rng.integers(0, 500, 1500),
+                "ts": rng.integers(0, 3_600_000, 1500),
+                "v": np.full(1500, float(i)),
+            }, schema=schema)
+            await eng.write(WriteRequest(batch, TimeRange(0, 3_600_000)))
+
+        async def collect() -> list:
+            rows = []
+            async for b in eng.scan(ScanRequest(
+                range=TimeRange(0, 3_600_000),
+                predicate=F.Compare("pk", "lt", 400),
+            )):
+                rows.extend(zip(b["pk"].to_pylist(), b["ts"].to_pylist(),
+                                b["v"].to_pylist()))
+            return rows
+
+        monkeypatch.setattr(_LinkProfile, "_cached", dict(FAST_LINK))
+        monkeypatch.setenv("HORAEDB_SCAN_PATH", "device")
+        with scanstats.scan_stats() as st:
+            dev_rows = await collect()
+        assert "path_device_merge" in st.counts or \
+            "path_device_merge_packed" in st.counts, st.counts
+        monkeypatch.setenv("HORAEDB_SCAN_PATH", "host")
+        host_rows = await collect()
+        assert dev_rows == host_rows and len(dev_rows) > 0
+        await eng.close()
 
 
 class TestLinkProbeHardening:
