@@ -91,12 +91,12 @@ class TestPlannerRouting:
 
 class TestChunkedDeviceDoubleBuffer:
     @async_test
-    async def test_chunked_scan_device_route_matches_host(self, monkeypatch):
+    async def test_chunked_scan_device_route_matches_host(
+        self, monkeypatch, tmp_path
+    ):
         """The hierarchical scan's deferred device merges (chunk i's kernel
         overlapping chunk i+1's decode+pack) must produce exactly the host
         route's rows — across multiple chunks and a predicate."""
-        import tempfile
-
         import pyarrow as pa_mod
 
         from horaedb_tpu.objstore import LocalStore
@@ -113,7 +113,7 @@ class TestChunkedDeviceDoubleBuffer:
             [("pk", pa_mod.int64()), ("ts", pa_mod.int64()),
              ("v", pa_mod.float64())]
         )
-        store = LocalStore(tempfile.mkdtemp())
+        store = LocalStore(str(tmp_path / "store"))
         eng = await ObjectBasedStorage.try_new(
             "db", store, schema, num_primary_keys=2,
             segment_duration_ms=3_600_000,
