@@ -51,6 +51,13 @@ def sort_sentinel(dtype) -> np.generic:
     return np.iinfo(dt).max
 
 
+# The packed-u64 merge sentinel: masked/padding rows sink to all-ones, which
+# sorts after every valid (≤63-bit) packed key. ONE definition shared by the
+# single-device packed kernel (storage/read.py) and the cross-chip merge
+# (parallel/merge.py) — the masked-row contract between them is this value.
+PACK_SENTINEL = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+
+
 @dataclass
 class Block:
     """A padded SoA batch on device."""

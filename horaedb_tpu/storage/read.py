@@ -46,7 +46,7 @@ from horaedb_tpu.common.error import ensure
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import dedup as dedup_ops
 from horaedb_tpu.ops import filter as filter_ops
-from horaedb_tpu.ops.blocks import Block, arrow_column_to_numpy
+from horaedb_tpu.ops.blocks import PACK_SENTINEL, Block, arrow_column_to_numpy
 from horaedb_tpu.ops.filter import Predicate
 from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.config import UpdateMode
@@ -220,6 +220,13 @@ class _LinkProfile:
 # choice — being 2x off moves the crossover, not correctness.
 _HOST_SORT_S_PER_ROW = 200e-9
 _HOST_EVAL_S_PER_ROW = 2e-9
+# Block size past which an ambient mesh upgrades the packed merge to the
+# cross-chip sample-sort (parallel/merge.py). Below it the all-to-all's
+# fixed cost (extra device sort + exchange + per-device dispatch) outweighs
+# the parallelism. Read per call like HORAEDB_SCAN_PATH, so A/B harnesses
+# and the virtual-mesh dryrun can flip it after import.
+def _sharded_min_rows() -> int:
+    return int(os.environ.get("HORAEDB_SHARDED_MIN_ROWS", 4_000_000))
 
 
 def _pack_sort_keys(
@@ -262,7 +269,7 @@ def _pack_sort_keys(
     return packed, encs[-1][1]
 
 
-_PACK_SENTINEL = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+_PACK_SENTINEL = PACK_SENTINEL  # shared masked-row contract (ops/blocks.py)
 
 
 @lru_cache(maxsize=64)
@@ -470,9 +477,15 @@ def _plan_and_merge(
     wins even at worst-case selectivity, the predicate ships as a template
     and evaluates on device (no host pass at all).
 
-    `HORAEDB_SCAN_PATH` in {auto, host, device} overrides (A/B harnesses,
-    tests). Binary-column predicates always evaluate on host (the device has
-    no byte lanes) but may still merge on device via the mask lane.
+    `HORAEDB_SCAN_PATH` in {auto, host, device, sharded} overrides (A/B
+    harnesses, tests). Binary-column predicates always evaluate on host (the
+    device has no byte lanes) but may still merge on device via the mask lane.
+
+    When an ambient mesh is installed (parallel/mesh.py) the packed route
+    upgrades to the cross-chip sample-sort merge (parallel/merge.py) for
+    blocks past `HORAEDB_SHARDED_MIN_ROWS` — the sharded analog of the
+    reference's single-node SortPreservingMergeExec (read.rs:479-492);
+    `sharded` mode forces it regardless of size (tests, dryrun).
     """
     pk_names = tuple(schema.primary_key_names)
     sort_keys = pk_names + (SEQ_COLUMN_NAME,)
@@ -498,12 +511,29 @@ def _plan_and_merge(
         """Single-u64-lane device merge -> np.ndarray indices, a zero-arg
         collect closure (defer_device), or None when keys don't pack. Worth
         the ~30 ns/row host pack only when it saves more link time than it
-        costs — i.e. slow links, exactly where the device path's H2D hurts."""
-        if (key_bytes - 8) / link["h2d_bw"] < 30e-9:
+        costs — i.e. slow links, exactly where the device path's H2D hurts.
+        Routes to the cross-chip sample-sort merge when a mesh is ambient."""
+        from horaedb_tpu.parallel.mesh import active_mesh
+
+        mesh = active_mesh()
+        want_sharded = mesh is not None and (
+            mode == "sharded" or n >= _sharded_min_rows()
+        )
+        if not want_sharded and (key_bytes - 8) / link["h2d_bw"] < 30e-9:
             return None
         with scanstats.stage("host_prep"):
             packres = _pack_sort_keys(col_of, sort_keys, n)
             if packres is None:
+                if mode == "sharded" or want_sharded:
+                    # forced/auto-upgraded sharded mode downgrading is worth
+                    # a trace: an A/B harness must not silently measure the
+                    # single-device path (float or >63-bit keys don't pack)
+                    scanstats.note("path_sharded_fallback_unpackable")
+                    logger.warning(
+                        "sharded merge requested but sort keys do not pack "
+                        "into u64; falling back to the single-device lane "
+                        "kernel (n=%d)", n,
+                    )
                 return None
             packed, seq_width = packres
             if mask is not None:
@@ -511,6 +541,15 @@ def _plan_and_merge(
                 nv = int(np.count_nonzero(mask))
             else:
                 nv = n
+        if want_sharded:
+            from horaedb_tpu.parallel.merge import sharded_packed_merge
+
+            scanstats.note("path_device_merge_sharded")
+            with scanstats.stage("device_merge"):
+                res = sharded_packed_merge(
+                    packed, seq_width, do_dedup, mesh, defer=defer_device
+                )
+            return res
         scanstats.note("path_device_merge_packed")
         with scanstats.stage("h2d"):
             block = Block.from_numpy({"__packed__": packed},
@@ -617,6 +656,10 @@ def _plan_and_merge(
         if binary_pred:
             return device_merge(eval_mask())
         return device_merge(None)
+    if mode == "sharded":
+        # force the cross-chip route: host-eval any predicate into a mask so
+        # the packed path (the only sharded one) is always eligible
+        return device_merge(eval_mask())
     if mode == "host":
         return host_merge(eval_mask())
     if predicate is None:
