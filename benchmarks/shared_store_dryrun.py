@@ -140,6 +140,106 @@ def reader(expect_rounds: int) -> None:
     asyncio.run(run())
 
 
+def contender(node: str, hold: bool) -> None:
+    """Split-brain contention phase (VERDICT r04 #5): two PROCESSES race one
+    region root with epoch fencing. The holder acquires first, writes, then
+    waits; once the usurper has claimed a higher epoch and written, the
+    holder's next write must be rejected with FencedError — exactly one
+    writer wins, and the manifest stays consistent for a later reader."""
+    import asyncio
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import numpy as np
+    import pyarrow as pa
+
+    from horaedb_tpu.storage import ObjectBasedStorage, TimeRange, WriteRequest
+    from horaedb_tpu.storage.fence import FencedError
+
+    schema = pa.schema(
+        [("pk", pa.int64()), ("ts", pa.int64()), ("v", pa.float64())]
+    )
+
+    def batch(pk: int, v: float) -> pa.RecordBatch:
+        return pa.RecordBatch.from_pydict(
+            {"pk": np.array([pk], np.int64), "ts": np.array([10], np.int64),
+             "v": np.array([v], np.float64)}, schema=schema,
+        )
+
+    async def run() -> None:
+        store = _open_store()
+        eng = await ObjectBasedStorage.try_new(
+            root="fence-db", store=store, arrow_schema=schema,
+            num_primary_keys=2, segment_duration_ms=3_600_000,
+            enable_compaction_scheduler=False, start_background_merger=False,
+            fence_node_id=node, fence_validate_interval_s=0.0,
+        )
+        await eng.write(WriteRequest(batch(1 if hold else 2, 1.0), TimeRange(10, 11)))
+        fenced = False
+        if hold:
+            print(json.dumps({"role": "contender", "node": node, "ready": True}),
+                  flush=True)
+            sys.stdin.readline()  # parent signals: usurper has won
+            try:
+                await eng.write(WriteRequest(batch(3, 3.0), TimeRange(10, 11)))
+            except FencedError:
+                fenced = True
+        await eng.close()
+        await _close_store(store)
+        print(json.dumps({"role": "contender", "node": node, "hold": hold,
+                          "fenced": fenced}), flush=True)
+        if hold and not fenced:
+            raise SystemExit(1)
+
+    asyncio.run(run())
+
+
+def contention_reader() -> None:
+    """Validates the raced region: holder's pre-deposition row + usurper's
+    row present, holder's post-deposition row absent."""
+    import asyncio
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import pyarrow as pa
+
+    from horaedb_tpu.storage import (
+        ObjectBasedStorage,
+        ScanRequest,
+        TimeRange,
+    )
+
+    schema = pa.schema(
+        [("pk", pa.int64()), ("ts", pa.int64()), ("v", pa.float64())]
+    )
+
+    async def run() -> None:
+        store = _open_store()
+        eng = await ObjectBasedStorage.try_new(
+            root="fence-db", store=store, arrow_schema=schema,
+            num_primary_keys=2, segment_duration_ms=3_600_000,
+            enable_compaction_scheduler=False, start_background_merger=False,
+        )
+        out = []
+        async for b in eng.scan(ScanRequest(range=TimeRange(0, 3_600_000))):
+            out.append(b)
+        t = pa.Table.from_batches(out)
+        pks = sorted(t.column("pk").to_pylist())
+        await eng.close()
+        await _close_store(store)
+        ok = pks == [1, 2]
+        print(json.dumps({"role": "contention_reader", "pks": pks, "ok": ok}),
+              flush=True)
+        if not ok:
+            raise SystemExit(1)
+
+    asyncio.run(run())
+
+
 def main() -> None:
     root = tempfile.mkdtemp(prefix="shared_store_")
     env = _engine_env()
@@ -165,12 +265,34 @@ def main() -> None:
         child(["reader", "1"])   # sees round 0 exactly
         child(["writer", "1"])
         child(["reader", "2"])   # a fresh reader sees both rounds
+
+        # contention phase: two processes race one fenced region
+        holder = subprocess.Popen(
+            [sys.executable, me, "contender", "node-a", "hold"],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            line = holder.stdout.readline()  # wait for holder's first write
+            assert json.loads(line).get("ready"), line
+            child(["contender", "node-b"])   # usurper claims + writes
+            holder.stdin.write("go\n")
+            holder.stdin.flush()
+            out, _ = holder.communicate(timeout=120)
+            print(out.strip())
+            if holder.returncode != 0:
+                raise SystemExit(holder.returncode)
+            assert json.loads(out.strip().splitlines()[-1])["fenced"], out
+        finally:
+            if holder.poll() is None:
+                holder.kill()
+        child(["contention_reader"])
     finally:
         if stop_s3 is not None:
             stop_s3()
     print(json.dumps({
         "bench": "shared_store_dryrun", "ok": True, "root": root,
         "store": "S3Like" if os.environ.get("SHARED_STORE_S3") == "1" else "Local",
+        "phases": ["writer/reader x2", "fence contention"],
     }))
 
 
@@ -179,5 +301,9 @@ if __name__ == "__main__":
         writer(int(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == "reader":
         reader(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "contender":
+        contender(sys.argv[2], hold=len(sys.argv) > 3 and sys.argv[3] == "hold")
+    elif len(sys.argv) > 1 and sys.argv[1] == "contention_reader":
+        contention_reader()
     else:
         main()

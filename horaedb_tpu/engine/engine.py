@@ -102,6 +102,8 @@ class MetricEngine:
         sst_executor=None,
         manifest_executor=None,
         parser_pool=None,
+        fence_node_id: str | None = None,
+        fence_validate_interval_s: float = 5.0,
     ) -> "MetricEngine":
         """`ingest_buffer_rows` > 0 buffers data-table rows across writes
         and flushes as one SST per segment when the threshold is reached
@@ -109,11 +111,25 @@ class MetricEngine:
         `sst_executor`/`manifest_executor` size CPU-heavy storage work
         (ThreadConfig, see ObjectBasedStorage.try_new). `parser_pool` shares
         the caller's ParserPool (so e.g. the server's pool telemetry covers
-        engine ingest); None = engine creates its own on first use."""
+        engine ingest); None = engine creates its own on first use.
+        `fence_node_id` claims exclusive write ownership of this engine
+        root: ONE epoch fence covers all six tables (the region is the
+        ownership unit, RFC :28-76); a later claimant deposes this process
+        and its writes fail with FencedError (storage/fence.py)."""
         self = object.__new__(cls)
         self._store = store
         self._segment_duration = segment_duration_ms
         self._pool = parser_pool
+
+        fence = None
+        if fence_node_id is not None:
+            from horaedb_tpu.storage.fence import EpochFence
+
+            fence = await EpochFence.acquire(
+                store, root.strip("/"), fence_node_id,
+                validate_interval_s=fence_validate_interval_s,
+            )
+        self._fence = fence
 
         sample_cfg = sample_table_config(config)
 
@@ -129,6 +145,7 @@ class MetricEngine:
                 enable_compaction_scheduler=compaction,
                 sst_executor=sst_executor,
                 manifest_executor=manifest_executor,
+                fence=fence,
             )
 
         self.metrics_table = await open_table(

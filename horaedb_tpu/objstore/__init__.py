@@ -11,10 +11,15 @@ to threads so manifest/compaction loops never block the event loop.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import os
 import shutil
+import threading
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
+
+# unique per-attempt suffix stream for LocalStore.put_if_absent sidecars
+_ifabsent_seq = itertools.count()
 
 from horaedb_tpu.common.error import HoraeError
 
@@ -32,11 +37,26 @@ class NotFound(HoraeError):
     distinguishes missing-snapshot from corrupt-snapshot, manifest/mod.rs:336-354)."""
 
 
+class PreconditionFailed(HoraeError):
+    """Raised by put_if_absent when the object already exists — the loser's
+    signal in the region-ownership epoch race (storage/fence.py)."""
+
+
 class ObjectStore(ABC):
     """put/get/list/delete/head over a flat namespace of `/`-separated keys."""
 
     @abstractmethod
     async def put(self, path: str, data: bytes) -> None: ...
+
+    async def put_if_absent(self, path: str, data: bytes) -> None:
+        """Atomic create-if-absent: succeeds exactly once per key across all
+        concurrent callers; raises PreconditionFailed if the key exists.
+        The primitive behind epoch fencing (S3: `If-None-Match: *`
+        conditional PUT; local FS: O_EXCL-style link; memory: dict under
+        lock). Stores that cannot provide it must override and raise."""
+        raise HoraeError(
+            f"{type(self).__name__} does not support conditional puts"
+        )
 
     @abstractmethod
     async def get(self, path: str) -> bytes: ...
@@ -77,6 +97,12 @@ class MemStore(ObjectStore):
 
     async def put(self, path: str, data: bytes) -> None:
         async with self._lock:
+            self._objects[path] = bytes(data)
+
+    async def put_if_absent(self, path: str, data: bytes) -> None:
+        async with self._lock:
+            if path in self._objects:
+                raise PreconditionFailed(f"object exists: {path}")
             self._objects[path] = bytes(data)
 
     async def get(self, path: str) -> bytes:
@@ -137,6 +163,32 @@ class LocalStore(ObjectStore):
 
         await asyncio.to_thread(_put)
 
+    async def put_if_absent(self, path: str, data: bytes) -> None:
+        def _put() -> None:
+            fs = self._fs_path(path)
+            os.makedirs(os.path.dirname(fs), exist_ok=True)
+            # full-content atomic create: write a sidecar, then hard-link it
+            # to the final name — link(2) fails with EEXIST atomically, and
+            # the object can never be observed partially written. The sidecar
+            # name must be unique per ATTEMPT (pid alone collides across the
+            # thread pool's concurrent callers racing one key)
+            tmp = fs + f".{os.getpid()}.{threading.get_ident()}.{next(_ifabsent_seq)}.ifabsent"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            try:
+                os.link(tmp, fs)
+            except FileExistsError:
+                raise PreconditionFailed(f"object exists: {path}") from None
+            finally:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
+        await asyncio.to_thread(_put)
+
     async def put_stream(self, path: str, chunks) -> int:
         """Streaming put from an async iterator of bytes chunks (the
         multipart-upload analog: the reference streams SST encodes straight
@@ -186,7 +238,7 @@ class LocalStore(ObjectStore):
                 return out
             for dirpath, _dirnames, filenames in os.walk(base):
                 for name in filenames:
-                    if name.endswith(".tmp"):
+                    if name.endswith((".tmp", ".ifabsent")):
                         continue
                     fs = os.path.join(dirpath, name)
                     rel = os.path.relpath(fs, self.root).replace(os.sep, "/")

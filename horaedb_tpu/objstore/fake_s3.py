@@ -115,6 +115,8 @@ class FakeS3:
             return web.Response(status=404, text="NoSuchBucket")
         key = request.match_info["key"]
         if request.method == "PUT":
+            if request.headers.get("If-None-Match") == "*" and key in self.objects:
+                return web.Response(status=412, text="PreconditionFailed")
             self.objects[key] = await request.read()
             return web.Response(status=200)
         if key not in self.objects:

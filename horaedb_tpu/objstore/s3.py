@@ -36,7 +36,12 @@ from dataclasses import dataclass, field
 
 from horaedb_tpu.common.error import HoraeError
 from horaedb_tpu.common.time_ext import ReadableDuration
-from horaedb_tpu.objstore import NotFound, ObjectMeta, ObjectStore
+from horaedb_tpu.objstore import (
+    NotFound,
+    ObjectMeta,
+    ObjectStore,
+    PreconditionFailed,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -216,10 +221,17 @@ class S3LikeStore(ObjectStore):
         payload: bytes | None = None,
         io: bool = False,
         uri: str | None = None,
+        extra_headers: dict[str, str] | None = None,
+        allow_statuses: tuple[int, ...] = (),
     ):
         """One signed request with bounded retries. Returns (status, body,
         content_length). 404 surfaces as NotFound; other 4xx raise S3Error
-        immediately; 5xx/429 and transport errors retry."""
+        immediately; 5xx/429 and transport errors retry.
+
+        `extra_headers` ride unsigned (legal in SigV4 — only SignedHeaders
+        participate in the signature); conditional headers like
+        `If-None-Match` go here. Statuses in `allow_statuses` return to the
+        caller instead of raising (e.g. 412 PreconditionFailed)."""
         import aiohttp
 
         import yarl
@@ -243,6 +255,8 @@ class S3LikeStore(ObjectStore):
         last: str = ""
         for attempt in range(attempts):
             headers = self._headers(method, uri, query, payload)
+            if extra_headers:
+                headers = {**headers, **extra_headers}
             try:
                 async with session.request(
                     method,
@@ -252,6 +266,8 @@ class S3LikeStore(ObjectStore):
                     timeout=req_timeout,
                 ) as resp:
                     body = await resp.read()
+                    if resp.status in allow_statuses:
+                        return resp.status, body, 0
                     if resp.status == 404:
                         raise NotFound(f"object not found: {key}")
                     if resp.status in (429,) or resp.status >= 500:
@@ -273,6 +289,19 @@ class S3LikeStore(ObjectStore):
 
     async def put(self, path: str, data: bytes) -> None:
         await self._request("PUT", self._key(path), payload=bytes(data), io=True)
+
+    async def put_if_absent(self, path: str, data: bytes) -> None:
+        # S3 conditional write (supported by AWS since 2024-08 and by the
+        # compatible stores this client targets): If-None-Match: * makes the
+        # PUT fail with 412 when the key exists. 409 also maps (some stores
+        # answer ConditionalRequestConflict for concurrent conditional PUTs
+        # racing on one key — for the caller both mean "lost the race").
+        status, _, _ = await self._request(
+            "PUT", self._key(path), payload=bytes(data), io=True,
+            extra_headers={"If-None-Match": "*"}, allow_statuses=(409, 412),
+        )
+        if status in (409, 412):
+            raise PreconditionFailed(f"object exists: {path}")
 
     async def get(self, path: str) -> bytes:
         _, body, _ = await self._request("GET", self._key(path), io=True)

@@ -131,6 +131,11 @@ class MetricEngineConfig:
     # design; one metric spans regions, reads fan out + merge, regions can
     # split). "metric" = coarse metric-granularity routing.
     region_granularity: str = "series"
+    # Non-empty = claim exclusive write ownership of each region root via
+    # epoch fencing (storage/fence.py): required when several server
+    # processes share one object store; a later claimant deposes this one
+    # and its writes fail with FencedError instead of corrupting manifests.
+    node_id: str = ""
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "MetricEngineConfig":

@@ -460,6 +460,9 @@ async def build_app(config: Config) -> web.Application:
         ingest_buffer_rows=config.metric_engine.ingest_buffer_rows,
         parser_pool=pool,
     )
+    if config.metric_engine.node_id:
+        # multi-process shared store: claim per-region write ownership
+        engine_kwargs["fence_node_id"] = config.metric_engine.node_id
     if config.metric_engine.num_regions > 1:
         from horaedb_tpu.engine.region import RegionedEngine
 

@@ -1,0 +1,147 @@
+"""Cross-process single-writer-per-region enforcement: epoch fencing.
+
+The reference gets single-writer-per-region *by construction* — one process
+owns one `ObjectBasedStorage` and the RFC's meta plane routes each region to
+exactly one node (/root/reference/docs/rfcs/20240827-metric-engine.md:28-76;
+/root/reference/src/columnar_storage/src/types.rs:135 notes the object store
+is the only shared medium). A shared S3 data plane gives no such guarantee:
+nothing stops two processes from both mounting one region root and racing
+its manifest. This module turns the assumption into an enforced contract.
+
+Design — monotonic epoch claims via conditional put (the fencing-token
+pattern, adapted to object stores):
+
+- Ownership of `{root}` is an epoch number. To acquire, list
+  `{root}/fence/`, take max+1, and `put_if_absent` the zero-padded epoch
+  key. The conditional put is the arbiter: exactly one contender can create
+  a given epoch (S3 `If-None-Match: *`; local FS atomic link; memory dict).
+- Highest epoch wins, forever. A writer holding epoch E validates before
+  every manifest mutation that no epoch > E exists; if one does it raises
+  FencedError and the engine refuses the write — the deposed writer can
+  never again move the manifest.
+- Validation is one LIST, cached for `validate_interval` seconds (0 =
+  validate every time, used by tests for deterministic interleavings). The
+  residual window is the in-flight mutation a deposed writer issued between
+  its last validation and the usurper's claim — the same window any
+  lease/fencing design has without server-side CAS on every object; closing
+  it entirely would need conditional puts on each delta/snapshot write.
+  With default settings that window is seconds; correctness of committed
+  history is unaffected because delta files are append-only and
+  id-monotonic (a stale delta adds a stale SST record; it never corrupts
+  the snapshot codec or clobbers another file).
+
+Epoch claims are never deleted: the dir stays tiny (one object per
+failover) and doubles as an ownership audit log.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.objstore import ObjectStore, PreconditionFailed
+
+logger = logging.getLogger(__name__)
+
+FENCE_DIR = "fence"
+
+
+class FencedError(HoraeError):
+    """This writer's epoch has been superseded — it no longer owns the
+    region and must stop mutating its manifest."""
+
+
+def _fence_dir(root: str) -> str:
+    return f"{root}/{FENCE_DIR}"
+
+
+def _epoch_path(root: str, epoch: int) -> str:
+    return f"{_fence_dir(root)}/{epoch:020d}"
+
+
+def _epoch_of(path: str) -> int:
+    try:
+        return int(path.rsplit("/", 1)[-1])
+    except ValueError:
+        return -1
+
+
+class EpochFence:
+    """A claimed writer epoch on one region root (see module docstring)."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        root: str,
+        epoch: int,
+        node_id: str,
+        validate_interval_s: float = 5.0,
+    ):
+        self._store = store
+        self._root = root
+        self.epoch = epoch
+        self.node_id = node_id
+        self._validate_interval = validate_interval_s
+        self._last_validated = time.monotonic()
+
+    @classmethod
+    async def acquire(
+        cls,
+        store: ObjectStore,
+        root: str,
+        node_id: str,
+        validate_interval_s: float = 5.0,
+        max_attempts: int = 16,
+    ) -> "EpochFence":
+        """Claim the next epoch on `root`. Loses of the conditional-put race
+        retry with the next number; every successful return is the unique
+        owner of a strictly higher epoch than all prior owners."""
+        payload = json.dumps(
+            {"node": node_id, "acquired_unix_ms": int(time.time() * 1000)}
+        ).encode()
+        for _ in range(max_attempts):
+            metas = await store.list(_fence_dir(root))
+            top = max((_epoch_of(m.path) for m in metas), default=0)
+            epoch = top + 1
+            try:
+                await store.put_if_absent(_epoch_path(root, epoch), payload)
+            except PreconditionFailed:
+                continue  # another contender took this epoch; re-list
+            logger.info(
+                "fence acquired: root=%s epoch=%d node=%s", root, epoch, node_id
+            )
+            return cls(store, root, epoch, node_id, validate_interval_s)
+        raise HoraeError(
+            f"could not acquire fence on {root} after {max_attempts} attempts "
+            "(heavy ownership contention)"
+        )
+
+    async def ensure_valid(self, force: bool = False) -> None:
+        """Raise FencedError if a higher epoch exists. Cached for
+        `validate_interval` seconds unless `force`."""
+        if (
+            not force
+            and self._validate_interval > 0
+            and time.monotonic() - self._last_validated < self._validate_interval
+        ):
+            return
+        metas = await self._store.list(_fence_dir(self._root))
+        top = max((_epoch_of(m.path) for m in metas), default=0)
+        if top > self.epoch:
+            raise FencedError(
+                f"writer epoch {self.epoch} on {self._root} superseded by "
+                f"{top}: this process no longer owns the region"
+            )
+        self._last_validated = time.monotonic()
+
+    async def current_owner(self) -> dict:
+        """The newest claim's payload (diagnostics / admin surface)."""
+        metas = await self._store.list(_fence_dir(self._root))
+        if not metas:
+            return {}
+        newest = max(metas, key=lambda m: _epoch_of(m.path))
+        info = json.loads(await self._store.get(newest.path))
+        info["epoch"] = _epoch_of(newest.path)
+        return info

@@ -52,7 +52,8 @@ class ManifestMerger:
     """Background delta→snapshot folder (mod.rs:178-333)."""
 
     def __init__(
-        self, root: str, store: ObjectStore, config: ManifestConfig, executor=None
+        self, root: str, store: ObjectStore, config: ManifestConfig, executor=None,
+        fence=None,
     ):
         self._root = root
         self._store = store
@@ -62,6 +63,9 @@ class ManifestMerger:
         # manifest-compact runtime analog (main.rs:102-119). None = fold
         # inline on the event loop (fine at test scale).
         self._executor = executor
+        # Optional EpochFence (storage/fence.py): a deposed process must not
+        # fold a stale view over the new owner's snapshot
+        self._fence = fence
         self._deltas_num = 0
         self._merge_signal: asyncio.Queue[None] = asyncio.Queue(maxsize=config.channel_size)
         self._task: asyncio.Task | None = None
@@ -127,7 +131,17 @@ class ManifestMerger:
             if self._deltas_num > self._config.min_merge_threshold:
                 try:
                     await self.do_merge()
-                except Exception:  # noqa: BLE001 - keep the loop alive
+                except Exception as e:  # noqa: BLE001 - keep the loop alive
+                    from horaedb_tpu.storage.fence import FencedError
+
+                    if isinstance(e, FencedError):
+                        # terminal: this process lost region ownership — a
+                        # retry loop would hammer the shared store (full
+                        # delta LIST+GET+fold per interval) forever
+                        logger.error(
+                            "manifest merger stopping: %s", e
+                        )
+                        return
                     logger.exception("manifest merge failed; will retry")
 
     async def do_merge(self) -> None:
@@ -160,6 +174,11 @@ class ManifestMerger:
                 data = await asyncio.get_running_loop().run_in_executor(
                     self._executor, fold
                 )
+            if self._fence is not None:
+                # fresh check RIGHT before the snapshot write: a deposed
+                # merger folding a stale delta list would regress the new
+                # owner's snapshot and lose its folded adds forever
+                await self._fence.ensure_valid(force=True)
             with context("write manifest snapshot"):
                 await self._store.put(snapshot_path(self._root), data)
             # Commit point passed: delta deletions are best-effort (mod.rs:310-330).
@@ -186,13 +205,17 @@ class Manifest:
     """Live-SST registry (mod.rs:66-176)."""
 
     def __init__(
-        self, root: str, store: ObjectStore, config: ManifestConfig, executor=None
+        self, root: str, store: ObjectStore, config: ManifestConfig, executor=None,
+        fence=None,
     ):
         self._root = root
         self._store = store
         self._config = config
         self._ssts: list[SstFile] = []
-        self._merger = ManifestMerger(root, store, config, executor=executor)
+        self._fence = fence
+        self._merger = ManifestMerger(
+            root, store, config, executor=executor, fence=fence
+        )
 
     @classmethod
     async def try_new(
@@ -202,8 +225,13 @@ class Manifest:
         config: ManifestConfig | None = None,
         start_background_merger: bool = True,
         executor=None,
+        fence=None,
     ) -> "Manifest":
-        m = cls(root, store, config or ManifestConfig(), executor=executor)
+        """`fence`: optional EpochFence enforcing cross-process single-writer
+        ownership of this manifest root (storage/fence.py) — every update
+        and snapshot fold validates the epoch first."""
+        m = cls(root, store, config or ManifestConfig(), executor=executor,
+                fence=fence)
         await m._merger.bootstrap()
         snapshot = await read_snapshot(store, snapshot_path(root))
         m._ssts = snapshot.into_ssts()
@@ -227,6 +255,9 @@ class Manifest:
         # Encode BEFORE counting the delta: an encode failure (e.g. a meta
         # field overflowing the u32 wire format) must not leak a phantom
         # increment that the merger can never drain.
+        if self._fence is not None:
+            # single-writer fence: a superseded epoch must not commit
+            await self._fence.ensure_valid()
         payload = encode_update(to_adds, to_deletes)
         self._merger.maybe_schedule_merge()
         path = delta_path(self._root, allocate_id())
