@@ -10,6 +10,8 @@ predicates followed by on-device aggregation.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pyarrow as pa
 
@@ -325,7 +327,7 @@ class SampleManager:
                 presorted0 = False  # concatenation breaks per-group order
             try:
                 await self._write_segment(
-                    *lanes0, presorted=presorted0, seq=seq0
+                    *lanes0, presorted=presorted0, seq=seq0, fast=True
                 )
             except BaseException:
                 for (sq, sg), grp in replay[i:]:
@@ -340,7 +342,7 @@ class SampleManager:
                 cols = [
                     np.concatenate([c[i] for c in cols_list]) for i in range(4)
                 ]
-                await self._write_segment(*cols, seq=snap_seq)
+                await self._write_segment(*cols, seq=snap_seq, fast=True)
             if chunks:
                 await self._flush_chunks(chunks, keys, seq=snap_seq)
         except BaseException:
@@ -353,9 +355,15 @@ class SampleManager:
     # written as independent SSTs concurrently: parquet encode (GIL-free)
     # and the per-object fsync are the flush bottleneck, and both overlap
     # across shards. More SSTs per segment is native LSM currency —
-    # compaction folds them. MAX_FLUSH_SHARDS bounds thread/file fan-out.
+    # compaction folds them. MAX_FLUSH_SHARDS bounds thread/file fan-out —
+    # by the ACTUAL cpu budget: on a 1-core box (CI, small containers)
+    # shard concurrency cannot overlap anything and each extra shard just
+    # pays its own fsync + manifest delta + encode setup.
     FLUSH_SHARD_ROWS = 128 * 1024
-    MAX_FLUSH_SHARDS = 8
+    MAX_FLUSH_SHARDS = min(8, 2 * (
+        len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity")
+        else (os.cpu_count() or 1)
+    ))
 
     async def _flush_accum_lanes(self, mid, tsid, ts, vals, seq=None) -> None:
         """Write out pk-sorted lanes taken from the C++ accumulator (the
@@ -406,12 +414,12 @@ class SampleManager:
                 lo = hi
         try:
             if len(work) == 1:
-                await self._write_segment(*work[0], presorted=True, seq=seq)
+                await self._write_segment(*work[0], presorted=True, seq=seq, fast=True)
             else:
                 async with asyncio.TaskGroup() as tg:
                     for lanes in work:
                         tg.create_task(
-                            self._write_segment(*lanes, presorted=True, seq=seq)
+                            self._write_segment(*lanes, presorted=True, seq=seq, fast=True)
                         )
         except BaseException:
             self._rebuffer_lanes(mid, tsid, ts, vals, per_seg, seq=seq)
@@ -503,12 +511,17 @@ class SampleManager:
         uniq = np.unique(seg)
         for seg_start in uniq:
             m = seg == seg_start if len(uniq) > 1 else slice(None)
-            await self._write_segment(mid[m], tsid[m], ts[m], vals[m], seq=seq)
+            await self._write_segment(mid[m], tsid[m], ts[m], vals[m], seq=seq, fast=True)
 
     async def _write_segment(
         self, metric_ids, tsids, ts, values,
         presorted: bool = False, seq: "int | None" = None,
+        fast: bool = False,
     ) -> None:
+        """`fast`: flush-path (L0) writes take the fast parquet profile —
+        compaction re-encodes them with the tuned one. Direct (unbuffered)
+        persists keep tuned encodings: with no buffer there may be no
+        compaction churn either, so those SSTs can live long."""
         batch = pa.RecordBatch.from_pydict(
             {
                 "metric_id": np.ascontiguousarray(metric_ids, dtype=np.uint64),
@@ -522,7 +535,8 @@ class SampleManager:
         lo = int(ts.min())
         hi = int(ts.max()) + 1
         await self._storage.write(
-            WriteRequest(batch, TimeRange(lo, hi), presorted=presorted, seq=seq)
+            WriteRequest(batch, TimeRange(lo, hi), presorted=presorted, seq=seq,
+                         fast_encode=fast)
         )
 
     # -- queries ---------------------------------------------------------------

@@ -97,6 +97,12 @@ class WriteConfig:
     enable_bloom_filter: bool = False
     compression: ParquetCompression = ParquetCompression.SNAPPY
     column_options: dict | None = None
+    # Fast-encode profile for ingest-flush SSTs (the LSM's L0): snappy +
+    # plain encoding writes ~2x faster than the tuned profile at ~1.7x the
+    # bytes; compaction re-encodes its outputs with the tuned profile, so
+    # the size cost is transient. Statistics and sorting columns are kept
+    # (row-group pruning and the presorted scan path must keep working).
+    flush_fast_encode: bool = True
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "WriteConfig":

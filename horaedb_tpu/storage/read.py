@@ -104,6 +104,10 @@ class WriteRequest:
     # snapshot-detach time so last-value dedup follows buffering order even
     # when a later snapshot's encode finishes first.
     seq: int | None = None
+    # Ingest-flush writes opt into the fast parquet encode profile (L0
+    # trade: ~2x faster encode, ~1.7x bytes until compaction re-encodes);
+    # honored only when WriteConfig.flush_fast_encode is on.
+    fast_encode: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -214,12 +218,64 @@ class _LinkProfile:
                     "sort_s_per_row": 1.2e-6}
 
 
-# host merge calibration (measured microbench on the CI shape): stable u64
+# host merge cost priors (measured microbench on the CI shape): stable u64
 # argsort + pack + dedup ≈ 150-250 ns per SURVIVING row; vectorized
 # predicate eval ≈ 2 ns/row per term. These only steer the host/device
 # choice — being 2x off moves the crossover, not correctness.
 _HOST_SORT_S_PER_ROW = 200e-9
 _HOST_EVAL_S_PER_ROW = 2e-9
+
+
+class _HostCalib:
+    """Self-calibrating host-cost estimates (VERDICT r04 #6).
+
+    The static numbers above are PRIORS; on any other machine they are
+    faith. Every real (non-presorted) host merge and host predicate eval is
+    timed in place and folded into a per-process EWMA, so a mis-set prior
+    converges to this host's true speed after a few sizable scans and the
+    host/device routing crossover lands where it belongs.
+
+    Learning is one-sided by construction: observations only arrive on the
+    routes actually taken, so a prior that wrongly makes the host look
+    EXPENSIVE routes everything to the device and never self-corrects (the
+    device side is covered by the measured _LinkProfile instead). The
+    dangerous direction — a prior that makes the host look cheap — corrects
+    itself, because the mis-routed host work is exactly what gets measured.
+
+    `HORAEDB_PLANNER_CALIB=off` freezes the priors (A/B and routing tests
+    that pin expectations to the static constants)."""
+
+    ALPHA = 0.25          # EWMA weight per observation
+    MIN_ROWS = 50_000     # below this, timer noise dominates the signal
+    _sort = _HOST_SORT_S_PER_ROW
+    _eval = _HOST_EVAL_S_PER_ROW
+
+    @staticmethod
+    def enabled() -> bool:
+        return os.environ.get("HORAEDB_PLANNER_CALIB", "on") != "off"
+
+    @classmethod
+    def sort_s_per_row(cls) -> float:
+        return cls._sort
+
+    @classmethod
+    def eval_s_per_row(cls) -> float:
+        return cls._eval
+
+    @classmethod
+    def observe_sort(cls, rows: int, secs: float) -> None:
+        if rows >= cls.MIN_ROWS and secs > 0 and cls.enabled():
+            cls._sort += cls.ALPHA * (secs / rows - cls._sort)
+
+    @classmethod
+    def observe_eval(cls, rows_terms: int, secs: float) -> None:
+        if rows_terms >= cls.MIN_ROWS and secs > 0 and cls.enabled():
+            cls._eval += cls.ALPHA * (secs / rows_terms - cls._eval)
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._sort = _HOST_SORT_S_PER_ROW
+        cls._eval = _HOST_EVAL_S_PER_ROW
 # Block size past which an ambient mesh upgrades the packed merge to the
 # cross-chip sample-sort (parallel/merge.py). Below it the all-to-all's
 # fixed cost (extra device sort + exchange + per-device dispatch) outweighs
@@ -500,10 +556,18 @@ def _plan_and_merge(
 
     def host_merge(mask: np.ndarray | None) -> np.ndarray:
         scanstats.note("path_host_merge")
+        sel_rows = int(np.count_nonzero(mask)) if mask is not None else n
+        t0 = time.perf_counter()
         with scanstats.stage("host_merge"):
-            return _host_merge_indices(
+            res = _host_merge_indices(
                 col_of, n, sort_keys, len(pk_names), mask, do_dedup
             )
+        # feed the planner's rolling host-sort estimate — but only when the
+        # merge actually sorted (the presorted O(n) shortcut is routed
+        # unconditionally and would poison the per-row figure)
+        if _presorted and not _presorted[0]:
+            _HostCalib.observe_sort(sel_rows, time.perf_counter() - t0)
+        return res
 
     key_bytes = sum(itemsize_of(name) for name in sort_keys)
 
@@ -631,7 +695,7 @@ def _plan_and_merge(
         # the arrow take that materializes survivors is paid identically by
         # both paths (the caller runs it on the returned indices), so it
         # appears in neither cost
-        return sel * _HOST_SORT_S_PER_ROW
+        return sel * _HostCalib.sort_s_per_row()
 
     _presorted: list[bool] = []
 
@@ -646,11 +710,22 @@ def _plan_and_merge(
                 ))
         return _presorted[0]
 
+    n_terms = (
+        max(1, len(list(filter_ops.iter_nodes(predicate))))
+        if predicate is not None else 1
+    )
+
+    def timed_eval() -> np.ndarray:
+        t0 = time.perf_counter()
+        mask = host_mask_fn()
+        _HostCalib.observe_eval(n * n_terms, time.perf_counter() - t0)
+        return mask
+
     def eval_mask() -> np.ndarray | None:
         if predicate is None:
             return None
         with scanstats.stage("host_filter"):
-            return host_mask_fn()
+            return timed_eval()
 
     if mode == "device":
         if binary_pred:
@@ -662,6 +737,16 @@ def _plan_and_merge(
         return device_merge(eval_mask())
     if mode == "host":
         return host_merge(eval_mask())
+    # ambient-mesh auto upgrade (docs/operations.md): past the sharded
+    # threshold the cross-chip merge supersedes the single-device cost
+    # compare — dev_cost models ONE device and would undersell an N-chip
+    # merge. Presorted blocks keep their O(n) host shortcut (no sort left
+    # to shard).
+    if n >= _sharded_min_rows() and not keys_presorted():
+        from horaedb_tpu.parallel.mesh import active_mesh
+
+        if active_mesh() is not None:
+            return device_merge(eval_mask())
     if predicate is None:
         if not keys_presorted() and dev_cost(key_bytes, n) < host_cost(n):
             return device_merge(None)
@@ -669,13 +754,12 @@ def _plan_and_merge(
 
     # auto with a predicate: if the device wins even at worst-case
     # selectivity, skip the host eval entirely
-    n_terms = max(1, len(list(filter_ops.iter_nodes(predicate))))
-    eval_cost = n * _HOST_EVAL_S_PER_ROW * n_terms
+    eval_cost = n * _HostCalib.eval_s_per_row() * n_terms
     if not binary_pred and dev_cost(tmpl_bytes, n) < eval_cost \
             and not keys_presorted():
         return device_merge(None)
     with scanstats.stage("host_filter"):
-        mask = host_mask_fn()
+        mask = timed_eval()
         sel = int(np.count_nonzero(mask))
     if sel == 0:
         return np.empty(0, np.int64)

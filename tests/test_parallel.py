@@ -496,3 +496,48 @@ class TestShardedScanEndToEnd:
         n_default, t_default = asyncio.run(run(None))
         assert n_sharded == n_default < 4  # compaction actually ran
         assert t_sharded.equals(t_default)
+
+
+class TestAutoShardedUpgrade:
+    def test_auto_mode_upgrades_past_threshold(self, mesh8, monkeypatch):
+        """With a mesh ambient and n past HORAEDB_SHARDED_MIN_ROWS, auto
+        mode must take the cross-chip route even when the single-device
+        cost model would have routed to host (docs/operations.md)."""
+        import pyarrow as pa
+
+        from horaedb_tpu.parallel.mesh import set_active_mesh
+        from horaedb_tpu.storage import scanstats
+        from horaedb_tpu.storage.config import UpdateMode
+        from horaedb_tpu.storage.read import _plan_and_merge
+        from horaedb_tpu.storage.types import StorageSchema
+
+        monkeypatch.delenv("HORAEDB_SCAN_PATH", raising=False)
+        monkeypatch.setenv("HORAEDB_SHARDED_MIN_ROWS", "100000")
+        schema = StorageSchema.try_new(
+            pa.schema([("pk", pa.int64()), ("v", pa.float64())]), 1,
+            UpdateMode.OVERWRITE,
+        )
+        n = 120_000
+        rng = np.random.default_rng(3)
+        cols = {
+            "pk": rng.integers(0, n // 4, n).astype(np.int64),
+            "__seq__": np.full(n, 3, dtype=np.uint64),
+            "v": rng.normal(size=n),
+        }
+        set_active_mesh(mesh8)
+        try:
+            with scanstats.scan_stats() as st:
+                idx = _plan_and_merge(
+                    schema, n, lambda name: cols[name], None, lambda: None,
+                    False, lambda name: cols[name].dtype.itemsize,
+                )
+        finally:
+            set_active_mesh(None)
+        assert "path_device_merge_sharded" in st.counts
+        # equivalence vs the host oracle
+        order = np.lexsort((cols["__seq__"], cols["pk"]))
+        grp = cols["pk"][order]
+        keep = np.empty(n, bool)
+        keep[:-1] = grp[:-1] != grp[1:]
+        keep[-1] = True
+        np.testing.assert_array_equal(np.sort(idx), np.sort(order[keep]))

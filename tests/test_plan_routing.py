@@ -218,3 +218,68 @@ class TestLinkProbeHardening:
         self._reset(monkeypatch, lambda: dict(real))
         monkeypatch.setenv("HORAEDB_LINK_PROBE_TIMEOUT_S", "10")
         assert _LinkProfile.get() == real
+
+
+import pytest
+
+
+class TestPlannerSelfCalibration:
+    """VERDICT r04 #6: a deliberately mis-set host-cost prior must converge
+    to the right route from in-place measurements (EWMA over real merges)."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_calib(self):
+        from horaedb_tpu.storage.read import _HostCalib
+
+        _HostCalib.reset()
+        yield
+        _HostCalib.reset()
+
+    def test_misset_cheap_host_prior_converges_to_device(self, monkeypatch):
+        from horaedb_tpu.storage.read import _HostCalib
+
+        monkeypatch.setattr(_LinkProfile, "_cached", dict(FAST_LINK))
+        # prior claims host sorts are ~free -> auto wrongly routes host
+        monkeypatch.setattr(_HostCalib, "_sort", 1e-12)
+        schema, n, cols = _make_inputs(n=200_000, shuffled=True)
+        with scanstats.scan_stats() as st0:
+            _run(schema, n, cols)
+        assert "path_host_merge" in _routes(st0)  # mis-routed at first
+        routes = None
+        for i in range(25):
+            with scanstats.scan_stats() as st:
+                _run(schema, n, cols)
+            routes = _routes(st)
+            if "path_host_merge" not in routes:
+                break
+        assert "path_host_merge" not in routes, (
+            f"route never converged off the mis-set prior; "
+            f"calibrated sort={_HostCalib.sort_s_per_row():.2e}"
+        )
+        # the estimate left the absurd prior far behind
+        assert _HostCalib.sort_s_per_row() > 1e-9
+
+    def test_calib_freezes_with_env_off(self, monkeypatch):
+        from horaedb_tpu.storage.read import _HostCalib
+
+        monkeypatch.setenv("HORAEDB_PLANNER_CALIB", "off")
+        monkeypatch.setattr(_LinkProfile, "_cached", dict(FAST_LINK))
+        monkeypatch.setattr(_HostCalib, "_sort", 1e-12)
+        schema, n, cols = _make_inputs(n=200_000, shuffled=True)
+        for _ in range(3):
+            with scanstats.scan_stats() as st:
+                _run(schema, n, cols)
+        assert "path_host_merge" in _routes(st)  # stays mis-routed, frozen
+        assert _HostCalib._sort == 1e-12
+
+    def test_presorted_merges_do_not_poison_estimate(self, monkeypatch):
+        from horaedb_tpu.storage.read import _HostCalib
+
+        monkeypatch.setattr(_LinkProfile, "_cached", dict(FAST_LINK))
+        before = _HostCalib.sort_s_per_row()
+        schema, n, cols = _make_inputs(n=200_000, shuffled=False)
+        with scanstats.scan_stats() as st:
+            _run(schema, n, cols)
+        assert "path_host_merge" in _routes(st)  # presorted always host
+        # the O(n) shortcut must not be folded into the per-row SORT cost
+        assert _HostCalib.sort_s_per_row() == before
