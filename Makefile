@@ -1,6 +1,6 @@
 # Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
 
-.PHONY: test native bench baselines serve lint clean soak dryruns tpu-suite
+.PHONY: test native bench baselines serve lint typecheck clean soak dryruns tpu-suite
 
 test:
 	python -m pytest tests/ -x -q
@@ -24,6 +24,17 @@ serve:
 lint:
 	python -m compileall -q horaedb_tpu tests benchmarks bench.py __graft_entry__.py
 	python tools/lint.py
+	$(MAKE) typecheck
+
+# mypy over the annotated core (config in pyproject.toml [tool.mypy]); the
+# dev image has no mypy, so this degrades to a loud skip locally — CI
+# (.github/workflows/ci.yml) installs and enforces it.
+typecheck:
+	@if python -c "import mypy" 2>/dev/null; then \
+	  python -m mypy; \
+	else \
+	  echo "typecheck: mypy not installed in this image; enforced in CI"; \
+	fi
 
 soak:
 	SOAK_REGIONS=3 SOAK_METRICS=8 SOAK_BUFFER_ROWS=30000 python benchmarks/soak.py 60
