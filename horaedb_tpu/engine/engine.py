@@ -527,8 +527,10 @@ class MetricEngine:
             for t, labels in sorted(per_tsid.items())
         ]
 
-    async def compact(self) -> None:
-        """Manual compaction trigger on the data table (the /compact hook)."""
+    async def compact(self, time_range=None) -> None:
+        """Manual compaction trigger on the data table (the /compact hook).
+        `time_range` scopes the pick (and its follow-on picks) to SSTs
+        overlapping that window; None compacts globally."""
         from horaedb_tpu.storage.read import CompactRequest
 
-        await self.data_table.compact(CompactRequest())
+        await self.data_table.compact(CompactRequest(time_range=time_range))

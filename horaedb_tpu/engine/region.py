@@ -538,10 +538,12 @@ class RegionedEngine:
             out.update(e.metadata())
         return out
 
-    async def compact(self) -> None:
+    async def compact(self, time_range=None) -> None:
         import asyncio
 
-        await asyncio.gather(*(e.compact() for e in self.engines.values()))
+        await asyncio.gather(
+            *(e.compact(time_range=time_range) for e in self.engines.values())
+        )
 
 
 def _merge_raw_tables(tagged: list, router: RangeRouter, limit: int | None):

@@ -395,6 +395,32 @@ def eval_predicate_host(pred: Predicate | None, table) -> np.ndarray:
     return ev(pred)
 
 
+def _isin_run_compressed(c: np.ndarray, probe: np.ndarray) -> np.ndarray:
+    """np.isin that exploits sorted-scan locality: engine lanes arrive in
+    (pk...) order, so tag/series columns are piecewise-constant. Detect the
+    runs (one vector diff) and, when the column compresses well, probe only
+    the run representatives and expand with repeat — the set probe is the
+    scan's costliest host-filter leaf (~7 ns/row via np.isin), and on a
+    10K-rows-per-series shape this turns it into ~2 ops/row. Columns that
+    don't compress (n_runs > n/8) keep the plain probe."""
+    n = len(c)
+    if n < 4096:
+        return np.isin(c, probe)
+    neq = c[1:] != c[:-1]
+    n_runs = int(np.count_nonzero(neq)) + 1
+    if n_runs > n // 8:
+        return np.isin(c, probe)
+    starts = np.empty(n_runs, dtype=np.int64)
+    starts[0] = 0
+    starts[1:] = np.flatnonzero(neq) + 1
+    reps = c[starts]
+    hit = np.isin(reps, probe)
+    lengths = np.empty(n_runs, dtype=np.int64)
+    lengths[:-1] = starts[1:] - starts[:-1]
+    lengths[-1] = n - starts[-1]
+    return np.repeat(hit, lengths)
+
+
 def eval_predicate_np(pred: Predicate | None, cols: dict[str, np.ndarray]) -> np.ndarray:
     """Vectorized predicate evaluation over numpy host lanes (numeric
     columns only; binary/string predicates go through eval_predicate_host).
@@ -425,7 +451,8 @@ def eval_predicate_np(pred: Predicate | None, cols: dict[str, np.ndarray]) -> np
             vals_list = _representable_values(p.values, c.dtype)
             if not vals_list:
                 return np.zeros(len(c), dtype=bool)
-            return np.isin(c, np.asarray(vals_list, dtype=c.dtype))
+            probe = np.asarray(vals_list, dtype=c.dtype)
+            return _isin_run_compressed(c, probe)
         if isinstance(p, And):
             out = ev(p.children[0])
             for ch in p.children[1:]:

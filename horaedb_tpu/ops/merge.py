@@ -1,12 +1,19 @@
-"""K-way sorted merge on device.
+"""K-way sorted merge on device: the standalone block-level primitive.
 
 The reference heap-merges k sorted SST streams row-by-row on CPU
 (SortPreservingMergeExec, read.rs:479-480). A comparison heap is the wrong
 shape for a vector machine; the XLA-idiomatic k-way merge is concatenate +
 one fused sort over the combined block — O(n log n) work but fully
 data-parallel, and the inputs being pre-sorted makes the sort's comparator
-networks cheap in practice. This is the core of both the scan path and the
-compaction executor (SURVEY C12, BASELINE config 5).
+networks cheap in practice.
+
+The PRODUCTION merge paths live elsewhere: the scan/compaction pipeline
+routes through storage/read.py (`_build_packed_index_kernel` single-chip,
+`_build_scan_kernel` fused filter+sort+dedup, and the hierarchical chunked
+scan's merge tree) and parallel/merge.py (the cross-chip sample-sort).
+This module is the simple whole-block form those paths specialize — used
+directly by small in-memory merges and as the oracle-sized building block
+in tests.
 """
 
 from __future__ import annotations

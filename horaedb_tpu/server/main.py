@@ -129,11 +129,30 @@ async def handle_toggle(request: web.Request) -> web.Response:
 
 
 async def handle_compact(request: web.Request) -> web.Response:
+    """Manual compaction. Optional `start`/`end` (epoch ms) scope the pick
+    to SSTs overlapping that window (reference /compact is global-only)."""
     state: ServerState = request.app[STATE_KEY]
-    await state.storage.compact(CompactRequest())
-    await state.engine.compact()
+    rng = None
+    if "start" in request.query or "end" in request.query:
+        try:
+            start = int(request.query.get("start", 0))
+            end = int(request.query.get("end", 1 << 62))
+        except ValueError:
+            return web.json_response(
+                {"error": "start/end must be integer epoch ms"}, status=400
+            )
+        if start > end:
+            return web.json_response(
+                {"error": f"start ({start}) must be <= end ({end})"}, status=400
+            )
+        rng = TimeRange(start, end)
+    await state.storage.compact(CompactRequest(time_range=rng))
+    await state.engine.compact(time_range=rng)
     METRICS.inc("horaedb_compactions_triggered_total")
-    return web.json_response({"compaction": "triggered"})
+    return web.json_response({
+        "compaction": "triggered",
+        **({"scope": [rng.start, rng.end]} if rng is not None else {}),
+    })
 
 
 async def handle_split_region(request: web.Request) -> web.Response:

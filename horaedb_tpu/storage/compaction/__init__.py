@@ -9,7 +9,12 @@ marking, manifest-commit-before-physical-delete).
 
 from dataclasses import dataclass, field
 
+from typing import TYPE_CHECKING
+
 from horaedb_tpu.storage.sst import SstFile
+
+if TYPE_CHECKING:
+    from horaedb_tpu.storage.types import TimeRange  # noqa: F401
 
 
 @dataclass
@@ -21,6 +26,11 @@ class Task:
     # Set by Executor.pre_check once the memory budget is charged, so the
     # release paths never refund a reservation that was never taken.
     mem_reserved: bool = field(default=False, compare=False)
+    # The time-range scope of the pick that produced this task (None =
+    # global). The executor's more-work ping re-picks under the SAME scope,
+    # so a window-scoped manual compaction drains its window instead of
+    # cascading into a global one; background ticks stay global.
+    scope: "TimeRange | None" = field(default=None, compare=False)
 
     def input_size(self) -> int:
         return sum(f.meta.size for f in self.inputs)

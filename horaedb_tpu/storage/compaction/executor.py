@@ -76,9 +76,11 @@ class Executor:
         for sst in task.inputs + task.expireds:
             sst.unmark_compaction()
 
-    def _trigger_more_task(self) -> None:
+    def _trigger_more_task(self, scope=None) -> None:
+        """Ping the picker for more work (executor.rs:147-151), re-picking
+        under the admitted task's scope (None = global)."""
         try:
-            self._trigger.put_nowait(None)
+            self._trigger.put_nowait(scope)
         except asyncio.QueueFull:
             pass
 
@@ -106,7 +108,7 @@ class Executor:
     # -- the compaction itself (executor.rs:155-222) --------------------------
     async def do_compaction(self, task: Task) -> None:
         self.pre_check(task)
-        self._trigger_more_task()
+        self._trigger_more_task(task.scope)
         logger.debug("Start do compaction, input_len=%d", len(task.inputs))
 
         time_range = TimeRange.union_of([f.meta.time_range for f in task.inputs])

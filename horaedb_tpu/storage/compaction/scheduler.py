@@ -19,6 +19,7 @@ from horaedb_tpu.storage.compaction import Task
 from horaedb_tpu.storage.compaction.executor import Executor
 from horaedb_tpu.storage.compaction.picker import TimeWindowCompactionStrategy
 from horaedb_tpu.storage.config import SchedulerConfig
+from horaedb_tpu.storage.types import TimeRange  # noqa: F401 — annotations
 
 logger = logging.getLogger(__name__)
 
@@ -33,7 +34,9 @@ class CompactionScheduler:
     ):
         self._config = config
         self._manifest = manifest
-        self._trigger: asyncio.Queue[None] = asyncio.Queue(maxsize=4)
+        # trigger payload = the pick scope: a TimeRange to restrict the
+        # pick, or None for a global pick (ticks and plain /compact)
+        self._trigger: "asyncio.Queue[TimeRange | None]" = asyncio.Queue(maxsize=4)
         self._tasks: asyncio.Queue[Task] = asyncio.Queue(
             maxsize=config.max_pending_compaction_tasks
         )
@@ -64,10 +67,16 @@ class CompactionScheduler:
         self._loops = []
         await self.executor.drain()
 
-    def trigger_compaction(self) -> None:
-        """Manual trigger, e.g. the `/compact` endpoint (scheduler.rs:106-112)."""
+    def trigger_compaction(self, time_range=None) -> None:
+        """Manual trigger, e.g. the `/compact` endpoint (scheduler.rs:106-112).
+
+        `time_range` scopes the pick to SSTs overlapping it (the reference's
+        CompactRequest is an empty struct and compacts globally; per-call
+        scoping lets an operator target one hot window without queueing work
+        for every segment). The scope rides the trigger channel; the
+        periodic tick stays global."""
         try:
-            self._trigger.put_nowait(None)
+            self._trigger.put_nowait(time_range)
         except asyncio.QueueFull:
             logger.debug("compaction trigger channel full; pick already pending")
 
@@ -83,14 +92,24 @@ class CompactionScheduler:
             for t in pending:
                 t.cancel()
             await asyncio.gather(*pending, return_exceptions=True)
-            self.pick_once()
+            scope = None
+            for t in done:
+                if t is recv and not t.cancelled() and t.exception() is None:
+                    scope = t.result()
+            self.pick_once(time_range=scope)
 
-    def pick_once(self) -> bool:
-        """One sequential pick; returns True if a task was enqueued."""
+    def pick_once(self, time_range=None) -> bool:
+        """One sequential pick; returns True if a task was enqueued.
+        `time_range` restricts candidates to overlapping SSTs."""
         expire_before = None
         if self._config.ttl is not None:
             expire_before = now_ms() - self._config.ttl.as_millis()
-        task = self._picker.pick_candidate(self._manifest.all_ssts(), expire_before)
+        ssts = self._manifest.all_ssts()
+        if time_range is not None:
+            ssts = [s for s in ssts if s.meta.time_range.overlaps(time_range)]
+        task = self._picker.pick_candidate(ssts, expire_before)
+        if task is not None:
+            task.scope = time_range
         if task is None:
             return False
         try:

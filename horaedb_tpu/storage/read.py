@@ -86,7 +86,11 @@ class ScanRequest:
 
 @dataclass
 class CompactRequest:
-    pass
+    """Manual-compaction request (storage.rs:372-374; the reference's is an
+    empty struct). `time_range` scopes the pick to SSTs overlapping it —
+    None keeps the reference's compact-everything behavior."""
+
+    time_range: "TimeRange | None" = None
 
 
 @dataclass

@@ -619,7 +619,7 @@ class ObjectBasedStorage(ColumnarStorage):
     # -- compaction (storage.rs:372-374) --------------------------------------
     async def compact(self, req: CompactRequest) -> None:
         ensure(self._scheduler is not None, "compaction scheduler disabled")
-        self._scheduler.trigger_compaction()
+        self._scheduler.trigger_compaction(time_range=req.time_range)
 
     @property
     def compaction_scheduler(self):

@@ -291,3 +291,49 @@ class TestShardedOutput:
         ).pick_candidate(ssts, expire_before_ms=None)
         assert picks is None or not picks.inputs
         await eng.close()
+
+
+class TestScopedCompaction:
+    @async_test
+    async def test_time_range_scope_limits_pick(self):
+        """CompactRequest.time_range compacts only the overlapping segment;
+        other segments' SSTs stay untouched (beyond the reference's empty
+        CompactRequest)."""
+        from horaedb_tpu.storage.read import CompactRequest
+
+        store = MemStore()
+        cfg = StorageConfig(
+            scheduler=SchedulerConfig(
+                schedule_interval=ReadableDuration.secs(3600),  # tick never fires
+                input_sst_min_num=2,
+            )
+        )
+        eng = await ObjectBasedStorage.try_new(
+            "db", store, make_schema(), 2, SEGMENT_MS,
+            config=cfg, start_background_merger=False,
+        )
+        schema = make_schema()
+        # 3 SSTs in segment 0, 3 in segment 1
+        for seg in range(2):
+            base = seg * SEGMENT_MS
+            for i in range(3):
+                await eng.write(
+                    WriteRequest(
+                        make_batch(schema, [1, 2 + i], [0, 0],
+                                   [base + 10, base + 20], [1.0, 2.0]),
+                        TimeRange(base + 10, base + 21),
+                    )
+                )
+        assert len(eng.manifest.all_ssts()) == 6
+        await eng.compact(CompactRequest(time_range=TimeRange(0, SEGMENT_MS)))
+        for _ in range(500):
+            await asyncio.sleep(0.02)
+            if len(eng.manifest.all_ssts()) <= 4:
+                break
+        await eng.compaction_scheduler.executor.drain()
+        ssts = eng.manifest.all_ssts()
+        seg0 = [s for s in ssts if s.meta.time_range.start < SEGMENT_MS]
+        seg1 = [s for s in ssts if s.meta.time_range.start >= SEGMENT_MS]
+        assert len(seg0) == 1      # scoped segment compacted
+        assert len(seg1) == 3      # out-of-scope segment untouched
+        await eng.close()
