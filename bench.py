@@ -16,6 +16,7 @@ real TPU chip); falls back to CPU with a smaller problem size.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -223,6 +224,12 @@ def main() -> None:
         "unit": "rows/s",
         "vs_baseline": round(best_rows_per_sec / base_rows_per_sec, 3),
         "platform": platform,
+        # CPU-fallback ratios depend on the box: XLA-CPU multithreads, the
+        # numpy baseline does not, so vs_baseline shrinks on small
+        # containers (r05's 1-core box: 1.43 vs r04's 2.12 for the SAME
+        # code). Recorded so cross-round CPU comparisons stay honest.
+        "cores": len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity")
+        else (os.cpu_count() or 1),
         "n_rows": n_rows,
         "num_series": num_series,
         "num_buckets": int(num_buckets),
@@ -244,8 +251,6 @@ def main() -> None:
     # backend) measures on the real chip and its result replaces the
     # fallback. Bounded: one 120 s probe + one child run; the child skips
     # this path (env guard) so there is no recursion.
-    import os
-
     if not responsive and os.environ.get("HORAEDB_BENCH_CHILD") != "1":
         recovered, _ = _device_responsive((120,))
         if recovered:

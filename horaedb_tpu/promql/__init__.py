@@ -1,0 +1,332 @@
+"""PromQL subset: tokenizer, AST, and parser.
+
+The reference ships no query language (its server is a demo HTTP surface,
+src/server/src/main.rs:59-80); its RFC names VictoriaMetrics as the model
+(docs/rfcs/20240827-metric-engine.md:80-84), whose whole point is serving
+PromQL over exactly this storage shape. This module closes that loop: a
+compact, honest subset of PromQL evaluated against the metric engine, with
+the `*_over_time` family and aggregations riding the device downsample
+pushdown (the TPU path) and counter functions riding the raw scan.
+
+Supported grammar (see promql/eval.py for semantics and divergences):
+
+    expr      := term (("+"|"-") term)*
+    term      := unary (("*"|"/") unary)*
+    unary     := "-"? primary
+    primary   := NUMBER
+               | FUNC "(" expr ")"
+               | AGG ("by"|"without") "(" labels ")" "(" expr ")"
+               | AGG "(" expr ")" [("by"|"without") "(" labels ")"]
+               | "(" expr ")"
+               | selector
+    selector  := NAME ["{" matcher ("," matcher)* "}"] ["[" DURATION "]"]
+    matcher   := NAME ("=" | "!=" | "=~" | "!~") STRING
+
+FUNC: rate increase delta avg_over_time sum_over_time min_over_time
+      max_over_time count_over_time last_over_time
+AGG:  sum avg min max count
+DURATION: integer + unit in {ms, s, m, h, d, w}
+
+Binary arithmetic requires at least one scalar operand (vector-vector
+matching is out of the subset and rejected loudly).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common.error import HoraeError
+
+
+class PromQLError(HoraeError):
+    """Parse or evaluation error (surfaces as Prometheus bad_data)."""
+
+
+FUNCS = frozenset({
+    "rate", "increase", "delta", "avg_over_time", "sum_over_time",
+    "min_over_time", "max_over_time", "count_over_time", "last_over_time",
+})
+AGGS = frozenset({"sum", "avg", "min", "max", "count"})
+
+_DURATION_UNITS = {
+    "ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+    "d": 86_400_000, "w": 7 * 86_400_000,
+}
+
+# matcher op -> QueryRequest matcher op (engine/engine.py:78-80); "=" maps
+# to the cheaper equality filter lane instead
+_MATCH_OPS = {"!=": "ne", "=~": "re", "!~": "nre"}
+
+
+# -- AST --------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Selector:
+    name: str
+    # (key, op, value) with op in {"=", "!=", "=~", "!~"}
+    matchers: tuple = ()
+    range_ms: int | None = None  # [5m] -> 300000; None = instant vector
+
+
+@dataclass(frozen=True)
+class Func:
+    fn: str
+    arg: Selector  # subset: over-time/counter functions take a selector
+
+
+@dataclass(frozen=True)
+class Agg:
+    op: str
+    expr: object
+    by: tuple | None = None       # by(...) projection
+    without: tuple | None = None  # without(...) exclusion
+
+
+@dataclass(frozen=True)
+class Scalar:
+    value: float
+
+
+@dataclass(frozen=True)
+class BinOp:
+    op: str  # + - * /
+    left: object
+    right: object
+
+
+# -- tokenizer --------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<WS>\s+)
+  | (?P<NUMBER>\d+\.\d*|\.\d+|\d+)
+  | (?P<NAME>[a-zA-Z_:][a-zA-Z0-9_:]*)
+  | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+  | (?P<OP>=~|!~|!=|=|\+|-|\*|/|\(|\)|\{|\}|\[|\]|,)
+    """,
+    re.VERBOSE,
+)
+
+
+@dataclass
+class _Tok:
+    kind: str
+    text: str
+    pos: int
+
+
+def _tokenize(src: str) -> list[_Tok]:
+    out, i = [], 0
+    while i < len(src):
+        m = _TOKEN_RE.match(src, i)
+        if m is None:
+            raise PromQLError(f"unexpected character {src[i]!r} at {i}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind != "WS":
+            out.append(_Tok(kind, m.group(), m.start()))
+    out.append(_Tok("EOF", "", len(src)))
+    return out
+
+
+def _unquote(s: str) -> str:
+    """Resolve PromQL string escapes. Hand-rolled: `unicode_escape` would
+    round-trip through latin-1 and mangle non-ASCII label values."""
+    body = s[1:-1]
+    if "\\" not in body:
+        return body
+    out, i = [], 0
+    simple = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\", '"': '"', "'": "'"}
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            n = body[i + 1]
+            if n in simple:
+                out.append(simple[n])
+                i += 2
+                continue
+            if n == "u" and i + 6 <= len(body):
+                try:
+                    out.append(chr(int(body[i + 2 : i + 6], 16)))
+                    i += 6
+                    continue
+                except ValueError:
+                    pass
+            if n == "x" and i + 4 <= len(body):
+                try:
+                    out.append(chr(int(body[i + 2 : i + 4], 16)))
+                    i += 4
+                    continue
+                except ValueError:
+                    pass
+            out.append(n)
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+# -- parser -----------------------------------------------------------------
+
+
+@dataclass
+class _Parser:
+    toks: list[_Tok]
+    i: int = field(default=0)
+
+    def peek(self) -> _Tok:
+        return self.toks[self.i]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, text: str) -> _Tok:
+        t = self.next()
+        if t.text != text:
+            raise PromQLError(f"expected {text!r} at {t.pos}, got {t.text!r}")
+        return t
+
+    # expr := term (("+"|"-") term)*
+    def expr(self):
+        node = self.term()
+        while self.peek().text in ("+", "-"):
+            op = self.next().text
+            node = BinOp(op, node, self.term())
+        return node
+
+    def term(self):
+        node = self.unary()
+        while self.peek().text in ("*", "/"):
+            op = self.next().text
+            node = BinOp(op, node, self.unary())
+        return node
+
+    def unary(self):
+        if self.peek().text == "-":
+            self.next()
+            return BinOp("-", Scalar(0.0), self.primary())
+        return self.primary()
+
+    def primary(self):
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            return Scalar(float(t.text))
+        if t.text == "(":
+            self.next()
+            node = self.expr()
+            self.expect(")")
+            return node
+        if t.kind == "NAME":
+            name = t.text
+            if name in FUNCS:
+                self.next()
+                self.expect("(")
+                arg = self.expr()
+                self.expect(")")
+                if not isinstance(arg, Selector):
+                    raise PromQLError(f"{name}() takes a range-vector selector")
+                if arg.range_ms is None:
+                    raise PromQLError(
+                        f"{name}() needs a range selector, e.g. m[5m]"
+                    )
+                return Func(name, arg)
+            if name in AGGS:
+                return self._aggregate(name)
+            return self._selector()
+        raise PromQLError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _aggregate(self, op: str):
+        self.next()  # the AGG name
+        by = without = None
+        if self.peek().text in ("by", "without"):
+            mode = self.next().text
+            labels = self._label_list()
+            if mode == "by":
+                by = labels
+            else:
+                without = labels
+        self.expect("(")
+        inner = self.expr()
+        self.expect(")")
+        if by is None and without is None and self.peek().text in ("by", "without"):
+            mode = self.next().text
+            labels = self._label_list()
+            if mode == "by":
+                by = labels
+            else:
+                without = labels
+        return Agg(op, inner, by=by, without=without)
+
+    def _label_list(self) -> tuple:
+        self.expect("(")
+        out = []
+        while self.peek().text != ")":
+            t = self.next()
+            if t.kind != "NAME":
+                raise PromQLError(f"expected label name at {t.pos}")
+            out.append(t.text)
+            if self.peek().text == ",":
+                self.next()
+        self.expect(")")
+        return tuple(out)
+
+    def _selector(self):
+        name = self.next().text
+        matchers = []
+        if self.peek().text == "{":
+            self.next()
+            while self.peek().text != "}":
+                key = self.next()
+                if key.kind != "NAME":
+                    raise PromQLError(f"expected label name at {key.pos}")
+                op = self.next().text
+                if op not in ("=", "!=", "=~", "!~"):
+                    raise PromQLError(f"bad matcher op {op!r}")
+                val = self.next()
+                if val.kind != "STRING":
+                    raise PromQLError(f"expected quoted value at {val.pos}")
+                matchers.append((key.text, op, _unquote(val.text)))
+                if self.peek().text == ",":
+                    self.next()
+            self.expect("}")
+        range_ms = None
+        if self.peek().text == "[":
+            self.next()
+            num = self.next()
+            if num.kind != "NUMBER":
+                raise PromQLError(f"expected duration at {num.pos}")
+            unit = self.next()
+            if unit.text not in _DURATION_UNITS:
+                raise PromQLError(f"bad duration unit {unit.text!r}")
+            range_ms = int(float(num.text) * _DURATION_UNITS[unit.text])
+            self.expect("]")
+        return Selector(name, tuple(matchers), range_ms)
+
+
+def parse(src: str):
+    """Parse one PromQL expression; raises PromQLError on any syntax the
+    subset does not cover."""
+    p = _Parser(_tokenize(src))
+    node = p.expr()
+    if p.peek().kind != "EOF":
+        t = p.peek()
+        raise PromQLError(f"trailing input at {t.pos}: {t.text!r}")
+    return node
+
+
+def parse_duration_ms(s: str) -> int:
+    """'5m' / '30s' / '250ms' -> milliseconds (for the `step` params)."""
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w)", s)
+    if m is None:
+        # Prometheus also accepts bare seconds
+        try:
+            return int(float(s) * 1000)
+        except ValueError:
+            raise PromQLError(f"bad duration {s!r}") from None
+    return int(float(m.group(1)) * _DURATION_UNITS[m.group(2)])

@@ -1,0 +1,405 @@
+"""PromQL subset evaluation over the metric engine.
+
+Execution strategy (the point of doing this in a TPU framework):
+
+- `sum_over_time` / `count_over_time` / `avg_over_time` / `min_over_time`
+  / `max_over_time` with window == step ride the engine's aggregate
+  PUSHDOWN (engine/data.py::query_downsample): every per-(series, bucket)
+  reduction runs inside the device scan — raw rows never reach the host.
+- Counter functions (`rate`, `increase`, `delta`), `last_over_time`,
+  instant selectors, and windows != step need per-window first/last
+  semantics the grid does not carry; they evaluate from the raw scan with
+  vectorized per-series window reductions on host.
+- Aggregations (`sum by (...)`) group the per-series step vectors; scalar
+  arithmetic is elementwise.
+
+Documented divergences from Prometheus (semantics kept simple and stated
+rather than silently approximated):
+
+1. Windows are right-aligned HALF-OPEN buckets [t-step, t) evaluated at
+   each step timestamp, not Prometheus's (t-window, t] — boundary samples
+   land one bucket later.
+2. `rate`/`increase` use (last - first + counter-reset corrections) over
+   the window WITHOUT Prometheus's edge extrapolation — values are exact
+   over observed samples, slightly lower than Prometheus near window
+   edges.
+3. Instant vector lookback is 5 minutes (Prometheus default), applied at
+   each step of a range query.
+4. Vector-vector binary arithmetic (label matching) is not in the subset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from horaedb_tpu.engine.engine import QueryRequest
+from horaedb_tpu.promql import (
+    Agg,
+    BinOp,
+    Func,
+    PromQLError,
+    Scalar,
+    Selector,
+    _MATCH_OPS,
+)
+
+LOOKBACK_MS = 300_000  # Prometheus default instant-vector staleness window
+
+# grid stat backing each aligned *_over_time function
+_GRID_STAT = {
+    "sum_over_time": "sum",
+    "count_over_time": "count",
+    "avg_over_time": "mean",
+    "min_over_time": "min",
+    "max_over_time": "max",
+}
+
+
+@dataclass
+class SeriesVector:
+    """One output series: its labels and one value per step (NaN = absent)."""
+
+    labels: dict[str, str]
+    values: np.ndarray
+
+
+def _to_query(sel: Selector, start_ms: int, end_ms: int,
+              bucket_ms: int | None = None) -> QueryRequest:
+    filters, matchers = [], []
+    for key, op, val in sel.matchers:
+        if op == "=":
+            filters.append((key.encode(), val.encode()))
+        else:
+            matchers.append((key.encode(), _MATCH_OPS[op], val.encode()))
+    return QueryRequest(
+        metric=sel.name.encode(), start_ms=start_ms, end_ms=end_ms,
+        filters=filters, matchers=matchers, bucket_ms=bucket_ms,
+    )
+
+
+class RangeEvaluator:
+    """Evaluate one parsed expression over [start, end] at `step` spacing.
+
+    Steps are `start + k*step` for k in 0..floor((end-start)/step)
+    (Prometheus range-query grid)."""
+
+    def __init__(self, engine, start_ms: int, end_ms: int, step_ms: int,
+                 max_series: int = 10_000):
+        if step_ms <= 0:
+            raise PromQLError("step must be > 0")
+        if end_ms < start_ms:
+            raise PromQLError("end must be >= start")
+        n_steps = (end_ms - start_ms) // step_ms + 1
+        if n_steps > 11_000:
+            raise PromQLError(
+                f"{n_steps} steps exceeds the resolution limit (11000); "
+                "increase step"
+            )
+        self._engine = engine
+        self.start = start_ms
+        self.step = step_ms
+        self.steps = start_ms + step_ms * np.arange(n_steps, dtype=np.int64)
+        self._max_series = max_series
+
+    # -- public -------------------------------------------------------------
+
+    async def eval(self, node) -> "list[SeriesVector] | float":
+        if isinstance(node, Scalar):
+            return node.value
+        if isinstance(node, BinOp):
+            return await self._binop(node)
+        if isinstance(node, Selector):
+            if node.range_ms is not None:
+                raise PromQLError(
+                    "a range selector needs a function (rate, *_over_time)"
+                )
+            return await self._instant(node)
+        if isinstance(node, Func):
+            return await self._func(node)
+        if isinstance(node, Agg):
+            return await self._agg(node)
+        raise PromQLError(f"unsupported node {type(node).__name__}")
+
+    # -- series plumbing ----------------------------------------------------
+
+    def _labels_of(self, sel: Selector, keep_name: bool):
+        """tsid -> result labels for one selector's metric."""
+        hit = self._engine.metric_mgr.get(sel.name.encode())
+        if hit is None:
+            return {}
+        by_tsid = self._engine.index_mgr.series_labels(hit[0])
+        out = {}
+        for tsid, labs in by_tsid.items():
+            d = {k.decode(errors="replace"): v.decode(errors="replace")
+                 for k, v in labs.items()}
+            if keep_name:
+                d["__name__"] = sel.name
+            out[tsid] = d
+        return out
+
+    async def _raw_series(self, sel: Selector, pre_ms: int):
+        """Raw samples per tsid over [start - pre, end], each sorted by ts:
+        {tsid: (ts_array, value_array)}."""
+        req = _to_query(sel, self.start - pre_ms, int(self.steps[-1]) + 1)
+        table = await self._engine.query(req)
+        if table is None:
+            return {}
+        tsid = table.column("tsid").to_numpy(zero_copy_only=False).astype(np.uint64)
+        ts = table.column("ts").to_numpy(zero_copy_only=False).astype(np.int64)
+        val = table.column("value").to_numpy(zero_copy_only=False)
+        order = np.lexsort((ts, tsid))
+        tsid, ts, val = tsid[order], ts[order], val[order]
+        out = {}
+        bounds = np.flatnonzero(tsid[1:] != tsid[:-1]) + 1
+        starts = np.concatenate([[0], bounds, [len(tsid)]])
+        for i in range(len(starts) - 1):
+            lo, hi = starts[i], starts[i + 1]
+            if lo < hi:
+                out[int(tsid[lo])] = (ts[lo:hi], val[lo:hi])
+        if len(out) > self._max_series:
+            raise PromQLError(
+                f"query selects {len(out)} series (limit {self._max_series})"
+            )
+        return out
+
+    # -- selector / function evaluation --------------------------------------
+
+    async def _instant(self, sel: Selector) -> list[SeriesVector]:
+        """Instant vector at each step: last sample within the lookback."""
+        series = await self._raw_series(sel, LOOKBACK_MS)
+        labels = self._labels_of(sel, keep_name=True)
+        out = []
+        for tsid, (ts, val) in series.items():
+            idx = np.searchsorted(ts, self.steps, side="right") - 1
+            vals = np.full(len(self.steps), np.nan)
+            ok = idx >= 0
+            cand = np.where(ok, idx, 0)
+            fresh = ok & (self.steps - ts[cand] <= LOOKBACK_MS)
+            vals[fresh] = val[cand[fresh]]
+            if np.isnan(vals).all():
+                continue
+            out.append(SeriesVector(labels.get(tsid, {}), vals))
+        return out
+
+    async def _func(self, node: Func) -> list[SeriesVector]:
+        sel = node.arg
+        window = sel.range_ms
+        if node.fn in _GRID_STAT and window == self.step:
+            return await self._grid_over_time(node.fn, sel)
+        series = await self._raw_series(sel, window)
+        labels = self._labels_of(sel, keep_name=False)
+        out = []
+        for tsid, (ts, val) in series.items():
+            vals = self._window_reduce(node.fn, ts, val, window)
+            if np.isnan(vals).all():
+                continue
+            out.append(SeriesVector(labels.get(tsid, {}), vals))
+        return out
+
+    async def _grid_over_time(self, fn: str, sel: Selector) -> list[SeriesVector]:
+        """window == step: ONE device-pushdown downsample serves every step
+        — the TPU fast path (raw rows never reach the host).
+
+        Buckets anchor one window BEFORE the first step, so bucket k covers
+        [steps[k] - step, steps[k]) and step 0 gets a real value from
+        pre-range samples — identical alignment to the raw-path
+        `_window_reduce` (a step nudge across the ==window boundary must
+        not add or drop points)."""
+        t0 = self.start - self.step
+        req = _to_query(sel, t0, int(self.steps[-1]), bucket_ms=self.step)
+        res = await self._engine.query(req)
+        labels = self._labels_of(sel, keep_name=False)
+        if res is None:
+            return []
+        tsids, grids = res
+        stat = _GRID_STAT[fn]
+        grid = np.asarray(grids[stat], dtype=np.float64)
+        count = np.asarray(grids["count"])
+        out = []
+        for i, tsid in enumerate(tsids):
+            vals = np.full(len(self.steps), np.nan)
+            n = min(grid.shape[1], len(self.steps))
+            v = grid[i, :n].copy()
+            v[count[i, :n] == 0] = np.nan
+            vals[:n] = v
+            if np.isnan(vals).all():
+                continue
+            out.append(SeriesVector(labels.get(int(tsid), {}), vals))
+        return out
+
+    def _window_reduce(self, fn: str, ts, val, window: int) -> np.ndarray:
+        """Per-step reduction over [t-window, t) windows of one series."""
+        lo = np.searchsorted(ts, self.steps - window, side="left")
+        hi = np.searchsorted(ts, self.steps, side="left")
+        n = len(self.steps)
+        vals = np.full(n, np.nan)
+        if fn in ("sum_over_time", "count_over_time", "avg_over_time"):
+            csum = np.concatenate([[0.0], np.cumsum(val)])
+            cnt = (hi - lo).astype(np.float64)
+            s = csum[hi] - csum[lo]
+            nz = cnt > 0
+            if fn == "sum_over_time":
+                vals[nz] = s[nz]
+            elif fn == "count_over_time":
+                vals[nz] = cnt[nz]
+            else:
+                vals[nz] = s[nz] / cnt[nz]
+            return vals
+        if fn == "last_over_time":
+            nz = hi > lo
+            vals[nz] = val[hi[nz] - 1]
+            return vals
+        if fn in ("min_over_time", "max_over_time"):
+            red = np.minimum if fn == "min_over_time" else np.maximum
+            for k in range(n):
+                if hi[k] > lo[k]:
+                    vals[k] = red.reduce(val[lo[k] : hi[k]])
+            return vals
+        if fn in ("rate", "increase", "delta"):
+            # counter semantics: increase = last - first + resets. A reset
+            # restarts the counter at ~0, so each one contributes the full
+            # PRE-RESET value (Prometheus's correction), not the drop
+            # amount. delta skips the correction (gauge). No edge
+            # extrapolation (module docstring).
+            drops = np.where(val[1:] < val[:-1], val[:-1], 0.0)
+            cdrop = np.concatenate([[0.0], np.cumsum(drops)])
+            nz = hi - lo >= 2
+            first = val[np.where(nz, lo, 0)]
+            last = val[np.where(nz, hi - 1, 0)]
+            resets = cdrop[np.where(nz, hi - 1, 0)] - cdrop[np.where(nz, lo, 0)]
+            if fn == "delta":
+                vals[nz] = (last - first)[nz]
+            else:
+                inc = (last - first + resets)[nz]
+                vals[nz] = inc if fn == "increase" else inc / (window / 1000.0)
+            return vals
+        raise PromQLError(f"unsupported function {fn}")
+
+    # -- aggregation / arithmetic --------------------------------------------
+
+    async def _agg(self, node: Agg) -> list[SeriesVector]:
+        inner = await self.eval(node.expr)
+        if isinstance(inner, float):
+            raise PromQLError(f"{node.op}() needs a vector operand")
+        groups: dict[tuple, list[SeriesVector]] = {}
+        for sv in inner:
+            if node.by is not None:
+                key_labels = {k: sv.labels.get(k, "") for k in node.by}
+            elif node.without is not None:
+                key_labels = {
+                    k: v for k, v in sv.labels.items()
+                    if k not in node.without and k != "__name__"
+                }
+            else:
+                key_labels = {}
+            key = tuple(sorted(key_labels.items()))
+            groups.setdefault(key, []).append(sv)
+        out = []
+        for key, members in sorted(groups.items()):
+            stack = np.stack([m.values for m in members])
+            with np.errstate(all="ignore"):
+                if node.op == "sum":
+                    vals = np.nansum(stack, axis=0)
+                elif node.op == "avg":
+                    vals = np.nanmean(stack, axis=0)
+                elif node.op == "min":
+                    vals = np.nanmin(stack, axis=0)
+                elif node.op == "max":
+                    vals = np.nanmax(stack, axis=0)
+                else:  # count
+                    vals = np.sum(~np.isnan(stack), axis=0).astype(np.float64)
+            # all-NaN step stays NaN (nansum yields 0.0 there — mask it)
+            allnan = np.isnan(stack).all(axis=0)
+            if node.op in ("sum", "count"):
+                vals = np.where(allnan, np.nan, vals)
+            out.append(SeriesVector(dict(key), vals))
+        return out
+
+    async def _binop(self, node: BinOp):
+        left = await self.eval(node.left)
+        right = await self.eval(node.right)
+        if isinstance(left, float) and isinstance(right, float):
+            return float(_apply(node.op, np.float64(left), np.float64(right)))
+        if isinstance(left, float):
+            return [
+                SeriesVector(sv.labels, _apply(node.op, left, sv.values))
+                for sv in right
+            ]
+        if isinstance(right, float):
+            return [
+                SeriesVector(sv.labels, _apply(node.op, sv.values, right))
+                for sv in left
+            ]
+        raise PromQLError(
+            "vector-vector arithmetic is outside the subset; one operand "
+            "must be a scalar"
+        )
+
+
+def _apply(op: str, a, b):
+    with np.errstate(all="ignore"):
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        return a / b
+
+
+def to_prometheus_matrix(
+    series: "list[SeriesVector] | float", steps: np.ndarray
+) -> dict:
+    """Prometheus /api/v1/query_range response `data` payload."""
+    secs = steps / 1000.0
+    if isinstance(series, float):
+        return {
+            "resultType": "matrix",
+            "result": [{
+                "metric": {},
+                "values": [[float(s), _fmt(series)] for s in secs],
+            }],
+        }
+    result = []
+    for sv in series:
+        pts = [
+            [float(secs[i]), _fmt(sv.values[i])]
+            for i in range(len(steps))
+            if not np.isnan(sv.values[i])
+        ]
+        if pts:
+            result.append({"metric": sv.labels, "values": pts})
+    return {"resultType": "matrix", "result": result}
+
+
+def to_prometheus_vector(
+    series: "list[SeriesVector] | float", at_ms: int
+) -> dict:
+    """Prometheus instant-query `data` payload (last step only)."""
+    sec = at_ms / 1000.0
+    if isinstance(series, float):
+        return {
+            "resultType": "scalar",
+            "result": [sec, _fmt(series)],
+        }
+    result = []
+    for sv in series:
+        v = sv.values[-1]
+        if not np.isnan(v):
+            result.append({"metric": sv.labels, "value": [sec, _fmt(v)]})
+    return {"resultType": "vector", "result": result}
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)

@@ -269,8 +269,109 @@ def _raw_table_response(table, limit: int) -> web.Response:
     return web.json_response(body)
 
 
+async def _promql_params(request: web.Request) -> dict:
+    """Merge query-string and form/JSON body params (Prometheus clients
+    send either; Grafana's POST mode uses form bodies). Malformed bodies
+    raise ValueError so callers answer the Prometheus 400 shape."""
+    out = dict(request.query)
+    if request.method == "POST":
+        if request.content_type == "application/json":
+            try:
+                body = await request.json()
+            except Exception as e:  # noqa: BLE001 — client data
+                raise ValueError(f"bad JSON body: {e}") from None
+            if not isinstance(body, dict):
+                raise ValueError("JSON body must be an object")
+            out.update({k: str(v) for k, v in body.items()})
+        else:
+            body = await request.post()
+            out.update({k: v for k, v in body.items() if isinstance(v, str)})
+    return out
+
+
+def _promql_error(e: Exception) -> web.Response:
+    return web.json_response(
+        {"status": "error", "errorType": "bad_data", "error": str(e)},
+        status=400,
+    )
+
+
+async def handle_query_range(request: web.Request) -> web.Response:
+    """Prometheus-compatible /api/v1/query_range: PromQL over the engine
+    (the subset in horaedb_tpu/promql — *_over_time/aggregations ride the
+    device pushdown). The reference has no query language at all."""
+    from horaedb_tpu.promql import PromQLError, parse, parse_duration_ms
+    from horaedb_tpu.promql.eval import RangeEvaluator, to_prometheus_matrix
+
+    state: ServerState = request.app[STATE_KEY]
+    try:
+        p = await _promql_params(request)
+        expr = parse(p["query"])
+        start_ms = int(float(p["start"]) * 1000)
+        end_ms = int(float(p["end"]) * 1000)
+        step_ms = parse_duration_ms(p["step"])
+        ev = RangeEvaluator(state.engine, start_ms, end_ms, step_ms)
+        series = await ev.eval(expr)
+    except (PromQLError, HoraeError, KeyError, ValueError) as e:
+        return _promql_error(e)
+    METRICS.inc("horaedb_queries_total")
+    return web.json_response(
+        {"status": "success", "data": to_prometheus_matrix(series, ev.steps)}
+    )
+
+
+async def handle_promql_instant(
+    request: web.Request, params: dict
+) -> web.Response:
+    """Prometheus-compatible instant query (the `query` param form of
+    /api/v1/query; requests without `query` fall through to the native
+    JSON query API below)."""
+    from horaedb_tpu.common.time_ext import now_ms
+    from horaedb_tpu.promql import PromQLError, parse
+    from horaedb_tpu.promql.eval import (
+        LOOKBACK_MS,
+        RangeEvaluator,
+        to_prometheus_vector,
+    )
+
+    state: ServerState = request.app[STATE_KEY]
+    try:
+        expr = parse(params["query"])
+        at_ms = int(float(params.get("time", now_ms() / 1000.0)) * 1000)
+        # instant = a one-step range ending at `time` (window functions need
+        # a left context; LOOKBACK covers bare selectors)
+        ev = RangeEvaluator(state.engine, at_ms - LOOKBACK_MS, at_ms, LOOKBACK_MS)
+        series = await ev.eval(expr)
+    except (PromQLError, HoraeError, ValueError) as e:
+        return _promql_error(e)
+    METRICS.inc("horaedb_queries_total")
+    return web.json_response(
+        {"status": "success", "data": to_prometheus_vector(series, at_ms)}
+    )
+
+
 async def handle_query(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
+    # PromQL routing: `query` in the URL, or in a form-encoded POST body
+    # (Grafana's POST mode). JSON POST bodies stay on the native API — its
+    # own `query` key never existed, so there is no ambiguity.
+    if "query" in request.query:
+        return await handle_promql_instant(request, dict(request.query))
+    if (
+        request.method == "POST"
+        and request.content_type in (
+            "application/x-www-form-urlencoded", "multipart/form-data"
+        )
+    ):
+        form = await request.post()
+        if "query" in form:
+            params = dict(request.query)
+            params.update({k: v for k, v in form.items() if isinstance(v, str)})
+            return await handle_promql_instant(request, params)
+        return web.json_response(
+            {"error": "form body without `query`; use the JSON API"},
+            status=400,
+        )
     try:
         if request.method == "GET":
             # curl/Grafana-style convenience: scalar params in the query
@@ -527,6 +628,8 @@ async def build_app(config: Config) -> web.Application:
             web.post("/api/v1/write", handle_remote_write),
             web.post("/api/v1/query", handle_query),
             web.get("/api/v1/query", handle_query),
+            web.get("/api/v1/query_range", handle_query_range),
+            web.post("/api/v1/query_range", handle_query_range),
             web.get("/api/v1/labels", handle_labels),
             web.get("/api/v1/metrics", handle_metrics_list),
             web.get("/api/v1/series", handle_series),

@@ -541,3 +541,66 @@ class TestAutoShardedUpgrade:
         keep[:-1] = grp[:-1] != grp[1:]
         keep[-1] = True
         np.testing.assert_array_equal(np.sort(idx), np.sort(order[keep]))
+
+
+class TestShardedAppendMode:
+    def test_append_mode_scan_sharded_equals_default(self, mesh8, monkeypatch):
+        """UpdateMode.APPEND (no dedup): the cross-chip merge must keep
+        every duplicate row in the same global order as the default path."""
+        import asyncio
+
+        import pyarrow as pa
+
+        from horaedb_tpu.objstore import MemStore
+        from horaedb_tpu.parallel.mesh import set_active_mesh
+        from horaedb_tpu.storage import (
+            ObjectBasedStorage,
+            ScanRequest,
+            StorageConfig,
+            TimeRange,
+            WriteRequest,
+        )
+        from horaedb_tpu.storage.config import UpdateMode
+
+        SEG = 3_600_000
+        schema = pa.schema(
+            [("pk1", pa.int64()), ("ts", pa.int64()), ("value", pa.float64())]
+        )
+
+        async def run(scan_path: str | None):
+            if scan_path:
+                monkeypatch.setenv("HORAEDB_SCAN_PATH", scan_path)
+                set_active_mesh(mesh8)
+            else:
+                monkeypatch.delenv("HORAEDB_SCAN_PATH", raising=False)
+            try:
+                rng = np.random.default_rng(17)
+                store = MemStore()
+                eng = await ObjectBasedStorage.try_new(
+                    root="db", store=store, arrow_schema=schema,
+                    num_primary_keys=2, segment_duration_ms=SEG,
+                    config=StorageConfig(update_mode=UpdateMode.APPEND),
+                    enable_compaction_scheduler=False,
+                    start_background_merger=False,
+                )
+                for _w in range(3):
+                    n = 2500
+                    batch = pa.RecordBatch.from_pydict(
+                        {"pk1": rng.integers(0, 50, n).astype(np.int64),
+                         "ts": rng.integers(0, SEG - 1, n).astype(np.int64),
+                         "value": rng.normal(size=n)},
+                        schema=schema,
+                    )
+                    await eng.write(WriteRequest(batch, TimeRange(0, SEG)))
+                out = []
+                async for b in eng.scan(ScanRequest(range=TimeRange(0, SEG))):
+                    out.append(b)
+                await eng.close()
+                return pa.Table.from_batches(out)
+            finally:
+                set_active_mesh(None)
+
+        t_sharded = asyncio.run(run("sharded"))
+        t_default = asyncio.run(run(None))
+        assert t_sharded.num_rows == 7500  # nothing deduped
+        assert t_sharded.equals(t_default)
