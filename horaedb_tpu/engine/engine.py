@@ -527,6 +527,39 @@ class MetricEngine:
             for t, labels in sorted(per_tsid.items())
         ]
 
+    def series_labels_map(
+        self, metric: bytes, tsids: "list[int] | None" = None
+    ) -> dict[int, dict[bytes, bytes]]:
+        """tsid -> raw label map for a metric, optionally restricted to
+        `tsids` (so a selective query never decodes the whole metric's
+        series). PromQL/discovery surface — implemented by RegionedEngine
+        too (fan-out union)."""
+        hit = self.metric_mgr.get(metric)
+        if hit is None:
+            return {}
+        per_tsid = self.index_mgr.series_labels(hit[0])
+        if tsids is None:
+            return per_tsid
+        return {t: per_tsid[t] for t in tsids if t in per_tsid}
+
+    async def match_series(
+        self, metric: bytes, filters, matchers
+    ) -> dict[int, dict[bytes, bytes]]:
+        """Matched tsid -> label map (Prometheus match[] resolution). Regex
+        matchers evaluate off the event loop — same safeguard as queries
+        (_resolve_query_async): Python `re` has no linear-time guarantee."""
+        resolved = await self._resolve_query_async(
+            QueryRequest(metric=metric, start_ms=0, end_ms=1,
+                         filters=filters, matchers=matchers)
+        )
+        if resolved is None:
+            return {}
+        metric_id, tsids = resolved
+        per_tsid = self.index_mgr.series_labels(metric_id)
+        if tsids is None:
+            return per_tsid
+        return {t: per_tsid[t] for t in tsids if t in per_tsid}
+
     async def compact(self, time_range=None) -> None:
         """Manual compaction trigger on the data table (the /compact hook).
         `time_range` scopes the pick (and its follow-on picks) to SSTs

@@ -531,6 +531,35 @@ class RegionedEngine:
             out.extend(e.metric_names())
         return sorted(set(out))
 
+    def series_labels_map(
+        self, metric: bytes, tsids: "list[int] | None" = None
+    ) -> dict[int, dict[bytes, bytes]]:
+        """Fan-out union of per-region tsid -> label maps (a split-migrated
+        series registered in parent and daughter resolves to one entry —
+        same labels either way)."""
+        if self._legacy:
+            return self._engine_for(metric).series_labels_map(metric, tsids)
+        out: dict[int, dict[bytes, bytes]] = {}
+        for e in self.engines.values():
+            for t, labs in e.series_labels_map(metric, tsids).items():
+                out.setdefault(t, labs)
+        return out
+
+    async def match_series(
+        self, metric: bytes, filters, matchers
+    ) -> dict[int, dict[bytes, bytes]]:
+        """Fan-out union of per-region match[] resolution (PromQL and the
+        discovery endpoints run unchanged on regioned deployments)."""
+        if self._legacy:
+            return await self._engine_for(metric).match_series(
+                metric, filters, matchers
+            )
+        out: dict[int, dict[bytes, bytes]] = {}
+        for e in self.engines.values():
+            for t, labs in (await e.match_series(metric, filters, matchers)).items():
+                out.setdefault(t, labs)
+        return out
+
     def metadata(self) -> "dict[bytes, str]":
         """Fan-out union of per-region metric-family metadata."""
         out: dict[bytes, str] = {}

@@ -124,12 +124,16 @@ class RangeEvaluator:
 
     # -- series plumbing ----------------------------------------------------
 
-    def _labels_of(self, sel: Selector, keep_name: bool):
-        """tsid -> result labels for one selector's metric."""
-        hit = self._engine.metric_mgr.get(sel.name.encode())
-        if hit is None:
-            return {}
-        by_tsid = self._engine.index_mgr.series_labels(hit[0])
+    # raw-path materialization cap: the native JSON API caps at 1M rows;
+    # PromQL's raw functions get more headroom (rate over long windows) but
+    # never unbounded — a panel query must not OOM the server
+    MAX_RAW_ROWS = 5_000_000
+
+    def _labels_of(self, sel: Selector, tsids, keep_name: bool):
+        """tsid -> result labels, decoded only for the tsids actually in
+        the result (a selective query must not decode a 100k-series
+        metric). Public engine surface — works on RegionedEngine too."""
+        by_tsid = self._engine.series_labels_map(sel.name.encode(), list(tsids))
         out = {}
         for tsid, labs in by_tsid.items():
             d = {k.decode(errors="replace"): v.decode(errors="replace")
@@ -143,9 +147,16 @@ class RangeEvaluator:
         """Raw samples per tsid over [start - pre, end], each sorted by ts:
         {tsid: (ts_array, value_array)}."""
         req = _to_query(sel, self.start - pre_ms, int(self.steps[-1]) + 1)
+        req.limit = self.MAX_RAW_ROWS + 1
         table = await self._engine.query(req)
         if table is None:
             return {}
+        if table.num_rows > self.MAX_RAW_ROWS:
+            raise PromQLError(
+                f"query materializes more than {self.MAX_RAW_ROWS} raw "
+                "samples; narrow the range/selector, or use an *_over_time "
+                "function with window == step (served by pushdown)"
+            )
         tsid = table.column("tsid").to_numpy(zero_copy_only=False).astype(np.uint64)
         ts = table.column("ts").to_numpy(zero_copy_only=False).astype(np.int64)
         val = table.column("value").to_numpy(zero_copy_only=False)
@@ -169,7 +180,7 @@ class RangeEvaluator:
     async def _instant(self, sel: Selector) -> list[SeriesVector]:
         """Instant vector at each step: last sample within the lookback."""
         series = await self._raw_series(sel, LOOKBACK_MS)
-        labels = self._labels_of(sel, keep_name=True)
+        labels = self._labels_of(sel, series.keys(), keep_name=True)
         out = []
         for tsid, (ts, val) in series.items():
             idx = np.searchsorted(ts, self.steps, side="right") - 1
@@ -189,7 +200,7 @@ class RangeEvaluator:
         if node.fn in _GRID_STAT and window == self.step:
             return await self._grid_over_time(node.fn, sel)
         series = await self._raw_series(sel, window)
-        labels = self._labels_of(sel, keep_name=False)
+        labels = self._labels_of(sel, series.keys(), keep_name=False)
         out = []
         for tsid, (ts, val) in series.items():
             vals = self._window_reduce(node.fn, ts, val, window)
@@ -210,10 +221,10 @@ class RangeEvaluator:
         t0 = self.start - self.step
         req = _to_query(sel, t0, int(self.steps[-1]), bucket_ms=self.step)
         res = await self._engine.query(req)
-        labels = self._labels_of(sel, keep_name=False)
         if res is None:
             return []
         tsids, grids = res
+        labels = self._labels_of(sel, [int(t) for t in tsids], keep_name=False)
         stat = _GRID_STAT[fn]
         grid = np.asarray(grids[stat], dtype=np.float64)
         count = np.asarray(grids["count"])
@@ -252,10 +263,18 @@ class RangeEvaluator:
             vals[nz] = val[hi[nz] - 1]
             return vals
         if fn in ("min_over_time", "max_over_time"):
+            # one vectorized reduceat over interleaved (lo, hi) bounds:
+            # even slots hold each window's reduction (odd slots are the
+            # inter-window gaps — discarded). A sentinel pad makes hi ==
+            # len(val) a legal index; empty windows are masked by `nz`.
             red = np.minimum if fn == "min_over_time" else np.maximum
-            for k in range(n):
-                if hi[k] > lo[k]:
-                    vals[k] = red.reduce(val[lo[k] : hi[k]])
+            pad = np.append(val, np.inf if fn == "min_over_time" else -np.inf)
+            idx = np.empty(2 * n, dtype=np.int64)
+            idx[0::2] = lo
+            idx[1::2] = np.maximum(hi, lo)
+            nz = hi > lo
+            out = red.reduceat(pad, idx)[0::2]
+            vals[nz] = out[nz]
             return vals
         if fn in ("rate", "increase", "delta"):
             # counter semantics: increase = last - first + resets. A reset

@@ -471,18 +471,119 @@ async def handle_metrics_list(request: web.Request) -> web.Response:
     return web.json_response({"metrics": [n.decode(errors="replace") for n in names]})
 
 
+async def _match_series(state: ServerState, match_exprs: list[str]) -> list[dict]:
+    """Resolve Prometheus `match[]` selectors to label maps (discovery
+    surface behind /api/v1/series, /labels and /label/:name/values). Goes
+    through the engines' public match_series — regex matchers evaluate off
+    the event loop and regioned deployments fan out."""
+    from horaedb_tpu.promql import PromQLError, Selector, parse
+    from horaedb_tpu.promql.eval import _to_query
+
+    out, seen = [], set()
+    for expr in match_exprs:
+        node = parse(expr)
+        if not isinstance(node, Selector) or node.range_ms is not None:
+            raise PromQLError(f"match[] must be an instant selector: {expr!r}")
+        q = _to_query(node, 0, 1)
+        matched = await state.engine.match_series(q.metric, q.filters, q.matchers)
+        for t, labs in matched.items():
+            if (node.name, t) in seen:
+                continue
+            seen.add((node.name, t))
+            d = {k.decode(errors="replace"): v.decode(errors="replace")
+                 for k, v in labs.items()}
+            d["__name__"] = node.name
+            out.append(d)
+    return out
+
+
 async def handle_series(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
+    if "match[]" in request.query:
+        # Prometheus-shaped series discovery (Grafana variables)
+        from horaedb_tpu.promql import PromQLError
+
+        try:
+            data = await _match_series(state, request.query.getall("match[]"))
+        except (PromQLError, HoraeError) as e:
+            return _promql_error(e)
+        return web.json_response({"status": "success", "data": data})
     metric = request.query.get("metric", "").encode()
     return web.json_response({"series": state.engine.series(metric)})
 
 
+async def _all_label_names(
+    state: ServerState, match_exprs: list[str] | None
+) -> list[str]:
+    names: set[str] = {"__name__"}
+    if match_exprs:
+        for d in await _match_series(state, match_exprs):
+            names.update(d.keys())
+        return sorted(names)
+    for metric in state.engine.metric_names():
+        hit = state.engine.metric_mgr.get(metric)
+        if hit is None:
+            continue
+        for labs in state.engine.index_mgr.series_labels(hit[0]).values():
+            names.update(k.decode(errors="replace") for k in labs)
+    return sorted(names)
+
+
 async def handle_labels(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
-    metric = request.query.get("metric", "").encode()
-    key = request.query.get("key", "").encode()
-    vals = state.engine.label_values(metric, key)
-    return web.json_response({"values": [v.decode(errors="replace") for v in vals]})
+    if "metric" in request.query or "key" in request.query:
+        # native surface: values of one key under one metric
+        metric = request.query.get("metric", "").encode()
+        key = request.query.get("key", "").encode()
+        vals = state.engine.label_values(metric, key)
+        return web.json_response(
+            {"values": [v.decode(errors="replace") for v in vals]}
+        )
+    # Prometheus-shaped label-NAME listing (optional match[] scope)
+    from horaedb_tpu.promql import PromQLError
+
+    try:
+        match = (request.query.getall("match[]")
+                 if "match[]" in request.query else None)
+        data = await _all_label_names(state, match)
+    except (PromQLError, HoraeError) as e:
+        return _promql_error(e)
+    return web.json_response({"status": "success", "data": data})
+
+
+async def handle_label_values(request: web.Request) -> web.Response:
+    """Prometheus /api/v1/label/{name}/values — Grafana's autocomplete
+    surface. `__name__` lists metrics; other labels union their values
+    across metrics (scoped by match[] when given)."""
+    from horaedb_tpu.promql import PromQLError
+
+    state: ServerState = request.app[STATE_KEY]
+    name = request.match_info["name"]
+    try:
+        if "match[]" in request.query:
+            rows = await _match_series(state, request.query.getall("match[]"))
+            vals = sorted({d[name] for d in rows if name in d})
+            return web.json_response({"status": "success", "data": vals})
+        if name == "__name__":
+            vals = sorted(
+                m.decode(errors="replace") for m in state.engine.metric_names()
+            )
+            return web.json_response({"status": "success", "data": vals})
+        out: set[str] = set()
+        for metric in state.engine.metric_names():
+            for v in state.engine.label_values(metric, name.encode()):
+                out.add(v.decode(errors="replace"))
+        return web.json_response({"status": "success", "data": sorted(out)})
+    except (PromQLError, HoraeError) as e:
+        return _promql_error(e)
+
+
+async def handle_buildinfo(request: web.Request) -> web.Response:
+    """Minimal Prometheus buildinfo (datasource health checks probe it)."""
+    return web.json_response({
+        "status": "success",
+        "data": {"version": "2.45.0", "application": "horaedb-tpu"},
+    })
 
 
 async def handle_metadata(request: web.Request) -> web.Response:
@@ -631,9 +732,11 @@ async def build_app(config: Config) -> web.Application:
             web.get("/api/v1/query_range", handle_query_range),
             web.post("/api/v1/query_range", handle_query_range),
             web.get("/api/v1/labels", handle_labels),
+            web.get("/api/v1/label/{name}/values", handle_label_values),
             web.get("/api/v1/metrics", handle_metrics_list),
             web.get("/api/v1/series", handle_series),
             web.get("/api/v1/metadata", handle_metadata),
+            web.get("/api/v1/status/buildinfo", handle_buildinfo),
         ]
     )
 

@@ -416,3 +416,105 @@ class TestReviewRegressions:
                 assert (await r.json())["errorType"] == "bad_data"
         finally:
             await runner.cleanup()
+
+
+class TestDiscoveryEndpoints:
+    @async_test
+    async def test_prometheus_discovery_surfaces(self):
+        """Grafana's Prometheus datasource probes: buildinfo, label names,
+        label values (__name__ = metric autocomplete), series via match[].
+        The native shapes stay answered when their params are present."""
+        import tempfile
+
+        import aiohttp
+        from aiohttp import web as aioweb
+
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import build_app
+
+        cfg = Config.from_dict({"metric_engine": {"storage": {"object_store": {
+            "type": "Local", "data_dir": tempfile.mkdtemp()}}}})
+        app = await build_app(cfg)
+        app = app[0] if isinstance(app, tuple) else app
+        runner = aioweb.AppRunner(app)
+        await runner.setup()
+        site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(f"{base}/api/v1/write", data=scrape_payload(),
+                                 headers={"Content-Type": "application/x-protobuf"})
+                assert r.status in (200, 204)
+                r = await s.get(f"{base}/api/v1/status/buildinfo")
+                assert (await r.json())["status"] == "success"
+                # metric autocomplete
+                r = await s.get(f"{base}/api/v1/label/__name__/values")
+                assert (await r.json())["data"] == ["reqs"]
+                # label values across metrics
+                r = await s.get(f"{base}/api/v1/label/dc/values")
+                assert (await r.json())["data"] == ["east", "west"]
+                # label values scoped by match[]
+                r = await s.get(f"{base}/api/v1/label/host/values",
+                                params={"match[]": 'reqs{dc="east"}'})
+                assert (await r.json())["data"] == ["web-0", "web-2"]
+                # label-NAME listing (Prometheus shape, no params)
+                r = await s.get(f"{base}/api/v1/labels")
+                assert (await r.json())["data"] == ["__name__", "dc", "host"]
+                # series discovery via match[]
+                r = await s.get(f"{base}/api/v1/series",
+                                params={"match[]": 'reqs{host=~"web-[01]"}'})
+                body = await r.json()
+                assert body["status"] == "success"
+                hosts = sorted(d["host"] for d in body["data"])
+                assert hosts == ["web-0", "web-1"]
+                assert all(d["__name__"] == "reqs" for d in body["data"])
+                # bad selector -> Prometheus error shape
+                r = await s.get(f"{base}/api/v1/series",
+                                params={"match[]": "rate(reqs[5m])"})
+                assert r.status == 400
+                # native shapes still answered
+                r = await s.get(f"{base}/api/v1/labels",
+                                params={"metric": "reqs", "key": "dc"})
+                assert (await r.json())["values"] == ["east", "west"]
+                r = await s.get(f"{base}/api/v1/series", params={"metric": "reqs"})
+                assert len((await r.json())["series"]) == 4
+        finally:
+            await runner.cleanup()
+
+
+class TestRegionedPromQL:
+    @async_test
+    async def test_promql_and_discovery_on_regioned_engine(self):
+        """PromQL + discovery must work when the engine is a RegionedEngine
+        (fan-out match_series/series_labels_map): same answers as the
+        unpartitioned engine."""
+        from horaedb_tpu.engine.region import RegionedEngine
+
+        store = MemStore()
+        eng = await RegionedEngine.open(
+            "metrics", store, num_regions=4, enable_compaction=False
+        )
+        n = await eng.write_payload(scrape_payload())
+        assert n == 4 * 40
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        # grid pushdown path across regions
+        out = await ev.eval(parse("sum by (dc) (sum_over_time(reqs[1m]))"))
+        by_dc = {s.labels["dc"]: s.values for s in out}
+        east = sum((h * 1000 + i) for h in (0, 2) for i in range(4))
+        assert by_dc["east"][1] == east
+        # raw path (rate) across regions
+        out = await ev.eval(parse('rate(reqs{host="web-1"}[2m])'))
+        assert len(out) == 1
+        # instant selector with regex matcher (off-loop fan-out resolve)
+        out = await ev.eval(parse('reqs{host=~"web-[02]"}'))
+        assert sorted(s.labels["host"] for s in out) == ["web-0", "web-2"]
+        # discovery surface
+        matched = await eng.match_series(b"reqs", [(b"dc", b"west")], [])
+        hosts = sorted(
+            labs[b"host"].decode() for labs in matched.values()
+        )
+        assert hosts == ["web-1", "web-3"]
+        await eng.close()
