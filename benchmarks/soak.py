@@ -111,7 +111,47 @@ async def run_soak(seconds: int) -> dict:
                     stats.setdefault("first_query_error", repr(e))
                 await asyncio.sleep(0.25)
 
-        await asyncio.gather(*(writer(w) for w in range(4)), querier(), querier())
+        async def promql_querier():
+            """PromQL surface under live ingest: range queries (grid
+            pushdown + aggregation), instant queries, and discovery —
+            Prometheus-shaped success required, errors counted."""
+            exprs = [
+                'sum by (host) (sum_over_time(%m[1m]))',
+                "rate(%m[2m])",
+                "avg_over_time(%m[1m]) * 2",
+                "%m",
+            ]
+            while time.time() < deadline:
+                now_s = time.time()
+                m = metric_name(random.randrange(N_METRICS)).decode()
+                query = random.choice(exprs).replace("%m", m)
+                try:
+                    async with sess.get(
+                        f"http://127.0.0.1:{PORT}/api/v1/query_range",
+                        params={"query": query, "start": str(now_s - 300),
+                                "end": str(now_s), "step": "1m"},
+                    ) as r:
+                        body = await r.json()
+                        ok = r.status == 200 and body.get("status") == "success"
+                        stats["promql_queries" if ok else "promql_errors"] = (
+                            stats.get("promql_queries" if ok else "promql_errors", 0) + 1
+                        )
+                        if not ok:
+                            stats.setdefault("first_promql_error", f"{r.status}: {body}")
+                    async with sess.get(
+                        f"http://127.0.0.1:{PORT}/api/v1/label/__name__/values"
+                    ) as r:
+                        if r.status != 200:
+                            stats["promql_errors"] = stats.get("promql_errors", 0) + 1
+                except Exception as e:  # noqa: BLE001
+                    stats["promql_errors"] = stats.get("promql_errors", 0) + 1
+                    stats.setdefault("first_promql_error", repr(e))
+                await asyncio.sleep(0.4)
+
+        await asyncio.gather(
+            *(writer(w) for w in range(4)), querier(), querier(),
+            promql_querier(),
+        )
         async with sess.get(f"http://127.0.0.1:{PORT}/metrics") as r:
             metrics_text = await r.text()
     for line in metrics_text.splitlines():
@@ -195,6 +235,8 @@ def main() -> None:
         ok = (
             stats["write_errors"] == 0
             and stats["query_errors"] == 0
+            and stats.get("promql_errors", 0) == 0
+            and stats.get("promql_queries", 0) > 0
             and stats.get("samples_ingested") == stats["samples_sent"]
         )
         stats["bench"] = "soak"
