@@ -17,9 +17,11 @@ Supported grammar (see promql/eval.py for semantics and divergences):
                | FUNC "(" expr ")"
                | AGG ("by"|"without") "(" labels ")" "(" expr ")"
                | AGG "(" expr ")" [("by"|"without") "(" labels ")"]
+               | ("topk"|"bottomk") "(" INT "," expr ")"
                | "(" expr ")"
                | selector
     selector  := NAME ["{" matcher ("," matcher)* "}"] ["[" DURATION "]"]
+                 ["offset" DURATION]
     matcher   := NAME ("=" | "!=" | "=~" | "!~") STRING
 
 FUNC: rate increase delta avg_over_time sum_over_time min_over_time
@@ -48,6 +50,7 @@ FUNCS = frozenset({
     "min_over_time", "max_over_time", "count_over_time", "last_over_time",
 })
 AGGS = frozenset({"sum", "avg", "min", "max", "count"})
+TOPK_AGGS = frozenset({"topk", "bottomk"})
 
 _DURATION_UNITS = {
     "ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
@@ -68,6 +71,7 @@ class Selector:
     # (key, op, value) with op in {"=", "!=", "=~", "!~"}
     matchers: tuple = ()
     range_ms: int | None = None  # [5m] -> 300000; None = instant vector
+    offset_ms: int = 0           # `offset 5m` shifts the data window back
 
 
 @dataclass(frozen=True)
@@ -82,6 +86,13 @@ class Agg:
     expr: object
     by: tuple | None = None       # by(...) projection
     without: tuple | None = None  # without(...) exclusion
+
+
+@dataclass(frozen=True)
+class TopK:
+    op: str      # topk | bottomk
+    k: int
+    expr: object
 
 
 @dataclass(frozen=True)
@@ -238,6 +249,16 @@ class _Parser:
                 return Func(name, arg)
             if name in AGGS:
                 return self._aggregate(name)
+            if name in TOPK_AGGS:
+                self.next()
+                self.expect("(")
+                k_tok = self.next()
+                if k_tok.kind != "NUMBER" or float(k_tok.text) != int(float(k_tok.text)):
+                    raise PromQLError(f"{name}() needs an integer k at {k_tok.pos}")
+                self.expect(",")
+                inner = self.expr()
+                self.expect(")")
+                return TopK(name, int(float(k_tok.text)), inner)
             return self._selector()
         raise PromQLError(f"unexpected token {t.text!r} at {t.pos}")
 
@@ -298,15 +319,22 @@ class _Parser:
         range_ms = None
         if self.peek().text == "[":
             self.next()
-            num = self.next()
-            if num.kind != "NUMBER":
-                raise PromQLError(f"expected duration at {num.pos}")
-            unit = self.next()
-            if unit.text not in _DURATION_UNITS:
-                raise PromQLError(f"bad duration unit {unit.text!r}")
-            range_ms = int(float(num.text) * _DURATION_UNITS[unit.text])
+            range_ms = self._duration()
             self.expect("]")
-        return Selector(name, tuple(matchers), range_ms)
+        offset_ms = 0
+        if self.peek().text == "offset":
+            self.next()
+            offset_ms = self._duration()
+        return Selector(name, tuple(matchers), range_ms, offset_ms)
+
+    def _duration(self) -> int:
+        num = self.next()
+        if num.kind != "NUMBER":
+            raise PromQLError(f"expected duration at {num.pos}")
+        unit = self.next()
+        if unit.text not in _DURATION_UNITS:
+            raise PromQLError(f"bad duration unit {unit.text!r}")
+        return int(float(num.text) * _DURATION_UNITS[unit.text])
 
 
 def parse(src: str):

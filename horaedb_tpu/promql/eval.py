@@ -42,6 +42,7 @@ from horaedb_tpu.promql import (
     PromQLError,
     Scalar,
     Selector,
+    TopK,
     _MATCH_OPS,
 )
 
@@ -120,6 +121,8 @@ class RangeEvaluator:
             return await self._func(node)
         if isinstance(node, Agg):
             return await self._agg(node)
+        if isinstance(node, TopK):
+            return await self._topk(node)
         raise PromQLError(f"unsupported node {type(node).__name__}")
 
     # -- series plumbing ----------------------------------------------------
@@ -145,8 +148,12 @@ class RangeEvaluator:
 
     async def _raw_series(self, sel: Selector, pre_ms: int):
         """Raw samples per tsid over [start - pre, end], each sorted by ts:
-        {tsid: (ts_array, value_array)}."""
-        req = _to_query(sel, self.start - pre_ms, int(self.steps[-1]) + 1)
+        {tsid: (ts_array, value_array)}. `offset` shifts the DATA window
+        back and the returned timestamps forward by the same amount, so
+        every downstream window computation stays offset-oblivious."""
+        o = sel.offset_ms
+        req = _to_query(sel, self.start - pre_ms - o,
+                        int(self.steps[-1]) + 1 - o)
         req.limit = self.MAX_RAW_ROWS + 1
         table = await self._engine.query(req)
         if table is None:
@@ -158,7 +165,7 @@ class RangeEvaluator:
                 "function with window == step (served by pushdown)"
             )
         tsid = table.column("tsid").to_numpy(zero_copy_only=False).astype(np.uint64)
-        ts = table.column("ts").to_numpy(zero_copy_only=False).astype(np.int64)
+        ts = table.column("ts").to_numpy(zero_copy_only=False).astype(np.int64) + o
         val = table.column("value").to_numpy(zero_copy_only=False)
         order = np.lexsort((ts, tsid))
         tsid, ts, val = tsid[order], ts[order], val[order]
@@ -218,8 +225,9 @@ class RangeEvaluator:
         pre-range samples — identical alignment to the raw-path
         `_window_reduce` (a step nudge across the ==window boundary must
         not add or drop points)."""
-        t0 = self.start - self.step
-        req = _to_query(sel, t0, int(self.steps[-1]), bucket_ms=self.step)
+        o = sel.offset_ms
+        t0 = self.start - self.step - o
+        req = _to_query(sel, t0, int(self.steps[-1]) - o, bucket_ms=self.step)
         res = await self._engine.query(req)
         if res is None:
             return []
@@ -295,6 +303,37 @@ class RangeEvaluator:
                 vals[nz] = inc if fn == "increase" else inc / (window / 1000.0)
             return vals
         raise PromQLError(f"unsupported function {fn}")
+
+    async def _topk(self, node: TopK) -> list[SeriesVector]:
+        """topk/bottomk with Prometheus RANGE semantics: the winning set is
+        chosen independently at every step, so a series appears only at the
+        steps where it ranks (masked NaN elsewhere)."""
+        inner = await self.eval(node.expr)
+        if isinstance(inner, float):
+            raise PromQLError(f"{node.op}() needs a vector operand")
+        if not inner or node.k <= 0:
+            return []
+        stack = np.stack([sv.values for sv in inner])  # [series, steps]
+        fill = -np.inf if node.op == "topk" else np.inf
+        arr = np.where(np.isnan(stack), fill, stack)
+        # secondary validity key: the NaN fill ties with a REAL -Inf (topk)
+        # / +Inf (bottomk) value, and a plain stable sort could rank the
+        # absent series into the k-set (its mask would then silently drop a
+        # real member). Valid entries must win every tie.
+        isnan = np.isnan(stack)
+        tie = isnan.astype(np.int8) if node.op == "bottomk" else (~isnan).astype(np.int8)
+        order = np.lexsort((tie, arr), axis=0)
+        k = min(node.k, stack.shape[0])
+        keep_idx = order[-k:, :] if node.op == "topk" else order[:k, :]
+        keep = np.zeros(stack.shape, dtype=bool)
+        keep[keep_idx, np.arange(stack.shape[1])[None, :]] = True
+        keep &= ~np.isnan(stack)
+        out = []
+        for i, sv in enumerate(inner):
+            vals = np.where(keep[i], sv.values, np.nan)
+            if not np.isnan(vals).all():
+                out.append(SeriesVector(sv.labels, vals))
+        return out
 
     # -- aggregation / arithmetic --------------------------------------------
 

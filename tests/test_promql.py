@@ -518,3 +518,92 @@ class TestRegionedPromQL:
         )
         assert hosts == ["web-1", "web-3"]
         await eng.close()
+
+
+class TestTopKAndOffset:
+    def test_parse_topk_and_offset(self):
+        from horaedb_tpu.promql import TopK
+
+        node = parse("topk(3, rate(reqs[1m]))")
+        assert isinstance(node, TopK) and node.op == "topk" and node.k == 3
+        sel = parse("reqs offset 5m")
+        assert sel.offset_ms == 300_000 and sel.range_ms is None
+        sel = parse("reqs[1m] offset 2h")
+        assert sel.range_ms == 60_000 and sel.offset_ms == 7_200_000
+        with pytest.raises(PromQLError):
+            parse("topk(1.5, reqs)")
+
+    @async_test
+    async def test_offset_shifts_window(self):
+        eng = await new_engine()
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        plain = await ev.eval(parse('sum_over_time(reqs{host="web-1"}[1m])'))
+        shifted = await ev.eval(
+            parse('sum_over_time(reqs{host="web-1"}[1m] offset 1m)')
+        )
+        pv, sv = plain[0].values, shifted[0].values
+        # offset 1m: step k sees what plain saw at step k-1
+        for k in range(2, len(ev.steps)):
+            assert sv[k] == pv[k - 1], k
+        # instant selector offset: value at t == plain value at t-offset
+        p = await ev.eval(parse('reqs{host="web-1"}'))
+        s = await ev.eval(parse('reqs{host="web-1"} offset 1m'))
+        assert s[0].values[2] == p[0].values[1]
+        await eng.close()
+
+    @async_test
+    async def test_topk_per_step_selection(self):
+        eng = await new_engine()
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        out = await ev.eval(parse("topk(2, sum_over_time(reqs[1m]))"))
+        # host values are h*1000 + i: hosts 3 and 2 always win
+        hosts = sorted(s.labels["host"] for s in out)
+        assert hosts == ["web-2", "web-3"]
+        bot = await ev.eval(parse("bottomk(1, sum_over_time(reqs[1m]))"))
+        assert [s.labels["host"] for s in bot] == ["web-0"]
+        # masked steps are NaN only where a series is outside the k set —
+        # here ranks are static, so winners have values at every data step
+        assert not np.isnan(out[0].values[1:]).any()
+        await eng.close()
+
+    @async_test
+    async def test_topk_k_larger_than_series(self):
+        eng = await new_engine()
+        ev = RangeEvaluator(eng, BASE, BASE + 120_000, 60_000)
+        out = await ev.eval(parse("topk(99, sum_over_time(reqs[1m]))"))
+        assert len(out) == 4
+        await eng.close()
+
+    def test_topk_real_inf_beats_absent_series(self):
+        """A real -Inf value must stay in the topk set when an absent (NaN)
+        series ties with the fill sentinel (and symmetrically for bottomk
+        with +Inf)."""
+        import asyncio
+
+        from horaedb_tpu.promql import TopK
+        from horaedb_tpu.promql.eval import SeriesVector
+
+        ev = RangeEvaluator.__new__(RangeEvaluator)
+        inner = [
+            SeriesVector({"s": "a"}, np.array([1.0])),
+            SeriesVector({"s": "b"}, np.array([-np.inf])),
+            SeriesVector({"s": "c"}, np.array([np.nan])),
+        ]
+
+        async def run(op, k):
+            async def fake_eval(_):
+                return inner
+            ev.eval = fake_eval
+            return await ev._topk(TopK(op, k, None))
+
+        out = asyncio.run(run("topk", 2))
+        assert sorted(s.labels["s"] for s in out) == ["a", "b"]
+        inner = [
+            SeriesVector({"s": "a"}, np.array([1.0])),
+            SeriesVector({"s": "b"}, np.array([np.inf])),
+            SeriesVector({"s": "c"}, np.array([np.nan])),
+        ]
+        out = asyncio.run(run("bottomk", 2))
+        assert sorted(s.labels["s"] for s in out) == ["a", "b"]
