@@ -607,3 +607,44 @@ class TestTopKAndOffset:
         ]
         out = asyncio.run(run("bottomk", 2))
         assert sorted(s.labels["s"] for s in out) == ["a", "b"]
+
+
+class TestParserFuzz:
+    def test_random_inputs_never_crash(self):
+        """Any input must either parse or raise PromQLError — never an
+        unhandled exception (the server maps PromQLError to 400)."""
+        import random as _random
+
+        rng = _random.Random(42)
+        alphabet = 'abz_09(){}[],=~!"\' .*+-/\\m5s'
+        for _ in range(3000):
+            s = "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 24)))
+            try:
+                parse(s)
+            except PromQLError:
+                pass
+
+    def test_mutated_valid_queries_never_crash(self):
+        import random as _random
+
+        rng = _random.Random(7)
+        seeds = [
+            'sum by (host) (rate(reqs{a="b",c=~"d.*"}[5m])) * 2',
+            "topk(3, avg_over_time(m[1m] offset 2h)) - 1",
+            'count without (dc) (max_over_time(x{y!="z"}[30s]))',
+        ]
+        for _ in range(3000):
+            s = list(rng.choice(seeds))
+            for _m in range(rng.randrange(1, 4)):
+                i = rng.randrange(len(s))
+                op = rng.random()
+                if op < 0.4:
+                    del s[i]
+                elif op < 0.8:
+                    s[i] = rng.choice('abz_09(){}[],=~!"\' .*5sm')
+                else:
+                    s.insert(i, rng.choice('(){}[]"'))
+            try:
+                parse("".join(s))
+            except PromQLError:
+                pass
