@@ -1,0 +1,119 @@
+"""PromQL evaluation throughput: grid-pushdown lane vs raw lane.
+
+Quantifies the claim that aligned `*_over_time` windows ride the device
+aggregate pushdown (raw rows never reach the host), against the raw-scan
+lane the counter functions use. One JSON line per case.
+
+Usage: python benchmarks/promql_bench.py [n_rows] [n_series]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    import jax
+
+    want = os.environ.get("HORAEDB_JAX_PLATFORM") or os.environ.get("JAX_PLATFORMS")
+    if want and "," not in want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:  # noqa: BLE001
+            pass
+
+    import numpy as np
+
+    from horaedb_tpu.engine import MetricEngine
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.promql import parse
+    from horaedb_tpu.promql.eval import RangeEvaluator, to_prometheus_matrix
+
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    n_series = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    per_series = n_rows // n_series
+    n_rows = per_series * n_series  # keep every per-sample lane the same length
+    BASE = 1_700_000_000_000
+    STEP = 60_000
+    span = per_series * 1_000  # 1s scrape interval
+    rng = np.random.default_rng(0)
+
+    async def run() -> None:
+        store = LocalStore(tempfile.mkdtemp(prefix="promql_"))
+        eng = await MetricEngine.open(
+            "db", store, enable_compaction=False,
+            ingest_buffer_rows=512 * 1024,
+            segment_duration_ms=24 * 3_600_000,
+        )
+        # register the series through the REAL ingest path (one sample
+        # each), then bulk-load samples via the sample manager with the
+        # engine-resolved ids (the ingest path itself is benched in
+        # ingest_bench.py; here the query side is under test)
+        from horaedb_tpu.pb import remote_write_pb2
+
+        reg = remote_write_pb2.WriteRequest()
+        for s in range(n_series):
+            t = reg.timeseries.add()
+            for k, v in ((b"__name__", b"m"),
+                         (b"host", f"web-{s:04d}".encode())):
+                lab = t.labels.add()
+                lab.name = k
+                lab.value = v
+            smp = t.samples.add()
+            smp.timestamp = BASE
+            smp.value = 0.0
+        await eng.write_payload(reg.SerializeToString())
+        await eng.flush()
+        matched = await eng.match_series(b"m", [], [])
+        hit = eng.metric_mgr.get(b"m")
+        assert hit is not None and len(matched) == n_series
+        metric_id = hit[0]
+        by_host = {labs[b"host"]: t for t, labs in matched.items()}
+        tsids = [by_host[f"web-{s:04d}".encode()] for s in range(n_series)]
+        mids = np.repeat(np.uint64(metric_id), n_rows)
+        ts_arr = np.tile(BASE + np.arange(per_series, dtype=np.int64) * 1_000,
+                         n_series)
+        tsid_arr = np.repeat(np.array(tsids, dtype=np.uint64), per_series)
+        vals = rng.normal(size=n_rows)
+        await eng.sample_mgr.persist(mids, tsid_arr, ts_arr, vals)
+        await eng.flush()
+
+        end = BASE + span - 1
+        cases = [
+            ("grid_pushdown", "sum by (host) (sum_over_time(m[1m]))"),
+            ("grid_avg", "avg_over_time(m[1m])"),
+            ("raw_rate", "rate(m[2m])"),
+            ("instant_selector", "m"),
+        ]
+        for name, q in cases:
+            ev = RangeEvaluator(eng, BASE, end, STEP, max_series=50_000)
+            expr = parse(q)
+            out = await ev.eval(expr)  # warm compiles/caches
+            t0 = time.perf_counter()
+            ev = RangeEvaluator(eng, BASE, end, STEP, max_series=50_000)
+            out = await ev.eval(expr)
+            el = time.perf_counter() - t0
+            data = to_prometheus_matrix(out, ev.steps)
+            print(json.dumps({
+                "bench": "promql", "case": name, "query": q,
+                "rows": n_rows, "series": n_series,
+                "steps": len(ev.steps),
+                "seconds": round(el, 4),
+                "rows_per_sec": round(n_rows / el),
+                "result_series": len(data["result"]),
+                "platform": jax.devices()[0].platform,
+            }))
+        await eng.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

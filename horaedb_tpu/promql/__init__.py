@@ -24,9 +24,13 @@ Supported grammar (see promql/eval.py for semantics and divergences):
                  ["offset" DURATION]
     matcher   := NAME ("=" | "!=" | "=~" | "!~") STRING
 
-FUNC: rate increase delta avg_over_time sum_over_time min_over_time
-      max_over_time count_over_time last_over_time
-AGG:  sum avg min max count
+FUNC:   rate increase delta avg_over_time sum_over_time min_over_time
+        max_over_time count_over_time last_over_time
+MATHFN: abs ceil floor round sqrt ln log2 log10 exp   — MATHFN "(" expr ")"
+        clamp_min clamp_max "(" expr "," ["-"] NUMBER ")"
+AGG:    sum avg min max count
+A NAME from any function set followed by anything but "(" parses as a
+metric selector (a metric named `rate` stays queryable).
 DURATION: integer + unit in {ms, s, m, h, d, w}
 
 Binary arithmetic requires at least one scalar operand (vector-vector
@@ -51,6 +55,10 @@ FUNCS = frozenset({
 })
 AGGS = frozenset({"sum", "avg", "min", "max", "count"})
 TOPK_AGGS = frozenset({"topk", "bottomk"})
+# elementwise math over a vector (or scalar); clamp_* take (expr, scalar)
+MATH_FUNCS = frozenset({"abs", "ceil", "floor", "round", "sqrt", "ln", "log2",
+                        "log10", "exp"})
+CLAMP_FUNCS = frozenset({"clamp_min", "clamp_max"})
 
 _DURATION_UNITS = {
     "ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
@@ -93,6 +101,13 @@ class TopK:
     op: str      # topk | bottomk
     k: int
     expr: object
+
+
+@dataclass(frozen=True)
+class MathFn:
+    fn: str           # abs/ceil/floor/round/sqrt/ln/log2/log10/exp/clamp_*
+    expr: object
+    arg: float | None = None  # clamp bound
 
 
 @dataclass(frozen=True)
@@ -223,6 +238,12 @@ class _Parser:
             return BinOp("-", Scalar(0.0), self.primary())
         return self.primary()
 
+    def _called(self) -> bool:
+        """True when the NAME at the cursor is followed by '(' — the
+        function-vs-metric disambiguation Prometheus itself uses (a metric
+        literally named `rate` or `abs` stays queryable)."""
+        return self.toks[self.i + 1].text == "("
+
     def primary(self):
         t = self.peek()
         if t.kind == "NUMBER":
@@ -235,7 +256,7 @@ class _Parser:
             return node
         if t.kind == "NAME":
             name = t.text
-            if name in FUNCS:
+            if name in FUNCS and self._called():
                 self.next()
                 self.expect("(")
                 arg = self.expr()
@@ -247,9 +268,11 @@ class _Parser:
                         f"{name}() needs a range selector, e.g. m[5m]"
                     )
                 return Func(name, arg)
-            if name in AGGS:
+            if name in AGGS and (
+                self._called() or self.toks[self.i + 1].text in ("by", "without")
+            ):
                 return self._aggregate(name)
-            if name in TOPK_AGGS:
+            if name in TOPK_AGGS and self._called():
                 self.next()
                 self.expect("(")
                 k_tok = self.next()
@@ -259,6 +282,27 @@ class _Parser:
                 inner = self.expr()
                 self.expect(")")
                 return TopK(name, int(float(k_tok.text)), inner)
+            if name in MATH_FUNCS and self._called():
+                self.next()
+                self.expect("(")
+                inner = self.expr()
+                self.expect(")")
+                return MathFn(name, inner)
+            if name in CLAMP_FUNCS and self._called():
+                self.next()
+                self.expect("(")
+                inner = self.expr()
+                self.expect(",")
+                bound = self.next()
+                neg = False
+                if bound.text == "-":
+                    neg = True
+                    bound = self.next()
+                if bound.kind != "NUMBER":
+                    raise PromQLError(f"{name}() needs a numeric bound at {bound.pos}")
+                self.expect(")")
+                b = float(bound.text) * (-1.0 if neg else 1.0)
+                return MathFn(name, inner, b)
             return self._selector()
         raise PromQLError(f"unexpected token {t.text!r} at {t.pos}")
 

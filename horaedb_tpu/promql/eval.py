@@ -39,12 +39,22 @@ from horaedb_tpu.promql import (
     Agg,
     BinOp,
     Func,
+    MathFn,
     PromQLError,
     Scalar,
     Selector,
     TopK,
     _MATCH_OPS,
 )
+
+_MATH = {
+    "abs": np.abs, "ceil": np.ceil, "floor": np.floor,
+    # Prometheus round() resolves .5 ties UP (floor(v+0.5)); np.round's
+    # banker's rounding would diverge on every half-integer
+    "round": lambda v: np.floor(v + 0.5),
+    "sqrt": np.sqrt, "ln": np.log, "log2": np.log2,
+    "log10": np.log10, "exp": np.exp,
+}
 
 LOOKBACK_MS = 300_000  # Prometheus default instant-vector staleness window
 
@@ -123,7 +133,31 @@ class RangeEvaluator:
             return await self._agg(node)
         if isinstance(node, TopK):
             return await self._topk(node)
+        if isinstance(node, MathFn):
+            return await self._math(node)
         raise PromQLError(f"unsupported node {type(node).__name__}")
+
+    async def _math(self, node: MathFn):
+        inner = await self.eval(node.expr)
+
+        def apply(v):
+            with np.errstate(all="ignore"):
+                if node.fn == "clamp_min":
+                    return np.maximum(v, node.arg)
+                if node.fn == "clamp_max":
+                    return np.minimum(v, node.arg)
+                return _MATH[node.fn](v)
+
+        if isinstance(inner, float):
+            return float(apply(np.float64(inner)))
+        # function application drops __name__ (Prometheus semantics)
+        return [
+            SeriesVector(
+                {k: v for k, v in sv.labels.items() if k != "__name__"},
+                apply(sv.values),
+            )
+            for sv in inner
+        ]
 
     # -- series plumbing ----------------------------------------------------
 

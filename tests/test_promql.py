@@ -648,3 +648,55 @@ class TestParserFuzz:
                 parse("".join(s))
             except PromQLError:
                 pass
+
+
+class TestMathFunctions:
+    def test_parse(self):
+        from horaedb_tpu.promql import MathFn
+
+        node = parse("abs(reqs - 2000)")
+        assert isinstance(node, MathFn) and node.fn == "abs"
+        node = parse("clamp_min(reqs, -1.5)")
+        assert node.fn == "clamp_min" and node.arg == -1.5
+        node = parse("clamp_max(rate(reqs[1m]), 10)")
+        assert node.arg == 10.0
+        with pytest.raises(PromQLError):
+            parse('clamp_min(reqs, "x")')
+
+    @async_test
+    async def test_math_against_oracle(self):
+        eng = await new_engine()
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        base_out = await ev.eval(parse('sum_over_time(reqs{host="web-1"}[1m])'))
+        base_vals = base_out[0].values
+        for q, f in [
+            ('abs(sum_over_time(reqs{host="web-1"}[1m]) - 5000)',
+             lambda v: np.abs(v - 5000)),
+            ('sqrt(sum_over_time(reqs{host="web-1"}[1m]))', np.sqrt),
+            ('clamp_max(sum_over_time(reqs{host="web-1"}[1m]), 4030)',
+             lambda v: np.minimum(v, 4030)),
+            ('clamp_min(sum_over_time(reqs{host="web-1"}[1m]), 4100)',
+             lambda v: np.maximum(v, 4100)),
+        ]:
+            out = await ev.eval(parse(q))
+            np.testing.assert_allclose(out[0].values, f(base_vals), rtol=1e-12)
+            assert "__name__" not in out[0].labels
+        # scalar form
+        assert await ev.eval(parse("abs(0 - 3)")) == 3.0
+        await eng.close()
+
+    def test_function_names_stay_queryable_as_metrics(self):
+        for name in ("rate", "abs", "sum", "topk", "clamp_min", "exp"):
+            node = parse(name)
+            assert isinstance(node, Selector) and node.name == name, name
+        node = parse('abs{host="a"}')
+        assert isinstance(node, Selector)
+
+    def test_round_half_up(self):
+        from horaedb_tpu.promql.eval import _MATH
+
+        import numpy as _np
+        assert _MATH["round"](_np.float64(0.5)) == 1.0
+        assert _MATH["round"](_np.float64(2.5)) == 3.0
+        assert _MATH["round"](_np.float64(-0.5)) == 0.0  # floor(-0.5+0.5)
