@@ -28,6 +28,7 @@ FUNC:   rate increase delta avg_over_time sum_over_time min_over_time
         max_over_time count_over_time last_over_time
 MATHFN: abs ceil floor round sqrt ln log2 log10 exp   — MATHFN "(" expr ")"
         clamp_min clamp_max "(" expr "," ["-"] NUMBER ")"
+        histogram_quantile "(" NUMBER "," expr ")"  — expr yields `le` buckets
 AGG:    sum avg min max count
 A NAME from any function set followed by anything but "(" parses as a
 metric selector (a metric named `rate` stays queryable).
@@ -108,6 +109,12 @@ class MathFn:
     fn: str           # abs/ceil/floor/round/sqrt/ln/log2/log10/exp/clamp_*
     expr: object
     arg: float | None = None  # clamp bound
+
+
+@dataclass(frozen=True)
+class HistogramQuantile:
+    q: float
+    expr: object  # must evaluate to a vector of `le`-labelled buckets
 
 
 @dataclass(frozen=True)
@@ -282,6 +289,23 @@ class _Parser:
                 inner = self.expr()
                 self.expect(")")
                 return TopK(name, int(float(k_tok.text)), inner)
+            if name == "histogram_quantile" and self._called():
+                self.next()
+                self.expect("(")
+                neg = self.peek().text == "-"
+                if neg:
+                    self.next()
+                q_tok = self.next()
+                if q_tok.kind != "NUMBER":
+                    raise PromQLError(
+                        f"histogram_quantile needs a numeric q at {q_tok.pos}"
+                    )
+                self.expect(",")
+                inner = self.expr()
+                self.expect(")")
+                return HistogramQuantile(
+                    float(q_tok.text) * (-1.0 if neg else 1.0), inner
+                )
             if name in MATH_FUNCS and self._called():
                 self.next()
                 self.expect("(")

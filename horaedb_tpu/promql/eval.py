@@ -26,6 +26,12 @@ rather than silently approximated):
 3. Instant vector lookback is 5 minutes (Prometheus default), applied at
    each step of a range query.
 4. Vector-vector binary arithmetic (label matching) is not in the subset.
+5. histogram_quantile: a step whose +Inf bucket is absent yields NO value
+   (as in Prometheus), but an absent FINITE bucket is treated as empty at
+   the previous cumulative count instead of being dropped from the vector
+   — the winning bucket matches Prometheus, while the interpolation lower
+   bound may be the absent bucket's le rather than the next-lower present
+   one.
 """
 
 from __future__ import annotations
@@ -39,6 +45,7 @@ from horaedb_tpu.promql import (
     Agg,
     BinOp,
     Func,
+    HistogramQuantile,
     MathFn,
     PromQLError,
     Scalar,
@@ -135,7 +142,90 @@ class RangeEvaluator:
             return await self._topk(node)
         if isinstance(node, MathFn):
             return await self._math(node)
+        if isinstance(node, HistogramQuantile):
+            return await self._histogram_quantile(node)
         raise PromQLError(f"unsupported node {type(node).__name__}")
+
+    async def _histogram_quantile(self, node: HistogramQuantile):
+        """Prometheus histogram_quantile over classic `le` buckets: group
+        the inner vector by labels-minus-le, enforce monotone cumulative
+        counts, and linearly interpolate within the winning bucket
+        (promql/quantile.go semantics; the +Inf bucket carries the total).
+        Vectorized over steps per group."""
+        inner = await self.eval(node.expr)
+        if isinstance(inner, float):
+            raise PromQLError("histogram_quantile needs a vector of buckets")
+        q = node.q
+        groups: dict[tuple, list[tuple[float, np.ndarray]]] = {}
+        glabels: dict[tuple, dict] = {}
+        for sv in inner:
+            le_s = sv.labels.get("le")
+            if le_s is None:
+                continue  # Prometheus ignores bucket-less series
+            try:
+                le = float("inf") if le_s in ("+Inf", "Inf", "inf") else float(le_s)
+            except ValueError:
+                continue
+            rest = {k: v for k, v in sv.labels.items()
+                    if k not in ("le", "__name__")}
+            key = tuple(sorted(rest.items()))
+            groups.setdefault(key, []).append((le, sv.values))
+            glabels[key] = rest
+        out = []
+        for key, buckets in sorted(groups.items()):
+            buckets.sort(key=lambda b: b[0])
+            les = np.array([b[0] for b in buckets])
+            if not np.isinf(les[-1]) or len(buckets) < 2:
+                continue  # no +Inf bucket -> undefined (Prometheus: NaN/skip)
+            raw = np.stack([b[1] for b in buckets])  # [buckets, steps]
+            # a step where the +Inf series is absent has NO total — emitting
+            # one from the finite buckets would fabricate a quantile
+            inf_absent = np.isnan(raw[-1])
+            # absent FINITE buckets impute to the previous bucket's
+            # cumulative count via the max-accumulate repair: they can then
+            # never win the bucket search, though the interpolation lower
+            # bound remains the absent bucket's le (documented divergence —
+            # Prometheus drops the bucket from the instant vector entirely)
+            cum = np.where(np.isnan(raw), 0.0, raw)
+            cum = np.maximum.accumulate(cum, axis=0)  # also repairs jitter
+            total = cum[-1]
+            n_steps = cum.shape[1]
+            vals = np.full(n_steps, np.nan)
+            ok = (total > 0) & ~inf_absent
+            if q < 0:
+                vals[ok] = -np.inf
+            elif q > 1:
+                vals[ok] = np.inf
+            else:
+                rank = q * total  # target cumulative count per step
+                # first bucket with cum >= rank (argmax of a bool stack)
+                ge = cum >= rank[None, :]
+                b_idx = np.argmax(ge, axis=0)
+                lo_bound = np.where(b_idx > 0, les[np.maximum(b_idx - 1, 0)], 0.0)
+                hi_bound = les[b_idx]
+                cum_lo = np.where(
+                    b_idx > 0,
+                    cum[np.maximum(b_idx - 1, 0), np.arange(n_steps)],
+                    0.0,
+                )
+                cum_hi = cum[b_idx, np.arange(n_steps)]
+                # +Inf winning bucket: Prometheus returns its lower bound
+                inf_win = np.isinf(hi_bound)
+                with np.errstate(all="ignore"):
+                    frac = np.where(
+                        cum_hi > cum_lo, (rank - cum_lo) / (cum_hi - cum_lo), 1.0
+                    )
+                    interp = lo_bound + (hi_bound - lo_bound) * frac
+                res = np.where(inf_win, lo_bound, interp)
+                # quantile.go: a winning FIRST bucket with upperBound <= 0
+                # returns the upper bound itself (interpolating from the
+                # hardcoded 0 lower bound would exceed the data's range)
+                if les[0] <= 0:
+                    res = np.where(b_idx == 0, les[0], res)
+                vals[ok] = res[ok]
+            if not np.isnan(vals).all():
+                out.append(SeriesVector(glabels[key], vals))
+        return out
 
     async def _math(self, node: MathFn):
         inner = await self.eval(node.expr)

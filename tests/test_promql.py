@@ -700,3 +700,139 @@ class TestMathFunctions:
         assert _MATH["round"](_np.float64(0.5)) == 1.0
         assert _MATH["round"](_np.float64(2.5)) == 3.0
         assert _MATH["round"](_np.float64(-0.5)) == 0.0  # floor(-0.5+0.5)
+
+
+class TestHistogramQuantile:
+    def _eval_sync(self, inner_series):
+        import asyncio
+
+        from horaedb_tpu.promql import HistogramQuantile
+
+        ev = RangeEvaluator.__new__(RangeEvaluator)
+
+        async def run(q):
+            async def fake_eval(_):
+                return inner_series
+            ev.eval = fake_eval
+            return await ev._histogram_quantile(HistogramQuantile(q, None))
+
+        return lambda q: asyncio.run(run(q))
+
+    def _buckets(self, counts_by_le, labels=None):
+        from horaedb_tpu.promql.eval import SeriesVector
+
+        labels = labels or {}
+        return [
+            SeriesVector({**labels, "le": le}, np.asarray(vals, dtype=float))
+            for le, vals in counts_by_le.items()
+        ]
+
+    def test_parse(self):
+        from horaedb_tpu.promql import HistogramQuantile
+
+        node = parse("histogram_quantile(0.9, rate(m_bucket[5m]))")
+        assert isinstance(node, HistogramQuantile) and node.q == 0.9
+        # still a valid metric name without parens
+        assert isinstance(parse("histogram_quantile"), Selector)
+
+    def test_interpolation_matches_prometheus_formula(self):
+        # buckets le=1: 10, le=2: 30, le=+Inf: 40  (one step)
+        ev = self._eval_sync(self._buckets(
+            {"1": [10.0], "2": [30.0], "+Inf": [40.0]}
+        ))
+        out = ev(0.5)
+        # rank = 0.5*40 = 20 -> bucket (1,2]: 1 + (20-10)/(30-10)*(2-1) = 1.5
+        assert out[0].values[0] == pytest.approx(1.5)
+        # q small enough to land in the first bucket: lower bound 0
+        out = ev(0.1)  # rank 4 -> bucket (0,1]: 0 + 4/10 = 0.4
+        assert out[0].values[0] == pytest.approx(0.4)
+        # q in the +Inf bucket -> its lower bound (the largest finite le)
+        out = ev(0.99)  # rank 39.6 > 30 -> +Inf bucket -> 2.0
+        assert out[0].values[0] == pytest.approx(2.0)
+
+    def test_out_of_range_q_and_empty(self):
+        ev = self._eval_sync(self._buckets(
+            {"1": [5.0], "+Inf": [5.0]}
+        ))
+        assert ev(-0.5)[0].values[0] == -np.inf
+        assert ev(1.5)[0].values[0] == np.inf
+        # zero observations -> no output series
+        ev0 = self._eval_sync(self._buckets({"1": [0.0], "+Inf": [0.0]}))
+        assert ev0(0.5) == []
+
+    def test_no_inf_bucket_skipped(self):
+        ev = self._eval_sync(self._buckets({"1": [5.0], "2": [9.0]}))
+        assert ev(0.5) == []
+
+    def test_groups_by_remaining_labels(self):
+        from horaedb_tpu.promql.eval import SeriesVector
+
+        series = (
+            self._buckets({"1": [4.0], "+Inf": [4.0]}, {"host": "a"})
+            + self._buckets({"1": [0.0], "2": [8.0], "+Inf": [8.0]}, {"host": "b"})
+            + [SeriesVector({"host": "c"}, np.array([1.0]))]  # no le: ignored
+        )
+        ev = self._eval_sync(series)
+        out = ev(0.5)
+        by_host = {s.labels["host"]: s.values[0] for s in out}
+        assert set(by_host) == {"a", "b"}
+        assert by_host["a"] == pytest.approx(0.5)   # rank 2 in (0,1]
+        assert by_host["b"] == pytest.approx(1.5)   # rank 4 in (1,2]
+
+    def test_counter_jitter_repaired(self):
+        # a small dip in cumulative counts must not produce negatives
+        ev = self._eval_sync(self._buckets(
+            {"1": [10.0], "2": [9.0], "+Inf": [12.0]}
+        ))
+        out = ev(0.5)
+        assert np.isfinite(out[0].values[0])
+
+    @async_test
+    async def test_end_to_end_over_engine(self):
+        """le-labelled bucket series through the real engine + rate()."""
+        req = remote_write_pb2.WriteRequest()
+        for le, rate_per_s in (("0.1", 5.0), ("0.5", 8.0), ("+Inf", 10.0)):
+            t = req.timeseries.add()
+            for k, v in ((b"__name__", b"lat_bucket"), (b"le", le.encode())):
+                lab = t.labels.add()
+                lab.name = k
+                lab.value = v
+            for i in range(40):
+                smp = t.samples.add()
+                smp.timestamp = BASE + i * 15_000
+                smp.value = rate_per_s * i * 15.0  # cumulative counter
+        store = MemStore()
+        eng = await MetricEngine.open("db", store, enable_compaction=False)
+        await eng.write_payload(req.SerializeToString())
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        out = await ev.eval(parse(
+            "histogram_quantile(0.5, rate(lat_bucket[2m]))"
+        ))
+        assert len(out) == 1
+        v = out[0].values
+        # steady rates: rank=5/s*0.5... cum rates per bucket: 5, 8, 10
+        # rank = 0.5*10 = 5 -> first bucket (0, 0.1]: 0 + 5/5*0.1 = 0.1
+        finite = v[np.isfinite(v)]
+        assert len(finite) > 0
+        np.testing.assert_allclose(finite, 0.1, rtol=1e-6)
+        await eng.close()
+
+    def test_negative_first_bucket_bound(self):
+        # all 5 observations <= -0.5: q=0.25 must return -0.5, not a value
+        # interpolated up from the hardcoded 0 lower bound
+        ev = self._eval_sync(self._buckets(
+            {"-0.5": [5.0], "+Inf": [10.0]}
+        ))
+        assert ev(0.25)[0].values[0] == pytest.approx(-0.5)
+        # positive first bucket keeps the 0-lower-bound interpolation
+        ev2 = self._eval_sync(self._buckets({"1": [10.0], "+Inf": [10.0]}))
+        assert ev2(0.5)[0].values[0] == pytest.approx(0.5)
+
+    def test_absent_inf_bucket_step_yields_no_value(self):
+        ev = self._eval_sync(self._buckets(
+            {"1": [5.0, 5.0], "+Inf": [10.0, np.nan]}
+        ))
+        out = ev(0.5)
+        assert np.isfinite(out[0].values[0])
+        assert np.isnan(out[0].values[1])
