@@ -586,6 +586,57 @@ async def handle_buildinfo(request: web.Request) -> web.Response:
     })
 
 
+async def handle_query_exemplars(request: web.Request) -> web.Response:
+    """Prometheus /api/v1/query_exemplars (Grafana's trace-integration
+    surface): instant-selector `query` + start/end seconds -> exemplars
+    grouped per series with their trace labels."""
+    from horaedb_tpu.engine.types import decode_series_key
+    from horaedb_tpu.promql import PromQLError, Selector, parse
+    from horaedb_tpu.promql.eval import _to_query
+
+    state: ServerState = request.app[STATE_KEY]
+    try:
+        p = await _promql_params(request)
+        node = parse(p["query"])
+        if not isinstance(node, Selector) or node.range_ms is not None:
+            raise PromQLError("query must be an instant vector selector")
+        start_ms = int(float(p["start"]) * 1000)
+        end_ms = int(float(p["end"]) * 1000)
+        req = _to_query(node, start_ms, end_ms + 1)
+        req.limit = 10_000
+        table = await state.engine.query_exemplars(req)
+    except (PromQLError, HoraeError, KeyError, ValueError) as e:
+        return _promql_error(e)
+    METRICS.inc("horaedb_queries_total")
+    if table is None or table.num_rows == 0:
+        return web.json_response({"status": "success", "data": []})
+    matched = await state.engine.match_series(req.metric, req.filters, req.matchers)
+    by_tsid: dict[int, list] = {}
+    tsids = table.column("tsid").to_pylist()
+    tss = table.column("ts").to_pylist()
+    vals = table.column("value").to_pylist()
+    blobs = table.column("labels").to_pylist()
+    for t, ts, v, blob in zip(tsids, tss, vals, blobs):
+        by_tsid.setdefault(int(t), []).append({
+            "labels": {
+                k.decode(errors="replace"): val.decode(errors="replace")
+                for k, val in decode_series_key(blob or b"")
+            },
+            "value": str(v),
+            "timestamp": ts / 1000.0,
+        })
+    data = []
+    for t, exemplars in sorted(by_tsid.items()):
+        labs = matched.get(t, {})
+        series_labels = {
+            k.decode(errors="replace"): v.decode(errors="replace")
+            for k, v in labs.items()
+        }
+        series_labels["__name__"] = node.name
+        data.append({"seriesLabels": series_labels, "exemplars": exemplars})
+    return web.json_response({"status": "success", "data": data})
+
+
 async def handle_metadata(request: web.Request) -> web.Response:
     """Prometheus-shaped /api/v1/metadata: metric family -> [{"type": t}],
     from remote-write METADATA records (advisory, in-memory)."""
@@ -731,6 +782,8 @@ async def build_app(config: Config) -> web.Application:
             web.get("/api/v1/query", handle_query),
             web.get("/api/v1/query_range", handle_query_range),
             web.post("/api/v1/query_range", handle_query_range),
+            web.get("/api/v1/query_exemplars", handle_query_exemplars),
+            web.post("/api/v1/query_exemplars", handle_query_exemplars),
             web.get("/api/v1/labels", handle_labels),
             web.get("/api/v1/label/{name}/values", handle_label_values),
             web.get("/api/v1/metrics", handle_metrics_list),

@@ -836,3 +836,129 @@ class TestHistogramQuantile:
         out = ev(0.5)
         assert np.isfinite(out[0].values[0])
         assert np.isnan(out[0].values[1])
+
+
+class TestGridRawDifferential:
+    """The grid (device pushdown) and raw (host window-reduce) lanes are
+    independent implementations of the same right-aligned window semantics.
+    Randomized datasets with gaps must evaluate identically through both —
+    any divergence is a real bug in one of them."""
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    @async_test
+    async def test_over_time_grid_equals_raw(self, seed, monkeypatch):
+        import horaedb_tpu.promql.eval as ev_mod
+
+        rng = np.random.default_rng(seed)
+        n_series = int(rng.integers(2, 6))
+        req = remote_write_pb2.WriteRequest()
+        for s in range(n_series):
+            t = req.timeseries.add()
+            for k, v in ((b"__name__", b"g"), (b"host", f"h{s}".encode())):
+                lab = t.labels.add()
+                lab.name = k
+                lab.value = v
+            # irregular timestamps with gaps: some series miss whole windows
+            n_pts = int(rng.integers(5, 60))
+            ts_offsets = np.sort(rng.choice(
+                np.arange(0, 600_000, 5_000), size=n_pts, replace=False
+            ))
+            for off in ts_offsets:
+                smp = t.samples.add()
+                smp.timestamp = BASE + int(off)
+                smp.value = float(rng.normal())
+        store = MemStore()
+        eng = await MetricEngine.open("db", store, enable_compaction=False)
+        await eng.write_payload(req.SerializeToString())
+
+        step = 60_000
+        end = BASE + 600_000
+        for fn in ("sum_over_time", "count_over_time", "avg_over_time",
+                   "min_over_time", "max_over_time"):
+            q = parse(f"{fn}(g[1m])")
+            ev1 = RangeEvaluator(eng, BASE, end, step)
+            grid_out = {tuple(sorted(s.labels.items())): s.values
+                        for s in await ev1.eval(q)}
+            # force the raw lane: empty the grid dispatch table
+            monkeypatch.setattr(ev_mod, "_GRID_STAT", {})
+            ev2 = RangeEvaluator(eng, BASE, end, step)
+            raw_out = {tuple(sorted(s.labels.items())): s.values
+                       for s in await ev2.eval(q)}
+            monkeypatch.undo()
+            assert set(grid_out) == set(raw_out), fn
+            for key in grid_out:
+                np.testing.assert_allclose(
+                    grid_out[key], raw_out[key], rtol=1e-9, atol=1e-12,
+                    equal_nan=True, err_msg=f"{fn} {key} seed={seed}",
+                )
+        await eng.close()
+
+
+class TestQueryExemplarsEndpoint:
+    @async_test
+    async def test_prometheus_exemplars_shape(self):
+        import tempfile
+
+        import aiohttp
+        from aiohttp import web as aioweb
+
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import build_app
+
+        # payload with exemplars carrying trace labels
+        req = remote_write_pb2.WriteRequest()
+        t = req.timeseries.add()
+        for k, v in ((b"__name__", b"lat"), (b"host", b"a")):
+            lab = t.labels.add()
+            lab.name = k
+            lab.value = v
+        for i in range(5):
+            smp = t.samples.add()
+            smp.timestamp = BASE + i * 1000
+            smp.value = float(i)
+        ex = t.exemplars.add()
+        ex.value = 0.99
+        ex.timestamp = BASE + 1500
+        exl = ex.labels.add()
+        exl.name = b"trace_id"
+        exl.value = b"abc123"
+
+        cfg = Config.from_dict({"metric_engine": {"storage": {"object_store": {
+            "type": "Local", "data_dir": tempfile.mkdtemp()}}}})
+        app = await build_app(cfg)
+        app = app[0] if isinstance(app, tuple) else app
+        runner = aioweb.AppRunner(app)
+        await runner.setup()
+        site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(f"{base}/api/v1/write",
+                                 data=req.SerializeToString(),
+                                 headers={"Content-Type": "application/x-protobuf"})
+                assert r.status in (200, 204)
+                r = await s.get(f"{base}/api/v1/query_exemplars",
+                                params={"query": 'lat{host="a"}',
+                                        "start": str(BASE / 1000),
+                                        "end": str((BASE + 10_000) / 1000)})
+                body = await r.json()
+                assert r.status == 200, body
+                assert body["status"] == "success"
+                assert len(body["data"]) == 1
+                series = body["data"][0]
+                assert series["seriesLabels"]["host"] == "a"
+                assert series["seriesLabels"]["__name__"] == "lat"
+                exs = series["exemplars"]
+                assert len(exs) == 1
+                assert exs[0]["labels"] == {"trace_id": "abc123"}
+                assert exs[0]["value"] == "0.99"
+                assert exs[0]["timestamp"] == (BASE + 1500) / 1000.0
+                # range selector rejected with Prometheus error shape
+                r = await s.get(f"{base}/api/v1/query_exemplars",
+                                params={"query": "lat[5m]", "start": "0",
+                                        "end": "1"})
+                assert r.status == 400
+        finally:
+            await runner.cleanup()
