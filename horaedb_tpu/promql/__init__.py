@@ -29,6 +29,7 @@ FUNC:   rate increase delta avg_over_time sum_over_time min_over_time
 MATHFN: abs ceil floor round sqrt ln log2 log10 exp   — MATHFN "(" expr ")"
         clamp_min clamp_max "(" expr "," ["-"] NUMBER ")"
         histogram_quantile "(" NUMBER "," expr ")"  — expr yields `le` buckets
+        label_replace "(" expr "," STRING x4 ")"  — dst, replacement, src, regex
 AGG:    sum avg min max count
 A NAME from any function set followed by anything but "(" parses as a
 metric selector (a metric named `rate` stays queryable).
@@ -115,6 +116,15 @@ class MathFn:
 class HistogramQuantile:
     q: float
     expr: object  # must evaluate to a vector of `le`-labelled buckets
+
+
+@dataclass(frozen=True)
+class LabelReplace:
+    expr: object
+    dst: str
+    replacement: str  # RE2-style $1 / ${name} group references
+    src: str
+    regex: str
 
 
 @dataclass(frozen=True)
@@ -306,6 +316,21 @@ class _Parser:
                 return HistogramQuantile(
                     float(q_tok.text) * (-1.0 if neg else 1.0), inner
                 )
+            if name == "label_replace" and self._called():
+                self.next()
+                self.expect("(")
+                inner = self.expr()
+                strs = []
+                for _ in range(4):
+                    self.expect(",")
+                    t2 = self.next()
+                    if t2.kind != "STRING":
+                        raise PromQLError(
+                            f"label_replace needs string args at {t2.pos}"
+                        )
+                    strs.append(_unquote(t2.text))
+                self.expect(")")
+                return LabelReplace(inner, strs[0], strs[1], strs[2], strs[3])
             if name in MATH_FUNCS and self._called():
                 self.next()
                 self.expect("(")

@@ -46,6 +46,7 @@ from horaedb_tpu.promql import (
     BinOp,
     Func,
     HistogramQuantile,
+    LabelReplace,
     MathFn,
     PromQLError,
     Scalar,
@@ -144,7 +145,64 @@ class RangeEvaluator:
             return await self._math(node)
         if isinstance(node, HistogramQuantile):
             return await self._histogram_quantile(node)
+        if isinstance(node, LabelReplace):
+            return await self._label_replace(node)
         raise PromQLError(f"unsupported node {type(node).__name__}")
+
+    async def _label_replace(self, node: LabelReplace):
+        """Prometheus label_replace(v, dst, replacement, src, regex): when
+        regex FULL-matches src's value, dst is set to replacement with
+        RE2-style $N/${name} group references expanded; an empty result
+        drops dst; non-matching series pass through unchanged. The engine's
+        catastrophic-backtracking guard applies (the regex is user input
+        evaluated on the event loop)."""
+        import re as _re
+
+        from horaedb_tpu.engine.index import _reject_catastrophic
+
+        inner = await self.eval(node.expr)
+        if isinstance(inner, float):
+            raise PromQLError("label_replace needs a vector operand")
+        if not _re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", node.dst):
+            raise PromQLError(f"invalid destination label {node.dst!r}")
+        try:
+            _reject_catastrophic(node.regex)
+        except Exception as e:  # noqa: BLE001 — HoraeError -> bad_data
+            raise PromQLError(str(e)) from None
+        try:
+            pat = _re.compile(node.regex)
+        except _re.error as e:
+            raise PromQLError(f"bad regex {node.regex!r}: {e}") from None
+        # RE2 replacement syntax -> Python expand template:
+        # $$ -> $, ${name} -> \g<name>, $1 -> \g<1>
+        def _tr(m):
+            g = m.group(1)
+            if g == "$":
+                return "$"
+            if g.startswith("{"):
+                return rf"\g<{g[1:-1]}>"
+            return rf"\g<{g}>"
+
+        template = _re.sub(r"\$(\$|\{\w+\}|\d+)", _tr, node.replacement)
+        out = []
+        for sv in inner:
+            m = pat.fullmatch(sv.labels.get(node.src, ""))
+            if m is None:
+                out.append(sv)
+                continue
+            try:
+                val = m.expand(template)
+            except (_re.error, IndexError) as e:
+                raise PromQLError(
+                    f"bad replacement {node.replacement!r}: {e}"
+                ) from None
+            labels = dict(sv.labels)
+            if val == "":
+                labels.pop(node.dst, None)
+            else:
+                labels[node.dst] = val
+            out.append(SeriesVector(labels, sv.values))
+        return out
 
     async def _histogram_quantile(self, node: HistogramQuantile):
         """Prometheus histogram_quantile over classic `le` buckets: group

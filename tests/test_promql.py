@@ -962,3 +962,82 @@ class TestQueryExemplarsEndpoint:
                 assert r.status == 400
         finally:
             await runner.cleanup()
+
+
+class TestLabelReplace:
+    def _apply(self, series, q):
+        import asyncio
+
+        ev = RangeEvaluator.__new__(RangeEvaluator)
+        node = parse(q)
+
+        async def run():
+            async def fake_eval(x):
+                if x is node.expr:
+                    return series
+                raise AssertionError
+            ev.eval = fake_eval
+            return await ev._label_replace(node)
+
+        return asyncio.run(run())
+
+    def _sv(self, labels, v=1.0):
+        from horaedb_tpu.promql.eval import SeriesVector
+
+        return SeriesVector(labels, np.array([v]))
+
+    def test_group_reference_and_passthrough(self):
+        out = self._apply(
+            [self._sv({"host": "web-01-east"}), self._sv({"host": "db-x"})],
+            'label_replace(m, "shard", "$1", "host", "web-(\\\\d+)-.*")',
+        )
+        assert out[0].labels == {"host": "web-01-east", "shard": "01"}
+        assert out[1].labels == {"host": "db-x"}  # no match: unchanged
+
+    def test_empty_replacement_drops_label(self):
+        out = self._apply(
+            [self._sv({"host": "a", "tmp": "x"})],
+            'label_replace(m, "tmp", "", "host", "a")',
+        )
+        assert out[0].labels == {"host": "a"}
+
+    def test_literal_and_dollar_escape(self):
+        out = self._apply(
+            [self._sv({"host": "a"})],
+            'label_replace(m, "cost", "$$5", "host", ".*")',
+        )
+        assert out[0].labels["cost"] == "$5"
+
+    def test_bad_inputs_rejected(self):
+        with pytest.raises(PromQLError):
+            self._apply([self._sv({"h": "a"})],
+                        'label_replace(m, "1bad", "x", "h", ".*")')
+        with pytest.raises(PromQLError):
+            self._apply([self._sv({"h": "a"})],
+                        'label_replace(m, "ok", "x", "h", "(")')
+        with pytest.raises(PromQLError):  # ReDoS shape refused
+            self._apply([self._sv({"h": "a"})],
+                        'label_replace(m, "ok", "x", "h", "(a+)+b")')
+        with pytest.raises(PromQLError):  # out-of-range group
+            self._apply([self._sv({"h": "a"})],
+                        'label_replace(m, "ok", "$3", "h", "(a)")')
+
+    def test_parse_requires_strings(self):
+        with pytest.raises(PromQLError):
+            parse('label_replace(m, dst, "r", "s", ".*")')
+        # still a valid metric name without parens
+        assert isinstance(parse("label_replace"), Selector)
+
+    @async_test
+    async def test_end_to_end_with_aggregation(self):
+        eng = await new_engine()
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        out = await ev.eval(parse(
+            'sum by (parity) (label_replace('
+            'sum_over_time(reqs[1m]), "parity", "$1", "host", "web-[02]*([02])"))'
+        ))
+        # hosts web-0/web-2 match -> parity "0"/"2"; web-1/web-3 unmatched
+        keys = sorted(s.labels.get("parity", "") for s in out)
+        assert keys == ["", "0", "2"]
+        await eng.close()
