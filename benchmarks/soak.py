@@ -211,12 +211,17 @@ def main() -> None:
             '[metric_engine.storage.object_store]\ntype = "Local"\n'
             f'data_dir = "{data_dir}/db"\n'
         )
+    # SOAK_NODE_ID enables per-region epoch fencing through the server
+    # config path (storage/fence.py) — normal operation must be unaffected
+    node_id = os.environ.get("SOAK_NODE_ID", "")
+    node_toml = f'node_id = "{node_id}"\n' if node_id else ""
     with open(cfg, "w") as f:
         f.write(
             f'port = {PORT}\n[test]\nsegment_duration = "2h"\n'
             f"[metric_engine]\ningest_buffer_rows = {buffer_rows}\n"
             f"num_regions = {num_regions}\n"
             f'ingest_flush_interval = "250ms"\n'
+            + node_toml
             + store_toml
         )
     env = dict(os.environ)
