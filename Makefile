@@ -1,6 +1,6 @@
 # Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
 
-.PHONY: test native bench baselines serve lint typecheck clean soak dryruns tpu-suite
+.PHONY: test native bench baselines serve lint jaxlint typecheck clean soak dryruns tpu-suite
 
 test:
 	python -m pytest tests/ -x -q
@@ -24,7 +24,16 @@ serve:
 lint:
 	python -m compileall -q horaedb_tpu tests benchmarks bench.py __graft_entry__.py
 	python tools/lint.py
+	$(MAKE) jaxlint
 	$(MAKE) typecheck
+
+# Domain-aware gate (tools/jaxlint.py): host-sync on hot paths (J001),
+# retrace hazards under jit (J002), dtype drift in engine code (J003),
+# lock discipline on the concurrency surface (J004). Findings print as
+# path:line: CODE message. Rules + suppression syntax:
+# docs/static-analysis.md
+jaxlint:
+	python tools/jaxlint.py
 
 # mypy over the annotated core (config in pyproject.toml [tool.mypy]); the
 # dev image has no mypy, so this degrades to a loud skip locally — CI

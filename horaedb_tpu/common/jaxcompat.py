@@ -1,0 +1,14 @@
+"""JAX version-compatibility shims (one home, no per-module drift).
+
+`shard_map` moved to a top-level export in jax 0.4.31; older images
+still spell it `jax.experimental.shard_map.shard_map`. Import it from
+here so the fallback lives in exactly one place — when jax removes the
+experimental path, this is the only edit site.
+"""
+
+try:
+    from jax import shard_map  # jax >= 0.4.31 top-level export
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map"]

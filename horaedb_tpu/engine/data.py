@@ -15,6 +15,7 @@ import os
 import numpy as np
 import pyarrow as pa
 
+from horaedb_tpu.common.aio import TaskGroup
 from horaedb_tpu.engine.tables import DATA_SCHEMA
 from horaedb_tpu.ops import aggregate as agg_ops
 from horaedb_tpu.ops import filter as F
@@ -416,7 +417,7 @@ class SampleManager:
             if len(work) == 1:
                 await self._write_segment(*work[0], presorted=True, seq=seq, fast=True)
             else:
-                async with asyncio.TaskGroup() as tg:
+                async with TaskGroup() as tg:
                     for lanes in work:
                         tg.create_task(
                             self._write_segment(*lanes, presorted=True, seq=seq, fast=True)
@@ -686,7 +687,7 @@ class SampleManager:
                 acc["min"] = np.minimum(acc["min"], part["min"])
                 acc["max"] = np.maximum(acc["max"], part["max"])
 
-        async with asyncio.TaskGroup() as tg:
+        async with TaskGroup() as tg:
             for seg in self._storage.group_by_segment(ssts):
                 tg.create_task(one_segment(seg))
         if acc is None or acc["count"].sum() == 0:

@@ -514,6 +514,20 @@ class MetricEngine:
         """All registered metric names (the /api/v1/metrics surface)."""
         return self.metric_mgr.names()
 
+    def label_names(self) -> list[bytes]:
+        """All label KEYS across every registered series (the
+        /api/v1/labels no-match[] surface; `__name__` is the endpoint's
+        concern). Public like `metric_names` so regioned deployments can
+        answer via fan-out instead of reaching into the managers."""
+        names: set[bytes] = set()
+        for metric in self.metric_mgr.names():
+            hit = self.metric_mgr.get(metric)
+            if hit is None:
+                continue
+            for labs in self.index_mgr.series_labels(hit[0]).values():
+                names.update(labs)
+        return sorted(names)
+
     def series(self, metric: bytes) -> list[dict[str, str]]:
         """Label sets of every series of a metric (the /api/v1/series
         surface), including tagless series."""

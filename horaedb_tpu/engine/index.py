@@ -74,7 +74,10 @@ def _reject_catastrophic(pattern: str) -> None:
     common catastrophic shapes; the length caps bound what slips through.
     Deliberately strict: `([a-z]+\\.)+` -style selectors are refused too —
     they are the textbook ReDoS shape on failing subjects."""
-    import re._parser as sre_parse
+    try:
+        import re._parser as sre_parse  # Python >= 3.11
+    except ImportError:  # 3.10 spells the private parser sre_parse
+        import sre_parse
 
     from horaedb_tpu.common.error import HoraeError
 

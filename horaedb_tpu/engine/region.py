@@ -531,6 +531,15 @@ class RegionedEngine:
             out.extend(e.metric_names())
         return sorted(set(out))
 
+    def label_names(self) -> list[bytes]:
+        """Fan-out union of per-region label keys (mirrors match_series:
+        the /api/v1/labels no-match[] branch runs unchanged on regioned
+        deployments)."""
+        out: set[bytes] = set()
+        for e in self.engines.values():
+            out.update(e.label_names())
+        return sorted(out)
+
     def series_labels_map(
         self, metric: bytes, tsids: "list[int] | None" = None
     ) -> dict[int, dict[bytes, bytes]]:

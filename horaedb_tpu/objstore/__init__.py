@@ -58,6 +58,17 @@ class ObjectStore(ABC):
             f"{type(self).__name__} does not support conditional puts"
         )
 
+    async def verify_conditional_puts(self, prefix: str) -> None:
+        """Prove put_if_absent is actually ENFORCED before anything (epoch
+        fencing) stakes correctness on it. Part of the store contract so
+        callers invoke it unconditionally — a silently-skipped probe is a
+        latent split-brain. Default: no-op, because local/memory stores
+        enforce natively in-process (O_EXCL link / dict under lock);
+        stores whose enforcement is a REMOTE claim (S3-likes: the far
+        endpoint's If-None-Match handling) override with a real probe
+        that raises HoraeError on a non-enforcing endpoint."""
+        return None
+
     @abstractmethod
     async def get(self, path: str) -> bytes: ...
 

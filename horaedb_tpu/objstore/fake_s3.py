@@ -31,12 +31,16 @@ class FakeS3:
 
     def __init__(self, bucket: str = "test-bucket",
                  verify_signatures: tuple[str, str, str] | None = None,
-                 list_page: int = _LIST_PAGE) -> None:
+                 list_page: int = _LIST_PAGE,
+                 ignore_conditional_puts: bool = False) -> None:
         self.bucket = bucket
         self.objects: dict[str, bytes] = {}
         self.auth_headers: list[str] = []
         self.requests: list[tuple[str, str]] = []
         self.list_page = list_page
+        # emulate pre-2024 S3 clones that answer 200 to a conditional PUT
+        # on an existing key (the capability the fence probe must reject)
+        self.ignore_conditional_puts = ignore_conditional_puts
         self._fail_budget = 0
         self._fail_status = 500
         # (key_id, key_secret, region) -> reject bad signatures with 403
@@ -115,7 +119,11 @@ class FakeS3:
             return web.Response(status=404, text="NoSuchBucket")
         key = request.match_info["key"]
         if request.method == "PUT":
-            if request.headers.get("If-None-Match") == "*" and key in self.objects:
+            if (
+                request.headers.get("If-None-Match") == "*"
+                and key in self.objects
+                and not self.ignore_conditional_puts
+            ):
                 return web.Response(status=412, text="PreconditionFailed")
             self.objects[key] = await request.read()
             return web.Response(status=200)

@@ -155,6 +155,7 @@ class S3LikeStore(ObjectStore):
         self._host = urllib.parse.urlparse(self._endpoint).netloc
         self._prefix = config.prefix.strip("/")
         self._session = None  # created lazily inside the running loop
+        self._cond_put_verified = False  # set by verify_conditional_puts
 
     # -- key <-> object mapping ---------------------------------------------
 
@@ -296,12 +297,47 @@ class S3LikeStore(ObjectStore):
         # PUT fail with 412 when the key exists. 409 also maps (some stores
         # answer ConditionalRequestConflict for concurrent conditional PUTs
         # racing on one key — for the caller both mean "lost the race").
+        # A store that silently IGNORES the condition (older MinIO/clones
+        # answer 200 for existing keys) breaks every caller that relies on
+        # exactly-one-winner semantics; verify_conditional_puts() probes
+        # for that before fencing trusts this verb (ADVICE r5).
         status, _, _ = await self._request(
             "PUT", self._key(path), payload=bytes(data), io=True,
             extra_headers={"If-None-Match": "*"}, allow_statuses=(409, 412),
         )
         if status in (409, 412):
             raise PreconditionFailed(f"object exists: {path}")
+
+    async def verify_conditional_puts(self, prefix: str) -> None:
+        """Capability probe: prove the endpoint actually enforces
+        `If-None-Match: *` before anything (epoch fencing) stakes
+        correctness on it. Two conditional PUTs of one sentinel key —
+        the second (or, when another process probed first, the first)
+        MUST come back PreconditionFailed; a store that answers 200 for
+        an existing key silently degrades fencing to no protection, so
+        that is a loud boot-time failure, not a latent split-brain.
+        Runs once per store instance; the sentinel stays behind as a
+        capability-audit marker (and fast-paths later probes)."""
+        if self._cond_put_verified:
+            return
+        key = f"{prefix.rstrip('/')}/.cond-put-probe"
+        try:
+            await self.put_if_absent(key, b"conditional-put capability probe")
+        except PreconditionFailed:
+            # an earlier probe's sentinel rejected us: condition enforced
+            self._cond_put_verified = True
+            return
+        try:
+            await self.put_if_absent(key, b"conditional-put capability probe")
+        except PreconditionFailed:
+            self._cond_put_verified = True
+            return
+        raise HoraeError(
+            f"object store at {self._endpoint!r} ignores conditional PUTs "
+            f"(If-None-Match: * on existing key {key!r} returned success); "
+            "epoch fencing cannot provide single-writer protection on this "
+            "store — upgrade the store or disable fencing (node_id)"
+        )
 
     async def get(self, path: str) -> bytes:
         _, body, _ = await self._request("GET", self._key(path), io=True)

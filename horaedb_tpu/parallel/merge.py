@@ -43,8 +43,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horaedb_tpu.common.jaxcompat import shard_map
 
 import horaedb_tpu.ops  # noqa: F401  — enables jax x64 (u64 key lanes)
 from horaedb_tpu.common.error import ensure

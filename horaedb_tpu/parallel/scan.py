@@ -18,9 +18,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.jaxcompat import shard_map
 from horaedb_tpu.ops import filter as filter_ops
 from horaedb_tpu.ops.filter import Predicate
 
@@ -229,6 +229,7 @@ def build_multisegment_downsample(
         # exactly one segment per seg-shard
         ensure(
             ts.shape[0] == 1,
+            # jaxlint: disable=J002 trace-time assert formats a STATIC shape, not a tracer
             f"n_segments must equal the seg mesh axis "
             f"(got {ts.shape[0]} local segments per shard)",
         )

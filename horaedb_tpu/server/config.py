@@ -25,7 +25,10 @@ Example:
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib  # Python >= 3.11
+except ImportError:  # 3.10 images ship the API-identical backport
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 from horaedb_tpu.common.error import ensure

@@ -520,12 +520,9 @@ async def _all_label_names(
         for d in await _match_series(state, match_exprs):
             names.update(d.keys())
         return sorted(names)
-    for metric in state.engine.metric_names():
-        hit = state.engine.metric_mgr.get(metric)
-        if hit is None:
-            continue
-        for labs in state.engine.index_mgr.series_labels(hit[0]).values():
-            names.update(k.decode(errors="replace") for k in labs)
+    # engines' public surface (NOT metric_mgr/index_mgr: RegionedEngine
+    # has neither — it answers via fan-out, mirroring match_series)
+    names.update(k.decode(errors="replace") for k in state.engine.label_names())
     return sorted(names)
 
 

@@ -68,6 +68,13 @@ def _epoch_of(path: str) -> int:
         return -1
 
 
+def _top_epoch(metas) -> int:
+    """Highest epoch among fence-dir entries; non-epoch objects (the
+    conditional-put capability-probe sentinel) are ignored, not -1 —
+    they must never shift epoch numbering."""
+    return max((e for m in metas if (e := _epoch_of(m.path)) >= 0), default=0)
+
+
 class EpochFence:
     """A claimed writer epoch on one region root (see module docstring)."""
 
@@ -98,12 +105,16 @@ class EpochFence:
         """Claim the next epoch on `root`. Loses of the conditional-put race
         retry with the next number; every successful return is the unique
         owner of a strictly higher epoch than all prior owners."""
+        # Part of the ObjectStore contract (base-class no-op for stores
+        # that enforce natively; S3-likes really probe the endpoint):
+        # run it before trusting put_if_absent with region ownership.
+        await store.verify_conditional_puts(_fence_dir(root))
         payload = json.dumps(
             {"node": node_id, "acquired_unix_ms": int(time.time() * 1000)}
         ).encode()
         for _ in range(max_attempts):
             metas = await store.list(_fence_dir(root))
-            top = max((_epoch_of(m.path) for m in metas), default=0)
+            top = _top_epoch(metas)
             epoch = top + 1
             try:
                 await store.put_if_absent(_epoch_path(root, epoch), payload)
@@ -128,7 +139,7 @@ class EpochFence:
         ):
             return
         metas = await self._store.list(_fence_dir(self._root))
-        top = max((_epoch_of(m.path) for m in metas), default=0)
+        top = _top_epoch(metas)
         if top > self.epoch:
             raise FencedError(
                 f"writer epoch {self.epoch} on {self._root} superseded by "
@@ -138,7 +149,10 @@ class EpochFence:
 
     async def current_owner(self) -> dict:
         """The newest claim's payload (diagnostics / admin surface)."""
-        metas = await self._store.list(_fence_dir(self._root))
+        metas = [
+            m for m in await self._store.list(_fence_dir(self._root))
+            if _epoch_of(m.path) >= 0  # skip the capability-probe sentinel
+        ]
         if not metas:
             return {}
         newest = max(metas, key=lambda m: _epoch_of(m.path))

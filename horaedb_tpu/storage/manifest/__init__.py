@@ -91,8 +91,10 @@ class ManifestMerger:
     # -- write-path hooks ---------------------------------------------------
     def maybe_schedule_merge(self) -> None:
         """Count one new delta; soft→signal merge, hard→reject (mod.rs:248-262)."""
+        # jaxlint: disable=J004 event-loop-confined; _merge_lock serializes the fold, not this
         self._deltas_num += 1
         if self._deltas_num > self._config.hard_merge_threshold:
+            # jaxlint: disable=J004 event-loop-confined; _merge_lock serializes the fold, not this
             self._deltas_num -= 1
             raise HoraeError(
                 f"Too many manifest delta files: {self._deltas_num + 1}, "
@@ -105,6 +107,7 @@ class ManifestMerger:
                 pass  # a merge is already queued; dropping the signal is fine
 
     def on_delta_write_failed(self) -> None:
+        # jaxlint: disable=J004 event-loop-confined; _merge_lock serializes the fold, not this
         self._deltas_num -= 1
 
     @property

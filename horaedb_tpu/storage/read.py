@@ -42,7 +42,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.error import HoraeError, ensure
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import dedup as dedup_ops
 from horaedb_tpu.ops import filter as filter_ops
@@ -201,13 +201,16 @@ class _LinkProfile:
                         "sort_s_per_row": 1.2e-6}
             warm = jax.jit(lambda x: x.sum())
             small = jax.device_put(np.arange(128, dtype=np.float32))
+            # jaxlint: disable=J001 one-time link calibration, off the query path
             warm(small).block_until_ready()  # compile outside the clock
             t0 = time.perf_counter()
+            # jaxlint: disable=J001 one-time link calibration, off the query path
             warm(small).block_until_ready()
             dispatch = max(time.perf_counter() - t0, 1e-5)
             probe = np.empty(8 << 20, np.uint8)
             t0 = time.perf_counter()
             d = jax.device_put(probe)
+            # jaxlint: disable=J001 one-time link calibration, off the query path
             d.block_until_ready()
             h2d = len(probe) / max(time.perf_counter() - t0 - dispatch, 1e-6)
             t0 = time.perf_counter()
@@ -330,6 +333,10 @@ def _pack_sort_keys(
 
 
 _PACK_SENTINEL = PACK_SENTINEL  # shared masked-row contract (ops/blocks.py)
+
+# once-per-process flag for the forced-sharded-without-mesh downgrade
+# warning (the scanstats note still records every occurrence)
+_warned_sharded_no_mesh = False
 
 
 @lru_cache(maxsize=64)
@@ -555,6 +562,14 @@ def _plan_and_merge(
 
     pred_cols = filter_ops.pred_columns(predicate)
     mode = os.environ.get("HORAEDB_SCAN_PATH", "auto")
+    if mode not in ("auto", "host", "device", "sharded"):
+        # a typo'd override must fail LOUDLY: an unknown mode falling
+        # through to auto would silently measure the wrong path — the
+        # exact A/B-honesty failure the explicit modes exist to prevent
+        raise HoraeError(
+            f"HORAEDB_SCAN_PATH={mode!r} is not one of "
+            "auto/host/device/sharded"
+        )
     link = _LinkProfile.get()
     dispatch = link["dispatch_s"]
 
@@ -584,8 +599,28 @@ def _plan_and_merge(
         from horaedb_tpu.parallel.mesh import active_mesh
 
         mesh = active_mesh()
+        if mode == "sharded" and mesh is None:
+            # forced sharded with no ambient mesh: the likeliest harness
+            # mistake (mesh install failed/skipped) — same honesty bar as
+            # the unpackable fallback below, the downgrade must be visible.
+            # The scanstats note records every occurrence; the log line is
+            # once-per-process (this fires on EVERY chunk of every scan —
+            # repeating it would bury the rest of the log)
+            scanstats.note("path_sharded_fallback_no_mesh")
+            global _warned_sharded_no_mesh
+            if not _warned_sharded_no_mesh:
+                _warned_sharded_no_mesh = True
+                logger.warning(
+                    "HORAEDB_SCAN_PATH=sharded but no mesh is active; "
+                    "falling back to the single-device kernel (n=%d)", n,
+                )
+        # size-based upgrade only in auto mode: an explicit mode=device
+        # must PIN the single-device kernel even on a mesh-active process,
+        # or A/B harnesses silently measure the sharded path (the same
+        # honesty bar the unpackable-fallback warning below holds)
         want_sharded = mesh is not None and (
-            mode == "sharded" or n >= _sharded_min_rows()
+            mode == "sharded"
+            or (mode == "auto" and n >= _sharded_min_rows())
         )
         if not want_sharded and (key_bytes - 8) / link["h2d_bw"] < 30e-9:
             return None
@@ -623,6 +658,7 @@ def _plan_and_merge(
             block = Block.from_numpy({"__packed__": packed},
                                      pad_keys=("__packed__",))
             if scanstats.active():  # fence only for attribution
+                # jaxlint: disable=J001 h2d attribution fence; profiling runs only
                 jax.block_until_ready(list(block.columns.values()))
         with scanstats.stage("device_merge"):
             kernel = _build_packed_index_kernel(seq_width, do_dedup)
@@ -660,6 +696,7 @@ def _plan_and_merge(
         with scanstats.stage("h2d"):
             block = Block.from_numpy(arrays, pad_keys=sort_keys)
             if scanstats.active():  # fence only for attribution
+                # jaxlint: disable=J001 h2d attribution fence; profiling runs only
                 jax.block_until_ready(list(block.columns.values()))
         with scanstats.stage("device_merge"):
             if mask is not None:

@@ -237,11 +237,68 @@ class TestFakeS3ConditionalPut:
                 await store.put_if_absent("f/1", b"b")
             assert await store.get("f/1") == b"a"
             # fencing over S3: the same epoch race resolves to one winner
+            # (acquire also runs the conditional-PUT capability probe —
+            # epochs must be unaffected by its sentinel object)
             f1 = await EpochFence.acquire(store, "db", "n1", validate_interval_s=0)
             f2 = await EpochFence.acquire(store, "db", "n2")
             assert (f1.epoch, f2.epoch) == (1, 2)
             with pytest.raises(FencedError):
                 await f1.ensure_valid()
+        finally:
+            await store.close()
+            await fake.stop()
+
+    @async_test
+    async def test_store_ignoring_conditional_puts_fails_acquire_loudly(self):
+        """ADVICE r5: an S3-compatible store that answers 200 to
+        `If-None-Match: *` on an existing key (older MinIO/clones) would
+        let two contenders both believe they own an epoch — fencing
+        silently degrades to no protection. First acquisition must probe
+        and fail LOUDLY instead."""
+        from horaedb_tpu.common.error import HoraeError
+        from horaedb_tpu.objstore.fake_s3 import FakeS3
+        from horaedb_tpu.objstore.s3 import S3LikeConfig, S3LikeStore
+
+        fake = FakeS3(ignore_conditional_puts=True)
+        url = await fake.start()
+        store = S3LikeStore(S3LikeConfig(
+            endpoint=url, bucket="test-bucket", region="r",
+            key_id="k", key_secret="s",
+        ))
+        try:
+            with pytest.raises(HoraeError, match="conditional PUT"):
+                await EpochFence.acquire(store, "db", "n1")
+        finally:
+            await store.close()
+            await fake.stop()
+
+    @async_test
+    async def test_probe_passes_once_and_caches(self):
+        from horaedb_tpu.objstore.fake_s3 import FakeS3
+        from horaedb_tpu.objstore.s3 import S3LikeConfig, S3LikeStore
+
+        fake = FakeS3()
+        url = await fake.start()
+        store = S3LikeStore(S3LikeConfig(
+            endpoint=url, bucket="test-bucket", region="r",
+            key_id="k", key_secret="s",
+        ))
+        try:
+            await store.verify_conditional_puts("db/fence")
+            n = len(fake.requests)
+            # verified once: later acquisitions skip the probe requests
+            await store.verify_conditional_puts("db/fence")
+            assert len(fake.requests) == n
+            # a SECOND process (fresh store instance) probing the same
+            # prefix proves enforcement from the sentinel's 412 directly
+            other = S3LikeStore(S3LikeConfig(
+                endpoint=url, bucket="test-bucket", region="r",
+                key_id="k", key_secret="s",
+            ))
+            try:
+                await other.verify_conditional_puts("db/fence")
+            finally:
+                await other.close()
         finally:
             await store.close()
             await fake.stop()

@@ -542,6 +542,55 @@ class TestAutoShardedUpgrade:
         keep[-1] = True
         np.testing.assert_array_equal(np.sort(idx), np.sort(order[keep]))
 
+    def test_device_mode_pins_single_device_even_on_mesh(
+        self, mesh8, monkeypatch
+    ):
+        """A/B honesty (ADVICE r5): HORAEDB_SCAN_PATH=device on a
+        mesh-active process with n past the sharded threshold must STILL
+        run the single-device kernel — the size-based upgrade applies in
+        auto mode only, or a harness forcing the device leg silently
+        measures the sharded path instead."""
+        import pyarrow as pa
+
+        from horaedb_tpu.parallel.mesh import set_active_mesh
+        from horaedb_tpu.storage import scanstats
+        from horaedb_tpu.storage.config import UpdateMode
+        from horaedb_tpu.storage.read import _plan_and_merge
+        from horaedb_tpu.storage.types import StorageSchema
+
+        monkeypatch.setenv("HORAEDB_SCAN_PATH", "device")
+        monkeypatch.setenv("HORAEDB_SHARDED_MIN_ROWS", "100000")
+        schema = StorageSchema.try_new(
+            pa.schema([("pk", pa.int64()), ("v", pa.float64())]), 1,
+            UpdateMode.OVERWRITE,
+        )
+        n = 120_000
+        rng = np.random.default_rng(3)
+        cols = {
+            "pk": rng.integers(0, n // 4, n).astype(np.int64),
+            "__seq__": np.full(n, 3, dtype=np.uint64),
+            "v": rng.normal(size=n),
+        }
+        set_active_mesh(mesh8)
+        try:
+            with scanstats.scan_stats() as st:
+                idx = _plan_and_merge(
+                    schema, n, lambda name: cols[name], None, lambda: None,
+                    False, lambda name: cols[name].dtype.itemsize,
+                )
+        finally:
+            set_active_mesh(None)
+        assert "path_device_merge_sharded" not in st.counts, st.counts
+        assert any(k.startswith("path_device_merge") for k in st.counts), \
+            st.counts
+        # same answer either way
+        order = np.lexsort((cols["__seq__"], cols["pk"]))
+        grp = cols["pk"][order]
+        keep = np.empty(n, bool)
+        keep[:-1] = grp[:-1] != grp[1:]
+        keep[-1] = True
+        np.testing.assert_array_equal(np.sort(idx), np.sort(order[keep]))
+
 
 class TestShardedAppendMode:
     def test_append_mode_scan_sharded_equals_default(self, mesh8, monkeypatch):

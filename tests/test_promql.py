@@ -517,7 +517,54 @@ class TestRegionedPromQL:
             labs[b"host"].decode() for labs in matched.values()
         )
         assert hosts == ["web-1", "web-3"]
+        # label-name discovery fans out too (ADVICE r5: used to require
+        # metric_mgr/index_mgr attributes RegionedEngine doesn't have)
+        assert eng.label_names() == [b"dc", b"host"]
         await eng.close()
+
+    @async_test
+    async def test_labels_endpoint_without_match_on_regioned_server(self):
+        """/api/v1/labels WITHOUT match[] on a num_regions > 1 deployment
+        (ADVICE r5 medium): the no-match[] branch used to reach into
+        state.engine.metric_mgr / index_mgr — attributes RegionedEngine
+        does not have — and 500'd with an AttributeError. It must answer
+        via the engines' public label_names() fan-out."""
+        import tempfile
+
+        import aiohttp
+        from aiohttp import web as aioweb
+
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import build_app
+
+        cfg = Config.from_dict({"metric_engine": {
+            "num_regions": 2,
+            "storage": {"object_store": {
+                "type": "Local", "data_dir": tempfile.mkdtemp()}}}})
+        app = await build_app(cfg)
+        app = app[0] if isinstance(app, tuple) else app
+        runner = aioweb.AppRunner(app)
+        await runner.setup()
+        site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.post(f"{base}/api/v1/write", data=scrape_payload(),
+                                 headers={"Content-Type": "application/x-protobuf"})
+                assert r.status in (200, 204)
+                r = await s.get(f"{base}/api/v1/labels")
+                body = await r.json()
+                assert r.status == 200, body
+                assert body["status"] == "success"
+                assert body["data"] == ["__name__", "dc", "host"]
+                # the match[]-scoped branch keeps working alongside
+                r = await s.get(f"{base}/api/v1/labels",
+                                params={"match[]": 'reqs{dc="east"}'})
+                assert (await r.json())["data"] == ["__name__", "dc", "host"]
+        finally:
+            await runner.cleanup()
 
 
 class TestTopKAndOffset:

@@ -1,0 +1,642 @@
+"""JAX-aware static analysis gate (`make jaxlint`, folded into `make lint`).
+
+tools/lint.py covers generic Python defects; this pass enforces the
+TPU-native invariants the reference enforces with `clippy -D warnings`:
+the engine's scan/merge/aggregate kernels are only "as fast as the
+hardware allows" (ROADMAP north star) while they stay on-device, and a
+single silent host sync or retrace in a hot path blows the decode-
+throughput budget without failing any test. Stdlib `ast` only.
+
+Rules (see docs/static-analysis.md for rationale and examples):
+
+  J000  malformed suppression (missing reason — every suppression must
+        say WHY the invariant is waived)
+  J001  host-sync call on a hot path: `.item()`, `.tolist()`,
+        `float()/int()/bool()` on array expressions, `np.asarray`/
+        `np.array`, `jax.device_get`, `.block_until_ready()` inside a
+        jit-traced function (decorated or wrapped with `jax.jit`/`pjit`/
+        `shard_map`), plus the unambiguous device syncs (`.item()`,
+        `.block_until_ready()`, `jax.device_get`) anywhere in the
+        allowlisted hot modules (HOT_MODULES below)
+  J002  retrace / trace-staleness hazard inside jit-traced code:
+        trace-time-frozen calls (`time.time()`, `datetime.now()`,
+        `np.random.*`, `random.*`), `print()` and f-strings (run at
+        trace time only / concretize tracers), and call sites passing
+        untraceable literals (str/bytes/set) to a function jit-wrapped
+        WITHOUT static_argnums/static_argnames
+  J003  dtype drift: a bare float literal flowing into `jnp.array`/
+        `jnp.full` without an explicit dtype (weak-type promotion makes
+        the result dtype depend on the surrounding expression — on TPU
+        that silently doubles lane width or truncates to f32)
+  J004  lock discipline: a class that owns a `*lock` attribute
+        (threading/asyncio Lock/RLock) but mutates `self._*` state in a
+        PUBLIC method outside any `with self._lock:` block — the
+        storage/fence/compaction concurrency surface
+
+Suppressions: `# jaxlint: disable=J001 <reason>` on the finding's line
+or the line immediately above. The reason is mandatory (J000 otherwise);
+multiple codes separate with commas. tools/lint.py's `# noqa` does NOT
+suppress jaxlint findings — the two gates are independent.
+
+Precision choices (documented, deliberate):
+- `np.asarray`/`float()` OUTSIDE jit in hot modules are not flagged: on
+  the host side of a kernel boundary they are routinely numpy->numpy
+  and flagging them would bury the signal in suppressions. Inside a
+  traced function they are always wrong and always flagged.
+- dict/list literals at jit call sites are legal pytrees with a fixed
+  structure per call site and are not flagged; str/bytes/set cannot be
+  traced at all and are.
+- J004 only inspects direct `self._x` assignments/augments/deletes and
+  known mutator-method calls (`.append`, `.pop`, ...); aliasing through
+  a local name is out of scope for a stdlib pass.
+
+Zero unsuppressed findings is the bar. Exit code = number of findings
+(capped 125), matching tools/lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+# one file-discovery policy for BOTH gates (same roots semantics, same
+# __pycache__/pb codegen exclusions) — a scope change in lint.py must
+# never silently diverge this gate's file set
+try:
+    from lint import iter_py_files  # script execution: sibling on sys.path
+except ImportError:  # package-style import (tools.jaxlint)
+    from tools.lint import iter_py_files
+
+# Modules whose host-side code is ALSO held to the no-silent-sync bar
+# (the columnar scan/merge/aggregate surface PAPERS.md budgets):
+HOT_MODULES = (
+    "horaedb_tpu/ops/",
+    "horaedb_tpu/parallel/",
+    "horaedb_tpu/storage/read.py",
+)
+# Engine-code scope for the dtype rule (J003):
+DTYPE_MODULES = (
+    "horaedb_tpu/ops/",
+    "horaedb_tpu/parallel/",
+    "horaedb_tpu/engine/",
+    "horaedb_tpu/storage/",
+)
+
+JIT_WRAPPERS = {
+    "jit", "jax.jit", "pjit", "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+# device -> host syncs, unambiguous even outside jit
+SYNC_METHODS = {"item", "block_until_ready"}
+SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+# additionally wrong inside a traced function
+TRACE_SYNC_METHODS = SYNC_METHODS | {"tolist"}
+TRACE_SYNC_CALLS = SYNC_CALLS | {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.block_until_ready",
+}
+CONCRETIZING_BUILTINS = {"float", "int", "bool"}
+
+# trace-time-frozen calls: evaluated ONCE at trace time, silently stale
+# on every cached-trace call after that
+FROZEN_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "time.process_time", "datetime.now", "datetime.datetime.now",
+    "datetime.utcnow", "datetime.datetime.utcnow",
+}
+FROZEN_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+JNP_DTYPE_CTORS = {
+    "jnp.array": 1, "jnp.full": 2,          # positional index of dtype
+    "jax.numpy.array": 1, "jax.numpy.full": 2,
+}
+
+LOCK_FACTORIES = ("Lock", "RLock", "Semaphore", "Condition")
+MUTATORS = {
+    "append", "appendleft", "add", "update", "pop", "popitem", "clear",
+    "extend", "remove", "discard", "insert", "setdefault",
+}
+
+SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=((?:J\d{3})(?:\s*,\s*J\d{3})*)(?:\s+(.+))?"
+)
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`jax.numpy.full` -> "jax.numpy.full"; None for non-name chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """True for `jax.jit`, `partial(jax.jit, ...)`, `shard_map`, and
+    calls of those (e.g. the decorator `@partial(jax.jit, ...)`)."""
+    d = dotted(node)
+    if d in JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fd = dotted(node.func)
+        if fd in JIT_WRAPPERS:
+            return True
+        if fd in PARTIAL_NAMES and node.args and _is_jit_expr(node.args[0]):
+            return True
+    return False
+
+
+def _jit_call_static(call: ast.Call) -> bool:
+    """Does this jit/partial(jit) call carry static_argnums/argnames?"""
+    kws = {kw.arg for kw in call.keywords}
+    if {"static_argnums", "static_argnames"} & kws:
+        return True
+    # partial(jax.jit, static_argnames=...) nests one level
+    if dotted(call.func) in PARTIAL_NAMES and call.args:
+        inner = call.args[0]
+        if isinstance(inner, ast.Call):
+            return _jit_call_static(inner)
+    return False
+
+
+class Suppressions:
+    """Per-file `# jaxlint: disable=...` map (same line or line above)."""
+
+    def __init__(self, lines: list[str]):
+        self.by_line: dict[int, tuple[set[str], str]] = {}
+        self.malformed: list[int] = []
+        for i, line in enumerate(lines, 1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            codes = {c.strip() for c in m.group(1).split(",")}
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.malformed.append(i)
+            self.by_line[i] = (codes, reason)
+
+    def covers(self, lineno: int, code: str) -> bool:
+        for ln in (lineno, lineno - 1):
+            ent = self.by_line.get(ln)
+            if ent and code in ent[0] and ent[1]:
+                return True
+        return False
+
+
+class JitIndex(ast.NodeVisitor):
+    """First pass: which defs/lambdas run under a jit trace, and which
+    NAMES are bound to bare (no-static) jit wrappers — for the J002
+    call-site check."""
+
+    def __init__(self) -> None:
+        self.jit_defs: set[ast.AST] = set()       # FunctionDef/Lambda nodes
+        self.wrapped_names: set[str] = set()       # names passed to jit/shard_map
+        self.bare_jit_names: set[str] = set()      # jit-wrapped, no statics
+        self._defs_by_name: dict[str, list[ast.AST]] = {}
+
+    def visit_FunctionDef(self, node):  # noqa  (shared handler)
+        self._defs_by_name.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec):
+                self.jit_defs.add(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fd = dotted(node.func)
+        is_wrap = fd in JIT_WRAPPERS or (
+            fd in PARTIAL_NAMES and node.args and _is_jit_expr(node.args[0])
+        )
+        if is_wrap and node.args:
+            pos = 1 if fd in PARTIAL_NAMES else 0
+            target = node.args[pos] if len(node.args) > pos else None
+            if isinstance(target, ast.Lambda):
+                self.jit_defs.add(target)
+            elif isinstance(target, ast.Name):
+                self.wrapped_names.add(target.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # `kernel = jax.jit(fn)` without statics: calls to `kernel` with
+        # untraceable literal args are J002 call-site findings
+        if (
+            isinstance(node.value, ast.Call)
+            and dotted(node.value.func) in JIT_WRAPPERS
+            and not _jit_call_static(node.value)
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.bare_jit_names.add(t.id)
+        self.generic_visit(node)
+
+    def finish(self) -> None:
+        # names handed to jit()/shard_map() mark their local defs traced
+        for name in self.wrapped_names:
+            for d in self._defs_by_name.get(name, []):
+                self.jit_defs.add(d)
+        # a def decorated @jax.jit with NO statics is also a bare-jit name
+        for defs in self._defs_by_name.values():
+            for d in defs:
+                if d in self.jit_defs and isinstance(
+                    d, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    for dec in d.decorator_list:
+                        if _is_jit_expr(dec) and not (
+                            isinstance(dec, ast.Call) and _jit_call_static(dec)
+                        ):
+                            self.bare_jit_names.add(d.name)
+
+
+def _walk_no_nested_defs(body: list[ast.stmt]):
+    """Yield nodes of a function body WITHOUT descending into nested
+    function/class definitions (those are visited separately, with their
+    own jit-context flag)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class Finding:
+    __slots__ = ("lineno", "code", "msg")
+
+    def __init__(self, lineno: int, code: str, msg: str):
+        self.lineno, self.code, self.msg = lineno, code, msg
+
+
+def _check_traced_body(fn, findings: list[Finding]) -> None:
+    """J001 + J002 inside one jit-traced function body."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for node in _walk_no_nested_defs(body):
+        if isinstance(node, ast.JoinedStr):
+            findings.append(Finding(
+                node.lineno, "J002",
+                "f-string under jit runs at trace time only (and "
+                "concretizes tracers); move formatting outside the kernel "
+                "or use jax.debug.print",
+            ))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        if fd in TRACE_SYNC_CALLS:
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"host sync `{fd}(...)` inside a jit-traced function — "
+                "forces a device->host transfer (or trace-time "
+                "concretization) on the hot path",
+            ))
+        elif fd in CONCRETIZING_BUILTINS and node.args and not isinstance(
+            node.args[0], ast.Constant
+        ):
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"`{fd}()` on a traced value inside jit concretizes the "
+                "tracer (ConcretizationTypeError at best, a silent host "
+                "sync at worst)",
+            ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in TRACE_SYNC_METHODS
+            and not node.args
+        ):
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"host sync `.{node.func.attr}()` inside a jit-traced "
+                "function — forces a device->host transfer on the hot path",
+            ))
+        elif fd == "print":
+            findings.append(Finding(
+                node.lineno, "J002",
+                "print() under jit runs at trace time only (silent on "
+                "cached traces); use jax.debug.print",
+            ))
+        elif fd in FROZEN_CALLS or (
+            fd is not None and fd.startswith(FROZEN_PREFIXES)
+        ):
+            findings.append(Finding(
+                node.lineno, "J002",
+                f"`{fd}()` under jit is evaluated once at trace time and "
+                "frozen into the compiled graph — every later call reuses "
+                "the stale value",
+            ))
+
+
+def _check_host_hot(tree: ast.Module, jit_defs: set, findings: list) -> None:
+    """J001 outside jit, hot modules only: unambiguous device syncs."""
+    # collect nodes inside traced defs so we don't double-report them
+    traced: set[ast.AST] = set()
+    for d in jit_defs:
+        body = d.body if isinstance(d.body, list) else [d.body]
+        for stmt in body:
+            traced.update(ast.walk(stmt))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or node in traced:
+            continue
+        fd = dotted(node.func)
+        if fd in SYNC_CALLS:
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"`{fd}(...)` in a hot module — an explicit device->host "
+                "sync on the scan/merge path; move it behind the kernel "
+                "boundary or suppress with the measured justification",
+            ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in SYNC_METHODS
+            and not node.args
+        ):
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"`.{node.func.attr}()` in a hot module — an explicit "
+                "device->host sync on the scan/merge path",
+            ))
+
+
+def _check_jit_call_sites(tree, bare_jit_names: set[str], findings) -> None:
+    """J002: untraceable literal args to bare-jit callables."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id in bare_jit_names):
+            continue
+        exprs = list(node.args) + [kw.value for kw in node.keywords]
+        for a in exprs:
+            bad = None
+            if isinstance(a, ast.Constant) and isinstance(a.value, (str, bytes)):
+                bad = f"{type(a.value).__name__} literal"
+            elif isinstance(a, ast.Set):
+                bad = "set literal"
+            if bad:
+                findings.append(Finding(
+                    node.lineno, "J002",
+                    f"{bad} passed to jit-wrapped `{node.func.id}` with no "
+                    "static_argnums/static_argnames — untraceable types "
+                    "must be static (and each distinct value retraces)",
+                ))
+
+
+def _check_dtype(tree: ast.Module, findings: list[Finding]) -> None:
+    """J003: bare float literals into jnp.array/jnp.full without dtype."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        if fd not in JNP_DTYPE_CTORS:
+            continue
+        dtype_pos = JNP_DTYPE_CTORS[fd]
+        if len(node.args) > dtype_pos:
+            continue  # positional dtype given
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        value_args = node.args[:dtype_pos]
+        has_float = any(
+            isinstance(sub, ast.Constant) and isinstance(sub.value, float)
+            for a in value_args
+            for sub in ast.walk(a)
+        )
+        if has_float:
+            findings.append(Finding(
+                node.lineno, "J003",
+                f"bare float literal into `{fd}` without dtype= — weak-type "
+                "promotion decides the lane width (f32 vs f64) from context; "
+                "pin it explicitly in engine code",
+            ))
+
+
+def _lock_attrs_of(cls: ast.ClassDef) -> set[str]:
+    """Attribute names of locks this class OWNS (self._lock = Lock())."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target, value = node.target, node.value
+        else:
+            continue
+        name = None
+        if isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id in ("self", "cls"):
+            name = target.attr
+        elif isinstance(target, ast.Name) and node in cls.body:
+            name = target.id
+        if name is None or not name.endswith("lock"):
+            continue
+        if isinstance(value, ast.Call):
+            vd = dotted(value.func) or ""
+            if vd.rsplit(".", 1)[-1] in LOCK_FACTORIES:
+                out.add(name)
+    return out
+
+
+def _self_underscore_target(expr: ast.expr, bound: str) -> str | None:
+    """Resolve (possibly subscripted) `<bound>._x...` store targets to
+    the owning attribute name `_x` (`bound` is the method's receiver
+    parameter: self or cls)."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == bound
+        and expr.attr.startswith("_")
+    ):
+        return expr.attr
+    return None
+
+
+def _check_lock_discipline(tree: ast.Module, findings: list[Finding]) -> None:
+    """J004 per class, two passes: (1) which `self._*` attrs does ANY
+    method mutate under a `with self.<lock>:` block — that set IS the
+    lock-guarded state, declared by the code itself; (2) a PUBLIC method
+    mutating one of those attrs outside the lock is the finding. Attrs
+    the lock never guards anywhere (event-loop-confined counters next
+    to a lock that serializes something else) are not flagged — the
+    class never claimed the lock covers them."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _lock_attrs_of(cls)
+        if not locks:
+            continue
+        guarded: set[str] = set()
+        for meth in cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_method_locking(meth, locks, guarded, None)
+        if not guarded:
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if meth.name.startswith("_"):
+                continue  # private/dunder: callers hold the lock
+            _scan_method_locking(meth, locks, guarded, findings)
+
+
+def _scan_method_locking(meth, locks, guarded, findings) -> None:
+    """findings=None: COLLECT attrs mutated under a lock into `guarded`.
+    Otherwise: FLAG unlocked mutations of guarded attrs."""
+    # only the method's FIRST parameter names the shared instance; `self`
+    # as a plain local (the `self = object.__new__(cls)` constructor
+    # idiom inside classmethods) is a not-yet-published object and its
+    # attribute writes race with nobody
+    params = meth.args.posonlyargs + meth.args.args
+    bound = params[0].arg if params else None
+    if bound not in ("self", "cls"):
+        return
+
+    def held_by(with_node) -> bool:
+        for item in with_node.items:
+            ctx = item.context_expr
+            if (
+                isinstance(ctx, ast.Attribute)
+                and isinstance(ctx.value, ast.Name)
+                and ctx.value.id == bound
+                and ctx.attr in locks
+            ):
+                return True
+        return False
+
+    def visit(nodes, locked: bool) -> None:
+        for node in nodes:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                visit(node.body, locked or held_by(node))
+                continue
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                       ast.ClassDef)
+            ):
+                continue  # nested scopes have their own call discipline
+            mut = _mutation_of(node, bound)
+            if mut is not None:
+                attr, verb = mut
+                if findings is None:
+                    if locked:
+                        guarded.add(attr)
+                elif not locked and attr in guarded:
+                    findings.append(Finding(
+                        node.lineno, "J004",
+                        f"public method {verb} `self.{attr}` outside "
+                        f"`with self.{'/'.join(sorted(locks))}:` — other "
+                        "methods mutate this attribute under the lock, so "
+                        "unlocked writes race them; take the lock or make "
+                        "the method private",
+                    ))
+            visit(ast.iter_child_nodes(node), locked)
+
+    visit(meth.body, False)
+
+
+def _mutation_of(node, bound: str) -> tuple[str, str] | None:
+    """(attr, verb) when `node` mutates `<bound>._x` state, else None.
+    Bare annotations (`self._x: int` with no value) declare, not write."""
+    attr = None
+    verb = None
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        if isinstance(node, ast.AnnAssign) and node.value is None:
+            return None
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            a = _self_underscore_target(t, bound)
+            if a:
+                attr, verb = a, "assigns"
+                break
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            a = _self_underscore_target(t, bound)
+            if a:
+                attr, verb = a, "deletes"
+                break
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in MUTATORS:
+        a = _self_underscore_target(node.func.value, bound)
+        if a:
+            attr, verb = a, f"mutates (.{node.func.attr})"
+    if attr is None or attr.endswith("lock"):
+        return None  # lazy lock creation is the lock's own lifecycle
+    return attr, verb
+
+
+def lint_file(path: Path) -> list[str]:
+    text = path.read_bytes().decode("utf-8", errors="replace")
+    lines = text.split("\n")
+    sup = Suppressions(lines)
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: J999 syntax error: {e.msg}"]
+
+    posix = path.as_posix()
+    is_hot = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in HOT_MODULES
+    )
+    in_dtype_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in DTYPE_MODULES
+    )
+
+    idx = JitIndex()
+    idx.visit(tree)
+    idx.finish()
+
+    findings: list[Finding] = []
+    for fn in idx.jit_defs:
+        _check_traced_body(fn, findings)
+    if is_hot:
+        _check_host_hot(tree, idx.jit_defs, findings)
+    _check_jit_call_sites(tree, idx.bare_jit_names, findings)
+    if in_dtype_scope:
+        _check_dtype(tree, findings)
+    _check_lock_discipline(tree, findings)
+
+    out = [
+        f"{path}:{ln}: J000 suppression missing reason (say why the "
+        "invariant is waived)"
+        for ln in sup.malformed
+    ]
+    for f in sorted(findings, key=lambda f: (f.lineno, f.code)):
+        if not sup.covers(f.lineno, f.code):
+            out.append(f"{path}:{f.lineno}: {f.code} {f.msg}")
+    return out
+
+
+def main() -> None:
+    # tests/ are deliberately out of the default roots: test corpora seed
+    # the very defects this gate rejects (tests/test_jaxlint.py)
+    roots = sys.argv[1:] or [
+        "horaedb_tpu", "benchmarks", "tools",
+        "bench.py", "__graft_entry__.py",
+    ]
+    files = iter_py_files(roots)
+    all_findings: list[str] = []
+    for f in files:
+        all_findings.extend(lint_file(f))
+    for line in all_findings:
+        print(line)
+    n = len(all_findings)
+    print(f"jaxlint: {n} finding(s) in {len(files)} files")
+    raise SystemExit(min(n, 125))
+
+
+if __name__ == "__main__":
+    main()
