@@ -1,6 +1,6 @@
 # Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
 
-.PHONY: test native bench baselines serve lint jaxlint typecheck clean soak dryruns tpu-suite
+.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics clean soak dryruns tpu-suite
 
 test:
 	python -m pytest tests/ -x -q
@@ -26,14 +26,23 @@ lint:
 	python tools/lint.py
 	$(MAKE) jaxlint
 	$(MAKE) typecheck
+	$(MAKE) smoke-metrics
 
 # Domain-aware gate (tools/jaxlint.py): host-sync on hot paths (J001),
 # retrace hazards under jit (J002), dtype drift in engine code (J003),
-# lock discipline on the concurrency surface (J004). Findings print as
-# path:line: CODE message. Rules + suppression syntax:
-# docs/static-analysis.md
+# lock discipline on the concurrency surface (J004), host timers/spans
+# inside jit bodies (J005). Findings print as path:line: CODE message.
+# Rules + suppression syntax: docs/static-analysis.md
 jaxlint:
 	python tools/jaxlint.py
+
+# Observability gate: boot the server against the in-process fake S3,
+# push one remote-write batch, run one query, and fail if any /metrics
+# line violates the Prometheus text exposition format
+# (tools/promcheck.py) or an expected family / the trace round-trip is
+# missing (tools/smoke_metrics.py).
+smoke-metrics:
+	JAX_PLATFORMS=cpu python tools/smoke_metrics.py
 
 # mypy over the annotated core (config in pyproject.toml [tool.mypy]); the
 # dev image has no mypy, so this degrades to a loud skip locally — CI

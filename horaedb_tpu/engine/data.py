@@ -10,17 +10,39 @@ predicates followed by on-device aggregation.
 
 from __future__ import annotations
 
+import logging
 import os
 
 import numpy as np
 import pyarrow as pa
 
+from horaedb_tpu.common import tracing
 from horaedb_tpu.common.aio import TaskGroup
 from horaedb_tpu.engine.tables import DATA_SCHEMA
 from horaedb_tpu.ops import aggregate as agg_ops
 from horaedb_tpu.ops import filter as F
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
 from horaedb_tpu.storage.read import ScanRequest, WriteRequest
 from horaedb_tpu.storage.types import TimeRange
+
+logger = logging.getLogger(__name__)
+
+FLUSH_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_ingest_flush_seconds",
+    help="One buffered-ingest write-out (snapshot detach -> SSTs durable), "
+         "by table root (region-qualified on regioned deployments).",
+    labelnames=("table",),
+)
+FLUSH_ROWS = GLOBAL_METRICS.counter(
+    "horaedb_ingest_flush_rows_total",
+    help="Rows made durable by ingest flush write-outs.",
+    labelnames=("table",),
+)
+FLUSH_FAILURES = GLOBAL_METRICS.counter(
+    "horaedb_ingest_flush_failures_total",
+    help="Failed write-outs (rows re-buffered for retry).",
+    labelnames=("table",),
+)
 
 
 # Above this series cardinality the dense pushdown grid (num_series x
@@ -42,6 +64,13 @@ class SampleManager:
     def __init__(self, storage, segment_duration_ms: int, buffer_rows: int = 0):
         self._storage = storage
         self._segment_duration = segment_duration_ms
+        # Observability identity: the storage root is region-qualified
+        # ("metrics/region-0/data") so flush logs/metrics name the region.
+        self._table_id = getattr(storage, "_root", None) or "data"
+        # pre-register the flush families' children so /metrics exposes
+        # them (zero state) before the first write-out
+        for fam in (FLUSH_SECONDS, FLUSH_ROWS, FLUSH_FAILURES):
+            fam.labels(self._table_id)
         # Opt-in ingest buffering (the RFC's own data-table design batches
         # many samples per stored row, docs/rfcs/20240827-metric-engine.md
         # :218-232): rows accumulate per segment and flush as ONE storage
@@ -143,7 +172,6 @@ class SampleManager:
         _writeout_once), so an unawaited task never warns; barriers that DO
         gather it still observe the exception object."""
         import asyncio
-        import logging
 
         t = asyncio.create_task(self._writeout_once(), name="ingest-flush")
         self._inflight.add(t)
@@ -151,9 +179,9 @@ class SampleManager:
         def _done(task: "asyncio.Task") -> None:
             self._inflight.discard(task)
             if not task.cancelled() and task.exception() is not None:
-                logging.getLogger(__name__).error(
-                    "ingest write-out failed; rows re-buffered",
-                    exc_info=task.exception(),
+                logger.error(
+                    "ingest write-out failed (table=%s); rows re-buffered",
+                    self._table_id, exc_info=task.exception(),
                 )
 
         t.add_done_callback(_done)
@@ -268,6 +296,18 @@ class SampleManager:
             failed = []
 
     async def _writeout_once(self) -> None:
+        """One write-out attempt, timed and traced (logic in
+        _writeout_impl; this wrapper owns the flush observability so every
+        caller — background task, flush barrier, retry loop — reports)."""
+        with tracing.span("ingest_flush", table=self._table_id):
+            try:
+                with FLUSH_SECONDS.labels(self._table_id).time():
+                    await self._writeout_impl()
+            except BaseException:
+                FLUSH_FAILURES.labels(self._table_id).inc()
+                raise
+
+    async def _writeout_impl(self) -> None:
         """Write out one snapshot of the buffers (one storage write per
         segment shard).
 
@@ -351,6 +391,11 @@ class SampleManager:
             raise
         if accum_lanes is not None:
             await self._flush_accum_lanes(*accum_lanes, seq=snap_seq)
+        FLUSH_ROWS.labels(self._table_id).inc(
+            snapshot_rows
+            + sum(len(lanes[2]) for _seq, _seg, lanes, _ps in rebuf)
+            + (len(accum_lanes[2]) if accum_lanes is not None else 0)
+        )
 
     # A flush larger than this splits into contiguous pk-range shards
     # written as independent SSTs concurrently: parquet encode (GIL-free)

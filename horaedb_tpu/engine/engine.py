@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.engine import tables
@@ -346,38 +347,47 @@ class MetricEngine:
 
         from horaedb_tpu.ingest import ParserPool
 
+        from horaedb_tpu.ingest.pooled_parser import PARSE_SECONDS
+
         if self._pool is None:
             self._pool = ParserPool()
         if not self.sample_mgr.native_accum_active:
             parsed = await self._pool.decode(payload)
-            return await self.write_parsed(parsed)
+            with tracing.span("append", samples=parsed.n_samples):
+                return await self.write_parsed(parsed)
         from horaedb_tpu.ingest.native import NativeParser
 
         total = 0
         async with self._pool.borrow() as parser:
             if not isinstance(parser, NativeParser):
-                parsed = await asyncio.to_thread(parser.parse, payload)
-                return await self.write_parsed(parsed)
+                with tracing.span("parse", bytes=len(payload)), \
+                        PARSE_SECONDS.time():
+                    parsed = await asyncio.to_thread(parser.parse, payload)
+                with tracing.span("append", samples=parsed.n_samples):
+                    return await self.write_parsed(parsed)
             # small payloads parse inline: the native parse runs ~1 GB/s, so
             # a sub-256KB payload blocks the loop far less than a thread
             # handoff costs (~100us)
-            if len(payload) <= 256 * 1024:
-                req = parser.parse_light(payload)
-            else:
-                req = await asyncio.to_thread(parser.parse_light, payload)
+            with tracing.span("parse", bytes=len(payload)), \
+                    PARSE_SECONDS.time():
+                if len(payload) <= 256 * 1024:
+                    req = parser.parse_light(payload)
+                else:
+                    req = await asyncio.to_thread(parser.parse_light, payload)
             if len(req.meta_type):
                 self._record_metadata(req)
             if req.n_series == 0:
                 return 0
-            metric_arr, tsid_arr = await self._resolve_ids_fast(req)
-            if req.n_samples and self.sample_mgr.backlogged:
-                # backlog cap BEFORE buffering: drain synchronously so a
-                # storage outage rejects this payload un-buffered (5xx ->
-                # sender retries later) instead of acking rows into an
-                # unbounded buffer on every retry
-                await self.sample_mgr.flush()
-            if req.n_samples:
-                total = self.sample_mgr.buffer_native_add(parser)
+            with tracing.span("append", samples=req.n_samples):
+                metric_arr, tsid_arr = await self._resolve_ids_fast(req)
+                if req.n_samples and self.sample_mgr.backlogged:
+                    # backlog cap BEFORE buffering: drain synchronously so a
+                    # storage outage rejects this payload un-buffered (5xx ->
+                    # sender retries later) instead of acking rows into an
+                    # unbounded buffer on every retry
+                    await self.sample_mgr.flush()
+                if req.n_samples:
+                    total = self.sample_mgr.buffer_native_add(parser)
         if len(req.exemplar_value):
             await self._persist_exemplars(req, metric_arr, tsid_arr)
         if total and self.sample_mgr.should_flush(total):

@@ -10,11 +10,24 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from horaedb_tpu.common import tracing
 from horaedb_tpu.ingest.types import ParsedWriteRequest
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
 
 logger = logging.getLogger(__name__)
 
 POOL_SIZE = 64
+
+PARSE_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_ingest_parse_seconds",
+    help="Remote-write wire decode time (the ingest parse lane), including "
+         "any worker-thread handoff for large payloads.",
+)
+POOL_WAIT_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_ingest_pool_wait_seconds",
+    help="Time spent waiting for a parser arena; sustained non-zero tail "
+         "means POOL_SIZE is the ingest bottleneck.",
+)
 
 
 def _new_backend():
@@ -51,7 +64,9 @@ class ParserPool:
         async with self.borrow() as parser:
             # native parse releases no GIL-bound state we await on; run in a
             # thread so large payloads don't stall the event loop
-            return await asyncio.to_thread(parser.parse, payload)
+            with tracing.span("parse", bytes=len(payload)), \
+                    PARSE_SECONDS.time():
+                return await asyncio.to_thread(parser.parse, payload)
 
     def borrow(self):
         """Async context manager lending a parser backend for multi-call use
@@ -79,7 +94,8 @@ class _Borrow:
         pool = self._pool
         pool._waiting += 1
         try:
-            await pool._sem.acquire()
+            with POOL_WAIT_SECONDS.time():
+                await pool._sem.acquire()
         finally:
             pool._waiting -= 1
         pool._in_use += 1

@@ -23,6 +23,25 @@ from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.jaxcompat import shard_map
 from horaedb_tpu.ops import filter as filter_ops
 from horaedb_tpu.ops.filter import Predicate
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+H2D_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_h2d_transfer_seconds",
+    help="Host->device placement time per sharded-scan input batch "
+         "(dispatch only unless a scanstats collector fences transfers).",
+)
+H2D_BYTES = GLOBAL_METRICS.counter(
+    "horaedb_h2d_transfer_bytes_total",
+    help="Bytes placed onto the mesh by sharded scans.",
+)
+# same family storage/read.py registers (registration is idempotent): the
+# mesh downsample is a distinct "sharded" route entry point
+SCAN_PATH = GLOBAL_METRICS.counter(
+    "horaedb_scan_path_total",
+    help="Merge route the scan planner took (host SIMD, single-device "
+         "kernel, or the cross-chip sharded merge).",
+    labelnames=("path",),
+)
 
 
 def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
@@ -188,6 +207,7 @@ def sharded_downsample(
 ):
     """One-shot wrapper: splits predicate literals so repeat queries with new
     constants reuse the memoized executable."""
+    SCAN_PATH.labels("sharded").inc()
     template, literals = filter_ops.split_literals(predicate)
     fn = build_sharded_downsample(
         mesh, num_series, num_buckets, template, with_minmax, sorted_input
@@ -279,19 +299,41 @@ def sharded_grouped_stats(
 
 def shard_rows(mesh: Mesh, arrays: tuple, pad_value=0):
     """Place 1-D host arrays onto the mesh row-sharded (pads to a multiple of
-    the rows axis; returns (device_arrays, valid_mask))."""
+    the rows axis; returns (device_arrays, valid_mask)). Placement is timed
+    into `horaedb_h2d_transfer_seconds` — the transfer lane VERDICT r02
+    found dominating "kernel-bound" configs; when a scanstats collector is
+    attached the puts are fenced so the histogram carries true transfer
+    time, not just dispatch."""
+    import time
+
     import numpy as np
+
+    from horaedb_tpu.storage import scanstats
 
     rows_par = mesh.shape["rows"]
     n = len(arrays[0])
     pad = (-n) % rows_par
-    out = []
     sharding = NamedSharding(mesh, P("rows"))
+    # pad on host BEFORE the timer: the concatenate is host_prep work and
+    # must not inflate the transfer lane (the exact misattribution the
+    # histogram exists to prevent)
+    padded = []
+    nbytes = 0
     for a in arrays:
         if pad:
             a = np.concatenate([a, np.full(pad, pad_value, dtype=a.dtype)])
-        out.append(jax.device_put(a, sharding))
+        padded.append(a)
+        nbytes += a.nbytes
     valid = np.ones(n + pad, dtype=bool)
     if pad:
         valid[n:] = False
-    return tuple(out), jax.device_put(valid, sharding)
+    t0 = time.perf_counter()
+    out = [jax.device_put(a, sharding) for a in padded]
+    valid_dev = jax.device_put(valid, sharding)
+    if scanstats.active():  # fence only for attribution (production path
+        # stays async so H2D overlaps kernel dispatch)
+        # jaxlint: disable=J001 h2d attribution fence; profiling runs only
+        jax.block_until_ready(out + [valid_dev])
+    H2D_SECONDS.observe(time.perf_counter() - t0)
+    H2D_BYTES.inc(nbytes + valid.nbytes)
+    return tuple(out), valid_dev

@@ -31,6 +31,7 @@ except ImportError:  # 3.10 images ship the API-identical backport
     import tomli as tomllib
 from dataclasses import dataclass, field
 
+from horaedb_tpu.common import tracing as _tracing_mod
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.time_ext import ReadableDuration
 from horaedb_tpu.objstore.s3 import HttpOptions, S3LikeConfig, TimeoutOptions
@@ -146,10 +147,40 @@ class MetricEngineConfig:
 
 
 @dataclass
+class TracingConfig:
+    """Request tracing knobs (common/tracing.py). Field defaults come from
+    the HORAEDB_TRACE_* env vars (via tracing.env_defaults), so build_app
+    applying this config never clobbers an env override the operator set
+    without a [tracing] section; an explicit config value wins over both."""
+
+    # Sample rate in [0, 1]: 1 traces every request, 0 disables tracing
+    # entirely (span() collapses to one contextvar get — the overhead
+    # budget the bench acceptance bar holds).
+    sample: float = field(
+        default_factory=lambda: _tracing_mod.env_defaults()[0]
+    )
+    # Traces slower than this log a WARNING with the trace id.
+    slow_threshold: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.millis(
+            int(_tracing_mod.env_defaults()[1] * 1000)
+        )
+    )
+    # Bounded in-memory ring of recent traces served at /debug/traces.
+    ring_capacity: int = field(
+        default_factory=lambda: _tracing_mod.env_defaults()[2]
+    )
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "TracingConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
 class Config:
     port: int = 5000
     test: TestConfig = field(default_factory=TestConfig)
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "Config":
@@ -165,6 +196,14 @@ class Config:
             return cls.from_dict(tomllib.load(f))
 
     def validate(self) -> None:
+        ensure(
+            0.0 <= self.tracing.sample <= 1.0,
+            f"tracing.sample must be in [0, 1], got {self.tracing.sample}",
+        )
+        ensure(
+            self.tracing.ring_capacity > 0,
+            "tracing.ring_capacity must be positive",
+        )
         store = self.metric_engine.storage.object_store
         kind = store.type.lower()
         ensure(

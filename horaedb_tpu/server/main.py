@@ -10,6 +10,9 @@ num_primary_keys=3, main.rs:178-185), the optional self-write load generator
     GET  /toggle           flip the load generator (main.rs:59-80)
     GET  /compact          manual compaction trigger
     GET  /metrics          Prometheus text metrics (beyond the reference)
+    GET  /debug/traces     recent request traces; /debug/traces/{id} is the
+                           span tree for the X-Horaedb-Trace-Id a query
+                           response echoed (common/tracing.py)
 
 plus the ingest/query endpoints the reference defines but never wired
 (remote_write "NOT yet wired into server", SURVEY L5):
@@ -31,11 +34,13 @@ import argparse
 import asyncio
 import logging
 import sys
+import time
 
 import numpy as np
 import pyarrow as pa
 from aiohttp import web
 
+from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import HoraeError
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.engine import MetricEngine, QueryRequest
@@ -50,6 +55,59 @@ from horaedb_tpu.storage.types import TimeRange
 logger = logging.getLogger("horaedb_tpu.server")
 
 STATE_KEY = web.AppKey("state", object)
+
+TRACE_HEADER = "X-Horaedb-Trace-Id"
+
+HTTP_SECONDS = METRICS.histogram(
+    "horaedb_http_request_seconds",
+    help="HTTP request latency by route template and method.",
+    labelnames=("endpoint", "method"),
+)
+HTTP_REQUESTS = METRICS.counter(
+    "horaedb_http_requests_total",
+    help="HTTP requests by route template, method, and status.",
+    labelnames=("endpoint", "method", "status"),
+)
+INGEST_BATCH_SAMPLES = METRICS.histogram(
+    "horaedb_remote_write_batch_samples",
+    help="Samples per accepted remote-write request.",
+    buckets=(1.0, 10.0, 100.0, 1000.0, 10_000.0, 100_000.0, 1_000_000.0),
+)
+
+
+@web.middleware
+async def observability_middleware(request: web.Request, handler):
+    """Every request (except the observability surfaces themselves) gets a
+    trace (subject to sampling) and a latency histogram sample; the trace
+    id is echoed in the X-Horaedb-Trace-Id response header so a caller can
+    fetch its span tree from /debug/traces/{id}."""
+    resource = request.match_info.route.resource
+    endpoint = resource.canonical if resource is not None else "unmatched"
+    if request.path.startswith(("/metrics", "/debug")):
+        return await handler(request)
+    t0 = time.perf_counter()
+    status = 500
+    with tracing.trace(
+        f"{request.method} {endpoint}", method=request.method,
+        path=request.path,
+    ) as t:
+        try:
+            resp = await handler(request)
+            status = resp.status
+        except web.HTTPException as e:
+            status = e.status
+            if t is not None:
+                e.headers[TRACE_HEADER] = t.trace_id
+            raise
+        finally:
+            tracing.add_attr(status=status)
+            HTTP_SECONDS.labels(endpoint, request.method).observe(
+                time.perf_counter() - t0
+            )
+            HTTP_REQUESTS.labels(endpoint, request.method, str(status)).inc()
+    if t is not None:
+        resp.headers[TRACE_HEADER] = t.trace_id
+    return resp
 
 
 def init_logging() -> None:
@@ -221,11 +279,13 @@ async def handle_remote_write(request: web.Request) -> web.Response:
     body = await request.read()
     if request.headers.get("Content-Encoding", "").lower() == "snappy":
         try:
-            body = snappy_decompress(body)
+            with tracing.span("snappy_decompress", bytes=len(body)):
+                body = snappy_decompress(body)
         except Exception:  # noqa: BLE001
             return web.json_response({"error": "bad snappy payload"}, status=400)
     try:
-        n = await state.engine.write_payload(body)
+        with tracing.span("ingest", bytes=len(body)):
+            n = await state.engine.write_payload(body)
     except HoraeError as e:
         # client-shaped errors (malformed wire bytes, missing __name__)
         # stay 4xx
@@ -241,6 +301,7 @@ async def handle_remote_write(request: web.Request) -> web.Response:
         return web.json_response({"error": str(e)}, status=500)
     METRICS.inc("horaedb_remote_write_requests_total")
     METRICS.inc("horaedb_remote_write_samples_total", n)
+    INGEST_BATCH_SAMPLES.observe(n)
     return web.json_response({"samples": n}, status=200)
 
 
@@ -575,6 +636,30 @@ async def handle_label_values(request: web.Request) -> web.Response:
         return _promql_error(e)
 
 
+async def handle_debug_traces(request: web.Request) -> web.Response:
+    """Recent traces, newest first (summaries; span trees via /{id})."""
+    try:
+        limit = int(request.query.get("limit", 50))
+    except ValueError:
+        return web.json_response({"error": "limit must be an int"}, status=400)
+    return web.json_response({
+        "sampling": tracing.sampling_enabled(),
+        "traces": tracing.recent(limit),
+    })
+
+
+async def handle_debug_trace(request: web.Request) -> web.Response:
+    """One trace's span tree by id (the X-Horaedb-Trace-Id header value)."""
+    t = tracing.get(request.match_info["id"])
+    if t is None:
+        return web.json_response(
+            {"error": "unknown trace id (evicted from the ring, or never "
+                      "sampled)"},
+            status=404,
+        )
+    return web.json_response(t)
+
+
 async def handle_buildinfo(request: web.Request) -> web.Response:
     """Minimal Prometheus buildinfo (datasource health checks probe it)."""
     return web.json_response({
@@ -757,7 +842,8 @@ async def build_app(config: Config) -> web.Application:
             while True:
                 await asyncio.sleep(interval)
                 try:
-                    await engine.flush()
+                    with tracing.trace("periodic_ingest_flush"):
+                        await engine.flush()
                 except Exception:  # noqa: BLE001 — keep flushing; writes retry
                     logger.exception("periodic ingest flush failed")
 
@@ -765,7 +851,15 @@ async def build_app(config: Config) -> web.Application:
             asyncio.create_task(flush_loop(), name="ingest-flush")
         )
 
-    app = web.Application(client_max_size=64 * 1024 * 1024)
+    tracing.configure(
+        sample=config.tracing.sample,
+        slow_s=config.tracing.slow_threshold.seconds,
+        ring=config.tracing.ring_capacity,
+    )
+    app = web.Application(
+        client_max_size=64 * 1024 * 1024,
+        middlewares=[observability_middleware],
+    )
     app[STATE_KEY] = state
     app.add_routes(
         [
@@ -787,6 +881,8 @@ async def build_app(config: Config) -> web.Application:
             web.get("/api/v1/series", handle_series),
             web.get("/api/v1/metadata", handle_metadata),
             web.get("/api/v1/status/buildinfo", handle_buildinfo),
+            web.get("/debug/traces", handle_debug_traces),
+            web.get("/debug/traces/{id}", handle_debug_trace),
         ]
     )
 

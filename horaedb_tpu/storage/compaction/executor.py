@@ -21,13 +21,31 @@ import logging
 
 import pyarrow as pa
 
+from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import ensure
+from horaedb_tpu.server.metrics import BYTES_BUCKETS, GLOBAL_METRICS
 from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.compaction import Task
 from horaedb_tpu.storage.sst import FileMeta, SstFile, allocate_id
 from horaedb_tpu.storage.types import TimeRange
 
 logger = logging.getLogger(__name__)
+
+COMPACTION_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_compaction_seconds",
+    help="One compaction task end to end (read inputs, device merge, "
+         "encode shards, manifest commit, physical deletes).",
+)
+COMPACTION_BYTES = GLOBAL_METRICS.histogram(
+    "horaedb_compaction_bytes",
+    help="Input bytes per compaction task (the admitted task's SST sizes).",
+    buckets=BYTES_BUCKETS,
+)
+COMPACTIONS = GLOBAL_METRICS.counter(
+    "horaedb_compactions_total",
+    help="Completed compaction tasks by result.",
+    labelnames=("result",),
+)
 
 
 class Executor:
@@ -88,11 +106,17 @@ class Executor:
     def submit(self, task: Task) -> asyncio.Task:
         async def _run() -> None:
             try:
-                await self.do_compaction(task)
+                with tracing.trace(
+                    "compaction", inputs=len(task.inputs),
+                    input_bytes=task.input_size(),
+                ), COMPACTION_SECONDS.time():
+                    await self.do_compaction(task)
             except Exception:  # noqa: BLE001
                 logger.exception("Do compaction failed")
+                COMPACTIONS.labels("error").inc()
                 self.on_failure(task)
             else:
+                COMPACTIONS.labels("ok").inc()
                 self.on_success(task)
 
         t = asyncio.create_task(_run(), name="compaction-task")
@@ -109,6 +133,7 @@ class Executor:
     async def do_compaction(self, task: Task) -> None:
         self.pre_check(task)
         self._trigger_more_task(task.scope)
+        COMPACTION_BYTES.observe(task.input_size())
         logger.debug("Start do compaction, input_len=%d", len(task.inputs))
 
         time_range = TimeRange.union_of([f.meta.time_range for f in task.inputs])

@@ -15,6 +15,7 @@ import asyncio
 import logging
 
 from horaedb_tpu.common.time_ext import now_ms
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
 from horaedb_tpu.storage.compaction import Task
 from horaedb_tpu.storage.compaction.executor import Executor
 from horaedb_tpu.storage.compaction.picker import TimeWindowCompactionStrategy
@@ -22,6 +23,17 @@ from horaedb_tpu.storage.config import SchedulerConfig
 from horaedb_tpu.storage.types import TimeRange  # noqa: F401 — annotations
 
 logger = logging.getLogger(__name__)
+
+QUEUE_DEPTH = GLOBAL_METRICS.gauge(
+    "horaedb_compaction_queue_depth",
+    help="Compaction tasks picked but not yet handed to the executor "
+         "(sustained depth means picking outpaces compaction bandwidth).",
+)
+PICKS = GLOBAL_METRICS.counter(
+    "horaedb_compaction_picks_total",
+    help="Picker outcomes per pick attempt.",
+    labelnames=("outcome",),
+)
 
 
 class CompactionScheduler:
@@ -111,14 +123,18 @@ class CompactionScheduler:
         if task is not None:
             task.scope = time_range
         if task is None:
+            PICKS.labels("empty").inc()
             return False
         try:
             self._tasks.put_nowait(task)
+            PICKS.labels("queued").inc()
+            QUEUE_DEPTH.set(self._tasks.qsize())
             return True
         except asyncio.QueueFull:
             # Task queue full: unmark so a later pick retries these files
             # (no memory to release — reservation happens in pre_check).
             logger.warning("compaction task queue full; dropping pick")
+            PICKS.labels("dropped_full").inc()
             for f in task.inputs + task.expireds:
                 f.unmark_compaction()
             return False
@@ -126,4 +142,5 @@ class CompactionScheduler:
     async def _recv_task_loop(self) -> None:
         while True:
             task = await self._tasks.get()
+            QUEUE_DEPTH.set(self._tasks.qsize())
             self.executor.submit(task)

@@ -42,8 +42,10 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import HoraeError, ensure
 from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
 from horaedb_tpu.ops import dedup as dedup_ops
 from horaedb_tpu.ops import filter as filter_ops
 from horaedb_tpu.ops.blocks import PACK_SENTINEL, Block, arrow_column_to_numpy
@@ -62,6 +64,17 @@ from horaedb_tpu.storage.types import (
 logger = logging.getLogger(__name__)
 
 DEFAULT_SCAN_BATCH_SIZE = 8192
+
+SCAN_PATH = GLOBAL_METRICS.counter(
+    "horaedb_scan_path_total",
+    help="Merge route the scan planner took (host SIMD, single-device "
+         "kernel, or the cross-chip sharded merge).",
+    labelnames=("path",),
+)
+# pre-register so the route split is visible on /metrics from boot
+for _p in ("host", "device", "sharded"):
+    SCAN_PATH.labels(_p)
+del _p
 
 
 def _is_binary_like(t: pa.DataType) -> bool:
@@ -575,6 +588,7 @@ def _plan_and_merge(
 
     def host_merge(mask: np.ndarray | None) -> np.ndarray:
         scanstats.note("path_host_merge")
+        SCAN_PATH.labels("host").inc()
         sel_rows = int(np.count_nonzero(mask)) if mask is not None else n
         t0 = time.perf_counter()
         with scanstats.stage("host_merge"):
@@ -648,12 +662,14 @@ def _plan_and_merge(
             from horaedb_tpu.parallel.merge import sharded_packed_merge
 
             scanstats.note("path_device_merge_sharded")
+            SCAN_PATH.labels("sharded").inc()
             with scanstats.stage("device_merge"):
                 res = sharded_packed_merge(
                     packed, seq_width, do_dedup, mesh, defer=defer_device
                 )
             return res
         scanstats.note("path_device_merge_packed")
+        SCAN_PATH.labels("device").inc()
         with scanstats.stage("h2d"):
             block = Block.from_numpy({"__packed__": packed},
                                      pad_keys=("__packed__",))
@@ -684,6 +700,7 @@ def _plan_and_merge(
             if packed_res is not None:
                 return packed_res
         scanstats.note("path_device_merge")
+        SCAN_PATH.labels("device").inc()
         need = list(sort_keys)
         if mask is None:
             need += [c for c in sorted(pred_cols) if c not in need]
@@ -1161,6 +1178,27 @@ class ParquetReader:
         batch_size: int = DEFAULT_SCAN_BATCH_SIZE,
         use_block_cache: bool = True,
     ) -> list[pa.RecordBatch]:
+        """Traced entry point of the per-segment pipeline: the span anchors
+        the per-stage lane timings (scanstats bridges every stage() into the
+        active span's `stages` attr) for /debug/traces."""
+        with tracing.span(
+            "scan_segment", ssts=len(ssts),
+            rows=sum(s.meta.num_rows for s in ssts),
+        ):
+            return await self._scan_segment(
+                ssts, predicate, projections, keep_builtin, batch_size,
+                use_block_cache,
+            )
+
+    async def _scan_segment(
+        self,
+        ssts: list[SstFile],
+        predicate: Predicate | None,
+        projections: list[int] | None,
+        keep_builtin: bool,
+        batch_size: int = DEFAULT_SCAN_BATCH_SIZE,
+        use_block_cache: bool = True,
+    ) -> list[pa.RecordBatch]:
         """The fused device pipeline for one time segment.
 
         Segments whose SSTs exceed `scan_block_rows` in total take the
@@ -1428,7 +1466,8 @@ class ParquetReader:
         }
         if extra_arrays:
             arrays.update(extra_arrays)
-        block = Block.from_numpy(arrays, pad_keys=sort_keys)
+        with scanstats.stage("h2d"):
+            block = Block.from_numpy(arrays, pad_keys=sort_keys)
 
         template, raw_literals = filter_ops.split_literals(predicate)
         literals = filter_ops.literal_arrays(
@@ -1439,9 +1478,10 @@ class ParquetReader:
             tuple(block.names), sort_keys, pk_names, template, do_dedup,
             presorted=_rows_presorted(arrays, sort_keys),
         )
-        sorted_cols, perm, keep, starts, kept = kernel(
-            block.columns, literals, block.num_valid
-        )
+        with scanstats.stage("device_merge"):
+            sorted_cols, perm, keep, starts, kept = kernel(
+                block.columns, literals, block.num_valid
+            )
         return sorted_cols, perm, keep, starts, kept, numeric_names, binary_names
 
     async def _scan_segment_chunked(
@@ -1669,16 +1709,20 @@ class ParquetReader:
             `valid_np` excludes rows via the reduction's weight column
             (sid_np must stay monotone for excluded rows too)."""
             if mesh is not None:
-                out = self._sharded_accumulate(
-                    mesh, ts_np, sid_np, val_np, t0, bucket_ms,
-                    num_series, num_buckets, with_minmax, valid_np=valid_np,
-                )
+                # path counter rides sharded_downsample (one inc per fold)
+                with scanstats.stage("device_agg"):
+                    out = self._sharded_accumulate(
+                        mesh, ts_np, sid_np, val_np, t0, bucket_ms,
+                        num_series, num_buckets, with_minmax, valid_np=valid_np,
+                    )
             else:
-                out = agg_ops.downsample_sorted(
-                    ts_np, sid_np, val_np, t0, bucket_ms,
-                    num_series=num_series, num_buckets=num_buckets,
-                    with_minmax=with_minmax, valid=valid_np,
-                )
+                SCAN_PATH.labels("device").inc()
+                with scanstats.stage("device_agg"):
+                    out = agg_ops.downsample_sorted(
+                        ts_np, sid_np, val_np, t0, bucket_ms,
+                        num_series=num_series, num_buckets=num_buckets,
+                        with_minmax=with_minmax, valid=valid_np,
+                    )
             grids["sum"] += np.asarray(out["sum"])
             grids["count"] += np.asarray(out["count"])
             if with_minmax:
@@ -1703,21 +1747,23 @@ class ParquetReader:
             return grids
 
         read_names = self._resolve_read_names(None, False)
-        tables = await asyncio.gather(
-            *(self.read_sst(s, read_names, predicate,
-               use_block_cache=use_block_cache) for s in ssts)
-        )
+        with scanstats.stage("io_decode"):
+            tables = await asyncio.gather(
+                *(self.read_sst(s, read_names, predicate,
+                   use_block_cache=use_block_cache) for s in ssts)
+            )
         tables = [t for t in tables if t.num_rows > 0]
         if not tables:
             return grids
-        tables = _order_tables_by_first_key(
-            tables,
-            tuple(self._schema.primary_key_names) + (SEQ_COLUMN_NAME,),
-        )
-        table = pa.concat_tables(tables).combine_chunks()
-        sid, sid_hit = dense_sid(
-            arrow_column_to_numpy(table.column(series_column).combine_chunks())
-        )
+        with scanstats.stage("host_prep"):
+            tables = _order_tables_by_first_key(
+                tables,
+                tuple(self._schema.primary_key_names) + (SEQ_COLUMN_NAME,),
+            )
+            table = pa.concat_tables(tables).combine_chunks()
+            sid, sid_hit = dense_sid(
+                arrow_column_to_numpy(table.column(series_column).combine_chunks())
+            )
 
         fast = (
             self._packed_downsample_pass(table, predicate, sid, sid_hit,

@@ -15,7 +15,12 @@ Usage:
 
 The collector is a contextvar, so concurrent asyncio tasks spawned inside the
 block attribute into the same collector without threading it through every
-call. Overhead when no collector is active: one contextvar get per stage.
+call. Every stage ALSO feeds the process-wide
+`horaedb_scan_stage_seconds{stage=...}` histogram (server/metrics.py) and the
+active trace span (common/tracing.py), so lane attribution is continuous on
+/metrics — not just inside ad-hoc scan_stats() blocks. Overhead per stage:
+two perf_counter calls + one histogram observe, against stage bodies that
+decode whole segments or dispatch device kernels.
 Stage sums can exceed wall clock (stages from concurrent SST reads overlap).
 """
 
@@ -25,6 +30,34 @@ import time
 from contextlib import contextmanager
 from contextvars import ContextVar
 from dataclasses import dataclass, field
+
+from horaedb_tpu.common import tracing
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+# Canonical lane names for the /metrics histogram: the raw stage names are
+# scan-internal (h2d/d2h/device_merge), but operators reason in the three
+# lanes VERDICT r02 established — IO+decode, host<->device transfer, XLA
+# kernel. Stages outside the map keep their own label (host_merge,
+# host_filter, materialize, encode, ...).
+_STAGE_LANE = {
+    "h2d": "transfer",
+    "d2h": "transfer",
+    "device_merge": "kernel",
+    "device_agg": "kernel",
+}
+
+STAGE_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_scan_stage_seconds",
+    help="Per-stage scan time by lane (io_decode, host_prep, transfer, "
+         "kernel, ...): the request-attribution view of scanstats.",
+    labelnames=("stage",),
+)
+# Pre-register the canonical lanes so /metrics always exposes the full
+# attribution surface (zero-count histograms), even before the first scan
+# routes through a given lane on this process.
+for _lane in ("io_decode", "host_prep", "transfer", "kernel"):
+    STAGE_SECONDS.labels(_lane)
+del _lane
 
 
 @dataclass
@@ -61,16 +94,23 @@ def scan_stats():
 
 @contextmanager
 def stage(name: str):
-    """Time one stage into the active collector (no-op when none)."""
+    """Time one stage into (a) the active per-query collector when one is
+    attached, (b) the process-wide `horaedb_scan_stage_seconds{stage=...}`
+    histogram — ALWAYS, so lane attribution shows on /metrics without any
+    collector — and (c) the active trace span's `stages` attr. Stages wrap
+    chunky work (a segment's decode, one device merge), so the two
+    perf_counter calls + one histogram observe are noise next to the work
+    itself."""
     st = _ACTIVE.get()
-    if st is None:
-        yield
-        return
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        st.add(name, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if st is not None:
+            st.add(name, dt)
+        STAGE_SECONDS.labels(_STAGE_LANE.get(name, name)).observe(dt)
+        tracing.add_stage(name, dt)
 
 
 def active() -> bool:

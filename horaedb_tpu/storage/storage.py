@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import io
 import logging
+import time
 from abc import ABC, abstractmethod
 from typing import AsyncIterator
 
@@ -30,10 +31,12 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import HoraeError, context, ensure
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import sort as sort_ops
 from horaedb_tpu.ops.blocks import arrow_column_to_numpy
+from horaedb_tpu.server.metrics import BYTES_BUCKETS, GLOBAL_METRICS
 from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.manifest import Manifest
 from horaedb_tpu.storage.read import (
@@ -46,6 +49,30 @@ from horaedb_tpu.storage.sst import FileMeta, SstFile, SstPathGenerator, allocat
 from horaedb_tpu.storage.types import StorageSchema, Timestamp, WriteResult
 
 logger = logging.getLogger(__name__)
+
+WRITE_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_storage_write_seconds",
+    help="One storage write (sort + parquet encode + upload + manifest "
+         "commit), by table root.",
+    labelnames=("table",),
+)
+WRITE_ROWS = GLOBAL_METRICS.counter(
+    "horaedb_storage_write_rows_total",
+    help="Rows written to durable SSTs, by table root.",
+    labelnames=("table",),
+)
+SST_BYTES = GLOBAL_METRICS.histogram(
+    "horaedb_sst_bytes",
+    help="Encoded size of SST objects written (flush shards, compaction "
+         "outputs, direct writes).",
+    buckets=BYTES_BUCKETS,
+)
+SCAN_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_storage_scan_seconds",
+    help="One storage scan, first SST lookup to last batch yielded (early "
+         "consumer breaks count as completed scans), by table root.",
+    labelnames=("table",),
+)
 
 
 def jax_backend_is_cpu() -> bool:
@@ -219,17 +246,21 @@ class ObjectBasedStorage(ColumnarStorage):
                 f"time range of one write must fall in one segment, "
                 f"range: [{req.time_range.start}, {req.time_range.end})",
             )
-        result = await self.write_batch(
-            req.batch, presorted=req.presorted, seq=req.seq,
-            fast_encode=req.fast_encode,
-        )
-        meta = FileMeta(
-            max_sequence=result.seq,
-            num_rows=req.batch.num_rows,
-            size=result.size,
-            time_range=req.time_range,
-        )
-        await self._manifest.add_file(result.id, meta)
+        with tracing.span("storage_write", table=self._root,
+                          rows=req.batch.num_rows), \
+                WRITE_SECONDS.labels(self._root).time():
+            result = await self.write_batch(
+                req.batch, presorted=req.presorted, seq=req.seq,
+                fast_encode=req.fast_encode,
+            )
+            meta = FileMeta(
+                max_sequence=result.seq,
+                num_rows=req.batch.num_rows,
+                size=result.size,
+                time_range=req.time_range,
+            )
+            await self._manifest.add_file(result.id, meta)
+        WRITE_ROWS.labels(self._root).inc(req.batch.num_rows)
 
     async def _run_sst(self, fn, *args):
         """Run CPU-heavy SST work on the configured executor (ThreadConfig
@@ -423,6 +454,7 @@ class ObjectBasedStorage(ColumnarStorage):
             with context(f"write sst {path}"):
                 await self._store.put(path, blob)
             await self._write_bloom_sidecar(file_id, path, table)
+            SST_BYTES.observe(len(blob))
             return len(blob)
 
         q: _queue.Queue = _queue.Queue(maxsize=4)
@@ -509,6 +541,7 @@ class ObjectBasedStorage(ColumnarStorage):
                 done.wait(timeout=0.05)
 
         await self._write_bloom_sidecar(file_id, path, table)
+        SST_BYTES.observe(size)
         return size
 
     async def _write_bloom_sidecar(self, file_id: int, path: str, table) -> None:
@@ -543,6 +576,7 @@ class ObjectBasedStorage(ColumnarStorage):
         (bounded one-segment prefetch — the async analog of the reference's
         UnionExec driving per-segment plans concurrently); an early consumer
         break (limit pushdown) cancels the prefetch."""
+        t0 = time.perf_counter()
         ssts = self._manifest.find_ssts(req.range)
         if req.min_sst_id is not None:
             ssts = [s for s in ssts if s.id > req.min_sst_id]
@@ -576,6 +610,11 @@ class ObjectBasedStorage(ColumnarStorage):
                     await pending
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
+            # NOT a tracing span: an async generator's frame suspends across
+            # consumer turns, and a contextvar set inside it would leak into
+            # the consumer's context — the per-stage spans attach from
+            # scan_segment (a plain coroutine) instead
+            SCAN_SECONDS.labels(self._root).observe(time.perf_counter() - t0)
 
     async def scan_segment_retrying(self, seg_ssts, time_range, op, empty_result=None):
         """Run a per-segment scan `op`, refreshing the segment's SST list

@@ -75,6 +75,60 @@ class TestJaxlintGate:
         # clickable path:line: CODE shape (satellite: CI-friendly output)
         assert f"{bad}:9: J001" in r.stdout, r.stdout
 
+    def test_j005_timer_inside_jit_fires(self, tmp_path):
+        """scanstats.stage()/tracing spans opened inside a jit body time
+        the trace, not the kernel — J005, with the aliased and bare-import
+        forms covered."""
+        bad = hot_file(
+            tmp_path,
+            "import jax\n"
+            "from horaedb_tpu.common import tracing\n"
+            "from horaedb_tpu.storage import scanstats\n"
+            "from horaedb_tpu.storage.scanstats import stage\n"
+            "\n"
+            "@jax.jit\n"
+            "def kernel(x):\n"
+            "    with scanstats.stage('kernel'):\n"      # J005 dotted
+            "        y = x.sum()\n"
+            "    with tracing.span('merge'):\n"          # J005 tracing
+            "        y = y + 1\n"
+            "    with stage('again'):\n"                 # J005 bare import
+            "        return y\n"
+        )
+        r = run_jaxlint(bad)
+        assert r.returncode != 0
+        assert r.stdout.count("J005") == 3, r.stdout
+        assert f"{bad}:8: J005" in r.stdout, r.stdout
+
+    def test_j005_host_side_timers_pass(self, tmp_path):
+        """Timers at the kernel call boundary (host side) are the accepted
+        idiom — the rule must not fire on how the tree actually times
+        kernels, and a reasoned suppression works."""
+        ok = hot_file(
+            tmp_path,
+            "import jax\n"
+            "from horaedb_tpu.common import tracing\n"
+            "from horaedb_tpu.storage import scanstats\n"
+            "\n"
+            "@jax.jit\n"
+            "def kernel(x):\n"
+            "    return x.sum()\n"
+            "\n"
+            "def run(x):\n"
+            "    with scanstats.stage('device_merge'):\n"
+            "        out = kernel(x)\n"
+            "    with tracing.span('collect'):\n"
+            "        return out\n"
+            "\n"
+            "@jax.jit\n"
+            "def suppressed(x):\n"
+            "    # jaxlint: disable=J005 measured: trace-time probe only\n"
+            "    with scanstats.stage('trace_probe'):\n"
+            "        return x\n"
+        )
+        r = run_jaxlint(ok)
+        assert r.returncode == 0, r.stdout
+
     def test_no_false_positives_on_accepted_idioms(self, tmp_path):
         """The idioms this tree actually uses must pass unsuppressed:
         static_argnames jit kernels over shapes, host numpy outside jit,

@@ -1,0 +1,306 @@
+"""The metrics registry (server/metrics.py): typed families, label
+escaping, histogram bucket math, thread safety, the legacy string API,
+and agreement with the Prometheus text-format validator
+(tools/promcheck.py) that `make smoke-metrics` enforces on the live
+server."""
+
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from horaedb_tpu.server.metrics import (
+    DEFAULT_BUCKETS,
+    Metrics,
+    escape_label_value,
+)
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import promcheck  # noqa: E402
+
+
+class TestFamilies:
+    def test_counter_type_help_and_value(self):
+        m = Metrics()
+        c = m.counter("req_total", help="requests served")
+        c.inc()
+        c.inc(2.5)
+        out = m.render()
+        assert "# HELP req_total requests served" in out
+        assert "# TYPE req_total counter" in out
+        assert "req_total 3.5" in out
+
+    def test_gauge_set_inc_dec(self):
+        m = Metrics()
+        g = m.gauge("depth")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value == 4
+        assert "# TYPE depth gauge" in m.render()
+
+    def test_labeled_children_render_sorted(self):
+        m = Metrics()
+        c = m.counter("ops_total", labelnames=("kind", "table"))
+        c.labels("write", "data").inc(2)
+        c.labels(kind="read", table="index").inc()
+        out = m.render()
+        assert 'ops_total{kind="read",table="index"} 1' in out
+        assert 'ops_total{kind="write",table="data"} 2' in out
+
+    def test_labelless_family_renders_zero_from_registration(self):
+        """A family must be visible (zero state) before its first event —
+        the smoke gate asserts compaction families exist on a server that
+        never compacted."""
+        m = Metrics()
+        m.counter("never_fired_total")
+        m.histogram("never_timed_seconds")
+        out = m.render()
+        assert "never_fired_total 0" in out
+        assert 'never_timed_seconds_bucket{le="+Inf"} 0' in out
+        assert "never_timed_seconds_count 0" in out
+
+    def test_type_conflict_raises(self):
+        m = Metrics()
+        m.counter("x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            m.gauge("x_total")
+
+    def test_reregistration_returns_same_family(self):
+        m = Metrics()
+        assert m.counter("x_total") is m.counter("x_total")
+
+    def test_wrong_label_count_raises(self):
+        m = Metrics()
+        c = m.counter("x_total", labelnames=("a",))
+        with pytest.raises(ValueError):
+            c.labels("v1", "v2")
+        with pytest.raises(ValueError):
+            c.inc()  # labeled family has no default child
+
+
+class TestLegacyStringApi:
+    def test_legacy_names_get_type_metadata(self):
+        """Satellite regression: the seed's render() emitted bare metric
+        lines with no # TYPE for everything except uptime."""
+        m = Metrics()
+        m.inc("horaedb_queries_total")
+        m.set("horaedb_parser_pool_size", 64)
+        out = m.render()
+        assert "# TYPE horaedb_queries_total counter" in out
+        assert "# TYPE horaedb_parser_pool_size gauge" in out
+        assert not promcheck.validate(out), promcheck.validate(out)
+
+    def test_legacy_embedded_labels(self):
+        m = Metrics()
+        m.set('horaedb_ssts_live{table="demo"}', 3)
+        m.set('horaedb_ssts_live{table="region-0/data"}', 7)
+        m.inc('writes_total{table="demo"}', 2)
+        out = m.render()
+        assert 'horaedb_ssts_live{table="demo"} 3' in out
+        assert 'horaedb_ssts_live{table="region-0/data"} 7' in out
+        assert out.count("# TYPE horaedb_ssts_live gauge") == 1
+        assert 'writes_total{table="demo"} 2' in out
+
+    def test_legacy_labeled_family_has_no_phantom_unlabeled_series(self):
+        """A family populated only through labeled legacy names must not
+        render a spurious unlabeled 0 series (min()/absent() queries over
+        the table gauges would see it)."""
+        m = Metrics()
+        m.set('ssts_live{table="data"}', 3)
+        out = m.render()
+        assert 'ssts_live{table="data"} 3' in out
+        assert "\nssts_live 0" not in out
+        # the label-less legacy form still eagerly exposes its zero state
+        m2 = Metrics()
+        m2.inc("plain_total", 0)
+        assert "plain_total 0" in m2.render()
+
+    def test_legacy_unescape_is_single_pass(self):
+        """An escaped backslash followed by 'n' is backslash+n, not a
+        newline: sequential .replace() decoding corrupted the round trip."""
+        m = Metrics()
+        m.set('g{v="a\\\\nb"}', 1)  # wire form of literal value a\nb
+        out = m.render()
+        assert 'g{v="a\\\\nb"} 1' in out  # re-renders identically
+        fam = m.get("g")
+        (key, child), = fam._children.items()
+        assert key == (("v", "a\\nb"),)  # literal backslash + n
+
+    def test_legacy_set_overwrites_not_accumulates(self):
+        m = Metrics()
+        m.set("g", 5)
+        m.set("g", 2)
+        assert "g 2" in m.render()
+
+
+class TestLabelEscaping:
+    def test_escape_function(self):
+        assert escape_label_value('a"b') == 'a\\"b'
+        assert escape_label_value("a\\b") == "a\\\\b"
+        assert escape_label_value("a\nb") == "a\\nb"
+
+    def test_rendered_values_escaped_and_valid(self):
+        m = Metrics()
+        g = m.gauge("g", labelnames=("v",))
+        hostile = 'quo"te back\\slash new\nline'
+        g.labels(hostile).set(1)
+        out = m.render()
+        assert 'v="quo\\"te back\\\\slash new\\nline"' in out
+        # the validator accepts it (raw quote/newline would be violations)
+        assert not promcheck.validate(out), promcheck.validate(out)
+
+    def test_unescaped_output_is_a_violation(self):
+        bad = '# TYPE g gauge\ng{v="un"escaped"} 1\n'
+        assert promcheck.validate(bad)
+
+
+class TestHistogram:
+    def test_bucket_math_cumulative(self):
+        m = Metrics()
+        h = m.histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        child = h._default()
+        cum = child.cumulative()
+        assert cum == [(0.1, 1), (1.0, 3), (10.0, 4), (float("inf"), 5)]
+        assert child.count == 5
+        assert child.sum == pytest.approx(56.05)
+
+    def test_boundary_is_inclusive(self):
+        """`le` is an inclusive upper bound: observe(1.0) lands in the
+        le="1" bucket, not the next one."""
+        m = Metrics()
+        h = m.histogram("h", buckets=(1.0, 2.0))
+        h.observe(1.0)
+        assert h._default().cumulative()[0] == (1.0, 1)
+
+    def test_render_shape(self):
+        m = Metrics()
+        h = m.histogram("lat_seconds", help="latency",
+                        labelnames=("stage",), buckets=(0.5, 1.0))
+        h.labels("io").observe(0.2)
+        h.labels("io").observe(3.0)
+        out = m.render()
+        assert "# TYPE lat_seconds histogram" in out
+        assert 'lat_seconds_bucket{stage="io",le="0.5"} 1' in out
+        assert 'lat_seconds_bucket{stage="io",le="1"} 1' in out
+        assert 'lat_seconds_bucket{stage="io",le="+Inf"} 2' in out
+        assert 'lat_seconds_sum{stage="io"} 3.2' in out
+        assert 'lat_seconds_count{stage="io"} 2' in out
+        assert not promcheck.validate(out), promcheck.validate(out)
+
+    def test_time_context_manager(self):
+        m = Metrics()
+        h = m.histogram("t_seconds")
+        with h.time():
+            pass
+        assert h._default().count == 1
+
+    def test_inf_bucket_not_duplicated(self):
+        m = Metrics()
+        h = m.histogram("h", buckets=(1.0, float("inf")))
+        h.observe(0.5)
+        out = m.render()
+        assert out.count('le="+Inf"') == 1
+
+
+class TestThreadSafety:
+    def test_concurrent_inc(self):
+        m = Metrics()
+        c = m.counter("n_total")
+        g = m.histogram("h_seconds", buckets=DEFAULT_BUCKETS)
+
+        def work():
+            for _ in range(10_000):
+                c.inc()
+                g.observe(0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 80_000
+        assert g._default().count == 80_000
+
+    def test_render_racing_observe_stays_consistent(self):
+        """A scrape concurrent with observes must never emit
+        _count != +Inf bucket (rows() takes ONE locked snapshot)."""
+        m = Metrics()
+        h = m.histogram("h", buckets=(0.5,))
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                h.observe(0.1)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            for _ in range(200):
+                out = m.render()
+                assert not promcheck.validate(out), promcheck.validate(out)
+        finally:
+            stop.set()
+            t.join()
+
+    def test_concurrent_label_children(self):
+        m = Metrics()
+        c = m.counter("n_total", labelnames=("w",))
+
+        def work(i):
+            for _ in range(5_000):
+                c.labels(str(i % 4)).inc()
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = sum(
+            c.labels(str(i)).value for i in range(4)
+        )
+        assert total == 40_000
+
+
+class TestPromcheckValidator:
+    """The smoke gate's validator must itself be sharp: each seeded
+    violation class fires, and the registry's real output never does."""
+
+    def test_detects_bare_metric_without_type(self):
+        assert any("no preceding # TYPE" in e
+                   for e in promcheck.validate("loose_metric 1\n"))
+
+    def test_detects_type_after_samples(self):
+        bad = "x 1\n# TYPE x counter\n"
+        assert any("after its samples" in e for e in promcheck.validate(bad))
+
+    def test_detects_noncumulative_histogram(self):
+        bad = ('# TYPE h histogram\n'
+               'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+               'h_bucket{le="+Inf"} 5\nh_sum 9\nh_count 5\n')
+        assert any("not cumulative" in e for e in promcheck.validate(bad))
+
+    def test_detects_missing_inf_bucket(self):
+        bad = ('# TYPE h histogram\n'
+               'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n')
+        assert any("+Inf" in e for e in promcheck.validate(bad))
+
+    def test_detects_count_bucket_mismatch(self):
+        bad = ('# TYPE h histogram\n'
+               'h_bucket{le="+Inf"} 3\nh_sum 1\nh_count 5\n')
+        assert any("_count" in e for e in promcheck.validate(bad))
+
+    def test_detects_duplicate_sample(self):
+        bad = "# TYPE c counter\nc 1\nc 2\n"
+        assert any("duplicate sample" in e for e in promcheck.validate(bad))
+
+    def test_accepts_full_registry_output(self):
+        m = Metrics()
+        m.counter("a_total", help="with help \\ and\nnewline").inc()
+        m.gauge("b", labelnames=("x",)).labels("v").set(-1.5)
+        m.histogram("c_seconds").observe(0.1)
+        m.inc('legacy_total{k="v"}')
+        assert not promcheck.validate(m.render()), promcheck.validate(m.render())

@@ -405,6 +405,121 @@ class TestGetQuery:
             await client.close()
 
 
+class TestObservability:
+    @async_test
+    async def test_trace_header_and_debug_roundtrip(self, tmp_path):
+        """A query response echoes X-Horaedb-Trace-Id and
+        GET /debug/traces/{id} returns that trace's span tree; /metrics
+        grows the per-stage scan histogram after the query and the whole
+        body passes the Prometheus text-format validator."""
+        import sys
+        from pathlib import Path
+
+        sys.path.insert(
+            0, str(Path(__file__).resolve().parent.parent / "tools")
+        )
+        import promcheck
+
+        from horaedb_tpu.common import tracing
+
+        tracing.configure(sample=1.0)
+        client = await make_client(tmp_path)
+        try:
+            payload = make_remote_write(
+                [({"__name__": "cpu", "host": "a"}, [(1000, 1.0), (2000, 2.0)])]
+            )
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200
+            assert "X-Horaedb-Trace-Id" in r.headers
+
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "cpu", "start_ms": 0, "end_ms": 10_000},
+            )
+            assert r.status == 200
+            trace_id = r.headers.get("X-Horaedb-Trace-Id")
+            assert trace_id
+
+            r = await client.get(f"/debug/traces/{trace_id}")
+            assert r.status == 200
+            tree = await r.json()
+            assert tree["trace_id"] == trace_id
+            assert tree["root"]["name"] == "POST /api/v1/query"
+            assert tree["root"]["duration_s"] is not None
+
+            r = await client.get("/debug/traces")
+            body = await r.json()
+            assert any(t["trace_id"] == trace_id for t in body["traces"])
+
+            r = await client.get("/debug/traces/nope")
+            assert r.status == 404
+
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "horaedb_scan_stage_seconds_bucket" in text
+            # the raw query actually drove the io_decode lane
+            io_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith("horaedb_scan_stage_seconds_count"
+                                 '{stage="io_decode"}')
+            ]
+            assert io_lines and float(io_lines[0].split()[-1]) > 0, io_lines
+            assert "# TYPE horaedb_http_request_seconds histogram" in text
+            assert "horaedb_storage_write_seconds_bucket" in text
+            errors = promcheck.validate(text)
+            assert not errors, errors[:10]
+        finally:
+            await client.close()
+
+    @async_test
+    async def test_sampling_disabled_no_header(self, tmp_path):
+        from horaedb_tpu.common import tracing
+
+        cfg = Config.from_toml(
+            f"""
+port = 0
+[tracing]
+sample = 0.0
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+"""
+        )
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/")
+            assert r.status == 200
+            assert "X-Horaedb-Trace-Id" not in r.headers
+        finally:
+            await client.close()
+            tracing.configure(sample=1.0)
+
+    def test_env_knobs_seed_config_defaults(self, monkeypatch):
+        """HORAEDB_TRACE_* must stay live when the config file has no
+        [tracing] section: build_app applies the config, and a compiled
+        default of 1.0 would clobber an operator's env override."""
+        monkeypatch.setenv("HORAEDB_TRACE_SAMPLE", "0.25")
+        monkeypatch.setenv("HORAEDB_TRACE_SLOW_S", "2.5")
+        c = Config.from_toml("port = 1\n")
+        assert c.tracing.sample == 0.25
+        assert c.tracing.slow_threshold.as_millis() == 2500
+        # explicit config wins over env
+        c = Config.from_toml("[tracing]\nsample = 0.5\n")
+        assert c.tracing.sample == 0.5
+
+    def test_tracing_config_validates(self):
+        with pytest.raises(HoraeError, match="tracing.sample"):
+            Config.from_toml("[tracing]\nsample = 1.5\n").validate()
+        c = Config.from_toml(
+            '[tracing]\nsample = 0.25\nslow_threshold = "250ms"\n'
+            "ring_capacity = 16\n"
+        )
+        c.validate()
+        assert c.tracing.slow_threshold.as_millis() == 250
+
+
 class TestMetadata:
     @async_test
     async def test_metadata_roundtrip(self, tmp_path):

@@ -1,0 +1,225 @@
+"""Request tracing (common/tracing.py): span trees, the bounded recent-
+trace ring, contextvar propagation across asyncio tasks and to_thread
+workers (the patterns tests/test_aio.py establishes), sampling-off
+no-ops, the slow-trace log line, and the scanstats stage bridge."""
+
+import asyncio
+import logging
+
+import pytest
+
+from horaedb_tpu.common import tracing
+from horaedb_tpu.storage import scanstats
+from tests.conftest import async_test
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracing():
+    """Every test starts with default knobs and an empty ring."""
+    tracing.configure(sample=1.0, slow_s=3600.0, ring=256)
+    tracing.reset()
+    yield
+    tracing.configure(sample=1.0, slow_s=1.0, ring=256)
+    tracing.reset()
+
+
+class TestSpanTree:
+    def test_nested_spans_build_a_tree(self):
+        with tracing.trace("root", kind="test") as t:
+            with tracing.span("child_a", n=1):
+                with tracing.span("grandchild"):
+                    pass
+            with tracing.span("child_b"):
+                pass
+        got = tracing.get(t.trace_id)
+        assert got is not None
+        assert got["name"] == "root"
+        assert got["spans"] == 4
+        root = got["root"]
+        assert root["attrs"] == {"kind": "test"}
+        assert [c["name"] for c in root["children"]] == ["child_a", "child_b"]
+        assert root["children"][0]["children"][0]["name"] == "grandchild"
+        assert root["duration_s"] is not None
+        for child in root["children"]:
+            assert child["duration_s"] is not None
+
+    def test_trace_id_is_unique_and_stable(self):
+        ids = set()
+        for _ in range(50):
+            with tracing.trace("t") as t:
+                assert tracing.current_trace_id() == t.trace_id
+            ids.add(t.trace_id)
+        assert len(ids) == 50
+        assert tracing.current_trace_id() is None
+
+    def test_nested_trace_degrades_to_span(self):
+        """A traced operation called from an already-traced context joins
+        the outer trace instead of starting a new root (the compaction
+        executor under a manually-triggered /compact request)."""
+        with tracing.trace("outer") as t:
+            with tracing.trace("inner") as t2:
+                assert t2 is t
+        got = tracing.get(t.trace_id)
+        assert got["spans"] == 2
+        assert got["root"]["children"][0]["name"] == "inner"
+
+    def test_add_attr_targets_current_span(self):
+        with tracing.trace("r") as t:
+            tracing.add_attr(status=200)
+            with tracing.span("c"):
+                tracing.add_attr(rows=5)
+        got = tracing.get(t.trace_id)
+        assert got["root"]["attrs"]["status"] == 200
+        assert got["root"]["children"][0]["attrs"]["rows"] == 5
+
+
+class TestRing:
+    def test_eviction_keeps_newest(self):
+        tracing.configure(ring=4)
+        ids = []
+        for i in range(6):
+            with tracing.trace(f"t{i}") as t:
+                pass
+            ids.append(t.trace_id)
+        assert tracing.get(ids[0]) is None
+        assert tracing.get(ids[1]) is None
+        for tid in ids[2:]:
+            assert tracing.get(tid) is not None
+        recent = tracing.recent()
+        assert [r["name"] for r in recent] == ["t5", "t4", "t3", "t2"]
+
+    def test_recent_limit(self):
+        for i in range(10):
+            with tracing.trace(f"t{i}"):
+                pass
+        assert len(tracing.recent(3)) == 3
+        assert tracing.recent(3)[0]["name"] == "t9"
+
+    def test_get_unknown_id(self):
+        assert tracing.get("doesnotexist") is None
+
+
+class TestPropagation:
+    @async_test
+    async def test_spans_cross_asyncio_tasks(self):
+        """Concurrent child tasks inherit the trace contextvar and their
+        spans land in the same trace — the engine's concurrent per-segment
+        scans must all attribute to the one query."""
+
+        from horaedb_tpu.common.aio import TaskGroup
+
+        async def worker(i):
+            with tracing.span(f"seg{i}"):
+                await asyncio.sleep(0.01)
+
+        with tracing.trace("query") as t:
+            async with TaskGroup() as tg:
+                for i in range(3):
+                    tg.create_task(worker(i))
+        got = tracing.get(t.trace_id)
+        names = sorted(c["name"] for c in got["root"]["children"])
+        assert names == ["seg0", "seg1", "seg2"]
+
+    @async_test
+    async def test_spans_cross_to_thread(self):
+        """asyncio.to_thread copies the context: a span opened in the
+        worker thread attaches to the caller's trace (the parquet decode
+        path)."""
+
+        def blocking():
+            with tracing.span("decode"):
+                pass
+
+        with tracing.trace("query") as t:
+            await asyncio.to_thread(blocking)
+        got = tracing.get(t.trace_id)
+        assert got["root"]["children"][0]["name"] == "decode"
+
+    @async_test
+    async def test_sibling_tasks_do_not_leak_traces(self):
+        """A trace started inside one task must not become the parent of
+        spans in a sibling task (context isolation)."""
+        seen = {}
+
+        async def a():
+            with tracing.trace("a") as t:
+                seen["a"] = t.trace_id
+                await asyncio.sleep(0.02)
+
+        async def b():
+            await asyncio.sleep(0.01)
+            assert tracing.current_trace_id() is None
+            with tracing.trace("b") as t:
+                seen["b"] = t.trace_id
+
+        await asyncio.gather(a(), b())
+        assert seen["a"] != seen["b"]
+
+
+class TestSampling:
+    def test_sampling_off_is_a_noop(self):
+        tracing.configure(sample=0.0)
+        with tracing.trace("t") as t:
+            assert t is None
+            assert tracing.current_trace_id() is None
+            with tracing.span("child") as sp:
+                assert sp is None
+        assert tracing.recent() == []
+
+    def test_span_outside_any_trace_is_a_noop(self):
+        with tracing.span("orphan") as sp:
+            assert sp is None
+        assert tracing.recent() == []
+
+
+class TestSlowTraceLog:
+    def test_slow_trace_logs_warning(self, caplog):
+        tracing.configure(slow_s=0.0)
+        with caplog.at_level(logging.WARNING, logger="horaedb_tpu.common.tracing"):
+            with tracing.trace("slow_op") as t:
+                pass
+        assert any(
+            "slow trace" in r.message and t.trace_id in r.message
+            for r in caplog.records
+        )
+
+    def test_fast_trace_does_not_log(self, caplog):
+        tracing.configure(slow_s=3600.0)
+        with caplog.at_level(logging.WARNING, logger="horaedb_tpu.common.tracing"):
+            with tracing.trace("fast_op"):
+                pass
+        assert not any("slow trace" in r.message for r in caplog.records)
+
+
+class TestScanstatsBridge:
+    def test_stage_feeds_span_and_collector_and_histogram(self):
+        before = scanstats.STAGE_SECONDS.labels("io_decode").count
+        with tracing.trace("q") as t:
+            with scanstats.scan_stats() as st:
+                with scanstats.stage("io_decode"):
+                    pass
+                with scanstats.stage("io_decode"):
+                    pass
+        # collector saw it
+        assert st.counts["io_decode"] == 2
+        # histogram saw it (canonical lane label)
+        assert scanstats.STAGE_SECONDS.labels("io_decode").count == before + 2
+        # the span accumulated it (not one span per stage call)
+        got = tracing.get(t.trace_id)
+        assert got["spans"] == 1
+        assert got["root"]["attrs"]["stages"]["io_decode"] >= 0
+
+    def test_stage_histogram_without_collector(self):
+        """Lane attribution must reach /metrics without scan_stats() —
+        the tentpole's 'continuously, in production' requirement."""
+        before = scanstats.STAGE_SECONDS.labels("transfer").count
+        with scanstats.stage("h2d"):
+            pass
+        assert scanstats.STAGE_SECONDS.labels("transfer").count == before + 1
+
+    def test_canonical_lanes_preregistered(self):
+        from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+        out = GLOBAL_METRICS.render()
+        for lane in ("io_decode", "host_prep", "transfer", "kernel"):
+            assert f'horaedb_scan_stage_seconds_bucket{{stage="{lane}"' in out

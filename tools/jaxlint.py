@@ -32,6 +32,12 @@ Rules (see docs/static-analysis.md for rationale and examples):
         (threading/asyncio Lock/RLock) but mutates `self._*` state in a
         PUBLIC method outside any `with self._lock:` block — the
         storage/fence/compaction concurrency surface
+  J005  host timer/span context manager inside a jit-traced function:
+        `scanstats.stage(...)`, `scanstats.scan_stats(...)`, and
+        tracing's `span`/`trace`/`start_trace` opened in a jit body time
+        TRACE time, not device execution (kernels dispatch
+        asynchronously and the body runs once at trace time) — a
+        J001-adjacent lie; time at the kernel call boundary outside jit
 
 Suppressions: `# jaxlint: disable=J001 <reason>` on the finding's line
 or the line immediately above. The reason is mandatory (J000 otherwise);
@@ -115,6 +121,29 @@ JNP_DTYPE_CTORS = {
     "jnp.array": 1, "jnp.full": 2,          # positional index of dtype
     "jax.numpy.array": 1, "jax.numpy.full": 2,
 }
+
+# Host-wall-clock timer / span context managers (J005): legitimate on the
+# host side of a kernel boundary, a lie inside a traced body. Bare names
+# cover `from ... import stage` style; dotted forms match only when the
+# module component is literally `scanstats`/`tracing` — an alias like
+# `import ... as ss; ss.stage(...)` evades the rule (the cost of not
+# flagging every unrelated `.trace()`/`.stage()` method, e.g. the linalg
+# `jnp.trace`). The tree imports these modules by their real names.
+TIMER_FUNCS = {"stage", "scan_stats", "span", "start_trace"}
+TIMER_MODULES = {"scanstats", "tracing"}
+
+
+def _is_timer_cm(fd: str | None) -> bool:
+    if fd is None:
+        return False
+    parts = fd.split(".")
+    tail = parts[-1]
+    if tail not in TIMER_FUNCS and not (tail == "trace" and len(parts) > 1):
+        return False
+    if len(parts) == 1:
+        return True
+    return parts[-2] in TIMER_MODULES or parts[0] in TIMER_MODULES
+
 
 LOCK_FACTORIES = ("Lock", "RLock", "Semaphore", "Condition")
 MUTATORS = {
@@ -294,7 +323,15 @@ def _check_traced_body(fn, findings: list[Finding]) -> None:
         if not isinstance(node, ast.Call):
             continue
         fd = dotted(node.func)
-        if fd in TRACE_SYNC_CALLS:
+        if _is_timer_cm(fd):
+            findings.append(Finding(
+                node.lineno, "J005",
+                f"host timer/span `{fd}(...)` inside a jit-traced function "
+                "— the block measures trace time, not device execution "
+                "(kernels dispatch asynchronously); time at the kernel call "
+                "boundary outside jit",
+            ))
+        elif fd in TRACE_SYNC_CALLS:
             findings.append(Finding(
                 node.lineno, "J001",
                 f"host sync `{fd}(...)` inside a jit-traced function — "

@@ -1,0 +1,207 @@
+"""Prometheus text exposition format validator (stdlib only).
+
+`make smoke-metrics` pipes the live server's /metrics body through this
+and fails on any violation — the render path in server/metrics.py is the
+contract every scraper depends on, and a malformed line (bare metric with
+no `# TYPE`, an unescaped quote in a label value, a non-cumulative
+histogram) breaks collectors silently or, worse, mis-counts.
+
+Checks, per https://prometheus.io/docs/instrumenting/exposition_formats/:
+- line grammar: comments (`# HELP` / `# TYPE` / plain `#`), sample lines
+  `name{labels} value [timestamp]`, blank lines;
+- metric and label names match the allowed charsets;
+- label values escape backslash, double-quote, and newline;
+- `# TYPE` appears at most once per family, BEFORE its samples, with a
+  valid type; every sample belongs to a family with an explicit TYPE
+  (untyped families must say `untyped`);
+- sample values parse as floats (`+Inf`/`-Inf`/`NaN` accepted);
+- histograms: `le` bounds sorted, bucket counts cumulative
+  (nondecreasing), a `+Inf` bucket present per child, and `_count` ==
+  the `+Inf` bucket;
+- no duplicate sample (same name + label set).
+
+Usage:
+    python tools/promcheck.py [file]      # file or stdin
+    from tools.promcheck import validate  # -> list[str] of violations
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# a sample line: name, optional {labels}, value, optional timestamp
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>\S+)"
+    r"(?:\s+(?P<ts>-?\d+))?\s*$"
+)
+LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
+VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+SUMMARY_SUFFIXES = ("_sum", "_count")
+
+
+def _parse_value(s: str) -> float | None:
+    if s in ("+Inf", "Inf"):
+        return float("inf")
+    if s == "-Inf":
+        return float("-inf")
+    if s == "NaN":
+        return float("nan")
+    try:
+        return float(s)
+    except ValueError:
+        return None
+
+
+def _parse_labels(raw: str, err) -> tuple[tuple[str, str], ...] | None:
+    """Parse `a="b",c="d"` strictly: every byte must be consumed by
+    well-formed, properly escaped pairs."""
+    out = []
+    pos = 0
+    while pos < len(raw):
+        m = LABEL_RE.match(raw, pos)
+        if m is None:
+            err(f"malformed label pair at {raw[pos:pos + 30]!r}")
+            return None
+        out.append((m.group(1), m.group(2)))
+        pos = m.end()
+        if pos < len(raw):
+            if raw[pos] != ",":
+                err(f"expected ',' between label pairs at {raw[pos:]!r}")
+                return None
+            pos += 1
+    return tuple(out)
+
+
+def _base_family(name: str, typed: dict) -> tuple[str, str | None]:
+    """Resolve a sample name to its declared family: histogram/summary
+    samples use the family name + a suffix."""
+    if name in typed:
+        return name, typed[name]
+    for suf in HISTOGRAM_SUFFIXES:
+        base = name[: -len(suf)] if name.endswith(suf) else None
+        if base and typed.get(base) in ("histogram", "summary"):
+            return base, typed[base]
+    return name, None
+
+
+def validate(text: str) -> list[str]:
+    errors: list[str] = []
+    typed: dict[str, str] = {}
+    first_sample_line: dict[str, int] = {}
+    seen_samples: set[tuple] = set()
+    # family -> child label key (minus le) -> list of (le, count)
+    buckets: dict[str, dict[tuple, list[tuple[float, float]]]] = {}
+    counts: dict[str, dict[tuple, float]] = {}
+
+    for i, line in enumerate(text.split("\n"), 1):
+        def err(msg: str, i=i) -> None:
+            errors.append(f"line {i}: {msg}")
+
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] in ("HELP", "TYPE"):
+                if len(parts) < 3:
+                    err(f"# {parts[1]} without a metric name")
+                    continue
+                name = parts[2]
+                if not METRIC_NAME_RE.match(name):
+                    err(f"invalid metric name in {parts[1]}: {name!r}")
+                    continue
+                if parts[1] == "TYPE":
+                    t = parts[3].strip() if len(parts) > 3 else ""
+                    if t not in VALID_TYPES:
+                        err(f"invalid TYPE {t!r} for {name}")
+                    if name in typed:
+                        err(f"duplicate # TYPE for {name}")
+                    if name in first_sample_line:
+                        err(f"# TYPE for {name} after its samples "
+                            f"(first at line {first_sample_line[name]})")
+                    typed[name] = t
+            continue
+        m = SAMPLE_RE.match(line)
+        if m is None:
+            err(f"unparseable sample line: {line[:60]!r}")
+            continue
+        name = m.group("name")
+        value = _parse_value(m.group("value"))
+        if value is None:
+            err(f"unparseable value {m.group('value')!r} for {name}")
+        raw_labels = m.group("labels")
+        labels = _parse_labels(raw_labels, err) if raw_labels else ()
+        if labels is None:
+            continue
+        for k, _v in labels:
+            if not LABEL_NAME_RE.match(k):
+                err(f"invalid label name {k!r} on {name}")
+        key = (name, labels)
+        if key in seen_samples:
+            err(f"duplicate sample {name}{dict(labels)}")
+        seen_samples.add(key)
+
+        family, ftype = _base_family(name, typed)
+        first_sample_line.setdefault(family, i)
+        if ftype is None:
+            err(f"sample {name!r} has no preceding # TYPE "
+                f"(bare metric line)")
+            continue
+        if ftype == "histogram" and value is not None:
+            child = tuple(p for p in labels if p[0] != "le")
+            if name.endswith("_bucket"):
+                le = dict(labels).get("le")
+                if le is None:
+                    err(f"{name} bucket without an le label")
+                    continue
+                b = _parse_value(le)
+                if b is None:
+                    err(f"{name}: unparseable le {le!r}")
+                    continue
+                buckets.setdefault(family, {}).setdefault(child, []).append(
+                    (b, value)
+                )
+            elif name.endswith("_count"):
+                counts.setdefault(family, {})[child] = value
+
+    for family, children in buckets.items():
+        for child, rows in children.items():
+            lbl = dict(child)
+            les = [b for b, _ in rows]
+            if les != sorted(les):
+                errors.append(f"{family}{lbl}: le bounds not sorted")
+            cum = [c for _, c in rows]
+            if any(later < earlier for earlier, later in zip(cum, cum[1:])):
+                errors.append(f"{family}{lbl}: bucket counts not cumulative")
+            if not les or les[-1] != float("inf"):
+                errors.append(f"{family}{lbl}: missing +Inf bucket")
+            else:
+                total = counts.get(family, {}).get(child)
+                if total is not None and total != cum[-1]:
+                    errors.append(
+                        f"{family}{lbl}: _count {total} != +Inf bucket "
+                        f"{cum[-1]}"
+                    )
+    return errors
+
+
+def main() -> None:
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], encoding="utf-8") as f:
+            text = f.read()
+    else:
+        text = sys.stdin.read()
+    errors = validate(text)
+    for e in errors:
+        print(e)
+    print(f"promcheck: {len(errors)} violation(s)")
+    raise SystemExit(min(len(errors), 125))
+
+
+if __name__ == "__main__":
+    main()

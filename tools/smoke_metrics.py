@@ -1,0 +1,153 @@
+"""Observability smoke gate (`make smoke-metrics`).
+
+Boots the real server (build_app) against the in-process fake S3 object
+store, pushes one remote-write batch, runs one raw and one downsample
+query, then fails loudly unless:
+
+- every /metrics line passes the Prometheus text-format validator
+  (tools/promcheck.py);
+- the expected metric families are present (per-stage scan histograms,
+  ingest/flush/storage/compaction families, HTTP latency);
+- the query response echoed an X-Horaedb-Trace-Id whose span tree
+  round-trips through GET /debug/traces/{id}.
+
+This is the end-to-end check the unit tests can't give: the families are
+registered at import time across six modules, and only a live request
+drives them all through one process.
+
+Run: python tools/smoke_metrics.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from promcheck import validate  # noqa: E402
+
+REQUIRED_FAMILIES = (
+    "horaedb_scan_stage_seconds_bucket",
+    'horaedb_scan_stage_seconds_bucket{stage="io_decode"',
+    'horaedb_scan_stage_seconds_bucket{stage="transfer"',
+    'horaedb_scan_stage_seconds_bucket{stage="kernel"',
+    'horaedb_scan_stage_seconds_bucket{stage="host_prep"',
+    "horaedb_scan_path_total",
+    "horaedb_remote_write_samples_total",
+    "horaedb_remote_write_batch_samples_bucket",
+    "horaedb_ingest_parse_seconds_bucket",
+    "horaedb_storage_write_seconds_bucket",
+    "horaedb_storage_scan_seconds_bucket",
+    "horaedb_sst_bytes_bucket",
+    "horaedb_compaction_queue_depth",
+    "horaedb_compaction_seconds_bucket",
+    "horaedb_http_request_seconds_bucket",
+    "horaedb_ingest_flush_seconds_bucket",
+    "horaedb_uptime_seconds",
+)
+
+
+def make_payload() -> bytes:
+    from horaedb_tpu.pb import remote_write_pb2
+
+    req = remote_write_pb2.WriteRequest()
+    for host, samples in (("a", [(1000, 1.5), (2000, 2.5)]),
+                          ("b", [(1500, 7.0)])):
+        ts = req.timeseries.add()
+        for k, v in ((b"__name__", b"smoke_cpu"), (b"host", host.encode())):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = v
+        for t, v in samples:
+            s = ts.samples.add()
+            s.timestamp = t
+            s.value = v
+    return req.SerializeToString()
+
+
+async def run() -> int:
+    import aiohttp
+    from aiohttp import web
+
+    from horaedb_tpu.objstore.fake_s3 import FakeS3
+    from horaedb_tpu.server.config import Config
+    from horaedb_tpu.server.main import build_app
+
+    failures: list[str] = []
+
+    def check(ok: bool, msg: str) -> None:
+        print(("ok   " if ok else "FAIL ") + msg)
+        if not ok:
+            failures.append(msg)
+
+    fake = FakeS3()
+    url = await fake.start()
+    cfg = Config.from_dict({
+        "metric_engine": {"storage": {"object_store": {
+            "type": "S3Like", "endpoint": url, "bucket": fake.bucket,
+            "region": "smoke", "key_id": "smoke", "key_secret": "smoke",
+        }}},
+    })
+    app = await build_app(cfg)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/api/v1/write",
+                              data=make_payload()) as r:
+                body = await r.json()
+                check(r.status == 200 and body.get("samples") == 3,
+                      f"remote-write accepted: {body}")
+            async with s.post(f"{base}/api/v1/query", json={
+                "metric": "smoke_cpu", "start_ms": 0, "end_ms": 10_000,
+            }) as r:
+                body = await r.json()
+                trace_id = r.headers.get("X-Horaedb-Trace-Id", "")
+                check(r.status == 200 and body.get("rows") == 3,
+                      f"raw query answered: {body}")
+                check(bool(trace_id), "query echoed X-Horaedb-Trace-Id")
+            async with s.post(f"{base}/api/v1/query", json={
+                "metric": "smoke_cpu", "start_ms": 0, "end_ms": 4000,
+                "bucket_ms": 2000,
+            }) as r:
+                check(r.status == 200, "downsample query answered")
+            async with s.get(f"{base}/debug/traces/{trace_id}") as r:
+                t = await r.json()
+                check(
+                    r.status == 200 and t.get("trace_id") == trace_id
+                    and t.get("root") is not None,
+                    "/debug/traces/{id} round-trips the span tree",
+                )
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+        errors = validate(text)
+        for e in errors[:20]:
+            print(f"FAIL promcheck: {e}")
+        check(not errors,
+              f"/metrics passes the exposition-format validator "
+              f"({len(text.splitlines())} lines)")
+        for fam in REQUIRED_FAMILIES:
+            check(fam in text, f"/metrics exposes {fam}")
+    finally:
+        await runner.cleanup()
+        await fake.stop()
+    print(f"smoke-metrics: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+def main() -> None:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    raise SystemExit(asyncio.run(run()))
+
+
+if __name__ == "__main__":
+    main()
