@@ -1,6 +1,6 @@
 # Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
 
-.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics clean soak dryruns tpu-suite
+.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics bench-smoke clean soak dryruns tpu-suite
 
 test:
 	python -m pytest tests/ -x -q
@@ -27,6 +27,7 @@ lint:
 	$(MAKE) jaxlint
 	$(MAKE) typecheck
 	$(MAKE) smoke-metrics
+	$(MAKE) bench-smoke
 
 # Domain-aware gate (tools/jaxlint.py): host-sync on hot paths (J001),
 # retrace hazards under jit (J002), dtype drift in engine code (J003),
@@ -43,6 +44,12 @@ jaxlint:
 # missing (tools/smoke_metrics.py).
 smoke-metrics:
 	JAX_PLATFORMS=cpu python tools/smoke_metrics.py
+
+# Aggregation-dispatch gate: a <60 s quick-shape bench.py --smoke on CPU
+# asserting the calibrated registry picks a valid impl, both A/B dicts are
+# non-empty, and the calibration cache round-trips (tools/bench_smoke.py).
+bench-smoke:
+	JAX_PLATFORMS=cpu python tools/bench_smoke.py
 
 # mypy over the annotated core (config in pyproject.toml [tool.mypy]); the
 # dev image has no mypy, so this degrades to a loud skip locally — CI

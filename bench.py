@@ -5,21 +5,36 @@ synthetic metric rows (10K series), the north-star pipeline of
 BASELINE.json: scan -> filter -> aggregate on device vs the single-thread
 CPU (numpy) baseline of the same computation.
 
+Every registered aggregation impl (ops/agg_registry.py) is A/B'd on both
+the sorted and unsorted lane; the HEADLINE rides the impl the calibrated
+dispatcher picks AUTOMATICALLY (no env pinning) — the bench measures what
+production would actually run, and the `sorted_ab`/`unsorted_ab` dicts
+plus the `agg_dispatcher` block explain why.
+
 Prints ONE JSON line:
   {"metric": "downsample_rows_per_sec", "value": N, "unit": "rows/s",
    "vs_baseline": ratio, ...extras}
 
 Run on whatever platform the environment provides (the driver runs it on the
-real TPU chip); falls back to CPU with a smaller problem size.
+real TPU chip); falls back to CPU with a smaller problem size. `--smoke`
+shrinks to a seconds-scale shape for the `make bench-smoke` gate.
+
+The accelerator probe rides common/linkprobe.py: verdicts cache on disk
+with a TTL and `HORAEDB_LINK_PROFILE={host|device|skip}` skips probing
+entirely, so a known-wedged tunnel costs this script <5 s instead of the
+5-10 minutes BENCH_r03-r05 each burned.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+SMOKE = "--smoke" in sys.argv
 
 
 def numpy_baseline(ts, sid, vals, bucket_ms, num_series, num_buckets, lo):
@@ -34,52 +49,13 @@ def numpy_baseline(ts, sid, vals, bucket_ms, num_series, num_buckets, lo):
     return sums, counts
 
 
-def _device_responsive(timeouts=(120, 180, 300)) -> tuple[bool, str]:
-    """Probe the default accelerator in a SUBPROCESS: a wedged remote-TPU
-    tunnel hangs forever inside the runtime (uninterruptible from Python),
-    so the probe must be killable. Retries with growing budgets and fresh
-    subprocesses — a single transient stall must not force the whole round
-    onto the CPU fallback. Returns (ok, reason)."""
-    import subprocess
-    import sys
-    import time as _time
-
-    code = (
-        "import jax, jax.numpy as jnp, numpy as np;"
-        "x = jnp.ones((128, 128));"
-        "print(float(np.asarray((x @ x).sum())))"
-    )
-    reasons = []
-    for attempt, timeout_s in enumerate(timeouts):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", code], capture_output=True, timeout=timeout_s
-            )
-            if out.returncode == 0:
-                return True, f"probe ok (attempt {attempt + 1})"
-            reasons.append(
-                f"attempt {attempt + 1}: rc={out.returncode} "
-                f"{out.stderr.decode(errors='replace')[-200:]}"
-            )
-        except subprocess.TimeoutExpired:
-            # the probe is a 128x128 matmul — worst-case legitimate cost is
-            # one cold compile (~40 s); a 120 s+ timeout is the TUNNEL
-            # wedged, not a slow kernel (VERDICT r03 #1: the distinction
-            # decides whether to re-try the chip or trust the CPU number)
-            reasons.append(
-                f"attempt {attempt + 1}: tunnel wedged "
-                f"(tiny-matmul probe timed out after {timeout_s}s)"
-            )
-        if attempt + 1 < len(timeouts):
-            _time.sleep(20)
-    return False, "; ".join(reasons)
-
-
 def main() -> None:
     # Probe BEFORE touching jax in this process (jax.devices() itself hangs
     # on a wedged tunnel); on failure, force the CPU backend so the bench
     # still reports a real measured number instead of hanging the round.
-    responsive, probe_reason = _device_responsive()
+    from horaedb_tpu.common import linkprobe
+
+    responsive, probe_reason = linkprobe.device_responsive()
     import jax
 
     if not responsive:
@@ -90,6 +66,7 @@ def main() -> None:
 
     import jax.numpy as jnp
 
+    from horaedb_tpu.ops import agg_registry
     from horaedb_tpu.ops import filter as F
     from horaedb_tpu.parallel import make_mesh
     from horaedb_tpu.parallel.scan import build_sharded_downsample
@@ -101,8 +78,12 @@ def main() -> None:
     bucket_ms = 300_000  # 5 minutes
     span_ms = 24 * 3600_000  # 1 day
     num_buckets = span_ms // bucket_ms  # 288
-    n_rows = 64_000_000 if on_accel else 2_000_000
-    iters = 10 if on_accel else 3
+    if SMOKE:
+        n_rows, iters = 256_000, 2
+    else:
+        n_rows = 64_000_000 if on_accel else 2_000_000
+        iters = 10 if on_accel else 3
+    num_cells = num_series * int(num_buckets)
 
     rng = np.random.default_rng(0)
     # i32 time offsets & f32 values: native lane widths on TPU (the engine
@@ -113,8 +94,8 @@ def main() -> None:
 
     mesh = make_mesh(1)
     pred = F.Compare("__val__", "gt", -1.0)
-    # mean-downsample: sum+count, strategy-dispatched (the TSBS 5m-avg shape);
-    # 'auto' = device-sort + block compaction on accelerators, scatter on CPU
+    # mean-downsample: sum+count, dispatcher-resolved (the TSBS 5m-avg
+    # shape); under jit the registry restricts to traceable impls
     fn = build_sharded_downsample(
         mesh, num_series, num_buckets, predicate=pred, with_minmax=False
     )
@@ -145,57 +126,113 @@ def main() -> None:
         float(np.asarray(probe(o)))
         return (time.perf_counter() - t_start) / iters
 
+    def timed_host(f) -> float:
+        """Mean seconds per pass of a synchronous host (numpy) pipeline."""
+        f()  # warmup (allocator, page faults)
+        t_start = time.perf_counter()
+        for _ in range(iters):
+            f()
+        return (time.perf_counter() - t_start) / iters
+
     dev_elapsed = timed(fn, d_ts, d_sid, d_vals, d_valid, lits, t0, bkt)
     out = fn(d_ts, d_sid, d_vals, d_valid, lits, t0, bkt)
-    dev_rows_per_sec = n_rows / dev_elapsed
+    out_counts = np.asarray(out["count"])
 
-    # A/B the unsorted strategies (auto above picks one; measure both):
-    # 'scatter' = two segment-sum scatters; 'sort' = lax.sort + block
-    # compaction. CPU runs only the auto path (scatter) to keep runtime sane.
-    unsorted_results: dict[str, float] = {}
-    if on_accel:
-        for u_impl in ("scatter", "sort"):
+    # ---- unsorted lane: A/B EVERY registered impl on this platform ------
+    unsorted_results: dict[str, float] = {"auto_jit": n_rows / dev_elapsed}
+    for u_impl in agg_registry.unsorted_impl_names(platform):
+        if agg_registry.is_host_impl(u_impl):
+            # impl=u_impl: the pipeline dispatches by NAME (KeyError on an
+            # unmapped impl) — a new host lane must never silently time as
+            # an old one under its name
+            elapsed = timed_host(lambda u=u_impl: agg_registry.host_downsample_unsorted(
+                ts, sid, vals, 0, bucket_ms, num_series, int(num_buckets),
+                with_minmax=False, valid=vals > np.float32(-1.0), impl=u,
+            ))
+        else:
             fn_u = build_sharded_downsample(
                 mesh, num_series, num_buckets, predicate=pred,
                 with_minmax=False, unsorted_impl=u_impl,
             )
             elapsed = timed(fn_u, d_ts, d_sid, d_vals, d_valid, lits, t0, bkt)
-            unsorted_results[u_impl] = n_rows / elapsed
-        dev_rows_per_sec = max(dev_rows_per_sec, *unsorted_results.values())
-    unsorted_impl_best = (
-        max(unsorted_results, key=unsorted_results.get)
-        if unsorted_results else "auto"
+        unsorted_results[u_impl] = n_rows / elapsed
+
+    # dispatcher's automatic pick for concrete host-side input (what the
+    # engine's materialized path would run); the jit pipeline's trace-time
+    # pick rides "auto_jit"
+    unsorted_choice = agg_registry.choose_unsorted(
+        n_rows, num_cells, concrete=True, platform=platform
+    )
+    dev_rows_per_sec = unsorted_results.get(
+        unsorted_choice, unsorted_results["auto_jit"]
     )
 
-    # A/B: the engine's natural scan order is SORTED by (series, ts) — the
-    # sorted-segment strategies apply there (block = pure-XLA MXU
-    # compaction, lanes = lane-parallel vmap scatter). Sort once on host
-    # (outside timing), time each strategy's pipeline on the same data.
+    # ---- sorted lane: the engine's natural scan order is SORTED by
+    # (series, ts). Sort once on host (outside timing), A/B every impl. --
     order = np.lexsort((ts, sid))
-    s_ts = jax.device_put(ts[order], sh)
-    s_sid = jax.device_put(sid[order], sh)
-    s_vals = jax.device_put(vals[order], sh)
+    ts_s, sid_s, vals_s = ts[order], sid[order], vals[order]
+    s_ts = jax.device_put(ts_s, sh)
+    s_sid = jax.device_put(sid_s, sh)
+    s_vals = jax.device_put(vals_s, sh)
 
-    impls = ["block", "lanes"] if on_accel else ["scatter"]
     sorted_results: dict[str, float] = {}
-    for impl_name in impls:
-        fn_sorted = build_sharded_downsample(
-            mesh, num_series, num_buckets, predicate=pred, with_minmax=False,
-            sorted_input=True, sorted_impl=impl_name,
-        )
-        elapsed = timed(fn_sorted, s_ts, s_sid, s_vals, d_valid, lits, t0, bkt)
-        sorted_results[impl_name] = n_rows / elapsed
-        out_sorted = fn_sorted(s_ts, s_sid, s_vals, d_valid, lits, t0, bkt)
-        np.testing.assert_allclose(
-            np.asarray(out_sorted["count"]), np.asarray(out["count"]), rtol=1e-6
-        )
-    sorted_impl_best = max(sorted_results, key=sorted_results.get)
-    sorted_rows_per_sec = sorted_results[sorted_impl_best]
+    for impl_name in agg_registry.sorted_impl_names(platform):
+        if agg_registry.is_host_impl(impl_name):
+            # name-dispatched (see the unsorted loop) and output captured
+            # from the TIMED closure — no extra full pass just for counts
+            host_out: dict = {}
 
-    # headline = the faster pipeline (both are real engine shapes; scan
-    # output is sorted, so the sorted path is the representative one when
-    # it wins)
+            def run_host(i=impl_name):
+                host_out["out"] = agg_registry.host_downsample_sorted(
+                    ts_s, sid_s, vals_s, 0, bucket_ms, num_series,
+                    int(num_buckets), with_minmax=False,
+                    valid=vals_s > np.float32(-1.0), impl=i,
+                )
+                return host_out["out"]
+
+            elapsed = timed_host(run_host)
+            out_sorted_counts = np.asarray(host_out["out"]["count"])
+        else:
+            fn_sorted = build_sharded_downsample(
+                mesh, num_series, num_buckets, predicate=pred,
+                with_minmax=False, sorted_input=True, sorted_impl=impl_name,
+            )
+            elapsed = timed(fn_sorted, s_ts, s_sid, s_vals, d_valid, lits, t0, bkt)
+            out_sorted_counts = np.asarray(
+                fn_sorted(s_ts, s_sid, s_vals, d_valid, lits, t0, bkt)["count"]
+            )
+        sorted_results[impl_name] = n_rows / elapsed
+        np.testing.assert_allclose(out_sorted_counts, out_counts, rtol=1e-6)
+
+    sorted_choice = agg_registry.choose_sorted(
+        n_rows, num_cells, concrete=True, platform=platform
+    )
+    if sorted_choice not in sorted_results:
+        # an env pin can name an impl this platform's A/B never ran
+        # (e.g. HORAEDB_AGG_IMPL=reduceat on an accelerator): report the
+        # measured best rather than KeyError-ing the whole round
+        sorted_choice = max(sorted_results, key=sorted_results.get)
+    sorted_rows_per_sec = sorted_results[sorted_choice]
+
+    # headline = the faster DISPATCHER-CHOSEN pipeline (both are real
+    # engine shapes; scan output is sorted, so the sorted path is the
+    # representative one when it wins). Per-impl maxima stay visible in
+    # the ab dicts — the headline must be reproducible without pinning.
     best_rows_per_sec = max(dev_rows_per_sec, sorted_rows_per_sec)
+
+    # calibration-cache provenance: did this run pay the micro-A/B (cold)
+    # or ride the persisted verdict (warm), and what did it measure?
+    calib_entry, calib_source = agg_registry.calibration_entry(
+        "sorted", n_rows, num_cells, platform=platform
+    )
+    dispatcher_info = {
+        "sorted": sorted_choice,
+        "unsorted": unsorted_choice,
+        "source": calib_source,
+        "cache": agg_registry.cache_path(),
+        "calib_ab": calib_entry.get("ab", {}),
+        "calib_rejected": calib_entry.get("rejected", {}),
+    }
 
     # CPU baseline timing on a bounded sample (single-thread numpy)
     sample = min(n_rows, 4_000_000)
@@ -211,9 +248,7 @@ def main() -> None:
     sums, counts = numpy_baseline(
         ts, sid, vals.astype(np.float64), bucket_ms, num_series, num_buckets, -1.0
     )
-    np.testing.assert_allclose(
-        np.asarray(out["count"]).reshape(-1), counts, rtol=1e-6
-    )
+    np.testing.assert_allclose(out_counts.reshape(-1), counts, rtol=1e-6)
     np.testing.assert_allclose(
         np.asarray(out["sum"]).reshape(-1), sums, rtol=2e-2, atol=2e-1
     )
@@ -237,33 +272,44 @@ def main() -> None:
         "device_s_per_pass": round(n_rows / best_rows_per_sec, 4),
         "baseline_rows_per_sec": round(base_rows_per_sec),
         "unsorted_rows_per_sec": round(dev_rows_per_sec),
-        "unsorted_impl": unsorted_impl_best,
+        "unsorted_impl": unsorted_choice,
         "unsorted_ab": {k: round(v) for k, v in unsorted_results.items()},
         "sorted_rows_per_sec": round(sorted_rows_per_sec),
-        "sorted_impl": sorted_impl_best,
+        "sorted_impl": sorted_choice,
         "sorted_ab": {k: round(v) for k, v in sorted_results.items()},
+        "agg_dispatcher": dispatcher_info,
         "probe": probe_reason,
+        "smoke": SMOKE,
     }
 
     # Last-chance accelerator retry, ONLY on the wedged-tunnel fallback
     # path (`not responsive`): the CPU fallback run itself took minutes —
     # if the tunnel recovered in that window, one fresh subprocess (new
     # backend) measures on the real chip and its result replaces the
-    # fallback. Bounded: one 120 s probe + one child run; the child skips
-    # this path (env guard) so there is no recursion.
-    if not responsive and os.environ.get("HORAEDB_BENCH_CHILD") != "1":
-        recovered, _ = _device_responsive((120,))
+    # fallback. Bounded: one 60 s LIVE probe (use_cache=False — it must
+    # not read back the wedged verdict this run just wrote) + one child
+    # run; the child skips this path (env guard) so there is no recursion.
+    # HORAEDB_LINK_PROFILE overrides skip the retry entirely (the operator
+    # already decided).
+    if (
+        not responsive
+        and not SMOKE
+        and linkprobe.override() is None
+        and os.environ.get("HORAEDB_BENCH_CHILD") != "1"
+    ):
+        recovered, _ = linkprobe.device_responsive(
+            timeouts=(60,), use_cache=False
+        )
         if recovered:
             import subprocess
-            import sys
 
             env = dict(os.environ, HORAEDB_BENCH_CHILD="1")
             try:
-                out = subprocess.run(
+                child_out = subprocess.run(
                     [sys.executable, __file__], capture_output=True,
                     timeout=2400, env=env,
                 )
-                for line in reversed(out.stdout.decode().splitlines()):
+                for line in reversed(child_out.stdout.decode().splitlines()):
                     try:
                         child = json.loads(line)
                     except ValueError:

@@ -4,7 +4,10 @@
 #
 #   bash benchmarks/run_tpu_suite.sh
 #
-# Captures: headline bench (scatter vs sorted A/B incl. block/lanes impls),
+# Captures: the aggregation-registry sweep FIRST (the queued ROOFLINE §1
+# experiments — ranks=32, bf16 one-hot, associative_scan prologue, fused
+# sorted scatter — measured the moment hardware returns), then the
+# headline bench (full per-impl sorted/unsorted A/B via the registry),
 # the five BASELINE configs at full size, engine ingest, query latencies.
 set -u
 cd "$(dirname "$0")/.."
@@ -17,6 +20,9 @@ run() {
   timeout "${STEP_TIMEOUT:-1800}" "$@" | tee -a "$OUT"
 }
 
+# the §1 experiment harvest: every registered impl at a dense 64M-row
+# sorted shape + the unsorted contenders, one JSON line
+run python -m horaedb_tpu.ops.agg_registry --sweep 64000000
 run python bench.py
 run python benchmarks/run_baselines.py
 run python benchmarks/ingest_bench.py 2000

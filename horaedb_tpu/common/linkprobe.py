@@ -1,0 +1,148 @@
+"""Shared accelerator-tunnel probe with a disk-cached verdict.
+
+BENCH_r03–r05 each burned 5–10 minutes re-proving the same wedged tunnel:
+the tiny-matmul probe timed out at 120–400 s per attempt, several attempts
+per round, and bench.py AND server startup each paid it separately. The
+tunnel's health does not flip between those callers, so the verdict is
+cached on disk with a TTL and shared:
+
+- `device_responsive()` — the subprocess probe bench.py uses (a wedged
+  remote-TPU runtime hangs uninterruptibly inside `jax.devices()`, so the
+  probe must be killable). Consults the cache first, writes it after.
+- `HORAEDB_LINK_PROFILE={host|device|skip}` skips probing entirely:
+  `host`/`skip` mean "plan as if the device is unreachable, pay nothing",
+  `device` means "trust the device without proving it". Anything else
+  (or unset) means auto.
+- `HORAEDB_PROBE_TTL_S` (default 1800) bounds verdict staleness;
+  `HORAEDB_PROBE_CACHE` overrides the cache file path.
+
+Import-light by design: bench.py must be able to import this BEFORE the
+jax runtime initializes (probing after `import jax` is too late — the
+import itself can hang on a wedged tunnel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+VALID_OVERRIDES = ("host", "device", "skip")
+DEFAULT_TIMEOUTS = (60, 150)  # one fast attempt + one cold-compile budget
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp, numpy as np;"
+    "x = jnp.ones((128, 128));"
+    "print(float(np.asarray((x @ x).sum())))"
+)
+
+
+def override() -> str | None:
+    """The HORAEDB_LINK_PROFILE override, or None for auto. Unknown values
+    fail loudly — a typo'd override silently probing would re-pay exactly
+    the minutes this knob exists to save."""
+    mode = os.environ.get("HORAEDB_LINK_PROFILE", "").strip().lower()
+    if not mode or mode == "auto":
+        return None
+    if mode not in VALID_OVERRIDES:
+        raise ValueError(
+            f"HORAEDB_LINK_PROFILE={mode!r} is not one of "
+            f"{'/'.join(VALID_OVERRIDES)} (or auto/unset)"
+        )
+    return mode
+
+
+def cache_path() -> str:
+    env = os.environ.get("HORAEDB_PROBE_CACHE")
+    if env:
+        return env
+    return os.path.join(tempfile.gettempdir(), "horaedb-tpu",
+                        "linkprobe.json")
+
+
+def _ttl_s() -> float:
+    try:
+        return float(os.environ.get("HORAEDB_PROBE_TTL_S", "1800"))
+    except ValueError:
+        return 1800.0
+
+
+def cached_verdict() -> tuple[bool, str] | None:
+    """(ok, reason) from a fresh-enough cached probe, else None."""
+    try:
+        with open(cache_path(), encoding="utf-8") as f:
+            data = json.load(f)
+        age = time.time() - float(data["unix"])
+        if 0 <= age <= _ttl_s():
+            return bool(data["ok"]), (
+                f"{data.get('reason', 'cached probe')} "
+                f"[cached {int(age)}s ago]"
+            )
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return None
+
+
+def store_verdict(ok: bool, reason: str) -> None:
+    path = cache_path()
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   prefix=".linkprobe.")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump({"ok": ok, "reason": reason, "unix": time.time()}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # cache is an optimization, never a failure
+
+
+def _probe_subprocess(timeouts) -> tuple[bool, str]:
+    """Tiny-matmul probe in killable subprocesses, growing budgets."""
+    import subprocess
+    import sys
+
+    reasons = []
+    for attempt, timeout_s in enumerate(timeouts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, timeout=timeout_s,
+            )
+            if out.returncode == 0:
+                return True, f"probe ok (attempt {attempt + 1})"
+            reasons.append(
+                f"attempt {attempt + 1}: rc={out.returncode} "
+                f"{out.stderr.decode(errors='replace')[-200:]}"
+            )
+        except subprocess.TimeoutExpired:
+            # the probe is a 128x128 matmul — worst-case legitimate cost is
+            # one cold compile (~40 s); a 60 s+ timeout is the TUNNEL
+            # wedged, not a slow kernel (VERDICT r03 #1)
+            reasons.append(
+                f"attempt {attempt + 1}: tunnel wedged "
+                f"(tiny-matmul probe timed out after {timeout_s}s)"
+            )
+        if attempt + 1 < len(timeouts):
+            time.sleep(10)
+    return False, "; ".join(reasons)
+
+
+def device_responsive(
+    timeouts=DEFAULT_TIMEOUTS, use_cache: bool = True
+) -> tuple[bool, str]:
+    """Is the default accelerator reachable? Order: env override (free) >
+    fresh cached verdict (free) > killable subprocess probe (cached after).
+    `use_cache=False` forces a live probe — the bench's last-chance
+    recovery retry must not read back the wedged verdict it just wrote."""
+    mode = override()
+    if mode in ("host", "skip"):
+        return False, f"HORAEDB_LINK_PROFILE={mode}: probe skipped"
+    if mode == "device":
+        return True, "HORAEDB_LINK_PROFILE=device: probe skipped"
+    if use_cache:
+        cached = cached_verdict()
+        if cached is not None:
+            return cached
+    ok, reason = _probe_subprocess(timeouts)
+    store_verdict(ok, reason)
+    return ok, reason

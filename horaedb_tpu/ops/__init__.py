@@ -10,6 +10,11 @@ src/columnar_storage/src/read.rs:429-494) with jit-compiled XLA:
   dedup.py      run-boundary detection + last-value (max-seq) group masks
   merge.py      k-way sorted merge as concat+sort (the XLA-idiomatic shape)
   aggregate.py  segment reductions: group-by, time-bucket downsample
+  blockagg.py   sorted-segment reduction strategies (block-rank compaction
+                variants, fused sorted scatter, adaptive fallbacks)
+  agg_registry.py  the impl registry + self-calibrating dispatcher behind
+                every aggregate lane (micro-A/B once per platform/density,
+                persisted; host reduceat/bincount lanes live here)
 
 Everything operates on fixed-size padded blocks with validity masks — XLA
 wants static shapes (SURVEY §7 risk (a)/(e)); dynamic row counts travel as

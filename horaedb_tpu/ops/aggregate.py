@@ -168,10 +168,36 @@ def downsample_sorted(
     WITHOUT breaking the sorted runs: excluded rows must keep a monotone
     series_idx (e.g. the searchsorted position, not -1) and are zeroed via
     the compaction's weight column.
+
+    Concrete (non-traced) inputs on the CPU backend consult the calibrated
+    registry dispatcher first: when the measured winner is a host lane
+    (np.add.reduceat over run boundaries), the WHOLE grid computes on host
+    — no device dispatch at all, and no f32-exact grid-size ceiling (host
+    keys are i64).
     """
     from horaedb_tpu.ops.blockagg import _F32_EXACT, sorted_segment_sum_count
 
     num_cells = num_series * num_buckets
+    traced = any(
+        isinstance(x, jax.core.Tracer)
+        for x in (ts, series_idx, values, valid)
+    )
+    # resolve the dispatcher ONCE and thread the choice through both
+    # reductions below — re-resolving per reduction would triple-count
+    # horaedb_agg_impl_total and re-read env/cache on the scan hot path
+    choice: str | None = None
+    if not traced and jax.devices()[0].platform == "cpu":
+        from horaedb_tpu.ops import agg_registry
+
+        choice = agg_registry.choose_sorted(
+            jnp.shape(values)[0], num_cells, concrete=True
+        )
+        if agg_registry.is_host_impl(choice):
+            return agg_registry.host_downsample_sorted(
+                ts, series_idx, values, t0, bucket_ms,
+                num_series=num_series, num_buckets=num_buckets,
+                with_minmax=with_minmax, valid=valid, impl=choice,
+            )
     if num_cells >= _F32_EXACT:
         # grid too large for exact f32 cell-id recovery; use the scatter path
         v_mask = (
@@ -198,7 +224,7 @@ def downsample_sorted(
     # bypass the dtype-preserving integer scatter route
     s, c = sorted_segment_sum_count(
         safe, jnp.where(ok, values, jnp.zeros((), values.dtype)), num_cells,
-        weights=ok.astype(values.dtype),
+        impl=choice, weights=ok.astype(values.dtype),
     )
     shape = (num_series, num_buckets)
     out = {
@@ -209,7 +235,9 @@ def downsample_sorted(
     if with_minmax:
         from horaedb_tpu.ops.blockagg import sorted_segment_min_max
 
-        mn, mx = sorted_segment_min_max(safe, values, num_cells, valid=ok)
+        mn, mx = sorted_segment_min_max(
+            safe, values, num_cells, impl=choice, valid=ok
+        )
         out["min"] = mn.reshape(shape)
         out["max"] = mx.reshape(shape)
     return out

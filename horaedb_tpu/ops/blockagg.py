@@ -74,8 +74,10 @@ def distinct_cells_per_block_max(k_sorted: jax.Array, block: int = DEFAULT_BLOCK
 XLA_CHUNK = 256
 
 
-@partial(jax.jit, static_argnames=("num_cells", "block", "ranks"))
-def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks, w=None):
+@partial(jax.jit, static_argnames=("num_cells", "block", "ranks",
+                                   "bf16_onehot", "scan_prologue"))
+def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks, w=None,
+                         bf16_onehot=False, scan_prologue=False):
     """Pure-XLA form of the block-rank compaction (same algorithm as the
     Pallas phase 1, expressed as chunked one-hot matmuls): the per-row
     scatter becomes an MXU contraction per row-block plus ONE scatter over
@@ -91,7 +93,22 @@ def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks, w=None):
     rows pass w=0 (with the value pre-masked to 0) while keeping their TRUE
     sorted cell id — masking via sentinel keys would interleave run breaks
     through the sorted stream and blow the per-block distinct-cell budget,
-    forcing the adaptive scatter fallback exactly when a filter is active."""
+    forcing the adaptive scatter fallback exactly when a filter is active.
+
+    ROOFLINE §1 experiment flags (both static, registry names in
+    ops/agg_registry.py):
+    - `bf16_onehot`: materialize the one-hot in bf16 and contract bf16
+      (value, weight) features with f32 accumulation — halves the one-hot
+      HBM traffic that dominates the kernel's model. Cell ids do NOT ride
+      the einsum (bf16 would corrupt them above ~2^8); they recover
+      EXACTLY via a boundary-masked integer max-reduce, the same trick the
+      min/max kernel uses. Counts stay exact (0/1 is exact in bf16, f32
+      accumulation); value sums carry the documented bf16 input-rounding
+      budget (agg_registry.BF16_L1_BUDGET) that the calibrator verifies
+      against a live f64 oracle before the lane may win.
+    - `scan_prologue`: compute the block-local rank with a boundary-
+      segmented `lax.associative_scan` instead of `cumsum` (log-depth
+      vector-unit prologue instead of a linear chain)."""
     n = k_sorted.shape[0]
     nb = n // block
     ones = w is None
@@ -121,13 +138,35 @@ def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks, w=None):
             [jnp.full((XLA_CHUNK, 1), -1, jnp.int32), k[:, :-1]], axis=1
         )
         boundary = k != prev
-        rank = jnp.cumsum(boundary.astype(jnp.int32), axis=1) - 1
+        b_i32 = boundary.astype(jnp.int32)
+        if scan_prologue:
+            rank = jax.lax.associative_scan(jnp.add, b_i32, axis=1) - 1
+        else:
+            rank = jnp.cumsum(b_i32, axis=1) - 1
         in_rank = rank < ranks
-        oh = (
+        oh_bool = (
             (rank[..., None]
              == jax.lax.broadcasted_iota(jnp.int32, (XLA_CHUNK, block, ranks), 2))
             & in_rank[..., None]
-        ).astype(jnp.float32)
+        )
+        if bf16_onehot:
+            # bf16 one-hot x bf16 (value, weight) features, f32 accumulate:
+            # native MXU mode, half the materialized-one-hot traffic. Ids
+            # recover via an exact integer max-reduce over the boundary row
+            # (unused ranks yield -1 -> routed to the drop sentinel).
+            oh = oh_bool.astype(jnp.bfloat16)
+            feats = jnp.stack([vv, ww], axis=-1).astype(jnp.bfloat16)
+            out = jnp.einsum(
+                "cbr,cbf->crf", oh, feats,
+                preferred_element_type=jnp.float32,
+            )
+            cells = jnp.max(
+                jnp.where(oh_bool & boundary[..., None], k[..., None], -1),
+                axis=1,
+            )
+            cells = jnp.where(cells < 0, num_cells, cells)
+            return out[..., 0], out[..., 1], cells
+        oh = oh_bool.astype(jnp.float32)
         # Precision.HIGHEST keeps f32 operands on the MXU: the default bf16
         # multiply would corrupt recovered cell ids above ~2^8 (each rank
         # sums exactly one nonzero term, so f32 recovery is exact < 2^24)
@@ -257,25 +296,47 @@ def sorted_segment_min_max(
     """(min, max) per cell for SORTED cell ids. Same adaptive structure as
     sorted_segment_sum_count: block-rank compaction (masked reduces, no
     matmul) with a scatter fallback when any block exceeds the rank budget.
-    `impl` maps 'scatter' to the plain scatter; every other strategy name
-    uses the block compaction (the reduce already fuses — no matmul
-    variant). Rows excluded via `valid` must keep in-range
+    `impl` takes the registry vocabulary: 'scatter'/'scatter_fused'/'lanes'
+    map to the plain scatter (no fused/lane min-max variant exists),
+    'reduceat' is the host run-boundary lane (concrete inputs only), and
+    every block_* name uses the masked-reduce compaction at its block/rank
+    config (bf16/scan flags are sum-count-only and are ignored here). Rows
+    excluded via `valid` must keep in-range
     sorted keys; rows may also carry sentinel keys >= num_cells (dropped by
     every impl's final scatter/clip) provided sentinel runs stay contiguous
     in the stream. +/-inf fills mark empty cells.
 
-    Non-f32 floats always take the dtype-preserving scatter: the block
-    path computes in f32, and a lax.cond joining f32/f64 branches would
-    be a trace-time type error anyway."""
+    Non-f32 floats always take the dtype-preserving scatter or host
+    reduceat: the block path computes in f32, and a lax.cond joining
+    f32/f64 branches would be a trace-time type error anyway."""
     ensure(num_cells < _F32_EXACT, f"num_cells {num_cells} exceeds f32-exact range")
+    traced = (
+        isinstance(k_sorted, jax.core.Tracer) or isinstance(v, jax.core.Tracer)
+    )
     impl = impl or _sorted_impl()
-    ensure(impl in ("auto", "scatter", "block", "lanes"),
-           f"unknown sorted impl {impl!r} (auto|scatter|block|lanes)")
-    on_cpu = jax.devices()[0].platform == "cpu"
-    if jnp.asarray(v).dtype != jnp.float32:
+    ensure(impl in _SORTED_IMPL_NAMES,
+           f"unknown sorted impl {impl!r} ({'|'.join(_SORTED_IMPL_NAMES)})")
+    if impl == "auto":
+        from horaedb_tpu.ops import agg_registry
+
+        impl = agg_registry.choose_sorted(
+            k_sorted.shape[0], num_cells, concrete=not traced
+        )
+    if jnp.asarray(v).dtype != jnp.float32 and impl != "reduceat":
         impl = "scatter"
-    if impl == "scatter" or (impl == "auto" and on_cpu):
+    if impl == "reduceat":
+        ensure(not traced,
+               "sorted impl 'reduceat' is a host lane; it cannot run on "
+               "traced values inside jit")
+        from horaedb_tpu.ops import agg_registry
+
+        return agg_registry.host_reduceat_min_max(
+            k_sorted, v, num_cells, valid=valid
+        )
+    if impl in ("scatter", "scatter_fused", "lanes"):
         return _scatter_min_max(k_sorted, v, num_cells, valid=valid)
+    if impl != "block":
+        block, ranks = _BLOCK_VARIANTS[impl][:2]
 
     def fast(k, vv, ok=None):
         return _block_min_max_xla(k, vv, num_cells, block, ranks, valid=ok)
@@ -317,9 +378,47 @@ def _scatter_sum_count(k_sorted, v, num_cells, w=None):
     return s, c
 
 
+@partial(jax.jit, static_argnames=("num_cells",))
+def _scatter_fused_sum_count(k_sorted, v, num_cells, w=None):
+    """ONE stacked (value, weight) segment-sum with indices_are_sorted=True
+    instead of two scalar scatters — the sorted contract lets XLA skip the
+    scatter's conflict handling, and stacking halves the scatter passes
+    (the TPU tiling penalty that rules stacking out in
+    aggregate.masked_segment_stats does not apply to the CPU backend this
+    lane wins on; on accelerators it simply loses the calibration A/B).
+    f32 accumulation — the dispatcher routes non-f32 inputs to the
+    dtype-preserving scatter before this is reachable."""
+    k = jnp.clip(k_sorted, 0, num_cells).astype(jnp.int32)
+    vf = v.astype(jnp.float32)
+    cw = jnp.ones_like(vf) if w is None else w.astype(jnp.float32)
+    feats = jnp.stack([vf, cw], axis=-1)  # [n, 2]
+    out = jax.ops.segment_sum(
+        feats, k, num_cells + 1, indices_are_sorted=True
+    )[:-1]
+    return out[:, 0], out[:, 1]
+
+
+# registry block-compaction variants: impl name -> (block, ranks,
+# bf16_onehot, scan_prologue). The vocabulary lives in
+# ops/agg_registry.py; execution stays here.
+_BLOCK_VARIANTS = {
+    "block": (DEFAULT_BLOCK, DEFAULT_RANKS, False, False),
+    "block_wide": (2048, 256, False, False),
+    "block_r32": (DEFAULT_BLOCK, 32, False, False),
+    "block_bf16": (DEFAULT_BLOCK, DEFAULT_RANKS, True, False),
+    "block_scan": (DEFAULT_BLOCK, DEFAULT_RANKS, False, True),
+}
+
+_SORTED_IMPL_NAMES = (
+    "auto", "scatter", "scatter_fused", "lanes", "reduceat",
+    *_BLOCK_VARIANTS,
+)
+
+
 def _unsorted_impl() -> str:
     """Strategy override for UNSORTED input: HORAEDB_UNSORTED_IMPL in
-    {auto, scatter, sort}. auto = device-sort + block compaction on
+    {auto, scatter, sort, bincount}. auto = the calibrated registry choice
+    for concrete inputs; under jit, device-sort + block compaction on
     accelerators (when the grid is f32-exact), plain scatter on CPU."""
     import os
 
@@ -358,9 +457,31 @@ def segment_sum_count(k, v, num_cells: int, impl: str | None = None, weights=Non
     'sort' device-sorts the rows (lax.sort runs ~4 ns/row on v5e — far
     cheaper than a 9 ns/row scatter it replaces TWO of) and reduces with the
     sorted block compaction: measured 2.1x the raw double-scatter on a v5e
-    chip (64M rows, 2.88M cells). 'auto' reads HORAEDB_UNSORTED_IMPL at
-    trace time; jitted callers bake the choice into the executable."""
-    impl = unsorted_strategy(k.shape[0], num_cells, jnp.asarray(v).dtype, impl)
+    chip (64M rows, 2.88M cells). 'bincount' is the host hash-grouping lane
+    (concrete inputs only). 'auto' on concrete inputs asks the calibrated
+    registry (ops/agg_registry.py); under jit it resolves by the static
+    density/backend heuristic at trace time and jitted callers bake the
+    choice into the executable."""
+    traced = isinstance(k, jax.core.Tracer) or isinstance(v, jax.core.Tracer)
+    resolved = impl or _unsorted_impl()
+    if resolved == "auto" and not traced:
+        from horaedb_tpu.ops import agg_registry
+
+        resolved = agg_registry.choose_unsorted(
+            k.shape[0], num_cells, concrete=True
+        )
+    impl = unsorted_strategy(
+        k.shape[0], num_cells, jnp.asarray(v).dtype, resolved
+    )
+    if impl == "bincount":
+        ensure(not traced,
+               "unsorted impl 'bincount' is a host lane; it cannot run on "
+               "traced values inside jit")
+        from horaedb_tpu.ops import agg_registry
+
+        return agg_registry.host_bincount_sum_count(
+            k, v, num_cells, weights=weights
+        )
     if impl == "scatter":
         return _scatter_sum_count(k, v, num_cells, w=weights)
     ensure(impl == "sort", f"unknown unsorted impl {impl!r}")
@@ -374,9 +495,9 @@ def segment_sum_count(k, v, num_cells: int, impl: str | None = None, weights=Non
 
 
 def _sorted_impl() -> str:
-    """Strategy override: HORAEDB_SORTED_IMPL in {auto, scatter, block,
-    lanes}. auto = the pure-XLA block compaction on accelerators, plain
-    scatter on CPU (where XLA's scatter is not the bottleneck)."""
+    """Strategy override: HORAEDB_SORTED_IMPL naming any registry impl
+    (ops/agg_registry.py; `HORAEDB_AGG_IMPL` takes precedence inside the
+    registry's dispatcher). auto = the calibrated per-platform choice."""
     import os
 
     return os.environ.get("HORAEDB_SORTED_IMPL", "auto")
@@ -402,32 +523,61 @@ def sorted_segment_sum_count(
     sorted cell id and the stream stays compactable (values must then be
     pre-masked to 0).
 
-    `impl` overrides the strategy explicitly (A/B harnesses); None reads
-    HORAEDB_SORTED_IMPL at trace time — note that jitted callers bake the
-    strategy into their compiled executable, so flipping the env var
-    mid-process does not retrace existing caches."""
+    `impl` overrides the strategy explicitly (A/B harnesses) with any
+    registry name (ops/agg_registry.py): scatter | scatter_fused | lanes |
+    reduceat (host, concrete inputs only) | block | block_wide | block_r32
+    | block_bf16 | block_scan. None reads HORAEDB_SORTED_IMPL at trace
+    time; 'auto' asks the calibrated registry dispatcher — note that
+    jitted callers bake the strategy into their compiled executable, so
+    flipping the env var mid-process does not retrace existing caches."""
     ensure(num_cells < _F32_EXACT, f"num_cells {num_cells} exceeds f32-exact range")
-    on_cpu = jax.devices()[0].platform == "cpu"
+    traced = (
+        isinstance(k_sorted, jax.core.Tracer) or isinstance(v, jax.core.Tracer)
+    )
     impl = impl or _sorted_impl()
     # fail loudly on removed/unknown strategy names (e.g. the deleted
     # 'pallas') rather than silently measuring a different path
-    ensure(impl in ("auto", "scatter", "block", "lanes"),
-           f"unknown sorted impl {impl!r} (auto|scatter|block|lanes)")
-    if jnp.asarray(v).dtype != jnp.float32:
-        # non-f32 inputs take the scatter route: the compaction accumulates
-        # f32, which loses exactness for integer sums above 2^24 (the
-        # scatter widens ints to 64-bit instead — exact), and a cond
-        # joining f32/f64 branches cannot trace
+    ensure(impl in _SORTED_IMPL_NAMES,
+           f"unknown sorted impl {impl!r} ({'|'.join(_SORTED_IMPL_NAMES)})")
+    if impl == "auto":
+        from horaedb_tpu.ops import agg_registry
+
+        impl = agg_registry.choose_sorted(
+            k_sorted.shape[0], num_cells, concrete=not traced
+        )
+    if jnp.asarray(v).dtype != jnp.float32 and impl != "reduceat":
+        # non-f32 inputs take a dtype-preserving route: the compactions
+        # accumulate f32, which loses exactness for integer sums above
+        # 2^24 (scatter and the host reduceat widen ints to 64-bit instead
+        # — exact), and a cond joining f32/f64 branches cannot trace
         impl = "scatter"
-    if impl == "scatter" or (impl == "auto" and on_cpu):
+    if impl == "reduceat":
+        ensure(not traced,
+               "sorted impl 'reduceat' is a host lane; it cannot run on "
+               "traced values inside jit")
+        from horaedb_tpu.ops import agg_registry
+
+        return agg_registry.host_reduceat_sum_count(
+            k_sorted, v, num_cells, weights=weights
+        )
+    if impl == "scatter":
         return _scatter_sum_count(k_sorted, v, num_cells, w=weights)
+    if impl == "scatter_fused":
+        return _scatter_fused_sum_count(k_sorted, v, num_cells, w=weights)
     if impl == "lanes":
         from horaedb_tpu.ops.aggregate import lane_segment_sum_count
 
         return lane_segment_sum_count(k_sorted, v, num_cells, w=weights)
+    if impl != "block":
+        block, ranks, bf16_onehot, scan_prologue = _BLOCK_VARIANTS[impl]
+    else:
+        bf16_onehot = scan_prologue = False
 
     def fast(k, vv, ww=None):
-        return _block_sum_count_xla(k, vv, num_cells, block, ranks, w=ww)
+        return _block_sum_count_xla(
+            k, vv, num_cells, block, ranks, w=ww,
+            bf16_onehot=bf16_onehot, scan_prologue=scan_prologue,
+        )
 
     if isinstance(k_sorted, jax.core.Tracer):
         # inside jit: runtime branch (int() on the pre-check would raise

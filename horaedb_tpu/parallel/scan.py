@@ -57,9 +57,11 @@ def _local_grids(ts, sid, vals, valid, t0, bucket_ms, series_lo, local_series,
 
     `sorted_input=True` declares rows ordered by (sid, ts) — the engine's
     natural scan-output order. The sum/count reduction then dispatches to
-    the sorted-segment compaction (ops/blockagg.py: block-rank one-hot
-    matmuls on the MXU instead of per-row scatters, with adaptive fallback);
-    results are identical either way, sortedness only affects speed.
+    the sorted-segment strategies (ops/blockagg.py; `sorted_impl=None`
+    resolves through the calibrated registry dispatcher in
+    ops/agg_registry.py at trace time, restricted to traceable impls —
+    host lanes cannot ride shard_map); results are identical either way,
+    sortedness only affects speed.
     """
     local_sid = sid - series_lo
     bucket = ((ts - t0) // bucket_ms).astype(jnp.int32)

@@ -40,6 +40,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from horaedb_tpu.common import tracing
 from horaedb_tpu.engine.engine import QueryRequest
 from horaedb_tpu.promql import (
     Agg,
@@ -411,6 +412,12 @@ class RangeEvaluator:
         t0 = self.start - self.step - o
         req = _to_query(sel, t0, int(self.steps[-1]) - o, bucket_ms=self.step)
         res = await self._engine.query(req)
+        # span attribution: which aggregation kernel the calibrated
+        # registry dispatcher served this pushdown with (visible on
+        # /debug/traces next to the scan stage timings)
+        from horaedb_tpu.ops import agg_registry
+
+        tracing.add_attr(agg_impl=agg_registry.last_choice())
         if res is None:
             return []
         tsids, grids = res

@@ -774,12 +774,20 @@ async def build_app(config: Config) -> web.Application:
 
     config.validate()
     store_cfg = config.metric_engine.storage.object_store
+    # imported at boot so horaedb_agg_impl_total renders on /metrics even
+    # before the first aggregate dispatch
+    from horaedb_tpu.ops import agg_registry
+
     if store_cfg.type.lower() == "s3like":
         from horaedb_tpu.objstore.s3 import S3LikeStore
 
         store = S3LikeStore(store_cfg.to_s3_config())
     else:
         store = LocalStore(store_cfg.data_dir)
+        # aggregation calibration cache lives under the data root (an S3
+        # deployment keeps the tmpdir default — the cache is per-BOX
+        # measurement, not shared state)
+        agg_registry.configure_cache_dir(store_cfg.data_dir)
     segment_ms = config.test.segment_duration.as_millis()
     # ThreadConfig sizes the dedicated executor for CPU-heavy SST work —
     # the analog of the reference's named multi-thread runtimes

@@ -148,7 +148,14 @@ class _LinkProfile:
     inline probe blocked the first scan with it (VERDICT r03 weak #5). On
     timeout the planner degrades to host-favoring numbers and every later
     scan re-checks (without blocking) whether the probe finally landed, so
-    a recovered tunnel upgrades the plan mid-process."""
+    a recovered tunnel upgrades the plan mid-process.
+
+    Probe-avoidance gates (common/linkprobe.py), both checked before any
+    thread starts: `HORAEDB_LINK_PROFILE=host|skip` pins the host-favoring
+    numbers and `device` pins PCIe-class numbers, paying nothing; a
+    fresh cached WEDGED verdict (e.g. bench.py just proved the tunnel
+    dead) short-circuits to the same host-favoring plan instead of
+    re-paying the bounded wait per process."""
 
     _cached: dict | None = None
     _lock = threading.Lock()
@@ -162,11 +169,35 @@ class _LinkProfile:
     # cannot be reached; host sort speed stays the local-CPU measurement
     _WEDGED = {"h2d_bw": 1e6, "d2h_bw": 1e6, "dispatch_s": 1.0,
                "sort_s_per_row": 1.2e-6}
+    # production-host plan (HORAEDB_LINK_PROFILE=device): PCIe-class link,
+    # accelerator sort rate — the operator vouches for the link, so the
+    # planner must not strand scans on host SIMD waiting for a probe
+    _TRUSTED = {"h2d_bw": 16e9, "d2h_bw": 16e9, "dispatch_s": 1e-4,
+                "sort_s_per_row": 25e-9}
 
     @classmethod
     def get(cls) -> dict:
         if cls._cached is not None:
             return cls._cached
+        from horaedb_tpu.common import linkprobe
+
+        mode = linkprobe.override()
+        if mode in ("host", "skip"):
+            with cls._lock:
+                cls._cached = dict(cls._WEDGED)
+                return cls._cached
+        if mode == "device":
+            with cls._lock:
+                cls._cached = dict(cls._TRUSTED)
+                return cls._cached
+        if cls._thread is None:
+            cached = linkprobe.cached_verdict()
+            if cached is not None and not cached[0]:
+                # a fresh wedged verdict: don't start a probe that will
+                # only burn the bounded wait; NOT memoized in _cached so a
+                # later process-lifetime call re-reads the (TTL-bounded)
+                # verdict and can upgrade once it expires
+                return dict(cls._WEDGED)
         with cls._lock:
             if cls._cached is not None:
                 return cls._cached
@@ -229,6 +260,11 @@ class _LinkProfile:
             t0 = time.perf_counter()
             np.asarray(d)
             d2h = len(probe) / max(time.perf_counter() - t0 - dispatch, 1e-6)
+            # a completed in-process device probe IS an accelerator-health
+            # verdict: share it so bench.py skips its subprocess probe
+            from horaedb_tpu.common import linkprobe
+
+            linkprobe.store_verdict(True, "in-process link probe ok")
             # accelerator multi-key sort throughput (v5e measured ~4 ns/row
             # per key lane; 6 lanes on the scan shape)
             return {"h2d_bw": h2d, "d2h_bw": d2h, "dispatch_s": dispatch,
@@ -1723,6 +1759,12 @@ class ParquetReader:
                         num_series=num_series, num_buckets=num_buckets,
                         with_minmax=with_minmax, valid=valid_np,
                     )
+                # lane attribution: which registry impl the calibrated
+                # dispatcher ran this fold on (host reduceat vs a device
+                # kernel decides whether device_agg even touched a device)
+                from horaedb_tpu.ops import agg_registry
+
+                scanstats.note("agg_impl_" + agg_registry.last_choice())
             grids["sum"] += np.asarray(out["sum"])
             grids["count"] += np.asarray(out["count"])
             if with_minmax:

@@ -14,6 +14,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Any test path that hits the aggregation dispatcher's 'auto' cold may
+# trigger a calibration micro-A/B (ops/agg_registry.py); shrink it so the
+# one-time cost is milliseconds, not seconds. Tests that pin their own
+# size/cache (test_agg_registry.py) override via monkeypatch.
+os.environ.setdefault("HORAEDB_AGG_CALIB_N", "20000")
 
 import asyncio
 import functools

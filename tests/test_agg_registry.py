@@ -1,0 +1,341 @@
+"""Aggregation registry: f64-oracle parity for EVERY registered impl,
+host-lane semantics, and the calibration cache's cold/warm round-trip.
+
+Parity bar (the registry's correctness contract):
+- count lanes are EXACT integers for every impl (bf16 included — 0/1
+  weights and one-hot entries are exactly representable in bf16, and
+  partials accumulate f32);
+- f32-accumulating sum lanes match the f64 oracle within the bf16 L1
+  budget `|err| <= 2^-7 * sum(|v|_cell) + 1e-3` — the DOCUMENTED ceiling
+  (agg_registry.BF16_L1_BUDGET); non-bf16 lanes sit far inside it.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.ops import agg_registry as R
+
+SORTED_IMPLS = R.sorted_impl_names("cpu")
+UNSORTED_IMPLS = R.unsorted_impl_names("cpu")
+
+
+def oracle(k, v, cells):
+    s = np.bincount(k, weights=v.astype(np.float64), minlength=cells)
+    c = np.bincount(k, minlength=cells)
+    l1 = np.bincount(k, weights=np.abs(v.astype(np.float64)), minlength=cells)
+    return s, c, l1
+
+
+def assert_parity(s, c, k, v, cells, impl):
+    es, ec, l1 = oracle(k, v, cells)
+    np.testing.assert_array_equal(
+        np.asarray(c).astype(np.int64), ec, err_msg=f"{impl}: count lane"
+    )
+    err = np.abs(np.asarray(s, dtype=np.float64) - es)
+    assert np.all(err <= R.BF16_L1_BUDGET * l1 + R.BF16_ATOL), (
+        impl, float(err.max())
+    )
+
+
+class TestSortedParity:
+    """Every registered sorted impl x every shape class."""
+
+    @pytest.mark.parametrize("impl", SORTED_IMPLS)
+    def test_dense(self, impl):
+        rng = np.random.default_rng(0)
+        n, cells = 60_000, 3_000  # ~20 rows/cell: compaction fast path
+        k = np.sort(rng.integers(0, cells, n)).astype(np.int32)
+        v = rng.normal(size=n).astype(np.float32)
+        s, c = R.run_sorted(impl, k, v, cells)
+        assert_parity(s, c, k, v, cells, impl)
+
+    @pytest.mark.parametrize("impl", SORTED_IMPLS)
+    def test_sparse_unique_cells(self, impl):
+        """One row per cell: every block compaction takes its adaptive
+        scatter fallback; host reduceat sees maximal run count."""
+        rng = np.random.default_rng(1)
+        n = 8_000
+        cells = 200_000
+        k = np.sort(rng.choice(cells, n, replace=False)).astype(np.int32)
+        v = rng.normal(size=n).astype(np.float32)
+        s, c = R.run_sorted(impl, k, v, cells)
+        assert_parity(s, c, k, v, cells, impl)
+
+    @pytest.mark.parametrize("impl", SORTED_IMPLS)
+    def test_empty_buckets_and_sentinels(self, impl):
+        """Half the grid never referenced + sentinel rows (id == cells)
+        appended: empty cells report (0, 0), sentinels drop."""
+        rng = np.random.default_rng(2)
+        n, cells = 20_000, 2_000
+        k = np.sort(rng.integers(0, cells // 2, n)).astype(np.int32)
+        v = np.ones(n, dtype=np.float32)
+        k2 = np.concatenate([k, np.full(777, cells, np.int32)])
+        v2 = np.concatenate([v, np.full(777, 99.0, np.float32)])
+        s, c = R.run_sorted(impl, k2, v2, cells)
+        assert float(np.asarray(c).sum()) == n
+        assert float(np.asarray(s).sum()) == pytest.approx(n)
+        assert float(np.asarray(c)[cells // 2:].sum()) == 0
+
+    @pytest.mark.parametrize("impl", SORTED_IMPLS)
+    def test_single_row(self, impl):
+        s, c = R.run_sorted(
+            impl, np.array([3], np.int32), np.array([2.5], np.float32), 8
+        )
+        assert float(np.asarray(c)[3]) == 1
+        assert float(np.asarray(s)[3]) == pytest.approx(2.5)
+        assert float(np.asarray(c).sum()) == 1
+
+    @pytest.mark.parametrize("impl", SORTED_IMPLS)
+    def test_weighted(self, impl):
+        """Predicate masks ride the weight column: masked rows keep their
+        TRUE sorted cell id and contribute (0, 0)."""
+        rng = np.random.default_rng(3)
+        n, cells = 40_000, 2_000
+        k = np.sort(rng.integers(0, cells, n)).astype(np.int32)
+        v = rng.normal(size=n).astype(np.float32)
+        keep = v > -0.5
+        s, c = R.run_sorted(
+            impl, k, np.where(keep, v, 0.0).astype(np.float32), cells,
+            weights=keep.astype(np.float32),
+        )
+        assert_parity(s, c, k[keep], v[keep], cells, impl)
+
+    def test_reduceat_nonmonotone_keys_accumulate(self):
+        """Clipping can fold two series onto one cell id and break key
+        monotonicity: a cell then spans SEVERAL runs, and the host lane
+        must accumulate them (plain assignment kept only the last run —
+        zero-clobbering valid data). Repro via downsample_sorted's
+        documented contract: trailing masked rows at the past-the-end
+        searchsorted position."""
+        ts = np.array([5, 25, 3, 4], np.int64)
+        sid = np.array([1, 1, 2, 2], np.int32)  # 2 == num_series: clipped
+        vals = np.array([10.0, 20.0, 99.0, 98.0])
+        valid = np.array([True, True, False, False])
+        out = R.host_downsample_sorted(
+            ts, sid, vals, 0, 10, num_series=2, num_buckets=4, valid=valid
+        )
+        assert out["count"][1][0] == 1 and out["sum"][1][0] == 10.0
+        assert out["count"][1][2] == 1 and out["sum"][1][2] == 20.0
+        assert float(out["count"].sum()) == 2
+        assert out["min"][1][0] == 10.0 and out["max"][1][2] == 20.0
+
+    def test_reduceat_empty_input(self):
+        s, c = R.run_sorted(
+            "reduceat", np.empty(0, np.int32), np.empty(0, np.float32), 16
+        )
+        assert s.shape == (16,) and float(np.asarray(c).sum()) == 0
+
+    def test_reduceat_integer_exact_beyond_f32(self):
+        """The host lane is dtype-preserving: int sums above 2^24 stay
+        exact (the f32-accumulating compactions would round them)."""
+        n = 4_000
+        k = np.zeros(n, np.int32)
+        v = np.full(n, 1 << 22, np.int64)
+        s, c = R.run_sorted("reduceat", k, v, 4)
+        assert int(np.asarray(s)[0]) == n * (1 << 22)
+        assert np.asarray(s).dtype == np.int64
+
+    def test_reduceat_f64_preserved(self):
+        """Engine CPU precision contract: f64 in, f64 accumulation out."""
+        rng = np.random.default_rng(4)
+        k = np.sort(rng.integers(0, 50, 10_000)).astype(np.int32)
+        v = rng.normal(size=10_000)
+        s, _c = R.run_sorted("reduceat", k, v, 50)
+        assert np.asarray(s).dtype == np.float64
+        es = np.bincount(k, weights=v, minlength=50)
+        np.testing.assert_allclose(np.asarray(s), es, rtol=1e-12)
+
+
+class TestUnsortedParity:
+    @pytest.mark.parametrize("impl", UNSORTED_IMPLS)
+    def test_dense_unsorted(self, impl):
+        rng = np.random.default_rng(5)
+        n, cells = 60_000, 3_000
+        k = rng.integers(0, cells, n).astype(np.int32)  # NOT sorted
+        v = rng.normal(size=n).astype(np.float32)
+        s, c = R.run_unsorted(impl, k, v, cells)
+        assert_parity(s, c, k, v, cells, impl)
+
+    @pytest.mark.parametrize("impl", UNSORTED_IMPLS)
+    def test_sentinels_dropped(self, impl):
+        rng = np.random.default_rng(6)
+        n, cells = 20_000, 500
+        k = rng.integers(0, cells, n).astype(np.int32)
+        v = np.ones(n, np.float32)
+        k2 = np.concatenate([k, np.full(333, cells, np.int32)])
+        v2 = np.concatenate([v, np.zeros(333, np.float32)])
+        perm = rng.permutation(len(k2))
+        s, c = R.run_unsorted(impl, k2[perm], v2[perm], cells)
+        assert float(np.asarray(c).sum()) == n
+        assert float(np.asarray(s).sum()) == pytest.approx(n)
+
+
+class TestHostMinMax:
+    def test_matches_oracle_with_valid_mask(self):
+        rng = np.random.default_rng(7)
+        n, cells = 30_000, 1_500
+        k = np.sort(rng.integers(0, cells, n)).astype(np.int32)
+        v = rng.normal(size=n).astype(np.float32)
+        keep = v > 0
+        mn, mx = R.host_reduceat_min_max(k, v, cells, valid=keep)
+        emn = np.full(cells, np.inf)
+        emx = np.full(cells, -np.inf)
+        np.minimum.at(emn, k[keep], v[keep])
+        np.maximum.at(emx, k[keep], v[keep])
+        np.testing.assert_allclose(mn, emn)
+        np.testing.assert_allclose(mx, emx)
+
+    def test_blockagg_reduceat_impl_routes_here(self):
+        from horaedb_tpu.ops.blockagg import sorted_segment_min_max
+
+        rng = np.random.default_rng(8)
+        k = np.sort(rng.integers(0, 100, 5_000)).astype(np.int32)
+        v = rng.normal(size=5_000).astype(np.float32)
+        mn, mx = sorted_segment_min_max(k, v, 100, impl="reduceat")
+        assert isinstance(np.asarray(mn), np.ndarray)
+        emn = np.full(100, np.inf)
+        np.minimum.at(emn, k, v)
+        np.testing.assert_allclose(np.asarray(mn), emn)
+
+
+class TestHostDownsample:
+    def test_sorted_and_unsorted_lanes_agree(self):
+        rng = np.random.default_rng(9)
+        n, ns, nb = 50_000, 120, 48
+        sid = rng.integers(0, ns, n).astype(np.int32)
+        ts = rng.integers(0, nb * 1000, n).astype(np.int64)
+        vals = rng.normal(size=n)
+        valid = vals > -0.3
+        order = np.lexsort((ts, sid))
+        a = R.host_downsample_sorted(
+            ts[order], sid[order], vals[order], 0, 1000, ns, nb,
+            valid=valid[order],
+        )
+        b = R.host_downsample_unsorted(
+            ts, sid, vals, 0, 1000, ns, nb, valid=valid
+        )
+        np.testing.assert_array_equal(a["count"], b["count"])
+        np.testing.assert_allclose(a["sum"], b["sum"], rtol=1e-9)
+        np.testing.assert_allclose(a["min"], b["min"])
+        np.testing.assert_allclose(a["max"], b["max"])
+
+    def test_engine_dispatch_uses_host_lane_when_pinned(self, monkeypatch):
+        """downsample_sorted on concrete CPU inputs consults the registry:
+        pin reduceat and the output must be numpy (no device round-trip),
+        matching the device pipeline's numbers."""
+        from horaedb_tpu.ops import aggregate as agg_ops
+
+        monkeypatch.setenv("HORAEDB_AGG_IMPL", "reduceat")
+        rng = np.random.default_rng(10)
+        n, ns, nb = 30_000, 64, 32
+        sid = np.sort(rng.integers(0, ns, n)).astype(np.int32)
+        ts = rng.integers(0, nb * 1000, n).astype(np.int64)
+        order = np.lexsort((ts, sid))
+        sid, ts = sid[order], ts[order]
+        vals = rng.normal(size=n)
+        out = agg_ops.downsample_sorted(
+            ts, sid, vals, 0, 1000, num_series=ns, num_buckets=nb
+        )
+        assert isinstance(out["sum"], np.ndarray)
+        flat = sid.astype(np.int64) * nb + ts // 1000
+        np.testing.assert_array_equal(
+            out["count"].reshape(-1).astype(np.int64),
+            np.bincount(flat, minlength=ns * nb),
+        )
+        np.testing.assert_allclose(
+            out["sum"].reshape(-1),
+            np.bincount(flat, weights=vals, minlength=ns * nb),
+            rtol=1e-12,
+        )
+
+
+class TestCalibrationCache:
+    @pytest.fixture(autouse=True)
+    def _isolated_cache(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HORAEDB_AGG_CACHE", str(tmp_path / "calib.json"))
+        monkeypatch.setenv("HORAEDB_AGG_CALIB_N", "8192")
+        monkeypatch.delenv("HORAEDB_AGG_IMPL", raising=False)
+        monkeypatch.delenv("HORAEDB_SORTED_IMPL", raising=False)
+        R.reset_cache(memory_only=True)
+        yield
+        R.reset_cache(memory_only=True)
+
+    def test_cold_calibrates_and_persists(self):
+        name = R.choose_sorted(100_000, 5_000, platform="cpu")
+        assert name in R.SORTED_IMPLS
+        path = R.cache_path()
+        assert os.path.exists(path)
+        data = json.loads(open(path, encoding="utf-8").read())
+        assert data["version"] == R.CALIB_VERSION
+        entry = data["entries"]["cpu/sorted/dense"]
+        assert entry["impl"] == name
+        assert entry["ab"], "A/B dict must be populated"
+        # the traceable fallback is recorded for jit callers
+        assert R.SORTED_IMPLS[entry["device_impl"]].traceable
+
+    def test_warm_skips_micro_ab(self, monkeypatch):
+        R.choose_sorted(100_000, 5_000, platform="cpu")  # cold: calibrates
+        R.reset_cache(memory_only=True)  # fresh process simulation
+
+        def boom(*a, **k):
+            raise AssertionError("warm run must not re-run the micro-A/B")
+
+        monkeypatch.setattr(R, "_calibrate", boom)
+        name = R.choose_sorted(100_000, 5_000, platform="cpu")
+        assert name in R.SORTED_IMPLS
+
+    def test_metric_reports_choice(self):
+        from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+        name = R.choose_sorted(100_000, 5_000, platform="cpu")
+        text = GLOBAL_METRICS.render()
+        assert f'horaedb_agg_impl_total{{impl="{name}"}}' in text
+
+    def test_env_pin_bypasses_calibration(self, monkeypatch):
+        monkeypatch.setenv("HORAEDB_AGG_IMPL", "scatter")
+
+        def boom(*a, **k):
+            raise AssertionError("a pinned impl must not calibrate")
+
+        monkeypatch.setattr(R, "_calibrate", boom)
+        assert R.choose_sorted(100_000, 5_000, platform="cpu") == "scatter"
+        assert R.last_choice() == "scatter"
+
+    def test_env_pin_rejects_unknown(self, monkeypatch):
+        from horaedb_tpu.common.error import HoraeError
+
+        monkeypatch.setenv("HORAEDB_AGG_IMPL", "pallas")
+        with pytest.raises(HoraeError):
+            R.choose_sorted(100_000, 5_000, platform="cpu")
+
+    def test_tracer_dispatch_restricted_to_traceable(self):
+        """Under jit the dispatcher must never hand back a host lane."""
+        name = R.choose_sorted(1_000_000, 10_000, concrete=False,
+                               platform="cpu")
+        assert R.SORTED_IMPLS[name].traceable
+
+    def test_registry_change_invalidates(self, tmp_path):
+        R.choose_sorted(100_000, 5_000, platform="cpu")
+        path = R.cache_path()
+        data = json.loads(open(path, encoding="utf-8").read())
+        data["sorted_impls"] = ["scatter"]  # stale impl inventory
+        open(path, "w", encoding="utf-8").write(json.dumps(data))
+        R.reset_cache(memory_only=True)
+        entry, source = R.calibration_entry("sorted", 100_000, 5_000,
+                                            platform="cpu")
+        assert source == "calibrated"  # re-measured, not trusted
+
+
+class TestSweepCli:
+    def test_sweep_reports_every_impl(self, monkeypatch, capsys):
+        monkeypatch.setenv("HORAEDB_AGG_CALIB_N", "8192")
+        R.main(["--sweep", "20000"])
+        out = json.loads(capsys.readouterr().out)
+        assert out["metric"] == "agg_registry_sweep"
+        for name in R.sorted_impl_names("cpu"):
+            assert name in out["sorted_ab"]
+        for name in R.unsorted_impl_names("cpu"):
+            assert name in out["unsorted_ab"]

@@ -223,3 +223,91 @@ class TestJaxlintGate:
         r = run_jaxlint("no_such_dir_xyz")
         assert r.returncode != 0
         assert "does not exist" in r.stdout + r.stderr
+
+    def test_j006_host_ufunc_inside_jit_fires(self, tmp_path):
+        """np.add.at / np.<ufunc>.reduceat inside a jit body: concretizes
+        tracers AND reinvents the registry's host lane — J006."""
+        bad = hot_file(
+            tmp_path,
+            "import jax\n"
+            "import numpy as np\n"
+            "\n"
+            "@jax.jit\n"
+            "def kernel(grid, idx, v):\n"
+            "    np.add.at(grid, idx, v)\n"            # J006
+            "    s = np.add.reduceat(v, idx)\n"        # J006
+            "    return grid, s\n"
+        )
+        r = run_jaxlint(bad)
+        assert r.returncode != 0
+        assert r.stdout.count("J006") == 2, r.stdout
+        assert f"{bad}:6: J006" in r.stdout, r.stdout
+
+    def test_j006_onehot_outside_registry_fires(self, tmp_path):
+        """Large one-hot materializations (jax.nn.one_hot > 64 classes,
+        == broadcasted_iota at rank 3+) in engine code outside
+        ops/blockagg.py / ops/agg_registry.py are ad-hoc aggregation
+        lanes — J006."""
+        bad = hot_file(
+            tmp_path,
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "\n"
+            "def wide(x):\n"
+            "    return jax.nn.one_hot(x, 4096)\n"     # J006: big one-hot
+            "\n"
+            "def iota_mat(rank):\n"
+            "    oh = rank[..., None] == jax.lax.broadcasted_iota(\n"
+            "        jnp.int32, (256, 512, 64), 2)\n"  # J006: rank-3 one-hot
+            "    return oh\n"
+        )
+        r = run_jaxlint(bad)
+        assert r.returncode != 0
+        assert r.stdout.count("J006") == 2, r.stdout
+
+    def test_j006_accepted_idioms_pass(self, tmp_path):
+        """Host reduceat OUTSIDE jit (promql's window reductions, the
+        registry's own lanes), small one-hots, rank-2 iota index masks,
+        and reasoned suppressions must not fire."""
+        ok = hot_file(
+            tmp_path,
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "import numpy as np\n"
+            "\n"
+            "def window_reduce(val, idx):\n"
+            "    # host side of the kernel boundary: the sanctioned place\n"
+            "    return np.minimum.reduceat(val, idx)\n"
+            "\n"
+            "def small_embed(x):\n"
+            "    return jax.nn.one_hot(x, 8)\n"
+            "\n"
+            "def index_mask(n, k):\n"
+            "    return k[:, None] == jax.lax.broadcasted_iota(\n"
+            "        jnp.int32, (4, n), 1)\n"
+            "\n"
+            "@jax.jit\n"
+            "def suppressed(grid, idx, v):\n"
+            "    # jaxlint: disable=J006 measured: registry lane loses here\n"
+            "    np.add.at(grid, idx, v)\n"
+            "    return grid\n"
+        )
+        r = run_jaxlint(ok)
+        assert r.returncode == 0, r.stdout
+
+    def test_j006_registry_modules_exempt_from_onehot(self, tmp_path):
+        """ops/blockagg.py and ops/agg_registry.py ARE the registry: their
+        one-hot materializations are the registered kernels themselves."""
+        d = tmp_path / "horaedb_tpu" / "ops"
+        d.mkdir(parents=True, exist_ok=True)
+        f = d / "blockagg.py"
+        f.write_text(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "\n"
+            "def compaction(rank):\n"
+            "    return rank[..., None] == jax.lax.broadcasted_iota(\n"
+            "        jnp.int32, (256, 512, 64), 2)\n"
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

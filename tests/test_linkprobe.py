@@ -1,0 +1,144 @@
+"""Link-probe hardening (common/linkprobe.py): the wedged-tunnel verdict
+must be skippable (HORAEDB_LINK_PROFILE), cacheable (disk + TTL), and
+honored by the scan planner's _LinkProfile — BENCH_r03-r05 each burned
+5-10 minutes re-proving the same dead tunnel."""
+
+import time
+
+import pytest
+
+from horaedb_tpu.common import linkprobe
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    monkeypatch.setenv("HORAEDB_PROBE_CACHE", str(tmp_path / "probe.json"))
+    monkeypatch.delenv("HORAEDB_LINK_PROFILE", raising=False)
+    monkeypatch.delenv("HORAEDB_PROBE_TTL_S", raising=False)
+    yield
+
+
+class TestOverride:
+    def test_unset_is_auto(self):
+        assert linkprobe.override() is None
+
+    @pytest.mark.parametrize("mode", ["host", "device", "skip"])
+    def test_valid_modes(self, mode, monkeypatch):
+        monkeypatch.setenv("HORAEDB_LINK_PROFILE", mode)
+        assert linkprobe.override() == mode
+
+    def test_typo_fails_loudly(self, monkeypatch):
+        monkeypatch.setenv("HORAEDB_LINK_PROFILE", "hsot")
+        with pytest.raises(ValueError):
+            linkprobe.override()
+
+    def test_skip_answers_instantly_without_subprocess(self, monkeypatch):
+        """The acceptance bar: a wedged-tunnel bench run with skip loses
+        <5 s to probing — i.e. no subprocess at all."""
+        monkeypatch.setenv("HORAEDB_LINK_PROFILE", "skip")
+
+        def boom(*a, **k):
+            raise AssertionError("skip must not spawn a probe")
+
+        monkeypatch.setattr(linkprobe, "_probe_subprocess", boom)
+        t0 = time.perf_counter()
+        ok, reason = linkprobe.device_responsive()
+        assert time.perf_counter() - t0 < 1.0
+        assert not ok and "skip" in reason
+
+    def test_device_trusts_without_probing(self, monkeypatch):
+        monkeypatch.setenv("HORAEDB_LINK_PROFILE", "device")
+        ok, reason = linkprobe.device_responsive()
+        assert ok and "probe skipped" in reason
+
+
+class TestVerdictCache:
+    def test_round_trip(self):
+        linkprobe.store_verdict(False, "tunnel wedged (test)")
+        cached = linkprobe.cached_verdict()
+        assert cached is not None
+        ok, reason = cached
+        assert not ok and "tunnel wedged" in reason and "cached" in reason
+
+    def test_ttl_expiry(self, monkeypatch):
+        linkprobe.store_verdict(True, "probe ok")
+        monkeypatch.setenv("HORAEDB_PROBE_TTL_S", "0")
+        assert linkprobe.cached_verdict() is None
+
+    def test_device_responsive_uses_cache(self, monkeypatch):
+        linkprobe.store_verdict(False, "wedged earlier this round")
+
+        def boom(*a, **k):
+            raise AssertionError("fresh verdict must not re-probe")
+
+        monkeypatch.setattr(linkprobe, "_probe_subprocess", boom)
+        ok, reason = linkprobe.device_responsive()
+        assert not ok and "cached" in reason
+
+    def test_use_cache_false_forces_live_probe(self, monkeypatch):
+        """The bench's last-chance recovery retry must not read back the
+        wedged verdict it just wrote."""
+        linkprobe.store_verdict(False, "wedged")
+        monkeypatch.setattr(
+            linkprobe, "_probe_subprocess", lambda t: (True, "recovered")
+        )
+        ok, reason = linkprobe.device_responsive(use_cache=False)
+        assert ok and reason == "recovered"
+        # and the recovery result replaced the cached verdict
+        assert linkprobe.cached_verdict()[0] is True
+
+    def test_corrupt_cache_ignored(self, tmp_path, monkeypatch):
+        path = tmp_path / "probe.json"
+        path.write_text("{not json")
+        monkeypatch.setenv("HORAEDB_PROBE_CACHE", str(path))
+        assert linkprobe.cached_verdict() is None
+
+
+class TestLinkProfileGates:
+    @pytest.fixture(autouse=True)
+    def _reset_profile(self):
+        """Full class-state reset: earlier tests in the session may have
+        started the probe thread and published a result."""
+        import threading
+
+        from horaedb_tpu.storage.read import _LinkProfile as LP
+
+        saved = (LP._cached, LP._thread, LP._result, LP._deadline, LP._done)
+        LP._cached = None
+        LP._thread = None
+        LP._result = None
+        LP._deadline = None
+        LP._done = threading.Event()
+        yield
+        LP._cached, LP._thread, LP._result, LP._deadline, LP._done = saved
+
+    def test_host_mode_pins_wedged_plan(self, monkeypatch):
+        from horaedb_tpu.storage.read import _LinkProfile
+
+        monkeypatch.setenv("HORAEDB_LINK_PROFILE", "host")
+        prof = _LinkProfile.get()
+        assert prof == _LinkProfile._WEDGED
+
+    def test_device_mode_pins_trusted_plan(self, monkeypatch):
+        from horaedb_tpu.storage.read import _LinkProfile
+
+        monkeypatch.setenv("HORAEDB_LINK_PROFILE", "device")
+        prof = _LinkProfile.get()
+        assert prof == _LinkProfile._TRUSTED
+
+    def test_cached_wedged_verdict_short_circuits(self, monkeypatch):
+        """A fresh wedged verdict (e.g. bench just proved the tunnel dead)
+        must spare the planner its bounded probe wait."""
+        from horaedb_tpu.storage import read as read_mod
+
+        linkprobe.store_verdict(False, "wedged by bench")
+        started = []
+        monkeypatch.setattr(
+            read_mod.threading, "Thread",
+            lambda *a, **k: started.append(1) or (_ for _ in ()).throw(
+                AssertionError("probe thread must not start")
+            ),
+        )
+        prof = read_mod._LinkProfile.get()
+        assert prof == read_mod._LinkProfile._WEDGED
+        assert not started

@@ -84,6 +84,81 @@ class TestAggregatePlanShape:
         assert full.count('"stablehlo.scatter"') == 4, full.count('"stablehlo.scatter"')
 
 
+class TestRegistryKernelPlanShape:
+    """Lowering-time pins for the registry kernels (ops/agg_registry.py):
+    scatter/sort op counts and partials shapes are the perf model — a
+    regression is caught here without hardware."""
+
+    def lower_sorted(self, impl, n=131072, cells=8):
+        import jax
+        import jax.numpy as jnp
+
+        from horaedb_tpu.ops.blockagg import sorted_segment_sum_count
+
+        f = jax.jit(
+            lambda k, v: sorted_segment_sum_count(k, v, cells, impl=impl)
+        )
+        return f.lower(
+            jnp.zeros(n, jnp.int32), jnp.zeros(n, jnp.float32)
+        ).as_text()
+
+    def test_scatter_fused_pays_exactly_one_scatter(self):
+        """The fused lane's whole point: sum+count ride ONE stacked
+        scatter (the plain sorted scatter pays 2)."""
+        hlo = self.lower_sorted("scatter_fused")
+        assert hlo.count('"stablehlo.scatter"') == 1, hlo.count(
+            '"stablehlo.scatter"'
+        )
+        plain = self.lower_sorted("scatter")
+        assert plain.count('"stablehlo.scatter"') == 2
+
+    def test_block_r32_partials_shape(self):
+        """ranks=32 halves the one-hot AND the partials: 256 blocks x 32
+        ranks = 8192 partial rows for n=131072 (16x compaction), vs 16384
+        at the default ranks=64. Scatter budget unchanged: 2 fast-branch +
+        2 fallback-branch."""
+        hlo = self.lower_sorted("block_r32")
+        assert hlo.count('"stablehlo.scatter"') == 4
+        assert "tensor<8192x" in hlo or "tensor<8192>" in hlo, \
+            "ranks=32 partials shape missing"
+        assert "stablehlo.dot_general" in hlo
+
+    def test_block_bf16_contracts_in_bf16(self):
+        """The bf16 lane's dot_general must take bf16 operands (that IS
+        the traffic saving) with an f32 accumulator, and ids must NOT ride
+        the einsum — no f32 3-feature contraction left."""
+        hlo = self.lower_sorted("block_bf16")
+        assert "stablehlo.dot_general" in hlo
+        assert "bf16" in hlo, "one-hot did not materialize in bf16"
+        assert hlo.count('"stablehlo.scatter"') == 4
+        # 2-feature contraction (value, weight): the f32 path's 3-feature
+        # shape must be absent
+        assert "x3xf32" not in hlo, "id column leaked into the bf16 einsum"
+
+    def test_block_scan_keeps_budget(self):
+        """The associative_scan prologue changes the rank computation, not
+        the scatter budget or the MXU contraction."""
+        hlo = self.lower_sorted("block_scan")
+        assert hlo.count('"stablehlo.scatter"') == 4
+        assert "stablehlo.dot_general" in hlo
+
+    def test_reduceat_refuses_to_trace(self):
+        """The host lane must fail LOUDLY at lowering time under jit, not
+        silently concretize (the J006 contract)."""
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        from horaedb_tpu.common.error import HoraeError
+        from horaedb_tpu.ops.blockagg import sorted_segment_sum_count
+
+        f = jax.jit(
+            lambda k, v: sorted_segment_sum_count(k, v, 8, impl="reduceat")
+        )
+        with pytest.raises(HoraeError):
+            f.lower(jnp.zeros(64, jnp.int32), jnp.zeros(64, jnp.float32))
+
+
 class TestSortedBlockPlanShape:
     def test_block_compaction_scatters_over_partials_not_rows(self):
         """The block-rank compaction's perf property, pinned in the HLO:

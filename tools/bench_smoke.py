@@ -1,0 +1,118 @@
+"""`make bench-smoke`: a <60 s quick-shape bench.py run that gates the
+aggregation registry's dispatch plumbing (wired into `make lint` next to
+smoke-metrics).
+
+Asserts, against the single JSON line bench.py --smoke emits:
+- the JSON parses and carries the headline metric;
+- the calibrated dispatcher picked a VALID registered impl for both the
+  sorted and unsorted lane (no env pinning — the automatic path);
+- `sorted_ab` and `unsorted_ab` are non-empty (the r05 regression:
+  unsorted_ab rendered `{}` while the harness claimed A/B coverage);
+- the calibration cache was written and round-trips as JSON.
+
+Runs on the CPU backend with HORAEDB_LINK_PROFILE=skip and a throwaway
+calibration cache, so the gate also exercises the COLD calibration path
+every time and never touches an accelerator tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # script execution: tools/ is sys.path[0]
+    sys.path.insert(0, REPO)
+BUDGET_S = 120  # hard kill; the target is <60 s
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="bench-smoke-") as tmp:
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            HORAEDB_LINK_PROFILE="skip",
+            HORAEDB_AGG_CACHE=os.path.join(tmp, "agg_calib.json"),
+            HORAEDB_AGG_CALIB_N="65536",
+        )
+        env.pop("HORAEDB_AGG_IMPL", None)  # the gate tests the AUTO path
+        env.pop("HORAEDB_SORTED_IMPL", None)
+        env.pop("HORAEDB_UNSORTED_IMPL", None)
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+            capture_output=True, text=True, timeout=BUDGET_S, env=env,
+            cwd=REPO,
+        )
+        elapsed = time.perf_counter() - t0
+        if proc.returncode != 0:
+            print(proc.stdout[-2000:])
+            print(proc.stderr[-2000:], file=sys.stderr)
+            print(f"bench-smoke: FAIL (bench.py rc={proc.returncode})")
+            return 1
+        result = None
+        for line in reversed(proc.stdout.splitlines()):
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and cand.get("metric"):
+                result = cand
+                break
+        failures: list[str] = []
+        if result is None:
+            failures.append("no JSON result line in bench output")
+            result = {}
+
+        def check(cond: bool, msg: str) -> None:
+            if not cond:
+                failures.append(msg)
+
+        from horaedb_tpu.ops import agg_registry
+
+        check(result.get("metric") == "downsample_rows_per_sec",
+              f"wrong metric: {result.get('metric')!r}")
+        check(result.get("value", 0) > 0, "non-positive headline value")
+        check(result.get("sorted_impl") in agg_registry.SORTED_IMPLS,
+              f"dispatcher picked unknown sorted impl "
+              f"{result.get('sorted_impl')!r}")
+        check(result.get("unsorted_impl") in agg_registry.UNSORTED_IMPLS,
+              f"dispatcher picked unknown unsorted impl "
+              f"{result.get('unsorted_impl')!r}")
+        check(bool(result.get("sorted_ab")), "sorted_ab is empty")
+        check(bool(result.get("unsorted_ab")),
+              "unsorted_ab is empty (the r05 regression)")
+        disp = result.get("agg_dispatcher") or {}
+        check(disp.get("source") in ("cache", "calibrated"),
+              f"missing calibration provenance: {disp.get('source')!r}")
+        cache_file = env["HORAEDB_AGG_CACHE"]
+        if not os.path.exists(cache_file):
+            failures.append("calibration cache was not persisted")
+        else:
+            try:
+                json.load(open(cache_file, encoding="utf-8"))
+            except ValueError:
+                failures.append("calibration cache is not valid JSON")
+        check(elapsed < 60,
+              f"smoke bench took {elapsed:.0f}s (budget 60s)")
+        if failures:
+            for f in failures:
+                print(f"bench-smoke: FAIL {f}")
+            print(json.dumps(result)[:1500])
+            return 1
+        print(
+            f"bench-smoke: OK in {elapsed:.1f}s — sorted="
+            f"{result['sorted_impl']} ({len(result['sorted_ab'])} impls), "
+            f"unsorted={result['unsorted_impl']} "
+            f"({len(result['unsorted_ab'])} impls), "
+            f"{result['value'] / 1e6:.1f}M rows/s"
+        )
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,15 @@ Rules (see docs/static-analysis.md for rationale and examples):
         TRACE time, not device execution (kernels dispatch
         asynchronously and the body runs once at trace time) — a
         J001-adjacent lie; time at the kernel call boundary outside jit
+  J006  ad-hoc aggregation lane outside the registry: host ufunc
+        scatter/segment calls (`np.add.at`, `np.<ufunc>.reduceat`)
+        inside a jit-traced body (they concretize tracers AND bypass
+        the calibrated dispatcher), and one-hot materializations
+        (`jax.nn.one_hot` above 64 classes, or an `==` against a
+        rank-3+ `broadcasted_iota`) in engine code outside
+        ops/blockagg.py / ops/agg_registry.py — every segment-reduction
+        strategy must register in ops/agg_registry.py so the
+        measured-winner dispatch stays complete
 
 Suppressions: `# jaxlint: disable=J001 <reason>` on the finding's line
 or the line immediately above. The reason is mandatory (J000 otherwise);
@@ -143,6 +152,29 @@ def _is_timer_cm(fd: str | None) -> bool:
     if len(parts) == 1:
         return True
     return parts[-2] in TIMER_MODULES or parts[0] in TIMER_MODULES
+
+
+# J006 scope: modules allowed to hold aggregation lanes (the registry and
+# its execution module); everything else in engine code must go through
+# them. Host-ufunc prong matches (np|numpy).<ufunc>.(at|reduceat).
+AGG_LANE_MODULES = (
+    "horaedb_tpu/ops/agg_registry.py",
+    "horaedb_tpu/ops/blockagg.py",
+)
+ONE_HOT_CALLS = {"jax.nn.one_hot", "nn.one_hot"}
+ONE_HOT_CLASS_THRESHOLD = 64
+IOTA_CALLS = {"jax.lax.broadcasted_iota", "lax.broadcasted_iota"}
+
+
+def _is_host_ufunc_lane(fd: str | None) -> bool:
+    if fd is None:
+        return False
+    parts = fd.split(".")
+    return (
+        len(parts) == 3
+        and parts[0] in ("np", "numpy")
+        and parts[-1] in ("at", "reduceat")
+    )
 
 
 LOCK_FACTORIES = ("Lock", "RLock", "Semaphore", "Condition")
@@ -323,7 +355,15 @@ def _check_traced_body(fn, findings: list[Finding]) -> None:
         if not isinstance(node, ast.Call):
             continue
         fd = dotted(node.func)
-        if _is_timer_cm(fd):
+        if _is_host_ufunc_lane(fd):
+            findings.append(Finding(
+                node.lineno, "J006",
+                f"host ufunc lane `{fd}(...)` inside a jit-traced function "
+                "— concretizes tracers AND bypasses the calibrated "
+                "aggregation dispatcher; register the strategy in "
+                "ops/agg_registry.py and call it outside jit",
+            ))
+        elif _is_timer_cm(fd):
             findings.append(Finding(
                 node.lineno, "J005",
                 f"host timer/span `{fd}(...)` inside a jit-traced function "
@@ -455,6 +495,59 @@ def _check_dtype(tree: ast.Module, findings: list[Finding]) -> None:
                 "promotion decides the lane width (f32 vs f64) from context; "
                 "pin it explicitly in engine code",
             ))
+
+
+def _check_onehot(tree: ast.Module, findings: list[Finding]) -> None:
+    """J006 prong 2: one-hot materializations in engine code outside the
+    registry modules. Two idioms: `jax.nn.one_hot(x, N)` with N above the
+    size threshold (a literal N <= 64 is a small embedding, not an
+    aggregation one-hot; a non-literal N is flagged — it can be anything),
+    and the `rank == broadcasted_iota(..., rank-3+ shape, ...)` compare
+    this codebase's block compaction uses."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fd = dotted(node.func)
+            if fd in ONE_HOT_CALLS:
+                n_arg = None
+                if len(node.args) > 1:
+                    n_arg = node.args[1]
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "num_classes":
+                            n_arg = kw.value
+                if (
+                    isinstance(n_arg, ast.Constant)
+                    and isinstance(n_arg.value, int)
+                    and n_arg.value <= ONE_HOT_CLASS_THRESHOLD
+                ):
+                    continue
+                findings.append(Finding(
+                    node.lineno, "J006",
+                    f"`{fd}` materialization above {ONE_HOT_CLASS_THRESHOLD} "
+                    "classes outside ops/blockagg.py / ops/agg_registry.py — "
+                    "one-hot traffic is the aggregate path's roofline "
+                    "(ROOFLINE §1); register the kernel so the calibrated "
+                    "dispatcher can measure it",
+                ))
+        elif isinstance(node, ast.Compare):
+            sides = [node.left, *node.comparators]
+            for side in sides:
+                if not (isinstance(side, ast.Call)
+                        and dotted(side.func) in IOTA_CALLS):
+                    continue
+                shape = side.args[1] if len(side.args) > 1 else None
+                if isinstance(shape, (ast.Tuple, ast.List)) \
+                        and len(shape.elts) < 3:
+                    continue  # rank-2 iota compares are index masks, not
+                    # materialized one-hots
+                findings.append(Finding(
+                    node.lineno, "J006",
+                    "one-hot materialization via `== broadcasted_iota` "
+                    "(rank-3+ shape) outside ops/blockagg.py / "
+                    "ops/agg_registry.py — register the kernel in the "
+                    "aggregation registry instead of an ad-hoc lane",
+                ))
+                break
 
 
 def _lock_attrs_of(cls: ast.ClassDef) -> set[str]:
@@ -644,6 +737,8 @@ def lint_file(path: Path) -> list[str]:
     _check_jit_call_sites(tree, idx.bare_jit_names, findings)
     if in_dtype_scope:
         _check_dtype(tree, findings)
+        if not any(posix.endswith(m) for m in AGG_LANE_MODULES):
+            _check_onehot(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

@@ -36,6 +36,7 @@ REQUIRED_FAMILIES = (
     'horaedb_scan_stage_seconds_bucket{stage="kernel"',
     'horaedb_scan_stage_seconds_bucket{stage="host_prep"',
     "horaedb_scan_path_total",
+    "horaedb_agg_impl_total",
     "horaedb_remote_write_samples_total",
     "horaedb_remote_write_batch_samples_bucket",
     "horaedb_ingest_parse_seconds_bucket",
