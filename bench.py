@@ -234,6 +234,15 @@ def main() -> None:
         "calib_rejected": calib_entry.get("rejected", {}),
     }
 
+    # compile vs steady-state split (common/xprof.py): every device
+    # pipeline above routed through instrumented xjit wrappers, so the
+    # process totals separate a compile-time regression (recompiles /
+    # compile_s grew) from a kernel regression (steady_s grew) — the two
+    # used to be indistinguishable in device_s_per_pass alone.
+    from horaedb_tpu.common import xprof
+
+    xprof_totals = xprof.snapshot()
+
     # CPU baseline timing on a bounded sample (single-thread numpy)
     sample = min(n_rows, 4_000_000)
     b_start = time.perf_counter()
@@ -270,6 +279,13 @@ def main() -> None:
         "num_buckets": int(num_buckets),
         # seconds per pass of the HEADLINE path (consistent with `value`)
         "device_s_per_pass": round(n_rows / best_rows_per_sec, 4),
+        # steady-state per-pass seconds (cache-hit; identical to
+        # device_s_per_pass — named so the split reads unambiguously next
+        # to compile_s) vs TOTAL one-time compile seconds this process
+        # paid across every kernel/shape the A/B sweep traced
+        "steady_s": round(n_rows / best_rows_per_sec, 4),
+        "compile_s": xprof_totals["total_compile_seconds"],
+        "recompiles": xprof_totals["total_compiles"],
         "baseline_rows_per_sec": round(base_rows_per_sec),
         "unsorted_rows_per_sec": round(dev_rows_per_sec),
         "unsorted_impl": unsorted_choice,

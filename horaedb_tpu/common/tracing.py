@@ -262,10 +262,20 @@ def add_stage(stage: str, seconds: float) -> None:
     stages[stage] = round(stages.get(stage, 0.0) + seconds, 6)
 
 
-def recent(limit: int = 50) -> list[dict]:
-    """Most-recent-first trace summaries (no span bodies)."""
+def recent(limit: int = 50, min_ms: float | None = None) -> list[dict]:
+    """Most-recent-first trace summaries (no span bodies). `min_ms` keeps
+    only traces at least that slow — the "last 10 slow traces" operator
+    pull — applied BEFORE `limit`, so the newest `limit` traces ABOVE the
+    threshold come back, not however many slow ones survive inside the
+    newest `limit`."""
     with _ring_lock:
         traces = list(_ring.values())
+    if min_ms is not None:
+        traces = [
+            t for t in traces
+            if t.root is not None and t.root.duration_s is not None
+            and t.root.duration_s * 1000.0 >= min_ms
+        ]
     out = []
     for t in reversed(traces[-limit:] if limit else traces):
         root = t.root

@@ -22,6 +22,7 @@ from horaedb_tpu.engine.tables import DATA_SCHEMA
 from horaedb_tpu.ops import aggregate as agg_ops
 from horaedb_tpu.ops import filter as F
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
+from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.read import ScanRequest, WriteRequest
 from horaedb_tpu.storage.types import TimeRange
 
@@ -675,9 +676,15 @@ class SampleManager:
         if not ssts or not tsids:
             return None
         if len(tsids) > MAX_PUSHDOWN_SERIES:
+            # the materialized fallback scans through ObjectBasedStorage.scan,
+            # which notes its own ssts_selected — noting here too would
+            # double-count the provenance
             return await self._query_downsample_materialized(
                 metric_id, tsids if filtered else None, rng, bucket_ms
             )
+        # EXPLAIN provenance: how many SSTs the time range selected (bloom
+        # pruning and actual reads are noted per SST in storage/read.py)
+        scanstats.note("ssts_selected", len(ssts))
         series_ids = np.asarray(sorted(tsids), dtype=np.uint64)
         num_buckets = int(n_buckets)  # validated against MAX_BUCKETS above
         pred = self._predicate(

@@ -469,11 +469,18 @@ class RegionedEngine:
         return self.engines[self.router.region_of_name(metric)]
 
     async def query(self, req: QueryRequest):
+        from horaedb_tpu.storage import scanstats
+
         if self._legacy:
+            scanstats.note_max("regions_fanout", 1)
             return await self._engine_for(req.metric).query(req)
         import asyncio
 
         ids = list(self.engines)
+        # EXPLAIN provenance: how many regions this query fanned out to
+        # (max, not sum: a multi-selector PromQL expression queries the
+        # engine several times under one collector)
+        scanstats.note_max("regions_fanout", len(ids))
         results = await asyncio.gather(
             *(self.engines[i].query(req) for i in ids)
         )

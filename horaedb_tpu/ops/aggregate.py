@@ -17,10 +17,10 @@ u64 TSIDs to dense series indices before dispatch (ops/__init__ docstring).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
+
+from horaedb_tpu.common.xprof import xjit
 
 
 def _masked_index(index: jax.Array, valid: jax.Array, num_segments: int) -> jax.Array:
@@ -93,7 +93,7 @@ def masked_segment_stats(
     return s, c, mn, mx
 
 
-@partial(jax.jit, static_argnames=("num_segments",))
+@xjit(kernel="grouped_stats", static_argnames=("num_segments",))
 def grouped_stats(
     values: jax.Array,
     index: jax.Array,
@@ -243,7 +243,7 @@ def downsample_sorted(
     return out
 
 
-@partial(jax.jit, static_argnames=("num_cells", "lanes"))
+@xjit(kernel="lane_sum_count", static_argnames=("num_cells", "lanes"))
 def lane_segment_sum_count(k, v, num_cells: int, lanes: int = 8, w=None):
     """Experimental lane-parallel scatter: rows reshape to [lanes, n/lanes]
     and each lane scatter-adds into its OWN partial grid (vmap batches the
@@ -278,7 +278,7 @@ def lane_segment_sum_count(k, v, num_cells: int, lanes: int = 8, w=None):
     return s, c
 
 
-@partial(jax.jit, static_argnames=("num_series", "num_buckets"))
+@xjit(kernel="downsample", static_argnames=("num_series", "num_buckets"))
 def downsample(
     ts: jax.Array,
     series_idx: jax.Array,
@@ -300,7 +300,7 @@ def downsample(
     return {k: v.reshape(num_series, num_buckets) for k, v in stats.items()}
 
 
-@partial(jax.jit, static_argnames=("num_segments",))
+@xjit(kernel="segment_last_value", static_argnames=("num_segments",))
 def segment_last_value(
     values: jax.Array,
     seq: jax.Array,

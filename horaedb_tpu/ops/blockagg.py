@@ -34,12 +34,11 @@ delete it"). benchmarks/results_tpu.jsonl r02 holds the measurement.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
 from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.xprof import xjit
 
 DEFAULT_BLOCK = 512
 DEFAULT_RANKS = 64
@@ -74,8 +73,9 @@ def distinct_cells_per_block_max(k_sorted: jax.Array, block: int = DEFAULT_BLOCK
 XLA_CHUNK = 256
 
 
-@partial(jax.jit, static_argnames=("num_cells", "block", "ranks",
-                                   "bf16_onehot", "scan_prologue"))
+@xjit(kernel="block_sum_count", static_argnames=("num_cells", "block",
+                                                 "ranks", "bf16_onehot",
+                                                 "scan_prologue"))
 def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks, w=None,
                          bf16_onehot=False, scan_prologue=False):
     """Pure-XLA form of the block-rank compaction (same algorithm as the
@@ -198,7 +198,7 @@ def _block_sum_count_xla(k_sorted, v, num_cells, block, ranks, w=None,
     return grid_sum, grid_cnt
 
 
-@partial(jax.jit, static_argnames=("num_cells", "block", "ranks"))
+@xjit(kernel="block_min_max", static_argnames=("num_cells", "block", "ranks"))
 def _block_min_max_xla(k_sorted, v, num_cells, block, ranks, valid=None):
     """min/max companion of _block_sum_count_xla: per-block rank masking +
     a fused masked-reduce over the block axis (XLA fuses the where into the
@@ -378,7 +378,7 @@ def _scatter_sum_count(k_sorted, v, num_cells, w=None):
     return s, c
 
 
-@partial(jax.jit, static_argnames=("num_cells",))
+@xjit(kernel="scatter_fused", static_argnames=("num_cells",))
 def _scatter_fused_sum_count(k_sorted, v, num_cells, w=None):
     """ONE stacked (value, weight) segment-sum with indices_are_sorted=True
     instead of two scalar scatters — the sorted contract lets XLA skip the

@@ -13,13 +13,13 @@ significant key.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
+from horaedb_tpu.common.xprof import xjit
 
-@partial(jax.jit, static_argnames=("num_keys",))
+
+@xjit(kernel="sort_perm", static_argnames=("num_keys",))
 def _sort_perm(keys: tuple[jax.Array, ...], num_keys: int) -> jax.Array:
     # ONE variadic lax.sort with an iota payload: lax.sort is directly
     # lexicographic over the first num_keys operands, so the permutation

@@ -49,6 +49,7 @@ from horaedb_tpu.common.jaxcompat import shard_map
 
 import horaedb_tpu.ops  # noqa: F401  — enables jax x64 (u64 key lanes)
 from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.xprof import xjit
 from horaedb_tpu.ops.blocks import PACK_SENTINEL as _SENTINEL
 MERGE_AXIS = "merge"
 # Pad granules: shard length and bucket capacity round up to these so the
@@ -133,7 +134,7 @@ def _build_sharded_merge(
         in_specs=(P(MERGE_AXIS), P(MERGE_AXIS), P()),
         out_specs=(P(MERGE_AXIS), P(MERGE_AXIS)),
     )
-    return jax.jit(mapped)
+    return xjit(mapped, kernel="sample_sort_merge")
 
 
 def _splitters_from_sample(

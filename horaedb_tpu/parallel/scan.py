@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.jaxcompat import shard_map
+from horaedb_tpu.common.xprof import xjit
 from horaedb_tpu.ops import filter as filter_ops
 from horaedb_tpu.ops.filter import Predicate
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
@@ -190,7 +191,7 @@ def build_sharded_downsample(
         in_specs=(row_spec, row_spec, row_spec, row_spec, P(), P(), P()),
         out_specs={k: grid_spec for k in keys},
     )
-    return jax.jit(mapped)
+    return xjit(mapped, kernel="sharded_downsample")
 
 
 def sharded_downsample(
@@ -276,7 +277,7 @@ def build_multisegment_downsample(
         in_specs=(row_spec, row_spec, row_spec, row_spec, P("seg"), P()),
         out_specs={k: grid_spec for k in ("sum", "count", "min", "max", "mean")},
     )
-    return jax.jit(mapped)
+    return xjit(mapped, kernel="multisegment_downsample")
 
 
 def sharded_grouped_stats(

@@ -42,6 +42,7 @@ import numpy as np
 
 from horaedb_tpu.common import tracing
 from horaedb_tpu.engine.engine import QueryRequest
+from horaedb_tpu.storage import scanstats
 from horaedb_tpu.promql import (
     Agg,
     BinOp,
@@ -338,6 +339,7 @@ class RangeEvaluator:
         req = _to_query(sel, self.start - pre_ms - o,
                         int(self.steps[-1]) + 1 - o)
         req.limit = self.MAX_RAW_ROWS + 1
+        scanstats.note("promql_raw_selects")
         table = await self._engine.query(req)
         if table is None:
             return {}
@@ -411,6 +413,7 @@ class RangeEvaluator:
         o = sel.offset_ms
         t0 = self.start - self.step - o
         req = _to_query(sel, t0, int(self.steps[-1]) - o, bucket_ms=self.step)
+        scanstats.note("promql_pushdowns")
         res = await self._engine.query(req)
         # span attribution: which aggregation kernel the calibrated
         # registry dispatcher served this pushdown with (visible on

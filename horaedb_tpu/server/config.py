@@ -176,11 +176,32 @@ class TracingConfig:
 
 
 @dataclass
+class SlowlogConfig:
+    """Slow-query flight recorder knobs (server/slowlog.py): the
+    `capacity` slowest query requests spool — full trace tree + EXPLAIN —
+    to `<object_store.data_dir>/slowlog/`, served at GET /debug/slowlog."""
+
+    # How many entries to keep (the N in "N slowest"); 0 disables the
+    # recorder entirely (no directory is created, no writes happen).
+    capacity: int = 32
+    # Requests faster than this never spool, even below capacity — keeps
+    # a cold server from burning disk writes on its first N fast queries.
+    min_duration: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.millis(0)
+    )
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "SlowlogConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
 class Config:
     port: int = 5000
     test: TestConfig = field(default_factory=TestConfig)
     metric_engine: MetricEngineConfig = field(default_factory=MetricEngineConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    slowlog: SlowlogConfig = field(default_factory=SlowlogConfig)
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "Config":
@@ -203,6 +224,10 @@ class Config:
         ensure(
             self.tracing.ring_capacity > 0,
             "tracing.ring_capacity must be positive",
+        )
+        ensure(
+            self.slowlog.capacity >= 0,
+            "slowlog.capacity must be >= 0 (0 disables the recorder)",
         )
         store = self.metric_engine.storage.object_store
         kind = store.type.lower()

@@ -40,7 +40,7 @@ import numpy as np
 import pyarrow as pa
 from aiohttp import web
 
-from horaedb_tpu.common import tracing
+from horaedb_tpu.common import tracing, xprof
 from horaedb_tpu.common.error import HoraeError
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.engine import MetricEngine, QueryRequest
@@ -48,6 +48,8 @@ from horaedb_tpu.ingest import ParserPool
 from horaedb_tpu.objstore import LocalStore
 from horaedb_tpu.server.config import Config
 from horaedb_tpu.server.metrics import GLOBAL_METRICS as METRICS
+from horaedb_tpu.server.slowlog import SlowLog, build_entry
+from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.read import CompactRequest, WriteRequest
 from horaedb_tpu.storage.storage import ObjectBasedStorage
 from horaedb_tpu.storage.types import TimeRange
@@ -75,38 +77,75 @@ INGEST_BATCH_SAMPLES = METRICS.histogram(
 )
 
 
+# Routes whose finished traces feed the slow-query flight recorder (the
+# diagnosis surfaces themselves never spool).
+QUERY_ENDPOINTS = frozenset((
+    "/api/v1/query", "/api/v1/query_range", "/api/v1/query_exemplars",
+))
+
+
+def _record_slow_query(slowlog: "SlowLog | None", t) -> None:
+    """Feed one FINISHED query trace to the flight recorder. The root
+    span's attrs already carry the EXPLAIN payload and scanstats stages
+    the handler attached, so the spooled entry is the full diagnosis the
+    operator would have gotten live with ?explain=1."""
+    if slowlog is None:
+        return
+    root = t.root
+    if root is None or root.duration_s is None:
+        return
+    if not slowlog.admit(root.duration_s):
+        return  # cheap pre-check; record() re-validates under its lock
+    entry = build_entry(t.as_dict(), root.attrs.get("explain"))
+    slowlog.record(t.trace_id, root.duration_s, entry)
+
+
 @web.middleware
 async def observability_middleware(request: web.Request, handler):
     """Every request (except the observability surfaces themselves) gets a
     trace (subject to sampling) and a latency histogram sample; the trace
     id is echoed in the X-Horaedb-Trace-Id response header so a caller can
-    fetch its span tree from /debug/traces/{id}."""
+    fetch its span tree from /debug/traces/{id}. Finished traces of query
+    endpoints feed the slow-query flight recorder (including failed
+    requests — a slow 500 is exactly what the recorder exists for)."""
     resource = request.match_info.route.resource
     endpoint = resource.canonical if resource is not None else "unmatched"
     if request.path.startswith(("/metrics", "/debug")):
         return await handler(request)
     t0 = time.perf_counter()
     status = 500
-    with tracing.trace(
-        f"{request.method} {endpoint}", method=request.method,
-        path=request.path,
-    ) as t:
-        try:
-            resp = await handler(request)
-            status = resp.status
-        except web.HTTPException as e:
-            status = e.status
-            if t is not None:
-                e.headers[TRACE_HEADER] = t.trace_id
-            raise
-        finally:
-            tracing.add_attr(status=status)
-            HTTP_SECONDS.labels(endpoint, request.method).observe(
-                time.perf_counter() - t0
-            )
-            HTTP_REQUESTS.labels(endpoint, request.method, str(status)).inc()
-    if t is not None:
-        resp.headers[TRACE_HEADER] = t.trace_id
+    finished = None
+    try:
+        with tracing.trace(
+            f"{request.method} {endpoint}", method=request.method,
+            path=request.path,
+        ) as t:
+            finished = t
+            try:
+                resp = await handler(request)
+                status = resp.status
+            except web.HTTPException as e:
+                status = e.status
+                if t is not None:
+                    e.headers[TRACE_HEADER] = t.trace_id
+                raise
+            finally:
+                tracing.add_attr(status=status)
+                HTTP_SECONDS.labels(endpoint, request.method).observe(
+                    time.perf_counter() - t0
+                )
+                HTTP_REQUESTS.labels(endpoint, request.method, str(status)).inc()
+    finally:
+        # the trace context exited above, so duration_s is final here
+        if finished is not None and endpoint in QUERY_ENDPOINTS:
+            state: ServerState = request.app[STATE_KEY]
+            try:
+                _record_slow_query(state.slowlog, finished)
+            except Exception:  # noqa: BLE001 — the flight recorder must
+                # never fail the request it is observing
+                logger.exception("slowlog record failed")
+    if finished is not None:
+        resp.headers[TRACE_HEADER] = finished.trace_id
     return resp
 
 
@@ -157,11 +196,13 @@ def snappy_decompress(buf: bytes) -> bytes:
 
 
 class ServerState:
-    def __init__(self, config: Config, storage, engine: MetricEngine, parser_pool=None):
+    def __init__(self, config: Config, storage, engine: MetricEngine,
+                 parser_pool=None, slowlog: "SlowLog | None" = None):
         self.config = config
         self.storage = storage       # demo ColumnarStorage (reference parity)
         self.engine = engine         # metric engine (remote-write path)
         self.parser_pool = parser_pool or ParserPool()
+        self.slowlog = slowlog       # slow-query flight recorder (or None)
         self.write_enabled = asyncio.Event()
         self.write_workers: list[asyncio.Task] = []
 
@@ -305,7 +346,7 @@ async def handle_remote_write(request: web.Request) -> web.Response:
     return web.json_response({"samples": n}, status=200)
 
 
-def _raw_table_response(table, limit: int) -> web.Response:
+def _raw_table_response(table, limit: int, explain: dict | None = None) -> web.Response:
     """Shared raw-row serialization (samples and exemplars): bounded by
     `limit` with a truncated flag; exemplar label blobs decode to dicts."""
     from horaedb_tpu.engine.types import decode_series_key
@@ -327,7 +368,90 @@ def _raw_table_response(table, limit: int) -> web.Response:
             }
             for blob in view.column("labels").to_pylist()
         ]
+    if explain is not None:
+        body["explain"] = explain
     return web.json_response(body)
+
+
+# ---------------------------------------------------------------------------
+# query EXPLAIN
+# ---------------------------------------------------------------------------
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def _want_explain(request: web.Request, params: dict | None = None) -> bool:
+    """`?explain=1` (query string, or merged PromQL form/JSON params)."""
+    v = request.query.get("explain", "")
+    if params is not None and not v:
+        v = str(params.get("explain", ""))
+    return v.lower() in _TRUTHY
+
+
+def _explain_payload(st, mode: str) -> dict:
+    """Assemble the plan a finished query leaves behind: what was touched
+    (regions, SSTs, bloom prunes), which routes/kernels served it
+    (scan path, dispatcher impl, instrumented-kernel envelopes), and where
+    the time went (per-lane stage seconds, compile vs steady split, the
+    roofline `bound` verdict). Pure dict assembly over the scanstats
+    collector — the query already paid for every number in here."""
+    att = st.attribution()
+    counts = dict(st.counts)
+    agg_impls = sorted(
+        k[len("agg_impl_"):] for k in counts if k.startswith("agg_impl_")
+    )
+    if not agg_impls and mode == "downsample":
+        # pushdowns that rode the sharded mesh path report via the
+        # process-global dispatcher provenance instead of a collector note
+        from horaedb_tpu.ops import agg_registry
+
+        last = agg_registry.last_choice()
+        if last:
+            agg_impls = [last]
+    scan_paths = sorted(
+        k[len("path_"):] for k in counts if k.startswith("path_")
+    )
+    compile_s = st.seconds.get("compile", 0.0)
+    total_s = sum(att["lanes_s"].values())
+    kernels = []
+    for entry in xprof.kernel_entries(st.kernels):
+        entry["calls"] = st.kernels.get(entry["kernel"], 0)
+        # the full signature map is catalog detail; EXPLAIN keeps the size
+        entry.pop("signatures", None)
+        kernels.append(entry)
+    return {
+        "mode": mode,
+        "regions": counts.get("regions_fanout", 1),
+        "ssts": {
+            "selected": counts.get("ssts_selected", 0),
+            "read": counts.get("ssts_read", 0),
+            "bloom_pruned": counts.get("ssts_bloom_pruned", 0),
+        },
+        "scan_paths": scan_paths,
+        "agg_impl": agg_impls[0] if agg_impls else None,
+        "agg_impls": agg_impls,
+        "stages_s": {k: round(v, 6) for k, v in st.seconds.items()},
+        "lanes_s": att["lanes_s"],
+        "bound": att["bound"],
+        "compile_s": round(compile_s, 6),
+        "steady_s": round(max(0.0, total_s - compile_s), 6),
+        "counts": counts,
+        "kernels": kernels,
+    }
+
+
+def _finish_explain(state: "ServerState", st, mode: str,
+                    want: bool) -> dict | None:
+    """Build the plan and attach it to the request's trace root so the
+    slow-query flight recorder (and /debug/traces/{id}) carries it even
+    when the caller did not ask for ?explain=1. Skipped entirely — zero
+    assembly cost on the hot path — when the caller didn't ask AND the
+    flight recorder is disabled (nobody would ever read it)."""
+    if not want and state.slowlog is None:
+        return None
+    explain = _explain_payload(st, mode)
+    tracing.add_attr(explain=explain, scanstats=st.as_dict())
+    return explain if want else None
 
 
 async def _promql_params(request: web.Request) -> dict:
@@ -372,13 +496,17 @@ async def handle_query_range(request: web.Request) -> web.Response:
         end_ms = int(float(p["end"]) * 1000)
         step_ms = parse_duration_ms(p["step"])
         ev = RangeEvaluator(state.engine, start_ms, end_ms, step_ms)
-        series = await ev.eval(expr)
+        with scanstats.scan_stats() as st:
+            series = await ev.eval(expr)
     except (PromQLError, HoraeError, KeyError, ValueError) as e:
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
-    return web.json_response(
-        {"status": "success", "data": to_prometheus_matrix(series, ev.steps)}
-    )
+    explain = _finish_explain(state, st, "promql_range",
+                              _want_explain(request, p))
+    body = {"status": "success", "data": to_prometheus_matrix(series, ev.steps)}
+    if explain is not None:
+        body["explain"] = explain
+    return web.json_response(body)
 
 
 async def handle_promql_instant(
@@ -402,13 +530,17 @@ async def handle_promql_instant(
         # instant = a one-step range ending at `time` (window functions need
         # a left context; LOOKBACK covers bare selectors)
         ev = RangeEvaluator(state.engine, at_ms - LOOKBACK_MS, at_ms, LOOKBACK_MS)
-        series = await ev.eval(expr)
+        with scanstats.scan_stats() as st:
+            series = await ev.eval(expr)
     except (PromQLError, HoraeError, ValueError) as e:
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
-    return web.json_response(
-        {"status": "success", "data": to_prometheus_vector(series, at_ms)}
-    )
+    explain = _finish_explain(state, st, "promql_instant",
+                              _want_explain(request, params))
+    body = {"status": "success", "data": to_prometheus_vector(series, at_ms)}
+    if explain is not None:
+        body["explain"] = explain
+    return web.json_response(body)
 
 
 async def handle_query(request: web.Request) -> web.Response:
@@ -448,6 +580,7 @@ async def handle_query(request: web.Request) -> web.Response:
                     "duplicate query parameter; use POST with matchers for "
                     "multiple constraints on one label"
                 )
+            qs.pop("explain", None)  # EXPLAIN flag, never a tag filter
             q = {
                 k: qs.pop(k)
                 for k in ("metric", "start_ms", "end_ms", "bucket_ms",
@@ -496,34 +629,48 @@ async def handle_query(request: web.Request) -> web.Response:
     except Exception as e:  # noqa: BLE001
         return web.json_response({"error": f"bad query: {e}"}, status=400)
     METRICS.inc("horaedb_queries_total")
+    want_explain = _want_explain(request, q)
+    mode = (
+        "exemplars" if q.get("exemplars")
+        else "raw" if req.bucket_ms is None else "downsample"
+    )
     try:
-        if q.get("exemplars"):
-            table = await state.engine.query_exemplars(req)
-            if table is None:
-                return web.json_response({"series": []})
-            return _raw_table_response(table, limit)
-        out = await state.engine.query(req)
+        with scanstats.scan_stats() as st:
+            if q.get("exemplars"):
+                table = await state.engine.query_exemplars(req)
+            else:
+                out = await state.engine.query(req)
     except HoraeError as e:
         return web.json_response({"error": str(e)}, status=400)
+    explain = _finish_explain(state, st, mode, want_explain)
+    if q.get("exemplars"):
+        if table is None:
+            return web.json_response(
+                {"series": [], **({"explain": explain} if explain else {})}
+            )
+        return _raw_table_response(table, limit, explain=explain)
     if out is None:
-        return web.json_response({"series": []})
+        return web.json_response(
+            {"series": [], **({"explain": explain} if explain else {})}
+        )
     if req.bucket_ms is None:
-        return _raw_table_response(out, limit)
+        return _raw_table_response(out, limit, explain=explain)
     tsids, grids = out
     # limit bounds the series dimension of bucketed responses too
     truncated = len(tsids) > limit
     tsids = tsids[:limit]
     mean = grids["mean"][:limit]
     count = grids["count"][:limit]
-    return web.json_response(
-        {
-            "tsids": [str(t) for t in tsids],
-            "buckets": grids["mean"].shape[1],
-            "truncated": truncated,
-            "mean": np.where(np.isnan(mean), None, mean).tolist(),
-            "count": count.tolist(),
-        }
-    )
+    body = {
+        "tsids": [str(t) for t in tsids],
+        "buckets": grids["mean"].shape[1],
+        "truncated": truncated,
+        "mean": np.where(np.isnan(mean), None, mean).tolist(),
+        "count": count.tolist(),
+    }
+    if explain is not None:
+        body["explain"] = explain
+    return web.json_response(body)
 
 
 async def handle_metrics_list(request: web.Request) -> web.Response:
@@ -637,14 +784,25 @@ async def handle_label_values(request: web.Request) -> web.Response:
 
 
 async def handle_debug_traces(request: web.Request) -> web.Response:
-    """Recent traces, newest first (summaries; span trees via /{id})."""
+    """Recent traces, newest first (summaries; span trees via /{id}).
+    `?limit=N` bounds the count; `?min_ms=X` keeps only traces at least
+    that slow — together they serve the operator's "last 10 slow traces"
+    pull without scraping the whole ring."""
     try:
         limit = int(request.query.get("limit", 50))
     except ValueError:
         return web.json_response({"error": "limit must be an int"}, status=400)
+    min_ms = None
+    if "min_ms" in request.query:
+        try:
+            min_ms = float(request.query["min_ms"])
+        except ValueError:
+            return web.json_response(
+                {"error": "min_ms must be a number"}, status=400
+            )
     return web.json_response({
         "sampling": tracing.sampling_enabled(),
-        "traces": tracing.recent(limit),
+        "traces": tracing.recent(limit, min_ms=min_ms),
     })
 
 
@@ -658,6 +816,53 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
             status=404,
         )
     return web.json_response(t)
+
+
+async def handle_debug_kernels(request: web.Request) -> web.Response:
+    """Process-wide instrumented-kernel catalog (common/xprof.py): per
+    kernel, the compile/retrace history, distinct arg-signatures, and —
+    where the backend supports cost/memory analysis — the predicted
+    FLOPs/bytes envelope with its arithmetic intensity. The static half of
+    the roofline story; /metrics' stage histograms are the measured half."""
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — catalog must render without a backend
+        backend = None
+    return web.json_response({
+        "backend": backend,
+        "totals": xprof.snapshot(),
+        "kernels": xprof.catalog(),
+    })
+
+
+async def handle_debug_slowlog(request: web.Request) -> web.Response:
+    """Slow-query flight recorder contents, slowest first: each entry is
+    one recorded request's full span tree + EXPLAIN payload. `?limit=N`
+    bounds the response; corrupt spool entries are skipped (logged +
+    counted in `corrupt_skipped`), never a 500."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.slowlog is None:
+        return web.json_response({
+            "enabled": False, "capacity": 0, "entries": [],
+        })
+    limit = None
+    if "limit" in request.query:
+        try:
+            limit = int(request.query["limit"])
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an int"}, status=400
+            )
+    entries, corrupt = state.slowlog.entries(limit=limit)
+    return web.json_response({
+        "enabled": True,
+        "capacity": state.slowlog.capacity,
+        "min_duration_s": state.slowlog.min_duration_s,
+        "corrupt_skipped": corrupt,
+        "entries": entries,
+    })
 
 
 async def handle_buildinfo(request: web.Request) -> web.Response:
@@ -778,6 +983,10 @@ async def build_app(config: Config) -> web.Application:
     # before the first aggregate dispatch
     from horaedb_tpu.ops import agg_registry
 
+    # same contract for the horaedb_jit_* families (lazy by module
+    # layering; forced here so scrapers see the zero state from boot)
+    xprof.register_metrics()
+
     if store_cfg.type.lower() == "s3like":
         from horaedb_tpu.objstore.s3 import S3LikeStore
 
@@ -835,7 +1044,19 @@ async def build_app(config: Config) -> web.Application:
         )
     else:
         engine = await MetricEngine.open("metrics", store, **engine_kwargs)
-    state = ServerState(config, storage, engine, parser_pool=pool)
+    slow = None
+    if config.slowlog.capacity > 0:
+        import os as _os
+
+        # the spool is per-box diagnostic state, like the agg-calib cache:
+        # it lives under the LOCAL data dir even for S3 deployments
+        slow = SlowLog(
+            _os.path.join(store_cfg.data_dir, "slowlog"),
+            capacity=config.slowlog.capacity,
+            min_duration_s=config.slowlog.min_duration.seconds,
+        )
+    state = ServerState(config, storage, engine, parser_pool=pool,
+                        slowlog=slow)
     if config.test.enable_write:
         state.write_enabled.set()
     for i in range(config.test.write_worker_num):
@@ -891,6 +1112,8 @@ async def build_app(config: Config) -> web.Application:
             web.get("/api/v1/status/buildinfo", handle_buildinfo),
             web.get("/debug/traces", handle_debug_traces),
             web.get("/debug/traces/{id}", handle_debug_trace),
+            web.get("/debug/kernels", handle_debug_kernels),
+            web.get("/debug/slowlog", handle_debug_slowlog),
         ]
     )
 

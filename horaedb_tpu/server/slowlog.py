@@ -1,0 +1,219 @@
+"""Slow-query flight recorder: the N slowest requests, spooled to disk.
+
+The trace ring (/debug/traces) answers "what just happened" but is
+bounded and churns under load: by the time an operator investigates last
+night's p99 spike, the offending trace is long evicted. This module keeps
+the N SLOWEST query requests — full span tree + scanstats + EXPLAIN
+payload — as individual JSON files under `<data-root>/slowlog/`, with
+bounded rotation (admission = being among the N slowest), served at
+GET /debug/slowlog.
+
+Design:
+- one file per entry, named `<duration_ms padded>-<trace_id>.json`, so
+  the duration ordering is recoverable from the DIRECTORY LISTING alone —
+  restart rebuilds the index without parsing a single body, and a corrupt
+  body can never corrupt admission;
+- admission under a lock: below capacity everything >= min_duration is
+  admitted; at capacity a new entry must beat the current fastest kept
+  entry, which is evicted (files deleted) — "keeps exactly N";
+- reads are forgiving: a corrupt spool file is skipped LOUDLY (WARNING
+  log + `horaedb_slowlog_corrupt_total`) and reported in the response
+  meta, never a 500 — the flight recorder must stay readable after a
+  partial write or a disk hiccup.
+
+Writes happen on the serving path but are one small JSON dump amortized
+over requests that were, by admission, already slow; operators who need
+zero disk writes set capacity 0 (disabled).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+from pathlib import Path
+
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+logger = logging.getLogger(__name__)
+
+SLOWLOG_RECORDS = GLOBAL_METRICS.counter(
+    "horaedb_slowlog_records_total",
+    help="Requests admitted to the slow-query flight recorder.",
+)
+SLOWLOG_CORRUPT = GLOBAL_METRICS.counter(
+    "horaedb_slowlog_corrupt_total",
+    help="Unreadable slowlog spool entries skipped on read.",
+)
+SLOWLOG_ENTRIES = GLOBAL_METRICS.gauge(
+    "horaedb_slowlog_entries",
+    help="Entries currently kept by the slow-query flight recorder.",
+)
+
+# `<duration_ms, zero-padded 12>-<trace_id hex>.json`
+_NAME_RE = re.compile(r"^(\d{12})-([0-9a-f]+)\.json$")
+
+
+def _fname(duration_s: float, trace_id: str) -> str:
+    ms = max(0, min(int(duration_s * 1000.0), 10 ** 12 - 1))
+    return f"{ms:012d}-{trace_id}.json"
+
+
+class SlowLog:
+    """Bounded slowest-N spool over one directory. Thread-safe; safe to
+    share between the event loop and worker threads (the JSON dump is
+    small and admission already implies the request was slow)."""
+
+    def __init__(self, directory: str | Path, capacity: int = 32,
+                 min_duration_s: float = 0.0):
+        self._dir = Path(directory)
+        self.capacity = max(0, int(capacity))
+        self.min_duration_s = float(min_duration_s)
+        self._lock = threading.Lock()
+        # trace_id -> (duration_ms, Path); rebuilt from filenames alone
+        self._index: dict[str, tuple[int, Path]] = {}
+        if self.capacity:
+            self._dir.mkdir(parents=True, exist_ok=True)
+            self._load()
+
+    # -- startup -----------------------------------------------------------
+    def _load(self) -> None:
+        """Rebuild the index from filenames (no body parses) and prune to
+        capacity — a restart with a smaller configured N keeps the N
+        slowest survivors, deleting the rest."""
+        for p in self._dir.iterdir():
+            m = _NAME_RE.match(p.name)
+            if m is None:
+                if p.suffix == ".tmp":
+                    # a crash between write_text and rename orphans the
+                    # temp file; reclaim it instead of leaking one per crash
+                    try:
+                        p.unlink()
+                    except OSError:
+                        pass
+                elif p.suffix == ".json":
+                    logger.warning("slowlog: unrecognized spool file %s "
+                                   "(ignored)", p)
+                continue
+            self._index[m.group(2)] = (int(m.group(1)), p)
+        while len(self._index) > self.capacity:
+            self._evict_fastest_locked()
+        SLOWLOG_ENTRIES.set(len(self._index))
+
+    def _evict_fastest_locked(self) -> None:
+        victim = min(self._index, key=lambda t: self._index[t][0])
+        _, path = self._index.pop(victim)
+        try:
+            path.unlink()
+        except OSError:
+            logger.warning("slowlog: could not delete evicted entry %s", path)
+
+    # -- write path --------------------------------------------------------
+    def admit(self, duration_s: float) -> bool:
+        """Cheap pre-check: would a request this slow be kept? Takes the
+        lock for the index scan — record() in a worker thread mutates the
+        dict, and an unlocked iteration could raise mid-scan."""
+        if not self.capacity or duration_s < self.min_duration_s:
+            return False
+        with self._lock:
+            if len(self._index) < self.capacity:
+                return True
+            fastest = min(d for d, _ in self._index.values())
+        return duration_s * 1000.0 > fastest
+
+    def record(self, trace_id: str, duration_s: float, entry: dict) -> bool:
+        """Admit one finished request. `entry` must be JSON-serializable
+        (trace tree + explain payload). Returns whether it was kept."""
+        if not self.capacity or duration_s < self.min_duration_s:
+            return False
+        ms = int(duration_s * 1000.0)
+        with self._lock:
+            if len(self._index) >= self.capacity:
+                fastest = min(d for d, _ in self._index.values())
+                if ms <= fastest:
+                    return False
+            path = self._dir / _fname(duration_s, trace_id)
+            try:
+                body = json.dumps(entry)
+                tmp = path.with_suffix(".tmp")
+                tmp.write_text(body)
+                tmp.rename(path)  # atomic: readers never see a torn body
+            except Exception:  # noqa: BLE001 — a non-serializable attr or a
+                # disk error must degrade to "not recorded", never fail the
+                # request the middleware is finishing
+                logger.warning("slowlog: could not spool entry %s", path,
+                               exc_info=True)
+                return False
+            # same trace_id re-recorded (should not happen — ids are
+            # random) keeps the newer file
+            old = self._index.pop(trace_id, None)
+            if old is not None and old[1] != path:
+                try:
+                    old[1].unlink()
+                except OSError:
+                    pass
+            self._index[trace_id] = (ms, path)
+            while len(self._index) > self.capacity:
+                self._evict_fastest_locked()
+            SLOWLOG_ENTRIES.set(len(self._index))
+        SLOWLOG_RECORDS.inc()
+        return True
+
+    # -- read path ---------------------------------------------------------
+    def entries(self, limit: int | None = None) -> tuple[list[dict], int]:
+        """(entries slowest-first, corrupt-skipped count). Each entry is
+        the recorded dict plus `trace_id`/`duration_ms` recovered from the
+        filename (authoritative even if the body lies)."""
+        with self._lock:
+            items = sorted(
+                self._index.items(), key=lambda kv: -kv[1][0]
+            )
+        if limit is not None:
+            items = items[:limit]
+        out: list[dict] = []
+        corrupt = 0
+        for trace_id, (ms, path) in items:
+            try:
+                body = json.loads(path.read_text())
+                if not isinstance(body, dict):
+                    raise ValueError("spool entry is not a JSON object")
+            except FileNotFoundError:
+                # a concurrent record() evicted this entry between the
+                # index snapshot and the read — healthy churn, not
+                # corruption
+                continue
+            except (OSError, ValueError) as e:
+                corrupt += 1
+                SLOWLOG_CORRUPT.inc()
+                logger.warning("slowlog: skipping corrupt spool entry %s: %s",
+                               path, e)
+                continue
+            body.setdefault("trace_id", trace_id)
+            body["duration_ms"] = ms
+            out.append(body)
+        return out, corrupt
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+
+def build_entry(trace_dict: dict, explain: dict | None) -> dict:
+    """The spooled payload for one slow request: the full span tree (whose
+    root attrs carry the scanstats stages) plus the EXPLAIN plan. The
+    plan also sits in the trace ROOT's attrs (the handler attached it
+    there for /debug/traces); drop that copy — it is byte-identical to
+    the top-level `explain` and would double the spool size."""
+    root = trace_dict.get("root")
+    if isinstance(root, dict) and isinstance(root.get("attrs"), dict):
+        root["attrs"].pop("explain", None)
+    return {
+        "trace_id": trace_dict.get("trace_id"),
+        "name": trace_dict.get("name"),
+        "duration_s": trace_dict.get("duration_s"),
+        "recorded_unix_ms": int(time.time() * 1000),
+        "explain": explain,
+        "trace": trace_dict,
+    }

@@ -44,6 +44,7 @@ import pyarrow.parquet as pq
 
 from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.common.xprof import xjit
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
 from horaedb_tpu.ops import dedup as dedup_ops
@@ -397,7 +398,7 @@ def _build_packed_index_kernel(seq_width: int, do_dedup: bool):
     bytes/survivor outbound. Dedup needs no pk gathers: the group id is
     packed >> seq_width."""
 
-    @jax.jit
+    @xjit(kernel="packed_merge")
     def kernel(packed, num_valid):
         n = packed.shape[0]
         iota = jnp.arange(n, dtype=jnp.int32)
@@ -550,13 +551,13 @@ def _build_index_kernel(
 
     if use_mask:
 
-        @jax.jit
+        @xjit(kernel="index_merge_mask")
         def kernel(cols: dict, ext_mask, num_valid):
             return core(cols, ext_mask != 0, num_valid)
 
     else:
 
-        @jax.jit
+        @xjit(kernel="index_merge_filter")
         def kernel(cols: dict, literals: tuple, num_valid):
             n = cols[sort_keys[0]].shape[0]
             mask = filter_ops.eval_predicate(template, cols, literals)
@@ -890,7 +891,7 @@ def _build_scan_kernel(
     two cumsums + one scatter of arange.
     """
 
-    @jax.jit
+    @xjit(kernel="scan_kernel")
     def kernel(cols: dict, literals: tuple, num_valid):
         n = cols[sort_keys[0]].shape[0]
         valid = jnp.arange(n) < num_valid
@@ -1108,11 +1109,14 @@ class ParquetReader:
         bloom sidecar rules the predicate out)."""
         path = self._path_gen.generate(sst.id)
         if predicate is not None and await self._bloom_skip(sst, predicate):
+            # EXPLAIN provenance: this SST never cost any IO
+            scanstats.note("ssts_bloom_pruned")
             fields = [
                 f for f in self._schema.arrow_schema
                 if columns is None or f.name in columns
             ]
             return pa.schema(fields).empty_table()
+        scanstats.note("ssts_read")
         cols_key = tuple(sorted(columns)) if columns is not None else ("*",)
         rg_cache = self._rg_cache_hooks(sst.id, cols_key) if use_block_cache else None
         if rg_cache is not None:

@@ -37,8 +37,9 @@ from horaedb_tpu.server.metrics import GLOBAL_METRICS
 # Canonical lane names for the /metrics histogram: the raw stage names are
 # scan-internal (h2d/d2h/device_merge), but operators reason in the three
 # lanes VERDICT r02 established — IO+decode, host<->device transfer, XLA
-# kernel. Stages outside the map keep their own label (host_merge,
-# host_filter, materialize, encode, ...).
+# kernel — plus the compile lane xprof feeds (a retrace storm looks like a
+# kernel stall unless it has its own label). Stages outside the map keep
+# their own label (host_merge, host_filter, materialize, encode, ...).
 _STAGE_LANE = {
     "h2d": "transfer",
     "d2h": "transfer",
@@ -49,21 +50,37 @@ _STAGE_LANE = {
 STAGE_SECONDS = GLOBAL_METRICS.histogram(
     "horaedb_scan_stage_seconds",
     help="Per-stage scan time by lane (io_decode, host_prep, transfer, "
-         "kernel, ...): the request-attribution view of scanstats.",
+         "kernel, compile, ...): the request-attribution view of scanstats.",
     labelnames=("stage",),
 )
 # Pre-register the canonical lanes so /metrics always exposes the full
 # attribution surface (zero-count histograms), even before the first scan
 # routes through a given lane on this process.
-for _lane in ("io_decode", "host_prep", "transfer", "kernel"):
+for _lane in ("io_decode", "host_prep", "transfer", "kernel", "compile"):
     STAGE_SECONDS.labels(_lane)
 del _lane
+
+# Roofline-attribution lane of each stage (attribution(), query EXPLAIN):
+# anything not listed is host-side work.
+_BOUND_LANE = {
+    "io_decode": "io",
+    "h2d": "transfer",
+    "d2h": "transfer",
+    "transfer": "transfer",
+    "device_merge": "kernel",
+    "device_agg": "kernel",
+    "kernel": "kernel",
+    "compile": "compile",
+}
 
 
 @dataclass
 class ScanStats:
     seconds: dict[str, float] = field(default_factory=dict)
     counts: dict[str, int] = field(default_factory=dict)
+    # instrumented-kernel invocations (common/xprof.py feeds this): which
+    # device kernels this query actually ran, and how often
+    kernels: dict[str, int] = field(default_factory=dict)
 
     def add(self, stage: str, secs: float) -> None:
         self.seconds[stage] = self.seconds.get(stage, 0.0) + secs
@@ -77,8 +94,36 @@ class ScanStats:
         out.update({k: v for k, v in self.counts.items() if k not in self.seconds})
         return out
 
+    def attribution(self) -> dict:
+        """Fold the raw stage seconds into the roofline lanes and name the
+        binding one: `bound` in io | transfer | kernel | compile | host
+        (None when nothing was timed). This is the live half of the
+        roofline story — xprof's kernel catalog supplies the predicted
+        FLOPs/bytes envelope, this supplies the measured split."""
+        lanes = {"io": 0.0, "host": 0.0, "transfer": 0.0, "kernel": 0.0,
+                 "compile": 0.0}
+        for stage_name, secs in self.seconds.items():
+            lanes[_BOUND_LANE.get(stage_name, "host")] += secs
+        bound = max(lanes, key=lanes.get) if any(lanes.values()) else None
+        return {
+            "lanes_s": {k: round(v, 6) for k, v in lanes.items()},
+            "bound": bound,
+        }
+
 
 _ACTIVE: ContextVar[ScanStats | None] = ContextVar("horaedb_scan_stats", default=None)
+
+# Compile-time deduction cell of the innermost open stage() block (None
+# outside any stage). Compiles fire INSIDE stage bodies — xprof's wrapper
+# detects them mid-`device_agg`/`device_merge` — so without this the
+# compile wall time would land in BOTH the enclosing stage's lane and the
+# compile lane, the kernel lane would always dominate, and `bound` could
+# never actually say "compile". record("compile", ...) credits the cell;
+# stage() subtracts it from its own elapsed time on close and propagates
+# it to the enclosing stage's cell (nested stages must deduct too).
+_COMPILE_DEDUCT: ContextVar["list[float] | None"] = ContextVar(
+    "horaedb_scan_compile_deduct", default=None
+)
 
 
 @contextmanager
@@ -102,15 +147,48 @@ def stage(name: str):
     perf_counter calls + one histogram observe are noise next to the work
     itself."""
     st = _ACTIVE.get()
+    cell = [0.0]
+    token = _COMPILE_DEDUCT.set(cell)
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        dt = time.perf_counter() - t0
+        dt = max(0.0, time.perf_counter() - t0 - cell[0])
+        _COMPILE_DEDUCT.reset(token)
+        outer = _COMPILE_DEDUCT.get()
+        if outer is not None:
+            outer[0] += cell[0]
         if st is not None:
             st.add(name, dt)
         STAGE_SECONDS.labels(_STAGE_LANE.get(name, name)).observe(dt)
         tracing.add_stage(name, dt)
+
+
+def record(name: str, secs: float) -> None:
+    """Fold an externally-timed duration in as if a stage() block measured
+    it: collector + process histogram + active trace span. xprof reports
+    compile time through this (the compile happens inside jax's dispatch,
+    where no `with stage(...):` block can wrap it); a compile recorded
+    inside an open stage is deducted from that stage so the time is
+    attributed ONCE — to the compile lane."""
+    if name == "compile":
+        cell = _COMPILE_DEDUCT.get()
+        if cell is not None:
+            cell[0] += secs
+    st = _ACTIVE.get()
+    if st is not None:
+        st.add(name, secs)
+    STAGE_SECONDS.labels(_STAGE_LANE.get(name, name)).observe(secs)
+    tracing.add_stage(name, secs)
+
+
+def kernel_use(name: str) -> None:
+    """Note one invocation of an instrumented kernel on the active
+    collector (no-op without one — one contextvar get, the same
+    steady-state budget as span())."""
+    st = _ACTIVE.get()
+    if st is not None:
+        st.kernels[name] = st.kernels.get(name, 0) + 1
 
 
 def active() -> bool:
@@ -126,3 +204,12 @@ def note(name: str, n: int = 1) -> None:
     st = _ACTIVE.get()
     if st is not None:
         st.count(name, n)
+
+
+def note_max(name: str, n: int) -> None:
+    """Record the MAXIMUM of `n` across the collector's lifetime instead
+    of a running sum — for width-style facts (e.g. regions fanned out)
+    that repeat per sub-query and would over-report if accumulated."""
+    st = _ACTIVE.get()
+    if st is not None:
+        st.counts[name] = max(st.counts.get(name, 0), n)

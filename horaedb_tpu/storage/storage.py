@@ -37,6 +37,7 @@ from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import sort as sort_ops
 from horaedb_tpu.ops.blocks import arrow_column_to_numpy
 from horaedb_tpu.server.metrics import BYTES_BUCKETS, GLOBAL_METRICS
+from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.config import StorageConfig
 from horaedb_tpu.storage.manifest import Manifest
 from horaedb_tpu.storage.read import (
@@ -580,6 +581,9 @@ class ObjectBasedStorage(ColumnarStorage):
         ssts = self._manifest.find_ssts(req.range)
         if req.min_sst_id is not None:
             ssts = [s for s in ssts if s.id > req.min_sst_id]
+        # EXPLAIN provenance: time-range SST selection (reads and bloom
+        # prunes are noted per SST in read.py)
+        scanstats.note("ssts_selected", len(ssts))
         if not ssts:
             return
         segments = self.group_by_segment(ssts)

@@ -108,9 +108,10 @@ class TestJaxlintGate:
             tmp_path,
             "import jax\n"
             "from horaedb_tpu.common import tracing\n"
+            "from horaedb_tpu.common.xprof import xjit\n"
             "from horaedb_tpu.storage import scanstats\n"
             "\n"
-            "@jax.jit\n"
+            "@xjit(kernel='k')\n"
             "def kernel(x):\n"
             "    return x.sum()\n"
             "\n"
@@ -120,7 +121,7 @@ class TestJaxlintGate:
             "    with tracing.span('collect'):\n"
             "        return out\n"
             "\n"
-            "@jax.jit\n"
+            "@xjit(kernel='s')\n"
             "def suppressed(x):\n"
             "    # jaxlint: disable=J005 measured: trace-time probe only\n"
             "    with scanstats.stage('trace_probe'):\n"
@@ -142,8 +143,9 @@ class TestJaxlintGate:
             "import jax\n"
             "import jax.numpy as jnp\n"
             "import numpy as np\n"
+            "from horaedb_tpu.common.xprof import xjit\n"
             "\n"
-            "@partial(jax.jit, static_argnames=('n',))\n"
+            "@partial(xjit, static_argnames=('n',))\n"
             "def kernel(x, n):\n"
             "    # device-side jnp.asarray is not a sync; int dtype literals\n"
             "    # are exact; f-strings and prints live OUTSIDE the kernel\n"
@@ -286,13 +288,90 @@ class TestJaxlintGate:
             "    return k[:, None] == jax.lax.broadcasted_iota(\n"
             "        jnp.int32, (4, n), 1)\n"
             "\n"
-            "@jax.jit\n"
+            "from horaedb_tpu.common.xprof import xjit\n"
+            "\n"
+            "@xjit(kernel='sup')\n"
             "def suppressed(grid, idx, v):\n"
             "    # jaxlint: disable=J006 measured: registry lane loses here\n"
             "    np.add.at(grid, idx, v)\n"
             "    return grid\n"
         )
         r = run_jaxlint(ok)
+        assert r.returncode == 0, r.stdout
+
+    def test_j007_naked_jit_in_hot_modules_fires(self, tmp_path):
+        """Every naked-jit spelling in ops//parallel//promql/ is an error:
+        decorator, partial-decorator, inline call, and the import-alias
+        escape hatch — each silently bypasses xprof's compile telemetry."""
+        bad = hot_file(
+            tmp_path,
+            "from functools import partial\n"
+            "import jax\n"
+            "from jax import jit\n"
+            "\n"
+            "@jax.jit\n"
+            "def a(x):\n"
+            "    return x\n"
+            "\n"
+            "@partial(jax.jit, static_argnames=('n',))\n"
+            "def b(x, n):\n"
+            "    return x + n\n"
+            "\n"
+            "c = jax.jit(lambda x: x)\n"
+        )
+        r = run_jaxlint(bad)
+        assert r.returncode != 0
+        assert r.stdout.count("J007") == 4, r.stdout  # import + 3 uses
+
+    def test_j007_xjit_and_suppressions_pass(self, tmp_path):
+        """The sanctioned spelling (xprof.xjit, any form) and reasoned
+        suppressions pass; xjit-wrapped bodies STAY under the in-jit
+        rules (a J001 host sync inside one still fires)."""
+        ok = hot_file(
+            tmp_path,
+            "from functools import partial\n"
+            "import jax\n"
+            "from horaedb_tpu.common.xprof import xjit\n"
+            "\n"
+            "@xjit(kernel='a', static_argnames=('n',))\n"
+            "def a(x, n):\n"
+            "    return x + n\n"
+            "\n"
+            "@partial(xjit, static_argnames=('n',))\n"
+            "def b(x, n):\n"
+            "    return x + n\n"
+            "\n"
+            "c = xjit(lambda x: x, kernel='c')\n"
+            "\n"
+            "# jaxlint: disable=J007 A/B probe outside the query path\n"
+            "d = jax.jit(lambda x: x)\n"
+        )
+        r = run_jaxlint(ok)
+        assert r.returncode == 0, r.stdout
+        bad = hot_file(
+            tmp_path,
+            "import numpy as np\n"
+            "from horaedb_tpu.common.xprof import xjit\n"
+            "\n"
+            "@xjit(kernel='k')\n"
+            "def k(x):\n"
+            "    return np.asarray(x)\n"
+        )
+        r = run_jaxlint(bad)
+        assert r.returncode != 0
+        assert "J001" in r.stdout, r.stdout
+
+    def test_j007_outside_hot_modules_not_flagged(self, tmp_path):
+        """storage/, engine/, bench harnesses, and common/xprof.py itself
+        keep plain jax.jit (the wrapper must be allowed to exist)."""
+        d = tmp_path / "horaedb_tpu" / "common"
+        d.mkdir(parents=True, exist_ok=True)
+        f = d / "xprof.py"
+        f.write_text(
+            "import jax\n"
+            "wrapped = jax.jit(lambda x: x)\n"
+        )
+        r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
 
     def test_j006_registry_modules_exempt_from_onehot(self, tmp_path):

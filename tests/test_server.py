@@ -519,6 +519,247 @@ data_dir = "{tmp_path}/data"
         c.validate()
         assert c.tracing.slow_threshold.as_millis() == 250
 
+    @async_test
+    async def test_debug_traces_limit_and_min_ms(self, tmp_path):
+        """?limit= bounds the ring dump; ?min_ms= filters to slow traces
+        only — together the 'last N slow traces' operator pull."""
+        from horaedb_tpu.common import tracing
+
+        tracing.configure(sample=1.0)
+        client = await make_client(tmp_path)
+        try:
+            for _ in range(5):
+                r = await client.get("/api/v1/metrics")
+                assert r.status == 200
+            r = await client.get("/debug/traces?limit=2")
+            body = await r.json()
+            assert len(body["traces"]) == 2
+            # every real trace here is far under 10 minutes
+            r = await client.get("/debug/traces?min_ms=600000")
+            body = await r.json()
+            assert body["traces"] == []
+            # threshold 0 keeps everything (same as no filter)
+            r = await client.get("/debug/traces?min_ms=0&limit=3")
+            body = await r.json()
+            assert len(body["traces"]) == 3
+            r = await client.get("/debug/traces?min_ms=abc")
+            assert r.status == 400
+            r = await client.get("/debug/traces?limit=abc")
+            assert r.status == 400
+        finally:
+            await client.close()
+
+
+# the pinned EXPLAIN plan schema: every key a dashboard / the flight
+# recorder may rely on (values vary per run; the SHAPE must not)
+EXPLAIN_KEYS = {
+    "mode", "regions", "ssts", "scan_paths", "agg_impl", "agg_impls",
+    "stages_s", "lanes_s", "bound", "compile_s", "steady_s", "counts",
+    "kernels",
+}
+EXPLAIN_LANES = {"io", "host", "transfer", "kernel", "compile"}
+
+
+class TestExplain:
+    @async_test
+    async def test_explain_schema_native_and_promql(self, tmp_path):
+        """?explain=1 returns the pinned plan schema on the native raw +
+        downsample forms and the PromQL instant + range forms; without
+        the flag no explain key appears."""
+        client = await make_client(tmp_path)
+        try:
+            payload = make_remote_write(
+                [({"__name__": "exq", "host": h}, [(1000, 1.0), (2000, 2.0)])
+                 for h in ("a", "b")]
+            )
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200
+
+            def check_plan(plan, mode):
+                assert plan is not None, "explain missing"
+                assert EXPLAIN_KEYS <= set(plan), sorted(plan)
+                assert plan["mode"] == mode
+                assert EXPLAIN_LANES <= set(plan["lanes_s"])
+                assert set(plan["ssts"]) == {"selected", "read",
+                                             "bloom_pruned"}
+                assert isinstance(plan["compile_s"], (int, float))
+                assert isinstance(plan["steady_s"], (int, float))
+                assert plan["regions"] >= 1
+                for k in plan["kernels"]:
+                    assert {"kernel", "compiles", "calls"} <= set(k)
+
+            # native raw
+            r = await client.post(
+                "/api/v1/query?explain=1",
+                json={"metric": "exq", "start_ms": 0, "end_ms": 10_000},
+            )
+            body = await r.json()
+            assert r.status == 200 and body["rows"] == 4, body
+            check_plan(body.get("explain"), "raw")
+            assert body["explain"]["ssts"]["selected"] >= 1
+            assert body["explain"]["bound"] is not None
+
+            # native downsample: the plan names the dispatcher impl
+            r = await client.post(
+                "/api/v1/query?explain=1",
+                json={"metric": "exq", "start_ms": 0, "end_ms": 4000,
+                      "bucket_ms": 2000},
+            )
+            body = await r.json()
+            assert r.status == 200, body
+            check_plan(body.get("explain"), "downsample")
+            assert body["explain"]["agg_impl"], body["explain"]
+
+            # GET form: explain must act as a flag, NOT leak into filters
+            r = await client.get(
+                "/api/v1/query?metric=exq&start_ms=0&end_ms=10000&explain=1"
+            )
+            body = await r.json()
+            assert r.status == 200 and body["rows"] == 4, body
+            check_plan(body.get("explain"), "raw")
+
+            # PromQL instant
+            r = await client.get(
+                "/api/v1/query?query=exq&time=2&explain=1"
+            )
+            body = await r.json()
+            assert r.status == 200 and body["status"] == "success", body
+            check_plan(body.get("explain"), "promql_instant")
+
+            # PromQL range
+            r = await client.get(
+                "/api/v1/query_range?query=sum_over_time(exq[1s])"
+                "&start=0&end=4&step=1&explain=1"
+            )
+            body = await r.json()
+            assert r.status == 200 and body["status"] == "success", body
+            check_plan(body.get("explain"), "promql_range")
+
+            # no flag -> no explain key on any form
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "exq", "start_ms": 0, "end_ms": 10_000},
+            )
+            body = await r.json()
+            assert "explain" not in body
+            r = await client.get("/api/v1/query?query=exq&time=2")
+            body = await r.json()
+            assert "explain" not in body
+        finally:
+            await client.close()
+
+
+class TestDebugKernels:
+    @async_test
+    async def test_kernel_catalog_served(self, tmp_path):
+        """/debug/kernels lists the instrumented kernels with compile
+        telemetry; the import graph alone registers the ops/ kernels."""
+        client = await make_client(tmp_path)
+        try:
+            r = await client.get("/debug/kernels")
+            assert r.status == 200
+            body = await r.json()
+            assert isinstance(body["kernels"], list)
+            names = {k["kernel"] for k in body["kernels"]}
+            # the registry block kernels register at import time
+            assert "block_sum_count" in names, sorted(names)
+            assert {"total_compiles", "total_compile_seconds"} <= set(
+                body["totals"]
+            )
+            for entry in body["kernels"]:
+                assert {"kernel", "compiles", "cache_entries",
+                        "compile_seconds"} <= set(entry)
+        finally:
+            await client.close()
+
+
+class TestSlowlogEndpoint:
+    @async_test
+    async def test_query_lands_in_slowlog_and_survives(self, tmp_path):
+        """A query request spools into <data>/slowlog (default min
+        duration 0 admits it), /debug/slowlog serves it with its trace
+        tree + explain payload, and a second server over the same data
+        dir still sees it (restart survival through the HTTP surface)."""
+        from horaedb_tpu.common import tracing
+
+        tracing.configure(sample=1.0)
+        client = await make_client(tmp_path)
+        try:
+            payload = make_remote_write(
+                [({"__name__": "slowm", "host": "a"}, [(1000, 5.0)])]
+            )
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "slowm", "start_ms": 0, "end_ms": 10_000},
+            )
+            assert r.status == 200
+            trace_id = r.headers["X-Horaedb-Trace-Id"]
+            r = await client.get("/debug/slowlog")
+            body = await r.json()
+            assert body["enabled"] is True
+            ids = [e["trace_id"] for e in body["entries"]]
+            assert trace_id in ids, body
+            entry = next(e for e in body["entries"]
+                         if e["trace_id"] == trace_id)
+            assert entry["trace"]["root"]["name"] == "POST /api/v1/query"
+            # the recorder carries the full plan even though the caller
+            # never sent ?explain=1
+            assert entry["explain"]["mode"] == "raw"
+            assert EXPLAIN_KEYS <= set(entry["explain"])
+            # writes (non-query endpoints) never spool
+            assert all(
+                e["trace"]["root"]["name"] != "POST /api/v1/write"
+                for e in body["entries"]
+            )
+            # ?limit= bounds the response
+            r = await client.get("/debug/slowlog?limit=0")
+            body = await r.json()
+            assert body["entries"] == []
+        finally:
+            await client.close()
+        # restart over the same data dir: the spool is durable
+        client2 = await make_client(tmp_path)
+        try:
+            r = await client2.get("/debug/slowlog")
+            body = await r.json()
+            assert trace_id in [e["trace_id"] for e in body["entries"]]
+        finally:
+            await client2.close()
+
+    @async_test
+    async def test_slowlog_disabled_by_config(self, tmp_path):
+        cfg = Config.from_toml(
+            f"""
+port = 0
+[slowlog]
+capacity = 0
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+"""
+        )
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/debug/slowlog")
+            body = await r.json()
+            assert body == {"enabled": False, "capacity": 0, "entries": []}
+        finally:
+            await client.close()
+
+    def test_slowlog_config_parses_and_validates(self):
+        c = Config.from_toml(
+            '[slowlog]\ncapacity = 5\nmin_duration = "100ms"\n'
+        )
+        c.validate()
+        assert c.slowlog.capacity == 5
+        assert c.slowlog.min_duration.as_millis() == 100
+        with pytest.raises(HoraeError, match="slowlog.capacity"):
+            Config.from_toml("[slowlog]\ncapacity = -1\n").validate()
+
 
 class TestMetadata:
     @async_test

@@ -1,0 +1,156 @@
+"""Slow-query flight recorder (server/slowlog.py): admission keeps
+exactly the N slowest, the spool survives restart, and corrupt entries
+are skipped loudly instead of failing the read."""
+
+import json
+
+from horaedb_tpu.server.slowlog import SlowLog, build_entry
+
+
+def entry(i: int) -> dict:
+    return {"trace_id": f"{i:016x}", "name": "q", "trace": {"spans": i}}
+
+
+class TestAdmission:
+    def test_keeps_exactly_n_slowest(self, tmp_path):
+        sl = SlowLog(tmp_path / "slow", capacity=3)
+        durations = [0.010, 0.050, 0.030, 0.005, 0.200, 0.040]
+        for i, d in enumerate(durations):
+            sl.record(f"{i:016x}", d, entry(i))
+        assert len(sl) == 3
+        entries, corrupt = sl.entries()
+        assert corrupt == 0
+        # slowest first: 200ms, 50ms, 40ms survive; the rest were evicted
+        assert [e["duration_ms"] for e in entries] == [200, 50, 40]
+        # exactly 3 spool files on disk — eviction deletes bodies
+        assert len(list((tmp_path / "slow").glob("*.json"))) == 3
+
+    def test_faster_than_the_kept_set_is_rejected(self, tmp_path):
+        sl = SlowLog(tmp_path / "slow", capacity=2)
+        assert sl.record("a" * 16, 0.5, entry(1))
+        assert sl.record("b" * 16, 0.4, entry(2))
+        assert not sl.record("c" * 16, 0.1, entry(3))
+        assert len(sl) == 2
+        assert not sl.admit(0.1)
+        assert sl.admit(0.6)
+
+    def test_min_duration_gate(self, tmp_path):
+        sl = SlowLog(tmp_path / "slow", capacity=8, min_duration_s=0.1)
+        assert not sl.record("a" * 16, 0.05, entry(1))
+        assert sl.record("b" * 16, 0.15, entry(2))
+        assert len(sl) == 1
+
+    def test_capacity_zero_disables(self, tmp_path):
+        sl = SlowLog(tmp_path / "slow", capacity=0)
+        assert not sl.admit(10.0)
+        assert not sl.record("a" * 16, 10.0, entry(1))
+        # disabled recorder never creates the directory
+        assert not (tmp_path / "slow").exists()
+
+
+class TestRestart:
+    def test_index_survives_restart(self, tmp_path):
+        sl = SlowLog(tmp_path / "slow", capacity=4)
+        for i, d in enumerate([0.3, 0.1, 0.2]):
+            sl.record(f"{i:016x}", d, entry(i))
+        fresh = SlowLog(tmp_path / "slow", capacity=4)
+        assert len(fresh) == 3
+        entries, _ = fresh.entries()
+        assert [e["duration_ms"] for e in entries] == [300, 200, 100]
+        # admission state carried over: a 50ms query still fits (capacity
+        # 4, only 3 kept), then the recorder is full and 10ms is rejected
+        assert fresh.record("f" * 16, 0.05, entry(9))
+        assert not fresh.record("e" * 16, 0.01, entry(8))
+
+    def test_restart_with_smaller_capacity_prunes_fastest(self, tmp_path):
+        sl = SlowLog(tmp_path / "slow", capacity=8)
+        for i, d in enumerate([0.4, 0.1, 0.3, 0.2]):
+            sl.record(f"{i:016x}", d, entry(i))
+        fresh = SlowLog(tmp_path / "slow", capacity=2)
+        assert len(fresh) == 2
+        entries, _ = fresh.entries()
+        assert [e["duration_ms"] for e in entries] == [400, 300]
+        assert len(list((tmp_path / "slow").glob("*.json"))) == 2
+
+
+class TestCorruptSpool:
+    def test_corrupt_entry_skipped_loudly(self, tmp_path, caplog):
+        import logging
+
+        sl = SlowLog(tmp_path / "slow", capacity=4)
+        sl.record("a" * 16, 0.2, entry(1))
+        sl.record("b" * 16, 0.1, entry(2))
+        # corrupt one body in place (torn write / disk hiccup)
+        victim = next((tmp_path / "slow").glob("000000000200-*.json"))
+        victim.write_text("{not json")
+        with caplog.at_level(logging.WARNING,
+                             logger="horaedb_tpu.server.slowlog"):
+            entries, corrupt = sl.entries()
+        assert corrupt == 1
+        assert [e["duration_ms"] for e in entries] == [100]
+        assert any("corrupt" in r.message for r in caplog.records)
+
+    def test_unrecognized_file_ignored_on_load(self, tmp_path, caplog):
+        import logging
+
+        d = tmp_path / "slow"
+        d.mkdir()
+        (d / "not-a-spool-entry.json").write_text("{}")
+        with caplog.at_level(logging.WARNING,
+                             logger="horaedb_tpu.server.slowlog"):
+            sl = SlowLog(d, capacity=4)
+        assert len(sl) == 0
+        assert any("unrecognized" in r.message for r in caplog.records)
+
+
+class TestRobustness:
+    def test_non_serializable_entry_degrades_to_not_recorded(self, tmp_path,
+                                                             caplog):
+        import logging
+
+        sl = SlowLog(tmp_path / "slow", capacity=4)
+        with caplog.at_level(logging.WARNING,
+                             logger="horaedb_tpu.server.slowlog"):
+            ok = sl.record("a" * 16, 0.2, {"bad": object()})
+        assert ok is False
+        assert len(sl) == 0
+        assert not list((tmp_path / "slow").glob("*"))  # no .tmp leak
+        assert any("could not spool" in r.message for r in caplog.records)
+
+    def test_orphaned_tmp_reclaimed_on_load(self, tmp_path):
+        d = tmp_path / "slow"
+        d.mkdir()
+        (d / "000000000100-aaaabbbbccccdddd.tmp").write_text("{torn")
+        sl = SlowLog(d, capacity=4)
+        assert len(sl) == 0
+        assert not list(d.glob("*.tmp"))
+
+    def test_concurrently_evicted_file_is_not_counted_corrupt(self, tmp_path):
+        sl = SlowLog(tmp_path / "slow", capacity=4)
+        sl.record("a" * 16, 0.2, entry(1))
+        sl.record("b" * 16, 0.1, entry(2))
+        # simulate an eviction racing the read: the file vanishes but the
+        # snapshot still lists it
+        next((tmp_path / "slow").glob("000000000100-*.json")).unlink()
+        entries, corrupt = sl.entries()
+        assert corrupt == 0
+        assert [e["duration_ms"] for e in entries] == [200]
+
+
+class TestEntryShape:
+    def test_build_entry_carries_trace_and_explain(self):
+        explain = {"mode": "downsample", "bound": "kernel"}
+        trace = {"trace_id": "ab" * 8, "name": "POST /api/v1/query",
+                 "duration_s": 1.5,
+                 # the handler also attached the plan to the root attrs
+                 # (for /debug/traces); the spool must not carry it twice
+                 "root": {"attrs": {"explain": explain, "status": 200}}}
+        e = build_entry(trace, explain)
+        assert e["trace_id"] == "ab" * 8
+        assert e["duration_s"] == 1.5
+        assert e["explain"]["bound"] == "kernel"
+        assert e["trace"]["name"] == "POST /api/v1/query"
+        assert "explain" not in e["trace"]["root"]["attrs"]
+        assert e["trace"]["root"]["attrs"]["status"] == 200
+        assert isinstance(e["recorded_unix_ms"], int)
+        json.dumps(e)  # must be spoolable as-is

@@ -98,6 +98,27 @@ class TestRing:
     def test_get_unknown_id(self):
         assert tracing.get("doesnotexist") is None
 
+    def test_recent_min_ms_filters_before_limit(self):
+        """min_ms keeps only slow-enough traces, and the limit applies to
+        the FILTERED set — 'last 2 slow traces', not 'slow traces among
+        the last 2'."""
+        import time
+
+        slow_ids = []
+        for i in range(6):
+            with tracing.trace(f"t{i}") as t:
+                if i < 2:
+                    time.sleep(0.02)
+            if i < 2:
+                slow_ids.append(t.trace_id)
+        # the 4 newest traces are all fast: without the filter they would
+        # fill limit=2 entirely
+        out = tracing.recent(2, min_ms=15.0)
+        assert [r["trace_id"] for r in out] == list(reversed(slow_ids))
+        assert tracing.recent(50, min_ms=60_000.0) == []
+        # min_ms=0 keeps everything (duration >= 0)
+        assert len(tracing.recent(0, min_ms=0.0)) >= 6
+
 
 class TestPropagation:
     @async_test

@@ -1,0 +1,208 @@
+"""Instrumented jit (common/xprof.py): compile detection is exact, the
+steady-state path is untouched, and the catalog/roofline surfaces hold
+their shape.
+
+The acceptance bar from the PR issue, pinned here: the compile counter
+increments on first call and on a retrace (new shape / new static), but
+NOT on a cache hit — and cache-hit calls add no retrace (which a counter
+increment would betray) and no device sync (the wrapper never calls a
+blocking API; verified by identical results + zero counter movement).
+"""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common import xprof
+from horaedb_tpu.common.xprof import xjit
+from horaedb_tpu.storage import scanstats
+
+
+def compile_total(kernel: str) -> float:
+    fam = xprof.register_metrics()[0]
+    return fam.labels(kernel).value
+
+
+class TestCompileCounter:
+    def test_first_call_and_retrace_count_cache_hit_does_not(self):
+        calls = []
+
+        @xjit(kernel="xp_counter", static_argnames=("n",))
+        def f(x, n):
+            calls.append(1)
+            return x * n
+
+        a = np.arange(8, dtype=np.float32)
+        before = compile_total("xp_counter")
+        out1 = np.asarray(f(a, 3))
+        assert compile_total("xp_counter") == before + 1
+        # cache hit: NO recompile, NO re-execution of the Python body
+        # (the body running again would mean a retrace — the exact
+        # steady-state overhead the issue forbids)
+        n_calls = len(calls)
+        out2 = np.asarray(f(a, 3))
+        assert compile_total("xp_counter") == before + 1
+        assert len(calls) == n_calls
+        np.testing.assert_array_equal(out1, out2)
+        np.testing.assert_array_equal(out1, a * 3)
+        # new shape retraces
+        np.asarray(f(np.arange(16, dtype=np.float32), 3))
+        assert compile_total("xp_counter") == before + 2
+        # new STATIC value retraces (the arg-signature must show it)
+        np.asarray(f(a, 4))
+        assert compile_total("xp_counter") == before + 3
+
+    def test_signatures_record_the_triggering_shape_and_static(self):
+        @xjit(kernel="xp_sigs", static_argnames=("flag",))
+        def g(x, flag=False):
+            return -x if flag else x
+
+        g(np.zeros(4, np.float32))
+        g(np.zeros(4, np.float32), flag=True)
+        (entry,) = xprof.kernel_entries(["xp_sigs"])
+        assert entry["compiles"] == 2
+        assert entry["cache_entries"] == 2
+        sigs = list(entry["signatures"])
+        assert any("float32[4]" in s for s in sigs)
+        assert any("True" in s for s in sigs)
+
+    def test_positional_statics_resolve_through_the_wrapper(self):
+        """jax resolves static_argnames to positions via the function
+        signature; the (*args, **kwargs) wrapper must stay transparent
+        (functools __wrapped__) or positional static calls would trace
+        the static as an array and crash on shape arithmetic."""
+
+        @xjit(kernel="xp_positional", static_argnames=("n",))
+        def h(x, n):
+            return x.reshape(n, -1)  # needs a CONCRETE n
+
+        out = np.asarray(h(np.arange(12, dtype=np.float32), 3))
+        assert out.shape == (3, 4)
+
+
+class TestCatalog:
+    def test_catalog_entry_shape_and_cost_envelope(self):
+        @xjit(kernel="xp_cost")
+        def f(x):
+            return (x * 2.0).sum()
+
+        f(np.arange(32, dtype=np.float32))
+        (entry,) = xprof.kernel_entries(["xp_cost"])
+        for key in ("kernel", "compiles", "compile_seconds", "cache_entries",
+                    "signatures", "flops", "bytes_accessed",
+                    "arithmetic_intensity", "cost", "memory"):
+            assert key in entry, key
+        assert entry["compiles"] == 1
+        assert entry["compile_seconds"] > 0
+        # CPU XLA supports cost analysis in this image (smoke-verified);
+        # if a backend ever stops, the envelope is None — not a crash
+        if entry["cost"] is not None:
+            assert entry["cost"].get("flops", 0) >= 0
+
+    def test_snapshot_totals_cover_new_compiles(self):
+        before = xprof.snapshot()["total_compiles"]
+
+        @xjit(kernel="xp_totals")
+        def f(x):
+            return x + 1
+
+        f(np.zeros(3, np.float32))
+        assert xprof.snapshot()["total_compiles"] == before + 1
+
+    def test_lower_passthrough(self):
+        @xjit(kernel="xp_lower")
+        def f(x):
+            return x * x
+
+        hlo = f.lower(np.zeros(7, np.float32)).as_text()
+        assert "stablehlo" in hlo or "HloModule" in hlo
+
+
+class TestScanstatsIntegration:
+    def test_compile_feeds_the_collector_and_cache_hit_does_not(self):
+        @xjit(kernel="xp_stats")
+        def f(x):
+            return x.sum()
+
+        a = np.arange(64, dtype=np.float32)
+        with scanstats.scan_stats() as st:
+            f(a)
+        assert st.seconds.get("compile", 0) > 0
+        assert st.kernels.get("xp_stats") == 1
+        with scanstats.scan_stats() as st2:
+            f(a)  # cache hit
+        assert "compile" not in st2.seconds
+        assert st2.kernels.get("xp_stats") == 1
+
+    def test_attribution_names_the_binding_lane(self):
+        st = scanstats.ScanStats()
+        st.add("io_decode", 0.1)
+        st.add("h2d", 0.5)
+        st.add("device_agg", 0.2)
+        st.add("compile", 0.05)
+        st.add("host_prep", 0.01)
+        att = st.attribution()
+        assert att["bound"] == "transfer"
+        assert att["lanes_s"]["io"] == pytest.approx(0.1)
+        assert att["lanes_s"]["transfer"] == pytest.approx(0.5)
+        assert att["lanes_s"]["kernel"] == pytest.approx(0.2)
+        assert att["lanes_s"]["compile"] == pytest.approx(0.05)
+        assert att["lanes_s"]["host"] == pytest.approx(0.01)
+
+    def test_empty_attribution_has_no_bound(self):
+        assert scanstats.ScanStats().attribution()["bound"] is None
+
+    def test_compile_bound_verdict(self):
+        st = scanstats.ScanStats()
+        st.add("compile", 2.0)
+        st.add("device_merge", 0.1)
+        assert st.attribution()["bound"] == "compile"
+
+    def test_compile_inside_stage_is_deducted_from_the_stage(self):
+        """Compiles fire INSIDE device stages (xprof detects them
+        mid-kernel-call); the compile time must be attributed ONCE — to
+        the compile lane — not doubled into the enclosing stage, or
+        `bound` could never say "compile"."""
+        import time
+
+        with scanstats.scan_stats() as st:
+            with scanstats.stage("device_agg"):
+                time.sleep(0.01)
+                scanstats.record("compile", 0.5)  # as xprof would
+        assert st.seconds["compile"] == pytest.approx(0.5)
+        # the stage recorded its own elapsed time MINUS the compile credit
+        assert st.seconds["device_agg"] < 0.2
+        assert st.attribution()["bound"] == "compile"
+
+    def test_nested_stage_compile_deducts_from_both(self):
+        with scanstats.scan_stats() as st:
+            with scanstats.stage("outer"):
+                with scanstats.stage("device_agg"):
+                    scanstats.record("compile", 0.4)
+        assert st.seconds["compile"] == pytest.approx(0.4)
+        assert st.seconds["device_agg"] < 0.1
+        assert st.seconds["outer"] < 0.1  # inner compile propagated out
+
+    def test_compile_outside_any_stage_needs_no_deduction(self):
+        with scanstats.scan_stats() as st:
+            scanstats.record("compile", 0.3)
+        assert st.seconds["compile"] == pytest.approx(0.3)
+
+
+class TestNestedTracing:
+    def test_xjit_callable_inside_jit_still_works(self):
+        """The registry kernels are invoked from inside other traced
+        functions (lax.cond branches); the wrapper must stay callable on
+        tracers and produce identical results."""
+        import jax
+        import jax.numpy as jnp
+
+        @xjit(kernel="xp_inner", static_argnames=("n",))
+        def inner(x, n):
+            return x + n
+
+        @jax.jit
+        def outer(x):
+            return inner(x, 2) * 2
+
+        out = np.asarray(outer(jnp.arange(4, dtype=jnp.float32)))
+        np.testing.assert_array_equal(out, (np.arange(4) + 2) * 2)

@@ -89,6 +89,16 @@ def main() -> int:
         disp = result.get("agg_dispatcher") or {}
         check(disp.get("source") in ("cache", "calibrated"),
               f"missing calibration provenance: {disp.get('source')!r}")
+        # compile/steady split (common/xprof.py): the cold-calibration run
+        # must have traced at least one instrumented kernel, and the split
+        # fields must ride the payload so bench trajectory can separate a
+        # compile-time regression from a kernel regression
+        check(result.get("recompiles", 0) > 0,
+              f"no recompiles recorded: {result.get('recompiles')!r}")
+        check(result.get("compile_s", 0) > 0,
+              f"compile_s missing/zero: {result.get('compile_s')!r}")
+        check(result.get("steady_s", 0) > 0,
+              f"steady_s missing/zero: {result.get('steady_s')!r}")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")

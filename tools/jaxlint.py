@@ -47,6 +47,12 @@ Rules (see docs/static-analysis.md for rationale and examples):
         ops/blockagg.py / ops/agg_registry.py — every segment-reduction
         strategy must register in ops/agg_registry.py so the
         measured-winner dispatch stays complete
+  J007  naked `jax.jit`/`jax.pjit` (or `from jax import jit`) in the
+        hot modules (ops/, parallel/, promql/): an uninstrumented jit
+        wrapper silently bypasses the compile telemetry, kernel catalog,
+        and EXPLAIN compile/steady split that common/xprof.py feeds —
+        route through `xprof.xjit` instead (same signature, jit kwargs
+        pass through)
 
 Suppressions: `# jaxlint: disable=J001 <reason>` on the finding's line
 or the line immediately above. The reason is mandatory (J000 otherwise);
@@ -103,8 +109,21 @@ JIT_WRAPPERS = {
     "jit", "jax.jit", "pjit", "jax.pjit",
     "jax.experimental.pjit.pjit",
     "shard_map", "jax.experimental.shard_map.shard_map",
+    # the instrumented wrapper (common/xprof.py) IS a jit wrapper: bodies
+    # it traces stay under the J001/J002/J005/J006 in-jit rules
+    "xjit", "xprof.xjit", "common.xprof.xjit",
 }
 PARTIAL_NAMES = {"partial", "functools.partial"}
+
+# J007: jit spellings that bypass xprof's compile telemetry. Scope below
+# (J007_MODULES); `shard_map` alone is fine — the telemetry hook is the
+# OUTER jit wrapper, which must be xjit.
+NAKED_JIT = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+J007_MODULES = (
+    "horaedb_tpu/ops/",
+    "horaedb_tpu/parallel/",
+    "horaedb_tpu/promql/",
+)
 
 # device -> host syncs, unambiguous even outside jit
 SYNC_METHODS = {"item", "block_until_ready"}
@@ -550,6 +569,37 @@ def _check_onehot(tree: ast.Module, findings: list[Finding]) -> None:
                 break
 
 
+def _check_naked_jit(tree: ast.Module, findings: list[Finding]) -> None:
+    """J007, hot modules only: any use of `jax.jit`/`jax.pjit` — call,
+    decorator, or `partial(jax.jit, ...)` (all contain the `jax.jit`
+    attribute node this walks for) — plus the import-alias escape hatch
+    `from jax import jit`. The instrumented wrapper (common/xprof.xjit)
+    is the only sanctioned jit spelling here: a naked jit silently drops
+    the kernel out of compile telemetry, /debug/kernels, and EXPLAIN's
+    compile/steady split."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            fd = dotted(node)
+            if fd in NAKED_JIT:
+                findings.append(Finding(
+                    node.lineno, "J007",
+                    f"naked `{fd}` in a hot module bypasses compile "
+                    "telemetry (horaedb_jit_* families, /debug/kernels, "
+                    "EXPLAIN compile split); route through "
+                    "common/xprof.xjit",
+                ))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(
+                a.name in ("jit", "pjit") for a in node.names
+            ):
+                findings.append(Finding(
+                    node.lineno, "J007",
+                    "`from jax import jit` in a hot module — importing the "
+                    "uninstrumented wrapper invites naked jit call sites; "
+                    "use common/xprof.xjit",
+                ))
+
+
 def _lock_attrs_of(cls: ast.ClassDef) -> set[str]:
     """Attribute names of locks this class OWNS (self._lock = Lock())."""
     out: set[str] = set()
@@ -724,6 +774,10 @@ def lint_file(path: Path) -> list[str]:
         (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
         for h in DTYPE_MODULES
     )
+    in_j007_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J007_MODULES
+    )
 
     idx = JitIndex()
     idx.visit(tree)
@@ -739,6 +793,8 @@ def lint_file(path: Path) -> list[str]:
         _check_dtype(tree, findings)
         if not any(posix.endswith(m) for m in AGG_LANE_MODULES):
             _check_onehot(tree, findings)
+    if in_j007_scope:
+        _check_naked_jit(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

@@ -7,9 +7,15 @@ query, then fails loudly unless:
 - every /metrics line passes the Prometheus text-format validator
   (tools/promcheck.py);
 - the expected metric families are present (per-stage scan histograms,
-  ingest/flush/storage/compaction families, HTTP latency);
+  ingest/flush/storage/compaction families, HTTP latency, and the
+  horaedb_jit_* compile-telemetry families with at least one labeled
+  kernel);
 - the query response echoed an X-Horaedb-Trace-Id whose span tree
-  round-trips through GET /debug/traces/{id}.
+  round-trips through GET /debug/traces/{id};
+- a `?explain=1` downsample query returns a plan with the dispatcher
+  impl, per-lane stage seconds, and a compile/steady split;
+- GET /debug/kernels serves the instrumented-kernel catalog and
+  GET /debug/slowlog returns the recorded query.
 
 This is the end-to-end check the unit tests can't give: the families are
 registered at import time across six modules, and only a live request
@@ -48,6 +54,14 @@ REQUIRED_FAMILIES = (
     "horaedb_http_request_seconds_bucket",
     "horaedb_ingest_flush_seconds_bucket",
     "horaedb_uptime_seconds",
+    # device-side compile telemetry (common/xprof.py): the counter must
+    # carry at least one real labeled kernel after the queries ran
+    "horaedb_jit_compile_total",
+    'horaedb_jit_compile_total{kernel="',
+    "horaedb_jit_compile_seconds_bucket",
+    "horaedb_jit_cache_entries",
+    'horaedb_scan_stage_seconds_bucket{stage="compile"',
+    "horaedb_slowlog_records_total",
 )
 
 
@@ -84,12 +98,18 @@ async def run() -> int:
         if not ok:
             failures.append(msg)
 
+    import tempfile
+
+    scratch = tempfile.mkdtemp(prefix="horaedb-smoke-")
     fake = FakeS3()
     url = await fake.start()
     cfg = Config.from_dict({
         "metric_engine": {"storage": {"object_store": {
             "type": "S3Like", "endpoint": url, "bucket": fake.bucket,
             "region": "smoke", "key_id": "smoke", "key_secret": "smoke",
+            # fresh local scratch: the slowlog spool must start empty so
+            # "the recorded request comes back" proves THIS process wrote it
+            "data_dir": scratch,
         }}},
     })
     app = await build_app(cfg)
@@ -114,11 +134,46 @@ async def run() -> int:
                 check(r.status == 200 and body.get("rows") == 3,
                       f"raw query answered: {body}")
                 check(bool(trace_id), "query echoed X-Horaedb-Trace-Id")
-            async with s.post(f"{base}/api/v1/query", json={
+            async with s.post(f"{base}/api/v1/query?explain=1", json={
                 "metric": "smoke_cpu", "start_ms": 0, "end_ms": 4000,
                 "bucket_ms": 2000,
             }) as r:
+                body = await r.json()
                 check(r.status == 200, "downsample query answered")
+                plan = body.get("explain") or {}
+                check(plan.get("mode") == "downsample"
+                      and bool(plan.get("agg_impl")),
+                      f"explain carries the dispatcher impl: "
+                      f"{plan.get('agg_impl')!r}")
+                lanes = plan.get("lanes_s") or {}
+                check(
+                    {"io", "transfer", "kernel", "compile", "host"}
+                    <= set(lanes),
+                    f"explain carries per-lane stage seconds: {lanes}",
+                )
+                check("compile_s" in plan and "steady_s" in plan
+                      and plan.get("bound") is not None,
+                      f"explain carries the compile/steady split + bound: "
+                      f"compile_s={plan.get('compile_s')} "
+                      f"steady_s={plan.get('steady_s')} "
+                      f"bound={plan.get('bound')}")
+            async with s.get(f"{base}/debug/kernels") as r:
+                cat = await r.json()
+                check(
+                    r.status == 200 and isinstance(cat.get("kernels"), list)
+                    and len(cat["kernels"]) > 0,
+                    f"/debug/kernels serves the catalog "
+                    f"({len(cat.get('kernels', []))} kernels)",
+                )
+            async with s.get(f"{base}/debug/slowlog") as r:
+                slog = await r.json()
+                ids = [e.get("trace_id") for e in slog.get("entries", [])]
+                check(
+                    r.status == 200 and slog.get("enabled") is True
+                    and trace_id in ids,
+                    f"/debug/slowlog recorded the query "
+                    f"({len(ids)} entries)",
+                )
             async with s.get(f"{base}/debug/traces/{trace_id}") as r:
                 t = await r.json()
                 check(
@@ -139,14 +194,24 @@ async def run() -> int:
     finally:
         await runner.cleanup()
         await fake.stop()
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
     print(f"smoke-metrics: {len(failures)} failure(s)")
     return 1 if failures else 0
 
 
 def main() -> None:
     import os
+    import tempfile
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # cold aggregation-calibration cache: the first downsample then pays
+    # the registry micro-A/B, which drives the instrumented device kernels
+    # and guarantees horaedb_jit_compile_total carries labeled kernels
+    os.environ["HORAEDB_AGG_CACHE"] = os.path.join(
+        tempfile.mkdtemp(prefix="horaedb-smoke-calib-"), "agg_calib.json"
+    )
     raise SystemExit(asyncio.run(run()))
 
 
