@@ -32,7 +32,9 @@ lint:
 # Domain-aware gate (tools/jaxlint.py): host-sync on hot paths (J001),
 # retrace hazards under jit (J002), dtype drift in engine code (J003),
 # lock discipline on the concurrency surface (J004), host timers/spans
-# inside jit bodies (J005). Findings print as path:line: CODE message.
+# inside jit bodies (J005), ad-hoc aggregation lanes (J006), naked jit
+# (J007), blocking flush work on the append path (J008). Findings print
+# as path:line: CODE message.
 # Rules + suppression syntax: docs/static-analysis.md
 jaxlint:
 	python tools/jaxlint.py

@@ -49,6 +49,89 @@ def numpy_baseline(ts, sid, vals, bucket_ms, num_series, num_buckets, lo):
     return sums, counts
 
 
+def ingest_lane(smoke: bool) -> dict:
+    """Engine ingest lane (ROOFLINE §7): remote-write payloads through
+    write_payload end-to-end, measured two ways — PURE append (no flush
+    inside the timed window: the parse + id-resolve + accumulate ceiling)
+    vs WITH background flushes (threshold crossings seal memtables to the
+    flush executor; the final drain is inside the timing so durability
+    counts). Host-side only; runs identically with or without an
+    accelerator. The with-flush/pure ratio is the measured overlap of the
+    ingest->flush pipeline on this box."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from horaedb_tpu.engine import MetricEngine
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.pb import remote_write_pb2
+
+    n_payloads = 16 if smoke else 150
+    n_series, n_samples = 200, 10
+
+    def payload(seq: int) -> bytes:
+        base = 1_700_000_000_000 + seq * 10_000
+        req = remote_write_pb2.WriteRequest()
+        for s in range(n_series):
+            series = req.timeseries.add()
+            for k, v in ((b"__name__", f"ingest_{s % 20}".encode()),
+                         (b"host", f"host-{s:04d}".encode())):
+                lab = series.labels.add()
+                lab.name = k
+                lab.value = v
+            for i in range(n_samples):
+                smp = series.samples.add()
+                smp.timestamp = base + i * 1000
+                smp.value = float(s + i)
+        return req.SerializeToString()
+
+    payloads = [payload(i) for i in range(n_payloads)]
+    total_rows = n_payloads * n_series * n_samples
+
+    async def run(buffer_rows: int, drain: bool) -> float:
+        root = tempfile.mkdtemp(prefix="horaedb-bench-ingest-")
+        store = LocalStore(root)
+        eng = await MetricEngine.open(
+            "db", store, enable_compaction=False,
+            ingest_buffer_rows=buffer_rows,
+        )
+        try:
+            await eng.write_payload(payloads[0])  # warm: series registration
+            await eng.flush()
+            t0 = time.perf_counter()
+            n = 0
+            for p in payloads:
+                n += await eng.write_payload(p)
+            if drain:
+                await eng.flush()
+            elapsed = time.perf_counter() - t0
+        finally:
+            await eng.close()
+            shutil.rmtree(root, ignore_errors=True)
+        return n / elapsed
+
+    # best-of-N: the with-flush number rides the box's fsync latency,
+    # which swings wildly on shared containers — the best round is the
+    # pipeline's capability, the others are disk-contention noise
+    rounds = 1 if smoke else 3
+    # pure lane: a threshold the run can never reach (NOT a giant
+    # sentinel — buffer_rows sizes real allocations on the fallback path)
+    pure = max(
+        asyncio.run(run(2 * total_rows, drain=False)) for _ in range(rounds)
+    )
+    # a buffer ~1/8 of the run forces several background flushes inside
+    # the timed window
+    with_flush = max(
+        asyncio.run(run(max(total_rows // 8, 1024), drain=True))
+        for _ in range(rounds)
+    )
+    return {
+        "ingest_pure_samples_per_sec": round(pure),
+        "ingest_with_flush_samples_per_sec": round(with_flush),
+        "ingest_rows": total_rows,
+    }
+
+
 def main() -> None:
     # Probe BEFORE touching jax in this process (jax.devices() itself hangs
     # on a wedged tunnel); on failure, force the CPU backend so the bench
@@ -297,6 +380,9 @@ def main() -> None:
         "probe": probe_reason,
         "smoke": SMOKE,
     }
+    # ingest lane (overlapped ingest->flush pipeline): pure vs with-flush
+    # samples/s ride the same JSON line (bench-smoke asserts them)
+    result.update(ingest_lane(SMOKE))
 
     # Last-chance accelerator retry, ONLY on the wedged-tunnel fallback
     # path (`not responsive`): the CPU fallback run itself took minutes —

@@ -10,14 +10,23 @@ predicates followed by on-device aggregation.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
+import time
 
 import numpy as np
 import pyarrow as pa
 
 from horaedb_tpu.common import tracing
 from horaedb_tpu.common.aio import TaskGroup
+from horaedb_tpu.engine.flush_executor import (
+    FLUSH_FAILURES_TOTAL,
+    FLUSH_OVERLAP_RATIO,
+    FLUSH_STAGE_SECONDS,
+    FlushExecutor,
+    SealedMemtable,
+)
 from horaedb_tpu.engine.tables import DATA_SCHEMA
 from horaedb_tpu.ops import aggregate as agg_ops
 from horaedb_tpu.ops import filter as F
@@ -60,9 +69,31 @@ MAX_BUCKETS = 100_000
 # concurrent queries — a dashboard burst cannot multiply it).
 SEGMENT_SCAN_CONCURRENCY = 4
 
+# Shared read-only zeros arena for the constant field_id column: flush
+# shards would otherwise allocate + zero-fill a fresh u64 lane per write
+# (pyarrow wraps the view zero-copy; the batch never mutates it).
+_ZEROS_U64 = np.zeros(0, dtype=np.uint64)
+
+
+def _zeros_u64(n: int) -> np.ndarray:
+    global _ZEROS_U64
+    if len(_ZEROS_U64) < n:
+        z = np.zeros(max(n, 2 * len(_ZEROS_U64), 4096), dtype=np.uint64)
+        z.setflags(write=False)
+        _ZEROS_U64 = z
+    return _ZEROS_U64[:n]
+
 
 class SampleManager:
-    def __init__(self, storage, segment_duration_ms: int, buffer_rows: int = 0):
+    def __init__(
+        self,
+        storage,
+        segment_duration_ms: int,
+        buffer_rows: int = 0,
+        flush_workers: int = 2,
+        flush_queue_max: int = 4,
+        flush_stall_deadline_s: float = 30.0,
+    ):
         self._storage = storage
         self._segment_duration = segment_duration_ms
         # Observability identity: the storage root is region-qualified
@@ -81,15 +112,23 @@ class SampleManager:
         # durable until flush; queries flush first so reads stay consistent.
         self._buffer_rows = buffer_rows
         self._buf: dict[int, list[tuple[np.ndarray, ...]]] = {}
-        # Dense-id chunk buffer: (metric_id, tsid) -> small dense int, plus
-        # per-request (dense-per-sample, ts, value) lanes. Flush
-        # counting-sorts by the pk rank of each dense id — O(n + k) — and
-        # emits batches already in pk order so the storage write's
+        # Dense-id column memtable: (metric_id, tsid) -> small dense int,
+        # plus PREALLOCATED (dense-per-sample, ts, value) column arrays
+        # appended in place (zero-copy drain: sealing hands over array
+        # views; there is no flush-time concatenate and no per-row emit).
+        # Flush counting-sorts by the pk rank of each dense id — O(n + k)
+        # — and emits batches already in pk order so the storage write's
         # sortedness fast path skips its sort.
         self._dense: dict[tuple[int, int], int] = {}
         self._dense_keys: list[tuple[int, int]] = []
-        self._chunks: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self._cols: tuple[np.ndarray, np.ndarray, np.ndarray] | None = None
+        self._fill = 0
+        # recycled column backings (double-buffer arena: a successful
+        # write-out returns its arrays here instead of the allocator)
+        self._spare_cols: list[tuple[np.ndarray, ...]] = []
         self._buffered = 0
+        # monotonic append counter — feeds the flush overlap-ratio metric
+        self._appended_rows = 0
         # Native C++ accumulator (ingest/native.py NativeAccum): samples go
         # straight from the parser arena into C++ lanes, flushed pk-sorted.
         # None when the native library is unavailable (Python chunk buffer
@@ -102,19 +141,25 @@ class SampleManager:
                 self._accum = NativeAccum()
             except Exception:  # noqa: BLE001 — fall back to Python buffering
                 self._accum = None
-        # Bounded background write-outs: threshold flushes run as tasks so
-        # encode threads + fsyncs overlap continued ingest, and up to
-        # MAX_CONCURRENT_FLUSHES snapshots may be in flight at once (each
-        # write-out detaches its snapshot atomically on the event loop, so
-        # snapshots are disjoint; pk+seq dedup makes any retry overlap
-        # harmless). flush() remains the strong barrier queries use.
-        self._inflight: "set[asyncio.Task]" = set()
-        # Failed-snapshot re-buffer: (seq, seg_start, lanes, presorted)
-        # groups carrying their ORIGINAL snapshot sequence. Replaying under
-        # a fresh (newer) seq would let a stale overwritten value beat a
-        # newer acked write that flushed successfully in between.
-        self._rebuf: "list[tuple[int, int, tuple, bool]]" = []
-        self._rebuf_rows = 0
+        # The overlapped ingest->flush pipeline (engine/flush_executor.py):
+        # threshold flushes SEAL the active memtable (atomic swap on the
+        # event loop — appends land in fresh buffers) and hand it to a
+        # bounded background worker pool, so the append path never blocks
+        # on drain/encode/upload. A full queue blocks appends on a
+        # condition variable with a deadline (backpressure, never a drop);
+        # flush() remains the strong barrier queries use.
+        self._executor: "FlushExecutor | None" = None
+        if buffer_rows > 0:
+            self._executor = FlushExecutor(
+                self._writeout_once,
+                self._table_id,
+                workers=flush_workers,
+                queue_max=flush_queue_max,
+                stall_deadline_s=flush_stall_deadline_s,
+            )
+        # bounded concurrent object-store PUTs across the flush pipeline
+        # (lazy: binds the running loop)
+        self._upload_sem: "asyncio.Semaphore | None" = None
         # shared bound for concurrent segment-pushdown scans (lazy: binds
         # the running loop)
         self._scan_sem: "asyncio.Semaphore | None" = None
@@ -131,94 +176,53 @@ class SampleManager:
         """Append the parser's current parse into the C++ accumulator
         (engine.write_payload holds the parser borrowed). Returns total
         buffered rows."""
-        return self._accum.add(parser)
+        before = self._accum.rows
+        total = self._accum.add(parser)
+        # feed the overlap-ratio metric on the native hot path too
+        self._appended_rows += total - before
+        return total
 
     def should_flush(self, rows: int) -> bool:
         return rows >= self._buffer_rows
 
     @property
     def buffered_rows(self) -> int:
-        """Total rows awaiting durability (native accumulator + Python
-        buffers + the failed-snapshot re-buffer)."""
+        """Total rows awaiting durability (native accumulator + active
+        Python memtable + sealed memtables queued/parked/in-flight on the
+        flush executor)."""
         accum = self._accum.rows if self._accum is not None else 0
-        return accum + self._buffered + self._rebuf_rows
+        pending = self._executor.pending_rows if self._executor else 0
+        return accum + self._buffered + pending
 
-    # Backlog hard cap, as a multiple of buffer_rows: past it, ingest stops
-    # deferring to the background flush and AWAITS one — restoring
-    # backpressure and surfacing persistent storage failures to the writer
-    # (a remote-write 5xx makes senders retry) instead of acking rows into
-    # an unbounded buffer.
-    BACKLOG_FACTOR = 4
-
-    @property
-    def backlogged(self) -> bool:
-        return self.buffered_rows >= self.BACKLOG_FACTOR * self._buffer_rows
-
-    # Concurrent background write-outs: two snapshots encode/fsync in
-    # parallel, roughly doubling sustained flush bandwidth. Each holds
-    # O(buffer_rows) host memory, so keep this small.
-    MAX_CONCURRENT_FLUSHES = 2
+    # Bound on concurrent object-store PUTs from this manager's flush
+    # pipeline: several workers x several shards would otherwise fan out
+    # encode+upload without limit on a small host.
+    MAX_INFLIGHT_UPLOADS = 4
 
     @property
     def flush_in_flight(self) -> bool:
-        return any(not t.done() for t in self._inflight)
+        return self._executor is not None and self._executor.busy
 
-    def _live_flushes(self) -> "list[asyncio.Task]":
-        return [t for t in self._inflight if not t.done()]
-
-    def _start_writeout(self) -> "asyncio.Task":
-        """Start a write-out task, registered in ``_inflight`` so EVERY
-        concurrent flush barrier can see and await it. The done-callback
-        retrieves + logs the exception (the failed snapshot re-buffers, see
-        _writeout_once), so an unawaited task never warns; barriers that DO
-        gather it still observe the exception object."""
-        import asyncio
-
-        t = asyncio.create_task(self._writeout_once(), name="ingest-flush")
-        self._inflight.add(t)
-
-        def _done(task: "asyncio.Task") -> None:
-            self._inflight.discard(task)
-            if not task.cancelled() and task.exception() is not None:
-                logger.error(
-                    "ingest write-out failed (table=%s); rows re-buffered",
-                    self._table_id, exc_info=task.exception(),
-                )
-
-        t.add_done_callback(_done)
-        return t
-
-    def flush_soon(self) -> None:
-        """Fire a background write-out (bounded fan-out): the CPU-heavy
-        sort/encode runs on worker threads and overlaps continued ingest.
-        Errors are logged, not raised — the failed snapshot re-buffers and a
-        later flush retries it; queries stay consistent because their
-        flush() awaits every in-flight write-out. The `backlogged` cap
-        bounds how long writers may keep deferring to this path."""
-        if len(self._live_flushes()) < self.MAX_CONCURRENT_FLUSHES:
-            self._start_writeout()
+    @property
+    def flush_executor(self) -> "FlushExecutor | None":
+        return self._executor
 
     async def drain(self) -> None:
-        """Await background write-outs, then flush the remainder
-        (shutdown). Loops: a concurrent writer may schedule new work while
-        we await — exit only once no write-out is live and no row is
-        buffered, so nothing is abandoned at loop teardown."""
-        import asyncio
-
+        """Await the flush queue empty, then flush the remainder
+        (shutdown + the periodic flush loop). Loops: a concurrent writer
+        may append while we await — exit only once no row is buffered
+        anywhere, so nothing is abandoned at loop teardown."""
+        if self._executor is None:
+            return
         while True:
-            live = self._live_flushes()
-            if live:
-                await asyncio.gather(*live, return_exceptions=True)
             await self.flush()
-            if not self._live_flushes() and not self._has_pending_rows:
+            if not self.buffered_rows:
                 return
 
     @property
     def _has_pending_rows(self) -> bool:
         return bool(
-            self._buffered
-            or self._rebuf
-            or (self._accum is not None and self._accum.rows)
+            self._buffered or (self._accum is not None and self._accum.rows)
         )
 
     async def persist(
@@ -240,16 +244,46 @@ class SampleManager:
                 chunk = (metric_ids[m], tsids[m], ts[m], values[m])
                 self._buf.setdefault(int(seg_start), []).append(chunk)
                 self._buffered += len(chunk[2])
+                self._appended_rows += len(chunk[2])
             else:
                 await self._write_segment(
                     metric_ids[m], tsids[m], ts[m], values[m]
                 )
         if self._buffer_rows > 0 and self._buffered >= self._buffer_rows:
-            await self.flush()
+            await self.seal_and_submit()
+
+    def _cols_for(self, n: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Active column arrays with room for `n` more rows — pulled from
+        the recycled spare pool when a completed flush returned one
+        (the double-buffer arena), grown geometrically otherwise."""
+        cols = self._cols
+        if cols is None:
+            # eager capacity is CAPPED: an absurd buffer_rows (bench
+            # sentinels, misconfiguration) must not preallocate
+            # buffer_rows-sized arrays up front — growth is geometric
+            cap = max(min(self._buffer_rows, 4 << 20), n, 1024)
+            if self._spare_cols and len(self._spare_cols[-1][0]) >= cap:
+                cols = self._spare_cols.pop()
+            else:
+                cols = (
+                    np.empty(cap, np.int64),   # dense series id per sample
+                    np.empty(cap, np.int64),   # ts
+                    np.empty(cap, np.float64),  # value
+                )
+            self._cols = cols
+        elif self._fill + n > len(cols[0]):
+            cap = max(2 * len(cols[0]), self._fill + n)
+            grown = tuple(np.empty(cap, c.dtype) for c in cols)
+            for g, c in zip(grown, cols):
+                g[: self._fill] = c[: self._fill]
+            self._cols = cols = grown
+        return cols
 
     async def buffer_request(self, metric_arr, tsid_arr, req) -> None:
         """Hash-lane buffered ingest: one dense-id dict probe per series,
-        then whole-request lanes append (no per-series slicing)."""
+        then whole-request column appends IN PLACE into the preallocated
+        active memtable (no per-request list nodes, no flush-time
+        concatenate — the zero-copy drain)."""
         dense = self._dense
         keys = self._dense_keys
         mids = metric_arr.tolist()
@@ -264,99 +298,175 @@ class SampleManager:
                 keys.append(k)
             per_series[s] = d
         ts = req.sample_ts
-        self._chunks.append((per_series[req.sample_series], ts, req.sample_value))
-        self._buffered += len(ts)
+        n = len(ts)
+        dcol, tcol, vcol = self._cols_for(n)
+        f = self._fill
+        dcol[f:f + n] = per_series[req.sample_series]
+        tcol[f:f + n] = ts
+        vcol[f:f + n] = req.sample_value
+        self._fill = f + n
+        self._buffered += n
+        self._appended_rows += n
         if self._buffered >= self._buffer_rows:
-            await self.flush()
+            await self.seal_and_submit()
+
+    def seal(self) -> "SealedMemtable | None":
+        """Atomically detach the active memtable into an immutable
+        SealedMemtable (the double-buffer swap): no awaits between the
+        buffer detach and the accumulator take, so appends racing this
+        seal land entirely in the fresh active buffers. Returns None when
+        nothing is buffered — two concurrent flush() calls cannot
+        double-seal the same rows.
+
+        The memtable's dedup sequence is pinned HERE, so last-value dedup
+        follows buffering order even if a later memtable's encode lands
+        its SSTs (with higher file ids) first."""
+        from horaedb_tpu.storage.sst import allocate_id
+
+        has_accum = self._accum is not None and self._accum.rows
+        if not (self._buffered or has_accum):
+            return None
+        t0 = time.perf_counter()
+        buf, self._buf = self._buf, {}
+        keys, self._dense_keys = self._dense_keys, []
+        self._dense = {}
+        cols_view = None
+        backing = None
+        if self._fill:
+            backing = self._cols
+            cols_view = tuple(c[: self._fill] for c in backing)
+            self._cols = None
+            self._fill = 0
+        rows = self._buffered
+        self._buffered = 0
+        lanes = None
+        if has_accum:
+            # synchronous C++ drain: pk-sorted lanes copied out, arena
+            # cleared — part of the same atomic swap
+            lanes = self._accum.take_sorted()
+            rows += len(lanes[2])
+        seq = allocate_id()
+        FLUSH_STAGE_SECONDS.labels(self._table_id, "drain").observe(
+            time.perf_counter() - t0
+        )
+        return SealedMemtable(
+            seq=seq, rows=rows, buf=buf, cols=cols_view, keys=keys,
+            cols_backing=backing, lanes=lanes,
+        )
+
+    async def seal_and_submit(self) -> None:
+        """Threshold flush trigger: swap in a fresh active memtable and
+        hand the sealed one to the background executor. The append hot
+        path never waits on drain/encode/upload — it blocks only when the
+        bounded flush queue is full (backpressure with a stall deadline,
+        horaedb_ingest_stall_seconds)."""
+        ex = self._executor
+        if ex is None:
+            return
+        # a new trigger is also the retry clock for parked failures
+        ex.kick_parked()
+        sealed = self.seal()
+        if sealed is not None:
+            try:
+                await ex.submit(sealed)
+            except BaseException:
+                # stall deadline (or cancellation) while the queue was
+                # full: the rows were already detached from the active
+                # memtable — PARK them (never drop acked rows; the next
+                # trigger or barrier retries) before surfacing the error
+                ex.park(sealed)
+                raise
 
     async def flush(self) -> None:
         """Strong flush barrier: every row buffered (acked) at entry is
         durable — or an error raised — by return.
 
-        All write-outs, including the one this barrier starts, register in
-        ``_inflight``, so concurrent flush() callers see each other's
-        in-flight snapshots (the old flush lock's guarantee). Pre-entry
-        rows are either (a) still buffered — covered by our own write-out,
-        or (b) detached into some registered task — covered by the gather.
-        A failed write-out re-buffers its snapshot; the retry loop then
-        drains it, surfacing persistent storage errors here rather than in
-        a log line."""
-        import asyncio
-
-        live = self._live_flushes()
-        if self._has_pending_rows:
-            live.append(self._start_writeout())
-        if not live:
+        Seals the active memtable (urgent submit bypasses the queue
+        bound), waits out the memtables queued/in-flight AT ENTRY (a
+        snapshot — sustained ingest submitting more work cannot starve
+        the barrier), then retries any PARKED failure inline exactly
+        once — a second failure is a persistent storage error and raises
+        here (the memtable re-parks first, so no acked row is ever
+        dropped)."""
+        ex = self._executor
+        if ex is None:
             return
-        results = await asyncio.gather(*live, return_exceptions=True)
-        failed = [r for r in results if isinstance(r, BaseException)]
-        while failed and self._has_pending_rows:
-            # rows re-buffered by a failure: retry inline; a persistent
-            # storage error raises out of this call
-            await self._writeout_once()
-            failed = []
-
-    async def _writeout_once(self) -> None:
-        """One write-out attempt, timed and traced (logic in
-        _writeout_impl; this wrapper owns the flush observability so every
-        caller — background task, flush barrier, retry loop — reports)."""
-        with tracing.span("ingest_flush", table=self._table_id):
+        ex.kick_parked()
+        sealed = self.seal()
+        if sealed is not None:
+            await ex.submit(sealed, urgent=True)
+        pending = ex.snapshot_pending()
+        while True:
+            await ex.wait_settled(pending)
+            parked = ex.take_parked()
+            if parked is None:
+                return
             try:
-                with FLUSH_SECONDS.labels(self._table_id).time():
-                    await self._writeout_impl()
+                await self._writeout_once(parked)
             except BaseException:
-                FLUSH_FAILURES.labels(self._table_id).inc()
+                ex.park(parked)
                 raise
 
-    async def _writeout_impl(self) -> None:
-        """Write out one snapshot of the buffers (one storage write per
-        segment shard).
+    async def _writeout_once(self, sealed: "SealedMemtable") -> None:
+        """One write-out attempt of a sealed memtable, timed and traced
+        (logic in _writeout_sealed; this wrapper owns the flush
+        observability so every caller — executor worker, flush-barrier
+        inline retry — reports). On failure the un-landed remainder has
+        already been converted into pinned-seq replay groups on `sealed`,
+        so parking it loses nothing."""
+        appended0 = self._appended_rows
+        rows = sealed.rows
+        with tracing.span(
+            "ingest_flush", table=self._table_id, rows=rows, seq=sealed.seq,
+        ):
+            try:
+                with FLUSH_SECONDS.labels(self._table_id).time():
+                    await self._writeout_sealed(sealed)
+            except BaseException:
+                FLUSH_FAILURES.labels(self._table_id).inc()
+                FLUSH_FAILURES_TOTAL.labels(self._table_id).inc()
+                raise
+        FLUSH_ROWS.labels(self._table_id).inc(rows)
+        if rows:
+            # rows appended to the ACTIVE memtable while this write-out ran,
+            # per flushed row: the measured producer/consumer overlap
+            FLUSH_OVERLAP_RATIO.labels(self._table_id).observe(
+                (self._appended_rows - appended0) / rows
+            )
+        if sealed.cols_backing is not None and len(self._spare_cols) < 2:
+            # recycle the column backing into the arena (success only: a
+            # failed attempt's replay groups may still view into it)
+            self._spare_cols.append(sealed.cols_backing)
+            sealed.cols_backing = None
 
-        Concurrency contract: buffers are snapshot-detached atomically (no
-        await between detach and the accumulator take) so concurrent
-        write-outs hold disjoint snapshots and rows appended by other
-        coroutines land in fresh buffers, never dropped. On ANY write
-        failure the snapshot converts into pinned-seq re-buffer groups
-        (keeping THIS snapshot's sequence) before the error propagates, so
-        already-acked samples survive for a retrying flush and a later
-        replay can never beat writes acked after them. Partial
-        double-writes are safe: the storage merge dedups by pk + seq."""
-        from horaedb_tpu.storage.sst import allocate_id
+    async def _writeout_sealed(self, sealed: "SealedMemtable") -> None:
+        """Write out one sealed memtable (one storage write per segment
+        shard).
 
-        buf, self._buf = self._buf, {}
-        chunks, self._chunks = self._chunks, []
-        keys, self._dense_keys = self._dense_keys, []
-        self._dense = {}
-        rebuf, self._rebuf = self._rebuf, []
-        rebuf_rows, self._rebuf_rows = self._rebuf_rows, 0
-        snapshot_rows = sum(len(c[1]) for c in chunks) + sum(
-            len(c[2]) for lst in buf.values() for c in lst
-        )
-        self._buffered -= snapshot_rows
-        # accumulator drain is synchronous C++ (atomic on the event loop):
-        # detach it as part of the same snapshot, before any await
-        accum_lanes = (
-            self._accum.take_sorted()
-            if self._accum is not None and self._accum.rows
-            else None
-        )
-        # The snapshot's dedup sequence is pinned NOW, so last-value dedup
-        # follows buffering order even if a later snapshot's encode lands
-        # its SSTs (with higher file ids) first.
-        snap_seq = allocate_id()
+        Failure contract: on ANY write failure every un-landed row
+        converts into pinned-seq replay groups on `sealed` (keeping the
+        memtable's ORIGINAL sequence) before the error propagates, so
+        already-acked samples survive for a retry and a delayed replay can
+        never beat writes acked after them. Partial double-writes are
+        safe: the storage merge dedups by pk + seq."""
+        snap_seq = sealed.seq
+        buf, sealed.buf = sealed.buf, {}
+        cols, sealed.cols = sealed.cols, None
+        keys, sealed.keys = sealed.keys, []
+        lanes, sealed.lanes = sealed.lanes, None
+        groups, sealed.groups = sealed.groups, []
 
-        def _rebuffer_fresh() -> None:
-            self._rebuffer_snapshot(buf, chunks, keys, snap_seq)
-            if accum_lanes is not None:
-                self._rebuffer_lanes(*accum_lanes, seq=snap_seq)
+        def _regroup_fresh() -> None:
+            self._group_snapshot(sealed, buf, cols, keys, snap_seq)
+            if lanes is not None:
+                self._group_lanes(sealed, *lanes, seq=snap_seq)
 
-        del rebuf_rows  # detached with the groups; recomputed on re-buffer
-        # 1) replay previously-failed groups under their ORIGINAL seqs,
-        # coalesced per (seq, segment) so a failed snapshot of many small
+        # 1) replay groups from failed attempts under their ORIGINAL seqs,
+        # coalesced per (seq, segment) so a failed memtable of many small
         # requests replays as one SST per segment, not one per request
-        # (already-landed shards of those snapshots dedup by pk+seq)
+        # (already-landed shards of those attempts dedup by pk+seq)
         merged: "dict[tuple[int, int], list]" = {}
-        for seq0, seg0, lanes0, presorted0 in rebuf:
+        for seq0, seg0, lanes0, presorted0 in groups:
             merged.setdefault((seq0, seg0), []).append((lanes0, presorted0))
         replay = list(merged.items())
         for i, ((seq0, _seg0), group) in enumerate(replay):
@@ -374,29 +484,23 @@ class SampleManager:
             except BaseException:
                 for (sq, sg), grp in replay[i:]:
                     for lanes1, presorted1 in grp:
-                        self._rebuf.append((sq, sg, lanes1, presorted1))
-                        self._rebuf_rows += len(lanes1[2])
-                _rebuffer_fresh()
+                        sealed.groups.append((sq, sg, lanes1, presorted1))
+                _regroup_fresh()
                 raise
-        # 2) this snapshot's fresh rows
+        # 2) this memtable's fresh rows
         try:
             for _seg_start, cols_list in sorted(buf.items()):
-                cols = [
+                seg_cols = [
                     np.concatenate([c[i] for c in cols_list]) for i in range(4)
                 ]
-                await self._write_segment(*cols, seq=snap_seq, fast=True)
-            if chunks:
-                await self._flush_chunks(chunks, keys, seq=snap_seq)
+                await self._write_segment(*seg_cols, seq=snap_seq, fast=True)
+            if cols is not None:
+                await self._flush_cols(cols, keys, seq=snap_seq)
         except BaseException:
-            _rebuffer_fresh()
+            _regroup_fresh()
             raise
-        if accum_lanes is not None:
-            await self._flush_accum_lanes(*accum_lanes, seq=snap_seq)
-        FLUSH_ROWS.labels(self._table_id).inc(
-            snapshot_rows
-            + sum(len(lanes[2]) for _seq, _seg, lanes, _ps in rebuf)
-            + (len(accum_lanes[2]) if accum_lanes is not None else 0)
-        )
+        if lanes is not None:
+            await self._flush_accum_lanes(sealed, *lanes, seq=snap_seq)
 
     # A flush larger than this splits into contiguous pk-range shards
     # written as independent SSTs concurrently: parquet encode (GIL-free)
@@ -412,14 +516,16 @@ class SampleManager:
         else (os.cpu_count() or 1)
     ))
 
-    async def _flush_accum_lanes(self, mid, tsid, ts, vals, seq=None) -> None:
+    async def _flush_accum_lanes(
+        self, sealed: "SealedMemtable", mid, tsid, ts, vals, seq=None
+    ) -> None:
         """Write out pk-sorted lanes taken from the C++ accumulator (the
         take CLEARED it, so rows buffered during the awaited writes are
         never lost), split by segment (and by shard within large segments),
-        write concurrently. On failure the lanes re-buffer into the Python
-        chunk store so acked samples survive for a retry."""
-        import asyncio
-
+        the shards' parquet encodes running concurrently across the SST
+        pool with the in-flight uploads bounded (_write_segment). On
+        failure the lanes convert into pinned-seq replay groups on
+        `sealed` so acked samples survive for a retry."""
         if not len(ts):
             return
         seg = ts - (ts % self._segment_duration)
@@ -469,15 +575,18 @@ class SampleManager:
                             self._write_segment(*lanes, presorted=True, seq=seq, fast=True)
                         )
         except BaseException:
-            self._rebuffer_lanes(mid, tsid, ts, vals, per_seg, seq=seq)
+            self._group_lanes(sealed, mid, tsid, ts, vals, per_seg, seq=seq)
             raise
 
-    def _rebuffer_lanes(self, mid, tsid, ts, vals, per_seg=None, seq=None) -> None:
-        """Re-buffer failed accumulator lanes PER SEGMENT into the pinned-seq
-        re-buffer (a batch must not cross a segment). The lanes keep their
-        snapshot's sequence so a later replay cannot beat writes acked after
-        them. Shards that did land before the failure are harmless to
-        re-write: storage dedups by pk + seq."""
+    def _group_lanes(
+        self, sealed: "SealedMemtable", mid, tsid, ts, vals,
+        per_seg=None, seq=None,
+    ) -> None:
+        """Convert failed accumulator lanes PER SEGMENT into pinned-seq
+        replay groups on `sealed` (a batch must not cross a segment). The
+        lanes keep their memtable's sequence so a later replay cannot beat
+        writes acked after them. Shards that did land before the failure
+        are harmless to re-write: storage dedups by pk + seq."""
         if not len(ts):
             return
         if per_seg is None:
@@ -492,21 +601,18 @@ class SampleManager:
                 ]
         for seg_start, lanes in per_seg:
             # accum lanes are pk-sorted; segment mask-gathers preserve that
-            self._rebuf.append((seq, seg_start, lanes, True))
-        self._rebuf_rows += len(ts)
+            sealed.groups.append((seq, seg_start, lanes, True))
 
-    def _rebuffer_snapshot(self, buf, chunks, keys, seq: int) -> None:
-        """Convert a failed snapshot's Python buffers into pinned-seq
-        re-buffer groups (per segment, original sequence preserved)."""
-        rows = 0
+    def _group_snapshot(self, sealed, buf, cols, keys, seq: int) -> None:
+        """Convert a failed memtable's fresh Python buffers into pinned-seq
+        replay groups (per segment, original sequence preserved). Column
+        views materialize into standalone per-segment lanes, so the parked
+        groups never pin the active arena's backing arrays."""
         for seg_start, lst in buf.items():
             for lanes in lst:
-                self._rebuf.append((seq, int(seg_start), lanes, False))
-                rows += len(lanes[2])
-        if chunks:
-            dense_ps = np.concatenate([c[0] for c in chunks])
-            ts = np.concatenate([c[1] for c in chunks])
-            vals = np.concatenate([c[2] for c in chunks])
+                sealed.groups.append((seq, int(seg_start), lanes, False))
+        if cols is not None:
+            dense_ps, ts, vals = cols
             key_mid = np.fromiter((k[0] for k in keys), np.uint64, len(keys))
             key_tsid = np.fromiter((k[1] for k in keys), np.uint64, len(keys))
             mid = key_mid[dense_ps]
@@ -514,21 +620,20 @@ class SampleManager:
             seg = ts - (ts % self._segment_duration)
             for s in np.unique(seg).tolist():
                 m = seg == s
-                self._rebuf.append(
+                sealed.groups.append(
                     (seq, int(s), (mid[m], tsid[m], ts[m], vals[m]), False)
                 )
-            rows += len(ts)
-        self._rebuf_rows += rows
 
-    async def _flush_chunks(self, chunks, keys, seq=None) -> None:
-        """Counting-sort the buffered lanes into pk order: rank the (few)
+    async def _flush_cols(self, cols, keys, seq=None) -> None:
+        """Counting-sort the column memtable into pk order: rank the (few)
         unique series keys, gather rank per sample, one stable O(n + k)
-        counting sort. Scrapes arrive in time order, so within a series the
-        chunk order already sorts ts — verified in O(n); only genuinely
-        out-of-order data pays a full lexsort."""
-        dense_ps = np.concatenate([c[0] for c in chunks])
-        ts = np.concatenate([c[1] for c in chunks])
-        vals = np.concatenate([c[2] for c in chunks])
+        counting sort. The lanes arrive as views into the preallocated
+        active arrays (zero-copy drain — no concatenate). Scrapes arrive
+        in time order, so within a series the append order already sorts
+        ts — verified in O(n); only genuinely out-of-order data pays a
+        full lexsort."""
+        t0 = time.perf_counter()
+        dense_ps, ts, vals = cols
         k = len(keys)
         key_arr = np.empty((k, 2), dtype=np.uint64)
         for i, (m, t) in enumerate(keys):
@@ -556,6 +661,10 @@ class SampleManager:
             mid, tsid, ts, vals = mid[perm2], tsid[perm2], ts[perm2], vals[perm2]
         seg = ts - (ts % self._segment_duration)
         uniq = np.unique(seg)
+        # pk-rank sort is the drain's CPU cost (encode/upload time below)
+        FLUSH_STAGE_SECONDS.labels(self._table_id, "drain").observe(
+            time.perf_counter() - t0
+        )
         for seg_start in uniq:
             m = seg == seg_start if len(uniq) > 1 else slice(None)
             await self._write_segment(mid[m], tsid[m], ts[m], vals[m], seq=seq, fast=True)
@@ -568,12 +677,16 @@ class SampleManager:
         """`fast`: flush-path (L0) writes take the fast parquet profile —
         compaction re-encodes them with the tuned one. Direct (unbuffered)
         persists keep tuned encodings: with no buffer there may be no
-        compaction churn either, so those SSTs can live long."""
+        compaction churn either, so those SSTs can live long.
+
+        Flush-path writes also ride the bounded upload semaphore: several
+        executor workers x several shards would otherwise fan encode+PUT
+        out without limit on a small host."""
         batch = pa.RecordBatch.from_pydict(
             {
                 "metric_id": np.ascontiguousarray(metric_ids, dtype=np.uint64),
                 "tsid": np.ascontiguousarray(tsids, dtype=np.uint64),
-                "field_id": np.zeros(len(ts), dtype=np.uint64),
+                "field_id": _zeros_u64(len(ts)),
                 "ts": np.ascontiguousarray(ts),
                 "value": np.ascontiguousarray(values),
             },
@@ -581,10 +694,15 @@ class SampleManager:
         )
         lo = int(ts.min())
         hi = int(ts.max()) + 1
-        await self._storage.write(
-            WriteRequest(batch, TimeRange(lo, hi), presorted=presorted, seq=seq,
-                         fast_encode=fast)
-        )
+        req = WriteRequest(batch, TimeRange(lo, hi), presorted=presorted,
+                           seq=seq, fast_encode=fast)
+        if fast:
+            if self._upload_sem is None:
+                self._upload_sem = asyncio.Semaphore(self.MAX_INFLIGHT_UPLOADS)
+            async with self._upload_sem:
+                await self._storage.write(req)
+        else:
+            await self._storage.write(req)
 
     # -- queries ---------------------------------------------------------------
     def _predicate(self, metric_id: int, tsids: list[int] | None, rng: TimeRange):
@@ -612,9 +730,9 @@ class SampleManager:
         storage.rs:335-370)."""
         if self._buffer_rows:
             # always flush (not just when _buffered > 0): an in-flight flush
-            # has already detached the buffers but its SSTs may not be
-            # durable yet — flush() waits on the lock, keeping reads
-            # consistent with acked writes
+            # has already sealed the buffers but its SSTs may not be durable
+            # yet — flush() quiesces the executor, keeping reads consistent
+            # with acked writes (union of active + sealed + flushed)
             await self.flush()
         from contextlib import aclosing
 
@@ -690,8 +808,6 @@ class SampleManager:
         pred = self._predicate(
             metric_id, list(series_ids) if filtered else None, rng
         )
-        import asyncio
-
         # Per-segment pushdown passes run CONCURRENTLY: reads of one
         # segment overlap another's device kernel — the engine-side analog
         # of the reference's UnionExec driving per-segment plans. The

@@ -100,6 +100,9 @@ class MetricEngine:
         config: StorageConfig | None = None,
         enable_compaction: bool = True,
         ingest_buffer_rows: int = 0,
+        flush_workers: int = 2,
+        flush_queue_max: int = 4,
+        flush_stall_deadline_s: float = 30.0,
         sst_executor=None,
         manifest_executor=None,
         parser_pool=None,
@@ -108,7 +111,10 @@ class MetricEngine:
     ) -> "MetricEngine":
         """`ingest_buffer_rows` > 0 buffers data-table rows across writes
         and flushes as one SST per segment when the threshold is reached
-        (see SampleManager.__init__ for the durability trade-off).
+        (see SampleManager.__init__ for the durability trade-off);
+        `flush_workers`/`flush_queue_max`/`flush_stall_deadline_s` size the
+        background flush executor (engine/flush_executor.py) that decouples
+        the append hot path from drain/encode/upload work.
         `sst_executor`/`manifest_executor` size CPU-heavy storage work
         (ThreadConfig, see ObjectBasedStorage.try_new). `parser_pool` shares
         the caller's ParserPool (so e.g. the server's pool telemetry covers
@@ -187,7 +193,11 @@ class MetricEngine:
         # whose shapes churn, at cryptographic collision resistance.
         self._lanes_fp: set[bytes] = set()
         self.sample_mgr = SampleManager(
-            self.data_table, segment_duration_ms, buffer_rows=ingest_buffer_rows
+            self.data_table, segment_duration_ms,
+            buffer_rows=ingest_buffer_rows,
+            flush_workers=flush_workers,
+            flush_queue_max=flush_queue_max,
+            flush_stall_deadline_s=flush_stall_deadline_s,
         )
         self.exemplar_mgr = SampleManager(self.exemplars_table, segment_duration_ms)
         await self.metric_mgr.open()
@@ -380,24 +390,29 @@ class MetricEngine:
                 return 0
             with tracing.span("append", samples=req.n_samples):
                 metric_arr, tsid_arr = await self._resolve_ids_fast(req)
-                if req.n_samples and self.sample_mgr.backlogged:
-                    # backlog cap BEFORE buffering: drain synchronously so a
-                    # storage outage rejects this payload un-buffered (5xx ->
-                    # sender retries later) instead of acking rows into an
-                    # unbounded buffer on every retry
-                    await self.sample_mgr.flush()
+                if len(req.exemplar_value):
+                    # the id lanes may be views into the borrowed parser's
+                    # decode arena (pooled_parser.DecodeArena) — exemplar
+                    # persistence runs after release, so own them first
+                    metric_arr = np.array(metric_arr)
+                    tsid_arr = np.array(tsid_arr)
                 if req.n_samples:
                     total = self.sample_mgr.buffer_native_add(parser)
         if len(req.exemplar_value):
             await self._persist_exemplars(req, metric_arr, tsid_arr)
         if total and self.sample_mgr.should_flush(total):
-            # background flush: encode threads overlap continued ingest
-            self.sample_mgr.flush_soon()
+            # hand the sealed memtable to the background flush executor:
+            # drain/encode/upload overlap continued ingest, and a FULL
+            # flush queue blocks here with a stall deadline (backpressure
+            # -> 5xx -> sender retries) instead of acking rows into an
+            # unbounded buffer
+            await self.sample_mgr.seal_and_submit()
         if self.sample_mgr.flush_in_flight:
             # cooperative yield: the steady write path never suspends, so a
             # driver hammering write_payload back-to-back would starve the
-            # flush task; one loop turn per payload lets its thread-offload
-            # completions schedule (a real server yields at socket reads)
+            # flush workers; one loop turn per payload lets their
+            # thread-offload completions schedule (a real server yields at
+            # socket reads)
             await asyncio.sleep(0)
         return req.n_samples
 

@@ -466,6 +466,7 @@ class IndexManager:
         import asyncio
 
         payload = await asyncio.to_thread(build)
+        # jaxlint: disable=J008 control-plane sidecar dump at quiesce/close, not the append path
         await self._sidecar_store.put(self._sidecar_path, payload)
 
     async def _load_sidecar(self) -> int | None:

@@ -294,6 +294,7 @@ class RegionedEngine:
                 list(range(num_regions)),
                 granularity,
             )
+            # jaxlint: disable=J008 one-time REGIONS descriptor create at open (control plane)
             await store.put(
                 desc_path,
                 json.dumps(self.router.to_descriptor(num_regions)).encode(),
@@ -349,6 +350,7 @@ class RegionedEngine:
             # engine first, descriptor second: a crash between the two
             # leaves an empty unreferenced sub-root (harmless), never a
             # referenced region with no engine state
+            # jaxlint: disable=J008 split-time descriptor rewrite (meta plane), not the append path
             await self._store.put(
                 self._desc_path,
                 json.dumps(

@@ -228,6 +228,11 @@ class NativeParser:
         # handle is only valid until the handle's next parse anyway.
         self._res = _RwResult()
         self._hres = _RwHashResult()
+        # Optional numpy scratch arena (pooled_parser.DecodeArena): when
+        # set, parse_light's id-lane copies reuse its buffers instead of
+        # allocating per request. Same lifetime contract as the C arena:
+        # lanes are valid only until this handle's next parse.
+        self.arena = None
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -253,10 +258,18 @@ class NativeParser:
         ns, nex = res.n_series, res.n_exemplars
         empty64 = _EMPTY_I64
         nexl = res.n_ex_labels if nex else 0
-        # one FFI crossing copies the three hot id lanes into owned memory
-        mid = np.empty(ns, np.uint64)
-        tsid = np.empty(ns, np.uint64)
-        nlen = np.empty(ns, np.int64)
+        # one FFI crossing copies the three hot id lanes out of the C
+        # arena — into the pooled DecodeArena's reusable scratch buffers
+        # when one is attached (zero allocations per steady-state request)
+        arena = self.arena
+        if arena is not None:
+            mid = arena.take("mid", ns, np.uint64)
+            tsid = arena.take("tsid", ns, np.uint64)
+            nlen = arena.take("nlen", ns, np.int64)
+        else:
+            mid = np.empty(ns, np.uint64)
+            tsid = np.empty(ns, np.uint64)
+            nlen = np.empty(ns, np.int64)
         if ns:
             self._lib.rw_copy_id_lanes(
                 self._h,

@@ -115,8 +115,30 @@ class EngineStorageConfig:
 
 
 @dataclass
+class IngestConfig:
+    """Overlapped ingest->flush pipeline knobs (engine/flush_executor.py).
+
+    `flush_workers` background write-out workers drain a queue of at most
+    `flush_queue_max` sealed memtables; when the queue is full, appends
+    block (backpressure, horaedb_ingest_stall_seconds) and fail with a
+    retryable error past `stall_deadline`. Bounded ingest memory is
+    roughly (flush_queue_max + flush_workers + 1) x ingest_buffer_rows."""
+
+    flush_workers: int = 2
+    flush_queue_max: int = 4
+    stall_deadline: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(30)
+    )
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "IngestConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
 class MetricEngineConfig:
     threads: ThreadConfig = field(default_factory=ThreadConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
     storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
     # Ingest buffering (engine/data.py SampleManager): 0 = every write is
     # immediately durable (reference write==SST semantics); > 0 buffers up
@@ -229,6 +251,9 @@ class Config:
             self.slowlog.capacity >= 0,
             "slowlog.capacity must be >= 0 (0 disables the recorder)",
         )
+        ing = self.metric_engine.ingest
+        ensure(ing.flush_workers >= 1, "ingest.flush_workers must be >= 1")
+        ensure(ing.flush_queue_max >= 1, "ingest.flush_queue_max must be >= 1")
         store = self.metric_engine.storage.object_store
         kind = store.type.lower()
         ensure(

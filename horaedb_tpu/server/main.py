@@ -1029,6 +1029,10 @@ async def build_app(config: Config) -> web.Application:
         sst_executor=sst_executor,
         manifest_executor=manifest_executor,
         ingest_buffer_rows=config.metric_engine.ingest_buffer_rows,
+        # overlapped ingest->flush pipeline sizing ([metric_engine.ingest])
+        flush_workers=config.metric_engine.ingest.flush_workers,
+        flush_queue_max=config.metric_engine.ingest.flush_queue_max,
+        flush_stall_deadline_s=config.metric_engine.ingest.stall_deadline.seconds,
         parser_pool=pool,
     )
     if config.metric_engine.node_id:

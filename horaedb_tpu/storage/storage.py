@@ -74,6 +74,15 @@ SCAN_SECONDS = GLOBAL_METRICS.histogram(
          "consumer breaks count as completed scans), by table root.",
     labelnames=("table",),
 )
+# Shared with engine/flush_executor.py (registry is idempotent by name):
+# flush-profile SST writes attribute their encode vs upload cost here; the
+# drain stage is observed at the memtable seal/sort.
+FLUSH_STAGE_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_flush_stage_seconds",
+    help="Per-stage flush cost: drain (memtable -> pk-sorted column "
+         "lanes), encode (parquet), upload (object-store PUT).",
+    labelnames=("table", "stage"),
+)
 
 
 def jax_backend_is_cpu() -> bool:
@@ -450,10 +459,23 @@ class ObjectBasedStorage(ColumnarStorage):
                 writer.close()
                 return sink.getvalue()
 
+            t_enc = time.perf_counter()
             blob = await self._run_sst(_encode_small)
+            if fast_encode:
+                # flush-path stage attribution: encode (thread pool; pyarrow
+                # cannot thread one file's columns, so flush parallelism is
+                # shard-level across the pool) vs the upload PUT below
+                FLUSH_STAGE_SECONDS.labels(self._root, "encode").observe(
+                    time.perf_counter() - t_enc
+                )
             ensure(len(blob) < 2**32, f"sst too large for manifest format: {len(blob)}")
+            t_up = time.perf_counter()
             with context(f"write sst {path}"):
                 await self._store.put(path, blob)
+            if fast_encode:
+                FLUSH_STAGE_SECONDS.labels(self._root, "upload").observe(
+                    time.perf_counter() - t_up
+                )
             await self._write_bloom_sidecar(file_id, path, table)
             SST_BYTES.observe(len(blob))
             return len(blob)
@@ -530,8 +552,15 @@ class ObjectBasedStorage(ColumnarStorage):
                 yield item
 
         try:
+            t_up = time.perf_counter()
             with context(f"write sst {path}"):
                 size = await self._store.put_stream(path, chunks())
+            if fast_encode:
+                # streaming path overlaps encode with the PUT; the combined
+                # wall time attributes to upload (encode rides the stream)
+                FLUSH_STAGE_SECONDS.labels(self._root, "upload").observe(
+                    time.perf_counter() - t_up
+                )
         finally:
             cancel.set()
             while not done.is_set():

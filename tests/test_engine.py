@@ -615,18 +615,21 @@ class TestIndexDeltaCompaction:
 
 class TestBackgroundFlushBackpressure:
     @async_test
-    async def test_backlog_cap_forces_synchronous_flush(self):
-        """Past BACKLOG_FACTOR x buffer_rows the write path must AWAIT the
-        flush (propagating storage errors) instead of acking into an
-        unbounded buffer."""
+    async def test_full_flush_queue_stalls_appends_and_surfaces_errors(self):
+        """With the store broken, failed memtables PARK on the bounded
+        flush queue; once it is full, appends block on the backpressure
+        condition variable and surface a retryable error at the stall
+        deadline instead of acking rows into an unbounded buffer."""
         import asyncio
 
         from horaedb_tpu.common.error import HoraeError
+        from horaedb_tpu.engine.flush_executor import INGEST_STALL_SECONDS
 
         store = MemStore()
         eng = await MetricEngine.open(
             "db", store, segment_duration_ms=HOUR,
             enable_compaction=False, ingest_buffer_rows=10,
+            flush_queue_max=2, flush_stall_deadline_s=0.2,
         )
         if not eng.sample_mgr.native_accum_active:
             pytest.skip("native accumulator unavailable")
@@ -638,12 +641,15 @@ class TestBackgroundFlushBackpressure:
             raise HoraeError("injected store failure")
 
         eng.sample_mgr._write_segment = failing
+        stall = INGEST_STALL_SECONDS.labels(eng.sample_mgr._table_id)
+        stalls0 = stall.count
         payload = make_remote_write(
             [({"__name__": "cpu", "host": f"h{i}"}, [(1000 + j, 1.0) for j in range(5)])
              for i in range(3)]
-        )  # 15 rows/payload, threshold 10, backlog cap 40: the first
-        # threshold crossings take the BACKGROUND flush path (and fail),
-        # re-buffering rows until the cap forces the synchronous branch
+        )  # 15 rows/payload, threshold 10, queue_max 2: the first threshold
+        # crossings seal + submit to the BACKGROUND executor (and fail,
+        # parking the memtables) until the queue is full and the submit
+        # stalls out to its deadline
         saw_error = False
         for _ in range(12):
             try:
@@ -652,9 +658,11 @@ class TestBackgroundFlushBackpressure:
                 saw_error = True
                 break
             await asyncio.sleep(0.01)  # let background flushes run
-        assert saw_error, "backlogged ingest never surfaced the storage failure"
-        assert eng.sample_mgr.buffered_rows <= eng.sample_mgr.BACKLOG_FACTOR * 10 + 30
-        assert calls["n"] >= 2  # background flushes ran (and failed) before the cap
+        assert saw_error, "full flush queue never surfaced the storage failure"
+        # bounded memory: queue_max sealed + one in flight + active buffer
+        assert eng.sample_mgr.buffered_rows <= (2 + 1) * 15 + 30
+        assert calls["n"] >= 2  # background write-outs ran (and failed)
+        assert stall.count > stalls0  # the stall was measured
         eng.sample_mgr._write_segment = type(eng.sample_mgr)._write_segment.__get__(eng.sample_mgr)
         await eng.close()
 

@@ -1,0 +1,308 @@
+"""Overlapped ingest->flush pipeline tests (engine/flush_executor.py +
+the SampleManager double-buffer rework):
+
+- swap protocol: appends during an in-flight flush land in the NEW
+  active memtable, reads see the union of active + sealed + flushed,
+  and two concurrent flush() calls cannot double-seal;
+- flush-failure durability: an injected object-store failure loses zero
+  rows (the sealed memtable parks with its sequence pinned and a retry
+  lands it), `horaedb_flush_failures_total` counts it, and shutdown
+  drains every queued flush before the engine closes;
+- executor mechanics: queue-depth gauge, bounded-queue backpressure.
+
+All concurrency here is deterministic — asyncio events gate the fake
+storage write, never sleeps-and-hope.
+"""
+
+import asyncio
+
+import pytest
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.engine import MetricEngine, QueryRequest
+from horaedb_tpu.ingest import PooledParser
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.pb import remote_write_pb2
+from tests.conftest import async_test
+
+HOUR = 3_600_000
+
+
+def make_remote_write(series_samples) -> bytes:
+    req = remote_write_pb2.WriteRequest()
+    for labels, samples in series_samples:
+        ts = req.timeseries.add()
+        for k in sorted(labels):
+            lab = ts.labels.add()
+            lab.name = k.encode()
+            lab.value = labels[k].encode()
+        for t, v in samples:
+            s = ts.samples.add()
+            s.timestamp = t
+            s.value = v
+    return req.SerializeToString()
+
+
+def payload_of(host: str, ts0: int, n: int, base_val: float) -> bytes:
+    return make_remote_write(
+        [({"__name__": "pipe", "host": host},
+          [(ts0 + i * 1000, base_val + i) for i in range(n)])]
+    )
+
+
+async def open_engine(store, **kw):
+    kw.setdefault("segment_duration_ms", HOUR)
+    kw.setdefault("enable_compaction", False)
+    kw.setdefault("ingest_buffer_rows", 8)
+    return await MetricEngine.open("db", store, **kw)
+
+
+class FlakyStore(MemStore):
+    """MemStore whose first `fail_puts` DATA-table SST puts raise — the
+    flaky object store of the fault-injection regression."""
+
+    def __init__(self, fail_puts: int = 1):
+        super().__init__()
+        self.fail_puts = fail_puts
+        self.failed = 0
+
+    async def put(self, path: str, data: bytes) -> None:
+        if (
+            self.fail_puts > 0
+            and path.startswith("db/data/")
+            and path.endswith(".sst")
+        ):
+            self.fail_puts -= 1
+            self.failed += 1
+            raise HoraeError("injected flaky object-store PUT")
+        await super().put(path, data)
+
+
+class TestSwapProtocol:
+    @async_test
+    async def test_appends_during_inflight_flush_land_in_new_buffer(self):
+        """While a sealed memtable's write-out is gated in flight, new
+        appends go to the FRESH active buffer (the double-buffer swap);
+        a query then sees the union of flushed + sealed + active."""
+        store = MemStore()
+        eng = await open_engine(store)
+        mgr = eng.sample_mgr
+        gate = asyncio.Event()
+        entered = asyncio.Event()
+        orig = mgr._write_segment
+
+        async def gated(*a, **kw):
+            entered.set()
+            await gate.wait()
+            return await orig(*a, **kw)
+
+        mgr._write_segment = gated
+        # 10 rows >= threshold 8: the write seals + submits to the executor
+        await eng.write_parsed(
+            PooledParser.decode(payload_of("a", 1000, 10, 0.0))
+        )
+        await asyncio.wait_for(entered.wait(), 5)
+        assert mgr.flush_in_flight
+        sealed_pending = mgr.flush_executor.pending_rows
+        assert sealed_pending == 10  # the sealed memtable, in flight
+        # appends DURING the in-flight flush: below threshold, stays active
+        await eng.write_parsed(
+            PooledParser.decode(payload_of("b", 2000, 3, 100.0))
+        )
+        assert mgr._has_pending_rows  # landed in the new ACTIVE buffer
+        assert mgr.buffered_rows == 13  # union tracked: sealed + active
+        gate.set()
+        t = await eng.query(QueryRequest(metric=b"pipe", start_ms=0,
+                                         end_ms=HOUR))
+        assert t.num_rows == 13  # reads see active + sealed + flushed
+        mgr._write_segment = orig
+        await eng.close()
+
+    @async_test
+    async def test_concurrent_flush_calls_do_not_double_seal(self):
+        """Two concurrent flush() barriers: exactly ONE seals the active
+        rows (the second sees an empty memtable), and the rows are
+        written exactly once."""
+        store = MemStore()
+        eng = await open_engine(store, ingest_buffer_rows=1000)
+        mgr = eng.sample_mgr
+        await eng.write_parsed(
+            PooledParser.decode(payload_of("a", 1000, 5, 0.0))
+        )
+        seals = []
+        orig_seal = mgr.seal
+
+        def spy_seal():
+            s = orig_seal()
+            seals.append(s)
+            return s
+
+        writes = []
+        orig_ws = mgr._write_segment
+
+        async def spy_ws(*a, **kw):
+            writes.append(len(a[2]))
+            return await orig_ws(*a, **kw)
+
+        mgr.seal = spy_seal
+        mgr._write_segment = spy_ws
+        await asyncio.gather(mgr.flush(), mgr.flush())
+        mgr.seal = orig_seal
+        mgr._write_segment = orig_ws
+        assert len([s for s in seals if s is not None]) == 1
+        assert sum(writes) == 5  # each row written exactly once
+        assert mgr.buffered_rows == 0
+        t = await eng.query(QueryRequest(metric=b"pipe", start_ms=0,
+                                         end_ms=HOUR))
+        assert t.num_rows == 5
+        await eng.close()
+
+
+class TestFlushFailureDurability:
+    @async_test
+    async def test_injected_flush_failure_loses_zero_rows(self):
+        """Fault injection: the object store raises on the first data-SST
+        PUT. The sealed memtable must park (rows intact, failure counted)
+        and the next flush trigger must land every row."""
+        from horaedb_tpu.engine.flush_executor import FLUSH_FAILURES_TOTAL
+
+        store = FlakyStore(fail_puts=1)
+        eng = await open_engine(store)
+        mgr = eng.sample_mgr
+        failures0 = FLUSH_FAILURES_TOTAL.labels(mgr._table_id).value
+        await eng.write_parsed(
+            PooledParser.decode(payload_of("a", 1000, 10, 0.0))
+        )
+        # wait (bounded) for the background write-out to fail and park
+        for _ in range(500):
+            if mgr.flush_executor.last_error is not None:
+                break
+            await asyncio.sleep(0.01)
+        assert store.failed == 1
+        assert mgr.buffered_rows == 10  # re-queued, nothing dropped
+        assert FLUSH_FAILURES_TOTAL.labels(mgr._table_id).value > failures0
+        # the query's flush barrier kicks the parked memtable; the store
+        # is healthy now, so the retry lands and every row is visible
+        t = await eng.query(QueryRequest(metric=b"pipe", start_ms=0,
+                                         end_ms=HOUR))
+        assert t.num_rows == 10
+        assert sorted(t.column("value").to_pylist()) == [float(i) for i in range(10)]
+        assert mgr.buffered_rows == 0
+        await eng.close()
+
+    @async_test
+    async def test_shutdown_drains_queued_flushes(self):
+        """Rows buffered below the threshold at close() must still be
+        durable: close -> flush barrier -> executor drained BEFORE the
+        manifests close. A fresh engine over the same store proves it."""
+        store = MemStore()
+        eng = await open_engine(store, ingest_buffer_rows=1000)
+        await eng.write_parsed(
+            PooledParser.decode(payload_of("a", 1000, 6, 0.0))
+        )
+        assert eng.sample_mgr.buffered_rows == 6  # nothing flushed yet
+        await eng.close()
+        eng2 = await open_engine(store, ingest_buffer_rows=1000)
+        t = await eng2.query(QueryRequest(metric=b"pipe", start_ms=0,
+                                          end_ms=HOUR))
+        assert t.num_rows == 6
+        await eng2.close()
+
+    @async_test
+    async def test_persistent_failure_raises_at_barrier_after_retry(self):
+        """A broken store: the barrier retries the parked memtable inline
+        exactly once and then surfaces the error — rows still parked."""
+        store = MemStore()
+        eng = await open_engine(store, ingest_buffer_rows=1000)
+        mgr = eng.sample_mgr
+        await eng.write_parsed(
+            PooledParser.decode(payload_of("a", 1000, 4, 0.0))
+        )
+        calls = {"n": 0}
+
+        async def failing(*a, **kw):
+            calls["n"] += 1
+            raise HoraeError("injected persistent store failure")
+
+        orig = mgr._write_segment
+        mgr._write_segment = failing
+        with pytest.raises(HoraeError):
+            await mgr.flush()
+        assert calls["n"] == 2  # worker attempt + one inline barrier retry
+        assert mgr.buffered_rows == 4  # parked, not dropped
+        mgr._write_segment = orig
+        await eng.close()  # drains cleanly once the store heals
+
+
+class TestExecutorMechanics:
+    @async_test
+    async def test_queue_depth_gauge_tracks_backlog(self):
+        from horaedb_tpu.engine.flush_executor import FLUSH_QUEUE_DEPTH
+
+        store = MemStore()
+        eng = await open_engine(store, flush_workers=1, flush_queue_max=4)
+        mgr = eng.sample_mgr
+        gauge = FLUSH_QUEUE_DEPTH.labels(mgr._table_id)
+        gate = asyncio.Event()
+        entered = asyncio.Event()
+        orig = mgr._write_segment
+
+        async def gated(*a, **kw):
+            entered.set()
+            await gate.wait()
+            return await orig(*a, **kw)
+
+        mgr._write_segment = gated
+        # first seal occupies the single worker; two more queue behind it
+        for i in range(3):
+            await eng.write_parsed(
+                PooledParser.decode(payload_of(f"h{i}", 1000, 9, 0.0))
+            )
+            if i == 0:
+                await asyncio.wait_for(entered.wait(), 5)
+        assert gauge.value == 2  # one in flight (excluded), two queued
+        gate.set()
+        await mgr.drain()
+        assert gauge.value == 0
+        mgr._write_segment = orig
+        await eng.close()
+
+    @async_test
+    async def test_full_queue_submit_raises_at_deadline(self):
+        """Bounded queue + dead worker gate: a submit past queue_max must
+        block, observe the stall histogram, and raise at the deadline."""
+        from horaedb_tpu.engine.flush_executor import INGEST_STALL_SECONDS
+
+        store = MemStore()
+        eng = await open_engine(
+            store, flush_workers=1, flush_queue_max=1,
+            flush_stall_deadline_s=0.15,
+        )
+        mgr = eng.sample_mgr
+        gate = asyncio.Event()
+        orig = mgr._write_segment
+
+        async def gated(*a, **kw):
+            await gate.wait()
+            return await orig(*a, **kw)
+
+        mgr._write_segment = gated
+        stall = INGEST_STALL_SECONDS.labels(mgr._table_id)
+        stalls0 = stall.count
+        with pytest.raises(HoraeError, match="ingest stalled"):
+            # worker gated on the 1st, queue holds the 2nd, 3rd stalls out
+            for i in range(3):
+                await eng.write_parsed(
+                    PooledParser.decode(payload_of(f"h{i}", 1000, 9, 0.0))
+                )
+        assert stall.count > stalls0
+        # the memtable sealed by the stalled submit must be PARKED, not
+        # dropped: every acked row is still tracked
+        assert mgr.buffered_rows == 27
+        gate.set()
+        await mgr.drain()  # backpressure released: everything lands
+        mgr._write_segment = orig
+        t = await eng.query(QueryRequest(metric=b"pipe", start_ms=0,
+                                         end_ms=HOUR))
+        assert t.num_rows == 27  # zero rows lost across the stall
+        await eng.close()

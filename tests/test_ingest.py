@@ -439,3 +439,52 @@ class TestPool:
         payload = make_payload(seed=3)
         out = PooledParser.decode(payload)
         assert out.n_series == 50
+
+
+class TestDecodeArena:
+    """pooled_parser.DecodeArena: pooled parses must reuse scratch lane
+    buffers across requests (the 90 ns/sample parse budget, ROOFLINE §7)
+    — the allocation-count assertion."""
+
+    def test_arena_reuses_buffers(self):
+        from horaedb_tpu.ingest.pooled_parser import DecodeArena
+
+        a = DecodeArena()
+        v = a.take("mid", 100, np.uint64)
+        assert len(v) == 100 and v.dtype == np.uint64
+        assert a.allocations == 1
+        a.take("mid", 64, np.uint64)
+        assert a.allocations == 1  # smaller request reuses the buffer
+        a.take("mid", 5000, np.uint64)
+        assert a.allocations == 2  # growth reallocates (geometric)
+        a.take("mid", 4096, np.uint64)
+        assert a.allocations == 2  # the grown buffer serves again
+        a.take("mid", 16, np.int64)
+        assert a.allocations == 3  # dtype change cannot alias
+
+    def test_parse_light_steady_state_allocates_nothing(self):
+        """Repeated parses of the same payload shape must hit the arena
+        every time: zero NEW lane allocations per steady-state request."""
+        from horaedb_tpu.ingest import native as native_mod
+        from horaedb_tpu.ingest.pooled_parser import DecodeArena, _new_backend
+
+        if native_mod.load() is None:
+            pytest.skip("native parser not available")
+        parser = _new_backend()  # the pool's constructor attaches the arena
+        assert isinstance(parser.arena, DecodeArena)
+        payload = make_payload(seed=3, n_series=40)
+        req = parser.parse_light(payload)
+        base = parser.arena.allocations
+        takes0 = parser.arena.takes
+        for _ in range(5):
+            req = parser.parse_light(payload)
+        assert parser.arena.allocations == base  # no new buffers
+        assert parser.arena.takes == takes0 + 15  # 3 lanes x 5 parses
+        # arena-backed lanes still decode correctly vs the full parse
+        oracle = native_mod.NativeParser().parse(payload)
+        np.testing.assert_array_equal(
+            np.asarray(req.series_metric_id), oracle.series_metric_id
+        )
+        np.testing.assert_array_equal(
+            np.asarray(req.series_tsid), oracle.series_tsid
+        )

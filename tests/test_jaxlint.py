@@ -390,3 +390,63 @@ class TestJaxlintGate:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ008AppendHotPath:
+    """J008: blocking object-store / parquet-encode calls reachable from
+    the append hot path (ingest/, engine/) outside the flush executor
+    module — flush work must stay behind engine/flush_executor.py."""
+
+    def seeded(self, tmp_path, name="seeded.py", pkg="engine"):
+        d = tmp_path / "horaedb_tpu" / pkg
+        d.mkdir(parents=True, exist_ok=True)
+        f = d / name
+        f.write_text(
+            "import pyarrow.parquet as pq\n"
+            "\n"
+            "async def append(store, table, payload):\n"
+            "    pq.write_table(table, 'x.parquet')\n"        # J008 encode
+            "    await store.put('k', payload)\n"             # J008 put
+            "    await store.put_stream('k', payload)\n"      # J008 put
+        )
+        return f
+
+    def test_fires_in_engine_and_ingest(self, tmp_path):
+        for pkg in ("engine", "ingest"):
+            r = run_jaxlint(self.seeded(tmp_path, pkg=pkg))
+            assert r.returncode == 3, r.stdout
+            assert r.stdout.count("J008") == 3, r.stdout
+            assert "parquet encode" in r.stdout
+            assert ".put_stream()" in r.stdout
+
+    def test_flush_executor_module_exempt(self, tmp_path):
+        r = run_jaxlint(self.seeded(tmp_path, name="flush_executor.py"))
+        assert r.returncode == 0, r.stdout
+
+    def test_outside_append_modules_not_flagged(self, tmp_path):
+        """storage/ and objstore/ ARE the durability layer: their puts and
+        parquet writers are the sanctioned implementation."""
+        d = tmp_path / "horaedb_tpu" / "storage"
+        d.mkdir(parents=True, exist_ok=True)
+        f = d / "storage.py"
+        f.write_text(
+            "import pyarrow.parquet as pq\n"
+            "\n"
+            "async def write_sst(store, table, blob):\n"
+            "    pq.write_table(table, 'x.parquet')\n"
+            "    await store.put('k', blob)\n"
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        d = tmp_path / "horaedb_tpu" / "engine"
+        d.mkdir(parents=True, exist_ok=True)
+        f = d / "meta.py"
+        f.write_text(
+            "async def write_descriptor(store, desc):\n"
+            "    # jaxlint: disable=J008 control-plane descriptor write at open\n"
+            "    await store.put('REGIONS', desc)\n"
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

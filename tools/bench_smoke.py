@@ -99,6 +99,12 @@ def main() -> int:
               f"compile_s missing/zero: {result.get('compile_s')!r}")
         check(result.get("steady_s", 0) > 0,
               f"steady_s missing/zero: {result.get('steady_s')!r}")
+        # ingest lane (overlapped ingest->flush pipeline): both numbers
+        # must ride the payload so bench trajectory can track the overlap
+        check(result.get("ingest_pure_samples_per_sec", 0) > 0,
+              "ingest lane: pure samples/s missing/zero")
+        check(result.get("ingest_with_flush_samples_per_sec", 0) > 0,
+              "ingest lane: with-flush samples/s missing/zero")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")

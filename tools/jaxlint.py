@@ -53,6 +53,14 @@ Rules (see docs/static-analysis.md for rationale and examples):
         and EXPLAIN compile/steady split that common/xprof.py feeds —
         route through `xprof.xjit` instead (same signature, jit kwargs
         pass through)
+  J008  blocking flush work reachable from the append hot path
+        (ingest/, engine/ outside engine/flush_executor.py): direct
+        parquet-encode calls (`pq.ParquetWriter`/`pq.write_table`) and
+        direct object-store puts (`.put`/`.put_stream`/
+        `.put_if_absent`) — the overlapped ingest->flush pipeline only
+        holds its measured 3x with-flush throughput while flush work
+        runs on the flush executor through the storage layer; control-
+        plane writes (descriptors, sidecars) suppress with the reason
 
 Suppressions: `# jaxlint: disable=J001 <reason>` on the finding's line
 or the line immediately above. The reason is mandatory (J000 otherwise);
@@ -124,6 +132,22 @@ J007_MODULES = (
     "horaedb_tpu/parallel/",
     "horaedb_tpu/promql/",
 )
+
+# J008: the append hot path (ingest decode + the engine write layers)
+# must not reach blocking flush work directly — parquet encodes and
+# object-store puts belong behind the flush executor
+# (engine/flush_executor.py) and the storage layer it drives.
+J008_MODULES = (
+    "horaedb_tpu/ingest/",
+    "horaedb_tpu/engine/",
+)
+J008_EXEMPT = ("horaedb_tpu/engine/flush_executor.py",)
+PARQUET_ENCODE_CALLS = {
+    "pq.ParquetWriter", "pq.write_table", "pq.write_to_dataset",
+    "pyarrow.parquet.ParquetWriter", "pyarrow.parquet.write_table",
+    "parquet.ParquetWriter", "parquet.write_table",
+}
+OBJSTORE_PUT_VERBS = {"put", "put_stream", "put_if_absent"}
 
 # device -> host syncs, unambiguous even outside jit
 SYNC_METHODS = {"item", "block_until_ready"}
@@ -600,6 +624,37 @@ def _check_naked_jit(tree: ast.Module, findings: list[Finding]) -> None:
                 ))
 
 
+def _check_append_hot_path(tree: ast.Module, findings: list[Finding]) -> None:
+    """J008, append-hot modules only: direct parquet-encode calls and
+    direct object-store put verbs. The storage layer (`storage.write`)
+    is the sanctioned durability path — it runs on the flush executor's
+    workers with encode offloaded to the SST pool; a call site here
+    would drag that work back onto the append path. Control-plane writes
+    (region descriptors, index sidecars) carry reasoned suppressions."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        if fd in PARQUET_ENCODE_CALLS:
+            findings.append(Finding(
+                node.lineno, "J008",
+                f"parquet encode `{fd}(...)` reachable from the append hot "
+                "path — flush encode belongs behind the flush executor "
+                "(engine/flush_executor.py) via the storage layer",
+            ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in OBJSTORE_PUT_VERBS
+        ):
+            findings.append(Finding(
+                node.lineno, "J008",
+                f"direct object-store `.{node.func.attr}()` reachable from "
+                "the append hot path — route durability through the "
+                "storage layer / flush executor, or suppress with the "
+                "control-plane justification",
+            ))
+
+
 def _lock_attrs_of(cls: ast.ClassDef) -> set[str]:
     """Attribute names of locks this class OWNS (self._lock = Lock())."""
     out: set[str] = set()
@@ -778,6 +833,10 @@ def lint_file(path: Path) -> list[str]:
         (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
         for h in J007_MODULES
     )
+    in_j008_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J008_MODULES
+    ) and not any(posix.endswith(m) for m in J008_EXEMPT)
 
     idx = JitIndex()
     idx.visit(tree)
@@ -795,6 +854,8 @@ def lint_file(path: Path) -> list[str]:
             _check_onehot(tree, findings)
     if in_j007_scope:
         _check_naked_jit(tree, findings)
+    if in_j008_scope:
+        _check_append_hot_path(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

@@ -53,6 +53,18 @@ REQUIRED_FAMILIES = (
     "horaedb_compaction_seconds_bucket",
     "horaedb_http_request_seconds_bucket",
     "horaedb_ingest_flush_seconds_bucket",
+    # overlapped ingest->flush pipeline (engine/flush_executor.py): the
+    # bulk write below crosses the buffer threshold, so a background
+    # flush must have run and fed the stage histograms
+    "horaedb_flush_queue_depth",
+    "horaedb_ingest_stall_seconds_bucket",
+    # (table renders before stage in this family's label set)
+    "horaedb_flush_stage_seconds_bucket",
+    'stage="drain"',
+    'stage="encode"',
+    'stage="upload"',
+    "horaedb_flush_failures_total",
+    "horaedb_flush_overlap_ratio_bucket",
     "horaedb_uptime_seconds",
     # device-side compile telemetry (common/xprof.py): the counter must
     # carry at least one real labeled kernel after the queries ran
@@ -83,6 +95,26 @@ def make_payload() -> bytes:
     return req.SerializeToString()
 
 
+def make_bulk_payload(n_series: int, n_samples: int) -> bytes:
+    """Enough rows to cross the ingest buffer threshold, so at least one
+    BACKGROUND flush runs and the pipeline stage histograms get fed."""
+    from horaedb_tpu.pb import remote_write_pb2
+
+    req = remote_write_pb2.WriteRequest()
+    for s in range(n_series):
+        ts = req.timeseries.add()
+        for k, v in ((b"__name__", b"smoke_bulk"),
+                     (b"host", f"bulk-{s:03d}".encode())):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = v
+        for i in range(n_samples):
+            smp = ts.samples.add()
+            smp.timestamp = 1000 + i * 1000
+            smp.value = float(s + i)
+    return req.SerializeToString()
+
+
 async def run() -> int:
     import aiohttp
     from aiohttp import web
@@ -104,13 +136,20 @@ async def run() -> int:
     fake = FakeS3()
     url = await fake.start()
     cfg = Config.from_dict({
-        "metric_engine": {"storage": {"object_store": {
-            "type": "S3Like", "endpoint": url, "bucket": fake.bucket,
-            "region": "smoke", "key_id": "smoke", "key_secret": "smoke",
-            # fresh local scratch: the slowlog spool must start empty so
-            # "the recorded request comes back" proves THIS process wrote it
-            "data_dir": scratch,
-        }}},
+        "metric_engine": {
+            "storage": {"object_store": {
+                "type": "S3Like", "endpoint": url, "bucket": fake.bucket,
+                "region": "smoke", "key_id": "smoke", "key_secret": "smoke",
+                # fresh local scratch: the slowlog spool must start empty so
+                # "the recorded request comes back" proves THIS process
+                # wrote it
+                "data_dir": scratch,
+            }},
+            # small buffer + explicit executor sizing: the bulk write must
+            # cross the threshold and take the BACKGROUND flush path
+            "ingest_buffer_rows": 64,
+            "ingest": {"flush_workers": 2, "flush_queue_max": 4},
+        },
     })
     app = await build_app(cfg)
     runner = web.AppRunner(app)
@@ -126,6 +165,20 @@ async def run() -> int:
                 body = await r.json()
                 check(r.status == 200 and body.get("samples") == 3,
                       f"remote-write accepted: {body}")
+            # bulk write: 40 series x 4 samples = 160 rows vs the 64-row
+            # buffer -> the threshold seals a memtable to the background
+            # flush executor (queue depth / stall / stage families)
+            async with s.post(f"{base}/api/v1/write",
+                              data=make_bulk_payload(40, 4)) as r:
+                body = await r.json()
+                check(r.status == 200 and body.get("samples") == 160,
+                      f"bulk remote-write accepted: {body}")
+            async with s.post(f"{base}/api/v1/query", json={
+                "metric": "smoke_bulk", "start_ms": 0, "end_ms": 10_000,
+            }) as r:
+                body = await r.json()
+                check(r.status == 200 and body.get("rows") == 160,
+                      f"bulk rows visible after background flush: {body}")
             async with s.post(f"{base}/api/v1/query", json={
                 "metric": "smoke_cpu", "start_ms": 0, "end_ms": 10_000,
             }) as r:
