@@ -1,6 +1,6 @@
 # Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
 
-.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics bench-smoke clean soak dryruns tpu-suite
+.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics bench-smoke chaos-smoke clean soak dryruns tpu-suite
 
 test:
 	python -m pytest tests/ -x -q
@@ -28,13 +28,15 @@ lint:
 	$(MAKE) typecheck
 	$(MAKE) smoke-metrics
 	$(MAKE) bench-smoke
+	$(MAKE) chaos-smoke
 
 # Domain-aware gate (tools/jaxlint.py): host-sync on hot paths (J001),
 # retrace hazards under jit (J002), dtype drift in engine code (J003),
 # lock discipline on the concurrency surface (J004), host timers/spans
 # inside jit bodies (J005), ad-hoc aggregation lanes (J006), naked jit
-# (J007), blocking flush work on the append path (J008). Findings print
-# as path:line: CODE message.
+# (J007), blocking flush work on the append path (J008), naked
+# object-store construction outside the ResilientStore boundary (J009).
+# Findings print as path:line: CODE message.
 # Rules + suppression syntax: docs/static-analysis.md
 jaxlint:
 	python tools/jaxlint.py
@@ -52,6 +54,14 @@ smoke-metrics:
 # non-empty, and the calibration cache round-trips (tools/bench_smoke.py).
 bench-smoke:
 	JAX_PLATFORMS=cpu python tools/bench_smoke.py
+
+# Fault-tolerance gate: boot the real server over a seeded ChaosStore
+# (injected errors, torn writes, listing lag), assert exact query
+# results under live faults, breaker-open 503s with Retry-After, the
+# horaedb_objstore_* families, and crash recovery (fence re-acquire +
+# orphan-SST GC) at smoke scale (tools/chaos_smoke.py).
+chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
 # mypy over the annotated core (config in pyproject.toml [tool.mypy]); the
 # dev image has no mypy, so this degrades to a loud skip locally — CI

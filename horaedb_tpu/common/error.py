@@ -1,8 +1,31 @@
-"""Error type for the whole framework.
+"""Error type + failure taxonomy for the whole framework.
 
 The reference funnels every failure into a single `Error::Internal(anyhow::Error)`
 with pervasive `.context(...)` chains (src/common/src/error.rs:4-13). The Python
 analog is one exception type plus helpers that mirror `ensure!` / `.context()`.
+
+On top of the single base type sits the fault-tolerance taxonomy the
+object-store data plane (objstore/resilient.py) and the flush pipeline
+(engine/flush_executor.py) route on:
+
+- ``RetryableError`` — transient: a later identical attempt may succeed
+  (network blip, 5xx burst, timeout). Retry with backoff, park-and-replay.
+- ``PersistentError`` — deterministic: the same request will fail the same
+  way every time (4xx, malformed payload, too-large object). Retrying
+  burns budget without hope; surface it to the caller instead.
+- ``FatalError`` — a process-level invariant broke (deposed writer epoch,
+  corrupt snapshot): the current actor must stop, not retry.
+- ``UnavailableError`` — a RetryableError that additionally means "the
+  backend is down or this process is overloaded RIGHT NOW": circuit
+  breaker open, retry budget exhausted against a dead store, flush queue
+  stalled past deadline. The HTTP layer sheds these as 503 +
+  ``Retry-After`` (server/errors.py) instead of hanging or 500ing.
+
+``classify()`` maps any exception into the three retry classes. Unknown
+exception types classify ``retryable`` on purpose: transports raise
+arbitrary errors for transient faults, and the retry caps bound the cost
+of optimism, while a mis-classified ``persistent`` would drop work that
+one retry could have saved.
 """
 
 from __future__ import annotations
@@ -26,6 +49,49 @@ class HoraeError(Exception):
         return ": ".join(parts)
 
 
+class RetryableError(HoraeError):
+    """Transient failure: an identical retry may succeed."""
+
+
+class PersistentError(HoraeError):
+    """Deterministic failure: retrying the same request cannot succeed."""
+
+
+class FatalError(HoraeError):
+    """Process-level invariant broken: stop the current actor, don't retry."""
+
+
+class UnavailableError(RetryableError):
+    """The backend is down / this process is overloaded right now.
+
+    ``retry_after_s`` is the hint the HTTP layer surfaces as a
+    ``Retry-After`` header on the 503 it sheds (server/errors.py)."""
+
+    def __init__(self, msg: str, cause: BaseException | None = None,
+                 retry_after_s: float | None = None):
+        super().__init__(msg, cause=cause)
+        self.retry_after_s = retry_after_s
+
+
+def classify(exc: BaseException) -> str:
+    """Map any exception to ``"retryable" | "persistent" | "fatal"``.
+
+    Order matters: UnavailableError is Retryable, and mixed-lineage types
+    (e.g. a retries-exhausted transport error that subclasses both a
+    backend error and RetryableError) resolve retryable-first. The stdlib
+    transient families (timeouts, connection resets, OS-level IO) are
+    retryable without needing the marker class."""
+    if isinstance(exc, FatalError):
+        return "fatal"
+    if isinstance(exc, RetryableError):
+        return "retryable"
+    if isinstance(exc, PersistentError):
+        return "persistent"
+    # everything else — stdlib transients (timeouts, connection resets)
+    # and unknown types alike — defaults retryable (see docstring)
+    return "retryable"
+
+
 def ensure(cond: bool, msg: str) -> None:
     """`ensure!` analog (src/columnar_storage/src/macros.rs:18-30)."""
     if not cond:
@@ -34,10 +100,33 @@ def ensure(cond: bool, msg: str) -> None:
 
 @contextmanager
 def context(msg: str):
-    """`.context(msg)` analog: wrap any raised exception in HoraeError(msg)."""
+    """`.context(msg)` analog: wrap any raised exception in HoraeError(msg).
+
+    Taxonomy-preserving: wrapping an UnavailableError (or any
+    Retryable/Persistent/Fatal subclass) re-raises the SAME class — a
+    context frame must never demote a typed failure back to the plain
+    base, or the layers that route on the class (503 shedding, flush
+    classification, retry policy) silently lose it. The Retry-After hint
+    rides along."""
     try:
         yield
     except HoraeError as e:
-        raise HoraeError(msg, cause=e) from e
+        cls = HoraeError
+        if isinstance(e, (RetryableError, PersistentError, FatalError)):
+            cls = type(e)
+        try:
+            wrapped = cls(msg, cause=e)
+        except TypeError:  # exotic subclass __init__: keep the class's
+            # nearest taxonomy ancestor rather than losing the class
+            for base in (UnavailableError, RetryableError, PersistentError,
+                         FatalError):
+                if isinstance(e, base):
+                    wrapped = base(msg, cause=e)
+                    break
+            else:
+                wrapped = HoraeError(msg, cause=e)
+        if isinstance(e, UnavailableError) and isinstance(wrapped, UnavailableError):
+            wrapped.retry_after_s = e.retry_after_s
+        raise wrapped from e
     except Exception as e:  # noqa: BLE001 - deliberate funnel
         raise HoraeError(msg, cause=e) from e

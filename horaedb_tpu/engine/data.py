@@ -384,10 +384,20 @@ class SampleManager:
         Seals the active memtable (urgent submit bypasses the queue
         bound), waits out the memtables queued/in-flight AT ENTRY (a
         snapshot — sustained ingest submitting more work cannot starve
-        the barrier), then retries any PARKED failure inline exactly
-        once — a second failure is a persistent storage error and raises
-        here (the memtable re-parks first, so no acked row is ever
-        dropped)."""
+        the barrier), then handles PARKED failures by error class
+        (common/error.py):
+
+        - a RETRYABLE failure keeps PR 5's semantics: background
+          triggers re-queue it, and the barrier retries it inline
+          exactly once — a second failure raises here (the memtable
+          re-parks first, so no acked row is ever dropped).
+        - a memtable parked on a PERSISTENT or FATAL error is skipped by
+          background triggers entirely (kick_parked) — re-running a
+          deterministic failure every trigger burns store budget without
+          ever surfacing it. Only the barrier replays it (one inline
+          attempt per barrier): still broken -> the error surfaces HERE
+          on that first replay; cause fixed -> it drains. Rows stay
+          parked throughout."""
         ex = self._executor
         if ex is None:
             return
@@ -403,7 +413,8 @@ class SampleManager:
                 return
             try:
                 await self._writeout_once(parked)
-            except BaseException:
+            except BaseException as e:
+                parked.last_error = e
                 ex.park(parked)
                 raise
 

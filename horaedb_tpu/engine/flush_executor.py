@@ -40,7 +40,7 @@ from typing import Awaitable, Callable
 import numpy as np
 
 from horaedb_tpu.common import tracing
-from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.common.error import UnavailableError
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
 
 logger = logging.getLogger(__name__)
@@ -107,6 +107,11 @@ class SealedMemtable:
     # (seq, segment_start, (mid, tsid, ts, value), presorted)
     groups: list[tuple[int, int, tuple, bool]] = field(default_factory=list)
     attempts: int = 0
+    # the last write-out failure, kept WITH the memtable so retry policy
+    # can classify it (common/error.py): retryable failures re-queue on
+    # the next trigger; persistent/fatal ones surface at the barrier
+    # instead of parking forever
+    last_error: BaseException | None = None
 
 
 class FlushExecutor:
@@ -213,10 +218,14 @@ class FlushExecutor:
                 stalled = time.perf_counter() - t0
                 self._stall.observe(stalled)
                 err = self._last_error
-                raise HoraeError(
+                # typed overload signal: the HTTP layer sheds this as
+                # 503 + Retry-After (server/errors.py) instead of a 500 —
+                # the sender's retry IS the backpressure release valve
+                raise UnavailableError(
                     f"ingest stalled {stalled:.1f}s: flush queue full "
                     f"({self.backlog} sealed memtables, table={self._table})"
-                    + (f"; last flush error: {err}" if err else "")
+                    + (f"; last flush error: {err}" if err else ""),
+                    retry_after_s=min(self._deadline, 5.0),
                 )
             self._stall.observe(time.perf_counter() - t0)
         self._queue.append(sealed)
@@ -225,11 +234,27 @@ class FlushExecutor:
 
     def kick_parked(self) -> None:
         """Re-queue parked (failed) memtables at the FRONT — their pinned
-        seqs are the oldest and a retry should land before fresh work."""
+        seqs are the oldest and a retry should land before fresh work.
+
+        Classification gate (common/error.py): only RETRYABLE failures
+        re-queue here. A memtable whose last failure was persistent or
+        fatal stays parked — background workers re-attempting a
+        deterministic failure on every trigger would burn store budget
+        forever without ever surfacing it; the flush barrier owns
+        raising those (SampleManager.flush)."""
         if not self._parked:
             return
+        from horaedb_tpu.common.error import classify
+
+        keep: list[SealedMemtable] = []
         while self._parked:
-            self._queue.appendleft(self._parked.pop())
+            s = self._parked.pop()
+            if s.last_error is not None and classify(s.last_error) != "retryable":
+                keep.append(s)
+                continue
+            self._queue.appendleft(s)
+        keep.reverse()
+        self._parked = keep
         self._set_depth()
         self._kick()
 
@@ -278,6 +303,7 @@ class FlushExecutor:
                     raise
                 except BaseException as e:  # noqa: BLE001 — parked for retry
                     self._last_error = e
+                    item.last_error = e
                     self.park(item)
                     logger.error(
                         "background flush failed (table=%s, rows=%d, "

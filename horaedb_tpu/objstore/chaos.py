@@ -1,0 +1,249 @@
+"""ChaosStore: seeded fault injection over any ObjectStore.
+
+The reference tests its store layer against a well-behaved tmpdir
+filesystem; real S3 misbehaves in specific, enumerable ways. This module
+makes those behaviors injectable so the chaos lane (tests/test_chaos.py,
+tools/chaos_smoke.py) can drive the WHOLE engine — write, flush,
+compact, scan, crash, reopen — against them and assert exact results
+plus zero acknowledged-row loss:
+
+- **Injected errors**: per-op-type probability of raising a transient
+  (`InjectedFault`, classified retryable) error before or after the
+  inner op runs ("after" models a lost ack: the op took effect but the
+  caller saw a failure — retries must be idempotent).
+- **Added latency**: per-op delay, for deadline/timeout exercise.
+- **Torn writes**: a `put` lands a PREFIX of the payload in the inner
+  store, then raises — the non-atomic backend a crashed multipart leaves
+  behind. (Readers must never trust an object the manifest doesn't
+  reference; recovery must GC it.)
+- **Delayed visibility**: a `put` commits (GET/HEAD see it — matching
+  S3's strong read-after-write), but LIST omits it for
+  `visibility_lag_ops` store ops (or until `settle()`) — the
+  eventual-listing behavior manifest merges, fence validation, and
+  orphan GC must tolerate. Conditional puts are exempt: S3's
+  conditional writes are strongly consistent, and the fence stakes
+  correctness on exactly that.
+- **Crash points**: `crash_next(op, path_substr)` raises `InjectedCrash`
+  (a BaseException — deliberately NOT retryable/catchable by the
+  resilience layer) at the matching call, modelling the process dying
+  mid-sequence. The harness abandons the engine object without close()
+  and reopens over the surviving store state.
+
+Determinism: every probabilistic decision comes from one
+`random.Random(seed)`; a `FaultPlan` is a value object, so a failing
+soak seed reproduces exactly.
+
+Explicit one-shot injections (`fail_next`) exist alongside the
+probabilistic plan for tests that need a fault at an exact call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common.error import RetryableError
+from horaedb_tpu.objstore import ObjectMeta, ObjectStore
+
+
+class InjectedFault(RetryableError):
+    """A chaos-plan transient fault (retryable by design)."""
+
+
+class InjectedCrash(BaseException):
+    """The simulated process death. BaseException on purpose: nothing in
+    the engine (including the resilience layer's `except Exception`
+    ladders) may swallow it — it must unwind to the chaos harness, which
+    then abandons the engine and reopens, exactly like a real crash."""
+
+
+@dataclass
+class OpFaults:
+    """Per-op-type probabilities/levels. All default to 'well-behaved'."""
+
+    error_rate: float = 0.0          # P(raise InjectedFault before the op)
+    lost_ack_rate: float = 0.0       # P(op runs, then raise anyway)
+    latency_s: float = 0.0           # added await before the op
+    # put only, DATA-plane paths only ("/data/" objects): land a prefix,
+    # then raise. Control-plane writes (manifest delta/snapshot, fence
+    # epochs) are atomic in every real backend — S3's single PUT is
+    # atomic and LocalStore renames — so tearing them would model a
+    # store no deployment runs on; crashed multipart DATA uploads are
+    # the real-world source of partial objects.
+    torn_write_rate: float = 0.0
+
+
+@dataclass
+class FaultPlan:
+    """A seeded chaos schedule. `ops` maps op name (put/get/list/delete/
+    head/put_if_absent/put_stream) to its OpFaults; missing ops are
+    clean. `visibility_lag_ops` > 0 hides every put from LIST for that
+    many subsequent store ops (0 = immediately listed)."""
+
+    seed: int = 0
+    ops: dict[str, OpFaults] = field(default_factory=dict)
+    visibility_lag_ops: int = 0
+
+    def for_op(self, op: str) -> OpFaults:
+        return self.ops.get(op) or _CLEAN
+
+
+_CLEAN = OpFaults()
+
+
+class ChaosStore(ObjectStore):
+    """ObjectStore decorator applying a FaultPlan (see module docstring)."""
+
+    def __init__(self, inner: ObjectStore, plan: FaultPlan | None = None):
+        self._inner = inner
+        self.plan = plan or FaultPlan()
+        self._rng = random.Random(self.plan.seed)
+        # eventual-listing lag: path -> op_no at which LIST starts seeing it
+        self._unlisted: dict[str, int] = {}
+        self._op_no = 0
+        # explicit one-shot injections: op -> remaining forced failures
+        self._fail_next: dict[str, int] = {}
+        # armed crash points: (op, path_substr)
+        self._crashes: list[tuple[str, str]] = []
+        self.injected_errors = 0
+        self.injected_crashes = 0
+
+    # -- explicit controls ---------------------------------------------------
+
+    def fail_next(self, op: str, n: int = 1) -> None:
+        """Force the next `n` calls of `op` to raise InjectedFault."""
+        self._fail_next[op] = self._fail_next.get(op, 0) + n
+
+    def crash_next(self, op: str, path_substr: str = "") -> None:
+        """Arm a crash point: the next `op` call whose path contains
+        `path_substr` raises InjectedCrash INSTEAD of running."""
+        self._crashes.append((op, path_substr))
+
+    def settle(self) -> None:
+        """Make every lagging object LIST-visible now."""
+        self._unlisted.clear()
+
+    # -- fault machinery -----------------------------------------------------
+
+    def _check_crash(self, op: str, path: str) -> None:
+        for i, (c_op, substr) in enumerate(self._crashes):
+            if c_op == op and substr in path:
+                del self._crashes[i]
+                self.injected_crashes += 1
+                raise InjectedCrash(f"injected crash at {op} {path}")
+
+    async def _pre(self, op: str, path: str) -> OpFaults:
+        """Shared prologue: tick the op clock (expiring listing lag),
+        check crash points and forced failures, apply latency, roll the
+        error dice."""
+        self._op_no += 1
+        self._settle_due()
+        self._check_crash(op, path)
+        faults = self.plan.for_op(op)
+        if self._fail_next.get(op, 0) > 0:
+            self._fail_next[op] -= 1
+            self.injected_errors += 1
+            raise InjectedFault(f"forced fault: {op} {path}")
+        if faults.latency_s > 0:
+            await asyncio.sleep(faults.latency_s)
+        if faults.error_rate > 0 and self._rng.random() < faults.error_rate:
+            self.injected_errors += 1
+            raise InjectedFault(f"injected fault: {op} {path}")
+        return faults
+
+    def _post(self, op: str, path: str, faults: OpFaults) -> None:
+        """Lost-ack injection: the op ran; the caller still sees a fault."""
+        if faults.lost_ack_rate > 0 and self._rng.random() < faults.lost_ack_rate:
+            self.injected_errors += 1
+            raise InjectedFault(f"injected lost ack: {op} {path}")
+
+    def _settle_due(self) -> None:
+        if not self._unlisted:
+            return
+        for p in [p for p, at in self._unlisted.items() if self._op_no >= at]:
+            del self._unlisted[p]
+
+    def _mark_unlisted(self, path: str) -> None:
+        if self.plan.visibility_lag_ops > 0:
+            self._unlisted[path] = self._op_no + self.plan.visibility_lag_ops
+
+    # -- the verbs -----------------------------------------------------------
+
+    async def put(self, path: str, data: bytes) -> None:
+        faults = await self._pre("put", path)
+        if (
+            faults.torn_write_rate > 0 and "/data/" in path
+            and self._rng.random() < faults.torn_write_rate
+        ):
+            # a torn PUT: a strict prefix lands, the ack never comes
+            cut = self._rng.randrange(0, max(1, len(data)))
+            await self._inner.put(path, bytes(data[:cut]))
+            self._mark_unlisted(path)
+            self.injected_errors += 1
+            raise InjectedFault(f"injected torn write: put {path} ({cut}B)")
+        await self._inner.put(path, bytes(data))
+        self._mark_unlisted(path)
+        self._post("put", path, faults)
+
+    async def put_if_absent(self, path: str, data: bytes) -> None:
+        faults = await self._pre("put_if_absent", path)
+        # conditional puts skip listing lag: they ARE the arbiter the
+        # fence stakes correctness on, and S3's conditional writes are
+        # strongly consistent even where listings lag
+        await self._inner.put_if_absent(path, bytes(data))
+        self._post("put_if_absent", path, faults)
+
+    async def get(self, path: str) -> bytes:
+        faults = await self._pre("get", path)
+        # read-after-write is STRONG (matching modern S3): lag hits LIST only
+        data = await self._inner.get(path)
+        self._post("get", path, faults)
+        return data
+
+    async def list(self, prefix: str) -> list[ObjectMeta]:
+        faults = await self._pre("list", prefix)
+        out = await self._inner.list(prefix)
+        if self._unlisted:
+            out = [m for m in out if m.path not in self._unlisted]
+        self._post("list", prefix, faults)
+        return out
+
+    async def delete(self, path: str) -> None:
+        faults = await self._pre("delete", path)
+        self._unlisted.pop(path, None)
+        await self._inner.delete(path)
+        self._post("delete", path, faults)
+
+    async def head(self, path: str) -> ObjectMeta:
+        faults = await self._pre("head", path)
+        meta = await self._inner.head(path)
+        self._post("head", path, faults)
+        return meta
+
+    async def put_stream(self, path: str, chunks) -> int:
+        """Streamed put: crash points fire mid-stream (after the first
+        chunk is consumed) so a crashed multipart leaves consumed-but-
+        unlanded bytes, the worst case for replay logic."""
+        faults = await self._pre("put_stream", path)
+        parts: list[bytes] = []
+        async for c in chunks:
+            parts.append(c)
+            self._check_crash("put_stream_mid", path)
+        await self._inner.put(path, b"".join(parts))
+        self._mark_unlisted(path)
+        self._post("put_stream", path, faults)
+        return sum(len(p) for p in parts)
+
+    # -- pass-throughs -------------------------------------------------------
+
+    async def verify_conditional_puts(self, prefix: str) -> None:
+        await self._inner.verify_conditional_puts(prefix)
+
+    def local_path(self, path: str) -> str | None:
+        return self._inner.local_path(path)
+
+    async def close(self) -> None:
+        closer = getattr(self._inner, "close", None)
+        if closer is not None:
+            await closer()

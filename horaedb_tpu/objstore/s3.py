@@ -34,7 +34,11 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 
-from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.common.error import (
+    HoraeError,
+    PersistentError,
+    RetryableError,
+)
 from horaedb_tpu.common.time_ext import ReadableDuration
 from horaedb_tpu.objstore import (
     NotFound,
@@ -68,12 +72,27 @@ class HttpOptions:
 class TimeoutOptions:
     """Two-tier timeouts (reference config.rs:153-170): `timeout` bounds
     single-object metadata ops (head/delete/list page), `io_timeout` bounds
-    data-moving ops (get/put)."""
+    data-moving ops (get/put). On top of those TOTAL bounds, two explicit
+    transport-layer timeouts (config-surfaced under
+    `[metric_engine.storage.object_store.timeout]`):
+
+    - `connect_timeout` bounds TCP connect + TLS handshake per attempt —
+      a black-holed endpoint (SYN dropped by a firewall) fails in
+      seconds instead of pinning a flush worker for the full total;
+    - `read_timeout` bounds the gap between received chunks (sock_read)
+      — a server that accepts the request then stalls mid-body trips
+      this long before a large transfer's generous total would."""
 
     timeout: ReadableDuration = field(
         default_factory=lambda: ReadableDuration.secs(10)
     )
     io_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(10)
+    )
+    connect_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(5)
+    )
+    read_timeout: ReadableDuration = field(
         default_factory=lambda: ReadableDuration.secs(10)
     )
 
@@ -140,8 +159,19 @@ def sign_v4(
     )
 
 
-class S3Error(HoraeError):
-    """Non-retryable (or retries-exhausted) S3 response."""
+class S3Error(PersistentError):
+    """Deterministic S3 rejection (4xx other than 404/412): the same
+    request fails the same way every time — classified `persistent` so
+    upper layers (ResilientStore, the flush executor) surface it instead
+    of burning retry budget."""
+
+
+class S3RetriesExhausted(S3Error, RetryableError):
+    """The client's own bounded retries ran out against 5xx/429/transport
+    faults. Still an S3Error for compatibility, but classified
+    `retryable` (RetryableError wins in classify()): the fault was
+    transient — a LATER attempt may succeed, which is exactly what the
+    resilience layer's longer ladder and breaker exist to decide."""
 
 
 class S3LikeStore(ObjectStore):
@@ -251,7 +281,14 @@ class S3LikeStore(ObjectStore):
         url = yarl.URL(self._endpoint + uri + (f"?{qs}" if qs else ""),
                        encoded=True)
         tmo = (self.config.timeout.io_timeout if io else self.config.timeout.timeout)
-        req_timeout = aiohttp.ClientTimeout(total=tmo.seconds)
+        # explicit connect/read timeouts alongside the total: a black-holed
+        # endpoint (dropped SYNs) or a mid-body stall fails within its own
+        # bound instead of riding the full total on every attempt
+        req_timeout = aiohttp.ClientTimeout(
+            total=tmo.seconds,
+            connect=self.config.timeout.connect_timeout.seconds,
+            sock_read=self.config.timeout.read_timeout.seconds,
+        )
         attempts = max(1, self.config.max_retries)
         last: str = ""
         for attempt in range(attempts):
@@ -284,7 +321,9 @@ class S3LikeStore(ObjectStore):
                 last = f"{type(e).__name__}: {e}"
             if attempt + 1 < attempts:
                 await asyncio.sleep(min(0.05 * (2 ** attempt), 2.0))
-        raise S3Error(f"{method} {key}: retries exhausted ({attempts}): {last}")
+        raise S3RetriesExhausted(
+            f"{method} {key}: retries exhausted ({attempts}): {last}"
+        )
 
     # -- the five verbs -----------------------------------------------------
 

@@ -38,6 +38,22 @@ from horaedb_tpu.objstore.s3 import HttpOptions, S3LikeConfig, TimeoutOptions
 from horaedb_tpu.storage.config import StorageConfig, _from_dict
 
 
+def _default_retry():
+    # deferred: objstore.resilient registers metric families, whose
+    # registry module lives under server/ — a top-level import here would
+    # close the server.__init__ -> config -> resilient -> server.metrics
+    # cycle while server is still partially initialized
+    from horaedb_tpu.objstore.resilient import RetryPolicy
+
+    return RetryPolicy()
+
+
+def _default_breaker():
+    from horaedb_tpu.objstore.resilient import BreakerPolicy
+
+    return BreakerPolicy()
+
+
 @dataclass
 class TestConfig:
     """Self-write load generator (reference config.rs TestConfig)."""
@@ -70,6 +86,24 @@ class ThreadConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault-tolerance knobs for the object-store boundary
+    (objstore/resilient.py): the server wraps whichever store it builds
+    in a ResilientStore with this retry ladder and circuit breaker.
+    `[metric_engine.storage.object_store.resilience.retry]` /
+    `[...resilience.breaker]` in TOML. There is no off switch — set
+    `retry.max_attempts = 1` and `breaker.failure_threshold = 0` to get
+    single-attempt semantics with classification/metrics kept."""
+
+    retry: object = field(default_factory=_default_retry)
+    breaker: object = field(default_factory=_default_breaker)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "ResilienceConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
 class ObjectStoreConfig:
     """Tagged store selection: `type = "Local"` (data_dir) or
     `type = "S3Like"` with the reference's full knob tree
@@ -89,6 +123,9 @@ class ObjectStoreConfig:
     max_retries: int = 3
     http: HttpOptions = field(default_factory=HttpOptions)
     timeout: TimeoutOptions = field(default_factory=TimeoutOptions)
+    # retry/backoff/breaker policy applied by the server's ResilientStore
+    # wrapper around EITHER store type (objstore/resilient.py)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "ObjectStoreConfig":
@@ -265,3 +302,13 @@ class Config:
                 bool(store.endpoint and store.bucket),
                 "S3Like object_store requires endpoint and bucket",
             )
+        res = store.resilience
+        ensure(
+            res.retry.max_attempts >= 1,
+            "object_store.resilience.retry.max_attempts must be >= 1",
+        )
+        ensure(
+            res.breaker.failure_threshold >= 0,
+            "object_store.resilience.breaker.failure_threshold must be "
+            ">= 0 (0 disables the breaker)",
+        )

@@ -1,0 +1,50 @@
+"""HTTP shedding for the failure taxonomy (common/error.py).
+
+The graceful-degradation contract: when the object store is down
+(circuit breaker open, retry budget exhausted) or this process is
+overloaded (flush queue stalled past its deadline), HTTP writes and
+queries answer **503 + Retry-After** with bounded latency — never a
+hang, never a silent drop, and never a 4xx (remote-write senders retry
+5xx but permanently DROP batches on 4xx, so the status code IS the
+durability contract).
+
+`Retry-After` comes from the error itself when the breaker knows how
+long it stays open (`UnavailableError.retry_after_s`), else a small
+default — enough to decorrelate a sender fleet without stalling it.
+"""
+
+from __future__ import annotations
+
+import math
+
+from aiohttp import web
+
+from horaedb_tpu.common.error import UnavailableError
+
+# fallback Retry-After when the error carries no hint (seconds)
+DEFAULT_RETRY_AFTER_S = 1
+
+
+def retry_after_seconds(e: BaseException) -> int:
+    """Integer Retry-After for an unavailable-class error (>= 1: a 0
+    would tell well-behaved clients to hammer immediately)."""
+    hint = getattr(e, "retry_after_s", None)
+    if hint is None or hint <= 0:
+        return DEFAULT_RETRY_AFTER_S
+    return max(1, math.ceil(hint))
+
+
+def unavailable_response(
+    e: UnavailableError | BaseException, extra: dict | None = None
+) -> web.Response:
+    """503 + Retry-After for a store-down / overloaded request. `extra`
+    merges into the JSON body (e.g. partial-result provenance / EXPLAIN
+    for a scan that could not read a required SST)."""
+    body = {"error": str(e), "unavailable": True}
+    if extra:
+        body.update(extra)
+    return web.json_response(
+        body,
+        status=503,
+        headers={"Retry-After": str(retry_after_seconds(e))},
+    )

@@ -41,12 +41,14 @@ import pyarrow as pa
 from aiohttp import web
 
 from horaedb_tpu.common import tracing, xprof
-from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.common.error import HoraeError, UnavailableError
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.engine import MetricEngine, QueryRequest
 from horaedb_tpu.ingest import ParserPool
 from horaedb_tpu.objstore import LocalStore
+from horaedb_tpu.objstore.resilient import ResilientStore
 from horaedb_tpu.server.config import Config
+from horaedb_tpu.server.errors import unavailable_response
 from horaedb_tpu.server.metrics import GLOBAL_METRICS as METRICS
 from horaedb_tpu.server.slowlog import SlowLog, build_entry
 from horaedb_tpu.storage import scanstats
@@ -327,6 +329,12 @@ async def handle_remote_write(request: web.Request) -> web.Response:
     try:
         with tracing.span("ingest", bytes=len(body)):
             n = await state.engine.write_payload(body)
+    except UnavailableError as e:
+        # overload / store-down shedding: 503 + Retry-After with bounded
+        # latency (breaker open fails fast; a stalled flush queue already
+        # waited out its deadline) — the sender retries, nothing is lost
+        logger.warning("remote write shed (unavailable): %s", e)
+        return unavailable_response(e)
     except HoraeError as e:
         # client-shaped errors (malformed wire bytes, missing __name__)
         # stay 4xx
@@ -426,6 +434,9 @@ def _explain_payload(st, mode: str) -> dict:
             "selected": counts.get("ssts_selected", 0),
             "read": counts.get("ssts_read", 0),
             "bloom_pruned": counts.get("ssts_bloom_pruned", 0),
+            # partial-result provenance: SSTs a degraded store could not
+            # serve (the query answered 503; this names what was missing)
+            "unavailable": counts.get("ssts_unavailable", 0),
         },
         "scan_paths": scan_paths,
         "agg_impl": agg_impls[0] if agg_impls else None,
@@ -498,6 +509,8 @@ async def handle_query_range(request: web.Request) -> web.Response:
         ev = RangeEvaluator(state.engine, start_ms, end_ms, step_ms)
         with scanstats.scan_stats() as st:
             series = await ev.eval(expr)
+    except UnavailableError as e:
+        return unavailable_response(e)
     except (PromQLError, HoraeError, KeyError, ValueError) as e:
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
@@ -532,6 +545,8 @@ async def handle_promql_instant(
         ev = RangeEvaluator(state.engine, at_ms - LOOKBACK_MS, at_ms, LOOKBACK_MS)
         with scanstats.scan_stats() as st:
             series = await ev.eval(expr)
+    except UnavailableError as e:
+        return unavailable_response(e)
     except (PromQLError, HoraeError, ValueError) as e:
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
@@ -640,6 +655,15 @@ async def handle_query(request: web.Request) -> web.Response:
                 table = await state.engine.query_exemplars(req)
             else:
                 out = await state.engine.query(req)
+    except UnavailableError as e:
+        # a required SST (or the flush barrier before the scan) hit a
+        # down store: typed 503 + Retry-After, with the partial-result
+        # provenance of what WAS reached (ssts.unavailable names the
+        # unreadable remainder) when the caller asked for the plan
+        extra = (
+            {"explain": _explain_payload(st, mode)} if want_explain else None
+        )
+        return unavailable_response(e, extra=extra)
     except HoraeError as e:
         return web.json_response({"error": str(e)}, status=400)
     explain = _finish_explain(state, st, mode, want_explain)
@@ -892,6 +916,8 @@ async def handle_query_exemplars(request: web.Request) -> web.Response:
         req = _to_query(node, start_ms, end_ms + 1)
         req.limit = 10_000
         table = await state.engine.query_exemplars(req)
+    except UnavailableError as e:
+        return unavailable_response(e)
     except (PromQLError, HoraeError, KeyError, ValueError) as e:
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
@@ -974,7 +1000,14 @@ async def bench_write_worker(state: ServerState, worker_id: int) -> None:
 # ---------------------------------------------------------------------------
 
 
-async def build_app(config: Config) -> web.Application:
+async def build_app(config: Config, store=None) -> web.Application:
+    """`store`: optional pre-built ObjectStore overriding the config's
+    store selection — the chaos gate (tools/chaos_smoke.py) boots the
+    real server over a ChaosStore this way. Callers injecting a store
+    own its resilience wrapping; config-built stores are always wrapped
+    in a ResilientStore here, so every component (engine flush,
+    manifest, fence, compaction, scan reads) inherits the retry/breaker
+    policy."""
     from concurrent.futures import ThreadPoolExecutor
 
     config.validate()
@@ -987,12 +1020,21 @@ async def build_app(config: Config) -> web.Application:
     # layering; forced here so scrapers see the zero state from boot)
     xprof.register_metrics()
 
-    if store_cfg.type.lower() == "s3like":
+    res = store_cfg.resilience
+    if store is not None:
+        pass  # injected store: caller owns wrapping (see docstring)
+    elif store_cfg.type.lower() == "s3like":
         from horaedb_tpu.objstore.s3 import S3LikeStore
 
-        store = S3LikeStore(store_cfg.to_s3_config())
+        store = ResilientStore(
+            S3LikeStore(store_cfg.to_s3_config()),
+            retry=res.retry, breaker=res.breaker, name="s3like",
+        )
     else:
-        store = LocalStore(store_cfg.data_dir)
+        store = ResilientStore(
+            LocalStore(store_cfg.data_dir),
+            retry=res.retry, breaker=res.breaker, name="local",
+        )
         # aggregation calibration cache lives under the data root (an S3
         # deployment keeps the tmpdir default — the cache is per-BOX
         # measurement, not shared state)

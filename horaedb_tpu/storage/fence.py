@@ -40,7 +40,7 @@ import json
 import logging
 import time
 
-from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.common.error import FatalError, HoraeError
 from horaedb_tpu.objstore import ObjectStore, PreconditionFailed
 
 logger = logging.getLogger(__name__)
@@ -48,9 +48,11 @@ logger = logging.getLogger(__name__)
 FENCE_DIR = "fence"
 
 
-class FencedError(HoraeError):
+class FencedError(FatalError):
     """This writer's epoch has been superseded — it no longer owns the
-    region and must stop mutating its manifest."""
+    region and must stop mutating its manifest. A FatalError in the
+    taxonomy (common/error.py): the resilience layer must never retry
+    past it, and the flush pipeline surfaces it instead of parking."""
 
 
 def _fence_dir(root: str) -> str:

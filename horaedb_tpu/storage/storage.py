@@ -83,6 +83,12 @@ FLUSH_STAGE_SECONDS = GLOBAL_METRICS.histogram(
          "lanes), encode (parquet), upload (object-store PUT).",
     labelnames=("table", "stage"),
 )
+ORPHAN_SSTS_GC = GLOBAL_METRICS.counter(
+    "horaedb_orphan_ssts_gc_total",
+    help="Orphan SST objects (uploaded but never manifest-committed — a "
+         "crash between upload and commit) reclaimed at storage open.",
+    labelnames=("table",),
+)
 
 
 def jax_backend_is_cpu() -> bool:
@@ -150,6 +156,7 @@ class ObjectBasedStorage(ColumnarStorage):
         fence_node_id: str | None = None,
         fence_validate_interval_s: float = 5.0,
         fence=None,
+        gc_orphans: bool = True,
     ) -> "ObjectBasedStorage":
         """`sst_executor` / `manifest_executor`: optional
         concurrent.futures.Executors for CPU-heavy SST work (sort, parquet
@@ -200,6 +207,14 @@ class ObjectBasedStorage(ColumnarStorage):
 
             ensure_id_above(max(s.id for s in existing))
         self._path_gen = SstPathGenerator(self._root)
+        if gc_orphans:
+            # crash recovery: a writer that died between SST upload and
+            # manifest commit left data objects nothing references — safe
+            # to reclaim here because the manifest bootstrap above already
+            # folded every committed delta, and single-writer ownership
+            # (by construction or epoch fence) means no concurrent
+            # uploader exists at open
+            await self._gc_orphan_ssts()
         self._reader = ParquetReader(
             store, self._path_gen, self._schema,
             scan_block_rows=config.scan_block_rows,
@@ -223,6 +238,58 @@ class ObjectBasedStorage(ColumnarStorage):
         if self._scheduler is not None:
             await self._scheduler.close()
         await self._manifest.close()
+
+    async def _gc_orphan_ssts(self) -> None:
+        """Reclaim data objects the manifest does not reference (crash
+        between upload and commit, or a bloom-failure cleanup that itself
+        failed). Best-effort: a faulty store at open degrades to a log
+        line, never a failed boot — the orphans cost capacity, not
+        correctness, and the next open retries. Orphan ids also raise the
+        id-allocation floor so a fresh write can never mint an id whose
+        `.sst` path is already occupied by a dead object."""
+        from horaedb_tpu.storage.sst import ensure_id_above
+
+        try:
+            metas = await self._store.list(f"{self._root}/data")
+        except Exception as e:  # noqa: BLE001 — GC is best-effort at open
+            logger.warning("orphan sst gc skipped (list failed): %s", e)
+            return
+        live = {s.id for s in self._manifest.all_ssts()}
+        by_id: dict[int, list[str]] = {}
+        for m in metas:
+            name = m.path.rsplit("/", 1)[-1]
+            stem, _, ext = name.partition(".")
+            if ext not in ("sst", "bloom") or not stem.isdigit():
+                continue
+            fid = int(stem)
+            if fid in live:
+                continue
+            by_id.setdefault(fid, []).append(m.path)
+        if not by_id:
+            return
+        ensure_id_above(max(by_id))
+        paths = [p for ps in by_id.values() for p in ps]
+        results = await asyncio.gather(
+            *(self._store.delete(p) for p in paths), return_exceptions=True
+        )
+        failed = [
+            p for p, r in zip(paths, results) if isinstance(r, BaseException)
+        ]
+        for p in failed:
+            logger.warning("orphan sst gc: failed to delete %s", p)
+        # count only FULLY reclaimed orphans: a failed delete stays behind
+        # for the next open to retry, and counting it now would double-count
+        # it then (and lie to the runbook watching this family)
+        failed_ids = {
+            int(p.rsplit("/", 1)[-1].partition(".")[0]) for p in failed
+        }
+        ORPHAN_SSTS_GC.labels(self._root).inc(len(by_id) - len(
+            failed_ids & set(by_id)
+        ))
+        logger.info(
+            "orphan sst gc: root=%s orphans=%d objects=%d (failed=%d)",
+            self._root, len(by_id), len(paths), len(failed),
+        )
 
     # -- accessors ----------------------------------------------------------
     @property
@@ -655,7 +722,14 @@ class ObjectBasedStorage(ColumnarStorage):
         input files between the caller's manifest snapshot and the read.
         Sound because compaction is segment-local (picker groups by
         segment), so the replacement SST lives in the same segment; an
-        empty refresh means the data was TTL-expired."""
+        empty refresh means the data was TTL-expired.
+
+        A store-unavailable failure (breaker open / retries exhausted in
+        the resilience layer) is NOT retried here — the store layer
+        already spent its budget. It is noted as `ssts_unavailable` scan
+        provenance (EXPLAIN / the 503 body carries it) and re-raised
+        typed, so the HTTP layer sheds instead of 500ing."""
+        from horaedb_tpu.common.error import UnavailableError
         from horaedb_tpu.objstore import NotFound
 
         seg_key = Timestamp(seg_ssts[0].meta.time_range.start).truncate_by(
@@ -664,6 +738,9 @@ class ObjectBasedStorage(ColumnarStorage):
         for _attempt in range(3):
             try:
                 return await op(seg_ssts)
+            except UnavailableError:
+                scanstats.note("ssts_unavailable", len(seg_ssts))
+                raise
             except NotFound:
                 fresh = [
                     s for s in self._manifest.find_ssts(time_range)

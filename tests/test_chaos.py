@@ -1,0 +1,657 @@
+"""Chaos lane: the fault-tolerant object-store data plane, end to end.
+
+Three layers of assertion, bottom-up:
+
+- `ChaosStore` / `ResilientStore` unit contracts: seeded determinism,
+  classified retries, per-op deadlines (a black-holed store costs a
+  bounded timeout, not a hang), circuit-breaker state machine, and the
+  `horaedb_objstore_*` metric families.
+- Flush-pipeline classification (the PR's flush satellite): a
+  `persistent` write-out error surfaces at the flush barrier on FIRST
+  replay instead of parking forever; retryable failures keep PR 5's
+  park-and-replay semantics.
+- The engine soak: write -> flush -> compact -> query loops over a
+  seeded fault plan (injected errors, torn writes, lost acks, listing
+  lag), a crash (engine abandoned without close) and reopen — asserting
+  EXACT query results against a host model, zero acknowledged-row loss,
+  and orphan-SST GC at recovery.
+
+Everything is deterministic: fault plans are seeded, breaker clocks are
+injected, and the blackhole store gates on asyncio events.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from horaedb_tpu.common.error import (
+    FatalError,
+    PersistentError,
+    RetryableError,
+    UnavailableError,
+    classify,
+)
+from horaedb_tpu.common.time_ext import ReadableDuration
+from horaedb_tpu.engine import MetricEngine, QueryRequest
+from horaedb_tpu.ingest import PooledParser
+from horaedb_tpu.objstore import MemStore, NotFound, PreconditionFailed
+from horaedb_tpu.objstore.chaos import (
+    ChaosStore,
+    FaultPlan,
+    InjectedCrash,
+    InjectedFault,
+    OpFaults,
+)
+from horaedb_tpu.objstore.resilient import (
+    BreakerPolicy,
+    CircuitBreaker,
+    ResilientStore,
+    RetryPolicy,
+)
+from tests.conftest import async_test
+from tests.test_flush_pipeline import make_remote_write
+
+HOUR = 3_600_000
+
+ms = ReadableDuration.millis
+secs = ReadableDuration.secs
+
+
+def fast_retry(attempts: int = 8, deadline_s: float = 5.0) -> RetryPolicy:
+    return RetryPolicy(
+        max_attempts=attempts, backoff_base=ms(1), backoff_cap=ms(3),
+        op_deadline=ms(int(deadline_s * 1000)),
+    )
+
+
+class TestTaxonomy:
+    def test_classify_covers_the_three_classes(self):
+        assert classify(RetryableError("x")) == "retryable"
+        assert classify(UnavailableError("x")) == "retryable"
+        assert classify(PersistentError("x")) == "persistent"
+        assert classify(FatalError("x")) == "fatal"
+        # stdlib transients and unknowns are retryable (bounded optimism)
+        assert classify(TimeoutError()) == "retryable"
+        assert classify(ConnectionResetError()) == "retryable"
+        assert classify(ValueError("?")) == "retryable"
+
+    def test_context_preserves_taxonomy_class(self):
+        """A context() frame must not demote a typed failure to the base
+        class — the 503 shedding path routes on isinstance. (Found by
+        the chaos gate: write_sst's context wrapper was flattening
+        UnavailableError into HoraeError, turning 503s into 500s.)"""
+        from horaedb_tpu.common.error import HoraeError, context
+
+        with pytest.raises(UnavailableError) as ei:
+            with context("write sst x"):
+                raise UnavailableError("breaker open", retry_after_s=4.0)
+        assert ei.value.retry_after_s == 4.0
+        assert "write sst x" in str(ei.value)
+        with pytest.raises(PersistentError):
+            with context("frame"):
+                raise PersistentError("403")
+        # plain errors still funnel to the base
+        with pytest.raises(HoraeError) as ei:
+            with context("frame"):
+                raise ValueError("x")
+        assert type(ei.value) is HoraeError
+
+    def test_fenced_error_is_fatal(self):
+        from horaedb_tpu.storage.fence import FencedError
+
+        assert classify(FencedError("deposed")) == "fatal"
+
+    def test_s3_error_split(self):
+        from horaedb_tpu.objstore.s3 import S3Error, S3RetriesExhausted
+
+        assert classify(S3Error("403")) == "persistent"
+        # retries-exhausted is still an S3Error but classified retryable
+        e = S3RetriesExhausted("retries exhausted")
+        assert isinstance(e, S3Error)
+        assert classify(e) == "retryable"
+
+
+class TestChaosStore:
+    @async_test
+    async def test_seeded_plans_are_deterministic(self):
+        async def run(seed):
+            chaos = ChaosStore(MemStore(), FaultPlan(
+                seed=seed, ops={"put": OpFaults(error_rate=0.5)}
+            ))
+            outcomes = []
+            for i in range(40):
+                try:
+                    await chaos.put(f"k/{i}", b"v")
+                    outcomes.append("ok")
+                except InjectedFault:
+                    outcomes.append("err")
+            return outcomes
+
+        a, b, c = await run(7), await run(7), await run(8)
+        assert a == b           # same seed, same schedule
+        assert a != c           # different seed, different schedule
+        assert "err" in a and "ok" in a
+
+    @async_test
+    async def test_torn_write_lands_prefix_and_raises(self):
+        inner = MemStore()
+        chaos = ChaosStore(inner, FaultPlan(
+            seed=1, ops={"put": OpFaults(torn_write_rate=1.0)}
+        ))
+        with pytest.raises(InjectedFault, match="torn write"):
+            await chaos.put("db/data/7.sst", b"x" * 100)
+        torn = inner._objects["db/data/7.sst"]
+        assert len(torn) < 100  # a strict prefix landed
+        # control-plane paths are never torn (atomic in real backends)
+        await chaos.put("db/manifest/delta/9", b"d" * 50)
+        assert inner._objects["db/manifest/delta/9"] == b"d" * 50
+
+    @async_test
+    async def test_listing_lag_hides_from_list_not_get(self):
+        chaos = ChaosStore(MemStore(), FaultPlan(seed=1, visibility_lag_ops=5))
+        await chaos.put("a/k", b"v")
+        assert await chaos.get("a/k") == b"v"  # read-after-write is strong
+        assert [m.path for m in await chaos.list("a")] == []
+        chaos.settle()
+        assert [m.path for m in await chaos.list("a")] == ["a/k"]
+
+    @async_test
+    async def test_crash_point_raises_base_exception(self):
+        chaos = ChaosStore(MemStore())
+        chaos.crash_next("put", "manifest/delta")
+        await chaos.put("db/data/1.sst", b"v")  # non-matching path: runs
+        with pytest.raises(InjectedCrash):
+            await chaos.put("db/manifest/delta/2", b"d")
+        assert not isinstance(InjectedCrash("x"), Exception)
+        # the crash point is one-shot
+        await chaos.put("db/manifest/delta/3", b"d")
+
+    @async_test
+    async def test_lost_ack_applies_the_write(self):
+        inner = MemStore()
+        chaos = ChaosStore(inner, FaultPlan(
+            seed=3, ops={"put": OpFaults(lost_ack_rate=1.0)}
+        ))
+        with pytest.raises(InjectedFault, match="lost ack"):
+            await chaos.put("k", b"v")
+        assert inner._objects["k"] == b"v"  # took effect despite the error
+
+
+class TestResilientStore:
+    @async_test
+    async def test_transient_faults_absorbed_with_metrics(self):
+        from horaedb_tpu.objstore.resilient import (
+            OBJSTORE_ATTEMPTS,
+            OBJSTORE_RETRIES,
+        )
+
+        chaos = ChaosStore(MemStore(), FaultPlan(
+            seed=11, ops={"put": OpFaults(error_rate=0.5)}
+        ))
+        rs = ResilientStore(chaos, retry=fast_retry(), name="t1")
+        retries0 = OBJSTORE_RETRIES.labels("put").value
+        ok0 = OBJSTORE_ATTEMPTS.labels("put", "ok").value
+        for i in range(30):
+            await rs.put(f"k/{i}", b"v")
+        assert len(await rs.list("k")) == 30
+        assert chaos.injected_errors > 0
+        assert OBJSTORE_RETRIES.labels("put").value - retries0 >= chaos.injected_errors
+        assert OBJSTORE_ATTEMPTS.labels("put", "ok").value - ok0 == 30
+
+    @async_test
+    async def test_persistent_error_surfaces_without_retry(self):
+        calls = {"n": 0}
+
+        class Rejecting(MemStore):
+            async def put(self, path, data):
+                calls["n"] += 1
+                raise PersistentError("400 malformed")
+
+        rs = ResilientStore(Rejecting(), retry=fast_retry(), name="t2")
+        with pytest.raises(PersistentError):
+            await rs.put("k", b"v")
+        assert calls["n"] == 1  # no retry burned on a deterministic failure
+
+    @async_test
+    async def test_fatal_error_surfaces_without_retry(self):
+        from horaedb_tpu.storage.fence import FencedError
+
+        class Deposed(MemStore):
+            async def put(self, path, data):
+                raise FencedError("epoch superseded")
+
+        rs = ResilientStore(Deposed(), retry=fast_retry(), name="t3")
+        with pytest.raises(FencedError):
+            await rs.put("k", b"v")
+
+    @async_test
+    async def test_semantic_results_pass_through(self):
+        rs = ResilientStore(MemStore(), retry=fast_retry(), name="t4")
+        with pytest.raises(NotFound):
+            await rs.get("missing")
+        await rs.put_if_absent("k", b"1")
+        with pytest.raises(PreconditionFailed):
+            await rs.put_if_absent("k", b"2")
+        assert rs.breaker.state == CircuitBreaker.CLOSED  # not failures
+
+    @async_test
+    async def test_blackholed_store_fails_in_bounded_time(self):
+        """The acceptance bar: a hung backend costs attempts x deadline,
+        not a hung flush worker. Deadline 50 ms x 2 attempts must raise
+        UnavailableError well within a couple of seconds."""
+
+        class Blackhole(MemStore):
+            async def put(self, path, data):
+                await asyncio.Event().wait()  # never returns
+
+        rs = ResilientStore(
+            Blackhole(), retry=fast_retry(attempts=2, deadline_s=0.05),
+            name="t5",
+        )
+        t0 = time.perf_counter()
+        with pytest.raises(UnavailableError, match="gave up"):
+            await rs.put("k", b"v")
+        assert time.perf_counter() - t0 < 2.0
+
+    @async_test
+    async def test_breaker_opens_half_opens_and_closes(self):
+        from horaedb_tpu.objstore.resilient import OBJSTORE_BREAKER_STATE
+
+        clock = {"t": 0.0}
+        healthy = {"on": False}
+
+        class Flappy(MemStore):
+            async def put(self, path, data):
+                if not healthy["on"]:
+                    raise RetryableError("down")
+                await super().put(path, data)
+
+        rs = ResilientStore(
+            Flappy(),
+            retry=fast_retry(attempts=2),
+            breaker=BreakerPolicy(failure_threshold=3, open_for=secs(10)),
+            name="t6",
+            clock=lambda: clock["t"],
+        )
+        # three full gave-ups open the breaker
+        for _ in range(3):
+            with pytest.raises(UnavailableError):
+                await rs.put("k", b"v")
+        assert rs.breaker.state == CircuitBreaker.OPEN
+        assert OBJSTORE_BREAKER_STATE.labels("t6").value == 2
+        # while open: fast fail, no inner attempts, Retry-After hint
+        with pytest.raises(UnavailableError, match="breaker open") as ei:
+            await rs.put("k", b"v")
+        assert ei.value.retry_after_s == pytest.approx(10.0)
+        # clock past open_for: half-open admits one probe; success closes
+        clock["t"] = 11.0
+        assert rs.breaker.state == CircuitBreaker.HALF_OPEN
+        healthy["on"] = True
+        await rs.put("k", b"v")
+        assert rs.breaker.state == CircuitBreaker.CLOSED
+        assert OBJSTORE_BREAKER_STATE.labels("t6").value == 0
+
+    @async_test
+    async def test_persistent_error_during_half_open_does_not_brick_breaker(self):
+        """Review regression: a half-open probe whose op ends in a
+        DETERMINISTIC rejection (4xx) must not leak the probe slot and
+        lock the breaker open forever. The backend responded, so
+        availability-wise the probe succeeded: the breaker closes and
+        later healthy ops proceed."""
+        clock = {"t": 0.0}
+        mode = {"m": "down"}
+
+        class Tricky(MemStore):
+            async def put(self, path, data):
+                if mode["m"] == "down":
+                    raise RetryableError("down")
+                if mode["m"] == "reject":
+                    raise PersistentError("403 on this key")
+                await super().put(path, data)
+
+        rs = ResilientStore(
+            Tricky(), retry=fast_retry(attempts=1),
+            breaker=BreakerPolicy(failure_threshold=1, open_for=secs(10)),
+            name="t8", clock=lambda: clock["t"],
+        )
+        with pytest.raises(UnavailableError):
+            await rs.put("k", b"v")
+        assert rs.breaker.state == CircuitBreaker.OPEN
+        clock["t"] = 11.0
+        mode["m"] = "reject"  # the probe hits a deterministic 4xx
+        with pytest.raises(PersistentError):
+            await rs.put("k", b"v")
+        # NOT bricked: the backend answered, the breaker is closed again
+        assert rs.breaker.state == CircuitBreaker.CLOSED
+        mode["m"] = "up"
+        await rs.put("k", b"v")  # healthy ops proceed immediately
+
+    @async_test
+    async def test_cancelled_probe_frees_the_half_open_slot(self):
+        """Review regression: cancelling an admitted op mid-flight (client
+        disconnect) must release the half-open probe slot so the NEXT
+        caller can probe — not lock the breaker open."""
+        clock = {"t": 0.0}
+        gate = asyncio.Event()
+        healthy = {"on": False}
+
+        class Hanging(MemStore):
+            async def put(self, path, data):
+                if not healthy["on"]:
+                    if clock["t"] > 10.0:
+                        await gate.wait()  # the probe hangs until cancelled
+                    raise RetryableError("down")
+                await super().put(path, data)
+
+        rs = ResilientStore(
+            Hanging(), retry=fast_retry(attempts=1, deadline_s=30.0),
+            breaker=BreakerPolicy(failure_threshold=1, open_for=secs(10)),
+            name="t9", clock=lambda: clock["t"],
+        )
+        with pytest.raises(UnavailableError):
+            await rs.put("k", b"v")
+        assert rs.breaker.state == CircuitBreaker.OPEN
+        clock["t"] = 11.0
+        probe = asyncio.ensure_future(rs.put("k", b"v"))
+        await asyncio.sleep(0.02)  # probe admitted and hanging on the gate
+        probe.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await probe
+        # the slot freed: a new probe is admitted and (store healed) closes
+        healthy["on"] = True
+        await rs.put("k", b"v")
+        assert rs.breaker.state == CircuitBreaker.CLOSED
+
+    @async_test
+    async def test_failed_half_open_probe_reopens(self):
+        clock = {"t": 0.0}
+
+        class Down(MemStore):
+            async def put(self, path, data):
+                raise RetryableError("down")
+
+        rs = ResilientStore(
+            Down(), retry=fast_retry(attempts=1),
+            breaker=BreakerPolicy(failure_threshold=1, open_for=secs(10)),
+            name="t7", clock=lambda: clock["t"],
+        )
+        with pytest.raises(UnavailableError):
+            await rs.put("k", b"v")
+        assert rs.breaker.state == CircuitBreaker.OPEN
+        clock["t"] = 11.0  # half-open: the probe runs and fails
+        with pytest.raises(UnavailableError):
+            await rs.put("k", b"v")
+        assert rs.breaker.state == CircuitBreaker.OPEN  # re-armed
+
+    def test_unavailable_response_shape(self):
+        """The shedding contract: 503 + Retry-After (server/errors.py)."""
+        from horaedb_tpu.server.errors import unavailable_response
+
+        r = unavailable_response(UnavailableError("down", retry_after_s=7.2))
+        assert r.status == 503
+        assert r.headers["Retry-After"] == "8"
+        r = unavailable_response(UnavailableError("down"))
+        assert int(r.headers["Retry-After"]) >= 1
+
+
+def payload_for(series: dict[str, list[tuple[int, float]]]) -> bytes:
+    return make_remote_write([
+        ({"__name__": "chaos", "host": host}, samples)
+        for host, samples in sorted(series.items())
+    ])
+
+
+async def open_chaos_engine(store, **kw):
+    kw.setdefault("segment_duration_ms", HOUR)
+    kw.setdefault("enable_compaction", True)
+    kw.setdefault("ingest_buffer_rows", 32)
+    return await MetricEngine.open("db", store, **kw)
+
+
+async def write_acked(eng, model: dict, series: dict, retries: int = 30):
+    """Send one payload with sender-style retries; fold into the host
+    model only once ACKED (write_parsed returned). Duplicate delivery of
+    an earlier possibly-half-applied attempt is the point: storage dedup
+    by pk+seq must make it exact anyway."""
+    payload = payload_for(series)
+    last = None
+    for _ in range(retries):
+        try:
+            await eng.write_parsed(PooledParser.decode(payload))
+        except (InjectedFault, UnavailableError) as e:
+            last = e
+            continue
+        for host, samples in series.items():
+            for ts, v in samples:
+                model[(host, ts)] = v
+        return
+    raise AssertionError(f"payload never acked after {retries} tries: {last}")
+
+
+async def flush_retrying(eng, retries: int = 30) -> None:
+    last = None
+    for _ in range(retries):
+        try:
+            await eng.flush()
+            return
+        except (InjectedFault, UnavailableError) as e:
+            last = e
+    raise AssertionError(f"flush barrier never succeeded: {last}")
+
+
+async def crash(eng) -> None:
+    """Simulate the process dying: cancel the engine's background tasks
+    (a dead process runs nothing) WITHOUT the graceful close path — no
+    flush barrier, no index-sidecar dump, no manifest fold. Buffered
+    rows, parked memtables, and uncommitted uploads are simply gone;
+    whatever the store holds is what recovery gets. Without this, the
+    abandoned engine's mergers would keep mutating the shared store
+    while the 'new process' runs — a zombie no real crash leaves."""
+    for t in (eng.metrics_table, eng.series_table, eng.index_table,
+              eng.tags_table, eng.data_table, eng.exemplars_table):
+        if t.compaction_scheduler is not None:
+            await t.compaction_scheduler.close()
+        await t.manifest.close()  # cancels the background merger only
+
+
+async def query_model(eng) -> dict:
+    """(host, ts) -> value as the engine answers it, via the raw path."""
+    t = await eng.query(QueryRequest(metric=b"chaos", start_ms=0,
+                                     end_ms=10 * HOUR))
+    if t is None:
+        return {}
+    labels = await eng.match_series(b"chaos", [], [])
+    host_of = {
+        tsid: labs[b"host"].decode() for tsid, labs in labels.items()
+    }
+    out = {}
+    for tsid, ts, v in zip(t.column("tsid").to_pylist(),
+                           t.column("ts").to_pylist(),
+                           t.column("value").to_pylist()):
+        out[(host_of[int(tsid)], ts)] = v
+    return out
+
+
+SOAK_PLAN = FaultPlan(
+    seed=20260803,
+    ops={
+        "put": OpFaults(error_rate=0.12, torn_write_rate=0.08,
+                        lost_ack_rate=0.04),
+        "get": OpFaults(error_rate=0.08),
+        "list": OpFaults(error_rate=0.08),
+        "delete": OpFaults(error_rate=0.10),
+        "head": OpFaults(error_rate=0.05),
+    },
+    visibility_lag_ops=7,
+)
+
+
+class TestEngineChaosSoak:
+    @async_test
+    async def test_soak_exact_results_zero_acked_loss_orphan_gc(self):
+        """The chaos soak: 24 rounds of write -> (flush) -> (compact) ->
+        query under SOAK_PLAN, a mid-soak crash (abandon without close)
+        and reopen. Invariants: query results EXACTLY match the host
+        model at every checkpoint, zero acknowledged rows are lost
+        across the crash (everything acked was flushed first), and the
+        torn/uncommitted objects the faults left behind are GC'd at
+        reopen."""
+        inner = MemStore()
+        chaos = ChaosStore(inner, SOAK_PLAN)
+        store = ResilientStore(
+            chaos, retry=fast_retry(attempts=10),
+            breaker=BreakerPolicy(failure_threshold=5, open_for=ms(50)),
+            name="soak",
+        )
+        eng = await open_chaos_engine(store)
+        model: dict = {}
+        base = 1000
+        for rnd in range(12):
+            series = {
+                f"h{rnd % 3}": [(base + rnd * 1000 + i, float(rnd * 10 + i))
+                                for i in range(4)],
+                f"g{rnd % 2}": [(base + rnd * 1000 + i, float(rnd))
+                                for i in range(3)],
+            }
+            await write_acked(eng, model, series)
+            if rnd % 4 == 3:
+                await flush_retrying(eng)
+                try:
+                    await eng.compact()
+                    sched = eng.data_table.compaction_scheduler
+                    await sched.executor.drain()
+                except Exception:  # noqa: BLE001 — compaction faults are
+                    pass           # re-picked later; never lose the soak
+            got = await query_model(eng)
+            assert got == model, f"round {rnd}: engine diverged from model"
+
+        # ---- crash: everything acked so far is made durable by a flush
+        # barrier, then the process "dies" (no close; in-flight state and
+        # any torn/uncommitted uploads stay behind in the store)
+        await flush_retrying(eng)
+        pre_crash_model = dict(model)
+        await crash(eng)  # abandoned, never gracefully closed
+        del eng
+
+        # ---- reopen over the SURVIVING store state (faults still on)
+        chaos.settle()  # listing lag expires while the process restarts
+        eng2 = await open_chaos_engine(store)
+
+        # zero acknowledged-row loss: every pre-crash acked row is there
+        got = await query_model(eng2)
+        assert got == pre_crash_model
+
+        # orphan GC: no unreferenced .sst objects survive recovery in the
+        # data table's namespace (torn writes + crash leftovers)
+        live = {s.id for s in eng2.data_table.manifest.all_ssts()}
+        leftover = [
+            p for p in inner._objects
+            if p.startswith("db/data/data/") and p.endswith(".sst")
+            and int(p.rsplit("/", 1)[-1][:-4]) not in live
+        ]
+        assert leftover == [], f"orphan ssts not GC'd: {leftover}"
+
+        # the engine keeps working after recovery: more acked writes land
+        for rnd in range(12, 24):
+            series = {
+                f"h{rnd % 3}": [(base + rnd * 1000 + i, float(rnd * 10 + i))
+                                for i in range(4)],
+            }
+            await write_acked(eng2, model, series)
+        await flush_retrying(eng2)
+        got = await query_model(eng2)
+        assert got == model
+        assert chaos.injected_errors > 0  # the plan actually fired
+        await eng2.close()
+
+
+class TestOrphanGcCounter:
+    @async_test
+    async def test_counter_counts_only_reclaimed_orphans(self):
+        """Review regression: an orphan whose delete FAILS at open stays
+        behind for the next open — it must not count as reclaimed now
+        (and then again later)."""
+        import pyarrow as pa
+
+        from horaedb_tpu.storage.storage import (
+            ORPHAN_SSTS_GC,
+            ObjectBasedStorage,
+        )
+
+        inner = MemStore()
+        chaos = ChaosStore(inner)
+        await inner.put("gcroot/data/123.sst", b"orphan-bytes")
+        schema = pa.schema([("pk", pa.int64()), ("v", pa.float64())])
+
+        async def open_storage():
+            return await ObjectBasedStorage.try_new(
+                "gcroot", chaos, schema, num_primary_keys=1,
+                segment_duration_ms=HOUR,
+                enable_compaction_scheduler=False,
+                start_background_merger=False,
+            )
+
+        gc0 = ORPHAN_SSTS_GC.labels("gcroot").value
+        chaos.fail_next("delete", 1)  # the orphan's delete at this open fails
+        eng = await open_storage()
+        await eng.close()
+        assert ORPHAN_SSTS_GC.labels("gcroot").value == gc0  # not reclaimed
+        assert "gcroot/data/123.sst" in inner._objects
+        eng = await open_storage()  # deletes succeed now
+        await eng.close()
+        assert ORPHAN_SSTS_GC.labels("gcroot").value == gc0 + 1
+        assert "gcroot/data/123.sst" not in inner._objects
+
+
+class TestCrashBetweenUploadAndCommit:
+    @async_test
+    async def test_orphan_sst_gc_on_reopen(self):
+        """The narrow crash-recovery case the tentpole names: the process
+        dies AFTER an SST upload but BEFORE its manifest commit. Reopen
+        must (a) replay the manifest to the pre-crash consistent
+        snapshot, (b) detect + GC the orphan object, (c) never surface
+        the uncommitted rows."""
+        from horaedb_tpu.storage.storage import ORPHAN_SSTS_GC
+
+        inner = MemStore()
+        chaos = ChaosStore(inner)
+        store = ResilientStore(chaos, retry=fast_retry(), name="crash1")
+        eng = await open_chaos_engine(store, enable_compaction=False,
+                                      ingest_buffer_rows=0)
+        model: dict = {}
+        await write_acked(eng, model, {"a": [(1000, 1.0), (2000, 2.0)]})
+        await flush_retrying(eng)
+
+        # arm the crash: the NEXT manifest delta write for the data table
+        # dies — the SST upload before it has already landed
+        chaos.crash_next("put", "db/data/manifest/delta/")
+        with pytest.raises(InjectedCrash):
+            await eng.write_parsed(PooledParser.decode(
+                payload_for({"a": [(3000, 3.0)]})
+            ))
+        await crash(eng)
+        del eng
+
+        orphans = [
+            p for p in inner._objects
+            if p.startswith("db/data/data/") and p.endswith(".sst")
+        ]
+        gc0 = ORPHAN_SSTS_GC.labels("db/data").value
+        eng2 = await open_chaos_engine(store, enable_compaction=False,
+                                       ingest_buffer_rows=0)
+        # consistent snapshot: exactly the acked (committed) rows
+        assert await query_model(eng2) == model
+        # the uploaded-but-uncommitted object was detected and reclaimed
+        live = {s.id for s in eng2.data_table.manifest.all_ssts()}
+        committed = {
+            f"db/data/data/{i}.sst" for i in live
+        }
+        remaining = {
+            p for p in inner._objects
+            if p.startswith("db/data/data/") and p.endswith(".sst")
+        }
+        assert remaining == committed
+        assert len(orphans) > len(committed)
+        assert ORPHAN_SSTS_GC.labels("db/data").value > gc0
+        await eng2.close()

@@ -184,6 +184,63 @@ class TestSplitBrain:
         await c.close()
 
     @async_test
+    async def test_crashed_writer_fence_reacquire_and_orphan_gc(self):
+        """Crash recovery across the fence (the chaos-lane acceptance
+        case): writer A dies between an SST upload and its manifest
+        commit. The next open must acquire the NEXT epoch cleanly (the
+        dead writer's claim needs no unfencing), recover the manifest to
+        the last committed snapshot, and GC the orphan SST the crash
+        left behind — and if A's process were somehow still alive, its
+        writes stay fenced out."""
+        from horaedb_tpu.objstore.chaos import ChaosStore, InjectedCrash
+
+        inner = MemStore()
+        store = ChaosStore(inner)
+        schema = make_schema()
+        a = await open_engine(store, "node-a")
+        await a.write(WriteRequest(
+            make_batch(schema, [1, 2], [10, 20], [1.0, 2.0]), TimeRange(10, 21)
+        ))
+        # the crash: next manifest delta write dies AFTER the SST landed
+        store.crash_next("put", "db/manifest/delta/")
+        with pytest.raises(InjectedCrash):
+            await a.write(WriteRequest(
+                make_batch(schema, [3], [30], [3.0]), TimeRange(30, 31)
+            ))
+        ssts_before = {
+            p for p in inner._objects
+            if p.startswith("db/data/") and p.endswith(".sst")
+        }
+        assert len(ssts_before) == 2  # committed + orphan
+
+        # replacement writer: next epoch, no cleanup step needed
+        b = await open_engine(store, "node-b")
+        assert b._fence.epoch == a._fence.epoch + 1
+        # manifest recovered to the committed snapshot; orphan GC'd
+        t = await collect(b)
+        rows = dict(zip(t.column("pk").to_pylist(), t.column("v").to_pylist()))
+        assert rows == {1: 1.0, 2: 2.0}
+        live = {s.id for s in b.manifest.all_ssts()}
+        remaining = {
+            p for p in inner._objects
+            if p.startswith("db/data/") and p.endswith(".sst")
+        }
+        assert remaining == {f"db/data/{i}.sst" for i in live}
+        assert len(remaining) == 1
+        # the zombie A (if its process survived) is fenced out
+        with pytest.raises(FencedError):
+            await a.write(WriteRequest(
+                make_batch(schema, [4], [40], [4.0]), TimeRange(40, 41)
+            ))
+        # B keeps full write rights after the recovery
+        await b.write(WriteRequest(
+            make_batch(schema, [5], [50], [5.0]), TimeRange(50, 51)
+        ))
+        assert (await collect(b)).num_rows == 3
+        await a.close()
+        await b.close()
+
+    @async_test
     async def test_fenceless_open_still_works(self):
         """fence_node_id=None keeps the zero-enforcement legacy behavior."""
         store = MemStore()

@@ -209,6 +209,51 @@ class TestFlushFailureDurability:
         await eng2.close()
 
     @async_test
+    async def test_classified_persistent_error_surfaces_on_first_replay(self):
+        """Error-taxonomy routing (common/error.py): a write-out failing
+        with a PERSISTENT error surfaces at the flush barrier on its
+        FIRST replay (the barrier's single inline attempt raises instead
+        of silently re-parking into an endless background retry loop),
+        and background triggers skip it entirely — a deterministic
+        failure must not burn a store attempt on every trigger. Rows
+        stay parked (zero loss) until the cause is fixed, after which
+        the next barrier drains them."""
+        from horaedb_tpu.common.error import PersistentError
+
+        store = MemStore()
+        eng = await open_engine(store, ingest_buffer_rows=1000)
+        mgr = eng.sample_mgr
+        await eng.write_parsed(
+            PooledParser.decode(payload_of("a", 1000, 4, 0.0))
+        )
+        calls = {"n": 0}
+
+        async def rejecting(*a, **kw):
+            calls["n"] += 1
+            raise PersistentError("injected deterministic store rejection")
+
+        orig = mgr._write_segment
+        mgr._write_segment = rejecting
+        with pytest.raises(PersistentError):
+            await mgr.flush()
+        # one background attempt + the barrier's first replay — surfaced
+        assert calls["n"] == 2
+        assert mgr.buffered_rows == 4  # parked, never dropped
+        # background triggers must not burn attempts on it
+        await mgr.seal_and_submit()
+        await asyncio.sleep(0.05)
+        assert calls["n"] == 2
+        assert mgr.buffered_rows == 4
+        # cause fixed: the next barrier gets one fresh attempt and drains
+        mgr._write_segment = orig
+        await mgr.flush()
+        assert mgr.buffered_rows == 0
+        t = await eng.query(QueryRequest(metric=b"pipe", start_ms=0,
+                                         end_ms=HOUR))
+        assert t.num_rows == 4
+        await eng.close()
+
+    @async_test
     async def test_persistent_failure_raises_at_barrier_after_retry(self):
         """A broken store: the barrier retries the parked memtable inline
         exactly once and then surfaces the error — rows still parked."""

@@ -450,3 +450,75 @@ class TestJ008AppendHotPath:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ009StoreBoundary:
+    """J009: concrete ObjectStore constructors outside objstore/ must be
+    immediate arguments of a ResilientStore(...) — the resilience
+    boundary (retry/backoff, deadlines, breaker, horaedb_objstore_*)
+    is decided at the construction site."""
+
+    def seeded(self, tmp_path, body, pkg="engine", name="seeded.py"):
+        d = tmp_path / "horaedb_tpu" / pkg
+        d.mkdir(parents=True, exist_ok=True)
+        f = d / name
+        f.write_text(body)
+        return f
+
+    def test_naked_store_construction_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.objstore import LocalStore, MemStore\n"
+            "from horaedb_tpu.objstore.s3 import S3LikeStore\n"
+            "\n"
+            "def build(cfg):\n"
+            "    a = LocalStore(cfg.data_dir)\n"          # J009
+            "    b = MemStore()\n"                        # J009
+            "    return S3LikeStore(cfg)\n"               # J009
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 3, r.stdout
+        assert r.stdout.count("J009") == 3, r.stdout
+        assert "ResilientStore" in r.stdout
+
+    def test_wrapped_construction_passes(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.objstore import LocalStore\n"
+            "from horaedb_tpu.objstore.chaos import ChaosStore\n"
+            "from horaedb_tpu.objstore.resilient import ResilientStore\n"
+            "from horaedb_tpu.objstore.s3 import S3LikeStore\n"
+            "\n"
+            "def build(cfg, retry):\n"
+            "    a = ResilientStore(LocalStore(cfg.data_dir), retry=retry)\n"
+            "    b = ResilientStore(S3LikeStore(cfg), name='s3')\n"
+            "    c = ChaosStore(LocalStore(cfg.data_dir))\n"  # harness wrap
+            "    return a, b, c\n"
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_objstore_modules_exempt(self, tmp_path):
+        """objstore/ builds the stores — it IS the boundary."""
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.objstore import MemStore\n"
+            "\n"
+            "def fixture():\n"
+            "    return MemStore()\n",
+            pkg="objstore",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.objstore import MemStore\n"
+            "\n"
+            "def scratch():\n"
+            "    # jaxlint: disable=J009 throwaway in-memory scratch space\n"
+            "    return MemStore()\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

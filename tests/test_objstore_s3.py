@@ -208,6 +208,70 @@ class TestS3Contract:
             await fake.stop()
 
 
+class TestTimeouts:
+    @async_test
+    async def test_blackholed_endpoint_fails_fast_not_forever(self):
+        """A server that accepts the connection and then never answers —
+        the black-hole failure mode. The explicit `read_timeout`
+        (sock_read) must fail the op in well under the 30 s total that
+        used to be the only bound (pre-satellite this test would sit out
+        total x attempts)."""
+        import asyncio
+        import time
+
+        from horaedb_tpu.common.time_ext import ReadableDuration
+
+        async def swallow(reader, writer):
+            await asyncio.sleep(3600)  # never respond
+
+        server = await asyncio.start_server(swallow, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        cfg = S3LikeConfig(
+            endpoint=f"http://127.0.0.1:{port}", bucket="b", **CREDS,
+            max_retries=2,
+        )
+        cfg.timeout.io_timeout = ReadableDuration.secs(30)
+        cfg.timeout.read_timeout = ReadableDuration.millis(200)
+        store = S3LikeStore(cfg)
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(S3Error, match="retries exhausted"):
+                await store.put("k", b"v")
+            assert time.perf_counter() - t0 < 10.0
+        finally:
+            await store.close()
+            server.close()
+            await server.wait_closed()
+
+    def test_connect_read_timeouts_config_surfaced(self):
+        from horaedb_tpu.server.config import Config
+
+        cfg = Config.from_toml(
+            """
+            [metric_engine.storage.object_store]
+            type = "S3Like"
+            endpoint = "http://127.0.0.1:9000"
+            bucket = "b"
+            [metric_engine.storage.object_store.timeout]
+            connect_timeout = "3s"
+            read_timeout = "7s"
+            """
+        )
+        s3 = cfg.metric_engine.storage.object_store.to_s3_config()
+        assert s3.timeout.connect_timeout.seconds == 3.0
+        assert s3.timeout.read_timeout.seconds == 7.0
+
+    def test_retries_exhausted_is_retryable_class(self):
+        """The taxonomy contract the flush executor and ResilientStore
+        route on: exhausted transient retries stay retryable; 4xx stays
+        persistent."""
+        from horaedb_tpu.common.error import classify
+        from horaedb_tpu.objstore.s3 import S3RetriesExhausted
+
+        assert classify(S3RetriesExhausted("retries exhausted")) == "retryable"
+        assert classify(S3Error("HTTP 403")) == "persistent"
+
+
 class TestEngineOnS3:
     @async_test
     async def test_write_scan_compact_recover_on_s3(self):

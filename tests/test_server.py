@@ -581,7 +581,7 @@ class TestExplain:
                 assert plan["mode"] == mode
                 assert EXPLAIN_LANES <= set(plan["lanes_s"])
                 assert set(plan["ssts"]) == {"selected", "read",
-                                             "bloom_pruned"}
+                                             "bloom_pruned", "unavailable"}
                 assert isinstance(plan["compile_s"], (int, float))
                 assert isinstance(plan["steady_s"], (int, float))
                 assert plan["regions"] >= 1

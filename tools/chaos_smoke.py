@@ -1,0 +1,277 @@
+"""Chaos smoke gate (`make chaos-smoke`, folded into `make lint`).
+
+Two phases, both at smoke scale (seconds, CPU-only):
+
+1. **Server on a ChaosStore.** Boots the REAL server (build_app) over a
+   seeded ChaosStore (injected errors, torn writes, listing lag) wrapped
+   in the ResilientStore the production boot path uses. Pushes
+   remote-write batches with sender-style retries, queries them back,
+   and asserts the engine's answers match the host model EXACTLY under
+   live faults. Then trips the circuit breaker and asserts the shedding
+   contract: writes answer **503 + Retry-After** (never a hang, never a
+   silent drop), and recover to 200 after reset. Finally checks the
+   `horaedb_objstore_*` families render on /metrics with retries
+   actually counted.
+
+2. **Crash recovery.** An epoch-fenced engine crashes (InjectedCrash)
+   between an SST upload and its manifest commit. Reopen must acquire
+   the next epoch with no unfencing step, recover to the committed
+   snapshot (zero acknowledged-row loss), and GC the orphan SST.
+
+This is the end-to-end half the unit chaos lane (tests/test_chaos.py)
+can't give: the HTTP status mapping, the boot-path store wrapping, and
+the metric rendering only exist in one live process.
+
+Run: JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SMOKE_SEED = 7
+
+
+def make_payload(metric: str, rows: list[tuple[str, int, float]]) -> bytes:
+    from horaedb_tpu.pb import remote_write_pb2
+
+    by_host: dict[str, list[tuple[int, float]]] = {}
+    for host, ts, v in rows:
+        by_host.setdefault(host, []).append((ts, v))
+    req = remote_write_pb2.WriteRequest()
+    for host in sorted(by_host):
+        series = req.timeseries.add()
+        for k, v in ((b"__name__", metric.encode()), (b"host", host.encode())):
+            lab = series.labels.add()
+            lab.name = k
+            lab.value = v
+        for t, val in by_host[host]:
+            s = series.samples.add()
+            s.timestamp = t
+            s.value = val
+    return req.SerializeToString()
+
+
+async def server_phase(check) -> None:
+    import aiohttp
+    from aiohttp import web
+
+    from horaedb_tpu.common.time_ext import ReadableDuration
+    from horaedb_tpu.objstore import MemStore
+    from horaedb_tpu.objstore.chaos import ChaosStore, FaultPlan, OpFaults
+    from horaedb_tpu.objstore.resilient import (
+        BreakerPolicy,
+        ResilientStore,
+        RetryPolicy,
+    )
+    from horaedb_tpu.server.config import Config
+    from horaedb_tpu.server.main import build_app
+
+    import tempfile
+
+    ms = ReadableDuration.millis
+    scratch = tempfile.mkdtemp(prefix="horaedb-chaos-smoke-")
+    chaos = ChaosStore(MemStore(), FaultPlan(
+        seed=SMOKE_SEED,
+        ops={
+            "put": OpFaults(error_rate=0.10, torn_write_rate=0.05,
+                            lost_ack_rate=0.03),
+            "get": OpFaults(error_rate=0.06),
+            "list": OpFaults(error_rate=0.06),
+            "delete": OpFaults(error_rate=0.08),
+        },
+        visibility_lag_ops=5,
+    ))
+    store = ResilientStore(
+        chaos,
+        retry=RetryPolicy(max_attempts=10, backoff_base=ms(1),
+                          backoff_cap=ms(5)),
+        breaker=BreakerPolicy(failure_threshold=5,
+                              open_for=ReadableDuration.secs(30)),
+        name="chaos-smoke",
+    )
+    cfg = Config.from_dict({
+        "metric_engine": {
+            "storage": {"object_store": {"data_dir": scratch}},
+            "ingest_buffer_rows": 16,
+        },
+    })
+    app = await build_app(cfg, store=store)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    model: dict[int, float] = {}
+    try:
+        async with aiohttp.ClientSession() as s:
+
+            async def send_acked(payload: bytes) -> bool:
+                """Sender semantics: retry any 5xx; honor tiny Retry-After."""
+                for _ in range(40):
+                    async with s.post(f"{base}/api/v1/write",
+                                      data=payload) as r:
+                        if r.status == 200:
+                            return True
+                        await asyncio.sleep(0.01)
+                return False
+
+            # 8 rounds of faulted writes; the model folds in only acked rows
+            for rnd in range(8):
+                rows = [
+                    (f"h{i % 3}", 1000 + rnd * 10_000 + i * 100,
+                     float(rnd * 100 + i))
+                    for i in range(6)
+                ]
+                acked = await send_acked(make_payload("chaos_smoke", rows))
+                check(acked, f"round {rnd}: write acked under faults")
+                if acked:
+                    for _h, ts, v in rows:
+                        model[ts] = v
+            async with s.post(f"{base}/api/v1/query", json={
+                "metric": "chaos_smoke", "start_ms": 0, "end_ms": 10**9,
+            }) as r:
+                body = await r.json()
+            got = dict(zip(body.get("ts", []), body.get("value", [])))
+            check(r.status == 200 and got == model,
+                  f"query matches host model exactly under faults "
+                  f"({len(model)} acked rows, "
+                  f"{chaos.injected_errors} injected faults)")
+
+            # ---- overload shedding: breaker open -> bounded 503s
+            store.breaker.force_open()
+            t0 = asyncio.get_running_loop().time()
+            async with s.post(
+                f"{base}/api/v1/write",
+                data=make_payload("chaos_shed", [("x", 1000, 1.0)]),
+            ) as r:
+                elapsed = asyncio.get_running_loop().time() - t0
+                check(r.status == 503,
+                      f"breaker-open write answers 503 (got {r.status})")
+                check(r.headers.get("Retry-After", "").isdigit(),
+                      f"503 carries Retry-After "
+                      f"({r.headers.get('Retry-After')!r})")
+                check(elapsed < 5.0,
+                      f"shed response is bounded-latency ({elapsed:.2f}s)")
+            store.breaker.reset()
+            ok = await send_acked(
+                make_payload("chaos_shed", [("x", 1000, 1.0)])
+            )
+            check(ok, "writes recover to 200 after breaker reset")
+
+            # ---- objstore resilience families render, retries counted
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            for fam in ("horaedb_objstore_attempts_total",
+                        "horaedb_objstore_retries_total",
+                        "horaedb_objstore_gave_up_total",
+                        "horaedb_objstore_breaker_state"):
+                check(fam in text, f"/metrics exposes {fam}")
+            retry_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith("horaedb_objstore_retries_total{")
+            ]
+            total_retries = sum(float(ln.rsplit(" ", 1)[1])
+                                for ln in retry_lines)
+            check(total_retries > 0,
+                  f"injected faults produced counted retries "
+                  f"({int(total_retries)})")
+    finally:
+        await runner.cleanup()
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+async def crash_phase(check) -> None:
+    from horaedb_tpu.common.time_ext import ReadableDuration
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.ingest import PooledParser
+    from horaedb_tpu.objstore import MemStore
+    from horaedb_tpu.objstore.chaos import ChaosStore, InjectedCrash
+    from horaedb_tpu.objstore.resilient import ResilientStore, RetryPolicy
+
+    HOUR = 3_600_000
+    inner = MemStore()
+    chaos = ChaosStore(inner)
+    store = ResilientStore(
+        chaos,
+        retry=RetryPolicy(max_attempts=4,
+                          backoff_base=ReadableDuration.millis(1)),
+        name="chaos-crash",
+    )
+
+    async def open_engine(node: str) -> MetricEngine:
+        return await MetricEngine.open(
+            "db", store, segment_duration_ms=HOUR, enable_compaction=False,
+            fence_node_id=node, fence_validate_interval_s=0.0,
+        )
+
+    eng = await open_engine("chaos-a")
+    await eng.write_parsed(PooledParser.decode(
+        make_payload("crash_smoke", [("a", 1000, 1.0), ("a", 2000, 2.0)])
+    ))
+    # the crash: SST upload lands, its manifest commit never does
+    chaos.crash_next("put", "db/data/manifest/delta/")
+    crashed = False
+    try:
+        await eng.write_parsed(PooledParser.decode(
+            make_payload("crash_smoke", [("a", 3000, 3.0)])
+        ))
+    except InjectedCrash:
+        crashed = True
+    check(crashed, "crash point fired between upload and commit")
+    # the dead process runs nothing: cancel its background tasks
+    for t in (eng.metrics_table, eng.series_table, eng.index_table,
+              eng.tags_table, eng.data_table, eng.exemplars_table):
+        await t.manifest.close()
+    old_epoch = eng._fence.epoch
+    del eng
+
+    eng2 = await open_engine("chaos-b")
+    check(eng2._fence.epoch == old_epoch + 1,
+          f"replacement writer acquired next epoch "
+          f"({old_epoch} -> {eng2._fence.epoch}) with no unfencing step")
+    t = await eng2.query(QueryRequest(metric=b"crash_smoke", start_ms=0,
+                                      end_ms=HOUR))
+    vals = sorted(t.column("value").to_pylist()) if t is not None else []
+    check(vals == [1.0, 2.0],
+          f"recovered to the committed snapshot exactly (rows={vals})")
+    live = {s.id for s in eng2.data_table.manifest.all_ssts()}
+    orphans = [
+        p for p in inner._objects
+        if p.startswith("db/data/data/") and p.endswith(".sst")
+        and int(p.rsplit("/", 1)[-1][:-4]) not in live
+    ]
+    check(orphans == [], f"orphan SSTs GC'd at reopen ({orphans})")
+    await eng2.close()
+
+
+async def run() -> int:
+    failures: list[str] = []
+
+    def check(ok: bool, msg: str) -> None:
+        print(("ok   " if ok else "FAIL ") + msg)
+        if not ok:
+            failures.append(msg)
+
+    await server_phase(check)
+    await crash_phase(check)
+    print(f"chaos-smoke: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+def main() -> None:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    raise SystemExit(asyncio.run(run()))
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,15 @@ Rules (see docs/static-analysis.md for rationale and examples):
         holds its measured 3x with-flush throughput while flush work
         runs on the flush executor through the storage layer; control-
         plane writes (descriptors, sidecars) suppress with the reason
+  J009  naked object-store construction outside objstore/: a concrete
+        store (`MemStore`/`LocalStore`/`S3LikeStore`) built in engine
+        code without being handed straight to a `ResilientStore(...)`
+        gives every component that receives it single-naked-attempt
+        semantics — no retry/backoff, no per-op deadline, no circuit
+        breaker, no horaedb_objstore_* attribution. The store boundary
+        is where resilience is decided, so the lint enforces it at the
+        construction site; harness/test fixtures that WANT raw-store
+        semantics suppress with the reason
 
 Suppressions: `# jaxlint: disable=J001 <reason>` on the finding's line
 or the line immediately above. The reason is mandatory (J000 otherwise);
@@ -142,6 +151,15 @@ J008_MODULES = (
     "horaedb_tpu/engine/",
 )
 J008_EXEMPT = ("horaedb_tpu/engine/flush_executor.py",)
+
+# J009: the resilience boundary (objstore/resilient.py). Concrete store
+# constructors outside objstore/ must be immediate arguments of a
+# ResilientStore(...) call. tests/ and benchmarks/tools harnesses are out
+# of scope — they deliberately build raw stores to inject faults.
+J009_MODULES = ("horaedb_tpu/",)
+J009_EXEMPT = ("horaedb_tpu/objstore/",)
+RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
+STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
     "pq.ParquetWriter", "pq.write_table", "pq.write_to_dataset",
     "pyarrow.parquet.ParquetWriter", "pyarrow.parquet.write_table",
@@ -655,6 +673,35 @@ def _check_append_hot_path(tree: ast.Module, findings: list[Finding]) -> None:
             ))
 
 
+def _check_store_boundary(tree: ast.Module, findings: list[Finding]) -> None:
+    """J009: concrete ObjectStore constructors outside objstore/ that are
+    not immediate arguments of a ResilientStore(...) (or ChaosStore(...)
+    — the chaos harness wraps before resilience does). One pass collects
+    the wrapped argument nodes; a second flags naked constructions."""
+    wrapped: set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        if fd and fd.rsplit(".", 1)[-1] in STORE_BOUNDARY_WRAPPERS:
+            wrapped.update(node.args)
+            wrapped.update(kw.value for kw in node.keywords)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or node in wrapped:
+            continue
+        fd = dotted(node.func)
+        if fd and fd.rsplit(".", 1)[-1] in RAW_STORE_CTORS:
+            findings.append(Finding(
+                node.lineno, "J009",
+                f"concrete object store `{fd}(...)` constructed outside "
+                "objstore/ without the ResilientStore boundary — the "
+                "receiver gets single-naked-attempt semantics (no retry/"
+                "backoff, deadlines, breaker, or horaedb_objstore_* "
+                "attribution); wrap it in objstore/resilient.ResilientStore "
+                "at the construction site or suppress with the reason",
+            ))
+
+
 def _lock_attrs_of(cls: ast.ClassDef) -> set[str]:
     """Attribute names of locks this class OWNS (self._lock = Lock())."""
     out: set[str] = set()
@@ -837,6 +884,13 @@ def lint_file(path: Path) -> list[str]:
         (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
         for h in J008_MODULES
     ) and not any(posix.endswith(m) for m in J008_EXEMPT)
+    in_j009_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J009_MODULES
+    ) and not any(
+        (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
+        for m in J009_EXEMPT
+    )
 
     idx = JitIndex()
     idx.visit(tree)
@@ -856,6 +910,8 @@ def lint_file(path: Path) -> list[str]:
         _check_naked_jit(tree, findings)
     if in_j008_scope:
         _check_append_hot_path(tree, findings)
+    if in_j009_scope:
+        _check_store_boundary(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

@@ -74,6 +74,16 @@ REQUIRED_FAMILIES = (
     "horaedb_jit_cache_entries",
     'horaedb_scan_stage_seconds_bucket{stage="compile"',
     "horaedb_slowlog_records_total",
+    # object-store resilience layer (objstore/resilient.py): the server
+    # wraps its store in a ResilientStore at boot, so the families must
+    # render with per-verb children from the manifest/boot traffic alone
+    "horaedb_objstore_attempts_total",
+    'horaedb_objstore_attempts_total{op="put",result="ok"',
+    'horaedb_objstore_attempts_total{op="get",result="ok"',
+    "horaedb_objstore_retries_total",
+    "horaedb_objstore_gave_up_total",
+    "horaedb_objstore_breaker_state",
+    "horaedb_orphan_ssts_gc_total",
 )
 
 
@@ -92,6 +102,23 @@ def make_payload() -> bytes:
             s = ts.samples.add()
             s.timestamp = t
             s.value = v
+    return req.SerializeToString()
+
+
+def make_payload_named(metric: str) -> bytes:
+    """One-sample payload under a FRESH metric name, so ingest cannot be
+    served from caches — registration must touch the object store."""
+    from horaedb_tpu.pb import remote_write_pb2
+
+    req = remote_write_pb2.WriteRequest()
+    ts = req.timeseries.add()
+    for k, v in ((b"__name__", metric.encode()), (b"host", b"shed")):
+        lab = ts.labels.add()
+        lab.name = k
+        lab.value = v
+    s = ts.samples.add()
+    s.timestamp = 1000
+    s.value = 1.0
     return req.SerializeToString()
 
 
@@ -234,6 +261,27 @@ async def run() -> int:
                     and t.get("root") is not None,
                     "/debug/traces/{id} round-trips the span tree",
                 )
+            # ---- overload shedding: with the store's circuit breaker
+            # forced open, a write that must touch the store (fresh
+            # metric name -> registration) answers 503 + Retry-After —
+            # the graceful-degradation contract (server/errors.py)
+            from horaedb_tpu.server.main import STATE_KEY
+
+            store = app[STATE_KEY].engine._store
+            store.breaker.force_open()
+            try:
+                async with s.post(f"{base}/api/v1/write",
+                                  data=make_payload_named("smoke_shed")) as r:
+                    check(r.status == 503,
+                          f"breaker-open write answers 503 (got {r.status})")
+                    check(r.headers.get("Retry-After", "").isdigit(),
+                          f"503 carries Retry-After "
+                          f"({r.headers.get('Retry-After')!r})")
+            finally:
+                store.breaker.reset()
+            async with s.post(f"{base}/api/v1/write",
+                              data=make_payload_named("smoke_shed")) as r:
+                check(r.status == 200, "write recovers after breaker reset")
             async with s.get(f"{base}/metrics") as r:
                 text = await r.text()
         errors = validate(text)
