@@ -35,8 +35,9 @@ lint:
 # lock discipline on the concurrency surface (J004), host timers/spans
 # inside jit bodies (J005), ad-hoc aggregation lanes (J006), naked jit
 # (J007), blocking flush work on the append path (J008), naked
-# object-store construction outside the ResilientStore boundary (J009).
-# Findings print as path:line: CODE message.
+# object-store construction outside the ResilientStore boundary (J009),
+# ad-hoc tombstone/retention filtering off the shared visibility helper
+# (J010). Findings print as path:line: CODE message.
 # Rules + suppression syntax: docs/static-analysis.md
 jaxlint:
 	python tools/jaxlint.py

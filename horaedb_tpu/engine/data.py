@@ -53,6 +53,16 @@ FLUSH_FAILURES = GLOBAL_METRICS.counter(
     help="Failed write-outs (rows re-buffered for retry).",
     labelnames=("table",),
 )
+LATE_SAMPLES = GLOBAL_METRICS.counter(
+    "horaedb_late_samples_total",
+    help="Out-of-order/backfill samples: appended rows whose timestamp "
+         "falls in a segment OLDER than the active one (the ingest low "
+         "watermark). Routed to per-time-partition late buffers and "
+         "flushed as ordinary per-segment SSTs; reads stay exact via "
+         "merge-dedup. A sustained rate means lagging agents or a "
+         "backfill import.",
+    labelnames=("table",),
+)
 
 
 # Above this series cardinality the dense pushdown grid (num_series x
@@ -101,8 +111,17 @@ class SampleManager:
         self._table_id = getattr(storage, "_root", None) or "data"
         # pre-register the flush families' children so /metrics exposes
         # them (zero state) before the first write-out
-        for fam in (FLUSH_SECONDS, FLUSH_ROWS, FLUSH_FAILURES):
+        for fam in (FLUSH_SECONDS, FLUSH_ROWS, FLUSH_FAILURES, LATE_SAMPLES):
             fam.labels(self._table_id)
+        # Out-of-order/backfill low watermark: the max sample timestamp
+        # this manager has ever buffered. A sample in a segment OLDER than
+        # the watermark's is LATE: counted, and on the column-memtable
+        # path ROUTED into per-time-partition buffers (self._buf, the
+        # persist()-path per-segment dict that rides the same seal/replay
+        # machinery) so the hot columnar drain keeps its O(n)
+        # ts-monotone fast path and one backfill trickle cannot force a
+        # full lexsort of the whole memtable.
+        self._high_wm: int | None = None
         # Opt-in ingest buffering (the RFC's own data-table design batches
         # many samples per stored row, docs/rfcs/20240827-metric-engine.md
         # :218-232): rows accumulate per segment and flush as ONE storage
@@ -175,12 +194,47 @@ class SampleManager:
     def buffer_native_add(self, parser) -> int:
         """Append the parser's current parse into the C++ accumulator
         (engine.write_payload holds the parser borrowed). Returns total
-        buffered rows."""
+        buffered rows.
+
+        Late-sample accounting rides here too (one ts-lane copy + min/max
+        per payload, ~1 ns/sample): the accumulator itself pk-sorts at
+        drain and the flush splits by segment, so out-of-order rows are
+        CORRECT on this path by construction — the watermark check only
+        feeds `horaedb_late_samples_total` and keeps the watermark shared
+        with the Python memtable paths."""
         before = self._accum.rows
         total = self._accum.add(parser)
+        added = total - before
         # feed the overlap-ratio metric on the native hot path too
-        self._appended_rows += total - before
+        self._appended_rows += added
+        if added:
+            ts = parser.sample_ts_view()
+            if len(ts):
+                late = self._late_mask(ts)
+                if late is not None:
+                    LATE_SAMPLES.labels(self._table_id).inc(
+                        int(np.count_nonzero(late))
+                    )
         return total
+
+    def _late_mask(self, ts: np.ndarray) -> "np.ndarray | None":
+        """Mask of samples whose segment is OLDER than the active segment
+        of the PRE-batch high watermark, then advance the watermark — None
+        when none are (the common in-order case pays one vectorized
+        max/min + two compares). Lateness is judged against the watermark
+        as it stood BEFORE this batch: an in-order batch that itself
+        straddles a segment rollover must not count its pre-boundary
+        samples as late (nothing arrived out of order)."""
+        prev = self._high_wm
+        mx = int(ts.max())
+        if prev is None or mx > prev:
+            self._high_wm = mx
+        if prev is None:
+            return None  # first traffic IS the stream, wherever it starts
+        low = prev - prev % self._segment_duration
+        if int(ts.min()) >= low:
+            return None
+        return ts < low
 
     def should_flush(self, rows: int) -> bool:
         return rows >= self._buffer_rows
@@ -233,9 +287,16 @@ class SampleManager:
         values: np.ndarray,      # f64 per sample
     ) -> None:
         """One storage write per touched segment, rows sorted on device by
-        the write path (or buffered, see __init__)."""
+        the write path (or buffered, see __init__). Already per-segment —
+        late samples land in their own partition by construction; the
+        watermark check only counts them."""
         if len(ts) == 0:
             return
+        late = self._late_mask(ts)
+        if late is not None:
+            LATE_SAMPLES.labels(self._table_id).inc(
+                int(np.count_nonzero(late))
+            )
         seg = ts - (ts % self._segment_duration)
         uniq = np.unique(seg)
         for seg_start in uniq:
@@ -283,30 +344,67 @@ class SampleManager:
         """Hash-lane buffered ingest: one dense-id dict probe per series,
         then whole-request column appends IN PLACE into the preallocated
         active memtable (no per-request list nodes, no flush-time
-        concatenate — the zero-copy drain)."""
-        dense = self._dense
-        keys = self._dense_keys
-        mids = metric_arr.tolist()
-        tids = tsid_arr.tolist()
-        per_series = np.empty(len(mids), dtype=np.int64)
-        for s in range(len(mids)):
-            k = (mids[s], tids[s])
-            d = dense.get(k)
-            if d is None:
-                d = len(keys)
-                dense[k] = d
-                keys.append(k)
-            per_series[s] = d
+        concatenate — the zero-copy drain).
+
+        Out-of-order/backfill samples (segments older than the watermark's
+        active segment) are ROUTED OUT into per-time-partition late
+        buffers (`self._buf`, the persist()-path per-segment dict, which
+        rides the same seal/replay machinery and flushes one SST per
+        partition): the hot columnar memtable keeps its ts-monotone O(n)
+        drain fast path, and a backfill trickle cannot force a full
+        lexsort of everything buffered with it."""
         ts = req.sample_ts
+        series_idx = req.sample_series
+        vals = req.sample_value
+        late = self._late_mask(ts) if len(ts) else None
+        if late is not None:
+            n_late = int(np.count_nonzero(late))
+            LATE_SAMPLES.labels(self._table_id).inc(n_late)
+            sel = np.flatnonzero(late)
+            l_sidx = series_idx[sel]
+            l_ts = ts[sel]
+            chunk = (
+                np.asarray(metric_arr, dtype=np.uint64)[l_sidx],
+                np.asarray(tsid_arr, dtype=np.uint64)[l_sidx],
+                l_ts,
+                vals[sel],
+            )
+            seg = l_ts - (l_ts % self._segment_duration)
+            uniq = np.unique(seg)
+            for seg_start in uniq:
+                m = seg == seg_start if len(uniq) > 1 else slice(None)
+                self._buf.setdefault(int(seg_start), []).append(
+                    tuple(a[m] for a in chunk)
+                )
+            self._buffered += n_late
+            self._appended_rows += n_late
+            keep = np.flatnonzero(~late)
+            series_idx = series_idx[keep]
+            ts = ts[keep]
+            vals = vals[keep]
         n = len(ts)
-        dcol, tcol, vcol = self._cols_for(n)
-        f = self._fill
-        dcol[f:f + n] = per_series[req.sample_series]
-        tcol[f:f + n] = ts
-        vcol[f:f + n] = req.sample_value
-        self._fill = f + n
-        self._buffered += n
-        self._appended_rows += n
+        if n:
+            dense = self._dense
+            keys = self._dense_keys
+            mids = metric_arr.tolist()
+            tids = tsid_arr.tolist()
+            per_series = np.empty(len(mids), dtype=np.int64)
+            for s in range(len(mids)):
+                k = (mids[s], tids[s])
+                d = dense.get(k)
+                if d is None:
+                    d = len(keys)
+                    dense[k] = d
+                    keys.append(k)
+                per_series[s] = d
+            dcol, tcol, vcol = self._cols_for(n)
+            f = self._fill
+            dcol[f:f + n] = per_series[series_idx]
+            tcol[f:f + n] = ts
+            vcol[f:f + n] = vals
+            self._fill = f + n
+            self._buffered += n
+            self._appended_rows += n
         if self._buffered >= self._buffer_rows:
             await self.seal_and_submit()
 
@@ -801,7 +899,9 @@ class SampleManager:
             f"downsample resolution too high: {n_buckets} buckets "
             f"(max {MAX_BUCKETS}); narrow the range or coarsen bucket_ms",
         )
-        ssts = self._storage.manifest.find_ssts(rng)
+        # retention-pruned SST selection (storage.select_ssts notes
+        # ssts_retention_pruned provenance for EXPLAIN)
+        ssts = self._storage.select_ssts(rng)
         if not ssts or not tsids:
             return None
         if len(tsids) > MAX_PUSHDOWN_SERIES:

@@ -18,13 +18,15 @@ import numpy as np
 
 from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import ensure
-from horaedb_tpu.common.time_ext import now_ms
+from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
 from horaedb_tpu.engine import tables
 from horaedb_tpu.engine.data import SampleManager
 from horaedb_tpu.engine.index import IndexManager
 from horaedb_tpu.engine.metric import MetricManager
+from horaedb_tpu.ingest.cardinality import CardinalityLimited, SeriesSketch
 from horaedb_tpu.ingest.types import ParsedWriteRequest
 from horaedb_tpu.objstore import ObjectStore
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
 from horaedb_tpu.storage.config import ColumnOptions, StorageConfig
 from horaedb_tpu.storage.storage import ObjectBasedStorage
 from horaedb_tpu.storage.types import TimeRange
@@ -34,6 +36,43 @@ logger = logging.getLogger(__name__)
 NAME_LABEL = b"__name__"
 
 DEFAULT_SEGMENT_MS = 2 * 3600_000  # 2h data segments
+
+SERIES_CARDINALITY = GLOBAL_METRICS.gauge(
+    "horaedb_series_cardinality",
+    help="HLL-sketch estimate of distinct (metric, tsid) series this "
+         "table has ever ingested (ingest/cardinality.py; seeded from "
+         "the index at open). The cardinality-explosion early-warning "
+         "signal, and the value the max_series limit compares against.",
+    labelnames=("table",),
+)
+CARD_REJECTED_SAMPLES = GLOBAL_METRICS.counter(
+    "horaedb_cardinality_rejected_samples_total",
+    help="Samples dropped because their series was NEW while the table "
+         "sat at its series-cardinality limit (partial-accept 503s; "
+         "existing-series samples in the same request were accepted).",
+    labelnames=("table",),
+)
+CARD_REJECTED_SERIES = GLOBAL_METRICS.counter(
+    "horaedb_cardinality_rejected_series_total",
+    help="Distinct new-series registrations rejected at the "
+         "series-cardinality limit (per request; a series retried across "
+         "requests counts each time).",
+    labelnames=("table",),
+)
+CARD_LIMITED_REQUESTS = GLOBAL_METRICS.counter(
+    "horaedb_cardinality_limited_requests_total",
+    help="Write requests answered with the 503/Retry-After "
+         "partial-accept because the series-cardinality limit rejected "
+         "at least one new series.",
+    labelnames=("table",),
+)
+TOMBSTONES_CREATED = GLOBAL_METRICS.counter(
+    "horaedb_tombstones_created_total",
+    help="Tombstone delete records created via the delete API, by table "
+         "root (applied at scan time immediately, physically at "
+         "compaction; horaedb_tombstones_applied_total tracks rows).",
+    labelnames=("table",),
+)
 
 
 def sample_table_config(config: StorageConfig | None) -> StorageConfig:
@@ -108,6 +147,8 @@ class MetricEngine:
         parser_pool=None,
         fence_node_id: str | None = None,
         fence_validate_interval_s: float = 5.0,
+        retention_period_ms: int | None = None,
+        max_series: int = 0,
     ) -> "MetricEngine":
         """`ingest_buffer_rows` > 0 buffers data-table rows across writes
         and flushes as one SST per segment when the threshold is reached
@@ -122,11 +163,32 @@ class MetricEngine:
         `fence_node_id` claims exclusive write ownership of this engine
         root: ONE epoch fence covers all six tables (the region is the
         ownership unit, RFC :28-76); a later claimant deposes this process
-        and its writes fail with FencedError (storage/fence.py)."""
+        and its writes fail with FencedError (storage/fence.py).
+
+        `retention_period_ms`: samples older than now - period stop
+        existing — row-exact at scan time (storage/visibility.py), and the
+        compaction scheduler's TTL expires whole SSTs physically. Applies
+        to the data + exemplars tables only (the registration tables hold
+        definitions, not samples). None = keep forever.
+
+        `max_series`: per-engine series-cardinality limit enforced by an
+        HLL sketch on the ingest path (ingest/cardinality.py): once the
+        estimate reaches the limit, NEW series are rejected with a
+        503/Retry-After partial-accept while existing-series samples keep
+        landing. 0 = unlimited (the sketch still runs and exports
+        horaedb_series_cardinality)."""
         self = object.__new__(cls)
         self._store = store
         self._segment_duration = segment_duration_ms
         self._pool = parser_pool
+        self._table_label = root.strip("/")
+        self._max_series = int(max_series)
+        self._sketch = SeriesSketch()
+        self._card_events = 0
+        for fam in (CARD_REJECTED_SAMPLES, CARD_REJECTED_SERIES,
+                    CARD_LIMITED_REQUESTS, TOMBSTONES_CREATED):
+            fam.labels(self._table_label)
+        SERIES_CARDINALITY.labels(self._table_label).set(0)
 
         fence = None
         if fence_node_id is not None:
@@ -139,8 +201,18 @@ class MetricEngine:
         self._fence = fence
 
         sample_cfg = sample_table_config(config)
+        if retention_period_ms is not None and retention_period_ms > 0:
+            # single source of truth: the compaction scheduler's TTL drives
+            # BOTH physical expiry (picker expireds + the expired-only task)
+            # and scan-time retention masking (storage.retention_floor_ms).
+            # Sample-bearing tables only — retention must never expire
+            # metric/series/index/tags registrations.
+            sample_cfg.scheduler.ttl = ReadableDuration.millis(
+                int(retention_period_ms)
+            )
 
         async def open_table(name, schema, num_pks, compaction):
+            sample_table = name in ("data", "exemplars")
             return await ObjectBasedStorage.try_new(
                 root=f"{root}/{name}",
                 store=store,
@@ -148,11 +220,14 @@ class MetricEngine:
                 num_primary_keys=num_pks,
                 segment_duration_ms=segment_duration_ms,
                 # sample-bearing tables get the measured encoding defaults
-                config=sample_cfg if name in ("data", "exemplars") else config,
+                config=sample_cfg if sample_table else config,
                 enable_compaction_scheduler=compaction,
                 sst_executor=sst_executor,
                 manifest_executor=manifest_executor,
                 fence=fence,
+                # row-exact retention + time-range tombstone deletes
+                # (storage/visibility.py) need the schema's time column
+                time_column="ts" if sample_table else None,
             )
 
         self.metrics_table = await open_table(
@@ -202,6 +277,14 @@ class MetricEngine:
         self.exemplar_mgr = SampleManager(self.exemplars_table, segment_duration_ms)
         await self.metric_mgr.open()
         await self.index_mgr.open()
+        # seed the cardinality sketch from the index the open just loaded:
+        # the estimate (and the limit) survive restarts without any extra
+        # durable state
+        mids, tsids = self.index_mgr.series_lanes()
+        self._sketch.add_pairs(mids, tsids)
+        SERIES_CARDINALITY.labels(self._table_label).set(
+            round(self._sketch.estimate())
+        )
         return self
 
     def sub_engines(self) -> "dict[str, MetricEngine]":
@@ -278,29 +361,131 @@ class MetricEngine:
             label_sets.append(rest)
         ids = await self.metric_mgr.populate_metric_ids(names, ts_now)
         metric_per_series = [ids[n] for n in names]
-        # 2. series registration + tsids
-        tsids = await self.index_mgr.populate_series_ids(
-            metric_per_series, label_sets, ts_now
-        )
+        # 2. cardinality gate (the pure-Python path derives the tsids it
+        # needs for the known-series probe — only once the estimate has
+        # already crossed the limit, so the hot case pays nothing)
+        rejected = None
+        if self._max_series and self._sketch.estimate() >= self._max_series:
+            from horaedb_tpu.engine.types import series_id_of, series_key_of
+
+            pred_tsids = np.fromiter(
+                (series_id_of(series_key_of(ls)) for ls in label_sets),
+                dtype=np.uint64, count=len(label_sets),
+            )
+            marr = np.asarray(metric_per_series, dtype=np.uint64)
+            known = self.index_mgr.known_pairs_mask(marr, pred_tsids)
+            if not bool(known.all()):
+                rejected = ~known
+        # series registration + tsids (accepted series only under the gate)
+        if rejected is None:
+            tsids = np.asarray(await self.index_mgr.populate_series_ids(
+                metric_per_series, label_sets, ts_now
+            ), dtype=np.uint64)
+        else:
+            acc = np.flatnonzero(~rejected)
+            acc_list = acc.tolist()
+            acc_tsids = await self.index_mgr.populate_series_ids(
+                [metric_per_series[i] for i in acc_list],
+                [label_sets[i] for i in acc_list], ts_now,
+            )
+            tsids = np.zeros(req.n_series, dtype=np.uint64)
+            tsids[acc] = np.asarray(acc_tsids, dtype=np.uint64)
         # 3. samples -> data rows
         n = req.n_samples
         metric_arr = np.asarray(metric_per_series, dtype=np.uint64)
-        tsid_arr = np.asarray(tsids, dtype=np.uint64)
+        tsid_arr = tsids
+        self._feed_sketch(
+            metric_arr if rejected is None else metric_arr[~rejected],
+            tsid_arr if rejected is None else tsid_arr[~rejected],
+        )
+        card_accept = card_reject = 0
         if n:
             series_idx = req.sample_series
-            await self.sample_mgr.persist(
-                metric_arr[series_idx], tsid_arr[series_idx],
-                req.sample_ts, req.sample_value,
-            )
+            if rejected is not None:
+                keep = ~rejected[series_idx]
+                card_accept = int(np.count_nonzero(keep))
+                card_reject = n - card_accept
+                sel = np.flatnonzero(keep)
+                series_idx = series_idx[sel]
+                if card_accept:
+                    await self.sample_mgr.persist(
+                        metric_arr[series_idx], tsid_arr[series_idx],
+                        req.sample_ts[sel], req.sample_value[sel],
+                    )
+            else:
+                await self.sample_mgr.persist(
+                    metric_arr[series_idx], tsid_arr[series_idx],
+                    req.sample_ts, req.sample_value,
+                )
         # 4. exemplars -> exemplars table (with their labels: trace ids are
         # the entire point of exemplars)
         if len(req.exemplar_value):
-            await self._persist_exemplars(req, metric_arr, tsid_arr)
+            await self._persist_exemplars(
+                req, metric_arr, tsid_arr,
+                keep_series=None if rejected is None else ~rejected,
+            )
+        if rejected is not None:
+            self._raise_cardinality(
+                int(np.count_nonzero(rejected)), card_reject, card_accept
+            )
         return n
+
+    def _cardinality_gate(self, metric_arr, tsid_arr) -> "np.ndarray | None":
+        """Per-series rejection mask when the table sits at its series
+        limit, else None. Cheap until the limit is actually reached (one
+        cached-estimate compare); only then does it pay the per-pair
+        known-series probes to tell existing traffic from the explosion."""
+        if not self._max_series:
+            return None
+        if self._sketch.estimate() < self._max_series:
+            return None
+        known = self.index_mgr.known_pairs_mask(metric_arr, tsid_arr)
+        if known.all():
+            return None
+        return ~known
+
+    def _feed_sketch(self, metric_arr, tsid_arr) -> None:
+        if self._sketch.add_pairs(metric_arr, tsid_arr):
+            SERIES_CARDINALITY.labels(self._table_label).set(
+                round(self._sketch.estimate())
+            )
+
+    def _raise_cardinality(
+        self, rejected_series: int, rejected_samples: int,
+        accepted_samples: int,
+    ) -> None:
+        """Count + sampled-log one partial-accept, then raise the typed
+        overload signal (503/Retry-After at the HTTP layer). Raised AFTER
+        the accepted samples were persisted/buffered — the ack contract
+        for in-budget traffic is unchanged."""
+        t = self._table_label
+        CARD_REJECTED_SERIES.labels(t).inc(rejected_series)
+        CARD_REJECTED_SAMPLES.labels(t).inc(rejected_samples)
+        CARD_LIMITED_REQUESTS.labels(t).inc()
+        self._card_events += 1
+        if self._card_events == 1 or self._card_events % 100 == 0:
+            logger.warning(
+                "series cardinality limit on %s: rejected %d new series "
+                "(%d samples), accepted %d samples (event %d, est ~%.0f, "
+                "limit %d)",
+                t, rejected_series, rejected_samples, accepted_samples,
+                self._card_events, self._sketch.estimate(), self._max_series,
+            )
+        raise CardinalityLimited(
+            table=t, limit=self._max_series,
+            estimate=self._sketch.estimate(),
+            accepted_samples=accepted_samples,
+            rejected_samples=rejected_samples,
+            rejected_series=rejected_series,
+        )
 
     async def _resolve_ids_fast(self, req: ParsedWriteRequest):
         """Hash-lane id resolution: validate names, register unseen metrics
-        and series. Returns (metric_arr, tsid_arr) u64 per series."""
+        and series. Returns (metric_arr, tsid_arr, rejected) — u64 lanes
+        per series plus the cardinality-limit rejection mask (None in the
+        overwhelmingly common in-budget case; True entries are NEW series
+        that were NOT registered and whose samples the caller must drop
+        and account via _raise_cardinality)."""
         ts_now = now_ms()
         name_len = req.series_name_len
         if np.any(name_len < 0):
@@ -310,18 +495,25 @@ class MetricEngine:
         tsid_arr = req.series_tsid
         # steady-state fast path: the exact lane bytes were seen (and their
         # series durably registered) before — one set probe, no per-series
-        # Python work
+        # Python work (registered series are by definition in-budget)
         h = hashlib.blake2b(metric_arr.tobytes(), digest_size=16)
         h.update(tsid_arr.tobytes())
         fp = h.digest()
         if fp in self._lanes_fp:
-            return metric_arr, tsid_arr
-        # 1. register unseen metrics (rare after warmup)
-        new_ids = self.metric_mgr.unknown_ids(metric_arr)
+            return metric_arr, tsid_arr, None
+        # 0. cardinality gate BEFORE any registration: at the limit, new
+        # series must not bloat the metrics/series/index tables either
+        rejected = self._cardinality_gate(metric_arr, tsid_arr)
+        acc = None if rejected is None else np.flatnonzero(~rejected)
+        m_acc = metric_arr if acc is None else metric_arr[acc]
+        t_acc = tsid_arr if acc is None else tsid_arr[acc]
+        # 1. register unseen metrics (rare after warmup), accepted series only
+        new_ids = self.metric_mgr.unknown_ids(m_acc)
         if len(new_ids):
             new_set = set(new_ids.tolist())
             seen: dict[int, bytes] = {}
-            for s in range(req.n_series):
+            series_iter = range(req.n_series) if acc is None else acc.tolist()
+            for s in series_iter:
                 m = int(metric_arr[s])
                 if m in new_set and m not in seen:
                     seen[m] = req.series_name(s)
@@ -329,17 +521,32 @@ class MetricEngine:
             await self.metric_mgr.register_named(
                 list(seen.values()), list(seen.keys()), ts_now
             )
-        # 2. register unseen series
-        await self.index_mgr.ensure_series_fast(
-            metric_arr, tsid_arr, req.series_key, ts_now,
-            tag_rows_of=req.series_tag_rows,
-        )
+        # 2. register unseen series (accepted only; index accessors take
+        # positions into the subset, so remap through `acc`)
+        if acc is None:
+            await self.index_mgr.ensure_series_fast(
+                metric_arr, tsid_arr, req.series_key, ts_now,
+                tag_rows_of=req.series_tag_rows,
+            )
+        else:
+            idx = acc.tolist()
+            await self.index_mgr.ensure_series_fast(
+                m_acc, t_acc,
+                (lambda i: req.series_key(idx[i])), ts_now,
+                tag_rows_of=(lambda i: req.series_tag_rows(idx[i])),
+            )
+        self._feed_sketch(m_acc, t_acc)
+        if rejected is not None:
+            # a partially-accepted shape is NOT fully registered: never
+            # fingerprint it, or a later in-budget retry would skip
+            # registration of the still-missing series
+            return metric_arr, tsid_arr, rejected
         # everything in these lanes is now durably registered — remember
         # the shape (bounded: scrape fleets send a few distinct shapes)
         if len(self._lanes_fp) >= 4096:
             self._lanes_fp.clear()
         self._lanes_fp.add(fp)
-        return metric_arr, tsid_arr
+        return metric_arr, tsid_arr, None
 
     async def write_payload(self, payload: bytes) -> int:
         """Parse + ingest one wire payload end-to-end. With native buffering
@@ -388,18 +595,51 @@ class MetricEngine:
                 self._record_metadata(req)
             if req.n_series == 0:
                 return 0
+            rejected = None
+            card_accept = card_reject = 0
             with tracing.span("append", samples=req.n_samples):
-                metric_arr, tsid_arr = await self._resolve_ids_fast(req)
-                if len(req.exemplar_value):
+                metric_arr, tsid_arr, rejected = \
+                    await self._resolve_ids_fast(req)
+                if len(req.exemplar_value) or rejected is not None:
                     # the id lanes may be views into the borrowed parser's
                     # decode arena (pooled_parser.DecodeArena) — exemplar
-                    # persistence runs after release, so own them first
+                    # persistence (and the rejection raise below) runs
+                    # after release, so own them first
                     metric_arr = np.array(metric_arr)
                     tsid_arr = np.array(tsid_arr)
-                if req.n_samples:
+                if req.n_samples and rejected is None:
                     total = self.sample_mgr.buffer_native_add(parser)
+                elif req.n_samples:
+                    # cardinality-limit degradation: the all-or-nothing C++
+                    # accumulator can't take a subset, so this (rare,
+                    # already-throttled) payload materializes its sample
+                    # lanes and buffers only existing-series samples —
+                    # in-budget traffic is never lost
+                    vals, ts, series = parser.sample_lanes()
+                    keep = ~rejected[series]
+                    card_accept = int(np.count_nonzero(keep))
+                    card_reject = len(series) - card_accept
+                    if card_accept:
+                        sel = np.flatnonzero(keep)
+                        s_idx = series[sel]
+                        # persist() runs its own threshold seal, so the
+                        # post-borrow should_flush below stays untriggered
+                        # (total stays 0) — a near-empty active memtable
+                        # must not seal into a tiny SST just because the
+                        # flush executor already holds pending rows
+                        await self.sample_mgr.persist(
+                            metric_arr[s_idx], tsid_arr[s_idx],
+                            ts[sel], vals[sel],
+                        )
         if len(req.exemplar_value):
-            await self._persist_exemplars(req, metric_arr, tsid_arr)
+            await self._persist_exemplars(
+                req, metric_arr, tsid_arr,
+                keep_series=None if rejected is None else ~rejected,
+            )
+        if rejected is not None:
+            self._raise_cardinality(
+                int(np.count_nonzero(rejected)), card_reject, card_accept
+            )
         if total and self.sample_mgr.should_flush(total):
             # hand the sealed memtable to the background flush executor:
             # drain/encode/upload overlap continued ingest, and a FULL
@@ -418,11 +658,26 @@ class MetricEngine:
 
     async def _write_parsed_fast(self, req: ParsedWriteRequest) -> int:
         """Hash-lane write path: per-series ids come from the C++ parser."""
-        metric_arr, tsid_arr = await self._resolve_ids_fast(req)
+        metric_arr, tsid_arr, rejected = await self._resolve_ids_fast(req)
         # 3. samples
         n = req.n_samples
+        card_accept = card_reject = 0
         if n:
-            if self.sample_mgr.buffering:
+            if rejected is not None:
+                # partial accept at the cardinality limit: only
+                # existing-series samples are buffered/persisted
+                series_idx = req.sample_series
+                keep = ~rejected[series_idx]
+                card_accept = int(np.count_nonzero(keep))
+                card_reject = n - card_accept
+                if card_accept:
+                    sel = np.flatnonzero(keep)
+                    s_idx = series_idx[sel]
+                    await self.sample_mgr.persist(
+                        metric_arr[s_idx], tsid_arr[s_idx],
+                        req.sample_ts[sel], req.sample_value[sel],
+                    )
+            elif self.sample_mgr.buffering:
                 await self.sample_mgr.buffer_request(metric_arr, tsid_arr, req)
             else:
                 series_idx = req.sample_series
@@ -431,11 +686,19 @@ class MetricEngine:
                     req.sample_ts, req.sample_value,
                 )
         if len(req.exemplar_value):
-            await self._persist_exemplars(req, metric_arr, tsid_arr)
+            await self._persist_exemplars(
+                req, metric_arr, tsid_arr,
+                keep_series=None if rejected is None else ~rejected,
+            )
+        if rejected is not None:
+            self._raise_cardinality(
+                int(np.count_nonzero(rejected)), card_reject, card_accept
+            )
         return n
 
     async def _persist_exemplars(
-        self, req: ParsedWriteRequest, metric_arr, tsid_arr
+        self, req: ParsedWriteRequest, metric_arr, tsid_arr,
+        keep_series: "np.ndarray | None" = None,
     ) -> None:
         import pyarrow as pa
 
@@ -443,12 +706,23 @@ class MetricEngine:
         from horaedb_tpu.storage.read import WriteRequest as StorageWrite
 
         ex_idx = req.exemplar_series
-        m = metric_arr[ex_idx]
-        t = tsid_arr[ex_idx]
         ts = req.exemplar_ts
         vals = req.exemplar_value
+        ex_pos = np.arange(len(vals))
+        if keep_series is not None:
+            # cardinality partial-accept: exemplars of rejected series drop
+            # with their samples
+            sel = np.flatnonzero(keep_series[ex_idx])
+            if not len(sel):
+                return
+            ex_idx = ex_idx[sel]
+            ts = ts[sel]
+            vals = vals[sel]
+            ex_pos = sel
+        m = metric_arr[ex_idx]
+        t = tsid_arr[ex_idx]
         labels = [
-            series_key_of(req.exemplar_labels(i)) for i in range(len(vals))
+            series_key_of(req.exemplar_labels(int(i))) for i in ex_pos
         ]
         seg = ts - (ts % self._segment_duration)
         for seg_start in np.unique(seg):
@@ -606,3 +880,61 @@ class MetricEngine:
         from horaedb_tpu.storage.read import CompactRequest
 
         await self.data_table.compact(CompactRequest(time_range=time_range))
+
+    # -- deletes ---------------------------------------------------------------
+    async def delete_series(
+        self,
+        metric: bytes,
+        filters=None,
+        matchers=None,
+        start_ms: int = 0,
+        end_ms: "int | None" = None,
+    ) -> dict:
+        """Tombstone delete: series of `metric` matching `filters`/
+        `matchers`, samples in [start_ms, end_ms). The delete is visible
+        to scans IMMEDIATELY (storage/visibility.py masks at read time)
+        and physically applied when compaction rewrites the SSTs; rows
+        written AFTER this call survive (re-ingest works). Exemplars of
+        the matched series in the range are deleted too.
+
+        `end_ms=None` (the "all time" form) caps at NOW rather than
+        infinity: rows written after this call survive by sequence
+        anyway, so an unbounded range would only buy coverage of
+        already-written future-dated samples — while making the
+        tombstone un-GC-able forever (it would overlap every live SST
+        for the rest of the table's life). Pass an explicit end_ms to
+        delete pre-written future-dated data.
+
+        Flushes first, so every previously-ACKED sample carries a write
+        sequence below the tombstone's and is therefore covered — the
+        delete-then-crash-then-replay case cannot resurrect data."""
+        from horaedb_tpu.storage.visibility import build_series_matchers
+
+        if end_ms is None:
+            end_ms = now_ms() + 1
+        resolved = await self._resolve_query_async(QueryRequest(
+            metric=metric, start_ms=start_ms, end_ms=end_ms,
+            filters=list(filters or []), matchers=list(matchers or []),
+        ))
+        if resolved is None:
+            return {"matched_series": 0, "tombstones": 0}
+        metric_id, tsids = resolved
+        # acked-but-buffered rows must be sealed (seq pinned) before the
+        # tombstone's seq is allocated
+        await self.flush()
+        rng = TimeRange(start_ms, end_ms)
+        mats = build_series_matchers(metric_id, tsids)
+        tombs = [await self.data_table.delete_rows(rng, mats)]
+        tombs.append(await self.exemplars_table.delete_rows(rng, mats))
+        TOMBSTONES_CREATED.labels(self._table_label).inc(len(tombs))
+        matched = (
+            len(tsids) if tsids is not None
+            else len(self.index_mgr.series_of(metric_id))
+        )
+        return {
+            "matched_series": matched,
+            "tombstones": len(tombs),
+            "tombstone_ids": [t.id for t in tombs],
+            "start_ms": start_ms,
+            "end_ms": end_ms,
+        }

@@ -1044,6 +1044,45 @@ class IndexManager:
             delta_tsids = set(self._metric_known.get(metric_id, ()))
         return sorted(set(base.tsids.tolist()) | delta_tsids)
 
+    def series_lanes(self) -> tuple[np.ndarray, np.ndarray]:
+        """(metric_id, tsid) u64 lanes of EVERY registered series — the
+        cardinality sketch's recovery seed at engine open (the sketch is
+        in-memory; restarts rebuild it from the index, which open just
+        loaded anyway)."""
+        mids: list[np.ndarray] = []
+        tsids: list[np.ndarray] = []
+        with self._mu:
+            base_items = list(self._base.items())
+            delta_items = [
+                (m, np.fromiter(s, dtype=np.uint64, count=len(s)))
+                for m, s in self._metric_known.items() if s
+            ]
+        for m, idx in base_items:
+            if len(idx.tsids):
+                mids.append(np.full(len(idx.tsids), m, dtype=np.uint64))
+                tsids.append(idx.tsids.astype(np.uint64, copy=False))
+        for m, arr in delta_items:
+            mids.append(np.full(len(arr), m, dtype=np.uint64))
+            tsids.append(arr)
+        if not mids:
+            e = np.empty(0, dtype=np.uint64)
+            return e, e
+        return np.concatenate(mids), np.concatenate(tsids)
+
+    def known_pairs_mask(
+        self, metric_ids: np.ndarray, tsids: np.ndarray
+    ) -> np.ndarray:
+        """Boolean mask: which (metric_id, tsid) pairs are ALREADY
+        registered. Cold path of the cardinality limiter — consulted only
+        once the estimate has crossed the limit, so the per-pair Python
+        probes stay off the in-budget hot path."""
+        out = np.empty(len(metric_ids), dtype=bool)
+        mids = metric_ids.tolist()
+        tids = tsids.tolist()
+        for i, (m, t) in enumerate(zip(mids, tids)):
+            out[i] = self._is_known(m, t)
+        return out
+
     def label_values(self, metric_id: int, key: bytes) -> list[bytes]:
         """LabelValues via the inverted index (the RFC's two-step fallback,
         RFC :120-130). Unique values come straight from the dictionary —

@@ -43,6 +43,7 @@ import numpy as np
 
 from horaedb_tpu.common.hash import seahash
 from horaedb_tpu.engine.engine import MetricEngine, QueryRequest
+from horaedb_tpu.ingest.cardinality import CardinalityLimited
 from horaedb_tpu.ingest.types import ParsedWriteRequest
 
 _TOP = 1 << 64
@@ -432,13 +433,37 @@ class RegionedEngine:
             return await self.engines[int(uniq[0])].write_parsed(req)
         import asyncio
 
-        counts = await asyncio.gather(*(
+        results = await asyncio.gather(*(
             self.engines[r].write_parsed(
                 _subset_request(req, np.flatnonzero(regions == r))
             )
             for r in uniq.tolist()
-        ))
-        return sum(counts)
+        ), return_exceptions=True)
+        # return_exceptions: every region's write SETTLES before the
+        # response — a bare gather would send the 503 while sibling
+        # regions are still writing, and its accounting would name one
+        # region's numbers as the whole request's
+        limited = [r for r in results
+                   if isinstance(r, CardinalityLimited)]
+        other = [r for r in results
+                 if isinstance(r, BaseException)
+                 and not isinstance(r, CardinalityLimited)]
+        if other:
+            raise other[0]
+        if limited:
+            # combine the per-region partial-accepts into one request-level
+            # accounting (accepted counts include fully-accepted regions)
+            accepted = sum(r for r in results if isinstance(r, int))
+            accepted += sum(e.accepted_samples for e in limited)
+            raise CardinalityLimited(
+                table=limited[0].table,
+                limit=limited[0].limit,
+                estimate=max(e.estimate for e in limited),
+                accepted_samples=accepted,
+                rejected_samples=sum(e.rejected_samples for e in limited),
+                rejected_series=sum(e.rejected_series for e in limited),
+            )
+        return sum(results)
 
     def _hash_lanes(
         self, req: ParsedWriteRequest, need_tsids: bool
@@ -591,6 +616,37 @@ class RegionedEngine:
         await asyncio.gather(
             *(e.compact(time_range=time_range) for e in self.engines.values())
         )
+
+    async def delete_series(
+        self, metric: bytes, filters=None, matchers=None,
+        start_ms: int = 0, end_ms: "int | None" = None,
+    ) -> dict:
+        """Fan-out tombstone delete: a metric's series hash across regions
+        (and a pre-split series may live in parent AND daughter manifests),
+        so every region evaluates the matchers independently. The NOW cap
+        for the all-time form resolves HERE so every region shares one
+        bound (see MetricEngine.delete_series)."""
+        import asyncio
+
+        from horaedb_tpu.common.time_ext import now_ms
+
+        if end_ms is None:
+            end_ms = now_ms() + 1
+
+        results = await asyncio.gather(*(
+            e.delete_series(metric, filters=filters, matchers=matchers,
+                            start_ms=start_ms, end_ms=end_ms)
+            for e in self.engines.values()
+        ))
+        return {
+            "matched_series": sum(r["matched_series"] for r in results),
+            "tombstones": sum(r["tombstones"] for r in results),
+            "tombstone_ids": [
+                i for r in results for i in r.get("tombstone_ids", [])
+            ],
+            "start_ms": start_ms,
+            "end_ms": end_ms,
+        }
 
 
 def _merge_raw_tables(tagged: list, router: RangeRouter, limit: int | None):

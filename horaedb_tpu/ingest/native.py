@@ -308,6 +308,26 @@ class NativeParser:
             n_series_hint=int(ns),
         )
 
+    def sample_lanes(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(value, ts, owning-series-index) copies of the CURRENT parse's
+        sample lanes. Only the cardinality-limit partial-accept path uses
+        this (engine/engine.py): the all-or-nothing C++ accumulator cannot
+        take a subset, so a limited payload materializes and masks."""
+        res = self._res
+        n = int(res.n_samples)
+        return (
+            _as_np(res.sample_value, n, np.float64),
+            _as_np(res.sample_ts, n, np.int64),
+            _as_np(res.sample_series, n, np.int64),
+        )
+
+    def sample_ts_view(self) -> np.ndarray:
+        """Standalone copy of the CURRENT parse's sample-ts lane (one
+        memcpy; the arena stays untouched). Valid only directly after a
+        parse/parse_light on this handle — the late-sample watermark
+        accounting (engine/data.py) reads it before the accumulator add."""
+        return _as_np(self._res.sample_ts, int(self._res.n_samples), np.int64)
+
     def parse(self, payload: bytes) -> ParsedWriteRequest:
         res = _RwResult()
         hres = _RwHashResult()

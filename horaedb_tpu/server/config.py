@@ -173,9 +173,61 @@ class IngestConfig:
 
 
 @dataclass
+class RetentionConfig:
+    """Per-table retention horizon (`[metric_engine.retention]`): samples
+    older than now - period stop existing. Row-exact at scan time via the
+    shared visibility mask (storage/visibility.py), whole SSTs expire
+    physically through the compaction scheduler's TTL (including
+    expired-only delete tasks on quiet tables). Applies to the data +
+    exemplars tables of every region; registration tables never expire.
+    period = "0s" / absent keeps samples forever."""
+
+    period: ReadableDuration | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "RetentionConfig":
+        if d is None:
+            return cls()
+        unknown = set(d) - {"period"}
+        ensure(not unknown,
+               f"unknown config keys for RetentionConfig: {sorted(unknown)}")
+        p = d.get("period")
+        if p in (None, "", 0, "0s"):
+            return cls()
+        return cls(period=ReadableDuration.parse(p))
+
+    def period_ms(self) -> int | None:
+        if self.period is None:
+            return None
+        ms = self.period.as_millis()
+        return ms if ms > 0 else None
+
+
+@dataclass
+class LimitsConfig:
+    """Dirty-traffic limits (`[metric_engine.limits]`).
+
+    `max_series`: per-engine series-cardinality cap enforced by the
+    ingest-path HLL sketch (ingest/cardinality.py): at the limit, NEW
+    series are rejected with a 503/Retry-After partial-accept while
+    existing-series samples keep landing. On regioned deployments the
+    limit applies PER REGION (series hash-partition evenly, so the
+    effective global cap is ~num_regions x max_series). 0 = unlimited
+    (the sketch still runs and exports horaedb_series_cardinality)."""
+
+    max_series: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "LimitsConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
 class MetricEngineConfig:
     threads: ThreadConfig = field(default_factory=ThreadConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
+    retention: RetentionConfig = field(default_factory=RetentionConfig)
+    limits: LimitsConfig = field(default_factory=LimitsConfig)
     storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
     # Ingest buffering (engine/data.py SampleManager): 0 = every write is
     # immediately durable (reference write==SST semantics); > 0 buffers up
@@ -291,6 +343,10 @@ class Config:
         ing = self.metric_engine.ingest
         ensure(ing.flush_workers >= 1, "ingest.flush_workers must be >= 1")
         ensure(ing.flush_queue_max >= 1, "ingest.flush_queue_max must be >= 1")
+        ensure(
+            self.metric_engine.limits.max_series >= 0,
+            "limits.max_series must be >= 0 (0 disables the limit)",
+        )
         store = self.metric_engine.storage.object_store
         kind = store.type.lower()
         ensure(

@@ -45,6 +45,7 @@ from horaedb_tpu.common.error import HoraeError, UnavailableError
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.engine import MetricEngine, QueryRequest
 from horaedb_tpu.ingest import ParserPool
+from horaedb_tpu.ingest.cardinality import CardinalityLimited
 from horaedb_tpu.objstore import LocalStore
 from horaedb_tpu.objstore.resilient import ResilientStore
 from horaedb_tpu.server.config import Config
@@ -329,6 +330,20 @@ async def handle_remote_write(request: web.Request) -> web.Response:
     try:
         with tracing.span("ingest", bytes=len(body)):
             n = await state.engine.write_payload(body)
+    except CardinalityLimited as e:
+        # series-cardinality partial-accept: existing-series samples WERE
+        # accepted and are durable per the normal ack contract; only new
+        # series (and their samples) were rejected. 503 + Retry-After so
+        # senders back off; the body carries the exact accounting.
+        logger.warning("remote write cardinality-limited: %s", e)
+        return unavailable_response(e, extra={
+            "partial_accept": True,
+            "accepted_samples": e.accepted_samples,
+            "rejected_samples": e.rejected_samples,
+            "rejected_series": e.rejected_series,
+            "cardinality_limit": e.limit,
+            "series_estimate": round(e.estimate),
+        })
     except UnavailableError as e:
         # overload / store-down shedding: 503 + Retry-After with bounded
         # latency (breaker open fails fast; a stalled flush queue already
@@ -434,10 +449,17 @@ def _explain_payload(st, mode: str) -> dict:
             "selected": counts.get("ssts_selected", 0),
             "read": counts.get("ssts_read", 0),
             "bloom_pruned": counts.get("ssts_bloom_pruned", 0),
+            # retention provenance: SSTs wholly past the horizon the
+            # selection dropped before any IO (storage.select_ssts)
+            "retention_pruned": counts.get("ssts_retention_pruned", 0),
             # partial-result provenance: SSTs a degraded store could not
             # serve (the query answered 503; this names what was missing)
             "unavailable": counts.get("ssts_unavailable", 0),
         },
+        # tombstone provenance (storage/visibility.py): delete records
+        # that masked rows in this scan, and how many rows they masked
+        "tombstones_applied": counts.get("tombstones_applied", 0),
+        "tombstone_rows_masked": counts.get("tombstone_rows_masked", 0),
         "scan_paths": scan_paths,
         "agg_impl": agg_impls[0] if agg_impls else None,
         "agg_impls": agg_impls,
@@ -695,6 +717,66 @@ async def handle_query(request: web.Request) -> web.Response:
     if explain is not None:
         body["explain"] = explain
     return web.json_response(body)
+
+
+async def handle_delete_series(request: web.Request) -> web.Response:
+    """Prometheus-admin-shaped tombstone delete
+    (POST /api/v1/admin/tsdb/delete_series): `match[]` instant selectors
+    plus optional `start`/`end` (epoch seconds; default = all time).
+    Deletes are visible to queries immediately (scan-time masking via the
+    shared visibility helper) and physically applied when compaction
+    rewrites the matched SSTs; samples written AFTER the delete survive."""
+    from horaedb_tpu.promql import PromQLError, Selector, parse
+    from horaedb_tpu.promql.eval import _to_query
+
+    state: ServerState = request.app[STATE_KEY]
+    try:
+        p = await _promql_params(request)
+    except ValueError as e:
+        return _promql_error(e)
+    # match[] is multi-valued in BOTH carriers (query string and form
+    # body) — _promql_params' dict collapse would silently drop all but
+    # the last selector, a silent under-delete on a GDPR surface
+    match_exprs = list(request.query.getall("match[]", []))
+    if request.method == "POST" and request.content_type in (
+        "application/x-www-form-urlencoded", "multipart/form-data"
+    ):
+        form = await request.post()
+        match_exprs += [v for v in form.getall("match[]", [])
+                        if isinstance(v, str)]
+    if not match_exprs and "match[]" in p:
+        match_exprs = [p["match[]"]]  # JSON body: single selector
+    if not match_exprs:
+        return _promql_error(ValueError("match[] selector(s) required"))
+    try:
+        start_ms = int(float(p["start"]) * 1000) if "start" in p else 0
+        # no end = "up to now": rows written after the delete survive by
+        # sequence anyway, and an unbounded range would make the
+        # tombstone permanently un-GC-able (it would overlap every live
+        # SST forever)
+        end_ms = (int(float(p["end"]) * 1000) + 1 if "end" in p
+                  else now_ms() + 1)
+        results = []
+        for expr in match_exprs:
+            node = parse(expr)
+            if not isinstance(node, Selector) or node.range_ms is not None:
+                raise PromQLError(
+                    f"match[] must be an instant selector: {expr!r}"
+                )
+            q = _to_query(node, start_ms, end_ms)
+            with tracing.span("delete_series", metric=node.name):
+                r = await state.engine.delete_series(
+                    q.metric, filters=q.filters, matchers=q.matchers,
+                    start_ms=start_ms, end_ms=end_ms,
+                )
+            r["match"] = expr
+            results.append(r)
+    except UnavailableError as e:
+        return unavailable_response(e)
+    except (PromQLError, HoraeError, KeyError, ValueError) as e:
+        return _promql_error(e)
+    METRICS.inc("horaedb_delete_series_requests_total")
+    return web.json_response({"status": "success", "data": results})
 
 
 async def handle_metrics_list(request: web.Request) -> web.Response:
@@ -1075,6 +1157,10 @@ async def build_app(config: Config, store=None) -> web.Application:
         flush_workers=config.metric_engine.ingest.flush_workers,
         flush_queue_max=config.metric_engine.ingest.flush_queue_max,
         flush_stall_deadline_s=config.metric_engine.ingest.stall_deadline.seconds,
+        # dirty-traffic knobs: retention horizon ([metric_engine.retention])
+        # and the series-cardinality limit ([metric_engine.limits])
+        retention_period_ms=config.metric_engine.retention.period_ms(),
+        max_series=config.metric_engine.limits.max_series,
         parser_pool=pool,
     )
     if config.metric_engine.node_id:
@@ -1155,6 +1241,7 @@ async def build_app(config: Config, store=None) -> web.Application:
             web.get("/api/v1/metrics", handle_metrics_list),
             web.get("/api/v1/series", handle_series),
             web.get("/api/v1/metadata", handle_metadata),
+            web.post("/api/v1/admin/tsdb/delete_series", handle_delete_series),
             web.get("/api/v1/status/buildinfo", handle_buildinfo),
             web.get("/debug/traces", handle_debug_traces),
             web.get("/debug/traces/{id}", handle_debug_trace),

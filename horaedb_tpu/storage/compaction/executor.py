@@ -65,7 +65,10 @@ class Executor:
 
     # -- admission (executor.rs:93-114) -------------------------------------
     def pre_check(self, task: Task) -> None:
-        ensure(bool(task.inputs), "compaction task must have inputs")
+        # expired-only tasks (retention enforcement: delete-only commit, no
+        # merge) are legal; a task with neither inputs nor expireds is not
+        ensure(bool(task.inputs) or bool(task.expireds),
+               "compaction task must have inputs or expireds")
         ensure(
             all(f.is_compaction() for f in task.inputs + task.expireds),
             "compaction task files must be marked in_compaction",
@@ -131,10 +134,21 @@ class Executor:
 
     # -- the compaction itself (executor.rs:155-222) --------------------------
     async def do_compaction(self, task: Task) -> None:
+        from horaedb_tpu.storage import visibility as vis_mod
+
         self.pre_check(task)
         self._trigger_more_task(task.scope)
         COMPACTION_BYTES.observe(task.input_size())
         logger.debug("Start do compaction, input_len=%d", len(task.inputs))
+
+        if not task.inputs:
+            # expired-only task (retention enforcement): delete-only commit,
+            # no merge — the horizon already proved every row out of range
+            to_deletes = [f.id for f in task.expireds]
+            await self._manifest.update([], to_deletes)
+            await self._delete_ssts(to_deletes)
+            await self._gc_tombstones()
+            return
 
         time_range = TimeRange.union_of([f.meta.time_range for f in task.inputs])
         # Same merge pipeline as the scan path, on device, builtins kept.
@@ -144,22 +158,29 @@ class Executor:
         # O(task rows) — admitted only under the memory_limit gate
         # (pre_check, default 2 GiB), the same bound the reference's
         # streamed plan enforces via its task budget (executor.rs:93-114).
-        batches = await self._storage.parquet_reader.scan_segment(
-            task.inputs,
-            predicate=None,
-            projections=None,
-            keep_builtin=True,
-            # a compaction reads every row group of soon-deleted inputs
-            # exactly once — caching them would evict the hot query entries
-            use_block_cache=False,
-        )
+        # The reads funnel through the shared visibility mask under the
+        # "compact" context (storage/visibility.py): tombstoned/expired
+        # rows are PHYSICALLY absent from the rewritten output — this is
+        # where a delete reclaims bytes.
+        with vis_mod.mask_context("compact"):
+            batches = await self._storage.parquet_reader.scan_segment(
+                task.inputs,
+                predicate=None,
+                projections=None,
+                keep_builtin=True,
+                # a compaction reads every row group of soon-deleted inputs
+                # exactly once — caching them would evict the hot query entries
+                use_block_cache=False,
+            )
         if not batches:
-            # All inputs were empty SSTs: commit a delete-only update instead
-            # of erroring (an error would unmark + re-pick the same files in
-            # an infinite retry loop).
+            # All inputs were empty SSTs (or every row was tombstoned/
+            # expired): commit a delete-only update instead of erroring (an
+            # error would unmark + re-pick the same files in an infinite
+            # retry loop).
             to_deletes = [f.id for f in task.expireds] + [f.id for f in task.inputs]
             await self._manifest.update([], to_deletes)
             await self._delete_ssts(to_deletes)
+            await self._gc_tombstones()
             return
         table = pa.Table.from_batches(batches)
 
@@ -215,6 +236,15 @@ class Executor:
         await self._manifest.update(new_files, to_deletes)
         # From now on, no error should be returned (executor.rs:218-219).
         await self._delete_ssts(to_deletes)
+        await self._gc_tombstones()
+
+    async def _gc_tombstones(self) -> None:
+        """Post-commit tombstone GC, best-effort like physical deletes:
+        records whose time range no live SST overlaps are dead weight."""
+        try:
+            await self._manifest.gc_tombstones()
+        except Exception as e:  # noqa: BLE001 — next compaction retries
+            logger.warning("tombstone gc failed: %s", e)
 
     async def _delete_ssts(self, ids: list[int]) -> None:
         """Best-effort parallel physical deletes (executor.rs:224-253),

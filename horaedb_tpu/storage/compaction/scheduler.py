@@ -120,6 +120,21 @@ class CompactionScheduler:
         if time_range is not None:
             ssts = [s for s in ssts if s.meta.time_range.overlaps(time_range)]
         task = self._picker.pick_candidate(ssts, expire_before)
+        if task is None and expire_before is not None:
+            # Retention enforcement: the reference picker only expires
+            # files when some segment also qualifies for a merge (the
+            # preserved quirk, picker.rs:92-95) — which would let expired
+            # SSTs linger forever on a quiet table. A TTL deployment gets
+            # an EXPIRED-ONLY task instead: delete-only commit, no merge.
+            expired = [
+                f for f in ssts
+                if not f.is_compaction() and f.is_expired(expire_before)
+            ]
+            if expired:
+                for f in expired:
+                    f.mark_compaction()
+                task = Task(inputs=[], expireds=expired)
+                PICKS.labels("expired_only").inc()
         if task is not None:
             task.scope = time_range
         if task is None:

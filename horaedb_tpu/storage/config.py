@@ -61,7 +61,9 @@ def _from_dict(cls, d: dict):
     kwargs = {}
     for name, value in d.items():
         default = getattr(cls(), name)
-        if name == "ttl" and value is not None:
+        if name in ("ttl", "period") and value is not None:
+            # duration-or-None fields: the None default gives the generic
+            # `.parse` dispatch below nothing to go on
             kwargs[name] = ReadableDuration.parse(value)
         elif name == "column_options" and value is not None:
             kwargs[name] = {

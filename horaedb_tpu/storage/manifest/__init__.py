@@ -34,6 +34,7 @@ logger = logging.getLogger(__name__)
 PREFIX_PATH = "manifest"
 SNAPSHOT_FILENAME = "snapshot"
 DELTA_PREFIX = "delta"
+TOMBSTONE_PREFIX = "tombstone"
 
 
 def snapshot_path(root: str) -> str:
@@ -46,6 +47,14 @@ def delta_dir(root: str) -> str:
 
 def delta_path(root: str, file_id: int) -> str:
     return f"{delta_dir(root)}/{file_id}"
+
+
+def tombstone_dir(root: str) -> str:
+    return f"{root}/{PREFIX_PATH}/{TOMBSTONE_PREFIX}"
+
+
+def tombstone_path(root: str, record_id: int) -> str:
+    return f"{tombstone_dir(root)}/{record_id}"
 
 
 class ManifestMerger:
@@ -215,6 +224,11 @@ class Manifest:
         self._store = store
         self._config = config
         self._ssts: list[SstFile] = []
+        # Tombstone delete records (storage/visibility.py): manifest-level
+        # control-plane state, one JSON object per record under
+        # manifest/tombstone/{id}. Low volume by construction (deletes are
+        # operator/GDPR events, not a data path).
+        self._tombstone_records: "list" = []
         self._fence = fence
         self._merger = ManifestMerger(
             root, store, config, executor=executor, fence=fence
@@ -238,8 +252,10 @@ class Manifest:
         await m._merger.bootstrap()
         snapshot = await read_snapshot(store, snapshot_path(root))
         m._ssts = snapshot.into_ssts()
+        await m._load_tombstones()
         logger.info(
-            "manifest loaded: root=%s ssts=%d", root, len(m._ssts)
+            "manifest loaded: root=%s ssts=%d tombstones=%d",
+            root, len(m._ssts), len(m._tombstone_records),
         )
         if start_background_merger:
             m._merger.start()
@@ -273,6 +289,78 @@ class Manifest:
         delete_set = set(to_deletes)
         self._ssts = [s for s in self._ssts if s.id not in delete_set]
         self._ssts.extend(to_adds)
+
+    # -- tombstone delete records (storage/visibility.py) --------------------
+    async def _load_tombstones(self) -> None:
+        """Recovery: fold every persisted tombstone record back in. A
+        corrupt record fails the open loudly — silently skipping one would
+        resurrect deleted data."""
+        from horaedb_tpu.storage.visibility import Tombstone
+
+        try:
+            metas = await self._store.list(tombstone_dir(self._root))
+        except NotFound:
+            metas = []
+        records = []
+        for meta in metas:
+            blob = await self._store.get(meta.path)
+            with context(f"decode tombstone {meta.path}"):
+                records.append(Tombstone.from_json(blob))
+        records.sort(key=lambda t: t.seq)
+        self._tombstone_records = records
+
+    async def add_tombstone(self, tomb) -> None:
+        """Durability point of a delete: the tombstone object's PUT. Applied
+        in memory only after it lands — an acked delete survives a crash."""
+        if self._fence is not None:
+            await self._fence.ensure_valid()
+        with context("write tombstone record"):
+            await self._store.put(
+                tombstone_path(self._root, tomb.id), tomb.to_json()
+            )
+        self._tombstone_records.append(tomb)
+
+    def all_tombstones(self) -> list:
+        return list(self._tombstone_records)
+
+    async def gc_tombstones(self) -> int:
+        """Drop tombstones no live SST overlaps: no remaining row can match,
+        so the record is dead weight (retention expiry and whole-range
+        deletes converge here; a tombstone inside a still-live range stays —
+        compaction keeps re-applying it, which is idempotent). Object
+        deletions are best-effort: a failed delete keeps the record
+        in memory AND on disk for the next pass. Returns records dropped."""
+        if not self._tombstone_records:
+            return 0
+        live = self._ssts
+        dead = [
+            t for t in self._tombstone_records
+            if not any(s.meta.time_range.overlaps(t.time_range) for s in live)
+        ]
+        if not dead:
+            return 0
+        results = await asyncio.gather(
+            *(self._store.delete(tombstone_path(self._root, t.id)) for t in dead),
+            return_exceptions=True,
+        )
+        dropped = []
+        for t, r in zip(dead, results):
+            if isinstance(r, BaseException) and not isinstance(r, NotFound):
+                logger.warning(
+                    "tombstone gc: failed to delete record %d: %s", t.id, r
+                )
+                continue
+            dropped.append(t)
+        if dropped:
+            gone = {t.id for t in dropped}
+            self._tombstone_records = [
+                t for t in self._tombstone_records if t.id not in gone
+            ]
+            logger.info(
+                "tombstone gc: root=%s dropped=%d remaining=%d",
+                self._root, len(dropped), len(self._tombstone_records),
+            )
+        return len(dropped)
 
     # -- queries ------------------------------------------------------------
     def all_ssts(self) -> list[SstFile]:

@@ -1014,6 +1014,11 @@ class ParquetReader:
         # pruned row groups are ALL cached skip the store entirely (footers
         # are tiny; evicted with the sst)
         self._meta_cache: dict[int, tuple] = {}
+        # Zero-arg callable returning the table's current Visibility (or
+        # None) — retention + tombstone masking applied to EVERY read_sst
+        # result via the shared helper (storage/visibility.py, jaxlint
+        # J010). Installed by ObjectBasedStorage; None = no masking.
+        self.visibility_provider = None
         # Tombstones for evicted sst ids: an in-flight read racing a delete
         # must not repopulate the caches after eviction (the entry would
         # leak forever). Bounded FIFO — old ids' reads are long finished.
@@ -1122,7 +1127,7 @@ class ParquetReader:
         if rg_cache is not None:
             cached = self._assemble_cached(sst.id, rg_cache[0], predicate)
             if cached is not None:
-                return cached
+                return self._mask_visibility(sst, cached)
 
         def meta_sink(meta, arrow_schema) -> None:
             with self._blk_lock:
@@ -1181,14 +1186,31 @@ class ParquetReader:
         from horaedb_tpu.objstore import NotFound
 
         try:
-            return await asyncio.to_thread(_read)
+            table = await asyncio.to_thread(_read)
         except _NeedBytes:
             data = await self._store.get(path)
-            return await asyncio.to_thread(_read_bytes, data)
+            table = await asyncio.to_thread(_read_bytes, data)
         except FileNotFoundError as e:
             # compaction deleted the file after the caller's manifest
             # snapshot; normalized so scan layers can refresh + retry
             raise NotFound(f"sst object vanished: {path}") from e
+        return self._mask_visibility(sst, table)
+
+    def _mask_visibility(self, sst: SstFile, table: pa.Table) -> pa.Table:
+        """Retention + tombstone masking via the SHARED helper
+        (storage/visibility.py) — the single funnel every scan route,
+        the downsample pushdown, and compaction read through. Applied
+        AFTER the block cache (cache entries stay raw/immutable; a
+        tombstone created later still masks cached hits) and BEFORE the
+        merge (exact for last-writer-wins, see the helper's contract)."""
+        if self.visibility_provider is None or table.num_rows == 0:
+            return table
+        vis = self.visibility_provider()
+        if vis is None:
+            return table
+        from horaedb_tpu.storage.visibility import apply_visibility
+
+        return apply_visibility(table, vis, sst_range=sst.meta.time_range)
 
     def evict_cached(self, file_id: int) -> None:
         """Drop the cached handle of a deleted SST (compaction calls this

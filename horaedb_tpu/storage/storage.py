@@ -157,6 +157,7 @@ class ObjectBasedStorage(ColumnarStorage):
         fence_validate_interval_s: float = 5.0,
         fence=None,
         gc_orphans: bool = True,
+        time_column: str | None = None,
     ) -> "ObjectBasedStorage":
         """`sst_executor` / `manifest_executor`: optional
         concurrent.futures.Executors for CPU-heavy SST work (sort, parquet
@@ -171,12 +172,30 @@ class ObjectBasedStorage(ColumnarStorage):
         construction (types.rs:135); a shared store needs it enforced.
         `fence`: share an already-acquired EpochFence instead (one claim
         covering several tables under one ownership root — the metric
-        engine's six tables fence as one region)."""
+        engine's six tables fence as one region).
+
+        `time_column`: the schema column holding the row's timestamp
+        (epoch ms), enabling ROW-exact retention masking and time-range
+        tombstone deletes (storage/visibility.py). None = retention only
+        prunes/expires whole SSTs (manifest time ranges) and
+        `delete_rows` is unavailable."""
         self = object.__new__(cls)
         config = config or StorageConfig()
         self._root = root.strip("/")
         self._store = store
         self._config = config
+        self._time_column = time_column
+        if time_column is not None:
+            ensure(
+                time_column in arrow_schema.names,
+                f"time_column {time_column!r} not in schema",
+            )
+            # pre-register the tombstone family children so /metrics shows
+            # the zero state from boot (the PR2 convention)
+            from horaedb_tpu.storage.visibility import TOMBSTONES_APPLIED
+
+            for ctx in ("scan", "compact"):
+                TOMBSTONES_APPLIED.labels(self._root, ctx)
         self._sst_executor = sst_executor
         self._segment_duration = segment_duration_ms
         self._schema = StorageSchema.try_new(
@@ -220,6 +239,11 @@ class ObjectBasedStorage(ColumnarStorage):
             scan_block_rows=config.scan_block_rows,
             scan_cache_bytes=config.scan_cache.as_bytes(),
         )
+        # EVERY SST read (materializing scan, chunked scan, downsample
+        # pushdown, compaction) funnels through the shared visibility mask
+        # (storage/visibility.py) via this provider — the single place
+        # tombstone/retention filtering happens (jaxlint J010)
+        self._reader.visibility_provider = self.visibility
         self._scheduler = None
         if enable_compaction_scheduler:
             # imported lazily: compaction depends on this module's writer
@@ -307,6 +331,89 @@ class ObjectBasedStorage(ColumnarStorage):
     @property
     def segment_duration_ms(self) -> int:
         return self._segment_duration
+
+    @property
+    def time_column(self) -> str | None:
+        return self._time_column
+
+    # -- visibility: retention + tombstone deletes (storage/visibility.py) --
+    def retention_floor(self) -> int | None:
+        """Rows/SSTs older than this are out of retention. Single source of
+        truth is the compaction scheduler's TTL, so scan-time masking and
+        compaction-time expiry can never disagree."""
+        ttl = self._config.scheduler.ttl
+        if ttl is None:
+            return None
+        from horaedb_tpu.common.time_ext import now_ms
+
+        return now_ms() - ttl.as_millis()
+
+    def visibility(self):
+        """Current Visibility for this table's reads, or None (the common
+        fast path: nothing subtractive is configured)."""
+        tombs = self._manifest.all_tombstones()
+        floor = self.retention_floor() if self._time_column else None
+        if not tombs and floor is None:
+            return None
+        from horaedb_tpu.storage.visibility import Visibility
+
+        return Visibility(
+            table=self._root,
+            time_column=self._time_column,
+            tombstones=tuple(tombs),
+            retention_floor_ms=floor,
+        )
+
+    def select_ssts(self, time_range: TimeRange) -> list[SstFile]:
+        """Manifest overlap selection + retention pruning: SSTs wholly
+        older than the retention floor never cost IO even before the
+        compaction picker expires them. EXPLAIN provenance:
+        `ssts_retention_pruned` counts what the horizon removed here."""
+        ssts = self._manifest.find_ssts(time_range)
+        floor = self.retention_floor()
+        if floor is not None:
+            kept = [s for s in ssts if s.meta.time_range.end >= floor]
+            pruned = len(ssts) - len(kept)
+            if pruned:
+                scanstats.note("ssts_retention_pruned", pruned)
+            ssts = kept
+        return ssts
+
+    async def delete_rows(
+        self,
+        time_range: TimeRange,
+        matchers: "tuple[tuple[str, tuple[int, ...] | None], ...]",
+    ):
+        """Create + persist one tombstone delete record: rows matching
+        every matcher inside `time_range` whose `__seq__` predates this
+        call become invisible to scans NOW and are physically removed when
+        compaction rewrites their SSTs. Returns the Tombstone.
+
+        The sequence is allocated HERE, from the same monotonic allocator
+        as write sequences — every row acked (sealed/written) before this
+        call has a smaller seq and is therefore covered; rows written
+        after it survive (re-ingest into a deleted range works)."""
+        ensure(
+            self._time_column is not None,
+            "delete_rows requires a table with a time_column",
+        )
+        from horaedb_tpu.storage.visibility import Tombstone
+
+        for col, _vals in matchers:
+            ensure(
+                col in self._schema.arrow_schema.names,
+                f"tombstone matcher column {col!r} not in schema",
+            )
+        rid = allocate_id()
+        tomb = Tombstone(
+            id=rid, seq=rid, time_range=time_range, matchers=tuple(matchers)
+        )
+        await self._manifest.add_tombstone(tomb)
+        logger.info(
+            "tombstone created: root=%s id=%d range=[%d,%d) matchers=%s",
+            self._root, rid, time_range.start, time_range.end, matchers,
+        )
+        return tomb
 
     # -- write path (storage.rs:189-333) ------------------------------------
     async def write(self, req: WriteRequest) -> None:
@@ -674,7 +781,7 @@ class ObjectBasedStorage(ColumnarStorage):
         UnionExec driving per-segment plans concurrently); an early consumer
         break (limit pushdown) cancels the prefetch."""
         t0 = time.perf_counter()
-        ssts = self._manifest.find_ssts(req.range)
+        ssts = self.select_ssts(req.range)
         if req.min_sst_id is not None:
             ssts = [s for s in ssts if s.id > req.min_sst_id]
         # EXPLAIN provenance: time-range SST selection (reads and bloom

@@ -1,0 +1,256 @@
+"""Row visibility: tombstone deletes + retention, ONE shared mask helper.
+
+Production metric stores need two subtractive operations an LSM never
+gets for free: **retention** (rows older than a horizon stop existing)
+and **tombstone deletes** (DELETE by series-matcher + time-range, the
+GDPR/tenant-offboarding path). Both are *logical first, physical later*:
+
+- scan time: every SST read funnels through :func:`apply_visibility`
+  (ParquetReader.read_sst), so deleted/expired rows are MASKED before
+  the merge — reads are exact whether or not compaction has run;
+- compaction time: the compaction executor reads its inputs through the
+  same funnel (under :func:`mask_context` ``"compact"``), so rewritten
+  SSTs physically lack the rows — the delete eventually reclaims bytes
+  and the GDPR story holds.
+
+Masking runs BEFORE merge-dedup, which is exact for last-writer-wins:
+a tombstone only ever matches rows with ``__seq__ < tombstone.seq``, so
+a newer surviving version of the same primary key still wins, and
+re-applying a tombstone to already-compacted data is a no-op.
+
+This module is the ONLY place tombstone/retention row filtering may be
+implemented (jaxlint J010 enforces it): per-reader ad-hoc filters would
+silently diverge between the materializing scan, the chunked scan, the
+downsample pushdown, and compaction — the exact class of bug that makes
+deletes "mostly work".
+
+Tombstone records are manifest-level objects (storage/manifest) encoded
+as JSON — low-volume control-plane state, debuggable with `cat`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+from dataclasses import dataclass
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
+from horaedb_tpu.storage import scanstats
+from horaedb_tpu.storage.types import SEQ_COLUMN_NAME, TimeRange
+
+logger = logging.getLogger(__name__)
+
+TOMBSTONES_APPLIED = GLOBAL_METRICS.counter(
+    "horaedb_tombstones_applied_total",
+    help="Rows masked (context=scan) or physically removed at rewrite "
+         "(context=compact) by tombstone delete records, by table root.",
+    labelnames=("table", "context"),
+)
+
+# Which pipeline is consuming the masked rows right now: "scan" (query
+# reads — rows are masked in the returned batches) or "compact" (the
+# compaction executor — masked rows are physically absent from the
+# rewritten output). Contextvar so the compaction executor flips it for
+# its whole read without threading a flag through every scan layer.
+_MASK_CONTEXT: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "horaedb_mask_context", default="scan"
+)
+
+
+@contextlib.contextmanager
+def mask_context(context: str):
+    """Run a block with visibility masking attributed to `context`."""
+    token = _MASK_CONTEXT.set(context)
+    try:
+        yield
+    finally:
+        _MASK_CONTEXT.reset(token)
+
+
+@dataclass(frozen=True)
+class Tombstone:
+    """One delete record: rows matching every matcher, inside
+    ``time_range``, written BEFORE the delete (``__seq__ < seq``) are
+    invisible. Rows written after the delete (seq >= this record's seq)
+    survive — re-ingest into a deleted range works.
+
+    ``matchers`` is a conjunction of (column, values) terms over integer
+    columns; ``values=None`` is a wildcard (any value matches). The
+    metric engine's series-matcher delete compiles to
+    ``[("metric_id", (mid,)), ("tsid", <resolved tsids> | None)]``.
+    """
+
+    id: int
+    seq: int
+    time_range: TimeRange
+    matchers: tuple[tuple[str, tuple[int, ...] | None], ...]
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "id": self.id,
+            "seq": self.seq,
+            "start": self.time_range.start,
+            "end": self.time_range.end,
+            "matchers": [
+                [col, None if vals is None else list(vals)]
+                for col, vals in self.matchers
+            ],
+        }).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Tombstone":
+        try:
+            d = json.loads(data)
+            return cls(
+                id=int(d["id"]),
+                seq=int(d["seq"]),
+                time_range=TimeRange(int(d["start"]), int(d["end"])),
+                matchers=tuple(
+                    (str(col), None if vals is None else tuple(int(v) for v in vals))
+                    for col, vals in d["matchers"]
+                ),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise HoraeError("corrupt tombstone record") from e
+
+
+@dataclass(frozen=True)
+class Visibility:
+    """The subtractive state one table's scans must honor right now."""
+
+    table: str
+    # the schema's designated time column for row-exact time filtering;
+    # None = no time column (retention then prunes whole SSTs only, and
+    # time-range tombstones cannot be created)
+    time_column: str | None
+    tombstones: tuple[Tombstone, ...] = ()
+    retention_floor_ms: int | None = None
+
+    @property
+    def empty(self) -> bool:
+        return not self.tombstones and self.retention_floor_ms is None
+
+
+def _column_lane(table: pa.Table, name: str) -> np.ndarray | None:
+    if name not in table.schema.names:
+        return None
+    from horaedb_tpu.ops.blocks import arrow_column_to_numpy
+
+    return np.asarray(arrow_column_to_numpy(table.column(name).combine_chunks()))
+
+
+def apply_visibility(
+    table: pa.Table,
+    vis: "Visibility | None",
+    sst_range: "TimeRange | None" = None,
+) -> pa.Table:
+    """Filter one SST's decoded rows through retention + tombstones.
+
+    Exactness contract: runs BEFORE merge-dedup on per-SST tables, which
+    preserves last-writer-wins (see module docstring). Idempotent — safe
+    on already-compacted data.
+
+    `sst_range` (the file's manifest time range) lets non-overlapping
+    tombstones skip without touching any column. A tombstone whose
+    matcher names a column absent from `table` is skipped for this read
+    (scan projections always include the primary key + ``__seq__``, so
+    this only arises for exotic projections) — skipping errs on the
+    visible side, never deletes the wrong rows.
+    """
+    if vis is None or vis.empty or table.num_rows == 0:
+        return table
+    floor = vis.retention_floor_ms
+    # sst_range short-circuits BOTH subtractive passes: a file whose
+    # manifest range starts past the floor cannot hold an expired row,
+    # and a tombstone that doesn't overlap the file cannot match — the
+    # common in-retention/undeleted read then returns without touching
+    # (or materializing) any column
+    need_retention = (
+        floor is not None and vis.time_column is not None
+        and (sst_range is None or sst_range.start < floor)
+    )
+    tombs = [
+        t for t in vis.tombstones
+        if sst_range is None or t.time_range.overlaps(sst_range)
+    ]
+    if not need_retention and not tombs:
+        return table
+    n = table.num_rows
+    ts = _column_lane(table, vis.time_column) if vis.time_column else None
+    drop = None
+    retained_out = 0
+    if need_retention and ts is not None:
+        expired = ts < floor
+        retained_out = int(np.count_nonzero(expired))
+        if retained_out:
+            drop = expired
+    tomb_rows = 0
+    tombs_applied = 0
+    seq = None
+    for t in tombs:
+        if ts is None:
+            continue  # no time column: tombstones cannot be evaluated
+        if seq is None:
+            seq = _column_lane(table, SEQ_COLUMN_NAME)
+            if seq is None:
+                # no __seq__ in this projection: cannot prove rows predate
+                # the delete — err visible (scan paths always fetch it)
+                logger.warning(
+                    "tombstone skipped: projection lacks %s (table=%s)",
+                    SEQ_COLUMN_NAME, vis.table,
+                )
+                break
+        m = (ts >= t.time_range.start) & (ts < t.time_range.end)
+        if not m.any():
+            continue
+        m &= seq < np.uint64(t.seq)
+        bad = False
+        for col, vals in t.matchers:
+            if vals is None:
+                continue
+            lane = _column_lane(table, col)
+            if lane is None:
+                bad = True
+                break
+            if len(vals) == 1:
+                m &= lane == lane.dtype.type(vals[0])
+            else:
+                m &= np.isin(lane, np.asarray(vals, dtype=lane.dtype))
+        if bad:
+            continue
+        hit = int(np.count_nonzero(m))
+        if hit:
+            tombs_applied += 1
+            tomb_rows += hit
+            drop = m if drop is None else (drop | m)
+    if drop is None:
+        return table
+    total = int(np.count_nonzero(drop))
+    if total == 0:
+        return table
+    context = _MASK_CONTEXT.get()
+    if tomb_rows:
+        TOMBSTONES_APPLIED.labels(vis.table, context).inc(tomb_rows)
+        scanstats.note("tombstones_applied", tombs_applied)
+        scanstats.note("tombstone_rows_masked", tomb_rows)
+    if retained_out:
+        scanstats.note("retention_rows_masked", retained_out)
+    return table.filter(pa.array(~drop))
+
+
+def build_series_matchers(
+    metric_id: int, tsids: "list[int] | None"
+) -> tuple[tuple[str, tuple[int, ...] | None], ...]:
+    """The metric-engine delete shape: one metric, optionally a resolved
+    TSID set (None = every series of the metric)."""
+    ensure(metric_id >= 0, "metric_id must be non-negative")
+    return (
+        ("metric_id", (int(metric_id),)),
+        ("tsid", None if tsids is None else tuple(int(t) for t in sorted(tsids))),
+    )

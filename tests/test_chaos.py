@@ -655,3 +655,160 @@ class TestCrashBetweenUploadAndCommit:
         assert len(orphans) > len(committed)
         assert ORPHAN_SSTS_GC.labels("db/data").value > gc0
         await eng2.close()
+
+
+class TestDirtyTrafficChaosSoak:
+    """The dirty-traffic lane: LATE (multi-segment out-of-order), DUPLICATE
+    (last-writer-wins overwrites), and DELETED (tombstone) data interleaved
+    under the same seeded fault plan as the base soak, with a mid-soak
+    crash (abandon without close) + reopen. Invariants at every
+    checkpoint: query results EXACTLY match the host model (before and
+    after compaction), deletes stay deleted across the reopen, and a
+    series-cardinality breach degrades to the counted partial-accept —
+    never a hang, never silent loss of in-budget samples."""
+
+    @async_test
+    async def test_dirty_soak_exact_with_deletes_crash_and_limit(self):
+        from horaedb_tpu.ingest.cardinality import CardinalityLimited
+
+        inner = MemStore()
+        chaos = ChaosStore(inner, FaultPlan(
+            seed=20260804,
+            ops={
+                "put": OpFaults(error_rate=0.10, torn_write_rate=0.06,
+                                lost_ack_rate=0.03),
+                "get": OpFaults(error_rate=0.06),
+                "list": OpFaults(error_rate=0.06),
+                "delete": OpFaults(error_rate=0.08),
+            },
+            visibility_lag_ops=6,
+        ))
+        store = ResilientStore(
+            chaos, retry=fast_retry(attempts=10),
+            breaker=BreakerPolicy(failure_threshold=5, open_for=ms(50)),
+            name="dirty-soak",
+        )
+        eng = await open_chaos_engine(store, max_series=40)
+        model: dict = {}
+        deleted_keys: set = set()
+
+        async def delete_acked(e, host: str, start: int, end: int) -> None:
+            """Tombstone delete with sender-style retries; fold into the
+            model only once acked. Retried deletes are idempotent (an
+            extra tombstone record with the same predicate)."""
+            last = None
+            for _ in range(30):
+                try:
+                    await e.delete_series(
+                        b"chaos", filters=[(b"host", host.encode())],
+                        start_ms=start, end_ms=end,
+                    )
+                except (InjectedFault, UnavailableError) as exc:
+                    last = exc
+                    continue
+                for (h, ts) in [k for k in model
+                                if k[0] == host and start <= k[1] < end]:
+                    del model[(h, ts)]
+                    deleted_keys.add((h, ts))
+                return
+            raise AssertionError(f"delete never acked: {last}")
+
+        def round_series(rnd: int) -> dict:
+            cur = 6 * HOUR + rnd * 10_000
+            series = {
+                f"h{rnd % 3}": [(cur + i * 100, float(rnd * 10 + i))
+                                for i in range(4)],
+                f"g{rnd % 2}": [(cur + i * 100, float(rnd)) for i in range(2)],
+            }
+            if rnd >= 1:
+                # DUPLICATES: overwrite two points from the previous round
+                # (later ack must win) ...
+                prev = 6 * HOUR + (rnd - 1) * 10_000
+                series[f"h{(rnd - 1) % 3}"] = [
+                    (prev + i * 100, float(1000 + rnd)) for i in range(2)
+                ]
+                # ... and LATE data: a lagging agent several SEGMENTS
+                # behind, plus a backfill correction of an old point
+                series[f"h{rnd % 3}"] = (
+                    series[f"h{rnd % 3}"]
+                    + [(cur - 5 * HOUR + rnd * 7, float(-rnd)),
+                       (cur - 2 * HOUR + rnd * 3, float(-2 * rnd))]
+                )
+            return series
+
+        for rnd in range(12):
+            await write_acked(eng, model, round_series(rnd))
+            if rnd == 5:
+                # delete one host's recent window (tombstone), then write
+                # INTO the deleted range — post-delete rows must survive
+                await delete_acked(eng, "h2", 6 * HOUR, 7 * HOUR)
+                await write_acked(eng, model,
+                                  {"h2": [(6 * HOUR + 50_123, 777.0)]})
+            if rnd % 4 == 3:
+                await flush_retrying(eng)
+                try:
+                    await eng.compact()
+                    await eng.data_table.compaction_scheduler.executor.drain()
+                except Exception:  # noqa: BLE001 — faulted compactions
+                    pass           # re-pick later; never lose the soak
+            got = await query_model(eng)
+            assert got == model, f"round {rnd}: engine diverged from model"
+
+        # ---- mid-soak crash + reopen (deletes must stay deleted)
+        await flush_retrying(eng)
+        pre_crash = dict(model)
+        await crash(eng)
+        del eng
+        chaos.settle()
+        eng2 = await open_chaos_engine(store, max_series=40)
+        got2 = await query_model(eng2)
+        assert got2 == pre_crash
+        # deletes stay deleted across the reopen (tombstones are durable
+        # manifest-level records): every deleted-and-never-rewritten key is
+        # absent, while post-delete re-ingests into the window survive
+        gone = deleted_keys - set(pre_crash)
+        assert gone and not gone & set(got2)
+        assert ("h2", 6 * HOUR + 50_123) in got2
+
+        # ---- keep soaking dirty traffic after recovery
+        for rnd in range(12, 20):
+            await write_acked(eng2, model, round_series(rnd))
+        await flush_retrying(eng2)
+        try:
+            await eng2.compact()
+            await eng2.data_table.compaction_scheduler.executor.drain()
+        except Exception:  # noqa: BLE001
+            pass
+        assert await query_model(eng2) == model
+
+        # ---- cardinality breach degrades to the counted partial-accept
+        from horaedb_tpu.engine.engine import CARD_LIMITED_REQUESTS
+
+        flood = {f"x{i:03d}": [(8 * HOUR + i, 1.0)] for i in range(60)}
+        await write_acked(eng2, model, flood)  # crosses the limit
+        limited0 = CARD_LIMITED_REQUESTS.labels(eng2._table_label).value
+        over = payload_for({
+            "h0": [(8 * HOUR + 9999, 7.0)],
+            "znew1": [(8 * HOUR + 1, 1.0)],
+            "znew2": [(8 * HOUR + 2, 2.0)],
+        })
+        limited = None
+        for _ in range(30):
+            try:
+                await eng2.write_parsed(PooledParser.decode(over))
+            except CardinalityLimited as e:
+                limited = e
+                break
+            except (InjectedFault, UnavailableError):
+                continue
+        assert limited is not None, "limit breach never surfaced"
+        assert limited.rejected_series == 2
+        assert limited.accepted_samples == 1  # existing-series sample landed
+        assert limited.retry_after_s and limited.retry_after_s > 0
+        assert CARD_LIMITED_REQUESTS.labels(eng2._table_label).value \
+            > limited0
+        model[("h0", 8 * HOUR + 9999)] = 7.0  # the partial accept is durable
+        await flush_retrying(eng2)
+        assert await query_model(eng2) == model
+        assert chaos.injected_errors > 0  # the plan actually fired
+        await eng2.close()

@@ -1,0 +1,486 @@
+"""Dirty-traffic hardening: out-of-order/backfill ingest, duplicate
+last-writer-wins semantics, retention + tombstone deletes, and the
+series-cardinality defense — each pinned EXACT against a host model.
+
+These are the deterministic unit/integration pins; the adversarial
+environment version (late/dup/deleted data under injected store faults
+with mid-soak crash/reopen) lives in tests/test_chaos.py.
+"""
+
+import asyncio
+import types
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common.time_ext import ReadableDuration, now_ms
+from horaedb_tpu.engine import MetricEngine, QueryRequest
+from horaedb_tpu.ingest import PooledParser
+from horaedb_tpu.ingest.cardinality import (
+    CardinalityLimited,
+    SeriesSketch,
+    mix_series_hash,
+)
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.storage import scanstats
+from horaedb_tpu.storage.config import SchedulerConfig, StorageConfig
+from tests.conftest import async_test
+from tests.test_flush_pipeline import make_remote_write
+
+HOUR = 3_600_000
+
+
+def compactable_cfg(**kw) -> StorageConfig:
+    kw.setdefault("input_sst_min_num", 2)
+    return StorageConfig(scheduler=SchedulerConfig(**kw))
+
+
+async def open_engine(store, **kw):
+    kw.setdefault("segment_duration_ms", HOUR)
+    kw.setdefault("enable_compaction", True)
+    kw.setdefault("config", compactable_cfg())
+    return await MetricEngine.open("db", store, **kw)
+
+
+async def write(eng, series: dict[str, list[tuple[int, float]]],
+                metric: str = "dirty") -> None:
+    payload = make_remote_write([
+        ({"__name__": metric, "host": host}, samples)
+        for host, samples in sorted(series.items())
+    ])
+    await eng.write_parsed(PooledParser.decode(payload))
+
+
+async def engine_rows(eng, metric: str = "dirty",
+                      end_ms: int = 2**60) -> dict:
+    """(host, ts) -> value as the engine answers the raw query."""
+    t = await eng.query(QueryRequest(
+        metric=metric.encode(), start_ms=0, end_ms=end_ms
+    ))
+    if t is None:
+        return {}
+    labels = await eng.match_series(metric.encode(), [], [])
+    host_of = {tsid: labs[b"host"].decode() for tsid, labs in labels.items()}
+    out = {}
+    for tsid, ts, v in zip(t.column("tsid").to_pylist(),
+                           t.column("ts").to_pylist(),
+                           t.column("value").to_pylist()):
+        out[(host_of[int(tsid)], ts)] = v
+    return out
+
+
+async def compact_and_drain(eng) -> None:
+    sched = eng.data_table.compaction_scheduler
+    sched.pick_once()
+    # let the recv loop hand the queued task to the executor
+    for _ in range(200):
+        await asyncio.sleep(0.01)
+        if not sched._tasks.qsize():
+            break
+    await sched.executor.drain()
+
+
+class TestDuplicateLastWriterWins:
+    @async_test
+    async def test_dedup_exact_at_scan_and_compaction_time(self):
+        """The pinned duplicate-sample contract: overwrites of the same
+        (series, ts) resolve last-writer-wins-by-seq — EXACTLY the same
+        answer from the scan-time merge over overlapping SSTs
+        (pre-compaction) and from the physically merged post-compaction
+        SST, both equal to the host model."""
+        eng = await open_engine(MemStore(), ingest_buffer_rows=0)
+        model: dict = {}
+        # three generations of overlapping writes, each its own SST,
+        # re-writing a subset of (host, ts) keys with new values
+        for gen in range(3):
+            series = {
+                "a": [(1000 + 100 * i, float(gen * 10 + i)) for i in range(4)],
+                "b": [(1000 + 100 * i, float(-gen - i)) for i in range(2)],
+            }
+            await write(eng, series)
+            for host, samples in series.items():
+                for ts, v in samples:
+                    model[(host, ts)] = v
+        # pre-compaction: the scan-time merge over overlapping SSTs
+        assert len(eng.data_table.manifest.all_ssts()) >= 3
+        assert await engine_rows(eng) == model
+        # compaction-time: the physically merged output answers identically
+        await compact_and_drain(eng)
+        assert len(eng.data_table.manifest.all_ssts()) < 3
+        assert await engine_rows(eng) == model
+        await eng.close()
+
+    @async_test
+    async def test_same_memtable_duplicates_latest_append_wins(self):
+        """Duplicates buffered into ONE memtable share a pinned seq; the
+        in-file row order must resolve them to the LAST append."""
+        eng = await open_engine(MemStore(), ingest_buffer_rows=10_000,
+                                enable_compaction=False)
+        await write(eng, {"a": [(1000, 1.0)]})
+        await write(eng, {"a": [(1000, 2.0)]})
+        await write(eng, {"a": [(1000, 3.0)]})
+        assert await engine_rows(eng) == {("a", 1000): 3.0}
+        await eng.close()
+
+
+class TestOutOfOrderIngest:
+    @async_test
+    async def test_late_samples_route_to_partitions_and_read_exact(self):
+        """Backfill/late samples: counted in horaedb_late_samples_total,
+        flushed as per-segment SSTs, and reads stay exact across the
+        in-order + late mix before AND after compaction."""
+        from horaedb_tpu.engine.data import LATE_SAMPLES
+
+        eng = await open_engine(MemStore(), ingest_buffer_rows=100_000)
+        table_id = eng.sample_mgr._table_id
+        late0 = LATE_SAMPLES.labels(table_id).value
+        model: dict = {}
+        now = 6 * HOUR
+
+        async def w(series):
+            await write(eng, series)
+            for host, samples in series.items():
+                for ts, v in samples:
+                    model[(host, ts)] = v
+
+        # in-order traffic establishes the watermark
+        await w({"a": [(now + i * 1000, float(i)) for i in range(4)]})
+        assert LATE_SAMPLES.labels(table_id).value == late0
+        # a lagging agent: samples 2 and 5 hours late (two distinct old
+        # segments) interleaved with current ones
+        await w({"a": [(now - 2 * HOUR, 21.0), (now + 5000, 5.0),
+                       (now - 5 * HOUR, 51.0)],
+                 "b": [(now - 2 * HOUR + 7, 22.0)]})
+        assert LATE_SAMPLES.labels(table_id).value == late0 + 3
+        # reads are exact BEFORE any flush (union of memtable partitions)
+        assert await engine_rows(eng) == model
+        await eng.flush()
+        # each late partition flushed as its own per-segment SST
+        segs = {
+            s.meta.time_range.start - s.meta.time_range.start % HOUR
+            for s in eng.data_table.manifest.all_ssts()
+        }
+        assert {now - 2 * HOUR - (now - 2 * HOUR) % HOUR,
+                now - 5 * HOUR - (now - 5 * HOUR) % HOUR,
+                now - now % HOUR} <= segs
+        assert await engine_rows(eng) == model
+        # a late DUPLICATE (backfill correcting an old point) still wins
+        await w({"a": [(now - 2 * HOUR, 99.0)]})
+        assert await engine_rows(eng) == model
+        await eng.flush()
+        await compact_and_drain(eng)
+        assert await engine_rows(eng) == model
+        await eng.close()
+
+    @async_test
+    async def test_buffer_request_routes_late_rows_out_of_columnar_memtable(self):
+        """Unit pin on the hash-lane columnar path: late rows land in the
+        per-segment late buffers (`_buf`), in-order rows in the columnar
+        memtable — so the drain's O(n) monotone fast path survives a
+        backfill trickle."""
+        eng = await open_engine(MemStore(), ingest_buffer_rows=100_000,
+                                enable_compaction=False)
+        mgr = eng.sample_mgr
+        metric_arr = np.array([11, 12], dtype=np.uint64)
+        tsid_arr = np.array([21, 22], dtype=np.uint64)
+        now = 6 * HOUR
+
+        def req(ts_list, series_list):
+            return types.SimpleNamespace(
+                sample_ts=np.array(ts_list, dtype=np.int64),
+                sample_series=np.array(series_list, dtype=np.int64),
+                sample_value=np.arange(len(ts_list), dtype=np.float64),
+            )
+
+        await mgr.buffer_request(metric_arr, tsid_arr, req([now, now + 1], [0, 1]))
+        assert mgr._buf == {} and mgr._fill == 2
+        await mgr.buffer_request(
+            metric_arr, tsid_arr,
+            req([now + 2, now - 3 * HOUR, now - 5 * HOUR], [0, 1, 1]),
+        )
+        # 2 late rows routed out, 1 in-order row appended in place
+        assert mgr._fill == 3
+        assert set(mgr._buf) == {
+            (now - 3 * HOUR) - (now - 3 * HOUR) % HOUR,
+            (now - 5 * HOUR) - (now - 5 * HOUR) % HOUR,
+        }
+        assert mgr.buffered_rows == 5
+        await eng.close()
+
+
+class TestRetention:
+    @async_test
+    async def test_scan_time_masking_is_row_exact_with_provenance(self):
+        """Retention is exact at SCAN time: whole-SST pruning (with
+        ssts_retention_pruned provenance) plus row masking inside SSTs
+        that straddle the horizon — before compaction ever runs."""
+        # one giant segment so a single write may hold rows on BOTH sides
+        # of the horizon (a straddling SST, deterministically)
+        eng = await open_engine(
+            MemStore(), ingest_buffer_rows=0, segment_duration_ms=2**50,
+            retention_period_ms=ReadableDuration.hours(1).as_millis(),
+        )
+        now = now_ms()
+        # one wholly-expired SST, one straddling SST (old + fresh row in
+        # one write), one fresh SST
+        await write(eng, {"a": [(now - 3 * HOUR, 1.0)]})
+        await write(eng, {"a": [(now - 2 * HOUR, 2.0), (now - 60_000, 3.0)]})
+        await write(eng, {"a": [(now - 30_000, 4.0)]})
+        with scanstats.scan_stats() as st:
+            got = await engine_rows(eng)
+        assert got == {("a", now - 60_000): 3.0, ("a", now - 30_000): 4.0}
+        counts = dict(st.counts)
+        assert counts.get("ssts_retention_pruned", 0) >= 1
+        assert counts.get("retention_rows_masked", 0) >= 1
+        await eng.close()
+
+    @async_test
+    async def test_expired_only_compaction_task_reclaims_quiet_tables(self):
+        """A quiet table (too few files for a merge pick) still expires:
+        the scheduler builds an expired-only delete task instead of
+        waiting for the reference picker's merge-qualify quirk."""
+        store = MemStore()
+        eng = await open_engine(
+            store, ingest_buffer_rows=0,
+            config=compactable_cfg(input_sst_min_num=5),
+            retention_period_ms=ReadableDuration.hours(1).as_millis(),
+        )
+        now = now_ms()
+        await write(eng, {"a": [(now - 3 * HOUR, 1.0)]})
+        await write(eng, {"a": [(now - 60_000, 2.0)]})
+        assert len(eng.data_table.manifest.all_ssts()) == 2
+        sched = eng.data_table.compaction_scheduler
+        assert sched.pick_once() is True  # expired-only task
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if not sched._tasks.qsize():
+                break
+        await sched.executor.drain()
+        live = eng.data_table.manifest.all_ssts()
+        assert len(live) == 1
+        assert live[0].meta.time_range.start >= now - HOUR
+        # the expired object is physically gone
+        dead = [p for p in store._objects
+                if p.startswith("db/data/data/") and p.endswith(".sst")]
+        assert len(dead) == 1
+        await eng.close()
+
+
+class TestTombstoneDeletes:
+    @async_test
+    async def test_delete_masks_now_compacts_later_survives_reopen(self):
+        """The delete lifecycle end to end: series-matcher + time-range
+        delete masks at scan time immediately, post-delete writes into the
+        range survive, compaction physically removes the rows from the
+        rewritten SST bytes, and the delete holds across engine reopen."""
+        store = MemStore()
+        eng = await open_engine(store, ingest_buffer_rows=0)
+        model: dict = {}
+
+        async def w(series):
+            await write(eng, series)
+            for host, samples in series.items():
+                for ts, v in samples:
+                    model[(host, ts)] = v
+
+        await w({"a": [(1000, 1.0), (2000, 2.0), (9000, 9.0)],
+                 "b": [(1000, 10.0), (2000, 20.0)]})
+        await w({"a": [(3000, 3.0)], "b": [(3000, 30.0)]})
+        # delete host=a samples in [0, 5000)
+        res = await eng.delete_series(
+            b"dirty", filters=[(b"host", b"a")], start_ms=0, end_ms=5000
+        )
+        assert res["matched_series"] == 1 and res["tombstones"] == 2
+        for ts in (1000, 2000, 3000):
+            del model[("a", ts)]
+        with scanstats.scan_stats() as st:
+            assert await engine_rows(eng) == model
+        assert dict(st.counts).get("tombstones_applied", 0) >= 1
+        # re-ingest into the deleted range AFTER the delete: survives
+        await w({"a": [(2000, 222.0)]})
+        assert await engine_rows(eng) == model
+        # compaction physically removes the masked rows
+        await compact_and_drain(eng)
+        assert await engine_rows(eng) == model
+        import io
+
+        import pyarrow.parquet as pq
+
+        a_tsid = {
+            labs[b"host"]: tsid
+            for tsid, labs in (await eng.match_series(b"dirty", [], [])).items()
+        }[b"a"]
+        live = {s.id for s in eng.data_table.manifest.all_ssts()}
+        physical = set()
+        for fid in live:
+            blob = store._objects[f"db/data/data/{fid}.sst"]
+            t = pq.read_table(io.BytesIO(blob))
+            for tsid, ts in zip(t.column("tsid").to_pylist(),
+                                t.column("ts").to_pylist()):
+                physical.add((int(tsid), ts))
+        assert (a_tsid, 1000) not in physical
+        assert (a_tsid, 3000) not in physical
+        assert (a_tsid, 2000) in physical  # the post-delete re-ingest
+        assert (a_tsid, 9000) in physical  # outside the deleted range
+        # deletes survive reopen (tombstones are manifest-level objects)
+        await eng.close()
+        eng2 = await open_engine(store, ingest_buffer_rows=0)
+        assert await engine_rows(eng2) == model
+        await eng2.close()
+
+    @async_test
+    async def test_tombstone_gc_when_no_live_sst_overlaps(self):
+        """A tombstone outlives its purpose once no live SST overlaps its
+        range — compaction's GC drops the record and its object."""
+        store = MemStore()
+        eng = await open_engine(store, ingest_buffer_rows=0)
+        await write(eng, {"a": [(1000, 1.0)]})
+        await eng.delete_series(b"dirty", start_ms=0, end_ms=5000)
+        man = eng.data_table.manifest
+        assert len(man.all_tombstones()) == 1
+        assert await man.gc_tombstones() == 0  # live SST still overlaps
+        # drop the overlapping SST (as retention/compaction would)
+        await man.update([], [s.id for s in man.all_ssts()])
+        assert await man.gc_tombstones() == 1
+        assert man.all_tombstones() == []
+        assert not [p for p in store._objects
+                    if "/manifest/tombstone/" in p and p.startswith("db/data/")]
+        await eng.close()
+
+
+class TestCardinalityDefense:
+    def test_sketch_accuracy_and_determinism(self):
+        rng = np.random.default_rng(7)
+        mids = rng.integers(0, 2**63, 20_000, dtype=np.int64).astype(np.uint64)
+        tsids = rng.integers(0, 2**63, 20_000, dtype=np.int64).astype(np.uint64)
+        s = SeriesSketch()
+        s.add_pairs(mids, tsids)
+        est = s.estimate()
+        assert abs(est - 20_000) / 20_000 < 0.05
+        # idempotent: re-adding the same series changes nothing
+        assert s.add_pairs(mids, tsids) is False
+        assert s.estimate() == est
+        # small-range regime is near-exact (the limit-check regime)
+        s2 = SeriesSketch()
+        s2.add_pairs(mids[:100], tsids[:100])
+        assert abs(s2.estimate() - 100) < 2
+        # the mix actually separates metric_id: same tsid set under two
+        # metrics is twice the series
+        s3 = SeriesSketch()
+        s3.add_pairs(np.full(50, 1, np.uint64), tsids[:50])
+        s3.add_pairs(np.full(50, 2, np.uint64), tsids[:50])
+        assert abs(s3.estimate() - 100) < 2
+        h1 = mix_series_hash(mids[:10], tsids[:10])
+        assert (h1 == mix_series_hash(mids[:10], tsids[:10])).all()
+
+    @async_test
+    async def test_limit_partial_accept_and_counters(self):
+        """At the limit: new series rejected with the typed partial-accept
+        (503/Retry-After at the HTTP layer), existing-series samples in
+        the SAME request accepted and durable, counters fed, the index
+        never bloats."""
+        from horaedb_tpu.engine.engine import (
+            CARD_LIMITED_REQUESTS,
+            CARD_REJECTED_SAMPLES,
+            CARD_REJECTED_SERIES,
+        )
+
+        store = MemStore()
+        eng = await open_engine(store, ingest_buffer_rows=0, max_series=3,
+                                enable_compaction=False)
+        label = eng._table_label
+        rej_samples0 = CARD_REJECTED_SAMPLES.labels(label).value
+        rej_series0 = CARD_REJECTED_SERIES.labels(label).value
+        req0 = CARD_LIMITED_REQUESTS.labels(label).value
+        await write(eng, {f"h{i}": [(1000, float(i))] for i in range(3)})
+        model = {(f"h{i}", 1000): float(i) for i in range(3)}
+        assert await engine_rows(eng) == model
+        # over the limit: 2 new series + 1 existing in one request
+        with pytest.raises(CardinalityLimited) as ei:
+            await write(eng, {
+                "h0": [(2000, 9.0)],
+                "new1": [(2000, 1.0)], "new2": [(2000, 2.0), (3000, 3.0)],
+            })
+        e = ei.value
+        assert e.accepted_samples == 1
+        assert e.rejected_samples == 3
+        assert e.rejected_series == 2
+        assert e.retry_after_s and e.retry_after_s > 0
+        # the existing-series sample IS durable; new series never registered
+        model[("h0", 2000)] = 9.0
+        assert await engine_rows(eng) == model
+        mid = eng.metric_mgr.get(b"dirty")[0]
+        assert len(eng.index_mgr.series_of(mid)) == 3
+        assert CARD_REJECTED_SAMPLES.labels(label).value == rej_samples0 + 3
+        assert CARD_REJECTED_SERIES.labels(label).value == rej_series0 + 2
+        assert CARD_LIMITED_REQUESTS.labels(label).value == req0 + 1
+        # the 503 mapping: CardinalityLimited IS an UnavailableError
+        from horaedb_tpu.server.errors import unavailable_response
+
+        r = unavailable_response(e)
+        assert r.status == 503 and int(r.headers["Retry-After"]) >= 1
+        await eng.close()
+        # the sketch reseeds from the index at reopen: still at the limit
+        eng2 = await open_engine(store, ingest_buffer_rows=0, max_series=3,
+                                 enable_compaction=False)
+        assert eng2._sketch.estimate() >= 3
+        with pytest.raises(CardinalityLimited):
+            await write(eng2, {"new3": [(5000, 1.0)]})
+        # in-budget traffic still flows
+        await write(eng2, {"h1": [(5000, 5.0)]})
+        model[("h1", 5000)] = 5.0
+        assert await engine_rows(eng2) == model
+        await eng2.close()
+
+    @async_test
+    async def test_gauge_exported_without_limit(self):
+        """max_series=0: no enforcement, but the sketch still runs and
+        exports horaedb_series_cardinality."""
+        from horaedb_tpu.engine.engine import SERIES_CARDINALITY
+
+        eng = await open_engine(MemStore(), ingest_buffer_rows=0,
+                                enable_compaction=False)
+        await write(eng, {f"h{i}": [(1000, 1.0)] for i in range(5)})
+        assert SERIES_CARDINALITY.labels(eng._table_label).value == 5
+        await eng.close()
+
+
+class TestRegionedCardinality:
+    @async_test
+    async def test_fanout_partial_accept_aggregates_accounting(self):
+        """Regioned write splitting across regions: a limit breach in one
+        region must SETTLE every sibling region's write before raising,
+        and the combined CardinalityLimited carries request-level
+        accounting (all accepted samples, all rejected series) — not one
+        region's slice."""
+        from horaedb_tpu.engine.region import RegionedEngine
+
+        eng = await RegionedEngine.open(
+            "rd", MemStore(), num_regions=2,
+            segment_duration_ms=HOUR, enable_compaction=False,
+            ingest_buffer_rows=0, max_series=3,
+        )
+        # fill: 8 series in one payload — the gate engages only on the
+        # NEXT new series (estimate was 0 pre-registration), so both
+        # regions end up over their limit
+        fill = {f"r{i}": [(1000, float(i))] for i in range(8)}
+        await write(eng, fill)
+        model = {(f"r{i}", 1000): float(i) for i in range(8)}
+        assert await engine_rows(eng) == model
+        # 2 existing + 2 brand-new series: whichever region(s) the new
+        # ones route to reject them; the combined accounting must cover
+        # the WHOLE request
+        with pytest.raises(CardinalityLimited) as ei:
+            await write(eng, {
+                "r0": [(2000, 10.0)], "r1": [(2000, 11.0)],
+                "zz1": [(2000, 1.0)], "zz2": [(2000, 2.0)],
+            })
+        e = ei.value
+        assert e.accepted_samples == 2
+        assert e.rejected_series == 2
+        assert e.rejected_samples == 2
+        # the accepted existing-series samples are durable in BOTH regions
+        model[("r0", 2000)] = 10.0
+        model[("r1", 2000)] = 11.0
+        assert await engine_rows(eng) == model
+        await eng.close()

@@ -696,8 +696,11 @@ class TestEngineRetention:
                     [({"__name__": "ret", "host": tag},
                       [(ts_base + i, float(i))])]
                 )))
+        # scan-time retention (storage/visibility.py): expired rows are
+        # masked IMMEDIATELY, before any compaction runs — retention is
+        # exact from the moment the horizon passes, not eventually
         t = await eng.query(QueryRequest(metric=b"ret", start_ms=0, end_ms=2**60))
-        assert t.num_rows == 6
+        assert t.num_rows == 3
         eng.data_table.compaction_scheduler.pick_once()
         for _ in range(200):
             ssts = eng.data_table.manifest.all_ssts()

@@ -522,3 +522,78 @@ class TestJ009StoreBoundary:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ010VisibilityBoundary:
+    """J010: tombstone/retention row filtering is ONE shared helper
+    (storage/visibility.apply_visibility). Consuming the visibility
+    state's row-filtering fields anywhere else is an ad-hoc per-reader
+    filter waiting to diverge between scan routes and compaction."""
+
+    def seeded(self, tmp_path, body, rel="storage/seeded.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_adhoc_filter_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def my_reader_filter(table, vis, ts):\n"
+            "    keep = ts >= (vis.retention_floor_ms or 0)\n"   # J010
+            "    for t in vis.tombstones:\n"                     # J010
+            "        keep &= ts < t.time_range.start\n"
+            "    return table.filter(keep)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 2, r.stdout
+        assert r.stdout.count("J010") == 2, r.stdout
+        assert "apply_visibility" in r.stdout
+
+    def test_shared_helper_module_exempt(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def apply_visibility(table, vis):\n"
+            "    floor = vis.retention_floor_ms\n"
+            "    return floor, list(vis.tombstones)\n",
+            rel="storage/visibility.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_manifest_store_exempt(self, tmp_path):
+        """The manifest package persists/loads/GCs the records — storing
+        the state is not filtering rows with it."""
+        f = self.seeded(
+            tmp_path,
+            "def gc(self, live):\n"
+            "    return [t for t in self.tombstones if t.id in live]\n",
+            rel="storage/manifest/seeded.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_construction_not_flagged(self, tmp_path):
+        """Building a Visibility (keyword args) is producing the state,
+        not consuming it — only attribute loads are flagged."""
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.storage.visibility import Visibility\n"
+            "\n"
+            "def build(tombs, floor):\n"
+            "    return Visibility(table='t', time_column='ts',\n"
+            "                      tombstones=tuple(tombs),\n"
+            "                      retention_floor_ms=floor)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def debug_dump(vis):\n"
+            "    # jaxlint: disable=J010 admin introspection dump, filters no rows\n"
+            "    return [t.id for t in vis.tombstones]\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

@@ -555,7 +555,7 @@ data_dir = "{tmp_path}/data"
 EXPLAIN_KEYS = {
     "mode", "regions", "ssts", "scan_paths", "agg_impl", "agg_impls",
     "stages_s", "lanes_s", "bound", "compile_s", "steady_s", "counts",
-    "kernels",
+    "kernels", "tombstones_applied", "tombstone_rows_masked",
 }
 EXPLAIN_LANES = {"io", "host", "transfer", "kernel", "compile"}
 
@@ -581,7 +581,9 @@ class TestExplain:
                 assert plan["mode"] == mode
                 assert EXPLAIN_LANES <= set(plan["lanes_s"])
                 assert set(plan["ssts"]) == {"selected", "read",
-                                             "bloom_pruned", "unavailable"}
+                                             "bloom_pruned",
+                                             "retention_pruned",
+                                             "unavailable"}
                 assert isinstance(plan["compile_s"], (int, float))
                 assert isinstance(plan["steady_s"], (int, float))
                 assert plan["regions"] >= 1

@@ -105,6 +105,21 @@ def main() -> int:
               "ingest lane: pure samples/s missing/zero")
         check(result.get("ingest_with_flush_samples_per_sec", 0) > 0,
               "ingest lane: with-flush samples/s missing/zero")
+        # dirty-traffic lanes: the out-of-order-ratio knob must report all
+        # three ratios, and the cardinality sketch's per-series cost must
+        # stay a rounding error against the ~110 ns/sample ingest budget
+        # (10 samples/series in the bench shape -> 1100 ns/series of
+        # budget; 1000 ns is already alarm-worthy on any box)
+        ooo = result.get("ingest_ooo_samples_per_sec") or {}
+        check(set(ooo) == {"0", "5", "25"}
+              and all(v > 0 for v in ooo.values()),
+              f"ingest ooo lanes missing/zero: {ooo}")
+        check("ingest_ooo_overhead_pct" in result,
+              "ingest ooo overhead missing")
+        sketch_ns = result.get("cardinality_sketch_ns_per_series", 0)
+        check(0 < sketch_ns < 1000,
+              f"cardinality sketch overhead out of budget: "
+              f"{sketch_ns} ns/series (budget <1000)")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")

@@ -98,6 +98,9 @@ async def server_phase(check) -> None:
         "metric_engine": {
             "storage": {"object_store": {"data_dir": scratch}},
             "ingest_buffer_rows": 16,
+            # dirty-traffic lane: the series-cardinality limit the breach
+            # check below crosses (ingest/cardinality.py)
+            "limits": {"max_series": 30},
         },
     })
     app = await build_app(cfg, store=store)
@@ -181,6 +184,124 @@ async def server_phase(check) -> None:
             check(total_retries > 0,
                   f"injected faults produced counted retries "
                   f"({int(total_retries)})")
+
+            # ---- dirty-traffic lane: duplicates, late data, a tombstone
+            # delete, and a cardinality breach — all over the SAME faulted
+            # store, asserted exact against the host model
+            host_of: dict[int, str] = {}
+            for rnd in range(8):
+                for i in range(6):
+                    host_of[1000 + rnd * 10_000 + i * 100] = f"h{i % 3}"
+
+            async def query_map() -> dict:
+                async with s.post(f"{base}/api/v1/query", json={
+                    "metric": "chaos_smoke", "start_ms": 0,
+                    "end_ms": 10**12,
+                }) as r:
+                    body = await r.json()
+                return dict(zip(body.get("ts", []), body.get("value", [])))
+
+            # DUPLICATES: overwrite three existing points (LWW by seq)
+            dup_rows = [(host_of[ts], ts, 9_000.0 + ts) for ts in
+                        sorted(model)[:3]]
+            ok = await send_acked(make_payload("chaos_smoke", dup_rows))
+            check(ok, "duplicate overwrites acked under faults")
+            if ok:
+                for _h, ts, v in dup_rows:
+                    model[ts] = v
+            # LATE: a lagging agent 13+ hours behind (a SEGMENT older than
+            # the watermark at the default 12h segment duration)
+            late_ts = 50 * 3_600_000
+            head_rows = [("h9", late_ts + 14 * 3_600_000, 1.0)]
+            late_rows = [("h9", late_ts + i, float(i)) for i in range(3)]
+            for rows in (head_rows, late_rows):
+                ok = await send_acked(make_payload("chaos_smoke", rows))
+                check(ok, "late-lane write acked under faults")
+                if ok:
+                    for h, ts, v in rows:
+                        model[ts] = v
+                        host_of[ts] = h
+            got = await query_map()
+            check(got == model,
+                  "query matches model exactly with duplicates + late data")
+            # DELETE: tombstone one host's window through the admin API
+            del_end_ms = 100_000
+            for _ in range(40):
+                async with s.post(
+                    f"{base}/api/v1/admin/tsdb/delete_series",
+                    params={"match[]": 'chaos_smoke{host="h1"}',
+                            "start": "0", "end": str(del_end_ms // 1000)},
+                ) as r:
+                    if r.status == 200:
+                        body = await r.json()
+                        break
+                    await asyncio.sleep(0.01)
+            check(r.status == 200 and body.get("status") == "success",
+                  f"delete_series acked under faults ({body})")
+            deleted = [ts for ts, h in host_of.items()
+                       if h == "h1" and ts <= del_end_ms and ts in model]
+            check(len(deleted) > 0, "delete matched existing rows")
+            for ts in deleted:
+                del model[ts]
+            got = await query_map()
+            check(got == model,
+                  f"deletes mask immediately and exactly "
+                  f"({len(deleted)} rows gone)")
+            # post-delete re-ingest into the deleted window survives
+            re_ts = deleted[0]
+            ok = await send_acked(make_payload(
+                "chaos_smoke", [("h1", re_ts, 4_242.0)]
+            ))
+            if ok:
+                model[re_ts] = 4_242.0
+            got = await query_map()
+            check(ok and got == model,
+                  "post-delete re-ingest into the deleted range survives")
+            # CARDINALITY breach: flood past the limit, then expect the
+            # counted 503/Retry-After partial-accept (bounded latency, the
+            # existing-series sample still accepted)
+            flood = [(f"x{i:02d}", 900_000 + i, 1.0) for i in range(40)]
+            ok = await send_acked(make_payload("chaos_card", flood))
+            check(ok, "flood payload crossing the limit acked")
+            over = make_payload("chaos_smoke", [
+                (host_of[re_ts], re_ts, 4_243.0),   # existing series
+                ("brandnew1", 901_001, 1.0),
+                ("brandnew2", 901_002, 1.0),
+            ])
+            body = {}
+            for _ in range(40):
+                t0 = asyncio.get_running_loop().time()
+                async with s.post(f"{base}/api/v1/write", data=over) as r:
+                    elapsed = asyncio.get_running_loop().time() - t0
+                    body = await r.json()
+                    if r.status == 503 and body.get("partial_accept"):
+                        break
+                    await asyncio.sleep(0.01)
+            check(r.status == 503 and body.get("partial_accept") is True,
+                  f"cardinality breach answers 503 partial-accept ({body})")
+            check(body.get("rejected_series") == 2
+                  and body.get("accepted_samples") == 1,
+                  f"partial-accept accounting exact ({body})")
+            check(r.headers.get("Retry-After", "").isdigit(),
+                  "cardinality 503 carries Retry-After")
+            check(elapsed < 5.0,
+                  f"cardinality shed is bounded-latency ({elapsed:.2f}s)")
+            model[re_ts] = 4_243.0  # the accepted existing-series sample
+            got = await query_map()
+            check(got == model, "in-budget samples survive the breach")
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            for fam in ("horaedb_series_cardinality",
+                        "horaedb_late_samples_total",
+                        "horaedb_tombstones_applied_total",
+                        "horaedb_cardinality_rejected_samples_total"):
+                check(fam in text, f"/metrics exposes {fam}")
+            card_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith("horaedb_cardinality_limited_requests_total{")
+            ]
+            check(sum(float(ln.rsplit(" ", 1)[1]) for ln in card_lines) > 0,
+                  "cardinality rejections are counted")
     finally:
         await runner.cleanup()
         import shutil

@@ -61,6 +61,14 @@ Rules (see docs/static-analysis.md for rationale and examples):
         holds its measured 3x with-flush throughput while flush work
         runs on the flush executor through the storage layer; control-
         plane writes (descriptors, sidecars) suppress with the reason
+  J010  ad-hoc tombstone/retention row filtering on the scan path:
+        touching `Visibility.tombstones` / `.retention_floor_ms` outside
+        storage/visibility.py (the shared mask helper) or
+        storage/manifest/ (the record store) — every scan route, the
+        downsample pushdown, AND compaction must subtract the same rows
+        through apply_visibility, or deletes "mostly work" (one reader
+        filters, another resurrects). Harness/test fixtures that
+        introspect the records suppress with the reason
   J009  naked object-store construction outside objstore/: a concrete
         store (`MemStore`/`LocalStore`/`S3LikeStore`) built in engine
         code without being handed straight to a `ResilientStore(...)`
@@ -158,6 +166,18 @@ J008_EXEMPT = ("horaedb_tpu/engine/flush_executor.py",)
 # of scope — they deliberately build raw stores to inject faults.
 J009_MODULES = ("horaedb_tpu/",)
 J009_EXEMPT = ("horaedb_tpu/objstore/",)
+
+# J010: tombstone/retention filtering is ONE shared helper
+# (storage/visibility.py, funneled through ParquetReader.read_sst); any
+# other engine code touching the visibility state's row-filtering fields
+# is an ad-hoc reader filter waiting to diverge. The manifest package is
+# the record STORE (load/persist/GC) and is exempt.
+J010_MODULES = ("horaedb_tpu/",)
+J010_EXEMPT = (
+    "horaedb_tpu/storage/visibility.py",
+    "horaedb_tpu/storage/manifest/",
+)
+VISIBILITY_FIELDS = {"tombstones", "retention_floor_ms"}
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
 STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
@@ -702,6 +722,26 @@ def _check_store_boundary(tree: ast.Module, findings: list[Finding]) -> None:
             ))
 
 
+def _check_visibility_boundary(tree: ast.Module, findings: list[Finding]) -> None:
+    """J010: attribute access on the visibility state's row-filtering
+    fields (`.tombstones`, `.retention_floor_ms`) outside the shared
+    helper. Keyword construction (`Visibility(tombstones=...)`) and the
+    manifest's accessor methods (`all_tombstones()`) are deliberately NOT
+    flagged — building/storing the state is fine; CONSUMING it for row
+    filtering belongs in storage/visibility.apply_visibility alone."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in VISIBILITY_FIELDS:
+            findings.append(Finding(
+                node.lineno, "J010",
+                f"`.{node.attr}` consumed outside storage/visibility.py — "
+                "tombstone/retention row filtering must go through the "
+                "shared apply_visibility helper (one funnel for every "
+                "scan route, the downsample pushdown, and compaction), "
+                "or deletes diverge between readers; suppress with the "
+                "reason for harness introspection",
+            ))
+
+
 def _lock_attrs_of(cls: ast.ClassDef) -> set[str]:
     """Attribute names of locks this class OWNS (self._lock = Lock())."""
     out: set[str] = set()
@@ -891,6 +931,13 @@ def lint_file(path: Path) -> list[str]:
         (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
         for m in J009_EXEMPT
     )
+    in_j010_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J010_MODULES
+    ) and not any(
+        (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
+        for m in J010_EXEMPT
+    )
 
     idx = JitIndex()
     idx.visit(tree)
@@ -912,6 +959,8 @@ def lint_file(path: Path) -> list[str]:
         _check_append_hot_path(tree, findings)
     if in_j009_scope:
         _check_store_boundary(tree, findings)
+    if in_j010_scope:
+        _check_visibility_boundary(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

@@ -84,6 +84,17 @@ REQUIRED_FAMILIES = (
     "horaedb_objstore_gave_up_total",
     "horaedb_objstore_breaker_state",
     "horaedb_orphan_ssts_gc_total",
+    # dirty-traffic hardening families: all must render from boot (the
+    # engine/storage pre-register their children), counters move only
+    # when late/deleted/over-limit traffic arrives
+    "horaedb_series_cardinality",
+    "horaedb_late_samples_total",
+    "horaedb_tombstones_applied_total",
+    'horaedb_tombstones_applied_total{table="metrics/data",context="scan"',
+    "horaedb_tombstones_created_total",
+    "horaedb_cardinality_rejected_samples_total",
+    "horaedb_cardinality_rejected_series_total",
+    "horaedb_cardinality_limited_requests_total",
 )
 
 
@@ -176,6 +187,10 @@ async def run() -> int:
             # cross the threshold and take the BACKGROUND flush path
             "ingest_buffer_rows": 64,
             "ingest": {"flush_workers": 2, "flush_queue_max": 4},
+            # series-cardinality limit ([metric_engine.limits]): high
+            # enough for the base traffic (~44 series), crossed by the
+            # card_fill flood below so the partial-accept 503 fires
+            "limits": {"max_series": 60},
         },
     })
     app = await build_app(cfg)
@@ -282,6 +297,31 @@ async def run() -> int:
             async with s.post(f"{base}/api/v1/write",
                               data=make_payload_named("smoke_shed")) as r:
                 check(r.status == 200, "write recovers after breaker reset")
+            # ---- cardinality defense: flood past max_series, then a
+            # write carrying one EXISTING series + new ones must answer
+            # the counted 503/Retry-After partial-accept
+            # ~43 series exist (smoke_cpu a/b + 40 smoke_bulk hosts +
+            # smoke_shed); 22 more cross the 60 limit (the gate engages on
+            # the NEXT new series, not retroactively)
+            async with s.post(f"{base}/api/v1/write",
+                              data=make_bulk_payload(62, 1)) as r:
+                check(r.status == 200, "flood crossing the limit accepted")
+            over = make_bulk_payload(64, 1)  # 62 exist + 2 brand-new hosts
+            async with s.post(f"{base}/api/v1/write", data=over) as r:
+                body = await r.json()
+                check(r.status == 503 and body.get("partial_accept") is True,
+                      f"cardinality breach answers 503 partial-accept "
+                      f"(got {r.status}: {body})")
+                check(body.get("rejected_series") == 2
+                      and body.get("accepted_samples") == 62,
+                      f"partial-accept accounting exact ({body})")
+                check(r.headers.get("Retry-After", "").isdigit(),
+                      "cardinality 503 carries Retry-After")
+            # in-budget traffic still flows at the limit
+            async with s.post(f"{base}/api/v1/write",
+                              data=make_bulk_payload(40, 1)) as r:
+                check(r.status == 200,
+                      "existing-series write still 200 at the limit")
             async with s.get(f"{base}/metrics") as r:
                 text = await r.text()
         errors = validate(text)
