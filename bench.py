@@ -177,6 +177,99 @@ def ingest_lane(smoke: bool) -> dict:
     }
 
 
+def query_qps_lane(smoke: bool) -> dict:
+    """Closed-loop multi-client query lane through the admission
+    scheduler (server/admission.py) + engine: per concurrency level
+    (1/8/64 clients), QPS, p50/p99 latency, and the shed rate. The
+    scheduler is sized small (cap 4, queue 16) so the 64-client level
+    actually exercises shedding — the lane measures the DEGRADATION
+    contract (bounded latency + 503-class sheds), not just raw speed."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from horaedb_tpu.common.error import UnavailableError
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.pb import remote_write_pb2
+    from horaedb_tpu.server.admission import AdmissionController, run_query
+
+    n_series, n_samples = 100, 20
+
+    def payload() -> bytes:
+        req = remote_write_pb2.WriteRequest()
+        base = 1_700_000_000_000
+        for s in range(n_series):
+            series = req.timeseries.add()
+            for k, v in ((b"__name__", b"qps_cpu"),
+                         (b"host", f"host-{s:04d}".encode())):
+                lab = series.labels.add()
+                lab.name = k
+                lab.value = v
+            for i in range(n_samples):
+                smp = series.samples.add()
+                smp.timestamp = base + i * 1000
+                smp.value = float(s + i)
+        return req.SerializeToString()
+
+    wall_s = 0.4 if smoke else 2.0
+    levels = (1, 8, 64)
+
+    async def run() -> dict:
+        root = tempfile.mkdtemp(prefix="horaedb-bench-qps-")
+        store = LocalStore(root)
+        eng = await MetricEngine.open("db", store, enable_compaction=False)
+        out: dict[str, dict] = {}
+        try:
+            await eng.write_payload(payload())
+            await eng.flush()
+            base = 1_700_000_000_000
+            req = QueryRequest(
+                metric=b"qps_cpu", start_ms=base,
+                end_ms=base + n_samples * 1000, bucket_ms=5000,
+            )
+            cells = 4 * n_series
+            for clients in levels:
+                ctl = AdmissionController(
+                    max_concurrent=4, queue_max=16, queue_deadline_s=0.25,
+                )
+                lat: list[float] = []
+                sheds = 0
+
+                async def one_client():
+                    nonlocal sheds
+                    t_end = time.perf_counter() + wall_s
+                    while time.perf_counter() < t_end:
+                        t0 = time.perf_counter()
+                        try:
+                            await run_query(ctl, eng, req, cells=cells)
+                        except UnavailableError:
+                            sheds += 1
+                            await asyncio.sleep(0.002)  # client backoff
+                            continue
+                        lat.append(time.perf_counter() - t0)
+
+                t0 = time.perf_counter()
+                await asyncio.gather(*(one_client() for _ in range(clients)))
+                elapsed = time.perf_counter() - t0
+                lat.sort()
+                total = len(lat) + sheds
+                out[str(clients)] = {
+                    "qps": round(len(lat) / elapsed, 1),
+                    "p50_ms": round(lat[len(lat) // 2] * 1000, 2) if lat else None,
+                    "p99_ms": round(
+                        lat[max(0, int(len(lat) * 0.99) - 1)] * 1000, 2
+                    ) if lat else None,
+                    "shed_pct": round(100.0 * sheds / total, 1) if total else 0.0,
+                }
+        finally:
+            await eng.close()
+            shutil.rmtree(root, ignore_errors=True)
+        return out
+
+    return {"query_qps": asyncio.run(run())}
+
+
 def main() -> None:
     # Probe BEFORE touching jax in this process (jax.devices() itself hangs
     # on a wedged tunnel); on failure, force the CPU backend so the bench
@@ -428,6 +521,9 @@ def main() -> None:
     # ingest lane (overlapped ingest->flush pipeline): pure vs with-flush
     # samples/s ride the same JSON line (bench-smoke asserts them)
     result.update(ingest_lane(SMOKE))
+    # query QPS lane (admission scheduler): closed-loop p50/p99 vs
+    # concurrency at 1/8/64 clients + shed rate (bench-smoke asserts it)
+    result.update(query_qps_lane(SMOKE))
 
     # Last-chance accelerator retry, ONLY on the wedged-tunnel fallback
     # path (`not responsive`): the CPU fallback run itself took minutes —

@@ -73,6 +73,27 @@ class UnavailableError(RetryableError):
         self.retry_after_s = retry_after_s
 
 
+class DeadlineExceeded(HoraeError):
+    """The end-to-end deadline of the request driving this work expired
+    (common/deadline.py carries the token; every natural yield point of
+    the scan path checks it cooperatively).
+
+    Deliberately NOT Retryable: under the SAME (already expired) deadline
+    an identical retry cannot succeed — retry ladders must stop, not burn
+    budget on work nobody will read. The HTTP layer answers 504 with
+    partial-progress provenance (server/errors.py), distinct from the
+    503/Retry-After overload shed: a 503 says "back off and resend", a
+    504 says "your budget ran out; widen timeout= or narrow the query"."""
+
+    def __init__(self, msg: str, cause: BaseException | None = None,
+                 budget_s: float | None = None,
+                 elapsed_s: float | None = None, at: str = ""):
+        super().__init__(msg, cause=cause)
+        self.budget_s = budget_s
+        self.elapsed_s = elapsed_s
+        self.at = at
+
+
 def classify(exc: BaseException) -> str:
     """Map any exception to ``"retryable" | "persistent" | "fatal"``.
 
@@ -83,6 +104,9 @@ def classify(exc: BaseException) -> str:
     retryable without needing the marker class."""
     if isinstance(exc, FatalError):
         return "fatal"
+    if isinstance(exc, DeadlineExceeded):
+        # the deadline that killed the attempt also kills any retry of it
+        return "persistent"
     if isinstance(exc, RetryableError):
         return "retryable"
     if isinstance(exc, PersistentError):
@@ -112,14 +136,15 @@ def context(msg: str):
         yield
     except HoraeError as e:
         cls = HoraeError
-        if isinstance(e, (RetryableError, PersistentError, FatalError)):
+        if isinstance(e, (RetryableError, PersistentError, FatalError,
+                          DeadlineExceeded)):
             cls = type(e)
         try:
             wrapped = cls(msg, cause=e)
         except TypeError:  # exotic subclass __init__: keep the class's
             # nearest taxonomy ancestor rather than losing the class
             for base in (UnavailableError, RetryableError, PersistentError,
-                         FatalError):
+                         FatalError, DeadlineExceeded):
                 if isinstance(e, base):
                     wrapped = base(msg, cause=e)
                     break
@@ -127,6 +152,10 @@ def context(msg: str):
                 wrapped = HoraeError(msg, cause=e)
         if isinstance(e, UnavailableError) and isinstance(wrapped, UnavailableError):
             wrapped.retry_after_s = e.retry_after_s
+        if isinstance(e, DeadlineExceeded) and isinstance(wrapped, DeadlineExceeded):
+            wrapped.budget_s = e.budget_s
+            wrapped.elapsed_s = e.elapsed_s
+            wrapped.at = e.at
         raise wrapped from e
     except Exception as e:  # noqa: BLE001 - deliberate funnel
         raise HoraeError(msg, cause=e) from e

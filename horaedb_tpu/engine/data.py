@@ -499,6 +499,8 @@ class SampleManager:
         ex = self._executor
         if ex is None:
             return
+        from horaedb_tpu.common import deadline as deadline_ctx
+
         ex.kick_parked()
         sealed = self.seal()
         if sealed is not None:
@@ -510,7 +512,15 @@ class SampleManager:
             if parked is None:
                 return
             try:
-                await self._writeout_once(parked)
+                # the inline replay is durability work for ACKED rows and
+                # must not run under the CALLING QUERY's deadline (the
+                # barrier runs in the query task when a scan flushes
+                # first): a budget-expired DeadlineExceeded here would
+                # park the memtable as "persistent" and background
+                # triggers would then skip it forever — acked rows stuck
+                # memory-only on a healthy store
+                with deadline_ctx.deadline_scope(None):
+                    await self._writeout_once(parked)
             except BaseException as e:
                 parked.last_error = e
                 ex.park(parked)
@@ -935,6 +945,12 @@ class SampleManager:
         async def one_segment(seg):
             nonlocal acc
             async with self._scan_sem:
+                # cooperative deadline: a segment pass acquired AFTER the
+                # budget died must not read + reduce (the TaskGroup
+                # cancels siblings on the first raise)
+                from horaedb_tpu.common import deadline as deadline_ctx
+
+                deadline_ctx.check("segment_scan")
                 # retry wrapper: a compaction may delete this snapshot's
                 # files mid-query; the refresh re-reads the live SSTs
                 part = await self._storage.scan_segment_retrying(

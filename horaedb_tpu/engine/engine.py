@@ -768,6 +768,11 @@ class MetricEngine:
 
     async def query(self, req: QueryRequest):
         """Raw rows (bucket_ms None) or downsample grids per series."""
+        from horaedb_tpu.common import deadline as deadline_ctx
+
+        # cooperative end-to-end deadline (common/deadline.py): a query
+        # whose budget is already spent must not pay resolution + scan
+        deadline_ctx.check("query_resolve")
         resolved = await self._resolve_query_async(req)
         if resolved is None:
             return None
@@ -812,6 +817,15 @@ class MetricEngine:
     def metric_names(self) -> list[bytes]:
         """All registered metric names (the /api/v1/metrics surface)."""
         return self.metric_mgr.names()
+
+    def series_count(self, metric: bytes) -> int:
+        """Registered series of a metric (in-memory index lookup, no IO).
+        The admission scheduler's cost model sizes grid queries with
+        this (server/admission.py); 0 for unknown metrics."""
+        hit = self.metric_mgr.get(metric)
+        if hit is None:
+            return 0
+        return len(self.index_mgr.series_of(hit[0]))
 
     def label_names(self) -> list[bytes]:
         """All label KEYS across every registered series (the

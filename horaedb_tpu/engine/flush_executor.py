@@ -283,6 +283,14 @@ class FlushExecutor:
     async def _run(self) -> None:
         """One worker: drain queued memtables until the queue is empty,
         then exit (per-item tasks — nothing lingers at loop teardown)."""
+        from horaedb_tpu.common import deadline as deadline_ctx
+
+        # background durability work must NOT inherit a request deadline:
+        # this task was possibly created from a query's flush barrier
+        # (tasks copy the spawning context), and killing a half-done SST
+        # upload because a dashboard panel's budget expired would turn a
+        # slow query into parked memtables
+        deadline_ctx.detach()
         cond = self._condition()
         try:
             while self._queue:

@@ -496,6 +496,7 @@ class RegionedEngine:
         return self.engines[self.router.region_of_name(metric)]
 
     async def query(self, req: QueryRequest):
+        from horaedb_tpu.common import deadline as deadline_ctx
         from horaedb_tpu.storage import scanstats
 
         if self._legacy:
@@ -503,6 +504,11 @@ class RegionedEngine:
             return await self._engine_for(req.metric).query(req)
         import asyncio
 
+        # cooperative deadline at the fan-out point: an expired query
+        # must not launch one scan per region (each per-region query
+        # re-checks on its own path, so a mid-fan-out expiry dies at the
+        # next natural yield point instead of finishing every region)
+        deadline_ctx.check("region_fanout")
         ids = list(self.engines)
         # EXPLAIN provenance: how many regions this query fanned out to
         # (max, not sum: a multi-selector PromQL expression queries the
@@ -564,6 +570,14 @@ class RegionedEngine:
         for e in self.engines.values():
             out.extend(e.metric_names())
         return sorted(set(out))
+
+    def series_count(self, metric: bytes) -> int:
+        """Fan-out sum of per-region registered series (a split-migrated
+        series registered in parent AND daughter counts twice — an
+        acceptable over-estimate for the admission cost model)."""
+        if self._legacy:
+            return self._engine_for(metric).series_count(metric)
+        return sum(e.series_count(metric) for e in self.engines.values())
 
     def label_names(self) -> list[bytes]:
         """Fan-out union of per-region label keys (mirrors match_series:

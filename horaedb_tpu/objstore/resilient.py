@@ -16,7 +16,13 @@ of the tree builds on:
   count as successes.
 - **Per-attempt deadlines.** Each attempt runs under
   `asyncio.wait_for(op, op_deadline)`: a black-holed endpoint costs a
-  bounded timeout, not a hung flush worker.
+  bounded timeout, not a hung flush worker. Ops issued on behalf of a
+  request additionally respect the request's end-to-end deadline
+  (common/deadline.py): each attempt is capped at the remaining budget
+  and the ladder stops — `DeadlineExceeded`, the HTTP 504 — once the
+  budget cannot cover another attempt, so retries/backoff never outlive
+  the query that asked. Background work (no deadline installed) keeps
+  the configured ladder unchanged.
 - **A circuit breaker per store.** `failure_threshold` consecutive
   gave-ups open the breaker; while open every call fails fast with
   `UnavailableError` (carrying a Retry-After hint) instead of burning a
@@ -49,8 +55,10 @@ import random
 import time
 from dataclasses import dataclass, field
 
+from horaedb_tpu.common import deadline as deadline_ctx
 from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import (
+    DeadlineExceeded,
     HoraeError,
     UnavailableError,
     classify,
@@ -275,14 +283,45 @@ class ResilientStore(ObjectStore):
         except asyncio.CancelledError:
             self.breaker.on_probe_aborted()
             raise
+        except DeadlineExceeded:
+            # the CALLER's budget died mid-ladder: no availability verdict
+            # either way — release a half-open probe slot without moving
+            # breaker state (same contract as a cancellation)
+            self.breaker.on_probe_aborted()
+            raise
+
+    def _raise_budget_spent(self, op: str, attempt: int,
+                            last: BaseException | None) -> None:
+        """The query deadline (common/deadline.py) cannot cover another
+        attempt: stop the ladder NOW, typed. An op issued on behalf of a
+        request must never outlive the request — a black-holed store
+        under a 1 s query deadline costs ~1 s, not the full ladder."""
+        d = deadline_ctx.current()
+        raise DeadlineExceeded(
+            f"{op} abandoned after {attempt} attempt(s): query deadline "
+            f"exceeded (store={self._name})",
+            cause=last,
+            budget_s=d.budget_s if d else None,
+            elapsed_s=d.elapsed_s() if d else None,
+            at=f"objstore_{op}",
+        )
 
     async def _attempt_loop(self, op: str, fn, args):
         deadline = self._retry.op_deadline.seconds
         attempts = max(1, self._retry.max_attempts)
         last: BaseException | None = None
         for attempt in range(attempts):
+            # per-attempt timeout = min(op_deadline, the driving query's
+            # remaining budget); background work (no deadline contextvar)
+            # keeps the configured op_deadline unchanged
+            rem = deadline_ctx.remaining_s()
+            timeout = deadline
+            if rem is not None:
+                if rem <= 0.0:
+                    self._raise_budget_spent(op, attempt, last)
+                timeout = min(deadline, rem)
             try:
-                result = await asyncio.wait_for(fn(*args), timeout=deadline)
+                result = await asyncio.wait_for(fn(*args), timeout=timeout)
             except HoraeError as e:
                 from horaedb_tpu.objstore import NotFound, PreconditionFailed
 
@@ -310,8 +349,15 @@ class ResilientStore(ObjectStore):
                 self.breaker.on_success()
                 raise last
             if attempt + 1 < attempts:
+                # retrying (or even just backing off) past the caller's
+                # remaining budget is work nobody will read: stop typed
+                rem = deadline_ctx.remaining_s()
+                if rem is not None and rem <= 0.0:
+                    self._raise_budget_spent(op, attempt + 1, last)
                 OBJSTORE_RETRIES.labels(op).inc()
                 backoff = self._backoff_s(attempt)
+                if rem is not None:
+                    backoff = min(backoff, max(rem, 0.0))
                 # the retry is a SPAN wrapping its backoff sleep, so a slow
                 # traced request shows exactly where its latency went
                 with tracing.span(

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from horaedb_tpu.common import deadline as deadline_ctx
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.jaxcompat import shard_map
 from horaedb_tpu.common.xprof import xjit
@@ -210,6 +211,9 @@ def sharded_downsample(
 ):
     """One-shot wrapper: splits predicate literals so repeat queries with new
     constants reuse the memoized executable."""
+    # cooperative deadline before the device dispatch (host side, outside
+    # the traced body): an expired query launches no kernel
+    deadline_ctx.check("device_lane")
     SCAN_PATH.labels("sharded").inc()
     template, literals = filter_ops.split_literals(predicate)
     fn = build_sharded_downsample(
@@ -313,6 +317,9 @@ def shard_rows(mesh: Mesh, arrays: tuple, pad_value=0):
 
     from horaedb_tpu.storage import scanstats
 
+    # cooperative deadline before the H2D transfer: expired queries ship
+    # no bytes to the device
+    deadline_ctx.check("device_lane")
     rows_par = mesh.shape["rows"]
     n = len(arrays[0])
     pad = (-n) % rows_par

@@ -173,6 +173,50 @@ class IngestConfig:
 
 
 @dataclass
+class QueryConfig:
+    """Query-path admission control knobs (`[metric_engine.query]`,
+    server/admission.py): a bounded scheduler in front of the engine so
+    a dashboard burst degrades to 503s + Retry-After instead of
+    unbounded concurrent scans, and every query carries an end-to-end
+    deadline (504 past it). See docs/operations.md "Query admission &
+    deadlines"."""
+
+    # Global in-flight query cap (scans running concurrently).
+    max_concurrent: int = 8
+    # Per-tenant in-flight cap; 0 = same as max_concurrent.
+    max_per_tenant: int = 0
+    # Bounded admission queue; a full queue sheds 503 immediately. 0
+    # disables queuing entirely (at-capacity queries shed at once).
+    queue_max: int = 64
+    # A query queued longer than this sheds 503 (the stall deadline).
+    queue_deadline: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(5)
+    )
+    # Default end-to-end query deadline; per-request override via
+    # Prometheus-style `timeout=` (clamped to max_timeout).
+    default_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(30)
+    )
+    max_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(300)
+    )
+    # Hard cost gate: shed (503) queries whose ESTIMATED device cost
+    # (server/admission.py CostModel, seeded from the xprof kernel
+    # catalog) exceeds this many seconds. 0 disables the gate — the
+    # estimate still rides EXPLAIN's admission verdict.
+    max_cost_s: float = 0.0
+    # Header naming the tenant for fairness accounting.
+    tenant_header: str = "X-Horaedb-Tenant"
+    # Weighted-fair shares per tenant (default weight 1.0):
+    # [metric_engine.query.tenant_weights] dashboards = 2.0
+    tenant_weights: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "QueryConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
 class RetentionConfig:
     """Per-table retention horizon (`[metric_engine.retention]`): samples
     older than now - period stop existing. Row-exact at scan time via the
@@ -226,6 +270,7 @@ class LimitsConfig:
 class MetricEngineConfig:
     threads: ThreadConfig = field(default_factory=ThreadConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
+    query: QueryConfig = field(default_factory=QueryConfig)
     retention: RetentionConfig = field(default_factory=RetentionConfig)
     limits: LimitsConfig = field(default_factory=LimitsConfig)
     storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
@@ -343,6 +388,23 @@ class Config:
         ing = self.metric_engine.ingest
         ensure(ing.flush_workers >= 1, "ingest.flush_workers must be >= 1")
         ensure(ing.flush_queue_max >= 1, "ingest.flush_queue_max must be >= 1")
+        q = self.metric_engine.query
+        ensure(q.max_concurrent >= 1, "query.max_concurrent must be >= 1")
+        ensure(q.max_per_tenant >= 0,
+               "query.max_per_tenant must be >= 0 (0 = the global cap)")
+        ensure(q.queue_max >= 0, "query.queue_max must be >= 0")
+        ensure(q.queue_deadline.seconds > 0,
+               "query.queue_deadline must be positive")
+        ensure(q.default_timeout.seconds > 0,
+               "query.default_timeout must be positive")
+        ensure(q.max_timeout.seconds >= q.default_timeout.seconds,
+               "query.max_timeout must be >= query.default_timeout")
+        ensure(q.max_cost_s >= 0, "query.max_cost_s must be >= 0")
+        ensure(
+            all(isinstance(v, (int, float)) and v > 0
+                for v in q.tenant_weights.values()),
+            "query.tenant_weights values must be positive numbers",
+        )
         ensure(
             self.metric_engine.limits.max_series >= 0,
             "limits.max_series must be >= 0 (0 disables the limit)",

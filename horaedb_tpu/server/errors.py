@@ -48,3 +48,33 @@ def unavailable_response(
         status=503,
         headers={"Retry-After": str(retry_after_seconds(e))},
     )
+
+
+def deadline_response(
+    e: BaseException,
+    progress: dict | None = None,
+    extra: dict | None = None,
+) -> web.Response:
+    """504 for an expired end-to-end query deadline (common/deadline.py).
+
+    Distinct from the 503 shed on purpose: a 503 says "the server is
+    overloaded, back off and resend", a 504 says "YOUR budget ran out —
+    widen `timeout=` or narrow the query". `progress` carries the
+    partial-progress provenance (regions fanned out, SSTs selected/read,
+    stage seconds) so the caller sees how far the scan got before the
+    budget died; the cooperative checks name WHERE it expired (`at`)."""
+    body = {"error": str(e), "deadline_exceeded": True}
+    budget = getattr(e, "budget_s", None)
+    if budget is not None:
+        body["budget_s"] = round(budget, 3)
+    elapsed = getattr(e, "elapsed_s", None)
+    if elapsed is not None:
+        body["elapsed_s"] = round(elapsed, 3)
+    at = getattr(e, "at", "")
+    if at:
+        body["at"] = at
+    if progress:
+        body["progress"] = progress
+    if extra:
+        body.update(extra)
+    return web.json_response(body, status=504)

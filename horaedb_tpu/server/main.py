@@ -40,16 +40,23 @@ import numpy as np
 import pyarrow as pa
 from aiohttp import web
 
+from horaedb_tpu.common import deadline as deadline_ctx
 from horaedb_tpu.common import tracing, xprof
-from horaedb_tpu.common.error import HoraeError, UnavailableError
+from horaedb_tpu.common.error import (
+    DeadlineExceeded,
+    HoraeError,
+    UnavailableError,
+)
 from horaedb_tpu.common.time_ext import now_ms
 from horaedb_tpu.engine import MetricEngine, QueryRequest
 from horaedb_tpu.ingest import ParserPool
 from horaedb_tpu.ingest.cardinality import CardinalityLimited
 from horaedb_tpu.objstore import LocalStore
 from horaedb_tpu.objstore.resilient import ResilientStore
+from horaedb_tpu.server import admission
+from horaedb_tpu.server.admission import AdmissionController
 from horaedb_tpu.server.config import Config
-from horaedb_tpu.server.errors import unavailable_response
+from horaedb_tpu.server.errors import deadline_response, unavailable_response
 from horaedb_tpu.server.metrics import GLOBAL_METRICS as METRICS
 from horaedb_tpu.server.slowlog import SlowLog, build_entry
 from horaedb_tpu.storage import scanstats
@@ -200,12 +207,16 @@ def snappy_decompress(buf: bytes) -> bytes:
 
 class ServerState:
     def __init__(self, config: Config, storage, engine: MetricEngine,
-                 parser_pool=None, slowlog: "SlowLog | None" = None):
+                 parser_pool=None, slowlog: "SlowLog | None" = None,
+                 admission_controller: "AdmissionController | None" = None):
         self.config = config
         self.storage = storage       # demo ColumnarStorage (reference parity)
         self.engine = engine         # metric engine (remote-write path)
         self.parser_pool = parser_pool or ParserPool()
         self.slowlog = slowlog       # slow-query flight recorder (or None)
+        # bounded query scheduler (server/admission.py): every query
+        # handler routes through it (jaxlint J011)
+        self.admission = admission_controller or AdmissionController()
         self.write_enabled = asyncio.Event()
         self.write_workers: list[asyncio.Task] = []
 
@@ -213,6 +224,28 @@ class ServerState:
 # ---------------------------------------------------------------------------
 # handlers
 # ---------------------------------------------------------------------------
+
+
+async def shield_mutation(coro):
+    """Run a state-MUTATING engine/storage call to completion even when
+    the client disconnects. `handler_cancellation` exists so abandoned
+    QUERIES free their admission slot — but it aborts every handler task,
+    and a write/admin mutation cancelled between its internal awaits
+    would commit half an operation (e.g. delete_series lands the
+    data-table tombstone but not the exemplars one). Shielding keeps the
+    mutation atomic: the inner task runs to completion, the cancellation
+    re-raises AFTER it settles, and a failure after disconnect is logged
+    (nobody is left to receive it)."""
+    task = asyncio.ensure_future(coro)
+    try:
+        return await asyncio.shield(task)
+    except asyncio.CancelledError:
+        try:
+            await task
+        except Exception:  # noqa: BLE001 — no caller left to tell
+            logger.exception("shielded mutation failed after client "
+                             "disconnect")
+        raise
 
 
 async def handle_root(request: web.Request) -> web.Response:
@@ -248,8 +281,8 @@ async def handle_compact(request: web.Request) -> web.Response:
                 {"error": f"start ({start}) must be <= end ({end})"}, status=400
             )
         rng = TimeRange(start, end)
-    await state.storage.compact(CompactRequest(time_range=rng))
-    await state.engine.compact(time_range=rng)
+    await shield_mutation(state.storage.compact(CompactRequest(time_range=rng)))
+    await shield_mutation(state.engine.compact(time_range=rng))
     METRICS.inc("horaedb_compactions_triggered_total")
     return web.json_response({
         "compaction": "triggered",
@@ -275,7 +308,7 @@ async def handle_split_region(request: web.Request) -> web.Response:
             {"error": "query param ?region=<id> required"}, status=400
         )
     try:
-        daughter = await state.engine.split_region(region)
+        daughter = await shield_mutation(state.engine.split_region(region))
     except HoraeError as e:
         return web.json_response({"error": str(e)}, status=400)
     METRICS.inc("horaedb_region_splits_total")
@@ -329,7 +362,7 @@ async def handle_remote_write(request: web.Request) -> web.Response:
             return web.json_response({"error": "bad snappy payload"}, status=400)
     try:
         with tracing.span("ingest", bytes=len(body)):
-            n = await state.engine.write_payload(body)
+            n = await shield_mutation(state.engine.write_payload(body))
     except CardinalityLimited as e:
         # series-cardinality partial-accept: existing-series samples WERE
         # accepted and are durable per the normal ack contract; only new
@@ -397,6 +430,72 @@ def _raw_table_response(table, limit: int, explain: dict | None = None) -> web.R
 
 
 # ---------------------------------------------------------------------------
+# query admission plumbing (server/admission.py)
+# ---------------------------------------------------------------------------
+
+
+def _tenant_of(request: web.Request) -> str:
+    """Fairness-accounting tenant: the configured header, else "default"."""
+    state: ServerState = request.app[STATE_KEY]
+    hdr = state.config.metric_engine.query.tenant_header
+    return request.headers.get(hdr, "") or "default"
+
+
+def _query_deadline(state: "ServerState", raw_timeout) -> "deadline_ctx.Deadline":
+    """End-to-end deadline for one query: Prometheus-style `timeout=`
+    override, clamped to [metric_engine.query] max_timeout; absent ->
+    default_timeout. Raises ValueError on garbage (the 400 path)."""
+    qcfg = state.config.metric_engine.query
+    secs = admission.parse_timeout_s(
+        raw_timeout, qcfg.default_timeout.seconds, qcfg.max_timeout.seconds
+    )
+    return deadline_ctx.Deadline(secs)
+
+
+def _promql_cells(state: "ServerState", expr, n_steps: int) -> int | None:
+    """Grid-cell estimate for the admission cost model: steps x the
+    matched-series count of every selector in the expression. Index
+    lookups only — no scan, no IO."""
+    from dataclasses import fields as dc_fields, is_dataclass
+
+    from horaedb_tpu.promql import Selector
+
+    names: list[str] = []
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, Selector):
+            names.append(node.name)
+        elif is_dataclass(node) and not isinstance(node, type):
+            for f in dc_fields(node):
+                v = getattr(node, f.name)
+                if isinstance(v, (list, tuple)):
+                    stack.extend(v)
+                else:
+                    stack.append(v)
+    if not names:
+        return None
+    series = sum(state.engine.series_count(n.encode()) for n in names)
+    return max(n_steps, 1) * max(series, 1)
+
+
+def _progress_payload(st) -> dict | None:
+    """Partial-progress provenance for a deadline-killed query's 504
+    body: how far the scan got before the budget died (the caller paid
+    for these numbers; naming them beats a bare timeout)."""
+    if st is None:
+        return None
+    counts = dict(st.counts)
+    return {
+        "regions": counts.get("regions_fanout", 0),
+        "ssts_selected": counts.get("ssts_selected", 0),
+        "ssts_read": counts.get("ssts_read", 0),
+        "ssts_bloom_pruned": counts.get("ssts_bloom_pruned", 0),
+        "stages_s": {k: round(v, 6) for k, v in st.seconds.items()},
+    }
+
+
+# ---------------------------------------------------------------------------
 # query EXPLAIN
 # ---------------------------------------------------------------------------
 
@@ -411,7 +510,7 @@ def _want_explain(request: web.Request, params: dict | None = None) -> bool:
     return v.lower() in _TRUTHY
 
 
-def _explain_payload(st, mode: str) -> dict:
+def _explain_payload(st, mode: str, admission_verdict: dict | None = None) -> dict:
     """Assemble the plan a finished query leaves behind: what was touched
     (regions, SSTs, bloom prunes), which routes/kernels served it
     (scan path, dispatcher impl, instrumented-kernel envelopes), and where
@@ -468,13 +567,18 @@ def _explain_payload(st, mode: str) -> dict:
         "bound": att["bound"],
         "compile_s": round(compile_s, 6),
         "steady_s": round(max(0.0, total_s - compile_s), 6),
+        # admission verdict (server/admission.py): queued?, queue-wait
+        # seconds, estimated device cost, load at admission. None when the
+        # query never reached admission (e.g. shed before a slot).
+        "admission": admission_verdict,
         "counts": counts,
         "kernels": kernels,
     }
 
 
 def _finish_explain(state: "ServerState", st, mode: str,
-                    want: bool) -> dict | None:
+                    want: bool,
+                    admission_verdict: dict | None = None) -> dict | None:
     """Build the plan and attach it to the request's trace root so the
     slow-query flight recorder (and /debug/traces/{id}) carries it even
     when the caller did not ask for ?explain=1. Skipped entirely — zero
@@ -482,7 +586,7 @@ def _finish_explain(state: "ServerState", st, mode: str,
     flight recorder is disabled (nobody would ever read it)."""
     if not want and state.slowlog is None:
         return None
-    explain = _explain_payload(st, mode)
+    explain = _explain_payload(st, mode, admission_verdict=admission_verdict)
     tracing.add_attr(explain=explain, scanstats=st.as_dict())
     return explain if want else None
 
@@ -522,22 +626,34 @@ async def handle_query_range(request: web.Request) -> web.Response:
     from horaedb_tpu.promql.eval import RangeEvaluator, to_prometheus_matrix
 
     state: ServerState = request.app[STATE_KEY]
+    st = None
     try:
         p = await _promql_params(request)
         expr = parse(p["query"])
         start_ms = int(float(p["start"]) * 1000)
         end_ms = int(float(p["end"]) * 1000)
         step_ms = parse_duration_ms(p["step"])
+        dl = _query_deadline(state, p.get("timeout"))
         ev = RangeEvaluator(state.engine, start_ms, end_ms, step_ms)
-        with scanstats.scan_stats() as st:
-            series = await ev.eval(expr)
+        cells = _promql_cells(state, expr, len(ev.steps))
+        # scan_stats outermost so the admission queue wait lands in the
+        # collector (stage="queue_wait"); the deadline covers queue wait
+        # AND the scan — end-to-end means end-to-end
+        with scanstats.scan_stats() as st, \
+                deadline_ctx.deadline_scope(dl):
+            slot = state.admission.slot(_tenant_of(request), cells=cells)
+            async with slot:
+                series = await ev.eval(expr)
+    except DeadlineExceeded as e:
+        return deadline_response(e, progress=_progress_payload(st))
     except UnavailableError as e:
         return unavailable_response(e)
     except (PromQLError, HoraeError, KeyError, ValueError) as e:
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
     explain = _finish_explain(state, st, "promql_range",
-                              _want_explain(request, p))
+                              _want_explain(request, p),
+                              admission_verdict=slot.verdict())
     body = {"status": "success", "data": to_prometheus_matrix(series, ev.steps)}
     if explain is not None:
         body["explain"] = explain
@@ -559,21 +675,30 @@ async def handle_promql_instant(
     )
 
     state: ServerState = request.app[STATE_KEY]
+    st = None
     try:
         expr = parse(params["query"])
         at_ms = int(float(params.get("time", now_ms() / 1000.0)) * 1000)
+        dl = _query_deadline(state, params.get("timeout"))
         # instant = a one-step range ending at `time` (window functions need
         # a left context; LOOKBACK covers bare selectors)
         ev = RangeEvaluator(state.engine, at_ms - LOOKBACK_MS, at_ms, LOOKBACK_MS)
-        with scanstats.scan_stats() as st:
-            series = await ev.eval(expr)
+        cells = _promql_cells(state, expr, 1)
+        with scanstats.scan_stats() as st, \
+                deadline_ctx.deadline_scope(dl):
+            slot = state.admission.slot(_tenant_of(request), cells=cells)
+            async with slot:
+                series = await ev.eval(expr)
+    except DeadlineExceeded as e:
+        return deadline_response(e, progress=_progress_payload(st))
     except UnavailableError as e:
         return unavailable_response(e)
     except (PromQLError, HoraeError, ValueError) as e:
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
     explain = _finish_explain(state, st, "promql_instant",
-                              _want_explain(request, params))
+                              _want_explain(request, params),
+                              admission_verdict=slot.verdict())
     body = {"status": "success", "data": to_prometheus_vector(series, at_ms)}
     if explain is not None:
         body["explain"] = explain
@@ -621,7 +746,7 @@ async def handle_query(request: web.Request) -> web.Response:
             q = {
                 k: qs.pop(k)
                 for k in ("metric", "start_ms", "end_ms", "bucket_ms",
-                          "limit", "exemplars")
+                          "limit", "exemplars", "timeout")
                 if k in qs
             }
             if "bucket_ms" in q:
@@ -665,30 +790,58 @@ async def handle_query(request: web.Request) -> web.Response:
         )
     except Exception as e:  # noqa: BLE001
         return web.json_response({"error": f"bad query: {e}"}, status=400)
+    try:
+        dl = _query_deadline(state, q.get("timeout"))
+    except ValueError as e:
+        return web.json_response({"error": f"bad query: {e}"}, status=400)
     METRICS.inc("horaedb_queries_total")
     want_explain = _want_explain(request, q)
     mode = (
         "exemplars" if q.get("exemplars")
         else "raw" if req.bucket_ms is None else "downsample"
     )
+    # cost-model sizing: only grid-shaped queries are predictable enough
+    # to price (buckets x registered series of the metric — index lookup)
+    cells = None
+    if mode == "downsample":
+        n_buckets = -(-(req.end_ms - req.start_ms) // req.bucket_ms)
+        cells = int(n_buckets) * max(state.engine.series_count(req.metric), 1)
+    tenant = _tenant_of(request)
+    st = None
     try:
-        with scanstats.scan_stats() as st:
+        with scanstats.scan_stats() as st, \
+                deadline_ctx.deadline_scope(dl):
             if q.get("exemplars"):
-                table = await state.engine.query_exemplars(req)
+                table, slot = await admission.run_query_exemplars(
+                    state.admission, state.engine, req, tenant=tenant
+                )
             else:
-                out = await state.engine.query(req)
+                out, slot = await admission.run_query(
+                    state.admission, state.engine, req, tenant=tenant,
+                    cells=cells,
+                )
+    except DeadlineExceeded as e:
+        # end-to-end budget spent (queued or mid-scan): 504 with the
+        # partial-progress provenance of what the scan HAD done
+        extra = (
+            {"explain": _explain_payload(st, mode)} if want_explain else None
+        )
+        return deadline_response(e, progress=_progress_payload(st),
+                                 extra=extra)
     except UnavailableError as e:
         # a required SST (or the flush barrier before the scan) hit a
-        # down store: typed 503 + Retry-After, with the partial-result
-        # provenance of what WAS reached (ssts.unavailable names the
-        # unreadable remainder) when the caller asked for the plan
+        # down store — or the admission scheduler shed (queue full /
+        # stalled / cost gate): typed 503 + Retry-After, with the
+        # partial-result provenance of what WAS reached when the caller
+        # asked for the plan
         extra = (
             {"explain": _explain_payload(st, mode)} if want_explain else None
         )
         return unavailable_response(e, extra=extra)
     except HoraeError as e:
         return web.json_response({"error": str(e)}, status=400)
-    explain = _finish_explain(state, st, mode, want_explain)
+    explain = _finish_explain(state, st, mode, want_explain,
+                              admission_verdict=slot.verdict())
     if q.get("exemplars"):
         if table is None:
             return web.json_response(
@@ -765,10 +918,10 @@ async def handle_delete_series(request: web.Request) -> web.Response:
                 )
             q = _to_query(node, start_ms, end_ms)
             with tracing.span("delete_series", metric=node.name):
-                r = await state.engine.delete_series(
+                r = await shield_mutation(state.engine.delete_series(
                     q.metric, filters=q.filters, matchers=q.matchers,
                     start_ms=start_ms, end_ms=end_ms,
-                )
+                ))
             r["match"] = expr
             results.append(r)
     except UnavailableError as e:
@@ -988,6 +1141,7 @@ async def handle_query_exemplars(request: web.Request) -> web.Response:
     from horaedb_tpu.promql.eval import _to_query
 
     state: ServerState = request.app[STATE_KEY]
+    st = None
     try:
         p = await _promql_params(request)
         node = parse(p["query"])
@@ -995,9 +1149,17 @@ async def handle_query_exemplars(request: web.Request) -> web.Response:
             raise PromQLError("query must be an instant vector selector")
         start_ms = int(float(p["start"]) * 1000)
         end_ms = int(float(p["end"]) * 1000)
+        dl = _query_deadline(state, p.get("timeout"))
         req = _to_query(node, start_ms, end_ms + 1)
         req.limit = 10_000
-        table = await state.engine.query_exemplars(req)
+        with scanstats.scan_stats() as st, \
+                deadline_ctx.deadline_scope(dl):
+            table, _slot = await admission.run_query_exemplars(
+                state.admission, state.engine, req,
+                tenant=_tenant_of(request),
+            )
+    except DeadlineExceeded as e:
+        return deadline_response(e, progress=_progress_payload(st))
     except UnavailableError as e:
         return unavailable_response(e)
     except (PromQLError, HoraeError, KeyError, ValueError) as e:
@@ -1187,8 +1349,17 @@ async def build_app(config: Config, store=None) -> web.Application:
             capacity=config.slowlog.capacity,
             min_duration_s=config.slowlog.min_duration.seconds,
         )
+    qcfg = config.metric_engine.query
+    adm = AdmissionController(
+        max_concurrent=qcfg.max_concurrent,
+        max_per_tenant=qcfg.max_per_tenant,
+        queue_max=qcfg.queue_max,
+        queue_deadline_s=qcfg.queue_deadline.seconds,
+        max_cost_s=qcfg.max_cost_s,
+        weights=qcfg.tenant_weights,
+    )
     state = ServerState(config, storage, engine, parser_pool=pool,
-                        slowlog=slow)
+                        slowlog=slow, admission_controller=adm)
     if config.test.enable_write:
         state.write_enabled.set()
     for i in range(config.test.write_worker_num):
@@ -1285,7 +1456,11 @@ def main() -> None:
 
     async def run():
         app = await build_app(config)
-        runner = web.AppRunner(app)
+        # handler_cancellation: a client disconnect raises CancelledError
+        # into the handler, so an abandoned query frees its admission
+        # slot and stops scanning instead of finishing work nobody reads
+        # (counted in horaedb_query_shed_total{reason="client_disconnect"})
+        runner = web.AppRunner(app, handler_cancellation=True)
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", config.port)
         await site.start()

@@ -42,6 +42,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from horaedb_tpu.common import deadline as deadline_ctx
 from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import HoraeError, ensure
 from horaedb_tpu.common.xprof import xjit
@@ -1112,6 +1113,9 @@ class ParquetReader:
         """Read one SST's projected columns, skipping row groups whose
         min/max statistics can't satisfy the predicate (and whole SSTs whose
         bloom sidecar rules the predicate out)."""
+        # cooperative deadline per SST read: an expired query stops
+        # paying IO + decode here, SST by SST (common/deadline.py)
+        deadline_ctx.check("sst_read")
         path = self._path_gen.generate(sst.id)
         if predicate is not None and await self._bloom_skip(sst, predicate):
             # EXPLAIN provenance: this SST never cost any IO
@@ -1770,6 +1774,10 @@ class ParquetReader:
             pmax over ICI. Single device: the local sorted kernel.
             `valid_np` excludes rows via the reduction's weight column
             (sid_np must stay monotone for excluded rows too)."""
+            # cooperative deadline between device-lane launches: each fold
+            # is one kernel dispatch — an expired query stops dispatching
+            # (host-side check; never traced into the kernel body)
+            deadline_ctx.check("device_lane")
             if mesh is not None:
                 # path counter rides sharded_downsample (one inc per fold)
                 with scanstats.stage("device_agg"):

@@ -803,10 +803,15 @@ class ObjectBasedStorage(ColumnarStorage):
                 empty_result=[],
             ))
 
+        from horaedb_tpu.common import deadline as deadline_ctx
+
         pending = start(segments[0])
         try:
             for i in range(len(segments)):
                 batches = await pending
+                # cooperative deadline between segments: an expired query
+                # stops here instead of prefetching + decoding the rest
+                deadline_ctx.check("segment_scan")
                 pending = start(segments[i + 1]) if i + 1 < len(segments) else None
                 for b in batches:
                     yield b

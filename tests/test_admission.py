@@ -1,0 +1,939 @@
+"""Query-path admission control (server/admission.py) + end-to-end
+deadlines (common/deadline.py).
+
+Ordering pins (FIFO within a tenant, weighted-fair across tenants),
+global/per-tenant cap enforcement, bounded-queue + stall-deadline
+shedding, cooperative deadline expiry mid-fan-out releasing the slot
+with the engine left consistent, cancellation freeing queued AND running
+entries, the cost model/gate, and the objstore-reads-respect-the-query-
+deadline satellite (a black-holed store under a short query deadline
+returns in ~deadline, not after the full retry ladder).
+
+Everything is deterministic: clocks injectable where it matters, events
+gate concurrency, and metric assertions are before/after deltas (the
+registry is process-global across the test session).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from horaedb_tpu.common import deadline as deadline_ctx
+from horaedb_tpu.common.deadline import Deadline, deadline_scope
+from horaedb_tpu.common.error import (
+    DeadlineExceeded,
+    UnavailableError,
+    classify,
+)
+from horaedb_tpu.common.time_ext import ReadableDuration
+from horaedb_tpu.engine import MetricEngine, QueryRequest
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.objstore.resilient import ResilientStore, RetryPolicy
+from horaedb_tpu.server import admission
+from horaedb_tpu.server.admission import (
+    QUERY_DEADLINE_EXCEEDED,
+    QUERY_INFLIGHT,
+    QUERY_QUEUED,
+    QUERY_SHED,
+    AdmissionController,
+    CostModel,
+    parse_timeout_s,
+)
+from tests.conftest import async_test
+from tests.test_engine import make_remote_write
+
+HOUR = 3_600_000
+
+ms = ReadableDuration.millis
+
+
+def shed(reason: str) -> float:
+    return QUERY_SHED.labels(reason).value
+
+
+# ---------------------------------------------------------------------------
+# the deadline token
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_budget_accounting_with_injected_clock(self):
+        t = [100.0]
+        d = Deadline(2.0, clock=lambda: t[0])
+        assert d.remaining_s() == pytest.approx(2.0)
+        assert not d.expired()
+        t[0] += 1.5
+        assert d.remaining_s() == pytest.approx(0.5)
+        d.check("mid")  # in budget: no raise
+        t[0] += 1.0
+        assert d.expired()
+        with pytest.raises(DeadlineExceeded) as ei:
+            d.check("sst_read")
+        assert ei.value.at == "sst_read"
+        assert ei.value.budget_s == pytest.approx(2.0)
+        assert ei.value.elapsed_s == pytest.approx(2.5)
+
+    def test_deadline_exceeded_is_persistent_not_retryable(self):
+        """A retry under the SAME expired deadline cannot succeed — the
+        resilience layer must stop its ladder, not burn budget."""
+        assert classify(DeadlineExceeded("x")) == "persistent"
+
+    def test_context_frame_preserves_the_class_and_fields(self):
+        from horaedb_tpu.common.error import context
+
+        with pytest.raises(DeadlineExceeded) as ei:
+            with context("scan segment 3"):
+                raise DeadlineExceeded("late", budget_s=1.0, elapsed_s=2.0,
+                                       at="sst_read")
+        assert ei.value.budget_s == 1.0 and ei.value.at == "sst_read"
+        assert "scan segment 3" in str(ei.value)
+
+    @async_test
+    async def test_scope_is_contextvar_propagated_and_nested(self):
+        assert deadline_ctx.current() is None
+        assert deadline_ctx.check() is None  # no-op without a deadline
+        with deadline_scope(Deadline(60.0)) as outer:
+            assert deadline_ctx.current() is outer
+
+            async def child():
+                return deadline_ctx.current()
+
+            # tasks copy the spawning context: the token rides along
+            assert await asyncio.create_task(child()) is outer
+            with deadline_scope(None):  # explicit clear for a sub-block
+                assert deadline_ctx.current() is None
+            assert deadline_ctx.current() is outer
+        assert deadline_ctx.current() is None
+
+    @async_test
+    async def test_detach_clears_in_task_without_leaking_to_spawner(self):
+        with deadline_scope(Deadline(60.0)):
+
+            async def background():
+                deadline_ctx.detach()
+                return deadline_ctx.current()
+
+            assert await asyncio.create_task(background()) is None
+            # the spawner's own context is untouched
+            assert deadline_ctx.current() is not None
+
+    def test_parse_timeout_forms(self):
+        assert parse_timeout_s("30s", 10.0, 300.0) == 30.0
+        assert parse_timeout_s("2.5", 10.0, 300.0) == 2.5
+        assert parse_timeout_s(1.25, 10.0, 300.0) == 1.25
+        assert parse_timeout_s(None, 10.0, 300.0) == 10.0
+        assert parse_timeout_s("", 10.0, 300.0) == 10.0
+        # clamped to the cap, default included
+        assert parse_timeout_s("1h", 10.0, 300.0) == 300.0
+        assert parse_timeout_s(None, 600.0, 300.0) == 300.0
+        with pytest.raises(ValueError):
+            parse_timeout_s("-3", 10.0, 300.0)
+        with pytest.raises(Exception):
+            parse_timeout_s("not a duration", 10.0, 300.0)
+
+
+# ---------------------------------------------------------------------------
+# scheduler ordering
+# ---------------------------------------------------------------------------
+
+
+class TestOrdering:
+    @async_test
+    async def test_fifo_within_one_tenant(self):
+        """cap=1: queued queries run in submission order."""
+        ctl = AdmissionController(max_concurrent=1, queue_max=16,
+                                  queue_deadline_s=10.0)
+        order: list[int] = []
+
+        async def q(i: int):
+            async with ctl.slot("t"):
+                order.append(i)
+                await asyncio.sleep(0)
+
+        tasks = []
+        for i in range(8):
+            tasks.append(asyncio.create_task(q(i)))
+            await asyncio.sleep(0)  # deterministic enqueue order
+        await asyncio.gather(*tasks)
+        assert order == list(range(8))
+        assert ctl.inflight == 0 and ctl.queued == 0
+
+    @async_test
+    async def test_weighted_fair_two_to_one(self):
+        """weights a=2, b=1, cap=1: grants interleave ~2:1 — tenant b is
+        never starved by a's deeper backlog, and the exact stride
+        sequence is pinned (deterministic tie-breaks)."""
+        ctl = AdmissionController(max_concurrent=1, queue_max=32,
+                                  queue_deadline_s=10.0,
+                                  weights={"a": 2.0, "b": 1.0})
+        hold = asyncio.Event()
+        grants: list[str] = []
+
+        async def blocker():
+            async with ctl.slot("warm"):
+                await hold.wait()
+
+        async def q(tenant: str):
+            async with ctl.slot(tenant):
+                grants.append(tenant)
+
+        b = asyncio.create_task(blocker())
+        await asyncio.sleep(0.01)
+        tasks = [asyncio.create_task(q("a")) for _ in range(6)]
+        tasks += [asyncio.create_task(q("b")) for _ in range(3)]
+        await asyncio.sleep(0.01)  # everyone queued behind the blocker
+        assert ctl.queued == 9
+        hold.set()
+        await asyncio.gather(b, *tasks)
+        assert grants == ["a", "b", "a", "a", "b", "a", "a", "b", "a"]
+
+    @async_test
+    async def test_unweighted_tenants_round_robin(self):
+        ctl = AdmissionController(max_concurrent=1, queue_max=32,
+                                  queue_deadline_s=10.0)
+        hold = asyncio.Event()
+        grants: list[str] = []
+
+        async def blocker():
+            async with ctl.slot("warm"):
+                await hold.wait()
+
+        async def q(tenant: str):
+            async with ctl.slot(tenant):
+                grants.append(tenant)
+
+        b = asyncio.create_task(blocker())
+        await asyncio.sleep(0.01)
+        tasks = [asyncio.create_task(q(t)) for t in ("a", "a", "a", "b", "b", "b")]
+        await asyncio.sleep(0.01)
+        hold.set()
+        await asyncio.gather(b, *tasks)
+        # equal weights alternate regardless of a's deeper backlog
+        assert grants == ["a", "b", "a", "b", "a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# cap enforcement
+# ---------------------------------------------------------------------------
+
+
+class TestCaps:
+    @async_test
+    async def test_global_inflight_cap(self):
+        ctl = AdmissionController(max_concurrent=3, queue_max=32,
+                                  queue_deadline_s=10.0)
+        live = 0
+        high_water = 0
+
+        async def q():
+            nonlocal live, high_water
+            async with ctl.slot("t"):
+                live += 1
+                high_water = max(high_water, live)
+                await asyncio.sleep(0.005)
+                live -= 1
+
+        await asyncio.gather(*(q() for _ in range(12)))
+        assert high_water == 3
+        assert ctl.inflight == 0
+        assert QUERY_INFLIGHT.value == 0
+
+    @async_test
+    async def test_per_tenant_cap_leaves_global_headroom_for_others(self):
+        """tenant cap 1, global cap 2: a's second query queues while b
+        runs concurrently with a's first."""
+        ctl = AdmissionController(max_concurrent=2, max_per_tenant=1,
+                                  queue_max=8, queue_deadline_s=10.0)
+        a_gate = asyncio.Event()
+        b_ran = asyncio.Event()
+        a2_ran = asyncio.Event()
+
+        async def a1():
+            async with ctl.slot("a"):
+                await a_gate.wait()
+
+        async def a2():
+            async with ctl.slot("a"):
+                a2_ran.set()
+
+        async def b1():
+            async with ctl.slot("b"):
+                b_ran.set()
+
+        t1 = asyncio.create_task(a1())
+        await asyncio.sleep(0.01)
+        t2 = asyncio.create_task(a2())
+        await asyncio.sleep(0.01)
+        assert ctl.queued == 1 and not a2_ran.is_set()  # a capped at 1
+        t3 = asyncio.create_task(b1())
+        await asyncio.wait_for(b_ran.wait(), 1.0)  # b admitted immediately
+        assert not a2_ran.is_set()
+        a_gate.set()
+        await asyncio.gather(t1, t2, t3)
+        assert a2_ran.is_set()
+
+
+# ---------------------------------------------------------------------------
+# shedding
+# ---------------------------------------------------------------------------
+
+
+class TestShedding:
+    @async_test
+    async def test_queue_full_sheds_immediately_with_retry_after(self):
+        ctl = AdmissionController(max_concurrent=1, queue_max=1,
+                                  queue_deadline_s=10.0)
+        hold = asyncio.Event()
+
+        async def holder():
+            async with ctl.slot():
+                await hold.wait()
+
+        async def waiter():
+            async with ctl.slot():
+                pass
+
+        before = shed("queue_full")
+        h = asyncio.create_task(holder())
+        await asyncio.sleep(0.01)
+        w = asyncio.create_task(waiter())
+        await asyncio.sleep(0.01)
+        t0 = time.perf_counter()
+        with pytest.raises(UnavailableError) as ei:
+            async with ctl.slot():
+                pass
+        assert time.perf_counter() - t0 < 1.0  # immediate, not queued
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        assert shed("queue_full") == before + 1
+        hold.set()
+        await asyncio.gather(h, w)
+
+    @async_test
+    async def test_stall_deadline_sheds_unavailable(self):
+        ctl = AdmissionController(max_concurrent=1, queue_max=4,
+                                  queue_deadline_s=0.05)
+        hold = asyncio.Event()
+
+        async def holder():
+            async with ctl.slot():
+                await hold.wait()
+
+        before = shed("stall")
+        h = asyncio.create_task(holder())
+        await asyncio.sleep(0.01)
+        t0 = time.perf_counter()
+        with pytest.raises(UnavailableError) as ei:
+            async with ctl.slot():
+                pass
+        elapsed = time.perf_counter() - t0
+        assert 0.04 <= elapsed < 2.0
+        assert "stalled" in str(ei.value)
+        assert shed("stall") == before + 1
+        assert ctl.queued == 0 and QUERY_QUEUED.value == 0
+        hold.set()
+        await h
+
+    @async_test
+    async def test_forced_full_admin_hook(self):
+        ctl = AdmissionController(max_concurrent=4)
+        before = shed("forced")
+        ctl.force_full()
+        with pytest.raises(UnavailableError):
+            async with ctl.slot():
+                pass
+        assert shed("forced") == before + 1
+        ctl.reset_forced()
+        async with ctl.slot():
+            pass  # admits again
+
+    @async_test
+    async def test_queue_max_zero_sheds_at_capacity(self):
+        ctl = AdmissionController(max_concurrent=1, queue_max=0,
+                                  queue_deadline_s=10.0)
+        hold = asyncio.Event()
+
+        async def holder():
+            async with ctl.slot():
+                await hold.wait()
+
+        h = asyncio.create_task(holder())
+        await asyncio.sleep(0.01)
+        with pytest.raises(UnavailableError):
+            async with ctl.slot():
+                pass
+        hold.set()
+        await h
+
+
+# ---------------------------------------------------------------------------
+# cancellation (client disconnect)
+# ---------------------------------------------------------------------------
+
+
+class TestCancellation:
+    @async_test
+    async def test_cancel_frees_a_queued_entry(self):
+        ctl = AdmissionController(max_concurrent=1, queue_max=8,
+                                  queue_deadline_s=10.0)
+        hold = asyncio.Event()
+
+        async def holder():
+            async with ctl.slot():
+                await hold.wait()
+
+        async def waiter():
+            async with ctl.slot():
+                pass
+
+        before = shed("client_disconnect")
+        h = asyncio.create_task(holder())
+        await asyncio.sleep(0.01)
+        w = asyncio.create_task(waiter())
+        await asyncio.sleep(0.01)
+        assert ctl.queued == 1
+        w.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await w
+        assert ctl.queued == 0 and QUERY_QUEUED.value == 0
+        assert shed("client_disconnect") == before + 1
+        hold.set()
+        await h
+        assert ctl.inflight == 0
+
+    @async_test
+    async def test_cancel_frees_a_running_entry_and_dispatches_next(self):
+        ctl = AdmissionController(max_concurrent=1, queue_max=8,
+                                  queue_deadline_s=10.0)
+        running = asyncio.Event()
+        next_ran = asyncio.Event()
+
+        async def victim():
+            async with ctl.slot():
+                running.set()
+                await asyncio.sleep(60)
+
+        async def successor():
+            async with ctl.slot():
+                next_ran.set()
+
+        before = shed("client_disconnect")
+        v = asyncio.create_task(victim())
+        await asyncio.wait_for(running.wait(), 1.0)
+        s = asyncio.create_task(successor())
+        await asyncio.sleep(0.01)
+        v.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await v
+        # the freed slot dispatched the queued successor
+        await asyncio.wait_for(next_ran.wait(), 1.0)
+        await s
+        assert shed("client_disconnect") == before + 1
+        assert ctl.inflight == 0 and QUERY_INFLIGHT.value == 0
+
+
+# ---------------------------------------------------------------------------
+# cost model + gate
+# ---------------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_unsized_queries_are_unpriced(self):
+        m = CostModel()
+        assert m.estimate_s(None) is None
+        assert m.estimate_s(0) is None
+
+    def test_ewma_learns_the_measured_per_cell_rate(self):
+        m = CostModel(alpha=1.0)  # full step: one observation converges
+        m.observe(1_000_000, 0.5)  # 5e-7 s/cell measured
+        assert m.per_cell_s == pytest.approx(5e-7)
+        # a SEEN shape class pays no compile prior
+        assert m.estimate_s(1_000_000) == pytest.approx(0.5, rel=0.01)
+
+    def test_compile_prior_consults_the_xprof_catalog(self):
+        """The compile-cost prior is the catalog's measured mean — >= 0
+        always, and added only for unseen shape classes."""
+        m = CostModel(alpha=1.0)
+        prior = m.compile_cost_s()
+        assert prior >= 0.0
+        m.observe(1 << 20, 1.0)
+        seen = m.estimate_s(1 << 20)
+        unseen = m.estimate_s(1 << 24)  # different power-of-two class
+        assert unseen >= (1 << 24) * m.per_cell_s  # includes prior (>= 0)
+        assert seen == pytest.approx((1 << 20) * m.per_cell_s)
+
+    @async_test
+    async def test_cost_gate_sheds_expensive_queries(self):
+        m = CostModel(alpha=1.0)
+        m.observe(1_000, 1.0)  # 1ms/cell: absurdly slow device
+        ctl = AdmissionController(max_concurrent=4, max_cost_s=0.5,
+                                  cost_model=m)
+        before = shed("cost")
+        with pytest.raises(UnavailableError) as ei:
+            async with ctl.slot("t", cells=10_000):  # est ~10s > 0.5s
+                pass
+        assert "max_cost_s" in str(ei.value)
+        assert shed("cost") == before + 1
+        # cheap and unsized queries still admit
+        async with ctl.slot("t", cells=10):
+            pass
+        async with ctl.slot("t", cells=None):
+            pass
+
+    @async_test
+    async def test_slot_feeds_observed_cost_back(self):
+        m = CostModel(alpha=1.0)
+        ctl = AdmissionController(max_concurrent=2, cost_model=m)
+        async with ctl.slot("t", cells=1000) as slot:
+            await asyncio.sleep(0.02)
+        assert slot.cost_estimate_s is not None
+        assert m.per_cell_s >= 0.02 / 1000 * 0.5  # observed ~20ms/1000 cells
+
+
+# ---------------------------------------------------------------------------
+# deadlines through the scheduler + the engine (mid-fan-out expiry)
+# ---------------------------------------------------------------------------
+
+
+class SlowStore:
+    """MemStore with a per-get delay (injectable scan slowness)."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner = inner
+        self.delay_s = delay_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def get(self, path: str) -> bytes:
+        if "/data/" in path:  # only slow the SST reads, not bootstrap
+            await asyncio.sleep(self.delay_s)
+        return await self._inner.get(path)
+
+
+async def _seeded_engine(store, n_hours: int = 4):
+    """One SST per hour-segment: a scan must read several objects. The
+    block cache is disabled so every scan actually pays the (slowed)
+    store reads — the deadline must expire MID-scan, not be outrun by a
+    warm cache."""
+    from horaedb_tpu.common.size_ext import ReadableSize
+    from horaedb_tpu.storage.config import StorageConfig
+
+    cfg = StorageConfig()
+    cfg.scan_cache = ReadableSize.mb(0)
+    eng = await MetricEngine.open(
+        "adm-db", store, segment_duration_ms=HOUR, enable_compaction=False,
+        config=cfg,
+    )
+    for h in range(n_hours):
+        payload = make_remote_write([
+            ({"__name__": "cpu", "host": f"h{i}"},
+             [(h * HOUR + 1000, float(h * 10 + i))])
+            for i in range(3)
+        ])
+        await eng.write_payload(payload)
+    return eng
+
+
+class TestDeadlineIntegration:
+    @async_test
+    async def test_queued_query_expires_with_504_not_stall(self):
+        ctl = AdmissionController(max_concurrent=1, queue_max=8,
+                                  queue_deadline_s=10.0)
+        hold = asyncio.Event()
+
+        async def holder():
+            async with ctl.slot():
+                await hold.wait()
+
+        before = QUERY_DEADLINE_EXCEEDED.value
+        h = asyncio.create_task(holder())
+        await asyncio.sleep(0.01)
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded):
+            with deadline_scope(Deadline(0.05)):
+                async with ctl.slot():
+                    pass
+        assert time.perf_counter() - t0 < 2.0
+        assert QUERY_DEADLINE_EXCEEDED.value == before + 1
+        assert ctl.queued == 0
+        hold.set()
+        await h
+
+    @async_test
+    async def test_deadline_expiry_mid_fanout_releases_slot_engine_consistent(self):
+        """The acceptance pin: a deadline that dies mid-scan (slow store,
+        several segments) raises DeadlineExceeded at a cooperative
+        checkpoint, frees its admission slot (inflight gauge), and
+        leaves the engine answering the SAME query correctly afterward."""
+        slow = SlowStore(MemStore(), delay_s=0.0)
+        eng = await _seeded_engine(slow, n_hours=4)
+        try:
+            req = QueryRequest(metric=b"cpu", start_ms=0, end_ms=5 * HOUR)
+            # reference run, no deadline: 4 segments x 3 series = 12 rows
+            table = await eng.query(req)
+            assert table.num_rows == 12
+            expected = sorted(zip(
+                table.column("ts").to_pylist(),
+                table.column("value").to_pylist(),
+            ))
+
+            # one store read (0.15s) strictly exceeds the whole budget
+            # (0.06s): the deadline MUST be expired at the first
+            # checkpoint after the read, independent of read concurrency
+            # and warm-kernel speed
+            ctl = AdmissionController(max_concurrent=2)
+            slow.delay_s = 0.15
+            before_inflight = QUERY_INFLIGHT.value
+            with pytest.raises(DeadlineExceeded) as ei:
+                with deadline_scope(Deadline(0.06)):
+                    await admission.run_query(ctl, eng, req)
+            # expired at a cooperative checkpoint with a location name
+            assert ei.value.at, str(ei.value)
+            # the slot was released promptly (the acceptance criterion)
+            assert ctl.inflight == 0
+            assert QUERY_INFLIGHT.value == before_inflight
+
+            # engine consistent: the same query, no deadline, exact rows
+            slow.delay_s = 0.0
+            table2, slot = await admission.run_query(ctl, eng, req)
+            got = sorted(zip(
+                table2.column("ts").to_pylist(),
+                table2.column("value").to_pylist(),
+            ))
+            assert got == expected
+            assert slot.verdict()["admitted"] is True
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_downsample_deadline_mid_fanout(self):
+        slow = SlowStore(MemStore(), delay_s=0.0)
+        eng = await _seeded_engine(slow, n_hours=4)
+        try:
+            req = QueryRequest(metric=b"cpu", start_ms=0, end_ms=4 * HOUR,
+                              bucket_ms=HOUR)
+            tsids, grids = await eng.query(req)
+            # see the raw test: one (concurrent) read outlives the whole
+            # budget, so the post-read checkpoint always fires
+            slow.delay_s = 0.15
+            with pytest.raises(DeadlineExceeded):
+                with deadline_scope(Deadline(0.06)):
+                    await eng.query(req)
+            slow.delay_s = 0.0
+            tsids2, grids2 = await eng.query(req)
+            assert tsids2 == tsids
+            import numpy as np
+
+            np.testing.assert_allclose(grids2["sum"], grids["sum"])
+            np.testing.assert_allclose(grids2["count"], grids["count"])
+        finally:
+            await eng.close()
+
+
+# ---------------------------------------------------------------------------
+# objstore reads respect the query deadline (the resilience satellite)
+# ---------------------------------------------------------------------------
+
+
+class Blackhole:
+    """A store whose data-plane verbs never answer (network blackhole)."""
+
+    async def get(self, path: str) -> bytes:
+        await asyncio.sleep(3600)
+
+    async def put(self, path: str, data: bytes) -> None:
+        await asyncio.sleep(3600)
+
+    async def list(self, prefix: str):
+        await asyncio.sleep(3600)
+
+    def local_path(self, path: str):
+        return None
+
+
+class TestResilientStoreDeadline:
+    @async_test
+    async def test_blackholed_get_returns_in_about_the_query_deadline(self):
+        """The satellite pin: a black-holed store under a short query
+        deadline answers DeadlineExceeded (-> 504) in ~deadline, NOT
+        after the full op_deadline x attempts retry ladder."""
+        rs = ResilientStore(
+            Blackhole(),
+            retry=RetryPolicy(max_attempts=4, backoff_base=ms(1),
+                              backoff_cap=ms(5), op_deadline=ms(30_000)),
+        )
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded) as ei:
+            with deadline_scope(Deadline(0.3)):
+                await rs.get("db/data/1.sst")
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, f"ladder not bounded by deadline: {elapsed}s"
+        assert "objstore_get" in (ei.value.at or "")
+
+    @async_test
+    async def test_backoff_never_outlives_the_deadline(self):
+        """A failing (not hanging) store: attempts stop once the budget
+        cannot cover another round — the backoff sleep is capped too."""
+
+        class Failing(Blackhole):
+            def __init__(self):
+                self.calls = 0
+
+            async def get(self, path):
+                self.calls += 1
+                raise ConnectionResetError("nope")
+
+        inner = Failing()
+        rs = ResilientStore(
+            inner,
+            retry=RetryPolicy(max_attempts=50, backoff_base=ms(40),
+                              backoff_cap=ms(200), op_deadline=ms(30_000)),
+        )
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded):
+            with deadline_scope(Deadline(0.2)):
+                await rs.get("db/data/1.sst")
+        assert time.perf_counter() - t0 < 2.0
+        assert inner.calls < 50  # the ladder stopped early
+
+    @async_test
+    async def test_background_work_keeps_the_full_ladder(self):
+        """No deadline installed (flush workers detach): the configured
+        op_deadline/attempts apply unchanged — UnavailableError, not
+        DeadlineExceeded."""
+        rs = ResilientStore(
+            Blackhole(),
+            retry=RetryPolicy(max_attempts=2, backoff_base=ms(1),
+                              backoff_cap=ms(2), op_deadline=ms(50)),
+        )
+        with pytest.raises(UnavailableError):
+            await rs.get("db/data/1.sst")
+
+    @async_test
+    async def test_flush_worker_detaches_a_query_deadline(self):
+        """A flush kicked from a query context must not inherit the
+        query's (expired) budget: rows land durably anyway."""
+        store = MemStore()
+        eng = await MetricEngine.open(
+            "det-db", store, segment_duration_ms=HOUR,
+            enable_compaction=False, ingest_buffer_rows=4,
+        )
+        try:
+            payload = make_remote_write([
+                ({"__name__": "det", "host": f"h{i}"}, [(1000, float(i))])
+                for i in range(6)  # crosses the 4-row buffer threshold
+            ])
+            with deadline_scope(Deadline(60.0)):
+                await eng.write_payload(payload)
+                await eng.flush()
+            table = await eng.query(
+                QueryRequest(metric=b"det", start_ms=0, end_ms=HOUR)
+            )
+            assert table.num_rows == 6
+        finally:
+            await eng.close()
+
+
+# ---------------------------------------------------------------------------
+# client-disconnect at the HTTP layer (the regression the satellite names)
+# ---------------------------------------------------------------------------
+
+
+class TestClientDisconnectHTTP:
+    @async_test
+    async def test_disconnect_cancels_scan_frees_slot_counts_shed(self, tmp_path):
+        """Before this PR a disconnected client's scan ran to completion.
+        Now: aiohttp (handler_cancellation) raises CancelledError into
+        the handler, the admission slot frees itself, the shed counter
+        moves, and the server keeps answering."""
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import STATE_KEY, build_app
+
+        cfg = Config.from_toml(f"""
+port = 0
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+""")
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            state = app[STATE_KEY]
+            payload = make_remote_write([
+                ({"__name__": "dc", "host": "a"}, [(1000, 1.0)])
+            ])
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200
+
+            release = asyncio.Event()
+            started = asyncio.Event()
+            orig_query = state.engine.query
+
+            async def slow_query(req):
+                started.set()
+                await release.wait()
+                return await orig_query(req)
+
+            state.engine.query = slow_query
+            before = shed("client_disconnect")
+            try:
+                with pytest.raises(asyncio.TimeoutError):
+                    # total-timeout abort closes the connection mid-response
+                    await client.post(
+                        "/api/v1/query",
+                        json={"metric": "dc", "start_ms": 0, "end_ms": 5000},
+                        timeout=aiohttp.ClientTimeout(total=0.3),
+                    )
+                await asyncio.wait_for(started.wait(), 2.0)
+                # the server notices the disconnect, cancels the handler,
+                # frees the slot and counts the shed (poll: teardown is
+                # asynchronous to the client-side timeout)
+                for _ in range(100):
+                    if (shed("client_disconnect") == before + 1
+                            and state.admission.inflight == 0):
+                        break
+                    await asyncio.sleep(0.02)
+                assert shed("client_disconnect") == before + 1
+                assert state.admission.inflight == 0
+            finally:
+                release.set()
+                state.engine.query = orig_query
+            # the freed slot serves the next caller normally
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "dc", "start_ms": 0, "end_ms": 5000},
+            )
+            assert r.status == 200 and (await r.json())["rows"] == 1
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# review regressions: nan timeouts, shielded mutations, barrier replay
+# ---------------------------------------------------------------------------
+
+
+class TestReviewRegressions:
+    def test_non_finite_timeouts_rejected(self):
+        """timeout=nan must not install a never-expiring deadline (NaN
+        compares False against everything, so `elapsed >= budget` and
+        the resilient layer's budget checks would all no-op)."""
+        for bad in ("nan", "inf", "-inf", float("nan"), float("inf")):
+            with pytest.raises(ValueError):
+                parse_timeout_s(bad, 10.0, 300.0)
+
+    @async_test
+    async def test_nan_timeout_is_a_400_not_a_deadlineless_slot(self, tmp_path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import build_app
+
+        cfg = Config.from_toml(f"""
+port = 0
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+""")
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/api/v1/query", json={
+                "metric": "x", "start_ms": 0, "end_ms": 1000,
+                "timeout": "nan",
+            })
+            assert r.status == 400, await r.text()
+        finally:
+            await client.close()
+
+    @async_test
+    async def test_shield_mutation_completes_despite_cancellation(self):
+        """A client disconnect (handler_cancellation) must not abort a
+        half-done mutation: the shielded call runs to completion, THEN
+        the cancellation propagates."""
+        from horaedb_tpu.server.main import shield_mutation
+
+        steps: list[str] = []
+
+        async def mutation():
+            steps.append("a")
+            await asyncio.sleep(0.05)
+            steps.append("b")  # the second half must still happen
+            return 42
+
+        async def handler():
+            return await shield_mutation(mutation())
+
+        t = asyncio.create_task(handler())
+        await asyncio.sleep(0.01)
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert steps == ["a", "b"], "mutation aborted mid-way"
+
+    @async_test
+    async def test_flush_barrier_replay_ignores_the_query_deadline(self):
+        """A parked (retryable) memtable replayed inline by a query's
+        flush barrier is durability work for ACKED rows: it must run
+        deadline-detached. Before the fix, an expired query budget made
+        the replay raise DeadlineExceeded -> parked as 'persistent' ->
+        background triggers skip it forever."""
+        from horaedb_tpu.common.error import PersistentError
+        from horaedb_tpu.objstore.resilient import ResilientStore, RetryPolicy
+
+        class FailDataPuts:
+            """First N SAMPLE-table puts fail PERSISTENTLY; then healthy.
+            Persistent matters: kick_parked skips persistent parks, so
+            the barrier's INLINE replay (the code path under test — it
+            runs in the query task, where the deadline contextvar lives)
+            is the only thing that can drain the memtable."""
+
+            def __init__(self, inner, n_fail):
+                self._inner = inner
+                self.n_fail = n_fail
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            async def put(self, path, data):
+                # the SAMPLE table's SSTs only ("<root>/data/data/*.sst");
+                # registration-table writes ("<root>/metrics/data/...")
+                # must ack cleanly or the test fails before any flush
+                if "/data/data/" in path and self.n_fail > 0:
+                    self.n_fail -= 1
+                    raise PersistentError("403 until operator fixes policy")
+                return await self._inner.put(path, data)
+
+        flaky = FailDataPuts(MemStore(), n_fail=1)
+        store = ResilientStore(
+            flaky,
+            retry=RetryPolicy(max_attempts=1, backoff_base=ms(1),
+                              backoff_cap=ms(2), op_deadline=ms(5000)),
+        )
+        eng = await MetricEngine.open(
+            "barrier-db", store, segment_duration_ms=HOUR,
+            enable_compaction=False, ingest_buffer_rows=4,
+        )
+        try:
+            payload = make_remote_write([
+                ({"__name__": "bar", "host": f"h{i}"}, [(1000, float(i))])
+                for i in range(6)  # crosses the buffer -> background flush
+            ])
+            await eng.write_payload(payload)
+            await asyncio.sleep(0.05)  # let the worker fail + park
+            # the barrier runs INSIDE an expired query budget (a scan's
+            # pre-flush); the parked replay must succeed anyway
+            with deadline_scope(Deadline(1e-9)):
+                await eng.flush()
+            table = await eng.query(
+                QueryRequest(metric=b"bar", start_ms=0, end_ms=HOUR)
+            )
+            assert table is not None and table.num_rows == 6
+        finally:
+            await eng.close()

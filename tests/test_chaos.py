@@ -812,3 +812,142 @@ class TestDirtyTrafficChaosSoak:
         assert await query_model(eng2) == model
         assert chaos.injected_errors > 0  # the plan actually fired
         await eng2.close()
+
+
+# ---------------------------------------------------------------------------
+# query-path overload (PR 8): closed-loop burst over a faulted store
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadChaos:
+    """The read-path degradation contract, end to end over HTTP: under a
+    sustained burst beyond the configured admission caps, over a store
+    that injects faults into every read, EVERY request completes with
+    200 / 503(+Retry-After) / 504 within bounded time — zero hangs, no
+    unbounded queue growth — admitted (200) results match the host
+    model EXACTLY, and deadline-exceeded queries measurably free their
+    scheduler slot (the inflight gauge returns to zero)."""
+
+    @async_test
+    async def test_query_burst_bounded_statuses_and_exact_results(self, tmp_path):
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu.server.admission import (
+            QUERY_INFLIGHT,
+            QUERY_SHED,
+        )
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import STATE_KEY, build_app
+        from tests.test_engine import make_remote_write
+
+        # data plane: reads fault at 20% + 2ms latency; writes stay clean
+        # (the burst is a QUERY soak — ingest chaos is the dirty soak's job)
+        chaos = ChaosStore(MemStore(), FaultPlan(
+            seed=11, ops={"get": OpFaults(error_rate=0.2, latency_s=0.002)},
+        ))
+        store = ResilientStore(chaos, retry=fast_retry(6, deadline_s=2.0))
+        cfg = Config.from_dict({
+            "metric_engine": {
+                # tight caps so the burst actually sheds
+                "query": {
+                    "max_concurrent": 2,
+                    "queue_max": 3,
+                    "queue_deadline": "250ms",
+                    "default_timeout": "5s",
+                },
+                "storage": {"object_store": {
+                    "data_dir": str(tmp_path / "scratch"),
+                }},
+            },
+        })
+        app = await build_app(cfg, store=store)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            state = app[STATE_KEY]
+            # host model: 6 series, 2 samples each, one segment
+            hosts = {f"h{i}": float(10 + i) for i in range(6)}
+            payload = make_remote_write([
+                ({"__name__": "burst", "host": h},
+                 [(1000, v), (2000, v + 1.0)])
+                for h, v in hosts.items()
+            ])
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200, await r.text()
+            expected_raw = sorted(
+                (ts, v + dv)
+                for v in hosts.values() for ts, dv in ((1000, 0.0), (2000, 1.0))
+            )
+            expected_means = sorted(v + 0.5 for v in hosts.values())
+
+            raw_q = {"metric": "burst", "start_ms": 0, "end_ms": 10_000}
+            ds_q = {"metric": "burst", "start_ms": 0, "end_ms": 3_600_000,
+                    "bucket_ms": 3_600_000}
+            statuses: list[int] = []
+            latencies: list[float] = []
+
+            async def one_client(cid: int):
+                for j in range(4):
+                    q = raw_q if (cid + j) % 2 == 0 else ds_q
+                    t0 = time.perf_counter()
+                    async with client.session.post(
+                        f"{client.make_url('/api/v1/query')}", json=q,
+                        timeout=aiohttp.ClientTimeout(total=30),
+                    ) as r:
+                        body = await r.json()
+                    latencies.append(time.perf_counter() - t0)
+                    statuses.append(r.status)
+                    if r.status == 503:
+                        assert r.headers.get("Retry-After", "").isdigit()
+                    elif r.status == 200 and q is raw_q:
+                        got = sorted(zip(body["ts"], body["value"]))
+                        assert got == expected_raw, "partial 200 result!"
+                    elif r.status == 200:
+                        assert sorted(
+                            row[0] for row in body["mean"]
+                        ) == expected_means
+                        assert all(row[0] == 2.0 for row in body["count"])
+
+            shed0 = sum(
+                QUERY_SHED.labels(rn).value
+                for rn in ("queue_full", "stall")
+            )
+            # closed-loop burst: 16 clients x 4 requests over caps of
+            # 2 running + 3 queued. Bounded end-to-end or the test fails.
+            await asyncio.wait_for(
+                asyncio.gather(*(one_client(i) for i in range(16))),
+                timeout=120,
+            )
+            assert len(statuses) == 64
+            assert set(statuses) <= {200, 503, 504}, sorted(set(statuses))
+            assert statuses.count(200) >= 1, "nothing was ever admitted"
+            # the caps were real: the burst shed at least once
+            shed_now = sum(
+                QUERY_SHED.labels(rn).value
+                for rn in ("queue_full", "stall")
+            )
+            assert shed_now > shed0, "burst never hit the bounds"
+            # bounded p99 (sorted latencies; well under the client timeout)
+            latencies.sort()
+            p99 = latencies[int(len(latencies) * 0.99) - 1]
+            assert p99 < 30.0, f"p99 {p99:.1f}s — not bounded"
+            # no slot leaked by the burst
+            assert state.admission.inflight == 0
+            assert state.admission.queued == 0
+            assert QUERY_INFLIGHT.value == 0
+
+            # deadline-exceeded frees the slot (inflight gauge pin): a
+            # tiny per-request timeout= must 504 and leave zero inflight
+            async with client.session.post(
+                f"{client.make_url('/api/v1/query')}",
+                json={**raw_q, "timeout": 1e-6},
+            ) as r:
+                assert r.status == 504, await r.text()
+                body = await r.json()
+                assert body["deadline_exceeded"] is True
+            assert state.admission.inflight == 0
+            assert QUERY_INFLIGHT.value == 0
+            assert chaos.injected_errors > 0  # the fault plan actually fired
+        finally:
+            await client.close()

@@ -597,3 +597,85 @@ class TestJ010VisibilityBoundary:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ011AdmissionBoundary:
+    """J011: server-layer query entry points must route through the
+    admission scheduler (server/admission.py) — a handler calling
+    `engine.query(...)` directly silently bypasses the concurrency cap,
+    queue/stall backpressure, end-to-end deadline, tenant fairness, and
+    the shed metrics."""
+
+    def seeded(self, tmp_path, body, rel="server/handlers.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_direct_engine_query_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def handle_query(state, req):\n"
+            "    out = await state.engine.query(req)\n"          # J011
+            "    t = await state.engine.query_exemplars(req)\n"  # J011
+            "    return out, t\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 2, r.stdout
+        assert r.stdout.count("J011") == 2, r.stdout
+        assert "admission" in r.stdout
+
+    def test_bare_engine_name_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def lane(engine, req):\n"
+            "    return await engine.query(req)\n",              # J011
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J011" in r.stdout
+
+    def test_admission_module_exempt(self, tmp_path):
+        """The funnel itself calls the engine — that is its job."""
+        f = self.seeded(
+            tmp_path,
+            "async def run_query(controller, engine, req):\n"
+            "    async with controller.slot():\n"
+            "        return await engine.query(req)\n",
+            rel="server/admission.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_outside_server_not_flagged(self, tmp_path):
+        """The engine layer queries itself (regions fan out, PromQL
+        evaluates) — the boundary is the SERVER layer only."""
+        f = self.seeded(
+            tmp_path,
+            "async def fan_out(self, req):\n"
+            "    return [await e.query(req) for e in self.engines]\n"
+            "async def inner(engine, req):\n"
+            "    return await engine.query(req)\n",
+            rel="engine/seeded.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_non_engine_receiver_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def lookup(state, req):\n"
+            "    return await state.registry.query(req)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def bench_lane(state, req):\n"
+            "    # jaxlint: disable=J011 harness lane, admission measured separately\n"
+            "    return await state.engine.query(req)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

@@ -555,7 +555,7 @@ data_dir = "{tmp_path}/data"
 EXPLAIN_KEYS = {
     "mode", "regions", "ssts", "scan_paths", "agg_impl", "agg_impls",
     "stages_s", "lanes_s", "bound", "compile_s", "steady_s", "counts",
-    "kernels", "tombstones_applied", "tombstone_rows_masked",
+    "kernels", "tombstones_applied", "tombstone_rows_masked", "admission",
 }
 EXPLAIN_LANES = {"io", "host", "transfer", "kernel", "compile"}
 
@@ -589,6 +589,13 @@ class TestExplain:
                 assert plan["regions"] >= 1
                 for k in plan["kernels"]:
                     assert {"kernel", "compiles", "calls"} <= set(k)
+                # admission verdict (server/admission.py) rides every
+                # admitted query's plan
+                adm = plan["admission"]
+                assert adm is not None and adm["admitted"] is True
+                assert {"tenant", "queued", "queue_wait_s",
+                        "cost_estimate_s", "inflight"} <= set(adm)
+                assert adm["tenant"] == "default"
 
             # native raw
             r = await client.post(

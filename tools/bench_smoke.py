@@ -120,6 +120,21 @@ def main() -> int:
         check(0 < sketch_ns < 1000,
               f"cardinality sketch overhead out of budget: "
               f"{sketch_ns} ns/series (budget <1000)")
+        # query QPS lane (admission scheduler): all three concurrency
+        # levels present and sane — positive QPS, p50 <= p99, shed rate
+        # a valid percentage (the 64-client level runs over a cap of 4,
+        # so shedding is expected, not an error)
+        qps = result.get("query_qps") or {}
+        check(set(qps) == {"1", "8", "64"},
+              f"query qps lane levels missing: {sorted(qps)}")
+        for lvl, row in qps.items():
+            check(row.get("qps", 0) > 0,
+                  f"query qps lane {lvl}: non-positive qps: {row}")
+            p50, p99 = row.get("p50_ms"), row.get("p99_ms")
+            check(p50 is not None and p99 is not None and 0 < p50 <= p99,
+                  f"query qps lane {lvl}: bad latency percentiles: {row}")
+            check(0.0 <= row.get("shed_pct", -1) <= 100.0,
+                  f"query qps lane {lvl}: bad shed_pct: {row}")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")

@@ -69,6 +69,13 @@ Rules (see docs/static-analysis.md for rationale and examples):
         through apply_visibility, or deletes "mostly work" (one reader
         filters, another resurrects). Harness/test fixtures that
         introspect the records suppress with the reason
+  J011  query entry point bypassing the admission scheduler: a call of
+        `<...>.engine.query(...)` / `.query_exemplars(...)` in server
+        code outside server/admission.py skips the bounded scheduler —
+        no concurrency cap, no queue/stall backpressure, no end-to-end
+        deadline, no per-tenant fairness, no shed metrics; route through
+        admission.run_query / run_query_exemplars (or hold an admission
+        slot and suppress with the reason)
   J009  naked object-store construction outside objstore/: a concrete
         store (`MemStore`/`LocalStore`/`S3LikeStore`) built in engine
         code without being handed straight to a `ResilientStore(...)`
@@ -166,6 +173,16 @@ J008_EXEMPT = ("horaedb_tpu/engine/flush_executor.py",)
 # of scope — they deliberately build raw stores to inject faults.
 J009_MODULES = ("horaedb_tpu/",)
 J009_EXEMPT = ("horaedb_tpu/objstore/",)
+
+# J011: the query-admission boundary (server/admission.py). Server-layer
+# code must reach the engine's query surface only through the admission
+# helpers; the owner-name heuristic (`engine`/`_engine` receiver) matches
+# this codebase's handler idiom (`state.engine.query(...)`) without
+# flagging unrelated `.query()` methods on other objects.
+J011_MODULES = ("horaedb_tpu/server/",)
+J011_EXEMPT = ("horaedb_tpu/server/admission.py",)
+QUERY_ENTRY_ATTRS = {"query", "query_exemplars"}
+ENGINE_RECEIVERS = {"engine", "_engine"}
 
 # J010: tombstone/retention filtering is ONE shared helper
 # (storage/visibility.py, funneled through ParquetReader.read_sst); any
@@ -722,6 +739,35 @@ def _check_store_boundary(tree: ast.Module, findings: list[Finding]) -> None:
             ))
 
 
+def _check_admission_boundary(tree: ast.Module, findings: list[Finding]) -> None:
+    """J011: `<...>.engine.query(...)` / `.query_exemplars(...)` in server
+    code outside server/admission.py. The receiver must be named
+    `engine`/`_engine` (directly or as the last attribute before the
+    verb) — the handler idiom this tree uses — so `registry.query(...)`
+    on unrelated objects never trips the rule."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in QUERY_ENTRY_ATTRS):
+            continue
+        owner = f.value
+        owner_name = None
+        if isinstance(owner, ast.Attribute):
+            owner_name = owner.attr
+        elif isinstance(owner, ast.Name):
+            owner_name = owner.id
+        if owner_name in ENGINE_RECEIVERS:
+            findings.append(Finding(
+                node.lineno, "J011",
+                f"direct engine `.{f.attr}(...)` in server code bypasses "
+                "the admission scheduler (no concurrency cap, queue/stall "
+                "backpressure, end-to-end deadline, tenant fairness, or "
+                "shed metrics); route through server/admission.run_query"
+                "/run_query_exemplars, or suppress with the reason",
+            ))
+
+
 def _check_visibility_boundary(tree: ast.Module, findings: list[Finding]) -> None:
     """J010: attribute access on the visibility state's row-filtering
     fields (`.tombstones`, `.retention_floor_ms`) outside the shared
@@ -938,6 +984,10 @@ def lint_file(path: Path) -> list[str]:
         (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
         for m in J010_EXEMPT
     )
+    in_j011_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J011_MODULES
+    ) and not any(posix.endswith(m) for m in J011_EXEMPT)
 
     idx = JitIndex()
     idx.visit(tree)
@@ -961,6 +1011,8 @@ def lint_file(path: Path) -> list[str]:
         _check_store_boundary(tree, findings)
     if in_j010_scope:
         _check_visibility_boundary(tree, findings)
+    if in_j011_scope:
+        _check_admission_boundary(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

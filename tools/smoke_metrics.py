@@ -95,6 +95,17 @@ REQUIRED_FAMILIES = (
     "horaedb_cardinality_rejected_samples_total",
     "horaedb_cardinality_rejected_series_total",
     "horaedb_cardinality_limited_requests_total",
+    # query-path admission control (server/admission.py): gauges +
+    # shed/deadline counters render from boot (children pre-registered),
+    # and queue wait is a first-class scan stage
+    "horaedb_query_inflight",
+    "horaedb_query_queued",
+    "horaedb_query_shed_total",
+    'horaedb_query_shed_total{reason="queue_full"',
+    'horaedb_query_shed_total{reason="stall"',
+    'horaedb_query_shed_total{reason="client_disconnect"',
+    "horaedb_query_deadline_exceeded_total",
+    'horaedb_scan_stage_seconds_bucket{stage="queue_wait"',
 )
 
 
@@ -252,6 +263,10 @@ async def run() -> int:
                       f"compile_s={plan.get('compile_s')} "
                       f"steady_s={plan.get('steady_s')} "
                       f"bound={plan.get('bound')}")
+                adm = plan.get("admission") or {}
+                check(adm.get("admitted") is True
+                      and "queue_wait_s" in adm,
+                      f"explain carries the admission verdict: {adm}")
             async with s.get(f"{base}/debug/kernels") as r:
                 cat = await r.json()
                 check(
@@ -322,6 +337,40 @@ async def run() -> int:
                               data=make_bulk_payload(40, 1)) as r:
                 check(r.status == 200,
                       "existing-series write still 200 at the limit")
+            # ---- query admission shedding: with the scheduler forced
+            # full, a query answers 503 + Retry-After (never a hang);
+            # reset restores service. A tiny per-request timeout= must
+            # answer 504 with the deadline taxonomy.
+            adm_ctl = app[STATE_KEY].admission
+            adm_ctl.force_full()
+            try:
+                async with s.post(f"{base}/api/v1/query", json={
+                    "metric": "smoke_cpu", "start_ms": 0, "end_ms": 10_000,
+                }) as r:
+                    check(r.status == 503,
+                          f"forced queue-full query answers 503 "
+                          f"(got {r.status})")
+                    check(r.headers.get("Retry-After", "").isdigit(),
+                          f"admission 503 carries Retry-After "
+                          f"({r.headers.get('Retry-After')!r})")
+            finally:
+                adm_ctl.reset_forced()
+            async with s.post(f"{base}/api/v1/query", json={
+                "metric": "smoke_cpu", "start_ms": 0, "end_ms": 10_000,
+            }) as r:
+                check(r.status == 200, "query recovers after admission reset")
+            async with s.post(f"{base}/api/v1/query", json={
+                "metric": "smoke_cpu", "start_ms": 0, "end_ms": 10_000,
+                "timeout": 1e-9,
+            }) as r:
+                body = await r.json()
+                check(r.status == 504
+                      and body.get("deadline_exceeded") is True,
+                      f"tiny timeout= answers 504 deadline-exceeded "
+                      f"(got {r.status}: {body})")
+            check(adm_ctl.inflight == 0,
+                  f"admission slots all freed (inflight="
+                  f"{adm_ctl.inflight})")
             async with s.get(f"{base}/metrics") as r:
                 text = await r.text()
         errors = validate(text)
