@@ -37,7 +37,9 @@ lint:
 # (J007), blocking flush work on the append path (J008), naked
 # object-store construction outside the ResilientStore boundary (J009),
 # ad-hoc tombstone/retention filtering off the shared visibility helper
-# (J010). Findings print as path:line: CODE message.
+# (J010), server query entries bypassing admission (J011), ad-hoc decode
+# of encoded SST lanes outside the sanctioned funnel (J012). Findings
+# print as path:line: CODE message.
 # Rules + suppression syntax: docs/static-analysis.md
 jaxlint:
 	python tools/jaxlint.py

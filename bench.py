@@ -270,6 +270,173 @@ def query_qps_lane(smoke: bool) -> dict:
     return {"query_qps": asyncio.run(run())}
 
 
+def scan_encoded_lane(smoke: bool) -> dict:
+    """Compressed-domain scan lane (storage/encoding.py + ops/decode.py):
+
+    - encode ns/row the flush path pays for the `.enc` sidecar;
+    - bytes/row on the wire per lane (the H2D shrink the encodings buy —
+      the acceptance bar is >=2x on the tsid/ts lanes);
+    - decode rows/s per (codec, impl) through the sanctioned funnel, plus
+      which impl the calibrated dispatcher picks per codec;
+    - end-to-end storage scans on the SAME tree, encoded-auto vs
+      HORAEDB_DECODE_IMPL=raw (the A/B honesty control): a filtered
+      config-2 shape (tsid InSet + value predicate) and a full-table
+      config-5 shape, best-of-3, scan block cache OFF so both paths pay
+      their decode every pass."""
+    import asyncio
+
+    import pyarrow as pa
+
+    from horaedb_tpu.objstore import MemStore
+    from horaedb_tpu.ops import decode as decode_ops
+    from horaedb_tpu.ops import filter as F
+    from horaedb_tpu.storage import (
+        ObjectBasedStorage,
+        ScanRequest,
+        StorageConfig,
+        TimeRange,
+        WriteRequest,
+    )
+    from horaedb_tpu.storage import encoding as enc_mod
+    from horaedb_tpu.common.size_ext import ReadableSize
+    from horaedb_tpu.storage.config import EncodingConfig
+
+    n = 30_000 if smoke else 1_000_000
+    n_series = 64 if smoke else 512
+    rng = np.random.default_rng(7)
+    tsid = np.sort(rng.integers(0, n_series, n, dtype=np.int64))
+    ts = 1_700_000_000_000 + np.arange(n, dtype=np.int64) * 15_000 \
+        + rng.integers(-4, 5, n)
+    vals = rng.normal(size=n)
+    table = pa.table({"tsid": tsid, "ts": ts, "value": vals})
+
+    # ---- encode cost + wire bytes --------------------------------------
+    reps = 2 if smoke else 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        e = enc_mod.encode_table(table, time_column="ts")
+    encode_ns = (time.perf_counter() - t0) / (reps * n) * 1e9
+    lane_ratio = {
+        name: round(l.decoded_bytes() / max(l.encoded_bytes(), 1), 2)
+        for name, l in e.lanes.items()
+    }
+    raw_bpr = sum(l.decoded_bytes() for l in e.lanes.values()) / n
+    enc_bpr = sum(l.encoded_bytes() for l in e.lanes.values()) / n
+
+    # ---- decode rows/s per (codec, impl) through the funnel ------------
+    # jaxlint: disable=J012 bench lane measuring the funnel's own decode rate
+    decode_rps: dict[str, dict] = {}
+    auto_impl: dict[str, str] = {}
+    for name, lane in e.lanes.items():
+        codec = lane.codec
+        if codec in decode_rps or codec in ("raw", "null"):
+            continue
+        per = {}
+        for impl in decode_ops.DECODE_IMPLS:
+            try:
+                enc_mod.decode_lane(lane, impl=impl)  # warm/compile
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    enc_mod.decode_lane(lane, impl=impl)
+                per[impl] = round(n / ((time.perf_counter() - t0) / reps))
+            except Exception:  # noqa: BLE001 — impl loses by forfeit
+                continue
+        decode_rps[codec] = per
+        auto_impl[codec] = decode_ops.choose(codec, n)
+
+    # ---- end-to-end scans: encoded-auto vs forced-raw ------------------
+    SEG = 24 * 3_600_000
+    cfg = StorageConfig(
+        encoding=EncodingConfig(enabled=True, min_rows=1),
+        scan_cache=ReadableSize(0),
+    )
+    schema = pa.schema([
+        ("tsid", pa.int64()), ("ts", pa.int64()), ("value", pa.float64()),
+    ])
+
+    async def build():
+        store = MemStore()
+        eng = await ObjectBasedStorage.try_new(
+            "bench", store, schema, num_primary_keys=1,
+            segment_duration_ms=SEG, config=cfg,
+            enable_compaction_scheduler=False,
+            start_background_merger=False,
+        )
+        # one segment: normalize ts into an ALIGNED [k*SEG, (k+1)*SEG)
+        t_lo = (1_700_000_000_000 // SEG + 1) * SEG
+        ts_n = t_lo + (ts - ts[0]) % SEG
+        batch = pa.RecordBatch.from_pydict(
+            {"tsid": tsid, "ts": ts_n, "value": vals}, schema=schema,
+        )
+        await eng.write(WriteRequest(
+            batch, TimeRange(int(ts_n.min()), int(ts_n.max()) + 1),
+        ))
+        return eng
+
+    async def scan_rows(eng, req) -> int:
+        rows = 0
+        async for b in eng.scan(req):
+            rows += b.num_rows
+        return rows
+
+    def timed_scan(eng, req, mode: str) -> float:
+        prior = os.environ.get("HORAEDB_DECODE_IMPL")
+        os.environ["HORAEDB_DECODE_IMPL"] = mode
+        try:
+            best = None
+            for _ in range(3 if not smoke else 2):
+                t0 = time.perf_counter()
+                asyncio.run(scan_rows(eng, req))
+                el = time.perf_counter() - t0
+                best = el if best is None else min(best, el)
+            return best
+        finally:
+            if prior is None:
+                os.environ.pop("HORAEDB_DECODE_IMPL", None)
+            else:
+                os.environ["HORAEDB_DECODE_IMPL"] = prior
+
+    eng = asyncio.run(build())
+    sel = tuple(int(x) for x in rng.choice(n_series, 8, replace=False))
+    shapes = {
+        "filtered": ScanRequest(
+            range=TimeRange(0, 2**62),
+            predicate=F.And(F.InSet("tsid", sel),
+                            F.Compare("value", "gt", 0.0)),
+        ),
+        "full": ScanRequest(range=TimeRange(0, 2**62)),
+    }
+    e2e: dict[str, dict] = {}
+    try:
+        for shape, req in shapes.items():
+            raw_s = timed_scan(eng, req, "raw")
+            enc_s = timed_scan(eng, req, "auto")
+            e2e[shape] = {
+                "raw_rows_per_sec": round(n / raw_s),
+                "encoded_rows_per_sec": round(n / enc_s),
+                "speedup": round(raw_s / enc_s, 3),
+            }
+    finally:
+        asyncio.run(eng.close())
+
+    return {
+        "scan_encoded": {
+            "rows": n,
+            "encode_ns_per_row": round(encode_ns, 1),
+            "bytes_per_row": {
+                "raw": round(raw_bpr, 2),
+                "encoded": round(enc_bpr, 2),
+                "ratio": round(raw_bpr / max(enc_bpr, 1e-9), 2),
+            },
+            "lane_ratios": lane_ratio,
+            "lane_codecs": dict(e.descriptor()),
+            "decode_rows_per_sec": decode_rps,
+            "decode_auto_impl": auto_impl,
+            "e2e": e2e,
+        }
+    }
+
+
 def main() -> None:
     # Probe BEFORE touching jax in this process (jax.devices() itself hangs
     # on a wedged tunnel); on failure, force the CPU backend so the bench
@@ -524,6 +691,9 @@ def main() -> None:
     # query QPS lane (admission scheduler): closed-loop p50/p99 vs
     # concurrency at 1/8/64 clients + shed rate (bench-smoke asserts it)
     result.update(query_qps_lane(SMOKE))
+    # compressed-domain scan lane (encoded sidecars + decode funnel):
+    # wire bytes/row, encode/decode rates, encoded-vs-raw e2e scans
+    result.update(scan_encoded_lane(SMOKE))
 
     # Last-chance accelerator retry, ONLY on the wedged-tunnel fallback
     # path (`not responsive`): the CPU fallback run itself took minutes —

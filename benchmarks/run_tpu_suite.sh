@@ -23,6 +23,9 @@ run() {
 # the §1 experiment harvest: every registered impl at a dense 64M-row
 # sorted shape + the unsorted contenders, one JSON line
 run python -m horaedb_tpu.ops.agg_registry --sweep 64000000
+# the decode-funnel harvest: host vs device decode per codec (the
+# compressed-domain scan's dispatcher inputs) at a dense 16M-row lane
+run python -m horaedb_tpu.ops.decode --sweep 16000000
 run python bench.py
 run python benchmarks/run_baselines.py
 run python benchmarks/ingest_bench.py 2000

@@ -39,13 +39,12 @@ from __future__ import annotations
 import contextvars
 import json
 import os
-import tempfile
-import threading
 import time
 from dataclasses import dataclass
 
 import numpy as np
 
+from horaedb_tpu.common.calib_cache import CalibCache
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
 
@@ -446,9 +445,6 @@ def run_unsorted(name: str, k, v, num_cells: int, weights=None):
 # amortizes with density — one winner does not serve both regimes
 DENSE_ROWS_PER_CELL = 8
 
-_cache_dir_override: str | None = None
-_state_lock = threading.Lock()
-_mem_cache: dict[str, dict] | None = None
 # last dispatcher decision, context-local first (accurate for code that
 # dispatches and attributes in the same coroutine/thread — read.py's
 # scanstats note), process-global fallback for observers in OTHER contexts
@@ -457,83 +453,37 @@ _last_choice_ctx: "contextvars.ContextVar[str | None]" = \
     contextvars.ContextVar("horaedb_agg_last_choice", default=None)
 _last_choice_global: str = "scatter"
 
+# persistence shared with ops/decode.py (common/calib_cache.py); the
+# inventory fields self-invalidate the file when the impl set changes
+_calib_cache = CalibCache(
+    env_var="HORAEDB_AGG_CACHE",
+    filename="agg_calib.json",
+    version=CALIB_VERSION,
+    tmp_prefix=".agg_calib.",
+    inventory=lambda: {
+        "sorted_impls": sorted(SORTED_IMPLS),
+        "unsorted_impls": sorted(UNSORTED_IMPLS),
+    },
+)
+
 
 def configure_cache_dir(path: str) -> None:
     """Point the calibration cache under the engine's data root (called by
     storage bring-up); HORAEDB_AGG_CACHE overrides with a full file path."""
-    global _cache_dir_override, _mem_cache
-    with _state_lock:
-        _cache_dir_override = path
-        _mem_cache = None
+    _calib_cache.configure_dir(path)
 
 
 def cache_path() -> str:
-    env = os.environ.get("HORAEDB_AGG_CACHE")
-    if env:
-        return env
-    base = _cache_dir_override or os.path.join(
-        tempfile.gettempdir(), "horaedb-tpu"
-    )
-    return os.path.join(base, "agg_calib.json")
+    return _calib_cache.path()
 
 
 def reset_cache(memory_only: bool = False) -> None:
     """Drop the in-memory view (tests); optionally leave the file."""
-    global _mem_cache
-    with _state_lock:
-        _mem_cache = None
-    if not memory_only:
-        try:
-            os.unlink(cache_path())
-        except OSError:
-            pass
+    _calib_cache.reset(memory_only)
 
 
-def _load_cache() -> dict:
-    global _mem_cache
-    with _state_lock:
-        if _mem_cache is not None:
-            return _mem_cache
-    data: dict = {}
-    try:
-        with open(cache_path(), encoding="utf-8") as f:
-            raw = json.load(f)
-        if (
-            isinstance(raw, dict)
-            and raw.get("version") == CALIB_VERSION
-            and raw.get("sorted_impls") == sorted(SORTED_IMPLS)
-            and raw.get("unsorted_impls") == sorted(UNSORTED_IMPLS)
-        ):
-            data = raw
-        # registry changed (new/removed impls or format): recalibrate
-    except (OSError, ValueError):
-        pass
-    with _state_lock:
-        _mem_cache = data
-    return data
-
-
-def _store_entry(key: str, entry: dict) -> None:
-    global _mem_cache
-    path = cache_path()
-    with _state_lock:
-        data = _mem_cache if _mem_cache else {}
-        data.setdefault("version", CALIB_VERSION)
-        data["sorted_impls"] = sorted(SORTED_IMPLS)
-        data["unsorted_impls"] = sorted(UNSORTED_IMPLS)
-        data.setdefault("entries", {})[key] = entry
-        _mem_cache = data
-        payload = json.dumps(data, indent=1, sort_keys=True)
-    try:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        fd, tmp = tempfile.mkstemp(
-            dir=os.path.dirname(path) or ".", prefix=".agg_calib."
-        )
-        with os.fdopen(fd, "w", encoding="utf-8") as f:
-            f.write(payload)
-        os.replace(tmp, path)  # atomic publish: readers never see a torn file
-    except OSError:
-        pass  # cache is an optimization; an unwritable root costs a re-A/B
+_load_cache = _calib_cache.load
+_store_entry = _calib_cache.store_entry
 
 
 def density_class(n: int, num_cells: int) -> str:

@@ -1,0 +1,464 @@
+"""On-device decode kernels for encoded SST lanes + the calibrated
+encoded-vs-host-vs-raw dispatcher (ROADMAP open item 1's device half).
+
+The compressed-domain scan ships QUALIFYING lanes to the device in their
+encoded form — bit-packed words instead of full-width rows — and expands
+them in device memory, shrinking H2D bytes/row at the source (the wall
+ROOFLINE §3 blames for config-5). Kernels are plain XLA (the tree's xjit
+idiom, same as ops/blockagg.py), built once per (codec, width, padded
+rows) and cached:
+
+  bit-unpack         shift/mask gather over a u32 word lane — each output
+                     element reads the two words its bit window can span
+                     (widths <= 32, the device envelope; wider pages fall
+                     back to the host funnel per page);
+  delta prefix-sum   dod timestamps: two `lax.associative_scan(add)`
+                     passes (log-depth vector prologue — the PR 3
+                     block_scan machinery's scan, reused on the decode
+                     path) over the unzigzagged second-order deltas;
+  xor prefix-scan    float values: `lax.associative_scan(bitwise_xor)`
+                     over the unpacked XOR stream, then a bitcast;
+  rle expand         run values gathered through a searchsorted over the
+                     cumulative run lengths.
+
+Dispatch is measured, not guessed (the ops/agg_registry.py envelope): a
+micro-A/B per (platform, codec) times host-numpy decode vs device decode
+once and persists the winner; `HORAEDB_DECODE_IMPL` pins (host | device |
+raw | auto), where `raw` disables the encoded read path entirely (the
+A/B-honesty control bench.py measures against). The choice is exported as
+`horaedb_decode_impl_total{impl=...}` and rides EXPLAIN provenance.
+
+Decoding an encoded lane anywhere outside this module or
+storage/encoding.py is a jaxlint J012 error (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import time
+from functools import lru_cache
+
+import numpy as np
+
+from horaedb_tpu.common.calib_cache import CalibCache
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.xprof import xjit
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+logger = logging.getLogger(__name__)
+
+DECODE_IMPL_TOTAL = GLOBAL_METRICS.counter(
+    "horaedb_decode_impl_total",
+    help="Decode lane the calibrated dispatcher selected per encoded-lane "
+         "decode (host numpy funnel vs on-device kernels).",
+    labelnames=("impl",),
+)
+for _i in ("host", "device"):
+    DECODE_IMPL_TOTAL.labels(_i)
+del _i
+
+DECODE_IMPLS = ("host", "device")
+# device bit-unpack envelope: one value spans at most two u32 words
+DEVICE_MAX_WIDTH = 32
+# pad rows to this granule so page-size jitter (last page of an SST) maps
+# to a handful of compiled shapes per (codec, width), not one per size
+_PAD_ROWS = 1024
+
+CALIB_VERSION = 1
+
+_U64_1 = np.uint64(1)
+
+
+# ---------------------------------------------------------------------------
+# kernels (xjit-instrumented; shapes static per cache key)
+# ---------------------------------------------------------------------------
+
+
+def _pad_rows(n: int) -> int:
+    return max(_PAD_ROWS, ((n + _PAD_ROWS - 1) // _PAD_ROWS) * _PAD_ROWS)
+
+
+def _words_for(n_pad: int, width: int) -> int:
+    # +1 guard word: the straddle read of the last element may touch it
+    return (n_pad * width + 31) // 32 + 1
+
+
+@lru_cache(maxsize=256)
+def _unpack_kernel(width: int, n_pad: int):
+    """words u32 -> u64 values at fixed bit `width` (LSB-first stream)."""
+    import jax.numpy as jnp
+
+    @xjit(kernel="decode_unpack")
+    def kernel(words):
+        bit = jnp.arange(n_pad, dtype=jnp.int64) * width
+        return _unpack_expr(jnp, words, bit, width)
+
+    return kernel
+
+
+def _unpack_expr(jnp, words, bit, width: int):
+    """Traced shift/mask bit-window read: each element gathers the two u32
+    words its `width`-bit window can span and shifts it out (the bitwidth-
+    unpack primitive of the decode path)."""
+    mask = np.uint64((1 << width) - 1)
+    wi = bit // 32
+    off = (bit % 32).astype(jnp.uint64)
+    w0 = jnp.take(words, wi).astype(jnp.uint64)
+    w1 = jnp.take(words, wi + 1).astype(jnp.uint64)
+    return ((w0 | (w1 << jnp.uint64(32))) >> off) & mask
+
+
+@lru_cache(maxsize=256)
+def _dod_kernel(width: int, n_pad: int):
+    """packed zigzag(d2) words + (first, first_delta) -> i64 values.
+
+    The stream holds dd of rows [2, rows); the kernel gathers it into a
+    row-aligned lane (rows 0/1 read zero), then two log-depth
+    `lax.associative_scan(add)` passes reconstruct deltas and values.
+    Mod-2^64 u64 arithmetic matches the host funnel bit for bit."""
+    import jax
+    import jax.numpy as jnp
+
+    @xjit(kernel="decode_dod")
+    def kernel(words, first, first_delta):
+        i = jnp.arange(n_pad, dtype=jnp.int64)
+        if width:
+            z = _unpack_expr(jnp, words, jnp.maximum(i - 2, 0) * width, width)
+        else:
+            z = jnp.zeros(n_pad, jnp.uint64)
+        dd = (z >> _U64_1) ^ (jnp.uint64(0) - (z & _U64_1))  # unzigzag
+        dd = jnp.where(i >= 2, dd, jnp.uint64(0))
+        # d[i] = first_delta + sum_{k<=i} dd[k] for i>=1; d[0] = 0
+        d = jnp.where(i >= 1, first_delta, jnp.uint64(0)) \
+            + jax.lax.associative_scan(jnp.add, dd)
+        # v[i] = first + sum_{k<=i} d[k]
+        v = first + jax.lax.associative_scan(jnp.add, d)
+        return v.view(jnp.int64)
+
+    return kernel
+
+
+@lru_cache(maxsize=256)
+def _xor_kernel(width: int, n_pad: int):
+    """packed xor stream + first bits -> u64 bit patterns via an
+    associative XOR scan (xor is associative: log-depth, fully vector).
+    Stream position j holds row j+1's xor delta; row 0 is the raw bits."""
+    import jax
+    import jax.numpy as jnp
+
+    @xjit(kernel="decode_xor")
+    def kernel(words, first_bits):
+        i = jnp.arange(n_pad, dtype=jnp.int64)
+        if width:
+            x = _unpack_expr(jnp, words, jnp.maximum(i - 1, 0) * width, width)
+        else:
+            x = jnp.zeros(n_pad, jnp.uint64)
+        x = jnp.where(i >= 1, x, first_bits)
+        return jax.lax.associative_scan(jnp.bitwise_xor, x)
+
+    return kernel
+
+
+@lru_cache(maxsize=64)
+def _rle_kernel(n_pad: int, runs_pad: int):
+    """run values + cumulative lengths -> expanded rows: one searchsorted
+    over the cumulative-run boundary lane + one gather."""
+    import jax.numpy as jnp
+
+    @xjit(kernel="decode_rle")
+    def kernel(values, cum):
+        idx = jnp.searchsorted(cum, jnp.arange(n_pad, dtype=cum.dtype),
+                               side="right")
+        return jnp.take(values, jnp.clip(idx, 0, runs_pad - 1))
+
+    return kernel
+
+
+def decode_page_device(codec: str, dtype: str, payload: bytes, rows: int,
+                       width: int, p0: int, p1: int,
+                       dict_values) -> "np.ndarray | None":
+    """Decode ONE encoded page on device and materialize the exact host
+    array; None when the page is outside the device envelope (the caller
+    falls back to the host funnel). The encoded payload — not the rows —
+    is what crosses the link inbound."""
+    import jax.numpy as jnp
+
+    dt = np.dtype(dtype)
+    if rows == 0:
+        return np.empty(0, dt)
+    if width > DEVICE_MAX_WIDTH:
+        return None
+    n_pad = _pad_rows(rows)
+
+    def words_lane(count: int) -> np.ndarray:
+        need = _words_for(n_pad, width)
+        w = np.zeros(need, np.uint32)
+        if width and count:
+            have = np.frombuffer(payload, "<u4",
+                                 count=(count * width + 31) // 32)
+            w[:len(have)] = have
+        return w
+
+    if codec == "dod":
+        if not np.issubdtype(dt, np.signedinteger):
+            return None
+        k = _dod_kernel(width, n_pad)
+        out = np.asarray(k(
+            words_lane(max(0, rows - 2)),
+            np.uint64(p0 & 0xFFFF_FFFF_FFFF_FFFF),
+            np.uint64(p1 & 0xFFFF_FFFF_FFFF_FFFF),
+        ))
+        return out[:rows].astype(dt, copy=False)
+    if codec == "xor":
+        if dt not in (np.float64, np.float32):
+            return None
+        k = _xor_kernel(width, n_pad)
+        bits = np.asarray(k(
+            words_lane(max(0, rows - 1)),
+            np.uint64(p0 & 0xFFFF_FFFF_FFFF_FFFF),
+        ))
+        if dt == np.float64:
+            return bits[:rows].view(np.float64)
+        return bits[:rows].astype(np.uint32).view(np.float32)
+    if codec == "dict":
+        if dict_values is None:
+            return None
+        k = _unpack_kernel(width, n_pad) if width else None
+        if width:
+            ids = np.asarray(k(words_lane(rows)))[:rows].astype(np.int64)
+        else:
+            ids = np.zeros(rows, np.int64)
+        from horaedb_tpu.storage.encoding import dict_array
+
+        return dict_array(dict_values, dt)[ids]
+    if codec == "rle":
+        n_runs = p0
+        if n_runs == 0:
+            return np.empty(0, dt)
+        vals = np.frombuffer(payload, dtype=dt.newbyteorder("<"),
+                             count=n_runs).astype(dt, copy=False)
+        lengths = np.frombuffer(payload, dtype="<u4", count=n_runs,
+                                offset=n_runs * dt.itemsize)
+        runs_pad = max(64, 1 << (n_runs - 1).bit_length())
+        vp = np.zeros(runs_pad, dt)
+        vp[:n_runs] = vals
+        cum = np.full(runs_pad, np.int64(rows), np.int64)
+        np.cumsum(lengths.astype(np.int64), out=cum[:n_runs])
+        k = _rle_kernel(_pad_rows(rows), runs_pad)
+        return np.asarray(k(vp, cum))[:rows]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# calibration + dispatch (the agg_registry envelope, decode-shaped)
+# ---------------------------------------------------------------------------
+
+_last_choice_ctx: "contextvars.ContextVar[str | None]" = \
+    contextvars.ContextVar("horaedb_decode_last_choice", default=None)
+_last_choice_global: str = "host"
+
+# persistence shared with ops/agg_registry.py (common/calib_cache.py)
+_calib_cache = CalibCache(
+    env_var="HORAEDB_DECODE_CACHE",
+    filename="decode_calib.json",
+    version=CALIB_VERSION,
+    tmp_prefix=".decode_calib.",
+)
+
+
+def configure_cache_dir(path: str) -> None:
+    """Point the calibration cache under the engine's data root (called
+    by storage bring-up); HORAEDB_DECODE_CACHE overrides with a full
+    file path."""
+    _calib_cache.configure_dir(path)
+
+
+def cache_path() -> str:
+    return _calib_cache.path()
+
+
+def reset_cache(memory_only: bool = False) -> None:
+    """Drop the in-memory view (tests); optionally leave the file."""
+    _calib_cache.reset(memory_only)
+
+
+_load_cache = _calib_cache.load
+_store_entry = _calib_cache.store_entry
+
+
+def _synth_lane(codec: str, n: int):
+    """Synthetic encoded lane of one codec class for the micro-A/B."""
+    from horaedb_tpu.storage import encoding as enc_mod
+
+    rng = np.random.default_rng(0xDEC)
+    if codec == "dod":
+        arr = (np.arange(n, dtype=np.int64) * 15_000
+               + rng.integers(-4, 5, n))
+        lane = enc_mod._encode_dod(arr, enc_mod.DEFAULT_PAGE_ROWS)
+    elif codec == "xor":
+        arr = rng.normal(size=n).astype(np.float64)
+        lane = enc_mod._encode_xor(arr, enc_mod.DEFAULT_PAGE_ROWS)
+    elif codec == "dict":
+        arr = rng.integers(0, 256, n, dtype=np.int64)
+        lane = enc_mod._encode_dict(arr, enc_mod.DEFAULT_PAGE_ROWS, 4096)
+    else:  # rle
+        arr = np.repeat(
+            rng.integers(0, 1 << 40, max(1, n // 64), dtype=np.int64), 64
+        )[:n]
+        lane = enc_mod._encode_rle(arr, enc_mod.DEFAULT_PAGE_ROWS)
+    lane.name = codec
+    return lane, arr
+
+
+def _calibrate(codec: str, platform: str) -> dict:
+    from horaedb_tpu.storage import encoding as enc_mod
+
+    try:
+        n = int(os.environ.get("HORAEDB_DECODE_CALIB_N", str(1 << 17)))
+    except ValueError:
+        n = 1 << 17
+    lane, arr = _synth_lane(codec, n)
+    ab: dict[str, float] = {}
+    rejected: dict[str, str] = {}
+    for impl in DECODE_IMPLS:
+        try:
+            out = enc_mod.decode_lane(lane, impl=impl)
+            ensure(np.array_equal(
+                out.view(np.uint64) if out.dtype == np.float64 else out,
+                arr.view(np.uint64) if arr.dtype == np.float64 else arr,
+            ), f"decode impl {impl} not bit-exact on {codec}")
+            t0 = time.perf_counter()
+            for _ in range(2):
+                enc_mod.decode_lane(lane, impl=impl)
+            ab[impl] = round(n / max((time.perf_counter() - t0) / 2, 1e-9))
+        except Exception as e:  # noqa: BLE001 — an impl that cannot run
+            # on this backend loses by forfeit, never kills dispatch
+            rejected[impl] = f"{type(e).__name__}: {e}"[:200]
+    if not ab:
+        ab = {"host": 0.0}
+    best = max(ab, key=ab.get)
+    return {
+        "impl": best, "ab": ab, "rejected": rejected, "n": n,
+        "calibrated_unix": int(time.time()),
+    }
+
+
+def calibration_entry(codec: str, platform: str | None = None) -> tuple[dict, str]:
+    if platform is None:
+        import jax
+
+        platform = jax.devices()[0].platform
+    key = f"{platform}/{codec}"
+    data = _load_cache()
+    entry = (data.get("entries") or {}).get(key)
+    if entry is not None:
+        return entry, "cache"
+    entry = _calibrate(codec, platform)
+    _store_entry(key, entry)
+    return entry, "calibrated"
+
+
+def _record(name: str) -> str:
+    global _last_choice_global
+    _last_choice_ctx.set(name)
+    _last_choice_global = name
+    DECODE_IMPL_TOTAL.labels(name).inc()
+    return name
+
+
+def scan_mode() -> str:
+    """The encoded-scan override: HORAEDB_DECODE_IMPL in {auto, host,
+    device, raw}. `raw` disables the encoded read path entirely (every
+    scan pays the full parquet decode — the A/B honesty control).
+    An unrecognized value degrades to `auto` with a once-per-value
+    warning: this runs on every v2-SST read, and a typo'd pin must not
+    turn every scan over an encoded tree into an error."""
+    mode = os.environ.get("HORAEDB_DECODE_IMPL", "auto")
+    if mode not in ("auto", "host", "device", "raw"):
+        _warn_bad_mode(mode)
+        return "auto"
+    return mode
+
+
+@lru_cache(maxsize=8)
+def _warn_bad_mode(mode: str) -> None:
+    logger.warning(
+        "HORAEDB_DECODE_IMPL=%r is not one of auto/host/device/raw; "
+        "treating as 'auto'", mode,
+    )
+
+
+def choose(codec: str, n: int, platform: str | None = None) -> str:
+    """Resolve the decode impl for one lane: env pin > calibration cache
+    (micro-A/B on first use). Small lanes pin to host — the device
+    dispatch overhead can never amortize under a page. raw/null lanes
+    have no device decode at all (decode_lane routes only
+    dod/xor/dict/rle through ops/decode.py), so they resolve to host
+    unconditionally: calibrating them would A/B a synthetic stand-in
+    lane, and a `device` verdict (pinned or calibrated) would put an
+    impl in the provenance that the lane never actually runs."""
+    if codec not in ("dod", "xor", "dict", "rle"):
+        scan_mode()  # still validate the env pin
+        return _record("host")
+    mode = scan_mode()
+    if mode in ("host", "device"):
+        return _record(mode)
+    if n < 2048:
+        return _record("host")
+    entry, _source = calibration_entry(codec, platform=platform)
+    return _record(entry["impl"])
+
+
+def last_choice() -> str:
+    ctx = _last_choice_ctx.get()
+    return ctx if ctx is not None else _last_choice_global
+
+
+# ---------------------------------------------------------------------------
+# sweep CLI (run_tpu_suite.sh: the decode half of the registry harvest)
+# ---------------------------------------------------------------------------
+
+
+def _sweep(n: int) -> dict:
+    """Force a fresh micro-A/B of every codec at `n` rows on this
+    platform and report rows/s per (codec, impl) plus the winner — the
+    decode analog of agg_registry --sweep, run by run_tpu_suite.sh the
+    moment hardware returns."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    os.environ["HORAEDB_DECODE_CALIB_N"] = str(n)
+    reset_cache(memory_only=True)
+    out: dict = {"metric": "decode_sweep", "platform": platform, "n": n}
+    codecs = {}
+    for codec in ("dod", "xor", "dict", "rle"):
+        entry = _calibrate(codec, platform)
+        codecs[codec] = {
+            "impl": entry["impl"],
+            "rows_per_sec": entry["ab"],
+            "rejected": entry["rejected"],
+        }
+    out["codecs"] = codecs
+    return out
+
+
+def main(argv: "list[str] | None" = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sweep", type=int, nargs="?", const=1 << 20,
+                    metavar="N_ROWS",
+                    help="A/B host vs device decode for every codec at "
+                         "N_ROWS and print one JSON line")
+    args = ap.parse_args(argv)
+    if args.sweep:
+        print(json.dumps(_sweep(args.sweep)))
+        return
+    ap.print_help()
+
+
+if __name__ == "__main__":
+    main()

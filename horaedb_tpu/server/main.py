@@ -533,6 +533,28 @@ def _explain_payload(st, mode: str, admission_verdict: dict | None = None) -> di
     scan_paths = sorted(
         k[len("path_"):] for k in counts if k.startswith("path_")
     )
+    # compressed-domain scan provenance (storage/encoding.py +
+    # ops/decode.py): which lanes scanned encoded and under which codec,
+    # the wire-vs-materialized byte split (compression ratio), what the
+    # zone maps / rle run skipping pruned before any decode, and which
+    # decode funnel the calibrated dispatcher ran
+    enc_lanes = {}
+    for k in counts:
+        if k.startswith("enclane_") and "=" in k:
+            lane, _, codec = k[len("enclane_"):].partition("=")
+            enc_lanes[lane] = codec
+    encoding = {
+        "lanes": enc_lanes,
+        "ssts_encoded": counts.get("ssts_encoded", 0),
+        "encoded_bytes": counts.get("encoded_bytes", 0),
+        "decoded_bytes": counts.get("decoded_bytes", 0),
+        "pages_pruned": counts.get("pages_pruned", 0),
+        "runs_skipped": counts.get("runs_skipped", 0),
+        "decode_impls": sorted(
+            k[len("decode_impl_"):] for k in counts
+            if k.startswith("decode_impl_")
+        ),
+    }
     compile_s = st.seconds.get("compile", 0.0)
     total_s = sum(att["lanes_s"].values())
     kernels = []
@@ -571,6 +593,7 @@ def _explain_payload(st, mode: str, admission_verdict: dict | None = None) -> di
         # seconds, estimated device cost, load at admission. None when the
         # query never reached admission (e.g. shed before a slot).
         "admission": admission_verdict,
+        "encoding": encoding,
         "counts": counts,
         "kernels": kernels,
     }
@@ -1279,10 +1302,13 @@ async def build_app(config: Config, store=None) -> web.Application:
             LocalStore(store_cfg.data_dir),
             retry=res.retry, breaker=res.breaker, name="local",
         )
-        # aggregation calibration cache lives under the data root (an S3
-        # deployment keeps the tmpdir default — the cache is per-BOX
-        # measurement, not shared state)
+        # aggregation + decode calibration caches live under the data root
+        # (an S3 deployment keeps the tmpdir default — the caches are
+        # per-BOX measurement, not shared state)
         agg_registry.configure_cache_dir(store_cfg.data_dir)
+        from horaedb_tpu.ops import decode as decode_ops
+
+        decode_ops.configure_cache_dir(store_cfg.data_dir)
     segment_ms = config.test.segment_duration.as_millis()
     # ThreadConfig sizes the dedicated executor for CPU-heavy SST work —
     # the analog of the reference's named multi-thread runtimes

@@ -209,6 +209,13 @@ class Executor:
                 *(self._storage.write_sst(fid, s) for fid, s in zip(ids, slices)),
                 return_exceptions=True,
             )
+            # compaction outputs carry the encoding descriptor of their
+            # fresh sidecar (pop_enc_meta): rewriting v1 inputs under an
+            # encoding-enabled config naturally upgrades the tree to
+            # format v2. Popped BEFORE the failure re-raise so successful
+            # siblings of a failed shard never strand their entries (the
+            # orphan objects themselves are GC'd at next open).
+            enc_metas = [self._storage.pop_enc_meta(fid) for fid in ids]
             for r in results:
                 if isinstance(r, BaseException):
                     raise r
@@ -221,9 +228,11 @@ class Executor:
                     num_rows=s.num_rows,
                     size=size,
                     time_range=time_range,
+                    format_version=fmt,
+                    encodings=encodings,
                 ),
             )
-            for fid, s, size in zip(ids, slices, sizes)
+            for fid, s, size, (fmt, encodings) in zip(ids, slices, sizes, enc_metas)
         ]
         logger.debug(
             "Compact output %d sst shard(s): ids=%s rows=%d",
@@ -255,14 +264,16 @@ class Executor:
             self._storage.parquet_reader.evict_cached(i)
         paths = [path_gen.generate(i) for i in ids]
         bloom_paths = [path_gen.generate_bloom(i) for i in ids]
+        enc_paths = [path_gen.generate_enc(i) for i in ids]
         results = await asyncio.gather(
             *(self._storage._store.delete(p) for p in paths),
             *(self._storage._store.delete(p) for p in bloom_paths),
+            *(self._storage._store.delete(p) for p in enc_paths),
             return_exceptions=True,
         )
         from horaedb_tpu.objstore import NotFound
 
-        for p, r in zip(paths + bloom_paths, results):
+        for p, r in zip(paths + bloom_paths + enc_paths, results):
             if isinstance(r, NotFound):
                 continue
             if isinstance(r, BaseException):

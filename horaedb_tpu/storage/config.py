@@ -112,6 +112,38 @@ class WriteConfig:
 
 
 @dataclass
+class EncodingConfig:
+    """Encoded-lane SST sidecars (storage/encoding.py) — the
+    compressed-domain scan's write side (TPU-build extension).
+
+    When enabled, every SST write also emits a `{id}.enc` sidecar holding
+    per-lane columnar encodings (dict/rle/dod/xor) with per-page zone
+    maps; readers evaluate predicates on the encoded form and ship
+    qualifying lanes to the device encoded (ops/decode.py). Disabled
+    tables write plain v1 SSTs; flipping the knob on upgrades the tree
+    naturally as compaction rewrites old files. Per-lane codec choice is
+    by measured encoded size, never configured."""
+
+    enabled: bool = False
+    # rows per encoded page (zone-map/pruning granule, shared across lanes)
+    page_rows: int = 4096
+    # dictionary-encoding cardinality ceiling per lane
+    max_dict: int = 4096
+    # SSTs below this row count skip the sidecar (the fixed header/page
+    # overhead outweighs any decode win on tiny registration batches)
+    min_rows: int = 256
+    # explicit lane allowlist; None encodes every eligible numeric lane
+    lanes: "list[str] | None" = None
+    # reader-side decoded-sidecar cache budget (LRU by resident bytes,
+    # like scan_cache for parquet row groups); 0 disables caching
+    sidecar_cache: ReadableSize = field(default_factory=lambda: ReadableSize.mb(32))
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "EncodingConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
 class ManifestConfig:
     """Manifest merger thresholds (config.rs; semantics in manifest/mod.rs):
     - soft limit: schedule a background merge;
@@ -162,6 +194,7 @@ class StorageConfig:
     answer to HBM limits (SURVEY §5.7/§7 risk (a))."""
 
     write: WriteConfig = field(default_factory=WriteConfig)
+    encoding: EncodingConfig = field(default_factory=EncodingConfig)
     manifest: ManifestConfig = field(default_factory=ManifestConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     update_mode: UpdateMode = UpdateMode.OVERWRITE

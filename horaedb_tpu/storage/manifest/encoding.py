@@ -30,12 +30,27 @@ from horaedb_tpu.storage.types import TimeRange
 
 MAGIC = 0xCAFE_1234
 VERSION = 1
+# Snapshot format v2 (TPU-build extension): appends the SST format_version
+# (storage/encoding.py: 2 = encoded-lane sidecar present) to each record.
+# The encoder emits v1 BYTES whenever every SST is format v1 — the
+# reference-conformance surface stays byte-exact for reference-shaped
+# trees, and only trees that actually hold encoded SSTs pay the new field.
+# Per-lane codec maps are NOT folded into the snapshot: the `.enc` sidecar
+# self-describes them (ground truth at read time) and pb deltas carry them
+# for provenance until the next merge.
+VERSION_ENC = 2
 HEADER_LEN = 14
 RECORD_LEN = 32
+RECORD_LEN_V2 = 36
 _HEADER = struct.Struct("<IBBQ")
 # One record: id u64 | start i64 | end i64 | size u32 | num_rows u32.
 _RECORD_DTYPE = np.dtype(
     [("id", "<u8"), ("start", "<i8"), ("end", "<i8"), ("size", "<u4"), ("num_rows", "<u4")]
+)
+# v2 record: v1 + format_version u32.
+_RECORD_DTYPE_V2 = np.dtype(
+    [("id", "<u8"), ("start", "<i8"), ("end", "<i8"), ("size", "<u4"),
+     ("num_rows", "<u4"), ("fmt", "<u4")]
 )
 
 
@@ -56,13 +71,18 @@ class Snapshot:
         ensure(len(data) >= HEADER_LEN, "snapshot shorter than header")
         magic, version, _flag, length = _HEADER.unpack_from(data, 0)
         ensure(magic == MAGIC, "invalid bytes to convert to header.")
-        ensure(version == VERSION, f"unsupported snapshot version: {version}")
+        ensure(version in (VERSION, VERSION_ENC),
+               f"unsupported snapshot version: {version}")
+        rec_len = RECORD_LEN if version == VERSION else RECORD_LEN_V2
+        rec_dtype = _RECORD_DTYPE if version == VERSION else _RECORD_DTYPE_V2
         body = data[HEADER_LEN:]
         ensure(len(body) == length, "snapshot length mismatch")
-        ensure(length % RECORD_LEN == 0, "snapshot body not a multiple of record size")
-        recs = np.frombuffer(body, dtype=_RECORD_DTYPE)
+        ensure(length % rec_len == 0, "snapshot body not a multiple of record size")
+        recs = np.frombuffer(body, dtype=rec_dtype)
         ssts: dict[int, SstFile] = {}
-        for rid, start, end, size, num_rows in recs.tolist():
+        for rec in recs.tolist():
+            rid, start, end, size, num_rows = rec[:5]
+            fmt = int(rec[5]) if version == VERSION_ENC else 1
             # Known reference quirk: a snapshot may contain duplicate file ids
             # (encoding.rs:304-305 cites horaedb#1608); last record wins here,
             # which also dedups on re-encode.
@@ -73,13 +93,17 @@ class Snapshot:
                     num_rows=int(num_rows),
                     size=int(size),
                     time_range=TimeRange(int(start), int(end)),
+                    format_version=max(1, fmt),
                 ),
             )
         return cls(ssts=ssts)
 
     def to_bytes(self) -> bytes:
         files = list(self.ssts.values())
-        recs = np.empty(len(files), dtype=_RECORD_DTYPE)
+        # v1 bytes unless an encoded SST forces the v2 record (see
+        # VERSION_ENC above) — all-v1 trees stay reference-byte-exact
+        v2 = any(f.meta.format_version > 1 for f in files)
+        recs = np.empty(len(files), dtype=_RECORD_DTYPE_V2 if v2 else _RECORD_DTYPE)
         # column-wise fills vectorize the encode (one tuple-assignment per
         # record was the hot spot in benchmarks/encoding_bench.py)
         recs["id"] = [f.id for f in files]
@@ -87,8 +111,10 @@ class Snapshot:
         recs["end"] = [f.meta.time_range.end for f in files]
         recs["size"] = [f.meta.size for f in files]
         recs["num_rows"] = [f.meta.num_rows for f in files]
+        if v2:
+            recs["fmt"] = [f.meta.format_version for f in files]
         body = recs.tobytes()
-        return _HEADER.pack(MAGIC, VERSION, 0, len(body)) + body
+        return _HEADER.pack(MAGIC, VERSION_ENC if v2 else VERSION, 0, len(body)) + body
 
     # -- delta application (order matters: adds then deletes, because delta
     # -- files are read unsorted; reference manifest/mod.rs:289-299) ---------

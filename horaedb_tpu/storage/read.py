@@ -980,6 +980,7 @@ class ParquetReader:
         schema: StorageSchema,
         scan_block_rows: int = 32 * 1024 * 1024,
         scan_cache_bytes: int = 0,
+        enc_cache_bytes: int = 32 * 1024 * 1024,
     ):
         self._store = store
         self._path_gen = sst_path_gen
@@ -1015,6 +1016,22 @@ class ParquetReader:
         # pruned row groups are ALL cached skip the store entirely (footers
         # are tiny; evicted with the sst)
         self._meta_cache: dict[int, tuple] = {}
+        # sst_id -> (decoded `.enc` sidecar, resident bytes). Value None =
+        # probed, absent/unreadable. Encoded sidecars are immutable like
+        # their SSTs; LRU by RESIDENT BYTES like the block cache above
+        # (a 1M-row sidecar is ~MBs decoded — an entry-count bound would
+        # leave the footprint unbounded across big SSTs), deletes evict,
+        # cap 0 disables. Cold fetches single-flight per sst id so N
+        # concurrent scans over a fresh tree pay one GET+decode, not N.
+        self._enc_cache: "OrderedDict[int, tuple[object, int]]" = OrderedDict()
+        self._enc_cache_bytes = 0
+        self._enc_cache_cap = enc_cache_bytes
+        self._enc_lock = threading.Lock()
+        # sst_id -> (owning loop, future) for the in-flight sidecar fetch;
+        # futures are loop-bound, so a caller on a DIFFERENT loop (engines
+        # are occasionally driven from more than one) duplicates the fetch
+        # rather than awaiting across loops
+        self._enc_inflight: "dict[int, tuple[object, object]]" = {}
         # Zero-arg callable returning the table's current Visibility (or
         # None) — retention + tombstone masking applied to EVERY read_sst
         # result via the shared helper (storage/visibility.py, jaxlint
@@ -1112,7 +1129,11 @@ class ParquetReader:
     ) -> pa.Table:
         """Read one SST's projected columns, skipping row groups whose
         min/max statistics can't satisfy the predicate (and whole SSTs whose
-        bloom sidecar rules the predicate out)."""
+        bloom sidecar rules the predicate out). Format-v2 SSTs serve
+        qualifying reads from the encoded-lane sidecar instead (predicates
+        evaluate on the encoded form, pages prune on zone maps, lanes
+        decode through the sanctioned funnel) — per SST, so mixed v1/v2
+        trees scan exactly with each file on its own path."""
         # cooperative deadline per SST read: an expired query stops
         # paying IO + decode here, SST by SST (common/deadline.py)
         deadline_ctx.check("sst_read")
@@ -1125,6 +1146,35 @@ class ParquetReader:
                 if columns is None or f.name in columns
             ]
             return pa.schema(fields).empty_table()
+        if sst.meta.format_version >= 2:
+            from horaedb_tpu.ops import decode as decode_ops
+
+            if decode_ops.scan_mode() != "raw":
+                enc = await self._enc_sidecar(sst)
+                if enc is not None:
+                    # off-loop like the parquet decode below: a full-SST
+                    # numpy expansion (and, on first use, the decode
+                    # calibration micro-A/B incl. kernel compiles) must
+                    # not freeze the event loop's admission/deadline/
+                    # cancellation machinery
+                    try:
+                        table = await asyncio.to_thread(
+                            self._read_encoded, enc, columns, predicate
+                        )
+                    except Exception:  # noqa: BLE001 — the parquet
+                        # object is authoritative: ANY malformed-sidecar
+                        # decode error (truncated payload a header-level
+                        # check missed, lying page metadata) degrades
+                        # this read, never 500s the query
+                        logger.warning(
+                            "encoded read failed for sst %d; falling "
+                            "back to parquet", sst.id, exc_info=True,
+                        )
+                        table = None
+                    if table is not None:
+                        scanstats.note("ssts_read")
+                        scanstats.note("ssts_encoded")
+                        return self._mask_visibility(sst, table)
         scanstats.note("ssts_read")
         cols_key = tuple(sorted(columns)) if columns is not None else ("*",)
         rg_cache = self._rg_cache_hooks(sst.id, cols_key) if use_block_cache else None
@@ -1200,6 +1250,186 @@ class ParquetReader:
             raise NotFound(f"sst object vanished: {path}") from e
         return self._mask_visibility(sst, table)
 
+    async def _enc_sidecar(self, sst: SstFile):
+        """Cached decoded `.enc` sidecar of a format-v2 SST, or None
+        (absent/corrupt — the parquet path covers it; a manifest-registered
+        v2 SST always has one, so a miss is a degraded store, not a bug)."""
+        loop = asyncio.get_running_loop()
+        fut = None
+        while True:
+            with self._enc_lock:
+                hit = self._enc_cache.get(sst.id)
+                if hit is not None:
+                    self._enc_cache.move_to_end(sst.id)
+                    return hit[0]
+                flight = self._enc_inflight.get(sst.id)
+                if flight is None:
+                    fut = loop.create_future()
+                    self._enc_inflight[sst.id] = (loop, fut)
+                    break
+            f_loop, f_fut = flight
+            if f_loop is not loop:
+                break  # cross-loop caller: duplicate the fetch for this read
+            # single-flight: the leader resolves the future with its verdict
+            # (None on a transient failure — this read falls back to parquet)
+            return await f_fut
+        enc, cacheable = None, False
+        try:
+            enc, cacheable = await self._fetch_enc_sidecar(sst)
+        finally:
+            if fut is not None:
+                if cacheable:
+                    self._enc_cache_put(sst.id, enc)
+                with self._enc_lock:
+                    entry = self._enc_inflight.get(sst.id)
+                    if entry is not None and entry[1] is fut:
+                        del self._enc_inflight[sst.id]
+                if not fut.done():
+                    fut.set_result(enc)
+        if fut is None and cacheable:
+            self._enc_cache_put(sst.id, enc)
+        return enc
+
+    def _enc_cache_put(self, sst_id: int, enc) -> None:
+        if self._enc_cache_cap <= 0:
+            return
+        nbytes = 64 if enc is None else enc.footprint_bytes() + 64
+        with self._blk_lock:
+            tomb = self._tombstoned(sst_id)
+        with self._enc_lock:
+            if not tomb and sst_id not in self._enc_cache:
+                self._enc_cache[sst_id] = (enc, nbytes)
+                self._enc_cache_bytes += nbytes
+                while self._enc_cache_bytes > self._enc_cache_cap and self._enc_cache:
+                    _, (_, nb) = self._enc_cache.popitem(last=False)
+                    self._enc_cache_bytes -= nb
+
+    async def _fetch_enc_sidecar(self, sst: SstFile):
+        """One store fetch + decode of an SST's `.enc` object. Returns
+        (enc-or-None, cacheable): transient store failures are NOT
+        cacheable (the SST is immutable; a cached None would downgrade it
+        to parquet for the entry's lifetime), NotFound and corrupt bytes
+        are deterministic verdicts and are."""
+        from horaedb_tpu.objstore import NotFound
+        from horaedb_tpu.storage import encoding as enc_mod
+
+        t0 = time.perf_counter()
+        try:
+            # deducted record, not a nested stage(): the callers wrap
+            # read_sst in their own io_decode block, and a nested stage
+            # would double-attribute this fetch to the io lane
+            data = await self._store.get(self._path_gen.generate_enc(sst.id))
+        except NotFound:
+            enc = None  # definitively absent: cacheable
+        except Exception:  # noqa: BLE001 — a TRANSIENT store failure
+            # (breaker open, retries exhausted, deadline spent) must not
+            # poison the cache. Fall back for THIS read only.
+            logger.warning(
+                "enc sidecar fetch failed for sst %d (transient; "
+                "falling back to parquet for this read)", sst.id,
+            )
+            scanstats.record(
+                "io_decode", time.perf_counter() - t0, deduct=True
+            )
+            return None, False
+        else:
+            try:
+                enc = enc_mod.decode_blob(data)
+                if enc.num_rows != sst.meta.num_rows:
+                    raise HoraeError(
+                        f"enc sidecar rows {enc.num_rows} != "
+                        f"sst {sst.meta.num_rows}"
+                    )
+            except Exception:  # noqa: BLE001 — corrupt sidecar bytes are
+                # deterministic (the object is immutable): cache the miss;
+                # the parquet object remains authoritative
+                logger.warning("unreadable enc sidecar for sst %d", sst.id)
+                enc = None
+        scanstats.record("io_decode", time.perf_counter() - t0, deduct=True)
+        return enc, True
+
+    def _read_encoded(self, enc, columns, predicate) -> "pa.Table | None":
+        """Serve one SST read from its encoded sidecar: per-page zone
+        pruning, predicate evaluation on the ENCODED form (rle run
+        skipping, dict-id rewrite — storage/encoding.py), then decode of
+        the surviving pages only, through the dispatcher-chosen funnel
+        (ops/decode.py device kernels or the host numpy funnel). None =
+        the sidecar does not cover the requested lanes; caller falls back
+        to parquet. Row-exact: the predicate filter here runs BEFORE the
+        merge exactly like the reference plan's FilterExec, so dropping
+        rejected rows early is semantically identical to the parquet
+        path's later row-wise mask."""
+        from horaedb_tpu.ops import decode as decode_ops
+        from horaedb_tpu.storage import encoding as enc_mod
+
+        schema = self._schema.arrow_schema
+        names = [
+            f.name for f in schema if columns is None or f.name in columns
+        ]
+        if any(n not in enc.lanes for n in names):
+            return None
+        fields = [schema.field(schema.names.index(n)) for n in names]
+        keep_pages, pruned = enc_mod.prune_pages(enc, predicate)
+        if pruned:
+            scanstats.note("pages_pruned", pruned)
+        # per-lane encoding provenance (EXPLAIN `encoding.lanes`)
+        for n in names:
+            scanstats.note(f"enclane_{n}={enc.lanes[n].codec}", 0)
+        if not keep_pages:
+            return pa.schema(fields).empty_table()
+
+        def lane_decode(n: str) -> np.ndarray:
+            """Full-lane decode through the CALIBRATED dispatcher — the
+            single decode entry for predicate eval and materialization,
+            so the env pin and the decode_impl provenance cover both."""
+            lane = enc.lanes[n]
+            rows = sum(lane.pages[p].rows for p in keep_pages)
+            impl = decode_ops.choose(lane.codec, rows)
+            scanstats.note(f"decode_impl_{impl}", 0)
+            return enc_mod.decode_lane(lane, keep_pages, impl=impl)
+
+        # deducted stage, not a nested stage(): read_sst runs inside the
+        # callers' io_decode stage blocks, and attribution must count the
+        # expansion ONCE — in the decode lane, with any first-use kernel
+        # compile inside the block deducted into ITS lane, not both
+        with scanstats.deducted_stage("decode"):
+            decoded: dict[str, np.ndarray] = {}
+            mask = None
+            if predicate is not None:
+                stats = enc_mod.EncodedEvalStats()
+                mask = enc_mod.encoded_mask(
+                    enc, predicate, keep_pages, stats, decoded,
+                    decode=lane_decode,
+                )
+                if stats.runs_skipped:
+                    scanstats.note("runs_skipped", stats.runs_skipped)
+                if mask is not None and bool(mask.all()):
+                    mask = None  # nothing rejected: skip the take
+            sel = np.nonzero(mask)[0] if mask is not None else None
+            if sel is not None and len(sel) == 0:
+                return pa.schema(fields).empty_table()
+            arrays = []
+            enc_bytes = dec_bytes = 0
+            for n in names:
+                lane = enc.lanes[n]
+                if lane.codec == "null":
+                    count = len(sel) if sel is not None else sum(
+                        lane.pages[p].rows for p in keep_pages
+                    )
+                    arrays.append(pa.nulls(count, fields[names.index(n)].type))
+                    continue
+                arr = decoded.get(n)
+                if arr is None:
+                    arr = lane_decode(n)
+                enc_bytes += sum(lane.pages[p].length for p in keep_pages)
+                dec_bytes += arr.nbytes
+                if sel is not None:
+                    arr = arr[sel]
+                arrays.append(_np_to_arrow(arr, fields[names.index(n)].type))
+            scanstats.note("encoded_bytes", enc_bytes)
+            scanstats.note("decoded_bytes", dec_bytes)
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
     def _mask_visibility(self, sst: SstFile, table: pa.Table) -> pa.Table:
         """Retention + tombstone masking via the SHARED helper
         (storage/visibility.py) — the single funnel every scan route,
@@ -1223,6 +1453,10 @@ class ParquetReader:
             entry = self._pf_cache.pop(self._path_gen.generate(file_id), None)
         with self._bloom_lock:
             self._bloom_cache.pop(file_id, None)
+        with self._enc_lock:
+            ent = self._enc_cache.pop(file_id, None)
+            if ent is not None:
+                self._enc_cache_bytes -= ent[1]
         with self._blk_lock:
             self._meta_cache.pop(file_id, None)
             for key in [k for k in self._blk_cache if k[0] == file_id]:

@@ -26,6 +26,7 @@ Stage sums can exceed wall clock (stages from concurrent SST reads overlap).
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from contextvars import ContextVar
@@ -55,8 +56,12 @@ STAGE_SECONDS = GLOBAL_METRICS.histogram(
 )
 # Pre-register the canonical lanes so /metrics always exposes the full
 # attribution surface (zero-count histograms), even before the first scan
-# routes through a given lane on this process.
-for _lane in ("io_decode", "host_prep", "transfer", "kernel", "compile"):
+# routes through a given lane on this process. `decode` is the
+# encoded-lane expansion stage (storage/encoding.py + ops/decode.py) —
+# first-class because the compressed-domain scan's whole bet is moving
+# wall time from io_decode/transfer into this (much smaller) lane.
+for _lane in ("io_decode", "host_prep", "transfer", "kernel", "compile",
+              "decode"):
     STAGE_SECONDS.labels(_lane)
 del _lane
 
@@ -71,6 +76,7 @@ _BOUND_LANE = {
     "device_agg": "kernel",
     "kernel": "kernel",
     "compile": "compile",
+    "decode": "decode",
 }
 
 
@@ -101,7 +107,7 @@ class ScanStats:
         roofline story — xprof's kernel catalog supplies the predicted
         FLOPs/bytes envelope, this supplies the measured split."""
         lanes = {"io": 0.0, "host": 0.0, "transfer": 0.0, "kernel": 0.0,
-                 "compile": 0.0}
+                 "compile": 0.0, "decode": 0.0}
         for stage_name, secs in self.seconds.items():
             lanes[_BOUND_LANE.get(stage_name, "host")] += secs
         bound = max(lanes, key=lanes.get) if any(lanes.values()) else None
@@ -121,9 +127,37 @@ _ACTIVE: ContextVar[ScanStats | None] = ContextVar("horaedb_scan_stats", default
 # never actually say "compile". record("compile", ...) credits the cell;
 # stage() subtracts it from its own elapsed time on close and propagates
 # it to the enclosing stage's cell (nested stages must deduct too).
-_COMPILE_DEDUCT: ContextVar["list[float] | None"] = ContextVar(
+_COMPILE_DEDUCT: ContextVar["_DeductCell | None"] = ContextVar(
     "horaedb_scan_compile_deduct", default=None
 )
+
+
+class _DeductCell:
+    """Deduction accumulator for one open stage. Credits arrive from
+    WORKER THREADS too — asyncio.to_thread copies the context, so the
+    concurrent per-SST decodes under one io_decode stage all share the
+    enclosing stage's cell — hence the lock (a bare `+=` is a lost-update
+    race) and the cap: cumulative credit never exceeds the stage's
+    elapsed wall, so overlapping thread-seconds deduct at most the time
+    that could physically have overlapped and the stage's own lane never
+    silently absorbs a negative."""
+
+    __slots__ = ("_t0", "_total", "_lock")
+
+    def __init__(self) -> None:
+        self._t0 = time.perf_counter()
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, secs: float) -> None:
+        with self._lock:
+            self._total = min(
+                self._total + secs, time.perf_counter() - self._t0
+            )
+
+    def total(self) -> float:
+        with self._lock:
+            return self._total
 
 
 @contextmanager
@@ -147,34 +181,67 @@ def stage(name: str):
     perf_counter calls + one histogram observe are noise next to the work
     itself."""
     st = _ACTIVE.get()
-    cell = [0.0]
+    cell = _DeductCell()
     token = _COMPILE_DEDUCT.set(cell)
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        dt = max(0.0, time.perf_counter() - t0 - cell[0])
+        dt = max(0.0, time.perf_counter() - t0 - cell.total())
         _COMPILE_DEDUCT.reset(token)
         outer = _COMPILE_DEDUCT.get()
         if outer is not None:
-            outer[0] += cell[0]
+            outer.add(cell.total())
         if st is not None:
             st.add(name, dt)
         STAGE_SECONDS.labels(_STAGE_LANE.get(name, name)).observe(dt)
         tracing.add_stage(name, dt)
 
 
-def record(name: str, secs: float) -> None:
+@contextmanager
+def deducted_stage(name: str):
+    """stage() for expansion work that runs INSIDE another stage's block
+    (the encoded read path's `decode` lane runs inside the callers'
+    `io_decode` stages): times the body, subtracts any nested deduction
+    credits (a first-use kernel compile fires mid-decode and records the
+    compile lane via xprof) so the compile seconds are not counted in
+    BOTH the compile and this lane, then records the net with
+    record(..., deduct=True) so the enclosing stage deducts the whole
+    wall — every second lands in exactly one lane."""
+    cell = _DeductCell()
+    token = _COMPILE_DEDUCT.set(cell)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = max(0.0, time.perf_counter() - t0 - cell.total())
+        _COMPILE_DEDUCT.reset(token)
+        outer = _COMPILE_DEDUCT.get()
+        if outer is not None:
+            # nested credits (compile) must also deduct from the
+            # enclosing stage; record() below adds `dt` itself
+            outer.add(cell.total())
+        record(name, dt, deduct=True)
+
+
+def record(name: str, secs: float, *, deduct: "bool | None" = None) -> None:
     """Fold an externally-timed duration in as if a stage() block measured
     it: collector + process histogram + active trace span. xprof reports
     compile time through this (the compile happens inside jax's dispatch,
     where no `with stage(...):` block can wrap it); a compile recorded
     inside an open stage is deducted from that stage so the time is
-    attributed ONCE — to the compile lane."""
-    if name == "compile":
+    attributed ONCE — to the compile lane. `deduct=True` extends the
+    same once-only attribution to any lane recorded inside an enclosing
+    stage (the encoded read path records its `decode` expansion and
+    sidecar-fetch time this way from inside the callers' `io_decode`
+    blocks — without the deduction, io would double-count every decode
+    second and `bound` could never say "decode")."""
+    if deduct is None:
+        deduct = name == "compile"
+    if deduct:
         cell = _COMPILE_DEDUCT.get()
         if cell is not None:
-            cell[0] += secs
+            cell.add(secs)
     st = _ACTIVE.get()
     if st is not None:
         st.add(name, secs)

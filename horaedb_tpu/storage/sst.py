@@ -65,12 +65,21 @@ def ensure_id_above(floor: int) -> None:
 
 @dataclass(frozen=True)
 class FileMeta:
-    """SST metadata carried in the manifest (sst.rs FileMeta)."""
+    """SST metadata carried in the manifest (sst.rs FileMeta).
+
+    TPU-build extension: `format_version` 2 marks an SST with an
+    encoded-lane sidecar (`{id}.enc`, storage/encoding.py) and
+    `encodings` names each lane's codec — the descriptor readers gate on
+    (v1 SSTs take the full parquet decode path) and EXPLAIN surfaces.
+    Mixed-version trees scan exactly; compaction rewrites v1 inputs into
+    v2 outputs when encoding is enabled, upgrading the tree naturally."""
 
     max_sequence: int
     num_rows: int
     size: int
     time_range: TimeRange
+    format_version: int = 1
+    encodings: tuple[tuple[str, str], ...] = ()
 
 
 @dataclass
@@ -106,6 +115,12 @@ class SstFile:
         pb.meta.size = self.meta.size
         pb.meta.time_range.start = self.meta.time_range.start
         pb.meta.time_range.end = self.meta.time_range.end
+        if self.meta.format_version > 1:
+            pb.meta.format_version = self.meta.format_version
+            for column, codec in self.meta.encodings:
+                e = pb.meta.encodings.add()
+                e.column = column
+                e.codec = codec
         return pb
 
     @classmethod
@@ -119,6 +134,12 @@ class SstFile:
                 num_rows=pb.meta.num_rows,
                 size=pb.meta.size,
                 time_range=TimeRange(pb.meta.time_range.start, pb.meta.time_range.end),
+                # proto3 absent scalar decodes 0: a delta written before
+                # the format existed is a v1 (plain parquet) SST
+                format_version=max(1, pb.meta.format_version),
+                encodings=tuple(
+                    (e.column, e.codec) for e in pb.meta.encodings
+                ),
             ),
         )
 
@@ -136,3 +157,9 @@ class SstPathGenerator:
         """Sidecar bloom-filter object (pyarrow cannot write parquet blooms;
         see storage/bloom.py)."""
         return f"{self.prefix}/{PREFIX_PATH}/{file_id}.bloom"
+
+    def generate_enc(self, file_id: int) -> str:
+        """Encoded-lane sidecar of a format-v2 SST (storage/encoding.py):
+        per-lane columnar encodings + zone maps the compressed-domain scan
+        reads instead of the parquet columns."""
+        return f"{self.prefix}/{PREFIX_PATH}/{file_id}.enc"

@@ -198,6 +198,9 @@ class ObjectBasedStorage(ColumnarStorage):
                 TOMBSTONES_APPLIED.labels(self._root, ctx)
         self._sst_executor = sst_executor
         self._segment_duration = segment_duration_ms
+        # file_id -> (format_version, encodings) of a just-written SST,
+        # consumed by the FileMeta construction site (write / compaction)
+        self._pending_enc: dict[int, tuple] = {}
         self._schema = StorageSchema.try_new(
             arrow_schema, num_primary_keys, config.update_mode
         )
@@ -238,6 +241,7 @@ class ObjectBasedStorage(ColumnarStorage):
             store, self._path_gen, self._schema,
             scan_block_rows=config.scan_block_rows,
             scan_cache_bytes=config.scan_cache.as_bytes(),
+            enc_cache_bytes=config.encoding.sidecar_cache.as_bytes(),
         )
         # EVERY SST read (materializing scan, chunked scan, downsample
         # pushdown, compaction) funnels through the shared visibility mask
@@ -283,7 +287,7 @@ class ObjectBasedStorage(ColumnarStorage):
         for m in metas:
             name = m.path.rsplit("/", 1)[-1]
             stem, _, ext = name.partition(".")
-            if ext not in ("sst", "bloom") or not stem.isdigit():
+            if ext not in ("sst", "bloom", "enc") or not stem.isdigit():
                 continue
             fid = int(stem)
             if fid in live:
@@ -437,11 +441,14 @@ class ObjectBasedStorage(ColumnarStorage):
                 req.batch, presorted=req.presorted, seq=req.seq,
                 fast_encode=req.fast_encode,
             )
+            fmt, encodings = self.pop_enc_meta(result.id)
             meta = FileMeta(
                 max_sequence=result.seq,
                 num_rows=req.batch.num_rows,
                 size=result.size,
                 time_range=req.time_range,
+                format_version=fmt,
+                encodings=encodings,
             )
             await self._manifest.add_file(result.id, meta)
         WRITE_ROWS.labels(self._root).inc(req.batch.num_rows)
@@ -650,7 +657,11 @@ class ObjectBasedStorage(ColumnarStorage):
                 FLUSH_STAGE_SECONDS.labels(self._root, "upload").observe(
                     time.perf_counter() - t_up
                 )
+            # bloom first, enc LAST: _write_enc_sidecar registers the
+            # pending (format, encodings) entry only once nothing after
+            # it can fail, so a failed write never strands it
             await self._write_bloom_sidecar(file_id, path, table)
+            await self._write_enc_sidecar(file_id, path, table)
             SST_BYTES.observe(len(blob))
             return len(blob)
 
@@ -744,9 +755,60 @@ class ObjectBasedStorage(ColumnarStorage):
                     pass
                 done.wait(timeout=0.05)
 
+        # bloom first, enc last (see write_sst fast path): the pending
+        # enc entry must be the final fallible step
         await self._write_bloom_sidecar(file_id, path, table)
+        await self._write_enc_sidecar(file_id, path, table)
         SST_BYTES.observe(size)
         return size
+
+    def pop_enc_meta(self, file_id: int) -> tuple[int, tuple]:
+        """(format_version, encodings) of a just-written SST — consumed
+        exactly once by the FileMeta construction site."""
+        return self._pending_enc.pop(file_id, (1, ()))
+
+    async def _write_enc_sidecar(self, file_id: int, path: str, table) -> None:
+        """Encoded-lane sidecar AFTER the SST object lands and BEFORE the
+        manifest can reference it — a registered v2 SST always has its
+        sidecar. Encode cost is attributed per table
+        (horaedb_flush_stage_seconds{stage=enc_encode}); a failed PUT
+        reclaims the SST object best-effort and raises, exactly like the
+        bloom sidecar path."""
+        cfg = self._config.encoding
+        if not cfg.enabled or table.num_rows < cfg.min_rows:
+            return
+        from horaedb_tpu.storage import encoding as enc_mod
+
+        def _encode_and_pack():
+            # blob serialization rides the same offload as the encode:
+            # b"".join over multi-MB lane payloads on the event loop would
+            # stall admission/deadline servicing during flush bursts
+            e = enc_mod.encode_table(
+                table, cfg.page_rows, cfg.max_dict,
+                self._time_column, cfg.lanes,
+            )
+            return (e, enc_mod.encode_blob(e)) if e is not None else (None, None)
+
+        try:
+            t0 = time.perf_counter()
+            enc, blob = await self._run_sst(_encode_and_pack)
+            if enc is None:
+                return
+            FLUSH_STAGE_SECONDS.labels(self._root, "enc_encode").observe(
+                time.perf_counter() - t0
+            )
+            await self._store.put(self._path_gen.generate_enc(file_id), blob)
+        except BaseException:
+            try:
+                await self._store.delete(path)
+            except Exception:  # noqa: BLE001 — orphan cleanup best-effort
+                logger.warning(
+                    "orphaned sst object %s after enc sidecar failure", path
+                )
+            raise
+        self._pending_enc[file_id] = (
+            enc_mod.SST_FORMAT_V2, enc.descriptor(),
+        )
 
     async def _write_bloom_sidecar(self, file_id: int, path: str, table) -> None:
         """Bloom sidecar AFTER the SST lands: readers only learn ids via the

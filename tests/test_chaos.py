@@ -951,3 +951,99 @@ class TestOverloadChaos:
             assert chaos.injected_errors > 0  # the fault plan actually fired
         finally:
             await client.close()
+
+
+class TestEncodedChaosSoak:
+    @async_test
+    async def test_encoded_ssts_survive_chaos_crash_and_compaction(
+        self, monkeypatch
+    ):
+        """The compressed-domain-scan chaos variant: the same
+        write -> flush -> compact -> query soak under SOAK_PLAN, with
+        encoded-lane sidecars ON (storage/encoding.py, min_rows=1 so
+        every data SST carries one). Invariants on top of the base soak:
+        results are EXACT at every checkpoint and across a mid-soak
+        crash/reopen, the tree actually holds format-v2 SSTs (the soak
+        must exercise the encoded read path, not silently fall back),
+        and the encoded scan equals the forced-raw scan bit for bit on
+        the surviving tree — torn/corrupt sidecars the fault plan leaves
+        behind may only degrade a read to parquet, never change it."""
+        from horaedb_tpu.storage.config import EncodingConfig, StorageConfig
+
+        inner = MemStore()
+        chaos = ChaosStore(inner, SOAK_PLAN)
+        store = ResilientStore(
+            chaos, retry=fast_retry(attempts=10),
+            breaker=BreakerPolicy(failure_threshold=5, open_for=ms(50)),
+            name="enc-soak",
+        )
+        cfg = StorageConfig(
+            encoding=EncodingConfig(enabled=True, min_rows=1)
+        )
+        eng = await open_chaos_engine(store, config=cfg)
+        model: dict = {}
+        base = 1000
+        for rnd in range(10):
+            series = {
+                f"h{rnd % 3}": [(base + rnd * 1000 + i, float(rnd * 10 + i))
+                                for i in range(4)],
+                f"g{rnd % 2}": [(base + rnd * 1000 + i, float(rnd))
+                                for i in range(3)],
+            }
+            await write_acked(eng, model, series)
+            if rnd % 4 == 3:
+                await flush_retrying(eng)
+                try:
+                    await eng.compact()
+                    await eng.data_table.compaction_scheduler.executor.drain()
+                except Exception:  # noqa: BLE001 — faulted compactions
+                    pass           # are re-picked later
+            got = await query_model(eng)
+            assert got == model, f"round {rnd}: encoded tree diverged"
+
+        await flush_retrying(eng)
+        # the soak must have produced encoded SSTs, or it proved nothing
+        fmts = [s.meta.format_version
+                for s in eng.data_table.manifest.all_ssts()]
+        assert 2 in fmts, f"no v2 SSTs in the soak tree: {fmts}"
+        pre_crash_model = dict(model)
+        await crash(eng)
+        del eng
+
+        chaos.settle()
+        eng2 = await open_chaos_engine(store, config=cfg)
+        got = await query_model(eng2)
+        assert got == pre_crash_model  # zero acked-row loss
+
+        # encoded vs forced-raw on the SAME surviving tree: bit-exact
+        monkeypatch.setenv("HORAEDB_DECODE_IMPL", "raw")
+        raw_model = await query_model(eng2)
+        monkeypatch.delenv("HORAEDB_DECODE_IMPL")
+        assert raw_model == pre_crash_model
+
+        # orphan GC covers .enc sidecars too: none outside the live set
+        live = {s.id for s in eng2.data_table.manifest.all_ssts()}
+        leftover = [
+            p for p in inner._objects
+            if p.startswith("db/data/data/") and p.endswith(".enc")
+            and int(p.rsplit("/", 1)[-1][:-4]) not in live
+        ]
+        assert leftover == [], f"orphan enc sidecars not GC'd: {leftover}"
+
+        # keeps working: more writes + a compaction pass stay exact
+        for rnd in range(10, 18):
+            series = {
+                f"h{rnd % 3}": [(base + rnd * 1000 + i, float(rnd * 10 + i))
+                                for i in range(4)],
+            }
+            await write_acked(eng2, model, series)
+        await flush_retrying(eng2)
+        try:
+            await eng2.compact()
+            await eng2.data_table.compaction_scheduler.executor.drain()
+        except Exception:  # noqa: BLE001
+            pass
+        got = await query_model(eng2)
+        assert got == model
+        assert chaos.injected_errors > 0
+        await eng2.close()

@@ -679,3 +679,86 @@ class TestJ011AdmissionBoundary:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ012DecodeFunnel:
+    """J012: encoded SST lanes decode in exactly one funnel
+    (storage/encoding.py host codecs, ops/decode.py device kernels, the
+    encoded reader path in storage/read.py). An ad-hoc np.cumsum over a
+    delta buffer or a hand-rolled shift/mask unpack starts bit-exact and
+    diverges the first time the sidecar format moves."""
+
+    def seeded(self, tmp_path, body, rel="engine/seeded.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_funnel_primitive_call_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def fast_read(lane):\n"
+            "    a = decode_lane(lane)\n"                        # J012
+            "    b = encoding.decode_blob(data)\n"               # J012
+            "    return unpack_bits(buf, n, w)\n",               # J012
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 3, r.stdout
+        assert r.stdout.count("J012") == 3, r.stdout
+        assert "funnel" in r.stdout
+
+    def test_decode_shaped_op_on_encoded_buffer_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def adhoc(enc_deltas, first):\n"
+            "    ts = np.cumsum(enc_deltas) + first\n"           # J012
+            "    ids = np.unpackbits(encoded_ids)\n"             # J012
+            "    return ts, ids\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 2, r.stdout
+        assert r.stdout.count("J012") == 2, r.stdout
+
+    def test_accumulate_over_payload_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def xor_decode(payload):\n"
+            "    return np.bitwise_xor.accumulate(payload)\n",   # J012
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J012" in r.stdout
+
+    def test_cumsum_on_plain_buffer_not_flagged(self, tmp_path):
+        """Decode-shaped ops over NON-encoded data are normal numpy."""
+        f = self.seeded(
+            tmp_path,
+            "def histogram(counts, lengths):\n"
+            "    edges = np.cumsum(lengths)\n"
+            "    return np.add.accumulate(counts), edges\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_funnel_modules_exempt(self, tmp_path):
+        for rel in ("storage/encoding.py", "ops/decode.py",
+                    "storage/read.py"):
+            f = self.seeded(
+                tmp_path,
+                "def _decode(lane, payload):\n"
+                "    d = np.cumsum(unpack_bits(payload, n, w))\n"
+                "    return decode_lane(lane)\n",
+                rel=rel,
+            )
+            r = run_jaxlint(f)
+            assert r.returncode == 0, (rel, r.stdout)
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def bench(lane):\n"
+            "    # jaxlint: disable=J012 bench lane measuring the funnel's own decode rate\n"
+            "    return decode_lane(lane, impl='host')\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

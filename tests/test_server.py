@@ -556,8 +556,14 @@ EXPLAIN_KEYS = {
     "mode", "regions", "ssts", "scan_paths", "agg_impl", "agg_impls",
     "stages_s", "lanes_s", "bound", "compile_s", "steady_s", "counts",
     "kernels", "tombstones_applied", "tombstone_rows_masked", "admission",
+    "encoding",
 }
-EXPLAIN_LANES = {"io", "host", "transfer", "kernel", "compile"}
+EXPLAIN_LANES = {"io", "host", "transfer", "kernel", "compile", "decode"}
+# compressed-domain scan provenance (storage/encoding.py + ops/decode.py)
+EXPLAIN_ENCODING_KEYS = {
+    "lanes", "ssts_encoded", "encoded_bytes", "decoded_bytes",
+    "pages_pruned", "runs_skipped", "decode_impls",
+}
 
 
 class TestExplain:
@@ -596,6 +602,12 @@ class TestExplain:
                 assert {"tenant", "queued", "queue_wait_s",
                         "cost_estimate_s", "inflight"} <= set(adm)
                 assert adm["tenant"] == "default"
+                # compressed-domain scan provenance rides every plan
+                # (zeros/empty when the tree holds no encoded SSTs)
+                encp = plan["encoding"]
+                assert EXPLAIN_ENCODING_KEYS <= set(encp), sorted(encp)
+                assert isinstance(encp["lanes"], dict)
+                assert isinstance(encp["decode_impls"], list)
 
             # native raw
             r = await client.post(

@@ -187,6 +187,24 @@ class TestScanstatsIntegration:
             scanstats.record("compile", 0.3)
         assert st.seconds["compile"] == pytest.approx(0.3)
 
+    def test_overlapping_thread_credits_cannot_zero_the_stage(self):
+        """Concurrent per-SST decodes under ONE io stage record
+        thread-seconds whose SUM can exceed the stage's wall (they
+        overlap); the deduction is capped at the elapsed wall so real io
+        time spent after/alongside them still lands in the io lane
+        instead of being silently zeroed by the over-credit."""
+        import time
+
+        with scanstats.scan_stats() as st:
+            with scanstats.stage("io_decode"):
+                # two workers' overlapping decode credits, far over wall
+                scanstats.record("decode", 5.0, deduct=True)
+                scanstats.record("decode", 5.0, deduct=True)
+                time.sleep(0.05)  # real io wall AFTER the credits
+        assert st.seconds["decode"] == pytest.approx(10.0)
+        assert st.seconds["io_decode"] >= 0.04, \
+            "over-credit zeroed the enclosing io lane"
+
 
 class TestNestedTracing:
     def test_xjit_callable_inside_jit_still_works(self):

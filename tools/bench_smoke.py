@@ -38,10 +38,13 @@ def main() -> int:
             HORAEDB_LINK_PROFILE="skip",
             HORAEDB_AGG_CACHE=os.path.join(tmp, "agg_calib.json"),
             HORAEDB_AGG_CALIB_N="65536",
+            HORAEDB_DECODE_CACHE=os.path.join(tmp, "decode_calib.json"),
+            HORAEDB_DECODE_CALIB_N="16384",
         )
         env.pop("HORAEDB_AGG_IMPL", None)  # the gate tests the AUTO path
         env.pop("HORAEDB_SORTED_IMPL", None)
         env.pop("HORAEDB_UNSORTED_IMPL", None)
+        env.pop("HORAEDB_DECODE_IMPL", None)
         t0 = time.perf_counter()
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
@@ -135,6 +138,32 @@ def main() -> int:
                   f"query qps lane {lvl}: bad latency percentiles: {row}")
             check(0.0 <= row.get("shed_pct", -1) <= 100.0,
                   f"query qps lane {lvl}: bad shed_pct: {row}")
+        # compressed-domain scan lane (storage/encoding.py +
+        # ops/decode.py): present, the calibrated dispatcher picked a
+        # VALID decode impl per codec, and the tsid/ts lanes actually
+        # compressed (the whole point of shipping them encoded)
+        from horaedb_tpu.ops import decode as decode_ops
+
+        se = result.get("scan_encoded") or {}
+        check(se.get("rows", 0) > 0, "scan_encoded lane missing")
+        check(se.get("encode_ns_per_row", 0) > 0,
+              "scan_encoded: encode cost missing")
+        bpr = se.get("bytes_per_row") or {}
+        check(bpr.get("ratio", 0) > 1.0,
+              f"scan_encoded: no wire-byte reduction: {bpr}")
+        for codec, impl in (se.get("decode_auto_impl") or {}).items():
+            check(impl in decode_ops.DECODE_IMPLS,
+                  f"scan_encoded: auto picked unknown impl {impl!r} "
+                  f"for {codec}")
+        check(bool(se.get("decode_auto_impl")),
+              "scan_encoded: auto-dispatch resolved no codec")
+        e2e = se.get("e2e") or {}
+        check({"filtered", "full"} <= set(e2e),
+              f"scan_encoded: e2e shapes missing: {sorted(e2e)}")
+        for shape, row in e2e.items():
+            check(row.get("raw_rows_per_sec", 0) > 0
+                  and row.get("encoded_rows_per_sec", 0) > 0,
+                  f"scan_encoded e2e {shape}: non-positive rate: {row}")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")

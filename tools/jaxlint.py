@@ -76,6 +76,18 @@ Rules (see docs/static-analysis.md for rationale and examples):
         deadline, no per-tenant fairness, no shed metrics; route through
         admission.run_query / run_query_exemplars (or hold an admission
         slot and suppress with the reason)
+  J012  ad-hoc decode of an encoded SST lane outside the sanctioned
+        funnel (storage/encoding.py host codecs, ops/decode.py device
+        kernels, storage/read.py's encoded reader): calling the funnel's
+        decode primitives (`decode_lane`/`decode_blob`/
+        `decode_page_device`/`unpack_bits`/`unzigzag`) elsewhere, or
+        running a decode-shaped op (`cumsum`, `unpackbits`,
+        `associative_scan`, `.accumulate`) over an encoded buffer (an
+        argument named like one: `*_enc`, `enc_*`, `*encoded*`,
+        `payload`) — a second decode path diverges from the funnel's
+        bit-exactness contract and dodges the calibrated host/device
+        dispatcher; harnesses that measure the funnel itself suppress
+        with the reason
   J009  naked object-store construction outside objstore/: a concrete
         store (`MemStore`/`LocalStore`/`S3LikeStore`) built in engine
         code without being handed straight to a `ResilientStore(...)`
@@ -195,6 +207,27 @@ J010_EXEMPT = (
     "horaedb_tpu/storage/manifest/",
 )
 VISIBILITY_FIELDS = {"tombstones", "retention_floor_ms"}
+
+# J012: the encoded-lane decode funnel (storage/encoding.py host codecs,
+# ops/decode.py device kernels) and the one reader that drives it
+# (storage/read.py's encoded path). Everything else in engine code must
+# not decode encoded buffers by hand.
+J012_MODULES = ("horaedb_tpu/",)
+J012_EXEMPT = (
+    "horaedb_tpu/storage/encoding.py",
+    "horaedb_tpu/ops/decode.py",
+    "horaedb_tpu/storage/read.py",
+)
+# the funnel's own decode entry points (dotted-name tail match)
+DECODE_FUNNEL_FUNCS = {
+    "decode_lane", "decode_blob", "decode_page_device", "unpack_bits",
+    "unzigzag",
+}
+# decode-shaped primitives that, applied to an encoded buffer, are an
+# ad-hoc decode path (tail match; `.accumulate` covers ufunc scans like
+# np.bitwise_xor.accumulate)
+DECODE_SHAPED_TAILS = {"cumsum", "unpackbits", "associative_scan", "accumulate"}
+_ENC_NAME_RE = re.compile(r"(^|_)enc(oded)?(_|$)|encoded|^payload$")
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
 STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
@@ -768,6 +801,51 @@ def _check_admission_boundary(tree: ast.Module, findings: list[Finding]) -> None
             ))
 
 
+def _arg_identifiers(node: ast.Call):
+    """Every Name/Attribute identifier reachable from a call's arguments."""
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name):
+                yield sub.id
+            elif isinstance(sub, ast.Attribute):
+                yield sub.attr
+
+
+def _check_decode_funnel(tree: ast.Module, findings: list[Finding]) -> None:
+    """J012, two prongs: (1) calls of the funnel's decode primitives
+    outside the funnel; (2) decode-shaped ops (cumsum/unpackbits/
+    associative_scan/ufunc .accumulate) whose arguments name an encoded
+    buffer (`*_enc`, `enc_*`, `*encoded*`, `payload`) — the naming idiom
+    of every encoded-buffer variable in this tree, same heuristic class
+    as J011's `engine` receiver match."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        tail = fd.rsplit(".", 1)[-1] if fd else None
+        if tail in DECODE_FUNNEL_FUNCS:
+            findings.append(Finding(
+                node.lineno, "J012",
+                f"`{tail}(...)` called outside the sanctioned decode "
+                "funnel (storage/encoding.py / ops/decode.py / the "
+                "encoded reader in storage/read.py) — ad-hoc decode paths "
+                "diverge from the funnel's bit-exactness contract and "
+                "skip the calibrated host/device dispatcher; route "
+                "through the reader, or suppress with the reason",
+            ))
+        elif tail in DECODE_SHAPED_TAILS and any(
+            _ENC_NAME_RE.search(name) for name in _arg_identifiers(node)
+        ):
+            findings.append(Finding(
+                node.lineno, "J012",
+                f"decode-shaped `{tail}(...)` over an encoded buffer "
+                "outside the sanctioned funnel — hand-rolled prefix-sum/"
+                "unpack of encoded lanes belongs in storage/encoding.py "
+                "(host) or ops/decode.py (device kernels); suppress with "
+                "the reason for harnesses measuring the funnel itself",
+            ))
+
+
 def _check_visibility_boundary(tree: ast.Module, findings: list[Finding]) -> None:
     """J010: attribute access on the visibility state's row-filtering
     fields (`.tombstones`, `.retention_floor_ms`) outside the shared
@@ -988,6 +1066,10 @@ def lint_file(path: Path) -> list[str]:
         (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
         for h in J011_MODULES
     ) and not any(posix.endswith(m) for m in J011_EXEMPT)
+    in_j012_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J012_MODULES
+    ) and not any(posix.endswith(m) for m in J012_EXEMPT)
 
     idx = JitIndex()
     idx.visit(tree)
@@ -1013,6 +1095,8 @@ def lint_file(path: Path) -> list[str]:
         _check_visibility_boundary(tree, findings)
     if in_j011_scope:
         _check_admission_boundary(tree, findings)
+    if in_j012_scope:
+        _check_decode_funnel(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [
