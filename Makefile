@@ -52,7 +52,7 @@ jaxlint:
 smoke-metrics:
 	JAX_PLATFORMS=cpu python tools/smoke_metrics.py
 
-# Aggregation-dispatch gate: a <60 s quick-shape bench.py --smoke on CPU
+# Aggregation-dispatch gate: a <120 s quick-shape bench.py --smoke on CPU
 # asserting the calibrated registry picks a valid impl, both A/B dicts are
 # non-empty, and the calibration cache round-trips (tools/bench_smoke.py).
 bench-smoke:

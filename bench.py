@@ -270,6 +270,219 @@ def query_qps_lane(smoke: bool) -> dict:
     return {"query_qps": asyncio.run(run())}
 
 
+def query_serving_lane(smoke: bool) -> dict:
+    """Serving-tier lane (horaedb_tpu/serving + storage/rollup.py): a
+    zipf(1.1)-repeated dashboard workload over 64 distinct panels —
+    production dashboard traffic re-runs the same few panels every
+    refresh — through the admission scheduler at 1/8/64 clients.
+
+    Reports:
+    - cold p50/p99 (every panel's FIRST execution: result-cache miss,
+      real scan — with rollup substitution where the grid aligns);
+    - the rollup substitution rate across the panel set (fraction of
+      panels whose plan folded pre-aggregated artifacts instead of raw
+      segment scans);
+    - per concurrency level: warm p50/p99 + QPS of the zipf-repeated
+      traffic and the measured result-cache hit rate (the acceptance
+      bar: warm p50 >= 3x faster than cold, hit rate > 80%)."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from horaedb_tpu.common.error import UnavailableError
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.pb import remote_write_pb2
+    from horaedb_tpu.server.admission import AdmissionController, run_query
+    from horaedb_tpu.serving import CACHE_REQUESTS
+    from horaedb_tpu.serving.cache import RESULT_CACHE
+    from horaedb_tpu.storage import scanstats
+    from horaedb_tpu.storage.config import SchedulerConfig, StorageConfig
+
+    MIN = 60_000
+    HOUR = 3_600_000
+    n_hosts = 16 if smoke else 64
+    hours = 2 if smoke else 4
+    n_panels = 64
+    wall_s = 0.3 if smoke else 2.0
+    levels = (1, 8, 64)
+
+    def payload(minute_lo: int, minute_hi: int) -> bytes:
+        """Per-minute integer-valued samples for every host across all
+        hour-segments — two halves so each segment holds two SSTs and
+        qualifies for compaction (rollup emission rides it)."""
+        req = remote_write_pb2.WriteRequest()
+        for h in range(n_hosts):
+            series = req.timeseries.add()
+            for k, v in ((b"__name__", b"panel_cpu"),
+                         (b"host", f"host-{h:02d}".encode())):
+                lab = series.labels.add()
+                lab.name = k
+                lab.value = v
+            for hr in range(hours):
+                for m in range(minute_lo, minute_hi):
+                    smp = series.samples.add()
+                    smp.timestamp = hr * HOUR + m * MIN
+                    smp.value = float(h + hr * 100 + m)
+        return req.SerializeToString()
+
+    def panels() -> list:
+        """64 DISTINCT dashboard panels across four shape families —
+        unfiltered overview grids at aligned (window, step) combos,
+        per-host per-minute drill-downs, raw recent windows, and
+        host-filtered hourly overviews. Three of the four families are
+        rollup-aligned (they substitute artifacts); the raw family
+        always scans."""
+        out = []
+        wins = [(a, b) for a in range(hours) for b in range(a + 1, hours + 1)]
+        steps = (HOUR, 30 * MIN, 15 * MIN, 10 * MIN, 6 * MIN, 5 * MIN)
+        for a, b, s in [(a, b, s) for s in steps for (a, b) in wins][:16]:
+            out.append(QueryRequest(
+                metric=b"panel_cpu", start_ms=a * HOUR, end_ms=b * HOUR,
+                bucket_ms=s,
+            ))
+        for j in range(16):  # drill-downs: distinct (hour, host) combos
+            hr = j % hours
+            host = f"host-{(j // hours) % n_hosts:02d}".encode()
+            out.append(QueryRequest(
+                metric=b"panel_cpu", start_ms=hr * HOUR,
+                end_ms=(hr + 1) * HOUR, bucket_ms=MIN,
+                filters=[(b"host", host)],
+            ))
+        for j in range(16):  # raw windows at distinct offsets
+            lo = (j * 7) % (hours * 60 - 10)
+            out.append(QueryRequest(
+                metric=b"panel_cpu", start_ms=lo * MIN,
+                end_ms=(lo + 10) * MIN,
+            ))
+        for j in range(16):  # host-filtered full-range overviews
+            host = f"host-{j % n_hosts:02d}".encode()
+            out.append(QueryRequest(
+                metric=b"panel_cpu", start_ms=0, end_ms=hours * HOUR,
+                bucket_ms=HOUR, filters=[(b"host", host)],
+            ))
+        return out
+
+    # zipf(1.1) over panel RANKS: the classic dashboard skew (a few hot
+    # panels dominate, a long warm tail still repeats)
+    rng = np.random.default_rng(7)
+    zipf_p = 1.0 / np.arange(1, n_panels + 1) ** 1.1
+    zipf_p /= zipf_p.sum()
+
+    async def run() -> dict:
+        root = tempfile.mkdtemp(prefix="horaedb-bench-serving-")
+        store = LocalStore(root)
+        cfg = StorageConfig()
+        cfg.scheduler = SchedulerConfig(input_sst_min_num=2)
+        eng = await MetricEngine.open(
+            "db", store, segment_duration_ms=HOUR, enable_compaction=True,
+            config=cfg,
+        )
+        try:
+            for lo, hi in ((0, 30), (30, 60)):
+                await eng.write_payload(payload(lo, hi))
+                await eng.flush()
+            # compact every segment so rollup artifacts exist (the picker
+            # is driven directly: the trigger channel rides a background
+            # loop the bench should not race)
+            sched = eng.data_table.compaction_scheduler
+            for _ in range(hours * 4):
+                picked = sched.pick_once()
+                while sched._tasks.qsize() or sched.executor._inflight:
+                    await asyncio.sleep(0.001)
+                    await sched.executor.drain()
+                if not picked:
+                    break
+            reqs = panels()
+            cells = n_hosts * hours  # hourly-grid panel cost estimate
+
+            # ---- cold pass: every panel's first execution (all misses)
+            RESULT_CACHE.clear()  # jaxlint: disable=J013 bench harness resets state between passes
+            cold_lat: list[float] = []
+            subst = 0
+            for req in reqs:
+                with scanstats.scan_stats() as st:
+                    t0 = time.perf_counter()
+                    await eng.query(req)
+                    cold_lat.append(time.perf_counter() - t0)
+                if st.counts.get("rollup_segments"):
+                    subst += 1
+            cold_lat.sort()
+
+            # ---- warm zipf traffic through admission per level
+            out_levels: dict[str, dict] = {}
+            for clients in levels:
+                ctl = AdmissionController(
+                    max_concurrent=4, queue_max=max(16, clients),
+                    queue_deadline_s=2.0,
+                )
+                hit0 = CACHE_REQUESTS.labels("hit").value
+                miss0 = CACHE_REQUESTS.labels("miss").value
+                lat: list[float] = []
+                sheds = 0
+                # shared absolute deadline + an explicit per-iteration
+                # yield: a cache-hit query can complete without ever
+                # suspending, and a per-client relative deadline would
+                # then serialize the "concurrent" clients (64 x wall_s)
+                t_end = time.perf_counter() + wall_s
+
+                async def one_client(seed: int):
+                    nonlocal sheds
+                    crng = np.random.default_rng(seed)
+                    while time.perf_counter() < t_end:
+                        req = reqs[int(crng.choice(n_panels, p=zipf_p))]
+                        t0 = time.perf_counter()
+                        try:
+                            await run_query(ctl, eng, req, cells=cells)
+                        except UnavailableError:
+                            sheds += 1
+                            await asyncio.sleep(0.002)
+                            continue
+                        lat.append(time.perf_counter() - t0)
+                        await asyncio.sleep(0)
+
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(one_client(100 + clients * 1000 + c)
+                      for c in range(clients))
+                )
+                elapsed = time.perf_counter() - t0
+                lat.sort()
+                hits = CACHE_REQUESTS.labels("hit").value - hit0
+                misses = CACHE_REQUESTS.labels("miss").value - miss0
+                looked = hits + misses
+                out_levels[str(clients)] = {
+                    "qps": round(len(lat) / elapsed, 1),
+                    "p50_ms": round(lat[len(lat) // 2] * 1000, 3)
+                    if lat else None,
+                    "p99_ms": round(
+                        lat[max(0, int(len(lat) * 0.99) - 1)] * 1000, 3
+                    ) if lat else None,
+                    "hit_rate": round(hits / looked, 3) if looked else None,
+                    "shed_pct": round(
+                        100.0 * sheds / (len(lat) + sheds), 1
+                    ) if (lat or sheds) else 0.0,
+                }
+            cold_p50 = cold_lat[len(cold_lat) // 2] * 1000
+            warm_p50 = out_levels["1"]["p50_ms"]
+            return {
+                "panels": n_panels,
+                "cold_p50_ms": round(cold_p50, 3),
+                "cold_p99_ms": round(
+                    cold_lat[max(0, int(len(cold_lat) * 0.99) - 1)] * 1000, 3
+                ),
+                "rollup_substitution_rate": round(subst / n_panels, 3),
+                "warm_vs_cold_p50": round(cold_p50 / warm_p50, 1)
+                if warm_p50 else None,
+                "levels": out_levels,
+            }
+        finally:
+            await eng.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+    return {"query_serving": asyncio.run(run())}
+
+
 def scan_encoded_lane(smoke: bool) -> dict:
     """Compressed-domain scan lane (storage/encoding.py + ops/decode.py):
 
@@ -694,6 +907,9 @@ def main() -> None:
     # compressed-domain scan lane (encoded sidecars + decode funnel):
     # wire bytes/row, encode/decode rates, encoded-vs-raw e2e scans
     result.update(scan_encoded_lane(SMOKE))
+    # serving-tier lane (rollups + result cache): zipf-repeated dashboard
+    # panels, cold/warm p50/p99, hit rate, substitution rate
+    result.update(query_serving_lane(SMOKE))
 
     # Last-chance accelerator retry, ONLY on the wedged-tunnel fallback
     # path (`not responsive`): the CPU fallback run itself took minutes —

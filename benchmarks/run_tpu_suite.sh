@@ -27,6 +27,11 @@ run python -m horaedb_tpu.ops.agg_registry --sweep 64000000
 # compressed-domain scan's dispatcher inputs) at a dense 16M-row lane
 run python -m horaedb_tpu.ops.decode --sweep 16000000
 run python bench.py
+# serving-tier lane standalone (also rides bench.py above): the CPU
+# bench box can only measure the IO+decode skip — on the real chip the
+# residency cache's pinned lanes are HBM handles, so this is where the
+# device-resident warm-scan rate (ROOFLINE §8's open question) lands
+run python -c "import json, bench; print(json.dumps({\"metric\": \"query_serving\", **bench.query_serving_lane(False)}))"
 run python benchmarks/run_baselines.py
 run python benchmarks/ingest_bench.py 2000
 run python benchmarks/query_bench.py 8000000

@@ -149,6 +149,7 @@ class MetricEngine:
         fence_validate_interval_s: float = 5.0,
         retention_period_ms: int | None = None,
         max_series: int = 0,
+        serving=None,
     ) -> "MetricEngine":
         """`ingest_buffer_rows` > 0 buffers data-table rows across writes
         and flushes as one SST per segment when the threshold is reached
@@ -176,7 +177,14 @@ class MetricEngine:
         estimate reaches the limit, NEW series are rejected with a
         503/Retry-After partial-accept while existing-series samples keep
         landing. 0 = unlimited (the sketch still runs and exports
-        horaedb_series_cardinality)."""
+        horaedb_series_cardinality).
+
+        `serving`: ServingTierConfig for the dashboard serving tier
+        (horaedb_tpu/serving — compaction-time rollups, the result
+        cache, device block residency). None = defaults (ON: the tier
+        is bit-exact vs forced-cold scans by construction)."""
+        from horaedb_tpu.serving import ServingTier
+
         self = object.__new__(cls)
         self._store = store
         self._segment_duration = segment_duration_ms
@@ -200,7 +208,19 @@ class MetricEngine:
             )
         self._fence = fence
 
+        self.serving = ServingTier(serving)
         sample_cfg = sample_table_config(config)
+        # serving tier layer a: compaction-time rollups on the sample
+        # tables (emission only ever runs where a compaction scheduler
+        # exists — the data table). User storage-config overrides win.
+        if not sample_cfg.rollup.enabled:
+            sample_cfg.rollup.enabled = (
+                self.serving.config.enabled
+                and self.serving.config.rollup_enabled
+            )
+            sample_cfg.rollup.resolutions = list(
+                self.serving.config.rollup_resolutions
+            )
         if retention_period_ms is not None and retention_period_ms > 0:
             # single source of truth: the compaction scheduler's TTL drives
             # BOTH physical expiry (picker expireds + the expired-only task)
@@ -273,8 +293,11 @@ class MetricEngine:
             flush_workers=flush_workers,
             flush_queue_max=flush_queue_max,
             flush_stall_deadline_s=flush_stall_deadline_s,
+            serving=self.serving,
         )
-        self.exemplar_mgr = SampleManager(self.exemplars_table, segment_duration_ms)
+        self.exemplar_mgr = SampleManager(
+            self.exemplars_table, segment_duration_ms, serving=self.serving,
+        )
         await self.metric_mgr.open()
         await self.index_mgr.open()
         # seed the cardinality sketch from the index the open just loaded:

@@ -54,6 +54,14 @@ def _default_breaker():
     return BreakerPolicy()
 
 
+def _serving_mod():
+    # deferred for the same cycle reason as the resilience defaults:
+    # serving registers metric families on the server-side registry
+    from horaedb_tpu import serving
+
+    return serving
+
+
 @dataclass
 class TestConfig:
     """Self-write load generator (reference config.rs TestConfig)."""
@@ -273,6 +281,13 @@ class MetricEngineConfig:
     query: QueryConfig = field(default_factory=QueryConfig)
     retention: RetentionConfig = field(default_factory=RetentionConfig)
     limits: LimitsConfig = field(default_factory=LimitsConfig)
+    # Serving tier for repeated dashboard traffic ([metric_engine.serving],
+    # horaedb_tpu/serving): compaction-time rollups, the invalidation-
+    # correct result cache, hot-block device residency. ON by default —
+    # answers are bit-exact vs forced-cold scans (HORAEDB_SERVING=off).
+    serving: "ServingTierConfig" = field(
+        default_factory=lambda: _serving_mod().ServingTierConfig()
+    )
     storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
     # Ingest buffering (engine/data.py SampleManager): 0 = every write is
     # immediately durable (reference write==SST semantics); > 0 buffers up

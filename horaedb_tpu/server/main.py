@@ -555,6 +555,36 @@ def _explain_payload(st, mode: str, admission_verdict: dict | None = None) -> di
             if k.startswith("decode_impl_")
         ),
     }
+    # serving-tier verdict (horaedb_tpu/serving): did the result cache
+    # serve this query (hit), was it computed + stored (miss), or was the
+    # tier off/bypassed (bypass / None when the query never reached the
+    # choke point); which rollup resolution(s) substituted for raw
+    # segment scans; and the residency split of the blocks touched.
+    if counts.get("serving_cache_hit"):
+        cache_verdict = "hit"
+    elif counts.get("serving_cache_miss"):
+        cache_verdict = "miss"
+    elif counts.get("serving_cache_bypass"):
+        cache_verdict = "bypass"
+    else:
+        cache_verdict = None
+    rollup_res = sorted(
+        k[len("rollup_res_"):] for k in counts if k.startswith("rollup_res_")
+    )
+    serving_verdict = {
+        "cache": cache_verdict,
+        "rollup": (
+            "none" if not rollup_res
+            else rollup_res[0] if len(rollup_res) == 1
+            else "mixed"
+        ),
+        "rollup_resolutions": rollup_res,
+        "rollup_segments": counts.get("rollup_segments", 0),
+        "rollup_rows_read": counts.get("rollup_rows_read", 0),
+        "raw_segments": counts.get("raw_segments", 0),
+        "blocks_resident": counts.get("blocks_resident", 0),
+        "blocks_fetched": counts.get("blocks_fetched", 0),
+    }
     compile_s = st.seconds.get("compile", 0.0)
     total_s = sum(att["lanes_s"].values())
     kernels = []
@@ -594,6 +624,7 @@ def _explain_payload(st, mode: str, admission_verdict: dict | None = None) -> di
         # query never reached admission (e.g. shed before a slot).
         "admission": admission_verdict,
         "encoding": encoding,
+        "serving": serving_verdict,
         "counts": counts,
         "kernels": kernels,
     }
@@ -1349,6 +1380,9 @@ async def build_app(config: Config, store=None) -> web.Application:
         # and the series-cardinality limit ([metric_engine.limits])
         retention_period_ms=config.metric_engine.retention.period_ms(),
         max_series=config.metric_engine.limits.max_series,
+        # serving tier ([metric_engine.serving]): rollups + result cache +
+        # device residency, bit-exact vs HORAEDB_SERVING=off
+        serving=config.metric_engine.serving,
         parser_pool=pool,
     )
     if config.metric_engine.node_id:

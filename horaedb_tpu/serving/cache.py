@@ -1,0 +1,221 @@
+"""Invalidation-correct query result cache (serving tier layer b).
+
+One process-global byte-bounded LRU over finished query results. Two
+independent mechanisms keep it correct — belt and braces:
+
+1. **The key is the invalidation contract.** A key is a digest over the
+   normalized plan fingerprint PLUS the sealed-SST id set covering the
+   range PLUS the visibility epoch (overlapping tombstone ids, retention
+   component). Every flush commits a new SST id, every compaction
+   replaces ids, every delete mints a tombstone id — so any mutation
+   changes the key and a stale entry can never be LOOKED UP again. SST
+   ids come from the process-wide monotonic allocator, so keys can never
+   collide across tables or engine instances either.
+
+2. **Events purge eagerly.** The mutation funnel (`serving_invalidate`,
+   called from the storage write commit, the compaction commit, and the
+   tombstone path — jaxlint J013 pins the call sites) drops a table's
+   entries the moment its data changes, so dead entries do not squat on
+   the byte budget until LRU pressure finds them.
+
+Fills are **single-flight**: N concurrent queries with the same key pay
+ONE computation (the leader's); followers await its future. A leader
+failure resolves followers with a sentinel and they fall back to their
+own fill — a poisoned future must never wedge every follower. Futures
+are loop-bound; a caller on a different event loop duplicates the fill
+rather than awaiting across loops (same policy as the PR 9 sidecar
+single-flight this reuses).
+
+Stored arrays are marked read-only: a caller mutating a shared cached
+grid would silently corrupt every later hit — better a loud ValueError.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from horaedb_tpu.serving import (
+    CACHE_BYTES,
+    CACHE_ENTRIES,
+    CACHE_EVICTIONS,
+    INVALIDATIONS,
+)
+
+logger = logging.getLogger(__name__)
+
+# leader-failure sentinel for single-flight followers (see module doc)
+_FILL_FAILED = object()
+
+
+def _freeze(value) -> None:
+    """Mark every numpy array reachable in a cached value read-only."""
+    if isinstance(value, np.ndarray):
+        try:
+            value.setflags(write=False)
+        except ValueError:
+            pass  # non-owning view; the base stays writable but shared
+        return
+    if isinstance(value, dict):
+        for v in value.values():
+            _freeze(v)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            _freeze(v)
+
+
+class ResultCache:
+    """Byte-bounded LRU keyed by opaque digests, with per-root indexing
+    for the event purge and loop-aware single-flight fills."""
+
+    def __init__(self, capacity_bytes: int = 0):
+        self._cap = capacity_bytes
+        # key -> (value, nbytes, root, notes)
+        self._entries: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._bytes = 0
+        self._by_root: dict[str, set] = {}
+        self._lock = threading.Lock()
+        # key -> (owning loop, future) for in-flight fills
+        self._inflight: dict[bytes, tuple] = {}
+
+    # -- sizing ---------------------------------------------------------------
+    def configure(self, capacity_bytes: int) -> None:
+        with self._lock:
+            self._cap = capacity_bytes
+            self._shrink_locked()
+        self._export()
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def _export(self) -> None:
+        CACHE_BYTES.set(self._bytes)
+        CACHE_ENTRIES.set(len(self._entries))
+
+    def _shrink_locked(self) -> None:
+        while self._bytes > self._cap and self._entries:
+            key, (_v, nb, root, _n) = self._entries.popitem(last=False)
+            self._bytes -= nb
+            keys = self._by_root.get(root)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._by_root[root]
+            CACHE_EVICTIONS.inc()
+
+    # -- the planner's read side (jaxlint J013: choke point only) -------------
+    def serving_get(self, key: bytes):
+        """(value, notes) on a hit, None on a miss. LRU-touches the
+        entry. `notes` is the fill-time provenance dict the choke point
+        replays into scanstats so EXPLAIN on a hit still names what the
+        cached plan covered."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            self._entries.move_to_end(key)
+            return ent[0], ent[3]
+
+    async def serving_single_flight(self, key: bytes, root: str, fill):
+        """Run `fill` (async, returns (value, nbytes, notes)) exactly
+        once per key across concurrent callers; store and return the
+        value with its notes. Returns (value, notes, leader) — followers
+        get the leader's stored notes to replay (their own collectors
+        saw none of the fill's scan)."""
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            flight = self._inflight.get(key)
+            if flight is None:
+                fut = loop.create_future()
+                self._inflight[key] = (loop, fut)
+            else:
+                fut = None
+        if fut is None:
+            f_loop, f_fut = flight
+            if f_loop is loop:
+                got = await f_fut
+                if got is not _FILL_FAILED:
+                    value, notes = got
+                    return value, notes, False
+            # leader failed, or cross-loop caller: compute independently
+            value, nbytes, notes = await fill()
+            self.serving_put(key, value, nbytes, root, notes)
+            return value, notes, True
+        try:
+            value, nbytes, notes = await fill()
+        except BaseException:
+            with self._lock:
+                if self._inflight.get(key, (None, None))[1] is fut:
+                    del self._inflight[key]
+            if not fut.done():
+                # followers fall back to their own fill; never poison them
+                fut.set_result(_FILL_FAILED)
+            raise
+        self.serving_put(key, value, nbytes, root, notes)
+        with self._lock:
+            if self._inflight.get(key, (None, None))[1] is fut:
+                del self._inflight[key]
+        if not fut.done():
+            fut.set_result((value, notes))
+        return value, notes, True
+
+    # -- mutation (jaxlint J013: funnel call sites only) ----------------------
+    def serving_put(
+        self, key: bytes, value, nbytes: int, root: str, notes: dict,
+    ) -> None:
+        if self._cap <= 0 or nbytes > self._cap // 4:
+            return  # one panel must not dominate the whole budget
+        _freeze(value)
+        with self._lock:
+            if key in self._entries:
+                return
+            self._entries[key] = (value, nbytes, root, dict(notes))
+            self._bytes += nbytes
+            self._by_root.setdefault(root, set()).add(key)
+            self._shrink_locked()
+        self._export()
+
+    def serving_invalidate(self, root: str, reason: str) -> int:
+        """The invalidation funnel: drop every entry of `root` because
+        its data changed (`reason` in flush|compact|delete). The keys
+        would never hit again anyway (the SST set / tombstone epoch in
+        the key changed) — this frees the bytes eagerly and feeds the
+        horaedb_serving_invalidations_total signal the runbooks watch."""
+        with self._lock:
+            keys = self._by_root.pop(root, None)
+            dropped = 0
+            if keys:
+                for k in keys:
+                    ent = self._entries.pop(k, None)
+                    if ent is not None:
+                        self._bytes -= ent[1]
+                        dropped += 1
+        INVALIDATIONS.labels(reason).inc()
+        self._export()
+        return dropped
+
+    def clear(self) -> None:
+        """Test hook: drop everything (not part of the funnel)."""
+        with self._lock:
+            self._entries.clear()
+            self._by_root.clear()
+            self._bytes = 0
+        self._export()
+
+
+# The process-global instance every engine shares (keys are globally
+# unique — see module doc), sized by the LAST engine open's config.
+RESULT_CACHE = ResultCache()
+
+
+def configure(capacity_bytes: int) -> None:
+    RESULT_CACHE.configure(capacity_bytes)

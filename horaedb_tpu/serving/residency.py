@@ -1,0 +1,185 @@
+"""Hot-block device residency (serving tier layer c).
+
+A byte-bounded cache of DECODED column blocks keyed
+``(sst id, row group, column set)`` — the exact io_decode + host_prep +
+transfer lanes ROOFLINE blames for the config-2/5 walls. It rides the
+reader's row-group cache hooks (storage/read.py), one tier above the
+host block cache:
+
+- **admission is heat-gated**: a block is pinned only after the scan
+  path has touched it ``admit_after`` times (default 2) — the same
+  repeat-traffic signal the slowlog surfaces — so a one-off backfill
+  scan cannot churn the hot set;
+- **values are device-pinned**: each numeric lane is ``jax.device_put``
+  at admission, so on accelerator backends the block lives in HBM and a
+  repeat scan pays neither the object-store GET, the parquet decode,
+  nor the H2D copy of those lanes. On the CPU backend the pin is a
+  committed host buffer and the measured win is the IO+decode skip.
+  Binary lanes (label blobs) stay host-side;
+- **eviction funnels** through the reader's ``evict_cached`` (compaction
+  deletes) plus LRU byte pressure — SSTs are immutable, so entries never
+  go stale, they only die with their file.
+
+Lookups return the assembled pyarrow table built ONCE at admission over
+zero-copy views of the pinned lanes; per-hit cost is a dict probe.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+
+import numpy as np
+import pyarrow as pa
+
+from horaedb_tpu.serving import RESIDENCY, RESIDENT_BLOCKS, RESIDENT_BYTES
+
+logger = logging.getLogger(__name__)
+
+
+def _device_put(arr: np.ndarray):
+    """Pin one lane on the default device; None when no backend exists
+    (the cache then holds the host copy only — still a decode skip)."""
+    try:
+        import jax
+
+        return jax.device_put(arr)
+    except Exception:  # noqa: BLE001 — backendless processes still cache
+        return None
+
+
+class DeviceBlockCache:
+    """LRU of device-pinned decoded blocks with touch-count admission."""
+
+    def __init__(self, capacity_bytes: int = 0, admit_after: int = 2):
+        self._cap = capacity_bytes
+        self._admit_after = max(1, admit_after)
+        # (sst_id, rg, cols_key) -> (table, device_lanes dict, nbytes)
+        self._blocks: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._bytes = 0
+        # heat: touch counts per block key, bounded FIFO so a long scan
+        # history cannot grow it without bound
+        self._heat: "OrderedDict[tuple, int]" = OrderedDict()
+        self._heat_cap = 8192
+        self._lock = threading.Lock()
+
+    def configure(self, capacity_bytes: int, admit_after: int = 2) -> None:
+        with self._lock:
+            self._cap = capacity_bytes
+            self._admit_after = max(1, admit_after)
+            self._shrink_locked()
+        self._export()
+
+    @property
+    def enabled(self) -> bool:
+        return self._cap > 0
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def _export(self) -> None:
+        RESIDENT_BYTES.set(self._bytes)
+        RESIDENT_BLOCKS.set(len(self._blocks))
+
+    def _shrink_locked(self) -> None:
+        while self._bytes > self._cap and self._blocks:
+            _k, (_t, _d, nb) = self._blocks.popitem(last=False)
+            self._bytes -= nb
+
+    # -- read side (reached only via storage/read.py's rg hooks) --------------
+    def resident_block(self, sst_id: int, rg: int, cols_key: tuple):
+        """The pinned block's assembled table, or None. LRU-touches."""
+        key = (sst_id, rg, cols_key)
+        with self._lock:
+            ent = self._blocks.get(key)
+            if ent is None:
+                return None
+            self._blocks.move_to_end(key)
+            return ent[0]
+
+    def device_lanes(self, sst_id: int, rg: int, cols_key: tuple):
+        """The pinned jax arrays of a resident block (lane -> Array), for
+        kernel paths that can consume device handles directly; None when
+        not resident or no backend pinned them."""
+        with self._lock:
+            ent = self._blocks.get((sst_id, rg, cols_key))
+            return ent[1] if ent is not None else None
+
+    # -- admission (reached only via storage/read.py's rg hooks) --------------
+    def note_fetch(
+        self, sst_id: int, rg: int, cols_key: tuple, table: pa.Table,
+    ) -> bool:
+        """Record one non-resident touch of a block; admit it once the
+        heat gate passes. Returns True when the block was admitted now."""
+        if self._cap <= 0:
+            return False
+        size = table.nbytes
+        if size > self._cap // 4:
+            return False  # one block must not dominate the budget
+        key = (sst_id, rg, cols_key)
+        with self._lock:
+            heat = self._heat.get(key, 0) + 1
+            self._heat[key] = heat
+            self._heat.move_to_end(key)
+            while len(self._heat) > self._heat_cap:
+                self._heat.popitem(last=False)
+            if heat < self._admit_after or key in self._blocks:
+                return False
+        # pin outside the lock: device_put can be slow on first touch.
+        # The decoded table itself is the served value (the IO+decode
+        # skip); the device handles are the HBM pins kernel paths can
+        # consume without an H2D copy. Binary lanes (labels) stay host.
+        # The byte budget charges BOTH copies — on an accelerator the
+        # device lanes are real HBM, and an uncounted second copy would
+        # let the true footprint run to ~2x the configured budget.
+        device_lanes: dict[str, object] = {}
+        dev_bytes = 0
+        for name, col in zip(table.schema.names, table.columns):
+            try:
+                arr = col.combine_chunks().to_numpy(zero_copy_only=False)
+            except Exception:  # noqa: BLE001 — non-numeric lane (labels)
+                continue
+            if arr.dtype == object:
+                continue
+            dev = _device_put(arr)
+            if dev is not None:
+                device_lanes[name] = dev
+                dev_bytes += arr.nbytes
+        total = size + dev_bytes
+        with self._lock:
+            if key in self._blocks or total > self._cap // 4:
+                return False
+            self._blocks[key] = (table, device_lanes, total)
+            self._bytes += total
+            self._heat.pop(key, None)
+            self._shrink_locked()
+        RESIDENCY.labels("admitted").inc()
+        self._export()
+        return True
+
+    # -- eviction funnel (storage/read.py evict_cached + tests) ---------------
+    def evict_sst(self, sst_id: int) -> None:
+        with self._lock:
+            dead = [k for k in self._blocks if k[0] == sst_id]
+            for k in dead:
+                self._bytes -= self._blocks.pop(k)[2]
+            for k in [k for k in self._heat if k[0] == sst_id]:
+                del self._heat[k]
+        if dead:
+            self._export()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._blocks.clear()
+            self._heat.clear()
+            self._bytes = 0
+        self._export()
+
+
+RESIDENCY_CACHE = DeviceBlockCache()
+
+
+def configure(capacity_bytes: int, admit_after: int = 2) -> None:
+    RESIDENCY_CACHE.configure(capacity_bytes, admit_after=admit_after)

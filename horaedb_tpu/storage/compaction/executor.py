@@ -134,6 +134,7 @@ class Executor:
 
     # -- the compaction itself (executor.rs:155-222) --------------------------
     async def do_compaction(self, task: Task) -> None:
+        from horaedb_tpu.serving.cache import RESULT_CACHE
         from horaedb_tpu.storage import visibility as vis_mod
 
         self.pre_check(task)
@@ -146,11 +147,20 @@ class Executor:
             # no merge — the horizon already proved every row out of range
             to_deletes = [f.id for f in task.expireds]
             await self._manifest.update([], to_deletes)
+            RESULT_CACHE.serving_invalidate(self._storage._root, "compact")
             await self._delete_ssts(to_deletes)
             await self._gc_tombstones()
+            await self._gc_rollups()
             return
 
         time_range = TimeRange.union_of([f.meta.time_range for f in task.inputs])
+        # Tombstones whose masking the merge below WILL include — captured
+        # BEFORE the read so the rollup record can never claim a delete it
+        # did not apply (a tombstone landing mid-task compares newer than
+        # this set and forces raw until the next compaction re-emits).
+        applied_tombs = tuple(sorted(
+            t.id for t in self._manifest.all_tombstones()
+        ))
         # Same merge pipeline as the scan path, on device, builtins kept.
         # Memory bound: device memory is O(scan_block_rows) (hierarchical
         # chunked scan), the parquet ENCODE streams to the store at
@@ -179,8 +189,10 @@ class Executor:
             # retry loop).
             to_deletes = [f.id for f in task.expireds] + [f.id for f in task.inputs]
             await self._manifest.update([], to_deletes)
+            RESULT_CACHE.serving_invalidate(self._storage._root, "compact")
             await self._delete_ssts(to_deletes)
             await self._gc_tombstones()
+            await self._gc_rollups()
             return
         table = pa.Table.from_batches(batches)
 
@@ -243,9 +255,120 @@ class Executor:
         # manifest delta (executor.rs:206-216).
         to_deletes = [f.id for f in task.expireds] + [f.id for f in task.inputs]
         await self._manifest.update(new_files, to_deletes)
+        # serving-tier invalidation funnel (jaxlint J013): the sealed-SST
+        # set just changed; cached results over the old set are dead
+        RESULT_CACHE.serving_invalidate(self._storage._root, "compact")
         # From now on, no error should be returned (executor.rs:218-219).
+        try:
+            # rollup emission rides the bytes compaction already rewrote:
+            # the merged table IS the segment's exact LWW-resolved,
+            # tombstone-applied content. Post-commit and best-effort — a
+            # failed artifact costs speed on the next dashboard refresh,
+            # never correctness (the planner scans raw without it).
+            await self._emit_rollups(task, table, new_files, time_range,
+                                     applied_tombs)
+        except Exception:  # noqa: BLE001 — perf artifact only
+            logger.warning("rollup emission failed (raw scans still exact)",
+                           exc_info=True)
         await self._delete_ssts(to_deletes)
         await self._gc_tombstones()
+        await self._gc_rollups()
+
+    async def _emit_rollups(
+        self, task: Task, table: pa.Table, new_files: list[SstFile],
+        time_range: TimeRange, applied_tombs: tuple,
+    ) -> None:
+        """Emit one pre-aggregated SST + registry record per configured
+        resolution for a FULL-segment compaction (storage/rollup.py holds
+        the freshness contract the records carry).
+
+        Emission is skipped — never wrong — when the contract cannot be
+        exact: a partial-segment task (un-merged siblings would carry
+        un-deduped duplicates), a racing flush that landed mid-task (the
+        output set is no longer the segment's whole live set), a
+        non-OVERWRITE schema, or a table without a trailing time-column
+        primary key."""
+        from horaedb_tpu.serving import ROLLUPS_BUILT, resolution_label
+        from horaedb_tpu.storage import rollup as rollup_mod
+        from horaedb_tpu.storage.config import UpdateMode
+        from horaedb_tpu.storage.types import Timestamp
+
+        storage = self._storage
+        cfg = storage.rollup_config
+        if not cfg.enabled or storage.time_column is None:
+            return
+        if storage.schema.update_mode != UpdateMode.OVERWRITE:
+            return
+        pks = storage.schema.primary_key_names
+        names = storage.schema.arrow_schema.names
+        if not pks or pks[-1] != storage.time_column:
+            return
+        if cfg.value_column not in names:
+            return
+        if table.num_rows < max(1, cfg.min_rows):
+            return
+        seg_ms = storage.segment_duration_ms
+        segs = {
+            Timestamp(f.meta.time_range.start).truncate_by(seg_ms).value
+            for f in task.inputs
+        }
+        if len(segs) != 1:
+            return
+        seg_start = segs.pop()
+        seg_range = TimeRange(seg_start, seg_start + seg_ms)
+        live = {
+            s.id for s in self._manifest.find_ssts(seg_range)
+            if Timestamp(s.meta.time_range.start).truncate_by(seg_ms).value
+            == seg_start
+        }
+        out_ids = {f.id for f in new_files}
+        if live != out_ids:
+            return  # partial-segment task or a flush raced the merge
+        group_cols = list(pks[:-1])
+        sources = tuple(sorted(out_ids))
+        for res in cfg.resolutions:
+            if res <= 0 or seg_ms % res != 0:
+                continue
+            rtab = await storage._run_sst(
+                rollup_mod.compute_rollup, table, group_cols,
+                storage.time_column, cfg.value_column, res,
+            )
+            blob = await storage._run_sst(rollup_mod.encode_rollup, rtab)
+            rid = allocate_id()
+            # artifact BEFORE record: a crash between the two leaves an
+            # unreferenced object the rollup orphan GC reclaims at open
+            await storage.store.put(
+                storage.sst_path_gen.generate_rollup(rid), blob
+            )
+            old = self._manifest.rollup_records().get((seg_start, res))
+            record = rollup_mod.RollupRecord(
+                id=allocate_id(),
+                resolution_ms=res,
+                segment_start=seg_start,
+                sst_id=rid,
+                num_rows=rtab.num_rows,
+                size=len(blob),
+                time_range=time_range,
+                source_sst_ids=sources,
+                tombstone_ids=applied_tombs,
+            )
+            await self._manifest.add_rollup(record)
+            if old is not None:
+                await self._manifest.remove_rollups([old])
+            ROLLUPS_BUILT.labels(resolution_label(res)).inc()
+            logger.debug(
+                "rollup emitted: seg=%d res=%d rows=%d size=%d sources=%s",
+                seg_start, res, rtab.num_rows, len(blob), sources,
+            )
+
+    async def _gc_rollups(self) -> None:
+        """Post-commit rollup-record GC, best-effort like tombstone GC:
+        records whose sources are no longer live can never pass the
+        freshness contract again."""
+        try:
+            await self._manifest.gc_rollups()
+        except Exception as e:  # noqa: BLE001 — next compaction retries
+            logger.warning("rollup gc failed: %s", e)
 
     async def _gc_tombstones(self) -> None:
         """Post-commit tombstone GC, best-effort like physical deletes:

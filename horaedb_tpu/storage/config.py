@@ -65,6 +65,11 @@ def _from_dict(cls, d: dict):
             # duration-or-None fields: the None default gives the generic
             # `.parse` dispatch below nothing to go on
             kwargs[name] = ReadableDuration.parse(value)
+        elif name in ("resolutions", "rollup_resolutions") and value is not None:
+            # rollup resolutions: "1m"/"1h" strings or raw ms ints
+            from horaedb_tpu.serving import parse_resolution
+
+            kwargs[name] = [parse_resolution(v) for v in value]
         elif name == "column_options" and value is not None:
             kwargs[name] = {
                 col: _from_dict(ColumnOptions, opts) for col, opts in value.items()
@@ -144,6 +149,44 @@ class EncodingConfig:
 
 
 @dataclass
+class RollupConfig:
+    """Compaction-time downsample rollups (storage/rollup.py — the
+    serving tier's layer a, TPU-build extension).
+
+    When enabled, a compaction that merges a FULL segment additionally
+    emits one pre-aggregated SST per resolution (sum/count/min/max per
+    series per bucket over `value_column`), recorded as a distinct
+    manifest artifact kind (`manifest/rollup/{id}` records referencing
+    `rollup/{id}.sst` objects — never listed among the data SSTs, so
+    raw scans are oblivious). The planner substitutes a rollup tree for
+    a raw segment scan only when the record's source SST set exactly
+    matches the segment's live set and no newer tombstone overlaps —
+    see plan_rollups for the full freshness contract. Requires a table
+    with `time_column` (the engine's sample tables) and OVERWRITE
+    update mode; resolutions must divide the segment duration."""
+
+    enabled: bool = False
+    resolutions: list = field(
+        default_factory=lambda: [60_000, 3_600_000]  # 1m, 1h
+    )
+    value_column: str = "value"
+    # merged segments below this row count skip rollup emission (the
+    # artifact would not be meaningfully smaller than the raw rows)
+    min_rows: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "RollupConfig":
+        if d and "resolutions" in d:
+            from horaedb_tpu.serving import parse_resolution
+
+            d = dict(d)
+            d["resolutions"] = [
+                parse_resolution(v) for v in d["resolutions"]
+            ]
+        return _from_dict(cls, d)
+
+
+@dataclass
 class ManifestConfig:
     """Manifest merger thresholds (config.rs; semantics in manifest/mod.rs):
     - soft limit: schedule a background merge;
@@ -195,6 +238,7 @@ class StorageConfig:
 
     write: WriteConfig = field(default_factory=WriteConfig)
     encoding: EncodingConfig = field(default_factory=EncodingConfig)
+    rollup: RollupConfig = field(default_factory=RollupConfig)
     manifest: ManifestConfig = field(default_factory=ManifestConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     update_mode: UpdateMode = UpdateMode.OVERWRITE

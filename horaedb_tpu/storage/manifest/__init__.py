@@ -57,6 +57,17 @@ def tombstone_path(root: str, record_id: int) -> str:
     return f"{tombstone_dir(root)}/{record_id}"
 
 
+ROLLUP_PREFIX = "rollup"
+
+
+def rollup_record_dir(root: str) -> str:
+    return f"{root}/{PREFIX_PATH}/{ROLLUP_PREFIX}"
+
+
+def rollup_record_path(root: str, record_id: int) -> str:
+    return f"{rollup_record_dir(root)}/{record_id}"
+
+
 class ManifestMerger:
     """Background delta→snapshot folder (mod.rs:178-333)."""
 
@@ -229,6 +240,12 @@ class Manifest:
         # manifest/tombstone/{id}. Low volume by construction (deletes are
         # operator/GDPR events, not a data path).
         self._tombstone_records: "list" = []
+        # Rollup artifact records (storage/rollup.py): the registry of
+        # the DISTINCT pre-aggregated artifact kind, one JSON object per
+        # record under manifest/rollup/{id}, keyed in memory by
+        # (segment_start, resolution_ms). Low volume: one per live
+        # (segment, resolution) at steady state.
+        self._rollup_records: "dict[tuple[int, int], object]" = {}
         self._fence = fence
         self._merger = ManifestMerger(
             root, store, config, executor=executor, fence=fence
@@ -253,6 +270,7 @@ class Manifest:
         snapshot = await read_snapshot(store, snapshot_path(root))
         m._ssts = snapshot.into_ssts()
         await m._load_tombstones()
+        await m._load_rollups()
         logger.info(
             "manifest loaded: root=%s ssts=%d tombstones=%d",
             root, len(m._ssts), len(m._tombstone_records),
@@ -361,6 +379,126 @@ class Manifest:
                 self._root, len(dropped), len(self._tombstone_records),
             )
         return len(dropped)
+
+    # -- rollup artifact records (storage/rollup.py) -------------------------
+    async def _load_rollups(self) -> None:
+        """Recovery: fold persisted rollup records back in. Unlike
+        tombstones, a corrupt/unreadable record is SAFE to drop — a
+        rollup is a performance artifact, never a correctness one (the
+        planner just scans raw) — so a bad record logs + skips instead of
+        failing the open. Newer record wins a (segment, resolution) slot
+        (ids are monotonic); losers are stale leftovers of a crash
+        between the fresh record's PUT and the supersede-delete."""
+        from horaedb_tpu.storage.rollup import RollupRecord
+
+        try:
+            metas = await self._store.list(rollup_record_dir(self._root))
+        except NotFound:
+            metas = []
+        except Exception as e:  # noqa: BLE001 — registry load best-effort
+            logger.warning("rollup record load skipped (list failed): %s", e)
+            metas = []
+        records: dict[tuple[int, int], RollupRecord] = {}
+        losers: list[RollupRecord] = []
+        for meta in metas:
+            try:
+                rec = RollupRecord.from_json(await self._store.get(meta.path))
+            except Exception as e:  # noqa: BLE001 — perf artifact only
+                logger.warning("skipping unreadable rollup record %s: %s",
+                               meta.path, e)
+                continue
+            key = (rec.segment_start, rec.resolution_ms)
+            prev = records.get(key)
+            if prev is None or rec.id > prev.id:
+                if prev is not None:
+                    losers.append(prev)
+                records[key] = rec
+            else:
+                losers.append(rec)
+        self._rollup_records = records
+        if losers:
+            # delete the superseded record objects now, best-effort: no
+            # later GC pass ever sees them (gc_rollups walks the in-memory
+            # winners only), so each crashed supersede-delete would
+            # otherwise leak one object every open re-lists forever.
+            # Their .sst artifacts become unreferenced here and are
+            # reclaimed by the rollup orphan GC at storage open.
+            results = await asyncio.gather(
+                *(self._store.delete(rollup_record_path(self._root, r.id))
+                  for r in losers),
+                return_exceptions=True,
+            )
+            failed = sum(
+                1 for r in results
+                if isinstance(r, BaseException) and not isinstance(r, NotFound)
+            )
+            logger.info(
+                "rollup load: dropped %d superseded record(s) (failed=%d)",
+                len(losers), failed,
+            )
+
+    async def add_rollup(self, record) -> None:
+        """Register one rollup artifact (durability point: the record
+        object's PUT). Replaces any older record for the same
+        (segment, resolution); the CALLER deletes the replaced record's
+        objects (supersede is part of the compaction commit path)."""
+        if self._fence is not None:
+            await self._fence.ensure_valid()
+        with context("write rollup record"):
+            await self._store.put(
+                rollup_record_path(self._root, record.id), record.to_json()
+            )
+        self._rollup_records[
+            (record.segment_start, record.resolution_ms)
+        ] = record
+
+    async def remove_rollups(self, records: list) -> None:
+        """Drop records + their SST objects, best-effort (superseded by
+        a fresh build, or their sources died). A failed delete leaves
+        the record for the next pass; the planner's source-set equality
+        check keeps a stale survivor unusable either way."""
+        from horaedb_tpu.storage.rollup import evict_rollup
+        from horaedb_tpu.storage.sst import SstPathGenerator
+
+        if not records:
+            return
+        path_gen = SstPathGenerator(self._root)
+        paths = []
+        for r in records:
+            paths.append(rollup_record_path(self._root, r.id))
+            paths.append(path_gen.generate_rollup(r.sst_id))
+            evict_rollup(r.sst_id)
+        results = await asyncio.gather(
+            *(self._store.delete(p) for p in paths), return_exceptions=True
+        )
+        for p, res in zip(paths, results):
+            if isinstance(res, BaseException) and not isinstance(res, NotFound):
+                logger.warning("rollup gc: failed to delete %s: %s", p, res)
+        for r in records:
+            key = (r.segment_start, r.resolution_ms)
+            if self._rollup_records.get(key) is r:
+                del self._rollup_records[key]
+
+    async def gc_rollups(self) -> int:
+        """Drop records whose source SSTs are no longer all live — their
+        freshness contract can never pass again (ids are never reused).
+        Called post-commit by the compaction executor; best-effort."""
+        if not self._rollup_records:
+            return 0
+        live = {s.id for s in self._ssts}
+        dead = [
+            r for r in self._rollup_records.values()
+            if not set(r.source_sst_ids) <= live
+        ]
+        await self.remove_rollups(dead)
+        return len(dead)
+
+    def rollup_records(self) -> dict:
+        """(segment_start, resolution_ms) -> RollupRecord, live view."""
+        return self._rollup_records
+
+    def referenced_rollup_sst_ids(self) -> set:
+        return {r.sst_id for r in self._rollup_records.values()}
 
     # -- queries ------------------------------------------------------------
     def all_ssts(self) -> list[SstFile]:

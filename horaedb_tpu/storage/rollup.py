@@ -1,0 +1,293 @@
+"""Compaction-time downsample rollups (serving tier layer a).
+
+Compaction is the ONE place that sees a segment's full, LWW-resolved,
+tombstone-applied content (the merged table it is about to rewrite), so
+it is the one place a pre-aggregated artifact can be emitted that is
+EXACT by construction: late data has been merge-deduped, deletes have
+been physically applied, and duplicate sequences resolved — nothing to
+reconcile at query time.
+
+Artifacts:
+- one rollup SST per (segment, resolution) under ``{root}/rollup/{id}.sst``
+  (a distinct artifact kind: its own prefix, never listed among the data
+  SSTs — raw scans and the data orphan GC are oblivious);
+- one JSON record per artifact under ``{root}/manifest/rollup/{id}``
+  (storage/manifest) carrying the FRESHNESS CONTRACT: the exact source
+  data-SST ids the rollup was derived from, and the tombstone ids whose
+  masking it already includes.
+
+Substitution (``plan_rollups``, consumed only by the planner choke point
+in engine/data.py — jaxlint J013): a segment's raw scan may be replaced
+by its rollup iff
+
+1. the segment's CURRENT live SST set == the record's source set (any
+   flush/backfill/compaction since the build changes the set — ids are
+   never reused — so staleness is structurally impossible);
+2. every live tombstone overlapping the segment is in the record's
+   applied set (a delete issued after the build forces raw until the
+   next compaction re-emits);
+3. the retention floor does not cut into the segment (row-exact raw
+   masking vs whole-bucket rollup rows would otherwise disagree);
+4. the query grid is resolution-aligned: ``bucket_ms``, the grid anchor,
+   and the range end are all multiples of the rollup resolution (every
+   grid bucket is then an exact union of rollup buckets).
+
+Rollup schema: the table's non-time primary keys (e.g. metric_id, tsid,
+field_id) + ``ts`` (bucket start) + sum/count/min/max over the
+configured value column. A 30-day range at step=1h reads ~720 rows per
+series instead of every raw sample — the billion-point-query fix.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.storage.types import TimeRange
+
+logger = logging.getLogger(__name__)
+
+# decoded-rollup read cache: artifacts are immutable and bucket-count
+# sized, so a small byte-bounded LRU makes repeat panel queries pure
+# memory reads. Superseded artifacts evict via evict_rollup; the budget
+# is configured at engine open ([metric_engine.serving] rollup_cache,
+# 0 disables) like the tier's other two caches.
+_CACHE: "OrderedDict[int, tuple[dict, int]]" = OrderedDict()
+_CACHE_BYTES = 0
+_CACHE_CAP = 16 * 1024 * 1024
+_CACHE_LOCK = threading.Lock()
+
+
+def configure_cache(capacity_bytes: int) -> None:
+    """Size the decoded-artifact LRU (ServingTier does this at engine
+    open); shrinking evicts oldest-first immediately."""
+    global _CACHE_BYTES, _CACHE_CAP
+    with _CACHE_LOCK:
+        _CACHE_CAP = capacity_bytes
+        while _CACHE_BYTES > _CACHE_CAP and _CACHE:
+            _, (_l, nb) = _CACHE.popitem(last=False)
+            _CACHE_BYTES -= nb
+
+STAT_COLUMNS = ("sum", "count", "min", "max")
+
+
+@dataclass(frozen=True)
+class RollupRecord:
+    """One rollup artifact's registry entry (JSON, manifest-level)."""
+
+    id: int                 # record id (allocation-unique)
+    resolution_ms: int
+    segment_start: int
+    sst_id: int             # the rollup/{id}.sst object
+    num_rows: int
+    size: int
+    time_range: TimeRange
+    source_sst_ids: tuple   # the data SSTs the rollup was derived from
+    tombstone_ids: tuple    # tombstones already applied at build time
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "id": self.id,
+            "resolution_ms": self.resolution_ms,
+            "segment_start": self.segment_start,
+            "sst_id": self.sst_id,
+            "num_rows": self.num_rows,
+            "size": self.size,
+            "time_range": [self.time_range.start, self.time_range.end],
+            "source_sst_ids": list(self.source_sst_ids),
+            "tombstone_ids": list(self.tombstone_ids),
+        }).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "RollupRecord":
+        try:
+            d = json.loads(data.decode())
+            return cls(
+                id=int(d["id"]),
+                resolution_ms=int(d["resolution_ms"]),
+                segment_start=int(d["segment_start"]),
+                sst_id=int(d["sst_id"]),
+                num_rows=int(d["num_rows"]),
+                size=int(d["size"]),
+                time_range=TimeRange(*d["time_range"]),
+                source_sst_ids=tuple(int(x) for x in d["source_sst_ids"]),
+                tombstone_ids=tuple(int(x) for x in d["tombstone_ids"]),
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            raise HoraeError(f"corrupt rollup record: {e}") from e
+
+
+def compute_rollup(
+    table: pa.Table,
+    group_columns: list[str],
+    ts_column: str,
+    value_column: str,
+    resolution_ms: int,
+) -> pa.Table:
+    """Aggregate a pk-sorted merged table into per-(group, bucket)
+    sum/count/min/max rows. The input MUST be the compaction merge
+    output: sorted by (group_columns..., ts), already deduped and
+    visibility-masked — every guarantee the freshness contract leans on.
+    Output rows keep the group order, so rollup SSTs are pk-sorted under
+    the same (group..., ts) key as the data table."""
+    n = table.num_rows
+    ensure(n > 0, "cannot roll up an empty table")
+    ts = np.asarray(table.column(ts_column).combine_chunks().to_numpy(
+        zero_copy_only=False
+    ), dtype=np.int64)
+    bucket = ts - ts % resolution_ms
+    vals = np.asarray(table.column(value_column).combine_chunks().to_numpy(
+        zero_copy_only=False
+    ), dtype=np.float64)
+    groups = [
+        np.asarray(table.column(c).combine_chunks().to_numpy(
+            zero_copy_only=False
+        ))
+        for c in group_columns
+    ]
+    # boundaries where any group key or the bucket changes (input sorted)
+    change = np.zeros(n, dtype=bool)
+    change[0] = True
+    if n > 1:
+        acc = bucket[1:] != bucket[:-1]
+        for g in groups:
+            acc = acc | (g[1:] != g[:-1])
+        change[1:] = acc
+    starts = np.flatnonzero(change)
+    counts = np.diff(np.append(starts, n))
+    sums = np.add.reduceat(vals, starts)
+    mins = np.minimum.reduceat(vals, starts)
+    maxs = np.maximum.reduceat(vals, starts)
+    cols = {c: g[starts] for c, g in zip(group_columns, groups)}
+    cols[ts_column] = bucket[starts]
+    cols["sum"] = sums
+    cols["count"] = counts.astype(np.int64)
+    cols["min"] = mins
+    cols["max"] = maxs
+    return pa.table(cols)
+
+
+def encode_rollup(table: pa.Table) -> bytes:
+    """One small parquet object per artifact (bucket-count scale — the
+    streaming writer machinery would be overhead here)."""
+    sink = io.BytesIO()
+    pq.write_table(table, sink, compression="zstd")
+    return sink.getvalue()
+
+
+def decode_rollup(data: bytes) -> dict:
+    """Rollup object -> numpy lane dict (what the planner folds)."""
+    t = pq.read_table(io.BytesIO(data))
+    return {
+        name: t.column(name).combine_chunks().to_numpy(zero_copy_only=False)
+        for name in t.schema.names
+    }
+
+
+def aligned_resolutions(
+    resolutions, t0: int, end: int, bucket_ms: int,
+) -> list[int]:
+    """Resolutions (coarsest first) an exact substitution can use for a
+    grid anchored at `t0` with `bucket_ms` buckets clipped at `end`."""
+    return sorted(
+        (
+            r for r in resolutions
+            if r > 0 and bucket_ms % r == 0 and t0 % r == 0 and end % r == 0
+        ),
+        reverse=True,
+    )
+
+
+def plan_rollups(
+    storage,
+    segments: list,
+    rng: TimeRange,
+    t0: int,
+    bucket_ms: int,
+) -> dict:
+    """segment_start -> usable RollupRecord (coarsest aligned resolution
+    that passes the freshness contract); segments absent from the map
+    scan raw. Pure in-memory planning — manifest state only, no IO.
+    Consumed ONLY by the planner choke point (jaxlint J013)."""
+    from horaedb_tpu.storage.types import Timestamp
+
+    cfg = storage.rollup_config
+    records = storage.manifest.rollup_records()
+    if not records or not cfg.enabled:
+        return {}
+    usable_res = aligned_resolutions(
+        cfg.resolutions, t0, rng.end, bucket_ms
+    )
+    if not usable_res:
+        return {}
+    seg_ms = storage.segment_duration_ms
+    floor = storage.retention_floor()
+    tombs = storage.manifest.all_tombstones()
+    out = {}
+    for seg in segments:
+        seg_start = Timestamp(
+            seg[0].meta.time_range.start
+        ).truncate_by(seg_ms).value
+        if floor is not None and floor > seg_start:
+            continue  # retention cuts into the segment: raw is row-exact
+        seg_range = TimeRange(seg_start, seg_start + seg_ms)
+        live_ids = {
+            s.id for s in storage.manifest.find_ssts(seg_range)
+            if Timestamp(s.meta.time_range.start).truncate_by(seg_ms).value
+            == seg_start
+        }
+        overlapping = {
+            t.id for t in tombs if t.time_range.overlaps(seg_range)
+        }
+        for res in usable_res:
+            rec = records.get((seg_start, res))
+            if rec is None:
+                continue
+            if set(rec.source_sst_ids) != live_ids:
+                continue  # data changed since the build: structurally stale
+            if not overlapping <= set(rec.tombstone_ids):
+                continue  # a newer delete is not reflected: raw until rebuilt
+            out[seg_start] = rec
+            break
+    return out
+
+
+async def read_rollup(storage, record: RollupRecord) -> dict:
+    """Fetch + decode one rollup artifact (cached). Raises on a store
+    failure — the planner degrades that segment to a raw scan."""
+    global _CACHE_BYTES
+    with _CACHE_LOCK:
+        hit = _CACHE.get(record.sst_id)
+        if hit is not None:
+            _CACHE.move_to_end(record.sst_id)
+            return hit[0]
+    path = storage.sst_path_gen.generate_rollup(record.sst_id)
+    data = await storage.store.get(path)
+    lanes = decode_rollup(data)
+    nbytes = sum(a.nbytes for a in lanes.values())
+    with _CACHE_LOCK:
+        if record.sst_id not in _CACHE and nbytes <= _CACHE_CAP // 4:
+            _CACHE[record.sst_id] = (lanes, nbytes)
+            _CACHE_BYTES += nbytes
+            while _CACHE_BYTES > _CACHE_CAP and _CACHE:
+                _, (_l, nb) = _CACHE.popitem(last=False)
+                _CACHE_BYTES -= nb
+    return lanes
+
+
+def evict_rollup(sst_id: int) -> None:
+    """Eviction funnel for superseded/deleted artifacts."""
+    global _CACHE_BYTES
+    with _CACHE_LOCK:
+        ent = _CACHE.pop(sst_id, None)
+        if ent is not None:
+            _CACHE_BYTES -= ent[1]

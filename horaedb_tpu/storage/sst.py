@@ -163,3 +163,10 @@ class SstPathGenerator:
         per-lane columnar encodings + zone maps the compressed-domain scan
         reads instead of the parquet columns."""
         return f"{self.prefix}/{PREFIX_PATH}/{file_id}.enc"
+
+    def generate_rollup(self, file_id: int) -> str:
+        """Pre-aggregated rollup SST (storage/rollup.py) — a DISTINCT
+        artifact kind under its own prefix: never listed among the data
+        SSTs, so raw scans and the data-orphan GC are oblivious to it;
+        manifest/rollup/{id} records are its registry."""
+        return f"{self.prefix}/rollup/{file_id}.sst"

@@ -237,6 +237,10 @@ class ObjectBasedStorage(ColumnarStorage):
             # (by construction or epoch fence) means no concurrent
             # uploader exists at open
             await self._gc_orphan_ssts()
+            # rollup artifacts live under their own prefix with their own
+            # registry (manifest/rollup records) — reclaim objects a crash
+            # stranded between the artifact PUT and the record PUT
+            await self._gc_orphan_rollups()
         self._reader = ParquetReader(
             store, self._path_gen, self._schema,
             scan_block_rows=config.scan_block_rows,
@@ -319,6 +323,43 @@ class ObjectBasedStorage(ColumnarStorage):
             self._root, len(by_id), len(paths), len(failed),
         )
 
+    async def _gc_orphan_rollups(self) -> None:
+        """Reclaim rollup objects no record references (crash between the
+        artifact PUT and its record PUT, or a failed supersede-delete).
+        Best-effort like the data orphan GC; ids raise the allocation
+        floor for the same reason."""
+        from horaedb_tpu.objstore import NotFound
+        from horaedb_tpu.storage.sst import ensure_id_above
+
+        try:
+            metas = await self._store.list(f"{self._root}/rollup")
+        except NotFound:
+            return
+        except Exception as e:  # noqa: BLE001 — GC is best-effort at open
+            logger.warning("rollup orphan gc skipped (list failed): %s", e)
+            return
+        live = self._manifest.referenced_rollup_sst_ids()
+        orphans = []
+        for m in metas:
+            name = m.path.rsplit("/", 1)[-1]
+            stem, _, ext = name.partition(".")
+            if ext != "sst" or not stem.isdigit():
+                continue
+            if int(stem) not in live:
+                orphans.append((int(stem), m.path))
+        if not orphans:
+            return
+        ensure_id_above(max(i for i, _ in orphans))
+        results = await asyncio.gather(
+            *(self._store.delete(p) for _i, p in orphans),
+            return_exceptions=True,
+        )
+        failed = sum(1 for r in results if isinstance(r, BaseException))
+        logger.info(
+            "rollup orphan gc: root=%s orphans=%d (failed=%d)",
+            self._root, len(orphans), failed,
+        )
+
     # -- accessors ----------------------------------------------------------
     @property
     def schema(self) -> StorageSchema:
@@ -339,6 +380,19 @@ class ObjectBasedStorage(ColumnarStorage):
     @property
     def time_column(self) -> str | None:
         return self._time_column
+
+    @property
+    def store(self) -> ObjectStore:
+        return self._store
+
+    @property
+    def sst_path_gen(self) -> SstPathGenerator:
+        return self._path_gen
+
+    @property
+    def rollup_config(self):
+        """Rollup emission/substitution knobs (storage/rollup.py)."""
+        return self._config.rollup
 
     # -- visibility: retention + tombstone deletes (storage/visibility.py) --
     def retention_floor(self) -> int | None:
@@ -413,6 +467,12 @@ class ObjectBasedStorage(ColumnarStorage):
             id=rid, seq=rid, time_range=time_range, matchers=tuple(matchers)
         )
         await self._manifest.add_tombstone(tomb)
+        # serving-tier invalidation funnel (jaxlint J013): the new
+        # tombstone id changes the visibility epoch in every cache key
+        # covering this range; purge the table's entries eagerly too
+        from horaedb_tpu.serving.cache import RESULT_CACHE
+
+        RESULT_CACHE.serving_invalidate(self._root, "delete")
         logger.info(
             "tombstone created: root=%s id=%d range=[%d,%d) matchers=%s",
             self._root, rid, time_range.start, time_range.end, matchers,
@@ -451,6 +511,11 @@ class ObjectBasedStorage(ColumnarStorage):
                 encodings=encodings,
             )
             await self._manifest.add_file(result.id, meta)
+        # serving-tier invalidation funnel (jaxlint J013): a committed SST
+        # changes the table's sealed set — cached results for it are dead
+        from horaedb_tpu.serving.cache import RESULT_CACHE
+
+        RESULT_CACHE.serving_invalidate(self._root, "flush")
         WRITE_ROWS.labels(self._root).inc(req.batch.num_rows)
 
     async def _run_sst(self, fn, *args):

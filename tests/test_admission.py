@@ -513,17 +513,18 @@ class SlowStore:
 
 async def _seeded_engine(store, n_hours: int = 4):
     """One SST per hour-segment: a scan must read several objects. The
-    block cache is disabled so every scan actually pays the (slowed)
-    store reads — the deadline must expire MID-scan, not be outrun by a
-    warm cache."""
+    block cache AND the serving tier are disabled so every scan actually
+    pays the (slowed) store reads — the deadline must expire MID-scan,
+    not be outrun by a warm cache or a result-cache hit."""
     from horaedb_tpu.common.size_ext import ReadableSize
+    from horaedb_tpu.serving import ServingTierConfig
     from horaedb_tpu.storage.config import StorageConfig
 
     cfg = StorageConfig()
     cfg.scan_cache = ReadableSize.mb(0)
     eng = await MetricEngine.open(
         "adm-db", store, segment_duration_ms=HOUR, enable_compaction=False,
-        config=cfg,
+        config=cfg, serving=ServingTierConfig(enabled=False),
     )
     for h in range(n_hours):
         payload = make_remote_write([

@@ -473,6 +473,31 @@ async def query_model(eng) -> dict:
     return out
 
 
+async def assert_model_twice(eng, model: dict, tag: str) -> None:
+    """The serving-tier soak check: the query AND its immediate repeat
+    (the result-cache hit path — serving is ON in these engines) must
+    both match the host model exactly. The failure being hunted is a
+    stale serve: a cached answer surviving a flush/compact/delete/crash
+    it should have been invalidated by."""
+    got = await query_model(eng)
+    assert got == model, f"{tag}: engine diverged from model"
+    again = await query_model(eng)
+    assert again == model, f"{tag}: repeated (serving-tier) query diverged"
+
+
+async def assert_forced_cold_matches(eng, model: dict, tag: str) -> None:
+    """The honesty switch under chaos: HORAEDB_SERVING=off recomputes
+    from first principles and must agree with the (served) model."""
+    import os
+
+    os.environ["HORAEDB_SERVING"] = "off"
+    try:
+        cold = await query_model(eng)
+    finally:
+        del os.environ["HORAEDB_SERVING"]
+    assert cold == model, f"{tag}: forced-cold scan diverged from model"
+
+
 SOAK_PLAN = FaultPlan(
     seed=20260803,
     ops={
@@ -523,8 +548,10 @@ class TestEngineChaosSoak:
                     await sched.executor.drain()
                 except Exception:  # noqa: BLE001 — compaction faults are
                     pass           # re-picked later; never lose the soak
-            got = await query_model(eng)
-            assert got == model, f"round {rnd}: engine diverged from model"
+            # serving tier ON: the query and its repeat (cache-hit path)
+            # both match — a stale serve after this round's write/flush/
+            # compact is the failure being hunted
+            await assert_model_twice(eng, model, f"round {rnd}")
 
         # ---- crash: everything acked so far is made durable by a flush
         # barrier, then the process "dies" (no close; in-flight state and
@@ -538,9 +565,10 @@ class TestEngineChaosSoak:
         chaos.settle()  # listing lag expires while the process restarts
         eng2 = await open_chaos_engine(store)
 
-        # zero acknowledged-row loss: every pre-crash acked row is there
-        got = await query_model(eng2)
-        assert got == pre_crash_model
+        # zero acknowledged-row loss: every pre-crash acked row is there —
+        # including through the serving tier's repeat path (a cached
+        # answer from the dead process must never mask recovery state)
+        await assert_model_twice(eng2, pre_crash_model, "post-crash")
 
         # orphan GC: no unreferenced .sst objects survive recovery in the
         # data table's namespace (torn writes + crash leftovers)
@@ -560,8 +588,9 @@ class TestEngineChaosSoak:
             }
             await write_acked(eng2, model, series)
         await flush_retrying(eng2)
-        got = await query_model(eng2)
-        assert got == model
+        await assert_model_twice(eng2, model, "post-recovery")
+        # the honesty switch agrees end-to-end under live faults
+        await assert_forced_cold_matches(eng2, model, "soak end")
         assert chaos.injected_errors > 0  # the plan actually fired
         await eng2.close()
 
@@ -751,8 +780,10 @@ class TestDirtyTrafficChaosSoak:
                     await eng.data_table.compaction_scheduler.executor.drain()
                 except Exception:  # noqa: BLE001 — faulted compactions
                     pass           # re-pick later; never lose the soak
-            got = await query_model(eng)
-            assert got == model, f"round {rnd}: engine diverged from model"
+            # serving tier ON: query + repeat both exact each round (the
+            # repeat is the result-cache hit path; late data, duplicates
+            # and deletes must all have invalidated correctly)
+            await assert_model_twice(eng, model, f"dirty round {rnd}")
 
         # ---- mid-soak crash + reopen (deletes must stay deleted)
         await flush_retrying(eng)
@@ -761,8 +792,8 @@ class TestDirtyTrafficChaosSoak:
         del eng
         chaos.settle()
         eng2 = await open_chaos_engine(store, max_series=40)
+        await assert_model_twice(eng2, pre_crash, "dirty post-crash")
         got2 = await query_model(eng2)
-        assert got2 == pre_crash
         # deletes stay deleted across the reopen (tombstones are durable
         # manifest-level records): every deleted-and-never-rewritten key is
         # absent, while post-delete re-ingests into the window survive
@@ -779,7 +810,7 @@ class TestDirtyTrafficChaosSoak:
             await eng2.data_table.compaction_scheduler.executor.drain()
         except Exception:  # noqa: BLE001
             pass
-        assert await query_model(eng2) == model
+        await assert_model_twice(eng2, model, "dirty post-compaction")
 
         # ---- cardinality breach degrades to the counted partial-accept
         from horaedb_tpu.engine.engine import CARD_LIMITED_REQUESTS
@@ -809,7 +840,8 @@ class TestDirtyTrafficChaosSoak:
             > limited0
         model[("h0", 8 * HOUR + 9999)] = 7.0  # the partial accept is durable
         await flush_retrying(eng2)
-        assert await query_model(eng2) == model
+        await assert_model_twice(eng2, model, "dirty soak end")
+        await assert_forced_cold_matches(eng2, model, "dirty soak end")
         assert chaos.injected_errors > 0  # the plan actually fired
         await eng2.close()
 

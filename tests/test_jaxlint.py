@@ -762,3 +762,84 @@ class TestJ012DecodeFunnel:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ013ServingFunnel:
+    """J013: the serving tier's result cache / rollup artifacts are read
+    at ONE planner choke point (engine/data.py) and mutated only through
+    the invalidation funnel (storage write commit, compaction commit,
+    tombstone path, reader eviction hooks). A second lookup or an ad-hoc
+    mutation is exactly how a cache serves stale data."""
+
+    def seeded(self, tmp_path, body, rel="server/seeded.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_read_primitives_fire_outside_choke_point(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def shortcut(cache, storage, key, segs, rng, b):\n"
+            "    hit = cache.serving_get(key)\n"                  # J013
+            "    plan = plan_rollups(storage, segs, rng, 0, b)\n"  # J013
+            "    return await read_rollup(storage, plan)\n",       # J013
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 3, r.stdout
+        assert r.stdout.count("J013") == 3, r.stdout
+        assert "choke point" in r.stdout
+
+    def test_mutation_fires_outside_funnel(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def handler(cache, root):\n"
+            "    cache.serving_invalidate(root, 'flush')\n"       # J013
+            "    cache.serving_put(b'k', None, 0, root, {})\n",   # J013
+            rel="engine/engine.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 2, r.stdout
+        assert r.stdout.count("J013") == 2, r.stdout
+        assert "invalidation funnel" in r.stdout
+
+    def test_choke_point_and_funnel_modules_exempt(self, tmp_path):
+        reads = (
+            "async def q(self, cache, key, storage, segs, rng, b):\n"
+            "    hit = cache.serving_get(key)\n"
+            "    return plan_rollups(storage, segs, rng, 0, b)\n"
+        )
+        for rel in ("engine/data.py", "serving/cache.py",
+                    "storage/rollup.py"):
+            r = run_jaxlint(self.seeded(tmp_path, reads, rel=rel))
+            assert r.returncode == 0, (rel, r.stdout)
+        writes = (
+            "def commit(cache, root):\n"
+            "    cache.serving_invalidate(root, 'compact')\n"
+        )
+        for rel in ("storage/storage.py", "storage/compaction/executor.py",
+                    "serving/cache.py", "storage/read.py"):
+            r = run_jaxlint(self.seeded(tmp_path, writes, rel=rel))
+            assert r.returncode == 0, (rel, r.stdout)
+
+    def test_unrelated_calls_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def other(cache, key):\n"
+            "    cache.get(key)\n"
+            "    cache.invalidate(key)\n"
+            "    plan = make_plan(key)\n"
+            "    return plan\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def gate(cache):\n"
+            "    # jaxlint: disable=J013 smoke gate asserting the funnel's own counters\n"
+            "    return cache.serving_get(b'probe')\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

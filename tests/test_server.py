@@ -556,13 +556,19 @@ EXPLAIN_KEYS = {
     "mode", "regions", "ssts", "scan_paths", "agg_impl", "agg_impls",
     "stages_s", "lanes_s", "bound", "compile_s", "steady_s", "counts",
     "kernels", "tombstones_applied", "tombstone_rows_masked", "admission",
-    "encoding",
+    "encoding", "serving",
 }
 EXPLAIN_LANES = {"io", "host", "transfer", "kernel", "compile", "decode"}
 # compressed-domain scan provenance (storage/encoding.py + ops/decode.py)
 EXPLAIN_ENCODING_KEYS = {
     "lanes", "ssts_encoded", "encoded_bytes", "decoded_bytes",
     "pages_pruned", "runs_skipped", "decode_impls",
+}
+# serving-tier verdict (horaedb_tpu/serving): result-cache outcome,
+# rollup substitution, residency split
+EXPLAIN_SERVING_KEYS = {
+    "cache", "rollup", "rollup_resolutions", "rollup_segments",
+    "rollup_rows_read", "raw_segments", "blocks_resident", "blocks_fetched",
 }
 
 
@@ -608,6 +614,12 @@ class TestExplain:
                 assert EXPLAIN_ENCODING_KEYS <= set(encp), sorted(encp)
                 assert isinstance(encp["lanes"], dict)
                 assert isinstance(encp["decode_impls"], list)
+                # serving verdict rides every plan: this query reached the
+                # choke point with serving ON, so the outcome is hit|miss
+                srv = plan["serving"]
+                assert EXPLAIN_SERVING_KEYS <= set(srv), sorted(srv)
+                assert srv["cache"] in ("hit", "miss")
+                assert srv["rollup"] in ("none", "1m", "1h", "mixed")
 
             # native raw
             r = await client.post(

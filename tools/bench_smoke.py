@@ -27,7 +27,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # script execution: tools/ is sys.path[0]
     sys.path.insert(0, REPO)
-BUDGET_S = 120  # hard kill; the target is <60 s
+BUDGET_S = 180  # hard kill; the soft target is <120 s
 
 
 def main() -> int:
@@ -164,6 +164,33 @@ def main() -> int:
             check(row.get("raw_rows_per_sec", 0) > 0
                   and row.get("encoded_rows_per_sec", 0) > 0,
                   f"scan_encoded e2e {shape}: non-positive rate: {row}")
+        # serving-tier lane (horaedb_tpu/serving): the zipf dashboard
+        # workload must be present, every concurrency level warm, the
+        # result cache actually hitting, rollup substitution happening,
+        # and warm p50 strictly faster than cold p50 (the whole point
+        # of the tier; cold pays a real scan, warm is a cache probe)
+        qs = result.get("query_serving") or {}
+        check(qs.get("panels") == 64,
+              f"query_serving lane missing/wrong panels: {qs.get('panels')}")
+        check(qs.get("cold_p50_ms", 0) > 0,
+              "query_serving: cold p50 missing/zero")
+        check(qs.get("rollup_substitution_rate", 0) > 0,
+              f"query_serving: no rollup substitution: "
+              f"{qs.get('rollup_substitution_rate')!r}")
+        qs_levels = qs.get("levels") or {}
+        check(set(qs_levels) == {"1", "8", "64"},
+              f"query_serving levels missing: {sorted(qs_levels)}")
+        for lvl, row in qs_levels.items():
+            check(row.get("qps", 0) > 0,
+                  f"query_serving {lvl}: non-positive qps: {row}")
+            check(row.get("hit_rate") is not None
+                  and row["hit_rate"] > 0.5,
+                  f"query_serving {lvl}: cache not hitting: {row}")
+        warm_p50 = (qs_levels.get("1") or {}).get("p50_ms")
+        check(warm_p50 is not None
+              and warm_p50 < qs.get("cold_p50_ms", 0),
+              f"query_serving: warm p50 not faster than cold "
+              f"(warm={warm_p50}, cold={qs.get('cold_p50_ms')})")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")
@@ -172,8 +199,12 @@ def main() -> int:
                 json.load(open(cache_file, encoding="utf-8"))
             except ValueError:
                 failures.append("calibration cache is not valid JSON")
-        check(elapsed < 60,
-              f"smoke bench took {elapsed:.0f}s (budget 60s)")
+        # budget grew 60 -> 120 s when the query_serving lane joined:
+        # the pre-existing lanes alone measured 57-80 s on the loaded
+        # 2-core bench box (high contention variance); the gate exists to
+        # catch runaway regressions, not 20% box noise
+        check(elapsed < 120,
+              f"smoke bench took {elapsed:.0f}s (budget 120s)")
         if failures:
             for f in failures:
                 print(f"bench-smoke: FAIL {f}")

@@ -88,6 +88,20 @@ Rules (see docs/static-analysis.md for rationale and examples):
         bit-exactness contract and dodges the calibrated host/device
         dispatcher; harnesses that measure the funnel itself suppress
         with the reason
+  J013  serving-tier funnel breach: the result cache and rollup
+        artifacts are read at ONE planner choke point (engine/data.py's
+        query methods, plus the serving/rollup modules themselves) and
+        mutated through ONE invalidation funnel (the storage write
+        commit, the compaction commit, the tombstone path, and the
+        reader's eviction hooks). Calling the read primitives
+        (`serving_get`/`serving_single_flight`/`plan_rollups`/
+        `read_rollup`/`resident_block`) elsewhere creates a second
+        lookup path that can serve stale results after the funnel
+        invalidated; calling the mutation primitives (`serving_put`/
+        `serving_invalidate`/`note_fetch`/`evict_sst`/`evict_rollup`)
+        elsewhere lets cache state change without the commit that
+        justifies it. Harness/test introspection suppresses with the
+        reason
   J009  naked object-store construction outside objstore/: a concrete
         store (`MemStore`/`LocalStore`/`S3LikeStore`) built in engine
         code without being handed straight to a `ResilientStore(...)`
@@ -228,6 +242,38 @@ DECODE_FUNNEL_FUNCS = {
 # np.bitwise_xor.accumulate)
 DECODE_SHAPED_TAILS = {"cumsum", "unpackbits", "associative_scan", "accumulate"}
 _ENC_NAME_RE = re.compile(r"(^|_)enc(oded)?(_|$)|encoded|^payload$")
+
+# J013: the serving-tier funnel (horaedb_tpu/serving + storage/rollup.py).
+# READ side: cache lookups / rollup planning / residency probes belong at
+# the planner choke point (engine/data.py) and in the tier's own modules
+# (storage/read.py hosts the residency hooks). WRITE side: cache/residency
+# mutation belongs to the invalidation funnel — the storage write commit,
+# the compaction commit, the tombstone path (all in storage/storage.py /
+# compaction/executor.py), the manifest's record store, and the reader's
+# eviction hooks.
+J013_MODULES = ("horaedb_tpu/",)
+J013_READ_EXEMPT = (
+    "horaedb_tpu/serving/",
+    "horaedb_tpu/engine/data.py",
+    "horaedb_tpu/storage/rollup.py",
+    "horaedb_tpu/storage/read.py",
+)
+J013_WRITE_EXEMPT = (
+    "horaedb_tpu/serving/",
+    "horaedb_tpu/storage/storage.py",
+    "horaedb_tpu/storage/compaction/executor.py",
+    "horaedb_tpu/storage/manifest/",
+    "horaedb_tpu/storage/rollup.py",
+    "horaedb_tpu/storage/read.py",
+)
+SERVING_READ_FUNCS = {
+    "serving_get", "serving_single_flight", "plan_rollups", "read_rollup",
+    "resident_block",
+}
+SERVING_WRITE_FUNCS = {
+    "serving_put", "serving_invalidate", "note_fetch", "evict_sst",
+    "evict_rollup",
+}
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
 STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
@@ -846,6 +892,39 @@ def _check_decode_funnel(tree: ast.Module, findings: list[Finding]) -> None:
             ))
 
 
+def _check_serving_funnel(
+    tree: ast.Module, findings: list[Finding],
+    check_reads: bool, check_writes: bool,
+) -> None:
+    """J013: serving-tier read primitives outside the planner choke point,
+    or mutation primitives outside the invalidation funnel (dotted-name
+    tail match, the J011/J012 heuristic class)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        tail = fd.rsplit(".", 1)[-1] if fd else None
+        if check_reads and tail in SERVING_READ_FUNCS:
+            findings.append(Finding(
+                node.lineno, "J013",
+                f"serving-tier read `{tail}(...)` outside the planner "
+                "choke point (engine/data.py's query methods) — a second "
+                "lookup path can serve results the invalidation funnel "
+                "already declared stale; route through the choke point, "
+                "or suppress with the reason",
+            ))
+        elif check_writes and tail in SERVING_WRITE_FUNCS:
+            findings.append(Finding(
+                node.lineno, "J013",
+                f"serving-tier mutation `{tail}(...)` outside the "
+                "invalidation funnel (storage write commit / compaction "
+                "commit / tombstone path / reader eviction hooks) — cache "
+                "state must only change with the commit that justifies "
+                "it; route through the funnel, or suppress with the "
+                "reason",
+            ))
+
+
 def _check_visibility_boundary(tree: ast.Module, findings: list[Finding]) -> None:
     """J010: attribute access on the visibility state's row-filtering
     fields (`.tombstones`, `.retention_floor_ms`) outside the shared
@@ -1070,6 +1149,18 @@ def lint_file(path: Path) -> list[str]:
         (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
         for h in J012_MODULES
     ) and not any(posix.endswith(m) for m in J012_EXEMPT)
+    in_j013_base = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J013_MODULES
+    )
+    j013_reads = in_j013_base and not any(
+        (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
+        for m in J013_READ_EXEMPT
+    )
+    j013_writes = in_j013_base and not any(
+        (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
+        for m in J013_WRITE_EXEMPT
+    )
 
     idx = JitIndex()
     idx.visit(tree)
@@ -1097,6 +1188,8 @@ def lint_file(path: Path) -> list[str]:
         _check_admission_boundary(tree, findings)
     if in_j012_scope:
         _check_decode_funnel(tree, findings)
+    if j013_reads or j013_writes:
+        _check_serving_funnel(tree, findings, j013_reads, j013_writes)
     _check_lock_discipline(tree, findings)
 
     out = [

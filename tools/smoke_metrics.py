@@ -106,6 +106,26 @@ REQUIRED_FAMILIES = (
     'horaedb_query_shed_total{reason="client_disconnect"',
     "horaedb_query_deadline_exceeded_total",
     'horaedb_scan_stage_seconds_bucket{stage="queue_wait"',
+    # serving tier (horaedb_tpu/serving): all families render from boot
+    # (children pre-registered); the repeated-query flow below moves the
+    # hit/miss counters and the write moves the invalidation counter
+    "horaedb_serving_cache_requests_total",
+    'horaedb_serving_cache_requests_total{result="hit"',
+    'horaedb_serving_cache_requests_total{result="miss"',
+    'horaedb_serving_cache_requests_total{result="bypass"',
+    "horaedb_serving_cache_bytes",
+    "horaedb_serving_cache_entries",
+    "horaedb_serving_cache_evictions_total",
+    "horaedb_serving_invalidations_total",
+    'horaedb_serving_invalidations_total{reason="flush"',
+    'horaedb_serving_invalidations_total{reason="compact"',
+    'horaedb_serving_invalidations_total{reason="delete"',
+    "horaedb_serving_rollups_built_total",
+    "horaedb_serving_rollup_substitutions_total",
+    "horaedb_serving_rollup_rows_total",
+    "horaedb_serving_resident_bytes",
+    "horaedb_serving_resident_blocks",
+    "horaedb_serving_residency_total",
 )
 
 
@@ -267,6 +287,35 @@ async def run() -> int:
                 check(adm.get("admitted") is True
                       and "queue_wait_s" in adm,
                       f"explain carries the admission verdict: {adm}")
+            # ---- serving tier: a repeated query flips the EXPLAIN cache
+            # verdict miss -> hit; a write to the table invalidates so the
+            # third run is a miss again (the result cache can never serve
+            # across a data change)
+            srv_q = {"metric": "smoke_cpu", "start_ms": 0, "end_ms": 8000,
+                     "bucket_ms": 1000}
+            verdicts = []
+            for step in ("first", "repeat"):
+                async with s.post(f"{base}/api/v1/query?explain=1",
+                                  json=srv_q) as r:
+                    body = await r.json()
+                    check(r.status == 200, f"serving {step} query answered")
+                    verdicts.append(
+                        (body.get("explain") or {}).get("serving") or {}
+                    )
+            check(verdicts[0].get("cache") == "miss",
+                  f"first serving query is a cache miss: {verdicts[0]}")
+            check(verdicts[1].get("cache") == "hit",
+                  f"repeated serving query is a cache hit: {verdicts[1]}")
+            async with s.post(f"{base}/api/v1/write",
+                              data=make_payload()) as r:
+                check(r.status == 200, "invalidating write accepted")
+            async with s.post(f"{base}/api/v1/query?explain=1",
+                              json=srv_q) as r:
+                body = await r.json()
+                srv = (body.get("explain") or {}).get("serving") or {}
+                check(srv.get("cache") == "miss",
+                      f"post-write re-query is a miss again (invalidation "
+                      f"funnel fired): {srv}")
             async with s.get(f"{base}/debug/kernels") as r:
                 cat = await r.json()
                 check(
