@@ -599,6 +599,70 @@ def _apply(op: str, a, b):
         return a / b
 
 
+def walk_expr(node):
+    """Yield every node of a parsed PromQL expression tree (generic
+    dataclass descent). THE walker: max_selector_window_ms,
+    selector_metrics, the rule engine's relevance filter, and the
+    server's provenance view all ride this one traversal, so a new node
+    type (or a Selector field change) is handled in exactly one place."""
+    from dataclasses import fields as dc_fields, is_dataclass
+
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if is_dataclass(n) and not isinstance(n, type):
+            for f in dc_fields(n):
+                v = getattr(n, f.name)
+                if isinstance(v, (list, tuple)):
+                    stack.extend(v)
+                else:
+                    stack.append(v)
+
+
+def selector_metrics(node) -> tuple:
+    """Sorted metric names the expression reads (every selector)."""
+    return tuple(sorted({
+        n.name for n in walk_expr(node) if isinstance(n, Selector)
+    }))
+
+
+def max_selector_window_ms(node) -> int:
+    """Largest data lookback any part of `node` reads at one step: the
+    max selector range (rate windows) floored at the instant-vector
+    LOOKBACK. The rule evaluator uses this to smear a dirty data range
+    onto the output steps it can influence — a sample at time x can only
+    change steps in (x, x + window]."""
+    worst = LOOKBACK_MS
+    for n in walk_expr(node):
+        if isinstance(n, Selector):
+            # `offset` shifts the DATA window back: a sample at x feeds
+            # steps in (x + offset, x + offset + window] — the lookback
+            # is window PLUS offset, not max of the two
+            window = (int(n.range_ms) if n.range_ms is not None
+                      else LOOKBACK_MS)
+            worst = max(worst, window + int(n.offset_ms or 0))
+    return worst
+
+
+async def evaluate_range(
+    engine, expr, start_ms: int, end_ms: int, step_ms: int,
+    max_series: int = 10_000,
+) -> "tuple[np.ndarray, list[SeriesVector] | float]":
+    """The reusable eval entry for standing queries (rule bodies): parse
+    (if given a string) and evaluate over the [start, end] step grid,
+    returning (steps, series). Exactly the engine the HTTP handlers run —
+    a recording rule's incremental output is bit-exact vs a cold
+    /api/v1/query_range of the same body by construction, because both
+    ARE this function."""
+    from horaedb_tpu.promql import parse
+
+    node = parse(expr) if isinstance(expr, str) else expr
+    ev = RangeEvaluator(engine, start_ms, end_ms, step_ms,
+                        max_series=max_series)
+    return ev.steps, await ev.eval(node)
+
+
 def to_prometheus_matrix(
     series: "list[SeriesVector] | float", steps: np.ndarray
 ) -> dict:

@@ -1,0 +1,1053 @@
+"""The rule evaluator: dirty-set driven incremental standing queries.
+
+One RuleEngine per metric engine. The design has three legs:
+
+1. **Dirty sets from the invalidation funnel.** The evaluator is the one
+   funnel consumer besides the result cache itself (jaxlint J014): every
+   flush/delete commit on the engine's data tables lands here as an
+   event ``(time range, needs_clear, written-by)``. A tick walks each
+   rule's unseen events; a rule with none (and nothing else to do) is
+   SKIPPED — `horaedb_rules_dirty_skips_total` — so a quiet tick is
+   O(changed rules), not O(rules). Compaction events are ignored
+   entirely: a compaction rewrites bytes, never logical content (deletes
+   and retention are already masked at scan time), so no rule output can
+   depend on it.
+
+2. **Incremental recording rules that are bit-exact by construction.**
+   A dirty data range [a, b) can only influence output steps in
+   (a, b + smear), where smear is the body's largest lookback window
+   (promql.eval.max_selector_window_ms). The tick re-evaluates exactly
+   those steps — through promql's RangeEvaluator, the same code a cold
+   /api/v1/query_range runs — and writes them back through the NORMAL
+   ingest path, where LWW merge-dedup makes re-materialization
+   idempotent. Deletes additionally tombstone the affected output span
+   first (a step whose value must DISAPPEAR cannot be fixed by an
+   overwrite). New steps beyond the watermark are evaluated only while
+   they can see data (step - smear <= the rule's observed ingest
+   high-water mark): with the PromQL subset presence-based (no absent()),
+   output past that bound is provably empty. The one documented gap:
+   future-dated samples written BEFORE the rule's first evaluation
+   materialize at the next mutation event or reopen, not spontaneously.
+
+3. **Crash recovery from durable fingerprints.** In-memory dirty state
+   dies with the process, so the tick checkpoints a per-segment
+   fingerprint of each data table (live SST ids + tombstone ids) through
+   the fenced rule store — but only when every rule has processed every
+   event (a checkpoint must never claim cleanliness it didn't earn). At
+   open, segments whose fingerprint differs from the checkpoint are
+   exactly what changed unwatched; they seed the reopen dirty set
+   (tombstones created while down re-seed with needs_clear). Re-deriving
+   an already-written range is an idempotent rewrite, so a crash at ANY
+   point between ingest, write-back, and checkpoint converges to the
+   cold-evaluation answer.
+
+Alert rules ride the same dirty sets: an inactive alert with no relevant
+mutation cannot become active (presence-based conditions only lose
+series as data ages out of the lookback), so it is skipped; pending and
+firing alerts always evaluate (their `for` clocks and resolution are
+time-driven). Transitions are exactly-once: each gets the rule's next
+monotonic sequence number and is PUT through the fenced store *before*
+any counter/surface reflects it — a crash before the PUT re-derives the
+transition once; after it, the durable log owns the identity.
+
+Self-invalidation guard: during the tick's write-back (including its
+flush barrier), funnel events are attributed to the set of rule output
+names being written. A rule is marked dirty by such an event only if it
+READS one of those names — and never by its own output alone. External
+ingest interleaving with the write-back window is attributed to it too
+(the funnel carries no author); that dirt is re-detected at the next
+external event or at reopen via the fingerprint diff, and in production
+the next scrape arrives long before either matters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from horaedb_tpu.common import tracing
+from horaedb_tpu.common.error import HoraeError, UnavailableError, ensure
+from horaedb_tpu.common.time_ext import now_ms as wall_now_ms
+from horaedb_tpu.rules import (
+    ALERT_TRANSITIONS,
+    ALERTS_ACTIVE,
+    RULE_DIRTY_SKIPS,
+    RULE_EVAL_LAG,
+    RULE_EVAL_SECONDS,
+    RULE_EVALS,
+    RULE_SAMPLES_WRITTEN,
+    RULE_TICKS,
+    RULE_WRITE_DEGRADED,
+    RULES_REGISTERED,
+    AlertRule,
+    RecordingRule,
+)
+from horaedb_tpu.rules.store import RuleStore
+
+logger = logging.getLogger(__name__)
+
+# chunk bound for one RangeEvaluator pass (its own cap is 11k steps)
+MAX_EVAL_STEPS = 5_000
+# samples per write-back protobuf chunk (bounds one ingest call)
+MAX_WRITE_SAMPLES = 100_000
+# transition-log tail kept in each alert rule's durable state record
+TRANSITION_TAIL = 256
+
+
+@dataclass
+class _Event:
+    """One funnel event, kept until every rule has seen (or outlived) it."""
+
+    id: int
+    rng: "tuple[int, int] | None"   # (start_ms, end_ms) or None = unknown
+    clear: bool                     # a delete: affected output must clear
+    written: "frozenset | None"     # rule outputs being written, None=external
+
+
+@dataclass
+class _RecRuntime:
+    rule: RecordingRule
+    parsed: object
+    smear: int
+    inputs: frozenset
+    last_event: int = 0
+    high_wm: "int | None" = None    # newest materialized output step
+    data_hi: int = 0                # observed ingest high-water mark
+
+
+@dataclass
+class _AlertRuntime:
+    rule: AlertRule
+    parsed: object
+    inputs: frozenset
+    last_event: int = 0
+    seq: int = 0                    # last durable transition sequence
+    # key (sorted label tuple) -> {"state","since_ms","fired_at","labels","value"}
+    states: dict = field(default_factory=dict)
+    transitions: list = field(default_factory=list)  # durable log tail
+    # a rule with no durable state yet must evaluate once regardless of
+    # events: its condition may ALREADY be true over pre-registration
+    # data the funnel never announced to it
+    force_eval: bool = False
+    # presence frontier: a sample at x can make the condition true at
+    # any tick t <= x + smear (offset selectors shift presence FORWARD;
+    # future-dated samples start it later) — the inactive-quiet skip is
+    # only sound beyond this frontier
+    smear: int = 0
+    data_hi: int = 0
+
+
+def _labels_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class RuleEngine:
+    """Evaluator + registry over one metric engine (module docstring)."""
+
+    def __init__(self) -> None:
+        raise RuntimeError("use RuleEngine.open")
+
+    @classmethod
+    async def open(
+        cls,
+        engine,
+        store,
+        root: str = "metrics/rules",
+        fence=None,
+        admission=None,
+        tenant: str = "rules",
+        clock=None,
+    ) -> "RuleEngine":
+        """`engine`: MetricEngine or RegionedEngine. `store`: the shared
+        object store (rule records live under `root`). `fence`: the
+        engine's epoch fence, when one is installed — rule state rides
+        the same single-writer contract. `admission`: optional
+        AdmissionController; evaluations then run as the low-weight
+        `tenant` so rule storms shed before dashboards notice.
+        `clock`: injectable now_ms() for deterministic tests."""
+        from horaedb_tpu.serving.cache import RESULT_CACHE
+
+        self = object.__new__(cls)
+        self._engine = engine
+        self._store = RuleStore(root, store, fence=fence)
+        self._admission = admission
+        self._tenant = tenant
+        self._clock = clock or wall_now_ms
+        self._recording: "dict[str, _RecRuntime]" = {}
+        self._alerts: "dict[str, _AlertRuntime]" = {}
+        self._events: "list[_Event]" = []
+        self._next_event = 1
+        self._writing_names: "frozenset | None" = None
+        self._tick_lock = asyncio.Lock()
+        self._degrade_events = 0
+        self._data_roots: set = set()
+        self._refresh_roots()
+        self._max_data_ts_cache: "int | None" = None
+        self._last_epoch: "dict | None" = None
+
+        rules, states = await self._store.load()
+        for rule in rules.values():
+            self._install(rule, states.get(rule.name))
+        self._export_registered()
+
+        # reopen dirty set: diff the durable fingerprints against the
+        # live manifests — what changed while no evaluator was watching
+        prev = await self._store.load_epoch()
+        self._seed_reopen_dirty(prev)
+
+        # the ONE other funnel consumer besides the cache (jaxlint J014)
+        self._sub_token = RESULT_CACHE.serving_subscribe(self._on_invalidate)
+        return self
+
+    async def close(self) -> None:
+        from horaedb_tpu.serving.cache import RESULT_CACHE
+
+        RESULT_CACHE.serving_unsubscribe(self._sub_token)
+
+    # -- registry -------------------------------------------------------------
+    def _install(self, rule, state: "dict | None") -> None:
+        from horaedb_tpu.promql import parse
+        from horaedb_tpu.promql.eval import max_selector_window_ms
+
+        parsed = parse(rule.expr)
+        inputs = frozenset(rule.input_metrics)
+        if rule.kind == "recording":
+            rt = _RecRuntime(
+                rule=rule, parsed=parsed,
+                smear=max_selector_window_ms(parsed), inputs=inputs,
+            )
+            if state:
+                hw = state.get("high_wm")
+                rt.high_wm = int(hw) if hw is not None else None
+                rt.data_hi = int(state.get("data_hi", 0))
+            self._recording[rule.name] = rt
+            self._alerts.pop(rule.name, None)
+        else:
+            # never-transitioned rules (no record, or the empty record a
+            # registration writes) force one evaluation: their condition
+            # may already be true over data the funnel never announced
+            virgin = not state or (
+                not state.get("seq") and not state.get("states")
+                and not state.get("transitions")
+            )
+            art = _AlertRuntime(
+                rule=rule, parsed=parsed, inputs=inputs,
+                force_eval=virgin,
+                smear=max_selector_window_ms(parsed),
+                # conservative frontier when none is recorded: the
+                # newest data the tables hold (extra evals, never a
+                # missed presence window)
+                data_hi=int((state or {}).get("data_hi")
+                            or self._max_data_ts()),
+            )
+            if state:
+                art.seq = int(state.get("seq", 0))
+                for s in state.get("states", []):
+                    labels = dict(s.get("labels") or {})
+                    art.states[_labels_key(labels)] = {
+                        "state": s["state"],
+                        "since_ms": int(s["since_ms"]),
+                        "fired_at": (int(s["fired_at"])
+                                     if s.get("fired_at") is not None
+                                     else None),
+                        "labels": labels,
+                        "value": float(s.get("value", 0.0)),
+                    }
+                art.transitions = list(state.get("transitions", []))
+            self._alerts[rule.name] = art
+            self._recording.pop(rule.name, None)
+
+    def _max_data_ts(self) -> int:
+        """Newest sample timestamp the data tables can hold (manifest
+        state only): the conservative alert presence frontier when no
+        recorded one survives. Memoized — loading 10k rules must not
+        walk every SST 10k times — and invalidated by every funnel
+        event (mutations are what move it)."""
+        if self._max_data_ts_cache is None:
+            hi = 0
+            for sub in self._engine.sub_engines().values():
+                for s in sub.data_table.manifest.all_ssts():
+                    hi = max(hi, int(s.meta.time_range.end))
+            self._max_data_ts_cache = hi
+        return self._max_data_ts_cache
+
+    def _export_registered(self) -> None:
+        RULES_REGISTERED.labels("recording").set(len(self._recording))
+        RULES_REGISTERED.labels("alert").set(len(self._alerts))
+        self._export_active()
+
+    def _export_active(self) -> None:
+        counts = {"pending": 0, "firing": 0}
+        for art in self._alerts.values():
+            for st in art.states.values():
+                counts[st["state"]] = counts.get(st["state"], 0) + 1
+        for k in ("pending", "firing"):
+            ALERTS_ACTIVE.labels(k).set(counts.get(k, 0))
+
+    async def register(self, rule) -> None:
+        """Durably register (or replace — by name) one validated rule.
+        Serialized with the tick: a mid-tick replacement must not let
+        the old runtime's checkpoint clobber the fresh reset."""
+        async with self._tick_lock:
+            await self._register_locked(rule)
+
+    async def _register_locked(self, rule) -> None:
+        rule.validate()
+        other = (self._alerts if rule.kind == "recording"
+                 else self._recording)
+        ensure(
+            rule.name not in other,
+            f"rule {rule.name!r} already exists with the other kind; "
+            "delete it first",
+        )
+        replacing_recording = rule.name in self._recording
+        await self._store.put_rule(rule)
+        if replacing_recording:
+            # the OLD body's materialized output is not the new body's:
+            # left in place it would answer queries (and claim EXPLAIN
+            # provenance) for an expression that never produced it.
+            # Tombstone the output span; the new body re-materializes
+            # from its fresh watermark.
+            await self._engine.delete_series(rule.name.encode())
+        # replacing a rule resets its runtime state deliberately: a new
+        # body/interval invalidates the old watermark and alert states —
+        # DURABLY, for both kinds: a stale alert-state record surviving a
+        # replacement would resurrect the OLD rule's firing states and
+        # sequence under the new definition at the next reopen
+        self._install(rule, None)
+        if rule.kind == "recording":
+            await self._store.put_state(rule.name, {
+                "kind": "recording", "high_wm": None, "data_hi": 0,
+            })
+        else:
+            await self._store.put_state(rule.name, {
+                "kind": "alert", "seq": 0, "states": [],
+                "transitions": [],
+            })
+        self._export_registered()
+
+    async def ensure_registered(self, rule) -> bool:
+        """Boot-time idempotent registration (config-declared rules):
+        register only when absent or the DEFINITION changed — an
+        unchanged rule keeps its watermark and alert states."""
+        async with self._tick_lock:
+            cur = (self._recording.get(rule.name)
+                   or self._alerts.get(rule.name))
+            if cur is not None:
+                if cur.rule.identity() == rule.identity():
+                    return False
+                if cur.rule.kind != rule.kind:
+                    await self._delete_locked(rule.name)  # kind swap
+            await self._register_locked(rule)
+            return True
+
+    async def delete(self, name: str) -> bool:
+        async with self._tick_lock:
+            return await self._delete_locked(name)
+
+    async def _delete_locked(self, name: str) -> bool:
+        known = name in self._recording or name in self._alerts
+        if not known:
+            return False
+        await self._store.delete_rule(name)
+        self._recording.pop(name, None)
+        self._alerts.pop(name, None)
+        self._export_registered()
+        return True
+
+    def list_rules(self) -> list:
+        return sorted(
+            [rt.rule for rt in self._recording.values()]
+            + [art.rule for art in self._alerts.values()],
+            key=lambda r: (r.kind, r.name),
+        )
+
+    def output_metrics(self) -> set:
+        """Recording-rule output metric names (EXPLAIN provenance)."""
+        return set(self._recording)
+
+    def rule_for_metric(self, metric: str):
+        rt = self._recording.get(metric)
+        return rt.rule if rt is not None else None
+
+    def alerts(self) -> list[dict]:
+        """Active alerts, Prometheus /api/v1/alerts shape."""
+        out = []
+        for name in sorted(self._alerts):
+            art = self._alerts[name]
+            for st in art.states.values():
+                out.append({
+                    # alertname LAST: it is the alert's identity and must
+                    # win over any rule/series label spelled "alertname"
+                    "labels": {
+                        **art.rule.labels,
+                        **st["labels"],
+                        "alertname": name,
+                    },
+                    "annotations": dict(art.rule.annotations),
+                    "state": st["state"],
+                    "activeAt": st["since_ms"] / 1000.0,
+                    "value": str(st["value"]),
+                })
+        return out
+
+    def transitions(self, name: str) -> list[dict]:
+        """One alert rule's durable transition-log tail (runbooks + the
+        chaos oracle)."""
+        art = self._alerts.get(name)
+        return list(art.transitions) if art is not None else []
+
+    # -- the funnel subscription (jaxlint J014) -------------------------------
+    def _refresh_roots(self) -> None:
+        self._data_roots = {
+            sub.data_table._root
+            for sub in self._engine.sub_engines().values()
+        }
+
+    def _on_invalidate(self, root: str, reason: str, time_range) -> None:
+        """Synchronous, cheap: record the dirty fact, return. Runs inside
+        the mutation commit that fired it (serving/cache.py)."""
+        if root not in self._data_roots:
+            # the region set can GROW under us (split_region mints a
+            # daughter root): refresh once before concluding the event
+            # belongs to someone else's table
+            self._refresh_roots()
+            if root not in self._data_roots:
+                return
+        if reason == "compact":
+            return  # content-neutral: deletes/retention already masked
+        rng = None
+        if time_range is not None:
+            rng = (int(time_range.start), int(time_range.end))
+        self._events.append(_Event(
+            id=self._next_event, rng=rng, clear=(reason == "delete"),
+            written=self._writing_names,
+        ))
+        self._next_event += 1
+        self._max_data_ts_cache = None  # the frontier just moved
+
+    def _relevant(self, ev: _Event, inputs: frozenset, own: str) -> bool:
+        if ev.written is None:
+            return True
+        return bool((ev.written & inputs) - {own})
+
+    def _events_after(self, last: int, inputs: frozenset, own: str) -> list:
+        return [
+            ev for ev in self._events
+            if ev.id > last and self._relevant(ev, inputs, own)
+        ]
+
+    def _compact_events(self) -> None:
+        floors = [rt.last_event for rt in self._recording.values()]
+        floors += [a.last_event for a in self._alerts.values()]
+        if not floors:
+            self._events.clear()
+            return
+        floor = min(floors)
+        self._events = [ev for ev in self._events if ev.id > floor]
+
+    # -- segment fingerprints (crash recovery) --------------------------------
+    def _seg_digests(self) -> dict:
+        """{root: {"seg_ms", "segs": {seg: digest}, "tombs": [ids]}} over
+        the engine's data tables — pure manifest state, no IO."""
+        from horaedb_tpu.storage.types import TimeRange
+
+        out: dict = {}
+        for sub in self._engine.sub_engines().values():
+            st = sub.data_table
+            seg_ms = int(st.segment_duration_ms)
+            segs: dict[int, list[int]] = {}
+            for s in st.manifest.all_ssts():
+                seg = int(s.meta.time_range.start) // seg_ms * seg_ms
+                segs.setdefault(seg, []).append(int(s.id))
+            tombs = st.manifest.all_tombstones()
+            d = {}
+            for seg, ids in segs.items():
+                h = hashlib.blake2b(digest_size=12)
+                h.update(",".join(map(str, sorted(ids))).encode())
+                overlapping = sorted(
+                    int(t.id) for t in tombs
+                    if t.time_range.overlaps(TimeRange(seg, seg + seg_ms))
+                )
+                h.update(b"|")
+                h.update(",".join(map(str, overlapping)).encode())
+                d[str(seg)] = h.hexdigest()
+            out[st._root] = {
+                "seg_ms": seg_ms,
+                "segs": d,
+                "tombs": sorted(int(t.id) for t in tombs),
+            }
+        return out
+
+    def _seed_reopen_dirty(self, prev: "dict | None") -> None:
+        """Diff durable fingerprints vs live manifests into dirty events
+        (module docstring leg 3). No checkpoint + existing rule state =
+        everything is suspect: one full clear+recompute."""
+        cur = self._seg_digests()
+        self._last_epoch = None  # re-persisted only after a clean tick
+        has_state = any(
+            rt.high_wm is not None for rt in self._recording.values()
+        ) or any(a.states or a.seq for a in self._alerts.values())
+        if prev is None:
+            if has_state:
+                self._record_reopen_event(None, clear=True)
+            return
+        proots = prev.get("roots")
+        if not isinstance(proots, dict):
+            if has_state:
+                self._record_reopen_event(None, clear=True)
+            return
+        if set(proots) != set(cur):
+            self._record_reopen_event(None, clear=True)
+            return
+        for root, cinfo in cur.items():
+            pinfo = proots[root]
+            seg_ms = int(cinfo["seg_ms"])
+            if int(pinfo.get("seg_ms", -1)) != seg_ms:
+                self._record_reopen_event(None, clear=True)
+                return
+            psegs = dict(pinfo.get("segs") or {})
+            csegs = cinfo["segs"]
+            for seg in set(psegs) | set(csegs):
+                if psegs.get(seg) == csegs.get(seg):
+                    continue
+                lo = int(seg)
+                # vanished segment: rows can DISAPPEAR (retention expiry
+                # fully applied + tombstone GC) — clear, then recompute
+                clear = seg not in csegs
+                self._record_reopen_event((lo, lo + seg_ms), clear=clear)
+            # tombstones minted while no evaluator was running: their
+            # ranges need a clear (output rows must disappear)
+            new_tombs = set(cinfo["tombs"]) - set(pinfo.get("tombs") or [])
+            if new_tombs:
+                for sub in self._engine.sub_engines().values():
+                    st = sub.data_table
+                    if st._root != root:
+                        continue
+                    for t in st.manifest.all_tombstones():
+                        if int(t.id) in new_tombs:
+                            self._record_reopen_event(
+                                (int(t.time_range.start),
+                                 int(t.time_range.end)),
+                                clear=True,
+                            )
+
+    def _record_reopen_event(self, rng, clear: bool) -> None:
+        self._events.append(_Event(
+            id=self._next_event, rng=rng, clear=clear, written=None,
+        ))
+        self._next_event += 1
+
+    # -- the tick -------------------------------------------------------------
+    async def tick(self, now_ms: "int | None" = None) -> dict:
+        """One evaluation pass. Serialized: the server loop and any admin
+        trigger share one lock, so ticks never interleave."""
+        async with self._tick_lock:
+            return await self._tick_locked(now_ms)
+
+    async def _tick_locked(self, now_ms: "int | None") -> dict:
+        now = int(now_ms if now_ms is not None else self._clock())
+        snapshot = self._next_event - 1
+        summary = {
+            "evaluated": 0, "skipped": 0, "errors": 0, "shed": 0,
+            "samples_written": 0, "transitions": 0, "deletes": 0,
+        }
+        with tracing.trace("rule_tick", rules=len(self._recording)
+                           + len(self._alerts)):
+            await self._tick_recording(now, snapshot, summary)
+            await self._tick_alerts(now, summary)
+        # epoch checkpoint — only when every rule has processed every
+        # event it cares about (a premature checkpoint would claim
+        # cleanliness for dirt that only lived in memory)
+        if summary["errors"] == 0 and not self._pending_relevant():
+            cur = self._seg_digests()
+            if cur != self._last_epoch:
+                try:
+                    await self._store.put_epoch({"roots": cur})
+                    self._last_epoch = cur
+                except Exception:  # noqa: BLE001 — wider reopen dirty
+                    logger.warning("rule epoch checkpoint failed; reopen "
+                                   "will re-derive more", exc_info=True)
+        self._compact_events()
+        # lag: how far the newest materialized step trails the data the
+        # rule could already see (quiescent rules are NOT lagging — their
+        # un-materialized steps are provably empty)
+        lags = []
+        for rt in self._recording.values():
+            if rt.high_wm is None:
+                continue
+            step = rt.rule.interval_ms
+            # last COMPLETE grid step the rule could have materialized:
+            # being mid-interval is not lag
+            frontier = min(now, rt.data_hi + rt.smear) // step * step
+            lags.append(max(0, frontier - rt.high_wm) / 1000.0)
+        RULE_EVAL_LAG.set(round(max(lags), 3) if lags else 0)
+        self._export_active()
+        noop = summary["evaluated"] == 0 and summary["errors"] == 0
+        RULE_TICKS.labels("noop" if noop else "ok").inc()
+        summary["noop"] = noop
+        return summary
+
+    def _pending_relevant(self) -> bool:
+        for rt in self._recording.values():
+            if self._events_after(rt.last_event, rt.inputs, rt.rule.name):
+                return True
+        for art in self._alerts.values():
+            if self._events_after(art.last_event, art.inputs,
+                                  art.rule.name):
+                return True
+        return False
+
+    # -- recording rules ------------------------------------------------------
+    async def _tick_recording(self, now: int, snapshot: int,
+                              summary: dict) -> None:
+        plans = []  # (rt, target, data_hi', samples, clears)
+        out_names = set()
+        for name in sorted(self._recording):
+            rt = self._recording.get(name)
+            if rt is None:
+                continue  # deleted over HTTP while this tick awaited
+            events = [
+                ev for ev in self._events
+                if ev.id <= snapshot and ev.id > rt.last_event
+                and self._relevant(ev, rt.inputs, name)
+            ]
+            plan = self._recording_plan(rt, now, events)
+            if plan is None:
+                summary["skipped"] += 1
+                RULE_DIRTY_SKIPS.labels("recording").inc()
+                continue
+            ranges, clears, target, data_hi = plan
+            if not ranges:
+                # bookkeeping-only advance (plan docstring): no
+                # evaluation ran, so the watermark stays put — only the
+                # observed data high-water mark moves
+                changed = data_hi != rt.data_hi
+                rt.data_hi = data_hi
+                rt.last_event = snapshot
+                summary["skipped"] += 1
+                RULE_DIRTY_SKIPS.labels("recording").inc()
+                if changed:
+                    try:
+                        await self._store.put_state(name, {
+                            "kind": "recording", "high_wm": rt.high_wm,
+                            "data_hi": rt.data_hi,
+                        })
+                    except Exception:  # noqa: BLE001 — reopen re-derives
+                        logger.warning("rule state checkpoint failed for "
+                                       "%s", name, exc_info=True)
+                continue
+            t0 = time.perf_counter()
+            try:
+                samples = await self._admitted(
+                    self._eval_recording(rt, ranges)
+                )
+            except UnavailableError:
+                summary["shed"] += 1
+                RULE_EVALS.labels("recording", "shed").inc()
+                continue
+            except Exception:  # noqa: BLE001 — dirty set kept; next tick
+                summary["errors"] += 1
+                RULE_EVALS.labels("recording", "error").inc()
+                logger.warning("recording rule %s evaluation failed",
+                               name, exc_info=True)
+                continue
+            RULE_EVAL_SECONDS.labels("recording").observe(
+                time.perf_counter() - t0
+            )
+            plans.append((rt, target, data_hi, samples, clears))
+            out_names.add(name)
+        if not plans:
+            return
+        # one guarded write-back for the whole tick: deletes first (their
+        # sequences must predate the rewrites), then the batched payload,
+        # then the flush barrier — all attributed to `out_names` so the
+        # self-invalidation guard and rule chaining both see the author
+        try:
+            await self._write_back(plans, frozenset(out_names), summary)
+        except Exception:  # noqa: BLE001 — nothing advanced; next tick
+            summary["errors"] += len(plans)
+            for _ in plans:
+                RULE_EVALS.labels("recording", "error").inc()
+            logger.warning("rule write-back failed; dirty sets kept",
+                           exc_info=True)
+            return
+        for rt, target, data_hi, _samples, _clears in plans:
+            changed = rt.high_wm != target or rt.data_hi != data_hi
+            rt.high_wm = target
+            rt.data_hi = data_hi
+            rt.last_event = snapshot
+            summary["evaluated"] += 1
+            RULE_EVALS.labels("recording", "ok").inc()
+            if changed:
+                try:
+                    await self._store.put_state(rt.rule.name, {
+                        "kind": "recording", "high_wm": rt.high_wm,
+                        "data_hi": rt.data_hi,
+                    })
+                except Exception:  # noqa: BLE001 — reopen re-derives
+                    logger.warning("rule state checkpoint failed for %s",
+                                   rt.rule.name, exc_info=True)
+
+    def _recording_plan(self, rt: _RecRuntime, now: int, events: list):
+        """(step ranges, clear ranges, new watermark, new data_hi) or
+        None = nothing to do (the dirty-set skip).
+
+        Evaluated steps are the union of: the full configured span on
+        first materialization; the trailing window of previously-known
+        data ((high_wm, data_hi + smear] — drains once, then quiet ticks
+        go to zero); and each event's influence ((a, b + smear) for a
+        mutation over [a, b)). Steps outside that union are provably
+        empty under the presence-based subset, so the watermark jumps
+        them for free whenever a plan runs at all."""
+        rule = rt.rule
+        step = rule.interval_ms
+        first = -(-rule.since_ms // step) * step
+        target = now // step * step
+        data_hi = rt.data_hi
+        for ev in events:
+            data_hi = max(data_hi,
+                          ev.rng[1] if ev.rng is not None else now)
+        covered_hi = rt.high_wm
+        if target < first:
+            # grid not started (future since_ms): nothing can evaluate,
+            # but events must still be CONSUMED (bookkeeping-only plan)
+            # or they pin the event list and starve the epoch checkpoint
+            if events:
+                return [], [], covered_hi, data_hi
+            return None
+        ranges: list[list] = []   # [lo, hi, clear]
+        if covered_hi is None:
+            # first materialization covers the whole configured span
+            # (the one pass that can see pre-registration data)
+            ranges.append([first, target, False])
+        else:
+            if target > covered_hi:
+                # trailing window of data the rule already knew about
+                lo = covered_hi + step
+                hi = min(target, (rt.data_hi + rt.smear) // step * step)
+                if hi >= lo:
+                    ranges.append([lo, hi, False])
+            for ev in events:
+                if ev.rng is None:
+                    ranges.append([first, target, ev.clear])
+                    continue
+                a, b = ev.rng
+                lo = max(first, a // step * step)
+                hi = min(target, -(-(b + rt.smear) // step) * step)
+                if lo <= hi:
+                    ranges.append([lo, hi, ev.clear])
+        if not ranges:
+            if events:
+                # events whose influence misses the grid entirely (e.g.
+                # future-dated data beyond the current target): nothing
+                # to evaluate NOW, but data_hi must advance — the
+                # trailing window materializes it once the grid catches
+                # up. Watermark unchanged (no evaluation ran).
+                return [], [], covered_hi, data_hi
+            return None
+        # merge overlapping/adjacent step ranges, OR-ing the clear flags
+        ranges.sort(key=lambda r: r[0])
+        merged = [ranges[0][:]]
+        for lo, hi, clear in ranges[1:]:
+            cur = merged[-1]
+            if lo <= cur[1] + step:
+                cur[1] = max(cur[1], hi)
+                cur[2] = cur[2] or clear
+            else:
+                merged.append([lo, hi, clear])
+        clears = [(lo, hi) for lo, hi, clear in merged if clear]
+        return [(lo, hi) for lo, hi, _ in merged], clears, target, data_hi
+
+    async def _admitted(self, coro):
+        """Run one rule evaluation under the low-weight rules tenant
+        (admission present) so a rule storm queues/sheds behind
+        dashboards instead of starving them."""
+        if self._admission is None:
+            return await coro
+        slot = self._admission.slot(self._tenant)
+        async with slot:
+            return await coro
+
+    async def _eval_recording(self, rt: _RecRuntime, ranges: list) -> list:
+        """Evaluate the body over each step range (chunked under the
+        evaluator's resolution cap); returns [(labels, [(ts, value)])].
+        Runs the same RangeEvaluator a cold query_range runs — the
+        bit-exactness anchor."""
+        from horaedb_tpu.promql.eval import evaluate_range
+
+        rule = rt.rule
+        step = rule.interval_ms
+        out: dict[tuple, list] = {}
+        labels_of: dict[tuple, dict] = {}
+        with tracing.span("rule_eval", rule=rule.name, kind="recording",
+                          ranges=len(ranges)):
+            for lo, hi in ranges:
+                chunk_lo = lo
+                while chunk_lo <= hi:
+                    chunk_hi = min(hi, chunk_lo + (MAX_EVAL_STEPS - 1) * step)
+                    steps, series = await evaluate_range(
+                        self._engine, rt.parsed, chunk_lo, chunk_hi, step,
+                    )
+                    if isinstance(series, float):
+                        raise HoraeError(
+                            f"recording rule {rule.name} evaluates to a "
+                            "scalar; bodies must produce a vector"
+                        )
+                    for sv in series:
+                        labels = {
+                            k: v for k, v in sv.labels.items()
+                            if k != "__name__"
+                        }
+                        labels.update(rule.labels)
+                        key = _labels_key(labels)
+                        labels_of.setdefault(key, labels)
+                        dst = out.setdefault(key, [])
+                        vals = sv.values
+                        for i in np.flatnonzero(~np.isnan(vals)):
+                            dst.append((int(steps[i]), float(vals[i])))
+                    chunk_lo = chunk_hi + step
+        return [(labels_of[k], pts) for k, pts in out.items()]
+
+    async def _write_back(self, plans: list, out_names: frozenset,
+                          summary: dict) -> None:
+        """Guarded write-back: tombstone the clear ranges, ingest the
+        batched output through the NORMAL write path (cardinality budget
+        included), then flush so everything is durable — and every
+        funnel event the work fires is attributed to `out_names` while
+        the guard holds."""
+        from horaedb_tpu.ingest.cardinality import CardinalityLimited
+
+        self._writing_names = out_names
+        try:
+            for rt, _t, _d, _samples, clears in plans:
+                for lo, hi in clears:
+                    with tracing.span("rule_clear", rule=rt.rule.name):
+                        await self._engine.delete_series(
+                            rt.rule.name.encode(),
+                            start_ms=int(lo), end_ms=int(hi) + 1,
+                        )
+                    summary["deletes"] += 1
+            total = 0
+            for payload, n in self._payloads(plans):
+                try:
+                    await self._engine.write_payload(payload)
+                except CardinalityLimited as e:
+                    # PR 7 partial-degrade: in-budget output landed; the
+                    # rejected new series are counted + sampled-logged —
+                    # never a silent drop
+                    RULE_WRITE_DEGRADED.inc()
+                    self._degrade_events += 1
+                    if (self._degrade_events == 1
+                            or self._degrade_events % 100 == 0):
+                        logger.warning(
+                            "rule write-back cardinality-degraded "
+                            "(event %d): %s", self._degrade_events, e,
+                        )
+                total += n
+            if total:
+                await self._engine.flush()
+            summary["samples_written"] += total
+            RULE_SAMPLES_WRITTEN.inc(total)
+        finally:
+            self._writing_names = None
+
+    def _payloads(self, plans: list):
+        """Batched remote-write protobuf chunks over every plan's output
+        series (one ingest call per ~MAX_WRITE_SAMPLES)."""
+        from horaedb_tpu.pb import remote_write_pb2
+
+        req = remote_write_pb2.WriteRequest()
+        n = 0
+        for rt, _t, _d, samples, _c in plans:
+            for labels, pts in samples:
+                if not pts:
+                    continue
+                ts_entry = req.timeseries.add()
+                lab = ts_entry.labels.add()
+                lab.name = b"__name__"
+                lab.value = rt.rule.name.encode()
+                for k in sorted(labels):
+                    lab = ts_entry.labels.add()
+                    lab.name = k.encode()
+                    lab.value = labels[k].encode()
+                for ts, v in pts:
+                    smp = ts_entry.samples.add()
+                    smp.timestamp = ts
+                    smp.value = v
+                n += len(pts)
+                if n >= MAX_WRITE_SAMPLES:
+                    yield req.SerializeToString(), n
+                    req = remote_write_pb2.WriteRequest()
+                    n = 0
+        if n:
+            yield req.SerializeToString(), n
+
+    # -- alert rules ----------------------------------------------------------
+    async def _tick_alerts(self, now: int, summary: dict) -> None:
+        for name in sorted(self._alerts):
+            art = self._alerts.get(name)
+            if art is None:
+                continue  # deleted over HTTP while this tick awaited
+            events = self._events_after(art.last_event, art.inputs, name)
+            if (not events and not art.states and not art.force_eval
+                    and now > art.data_hi + art.smear):
+                # presence-based conditions cannot BECOME true without a
+                # mutation — once the tick is past every known sample's
+                # influence window (offset selectors and future-dated
+                # samples shift presence FORWARD, hence the frontier
+                # check). Active states still need their for/resolve
+                # clocks; only settled-inactive quiet rules skip.
+                summary["skipped"] += 1
+                RULE_DIRTY_SKIPS.labels("alert").inc()
+                continue
+            seen = self._next_event - 1
+            # advance the presence frontier up front so the checkpoint
+            # inside _apply_alert records it; a failed eval re-derives
+            # the same max from the kept events (idempotent, and a too-
+            # large frontier only costs extra evaluations)
+            for ev in events:
+                art.data_hi = max(art.data_hi,
+                                  ev.rng[1] if ev.rng is not None else now)
+            t0 = time.perf_counter()
+            try:
+                active = await self._admitted(self._eval_alert(art, now))
+            except UnavailableError:
+                summary["shed"] += 1
+                RULE_EVALS.labels("alert", "shed").inc()
+                continue
+            except Exception:  # noqa: BLE001 — dirty kept; next tick
+                summary["errors"] += 1
+                RULE_EVALS.labels("alert", "error").inc()
+                logger.warning("alert rule %s evaluation failed", name,
+                               exc_info=True)
+                continue
+            RULE_EVAL_SECONDS.labels("alert").observe(
+                time.perf_counter() - t0
+            )
+            try:
+                n_tr = await self._apply_alert(art, active, now)
+            except Exception:  # noqa: BLE001 — checkpoint failed: state
+                # unchanged, transition not visible; next tick re-derives
+                # it ONCE (the exactly-once contract's crash side)
+                summary["errors"] += 1
+                RULE_EVALS.labels("alert", "error").inc()
+                logger.warning("alert state checkpoint failed for %s",
+                               name, exc_info=True)
+                continue
+            art.last_event = seen
+            art.force_eval = False
+            summary["evaluated"] += 1
+            summary["transitions"] += n_tr
+            RULE_EVALS.labels("alert", "ok").inc()
+
+    async def _eval_alert(self, art: _AlertRuntime, now: int) -> dict:
+        """Instant-vector evaluation at `now` (the HTTP instant-query
+        construction): key -> (labels, value) for every present series.
+        Rides the result cache through the engine's one query choke
+        point — N alert rules over the same selector pay one scan."""
+        from horaedb_tpu.promql.eval import LOOKBACK_MS, evaluate_range
+
+        with tracing.span("rule_eval", rule=art.rule.name, kind="alert"):
+            _steps, series = await evaluate_range(
+                self._engine, art.parsed, now - LOOKBACK_MS, now,
+                LOOKBACK_MS,
+            )
+        if isinstance(series, float):
+            raise HoraeError(
+                f"alert rule {art.rule.name} evaluates to a scalar; "
+                "alert bodies must produce a vector"
+            )
+        active: dict[tuple, tuple] = {}
+        for sv in series:
+            v = sv.values[-1]
+            if np.isnan(v):
+                continue
+            labels = {k: val for k, val in sv.labels.items()
+                      if k != "__name__"}
+            active[_labels_key(labels)] = (labels, float(v))
+        return active
+
+    async def _apply_alert(self, art: _AlertRuntime, active: dict,
+                           now: int) -> int:
+        """Drive the state machine, checkpoint durably, THEN make the
+        transitions visible (module docstring: the PUT is the
+        exactly-once commit point)."""
+        rule = art.rule
+        new_states: dict = {}
+        transitions: list[dict] = []
+
+        def note(frm: str, to: str, labels: dict, value: float) -> None:
+            transitions.append({
+                "seq": art.seq + len(transitions) + 1,
+                "at_ms": now, "from": frm, "to": to,
+                "labels": dict(labels), "value": value,
+            })
+
+        for key, (labels, value) in active.items():
+            prev = art.states.get(key)
+            if prev is None:
+                if rule.for_ms <= 0:
+                    new_states[key] = {
+                        "state": "firing", "since_ms": now,
+                        "fired_at": now, "labels": labels, "value": value,
+                    }
+                    note("inactive", "firing", labels, value)
+                else:
+                    new_states[key] = {
+                        "state": "pending", "since_ms": now,
+                        "fired_at": None, "labels": labels, "value": value,
+                    }
+                    note("inactive", "pending", labels, value)
+            elif (prev["state"] == "pending"
+                  and now - prev["since_ms"] >= rule.for_ms):
+                new_states[key] = {
+                    "state": "firing", "since_ms": prev["since_ms"],
+                    "fired_at": now, "labels": labels, "value": value,
+                }
+                note("pending", "firing", labels, value)
+            else:
+                new_states[key] = {**prev, "labels": labels,
+                                   "value": value}
+        for key, prev in art.states.items():
+            if key in active:
+                continue
+            note(prev["state"], "inactive", prev["labels"], prev["value"])
+        if not transitions and new_states == art.states:
+            return 0
+        seq = art.seq + len(transitions)
+        log = (art.transitions + transitions)[-TRANSITION_TAIL:]
+        await self._store.put_state(rule.name, {
+            "kind": "alert",
+            "seq": seq,
+            # presence frontier rides the checkpoint opportunistically;
+            # reopen without one falls back to the conservative
+            # _max_data_ts derivation (extra evals, never a miss)
+            "data_hi": art.data_hi,
+            "states": [
+                {
+                    "labels": st["labels"], "state": st["state"],
+                    "since_ms": st["since_ms"], "fired_at": st["fired_at"],
+                    "value": st["value"],
+                }
+                for _k, st in sorted(new_states.items())
+            ],
+            "transitions": log,
+        })
+        # durable: NOW the transitions exist
+        art.seq = seq
+        art.states = new_states
+        art.transitions = log
+        for tr in transitions:
+            if tr["to"] == "firing":
+                ALERT_TRANSITIONS.labels("firing").inc()
+            elif tr["to"] == "pending":
+                ALERT_TRANSITIONS.labels("pending").inc()
+            elif tr["from"] == "firing":
+                ALERT_TRANSITIONS.labels("resolved").inc()
+        return len(transitions)

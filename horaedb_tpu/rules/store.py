@@ -1,0 +1,175 @@
+"""Durable rule + alert-state storage: fenced manifest-level records.
+
+Layout under the rule root (one per engine, e.g. ``metrics/rules``):
+
+- ``{root}/manifest/rule/{digest(name)}`` — one JSON rule definition per
+  rule (rules/__init__.py serde). The PUT is the registration's
+  durability point; a registered rule survives crash/reopen.
+- ``{root}/manifest/state/{digest(name)}`` — one JSON state record per
+  rule: the recording watermark, or the alert rule's state machine
+  (per-series states + the exactly-once transition log tail + the
+  monotonic transition sequence).
+- ``{root}/manifest/epoch`` — the evaluator's segment-fingerprint
+  checkpoint: per data-table root, a digest of each segment's live SST
+  ids + overlapping tombstone ids at the end of the last tick. At open,
+  segments whose fingerprint differs from the checkpoint are exactly the
+  data that changed while no evaluator was watching — they seed the
+  reopen dirty set, so crash recovery re-derives only what it must.
+
+Every mutation validates the engine's epoch fence first (storage/
+fence.py) when one is installed: a deposed process must not advance rule
+state over the new owner's — the same single-writer contract the data
+manifests enforce. All paths live under ``manifest/``, which object-store
+fault models (objstore/chaos.py) treat as control-plane: atomic, never
+torn.
+
+Load policy mirrors tombstones, not rollups: a corrupt RULE or STATE
+record fails the open loudly. Silently skipping a rule record would
+silently stop a standing query; silently skipping an alert-state record
+could replay a transition the durable log already owns — the exactly-once
+contract dies either way. The epoch checkpoint alone is best-effort (a
+lost checkpoint only widens the reopen dirty set, never corrupts it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+
+from horaedb_tpu.common.error import context
+from horaedb_tpu.objstore import NotFound
+from horaedb_tpu.rules import rule_from_json
+
+logger = logging.getLogger(__name__)
+
+RULE_PREFIX = "manifest/rule"
+STATE_PREFIX = "manifest/state"
+EPOCH_PATH = "manifest/epoch"
+
+
+def _digest(name: str) -> str:
+    """Stable, path-safe key for a rule name (names are user input and
+    may contain characters no object path should)."""
+    return hashlib.blake2b(name.encode(), digest_size=16).hexdigest()
+
+
+class RuleStore:
+    """The durable half of the rule engine (rules/engine.py owns the
+    in-memory half and all evaluation)."""
+
+    def __init__(self, root: str, store, fence=None):
+        self._root = root.strip("/")
+        self._store = store
+        self._fence = fence
+
+    @property
+    def root(self) -> str:
+        return self._root
+
+    def _rule_path(self, name: str) -> str:
+        return f"{self._root}/{RULE_PREFIX}/{_digest(name)}"
+
+    def _state_path(self, name: str) -> str:
+        return f"{self._root}/{STATE_PREFIX}/{_digest(name)}"
+
+    def _epoch_path(self) -> str:
+        return f"{self._root}/{EPOCH_PATH}"
+
+    async def _ensure_owner(self) -> None:
+        if self._fence is not None:
+            # single-writer fence: a superseded epoch must not commit
+            # rule registrations, state checkpoints, or transitions
+            await self._fence.ensure_valid()
+
+    # -- rules ----------------------------------------------------------------
+    async def load(self) -> tuple[dict, dict]:
+        """(name -> rule, name -> state dict) from the durable records.
+        Corrupt records fail loudly (module docstring); a state record
+        whose rule is gone (crash between the two deletes) is dropped
+        best-effort."""
+        try:
+            metas = await self._store.list(f"{self._root}/{RULE_PREFIX}")
+        except NotFound:
+            metas = []
+        rules: dict = {}
+        for meta in metas:
+            blob = await self._store.get(meta.path)
+            with context(f"decode rule record {meta.path}"):
+                rule = rule_from_json(blob)
+            rules[rule.name] = rule
+        try:
+            smetas = await self._store.list(f"{self._root}/{STATE_PREFIX}")
+        except NotFound:
+            smetas = []
+        digests = {_digest(n): n for n in rules}
+        states: dict = {}
+        orphans = []
+        for meta in smetas:
+            key = meta.path.rsplit("/", 1)[-1]
+            name = digests.get(key)
+            if name is None:
+                orphans.append(meta.path)
+                continue
+            blob = await self._store.get(meta.path)
+            with context(f"decode rule state {meta.path}"):
+                states[name] = json.loads(blob.decode())
+        for p in orphans:
+            try:
+                await self._store.delete(p)
+            except Exception as e:  # noqa: BLE001 — retried next open
+                logger.warning("orphan rule state %s not deleted: %s", p, e)
+        return rules, states
+
+    async def put_rule(self, rule) -> None:
+        """Registration durability point (fenced)."""
+        await self._ensure_owner()
+        with context(f"write rule record {rule.name}"):
+            await self._store.put(self._rule_path(rule.name), rule.to_json())
+
+    async def delete_rule(self, name: str) -> None:
+        """Drop rule + state records. Rule first: a crash between the two
+        leaves an orphan STATE record, which load() GCs — the reverse
+        order would leave a rule evaluating with its state reset."""
+        await self._ensure_owner()
+        for path in (self._rule_path(name), self._state_path(name)):
+            try:
+                await self._store.delete(path)
+            except NotFound:
+                pass
+
+    # -- per-rule durable state ----------------------------------------------
+    async def put_state(self, name: str, state: dict) -> None:
+        """One rule's state checkpoint (fenced). For alert rules this PUT
+        *is* the exactly-once commit point: a transition exists iff it is
+        in this record."""
+        await self._ensure_owner()
+        with context(f"write rule state {name}"):
+            await self._store.put(
+                self._state_path(name),
+                json.dumps(state, sort_keys=True).encode(),
+            )
+
+    # -- the evaluator's segment-fingerprint checkpoint ----------------------
+    async def load_epoch(self) -> dict | None:
+        """None = no checkpoint (fresh store, or it was unreadable — the
+        caller must then treat everything as potentially dirty)."""
+        try:
+            blob = await self._store.get(self._epoch_path())
+        except NotFound:
+            return None
+        try:
+            d = json.loads(blob.decode())
+            return d if isinstance(d, dict) else None
+        except Exception as e:  # noqa: BLE001 — best-effort (docstring)
+            logger.warning("rule epoch checkpoint unreadable (%s); "
+                           "treating all segments dirty", e)
+            return None
+
+    async def put_epoch(self, epoch: dict) -> None:
+        await self._ensure_owner()
+        with context("write rule epoch checkpoint"):
+            await self._store.put(
+                self._epoch_path(),
+                json.dumps(epoch, sort_keys=True).encode(),
+            )

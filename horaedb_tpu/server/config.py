@@ -275,12 +275,49 @@ class LimitsConfig:
 
 
 @dataclass
+class RulesConfig:
+    """Streaming rule engine knobs (`[metric_engine.rules]`,
+    horaedb_tpu/rules): recording rules materialized incrementally at
+    flush time + alert rules with exactly-once transitions. See
+    docs/operations.md "Rules"."""
+
+    enabled: bool = True
+    # evaluator tick spacing (the server's background loop; rules are
+    # dirty-set driven, so a quiet tick costs ~nothing)
+    eval_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(30)
+    )
+    # admission-fairness identity for rule evaluations, and its
+    # weighted-fair share (merged into query.tenant_weights; low by
+    # default so a rule storm queues behind dashboards, not ahead)
+    tenant: str = "rules"
+    tenant_weight: float = 0.25
+    # rules declared in TOML ([[metric_engine.rules.recording]] /
+    # [[metric_engine.rules.alerting]] arrays of tables); validated and
+    # durably registered at boot (by name — a restart re-asserts them)
+    recording: list = field(default_factory=list)
+    alerting: list = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "RulesConfig":
+        # kind-tagging of the recording/alerting arrays lives in the
+        # generic loader (_from_dict), which is ALSO what runs when this
+        # config nests under MetricEngineConfig — one path, no drift
+        return _from_dict(cls, d)
+
+
+@dataclass
 class MetricEngineConfig:
     threads: ThreadConfig = field(default_factory=ThreadConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
     query: QueryConfig = field(default_factory=QueryConfig)
     retention: RetentionConfig = field(default_factory=RetentionConfig)
     limits: LimitsConfig = field(default_factory=LimitsConfig)
+    # Streaming rule engine ([metric_engine.rules], horaedb_tpu/rules):
+    # recording rules evaluated incrementally off the invalidation
+    # funnel's dirty sets, alert rules with fenced exactly-once
+    # transitions, both admission-controlled as a low-weight tenant.
+    rules: RulesConfig = field(default_factory=RulesConfig)
     # Serving tier for repeated dashboard traffic ([metric_engine.serving],
     # horaedb_tpu/serving): compaction-time rollups, the invalidation-
     # correct result cache, hot-block device residency. ON by default —
@@ -424,6 +461,12 @@ class Config:
             self.metric_engine.limits.max_series >= 0,
             "limits.max_series must be >= 0 (0 disables the limit)",
         )
+        rules = self.metric_engine.rules
+        ensure(rules.eval_interval.seconds > 0,
+               "rules.eval_interval must be positive")
+        ensure(rules.tenant_weight > 0,
+               "rules.tenant_weight must be positive")
+        ensure(bool(rules.tenant), "rules.tenant must be non-empty")
         store = self.metric_engine.storage.object_store
         kind = store.type.lower()
         ensure(

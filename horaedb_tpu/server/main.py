@@ -25,6 +25,14 @@ plus the ingest/query endpoints the reference defines but never wired
     GET  /api/v1/series    per-metric series listing
     GET  /api/v1/metadata  metric-family metadata (Prometheus shape)
 
+plus the streaming rule engine (horaedb_tpu/rules):
+
+    POST /api/v1/rules        register one recording/alert rule (durable)
+    GET  /api/v1/rules        registered rules, Prometheus groups shape
+    DELETE /api/v1/rules/{n}  unregister
+    GET  /api/v1/alerts       active alerts (+ ?transitions=<rule> tail)
+    POST /api/v1/rules/tick   force one evaluator tick (admin/debug)
+
 Run: python -m horaedb_tpu.server.main --config docs/example.toml
 """
 
@@ -208,7 +216,8 @@ def snappy_decompress(buf: bytes) -> bytes:
 class ServerState:
     def __init__(self, config: Config, storage, engine: MetricEngine,
                  parser_pool=None, slowlog: "SlowLog | None" = None,
-                 admission_controller: "AdmissionController | None" = None):
+                 admission_controller: "AdmissionController | None" = None,
+                 rules=None):
         self.config = config
         self.storage = storage       # demo ColumnarStorage (reference parity)
         self.engine = engine         # metric engine (remote-write path)
@@ -217,6 +226,8 @@ class ServerState:
         # bounded query scheduler (server/admission.py): every query
         # handler routes through it (jaxlint J011)
         self.admission = admission_controller or AdmissionController()
+        # streaming rule engine (horaedb_tpu/rules), None = disabled
+        self.rules = rules
         self.write_enabled = asyncio.Event()
         self.write_workers: list[asyncio.Task] = []
 
@@ -708,6 +719,7 @@ async def handle_query_range(request: web.Request) -> web.Response:
     explain = _finish_explain(state, st, "promql_range",
                               _want_explain(request, p),
                               admission_verdict=slot.verdict())
+    _attach_rule_provenance(state, explain, _selector_names(expr))
     body = {"status": "success", "data": to_prometheus_matrix(series, ev.steps)}
     if explain is not None:
         body["explain"] = explain
@@ -753,6 +765,7 @@ async def handle_promql_instant(
     explain = _finish_explain(state, st, "promql_instant",
                               _want_explain(request, params),
                               admission_verdict=slot.verdict())
+    _attach_rule_provenance(state, explain, _selector_names(expr))
     body = {"status": "success", "data": to_prometheus_vector(series, at_ms)}
     if explain is not None:
         body["explain"] = explain
@@ -896,6 +909,7 @@ async def handle_query(request: web.Request) -> web.Response:
         return web.json_response({"error": str(e)}, status=400)
     explain = _finish_explain(state, st, mode, want_explain,
                               admission_verdict=slot.verdict())
+    _attach_rule_provenance(state, explain, [q["metric"]])
     if q.get("exemplars"):
         if table is None:
             return web.json_response(
@@ -1263,6 +1277,179 @@ async def handle_metadata(request: web.Request) -> web.Response:
 
 
 # ---------------------------------------------------------------------------
+# streaming rule engine surface (horaedb_tpu/rules)
+# ---------------------------------------------------------------------------
+
+
+def _selector_names(expr) -> tuple:
+    """Metric names a parsed PromQL expression reads (EXPLAIN rule
+    provenance for the PromQL handlers) — the shared promql walker."""
+    from horaedb_tpu.promql.eval import selector_metrics
+
+    return selector_metrics(expr)
+
+
+def _rule_provenance(state: "ServerState", metrics) -> dict | None:
+    """EXPLAIN provenance for rule-produced series: which of the queried
+    metrics are recording-rule outputs, and the producing rule's body —
+    so a dashboard reading `cpu:rate5m` can see it is materialized, by
+    what, from what."""
+    if state.rules is None:
+        return None
+    hit = sorted(set(metrics) & state.rules.output_metrics())
+    if not hit:
+        return None
+    produced = {}
+    for m in hit:
+        rule = state.rules.rule_for_metric(m)
+        if rule is not None:
+            produced[m] = {"rule": rule.name, "expr": rule.expr,
+                           "interval_ms": rule.interval_ms}
+    return {"rule_produced": produced}
+
+
+def _attach_rule_provenance(state, explain, metrics) -> None:
+    if explain is None:
+        return
+    prov = _rule_provenance(state, metrics)
+    if prov is not None:
+        explain["rules"] = prov
+
+
+def _rules_unavailable() -> web.Response:
+    return web.json_response(
+        {"status": "error", "errorType": "unavailable",
+         "error": "rule engine disabled ([metric_engine.rules] "
+                  "enabled = false)"},
+        status=501,
+    )
+
+
+async def handle_rules_get(request: web.Request) -> web.Response:
+    """Registered rules, Prometheus /api/v1/rules groups shape (one
+    implicit group per kind), with live alert state folded in."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.rules is None:
+        return _rules_unavailable()
+    recording, alerting = [], []
+    active = {}
+    for a in state.rules.alerts():
+        active.setdefault(a["labels"]["alertname"], []).append(a)
+    for rule in state.rules.list_rules():
+        if rule.kind == "recording":
+            recording.append({
+                "type": "recording", "name": rule.name,
+                "query": rule.expr, "labels": rule.labels,
+                "interval": rule.interval_ms / 1000.0,
+            })
+        else:
+            alerts = active.get(rule.name, [])
+            worst = "inactive"
+            if any(a["state"] == "firing" for a in alerts):
+                worst = "firing"
+            elif alerts:
+                worst = "pending"
+            alerting.append({
+                "type": "alerting", "name": rule.name,
+                "query": rule.expr, "duration": rule.for_ms / 1000.0,
+                "labels": rule.labels, "annotations": rule.annotations,
+                "state": worst, "alerts": alerts,
+            })
+    groups = []
+    if recording:
+        groups.append({"name": "recording", "rules": recording})
+    if alerting:
+        groups.append({"name": "alerting", "rules": alerting})
+    return web.json_response({"status": "success",
+                              "data": {"groups": groups}})
+
+
+async def handle_rules_post(request: web.Request) -> web.Response:
+    """Register (or replace, by name) one rule. Body: {"kind":
+    "recording"|"alert", "name", "expr", "interval"|"for", "labels",
+    "annotations"}. The PUT of the durable record is the registration's
+    durability point — a 200 means the rule survives restarts."""
+    from horaedb_tpu.promql import PromQLError
+    from horaedb_tpu.rules import rule_from_dict
+
+    state: ServerState = request.app[STATE_KEY]
+    if state.rules is None:
+        return _rules_unavailable()
+    try:
+        body = await request.json()
+    except Exception as e:  # noqa: BLE001 — client data
+        return _promql_error(ValueError(f"bad JSON body: {e}"))
+    try:
+        rule = rule_from_dict(body, now_ms=now_ms())
+        # idempotent like the boot path: re-POSTing an UNCHANGED
+        # definition (config-sync reconciliation) must not reset the
+        # watermark or wipe the alert state machine / transition log
+        changed = await shield_mutation(state.rules.ensure_registered(rule))
+    except UnavailableError as e:
+        return unavailable_response(e)
+    except (PromQLError, HoraeError, KeyError, TypeError, ValueError) as e:
+        return _promql_error(e)
+    METRICS.inc("horaedb_rules_api_registrations_total")
+    return web.json_response({
+        "status": "success",
+        "data": {"kind": rule.kind, "name": rule.name, "expr": rule.expr,
+                 "updated": changed},
+    })
+
+
+async def handle_rules_delete(request: web.Request) -> web.Response:
+    state: ServerState = request.app[STATE_KEY]
+    if state.rules is None:
+        return _rules_unavailable()
+    name = request.match_info["name"]
+    try:
+        known = await shield_mutation(state.rules.delete(name))
+    except UnavailableError as e:
+        return unavailable_response(e)
+    if not known:
+        return web.json_response(
+            {"status": "error", "errorType": "bad_data",
+             "error": f"unknown rule {name!r}"},
+            status=404,
+        )
+    return web.json_response({"status": "success", "data": {"deleted": name}})
+
+
+async def handle_alerts(request: web.Request) -> web.Response:
+    """Active alerts (Prometheus /api/v1/alerts shape). The optional
+    `?transitions=<rule>` debug view returns that rule's durable
+    transition-log tail (the exactly-once record the runbooks and the
+    chaos oracle read)."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.rules is None:
+        return _rules_unavailable()
+    name = request.query.get("transitions")
+    if name:
+        return web.json_response({
+            "status": "success",
+            "data": {"rule": name,
+                     "transitions": state.rules.transitions(name)},
+        })
+    return web.json_response({
+        "status": "success", "data": {"alerts": state.rules.alerts()},
+    })
+
+
+async def handle_rules_tick(request: web.Request) -> web.Response:
+    """Force one evaluator tick NOW (admin/debug; the smoke gate and
+    stuck-pending runbooks use it instead of waiting out the interval).
+    Serialized with the background loop by the engine's tick lock."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.rules is None:
+        return _rules_unavailable()
+    try:
+        summary = await shield_mutation(state.rules.tick())
+    except UnavailableError as e:
+        return unavailable_response(e)
+    return web.json_response({"status": "success", "data": summary})
+
+
+# ---------------------------------------------------------------------------
 # self-write load generator (main.rs:187-233)
 # ---------------------------------------------------------------------------
 
@@ -1410,16 +1597,42 @@ async def build_app(config: Config, store=None) -> web.Application:
             min_duration_s=config.slowlog.min_duration.seconds,
         )
     qcfg = config.metric_engine.query
+    rcfg = config.metric_engine.rules
+    # rule evaluations run as a distinct weighted-fair tenant; its LOW
+    # default share means a rule storm queues behind dashboards, never
+    # ahead of them (an explicit tenant_weights entry wins)
+    weights = dict(qcfg.tenant_weights)
+    weights.setdefault(rcfg.tenant, rcfg.tenant_weight)
     adm = AdmissionController(
         max_concurrent=qcfg.max_concurrent,
         max_per_tenant=qcfg.max_per_tenant,
         queue_max=qcfg.queue_max,
         queue_deadline_s=qcfg.queue_deadline.seconds,
         max_cost_s=qcfg.max_cost_s,
-        weights=qcfg.tenant_weights,
+        weights=weights,
     )
+    rules_engine = None
+    if rcfg.enabled:
+        from horaedb_tpu.rules import rule_from_dict
+        from horaedb_tpu.rules.engine import RuleEngine
+
+        rules_engine = await RuleEngine.open(
+            engine, store, root="metrics/rules",
+            # single-writer discipline rides the engine's fence when one
+            # is configured (regioned deployments fence per region root;
+            # the rule store then relies on deployment discipline)
+            fence=getattr(engine, "_fence", None),
+            admission=adm, tenant=rcfg.tenant,
+        )
+        # config-declared rules: asserted idempotently (an unchanged
+        # definition keeps its watermark / alert states across restarts)
+        for entry in list(rcfg.recording) + list(rcfg.alerting):
+            await rules_engine.ensure_registered(
+                rule_from_dict(entry, now_ms=now_ms())
+            )
     state = ServerState(config, storage, engine, parser_pool=pool,
-                        slowlog=slow, admission_controller=adm)
+                        slowlog=slow, admission_controller=adm,
+                        rules=rules_engine)
     if config.test.enable_write:
         state.write_enabled.set()
     for i in range(config.test.write_worker_num):
@@ -1441,6 +1654,23 @@ async def build_app(config: Config, store=None) -> web.Application:
 
         state.write_workers.append(
             asyncio.create_task(flush_loop(), name="ingest-flush")
+        )
+    if rules_engine is not None:
+        # the evaluator tick loop: dirty-set driven, so a quiet tick
+        # costs ~nothing; failures log and retry next interval (the
+        # dirty sets only clear on success, so nothing is lost)
+        rules_interval = rcfg.eval_interval.seconds
+
+        async def rules_loop():
+            while True:
+                await asyncio.sleep(rules_interval)
+                try:
+                    await rules_engine.tick()
+                except Exception:  # noqa: BLE001 — keep ticking
+                    logger.exception("rule evaluator tick failed")
+
+        state.write_workers.append(
+            asyncio.create_task(rules_loop(), name="rule-evaluator")
         )
 
     tracing.configure(
@@ -1472,6 +1702,11 @@ async def build_app(config: Config, store=None) -> web.Application:
             web.get("/api/v1/metrics", handle_metrics_list),
             web.get("/api/v1/series", handle_series),
             web.get("/api/v1/metadata", handle_metadata),
+            web.get("/api/v1/rules", handle_rules_get),
+            web.post("/api/v1/rules", handle_rules_post),
+            web.delete("/api/v1/rules/{name}", handle_rules_delete),
+            web.get("/api/v1/alerts", handle_alerts),
+            web.post("/api/v1/rules/tick", handle_rules_tick),
             web.post("/api/v1/admin/tsdb/delete_series", handle_delete_series),
             web.get("/api/v1/status/buildinfo", handle_buildinfo),
             web.get("/debug/traces", handle_debug_traces),
@@ -1486,6 +1721,8 @@ async def build_app(config: Config, store=None) -> web.Application:
             t.cancel()
         # wait for in-flight writes before closing storage under them
         await asyncio.gather(*state.write_workers, return_exceptions=True)
+        if state.rules is not None:
+            await state.rules.close()
         await state.storage.close()
         await state.engine.close()
         closer = getattr(store, "close", None)

@@ -18,6 +18,16 @@ independent mechanisms keep it correct — belt and braces:
    entries the moment its data changes, so dead entries do not squat on
    the byte budget until LRU pressure finds them.
 
+The funnel is also the engine's ONE mutation broadcast: every event that
+purges the cache names exactly the (root, reason, time range) that
+changed, so standing-query consumers can ride it instead of polling.
+`serving_subscribe` registers a callback `(root, reason, time_range)`
+called synchronously on every invalidation, with error isolation (a
+broken subscriber logs; it never fails the commit that fired the event).
+jaxlint J014 pins the consumer set: only the cache itself and the rule
+evaluator (horaedb_tpu/rules) may subscribe — a third consumer would be
+a second standing-query engine growing outside the audited one.
+
 Fills are **single-flight**: N concurrent queries with the same key pay
 ONE computation (the leader's); followers await its future. A leader
 failure resolves followers with a sentinel and they fall back to their
@@ -81,6 +91,12 @@ class ResultCache:
         self._lock = threading.Lock()
         # key -> (owning loop, future) for in-flight fills
         self._inflight: dict[bytes, tuple] = {}
+        # invalidation subscribers: token -> callback(root, reason, range).
+        # Registered ONLY by the funnel's audited consumers (jaxlint J014:
+        # serving/ and the rule evaluator); called synchronously after the
+        # purge with error isolation.
+        self._subscribers: dict[int, object] = {}
+        self._next_token = 1
 
     # -- sizing ---------------------------------------------------------------
     def configure(self, capacity_bytes: int) -> None:
@@ -184,12 +200,19 @@ class ResultCache:
             self._shrink_locked()
         self._export()
 
-    def serving_invalidate(self, root: str, reason: str) -> int:
+    def serving_invalidate(
+        self, root: str, reason: str, time_range=None,
+    ) -> int:
         """The invalidation funnel: drop every entry of `root` because
         its data changed (`reason` in flush|compact|delete). The keys
         would never hit again anyway (the SST set / tombstone epoch in
         the key changed) — this frees the bytes eagerly and feeds the
-        horaedb_serving_invalidations_total signal the runbooks watch."""
+        horaedb_serving_invalidations_total signal the runbooks watch.
+
+        `time_range` (storage TimeRange or None=unknown) names WHAT
+        changed; the purge itself is root-granular either way, but
+        subscribers (the rule evaluator's dirty sets) use the range to
+        bound incremental recomputation."""
         with self._lock:
             keys = self._by_root.pop(root, None)
             dropped = 0
@@ -199,9 +222,38 @@ class ResultCache:
                     if ent is not None:
                         self._bytes -= ent[1]
                         dropped += 1
+            subscribers = list(self._subscribers.values())
         INVALIDATIONS.labels(reason).inc()
         self._export()
+        # notify outside the lock: a subscriber probing the cache (or
+        # raising) must never deadlock/fail the commit that fired this
+        for cb in subscribers:
+            try:
+                cb(root, reason, time_range)
+            except Exception:  # noqa: BLE001 — error isolation: the
+                # commit already happened; a broken consumer only logs
+                logger.exception(
+                    "serving invalidation subscriber failed "
+                    "(root=%s reason=%s)", root, reason,
+                )
         return dropped
+
+    # -- the subscription hook (jaxlint J014: serving/ + rules/ only) ---------
+    def serving_subscribe(self, callback) -> int:
+        """Register `callback(root, reason, time_range)` on the purge
+        funnel; returns an unsubscribe token. Callbacks run synchronously
+        inside the mutation commit that fired the event (same task, no
+        awaits), so they must be cheap — record the dirty fact and
+        return; evaluation belongs to the consumer's own tick."""
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            self._subscribers[token] = callback
+        return token
+
+    def serving_unsubscribe(self, token: int) -> None:
+        with self._lock:
+            self._subscribers.pop(token, None)
 
     def clear(self) -> None:
         """Test hook: drop everything (not part of the funnel)."""

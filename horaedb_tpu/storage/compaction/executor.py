@@ -147,7 +147,10 @@ class Executor:
             # no merge — the horizon already proved every row out of range
             to_deletes = [f.id for f in task.expireds]
             await self._manifest.update([], to_deletes)
-            RESULT_CACHE.serving_invalidate(self._storage._root, "compact")
+            RESULT_CACHE.serving_invalidate(
+                self._storage._root, "compact",
+                TimeRange.union_of([f.meta.time_range for f in task.expireds]),
+            )
             await self._delete_ssts(to_deletes)
             await self._gc_tombstones()
             await self._gc_rollups()
@@ -189,7 +192,12 @@ class Executor:
             # retry loop).
             to_deletes = [f.id for f in task.expireds] + [f.id for f in task.inputs]
             await self._manifest.update([], to_deletes)
-            RESULT_CACHE.serving_invalidate(self._storage._root, "compact")
+            RESULT_CACHE.serving_invalidate(
+                self._storage._root, "compact",
+                TimeRange.union_of(
+                    [f.meta.time_range for f in task.inputs + task.expireds]
+                ),
+            )
             await self._delete_ssts(to_deletes)
             await self._gc_tombstones()
             await self._gc_rollups()
@@ -257,7 +265,9 @@ class Executor:
         await self._manifest.update(new_files, to_deletes)
         # serving-tier invalidation funnel (jaxlint J013): the sealed-SST
         # set just changed; cached results over the old set are dead
-        RESULT_CACHE.serving_invalidate(self._storage._root, "compact")
+        RESULT_CACHE.serving_invalidate(
+            self._storage._root, "compact", time_range
+        )
         # From now on, no error should be returned (executor.rs:218-219).
         try:
             # rollup emission rides the bytes compaction already rewrote:

@@ -70,6 +70,15 @@ def _from_dict(cls, d: dict):
             from horaedb_tpu.serving import parse_resolution
 
             kwargs[name] = [parse_resolution(v) for v in value]
+        elif name in ("recording", "alerting") and value is not None:
+            # rule arrays ([[metric_engine.rules.recording]] /
+            # [[...alerting]]): tag each entry with its kind so the rule
+            # engine's one validator (rules.rule_from_dict) serves both
+            kind = "recording" if name == "recording" else "alert"
+            kwargs[name] = [
+                {**e, "kind": kind} if isinstance(e, dict) else e
+                for e in value
+            ]
         elif name == "column_options" and value is not None:
             kwargs[name] = {
                 col: _from_dict(ColumnOptions, opts) for col, opts in value.items()

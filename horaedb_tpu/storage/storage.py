@@ -472,7 +472,7 @@ class ObjectBasedStorage(ColumnarStorage):
         # covering this range; purge the table's entries eagerly too
         from horaedb_tpu.serving.cache import RESULT_CACHE
 
-        RESULT_CACHE.serving_invalidate(self._root, "delete")
+        RESULT_CACHE.serving_invalidate(self._root, "delete", time_range)
         logger.info(
             "tombstone created: root=%s id=%d range=[%d,%d) matchers=%s",
             self._root, rid, time_range.start, time_range.end, matchers,
@@ -515,7 +515,7 @@ class ObjectBasedStorage(ColumnarStorage):
         # changes the table's sealed set — cached results for it are dead
         from horaedb_tpu.serving.cache import RESULT_CACHE
 
-        RESULT_CACHE.serving_invalidate(self._root, "flush")
+        RESULT_CACHE.serving_invalidate(self._root, "flush", req.time_range)
         WRITE_ROWS.labels(self._root).inc(req.batch.num_rows)
 
     async def _run_sst(self, fn, *args):

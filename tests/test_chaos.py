@@ -985,6 +985,254 @@ class TestOverloadChaos:
             await client.close()
 
 
+class TestRulesChaosSoak:
+    """The streaming-rule-engine chaos lane: recording + alert rules over
+    a seeded fault plan, a mid-soak kill (abandon without close) and
+    reopen. Invariants at every soak round:
+
+    - the recording rule's stored output is EXACTLY what a cold
+      evaluation of the same PromQL body over the raw data produces at
+      that instant (the bit-exactness acceptance bar, held under
+      injected store faults, deletes, and the crash);
+    - alert transitions are exactly-once vs a host-model oracle running
+      the same state machine over the same tick schedule: gapless
+      monotonic sequences, no duplicate firing/resolved flaps, no lost
+      transitions across the kill/reopen.
+    """
+
+    BASE = 1_700_000_000_000
+    MIN = 60_000
+    LOOKBACK = 300_000
+    EXPR = "sum by (host) (sum_over_time(chaos_cpu[1m]))"
+
+    def _payload(self, series: dict, name: bytes) -> bytes:
+        return make_remote_write([
+            ({"__name__": name.decode(), "host": host}, samples)
+            for host, samples in sorted(series.items())
+        ])
+
+    async def _write_acked(self, eng, series, name=b"chaos_cpu",
+                           retries=30):
+        payload = self._payload(series, name)
+        last = None
+        for _ in range(retries):
+            try:
+                await eng.write_parsed(PooledParser.decode(payload))
+                return
+            except (InjectedFault, UnavailableError) as e:
+                last = e
+        raise AssertionError(f"payload never acked: {last}")
+
+    async def _tick_settled(self, rules, now, retries=30):
+        """Drive one logical tick to a clean state: evaluation/write/
+        checkpoint failures keep their dirty sets, so re-ticking at the
+        same instant is the sender-retry analog (transitions re-derive
+        at the same at_ms — exactly-once keeps them single)."""
+        last = None
+        for _ in range(retries):
+            s = await rules.tick(now_ms=now)
+            if s["errors"] == 0 and s["shed"] == 0:
+                return s
+            last = s
+        raise AssertionError(f"tick never settled: {last}")
+
+    async def _cold(self, eng, now):
+        from horaedb_tpu.promql.eval import evaluate_range
+
+        first = -(-self.BASE // self.MIN) * self.MIN
+        target = now // self.MIN * self.MIN
+        for _ in range(30):
+            try:
+                steps, series = await evaluate_range(
+                    eng, self.EXPR, first, target, self.MIN,
+                )
+                break
+            except (InjectedFault, UnavailableError):
+                continue
+        else:
+            raise AssertionError("cold eval never succeeded")
+        out = {}
+        for sv in series:
+            for t, v in zip(steps, sv.values):
+                if v == v:  # not NaN
+                    out[(sv.labels.get("host"), int(t))] = float(v)
+        return out
+
+    async def _stored(self, eng):
+        for _ in range(30):
+            try:
+                t = await eng.query(QueryRequest(
+                    metric=b"chaos:cpu:sum", start_ms=0,
+                    end_ms=self.BASE + 10_000 * self.MIN,
+                ))
+                labels = await eng.match_series(b"chaos:cpu:sum", [], [])
+                break
+            except (InjectedFault, UnavailableError):
+                continue
+        else:
+            raise AssertionError("rule-output query never succeeded")
+        if t is None:
+            return {}
+        host_of = {
+            tsid: labs[b"host"].decode() for tsid, labs in labels.items()
+        }
+        out = {}
+        for tsid, ts, v in zip(t.column("tsid").to_pylist(),
+                               t.column("ts").to_pylist(),
+                               t.column("value").to_pylist()):
+            out[(host_of[int(tsid)], ts)] = float(v)
+        return out
+
+    @async_test
+    async def test_rules_soak_exact_output_exactly_once_transitions(self):
+        from horaedb_tpu.rules import AlertRule, RecordingRule
+        from horaedb_tpu.rules.engine import RuleEngine
+
+        BASE, MIN = self.BASE, self.MIN
+        inner = MemStore()
+        chaos = ChaosStore(inner, FaultPlan(
+            seed=20260805,
+            ops={
+                "put": OpFaults(error_rate=0.10, lost_ack_rate=0.04),
+                "get": OpFaults(error_rate=0.08),
+                "list": OpFaults(error_rate=0.08),
+                "delete": OpFaults(error_rate=0.08),
+            },
+            visibility_lag_ops=6,
+        ))
+        store = ResilientStore(
+            chaos, retry=fast_retry(attempts=10),
+            breaker=BreakerPolicy(failure_threshold=5, open_for=ms(50)),
+            name="rules-soak",
+        )
+        eng = await MetricEngine.open(
+            "rdb", store, segment_duration_ms=HOUR,
+            enable_compaction=False, ingest_buffer_rows=32,
+        )
+        rules = await RuleEngine.open(eng, store, root="rdb/rules")
+        await rules.register(RecordingRule(
+            name="chaos:cpu:sum", expr=self.EXPR, interval_ms=MIN,
+            since_ms=BASE,
+        ).validate())
+        await rules.register(AlertRule(
+            name="ChaosAlert", expr='chaos_sig{host="s"}',
+            for_ms=2 * MIN,
+        ).validate())
+
+        # ---- the host-model oracle for the alert state machine --------
+        sig_ts: list[int] = []
+        oracle_state = "inactive"
+        oracle_since = None
+        oracle_transitions: list[tuple] = []
+
+        def oracle_tick(t: int) -> None:
+            nonlocal oracle_state, oracle_since
+            present = any(s <= t <= s + self.LOOKBACK for s in sig_ts)
+            if present and oracle_state == "inactive":
+                oracle_state, oracle_since = "pending", t
+                oracle_transitions.append(("inactive", "pending"))
+            elif (present and oracle_state == "pending"
+                  and t - oracle_since >= 2 * MIN):
+                oracle_state = "firing"
+                oracle_transitions.append(("pending", "firing"))
+            elif not present and oracle_state != "inactive":
+                oracle_transitions.append((oracle_state, "inactive"))
+                oracle_state, oracle_since = "inactive", None
+
+        async def check_round(tag: str, now: int) -> None:
+            stored = await self._stored(eng)
+            cold = await self._cold(eng, now)
+            assert stored == cold, (
+                f"{tag}: rule output diverged from cold eval "
+                f"(extra={sorted(set(stored) - set(cold))[:3]}, "
+                f"missing={sorted(set(cold) - set(stored))[:3]})"
+            )
+            got = [(t["from"], t["to"])
+                   for t in rules.transitions("ChaosAlert")]
+            assert got == oracle_transitions, (
+                f"{tag}: transitions diverged from oracle: "
+                f"got={got} want={oracle_transitions}"
+            )
+            seqs = [t["seq"] for t in rules.transitions("ChaosAlert")]
+            assert seqs == list(range(1, len(seqs) + 1)), (
+                f"{tag}: transition sequence not gapless: {seqs}"
+            )
+
+        # ---- pre-crash soak ------------------------------------------
+        for rnd in range(8):
+            now = BASE + (rnd + 1) * MIN
+            await self._write_acked(eng, {
+                f"h{rnd % 3}": [(BASE + rnd * MIN + 10_000,
+                                 float(rnd * 10 + 1))],
+                "h9": [(BASE + rnd * MIN + 20_000, float(rnd))],
+            })
+            if rnd in (2, 3, 4):  # the alert signal window
+                await self._write_acked(
+                    eng, {"s": [(now - 30_000, 1.0)]}, name=b"chaos_sig",
+                )
+                sig_ts.append(now - 30_000)
+            if rnd == 5:
+                # delete a slice of the input: output must re-converge
+                for _ in range(30):
+                    try:
+                        await eng.delete_series(
+                            b"chaos_cpu",
+                            filters=[(b"host", b"h0")],
+                            start_ms=BASE, end_ms=BASE + 3 * MIN,
+                        )
+                        break
+                    except (InjectedFault, UnavailableError):
+                        continue
+                else:
+                    raise AssertionError("delete never acked")
+            await self._tick_settled(rules, now)
+            oracle_tick(now)
+            await check_round(f"round {rnd}", now)
+
+        # ---- kill: abandon without close (buffered rows may die; the
+        # evaluator's in-memory dirty state certainly does)
+        pre_now = BASE + 8 * MIN
+        await rules.close()  # a dead process holds no subscription
+        await crash(eng)
+        del eng
+
+        chaos.settle()
+        eng = await MetricEngine.open(
+            "rdb", store, segment_duration_ms=HOUR,
+            enable_compaction=False, ingest_buffer_rows=32,
+        )
+        rules2 = await RuleEngine.open(eng, store, root="rdb/rules")
+        # durable state survived: rules, alert machine, transition log
+        assert {r.name for r in rules2.list_rules()} == {
+            "chaos:cpu:sum", "ChaosAlert",
+        }
+        got = [(t["from"], t["to"])
+               for t in rules2.transitions("ChaosAlert")]
+        assert got == oracle_transitions, (got, oracle_transitions)
+        rules = rules2
+
+        # ---- post-crash soak: keep mutating, stay exact, resolve ------
+        for rnd in range(8, 14):
+            now = BASE + (rnd + 1) * MIN
+            await self._write_acked(eng, {
+                f"h{rnd % 3}": [(BASE + rnd * MIN + 10_000,
+                                 float(rnd * 10 + 1))],
+            })
+            await self._tick_settled(rules, now)
+            oracle_tick(now)
+            await check_round(f"post-crash round {rnd}", now)
+        # the signal aged out mid-soak: the oracle (and the engine) must
+        # have resolved the alert exactly once, with no flap
+        flaps = [tr for tr in oracle_transitions
+                 if tr in (("pending", "firing"), ("firing", "inactive"))]
+        assert oracle_transitions.count(("firing", "inactive")) == 1
+        assert flaps == [("pending", "firing"), ("firing", "inactive")]
+        assert rules.alerts() == []
+        assert chaos.injected_errors > 0  # the plan actually fired
+        await rules.close()
+        await eng.close()
+
+
 class TestEncodedChaosSoak:
     @async_test
     async def test_encoded_ssts_survive_chaos_crash_and_compaction(

@@ -843,3 +843,70 @@ class TestJ013ServingFunnel:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ014FunnelSubscribers:
+    """J014: the invalidation funnel's consumer set is pinned — only the
+    cache (serving/) and the rule evaluator (rules/) may subscribe to
+    `serving_subscribe`/`serving_unsubscribe`. A third subscriber is a
+    second standing-query engine growing outside the audited one."""
+
+    def seeded(self, tmp_path, body, rel="engine/watcher.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_subscription_fires_outside_consumer_set(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def watch(cache, cb, token):\n"
+            "    t = cache.serving_subscribe(cb)\n"       # J014
+            "    cache.serving_unsubscribe(token)\n"       # J014
+            "    return t\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 2, r.stdout
+        assert r.stdout.count("J014") == 2, r.stdout
+        assert "consumer set" in r.stdout
+
+    def test_server_layer_also_in_scope(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def boot(cache, cb):\n"
+            "    return cache.serving_subscribe(cb)\n",
+            rel="server/main.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J014" in r.stdout
+
+    def test_consumer_modules_exempt(self, tmp_path):
+        body = (
+            "def init(cache, cb):\n"
+            "    return cache.serving_subscribe(cb)\n"
+        )
+        for rel in ("serving/cache.py", "rules/engine.py",
+                    "rules/sub/extra.py"):
+            r = run_jaxlint(self.seeded(tmp_path, body, rel=rel))
+            assert r.returncode == 0, (rel, r.stdout)
+
+    def test_unrelated_subscribe_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def other(bus, cb):\n"
+            "    bus.subscribe(cb)\n"
+            "    bus.unsubscribe(cb)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def gate(cache, cb):\n"
+            "    # jaxlint: disable=J014 harness asserting subscriber error isolation\n"
+            "    return cache.serving_subscribe(cb)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

@@ -1,0 +1,802 @@
+"""Streaming rule engine (horaedb_tpu/rules): recording rules are
+bit-exact vs cold evaluation of the same PromQL body across flush/
+backfill/delete/crash-reopen; quiet ticks evaluate ZERO rules (the
+dirty-set skip); rule output never re-triggers its own rule; alert
+state machines transition exactly-once through the fenced store."""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.engine import MetricEngine, QueryRequest
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.pb import remote_write_pb2
+from horaedb_tpu.rules import (
+    RULE_DIRTY_SKIPS,
+    RULE_WRITE_DEGRADED,
+    AlertRule,
+    RecordingRule,
+    rule_from_dict,
+)
+from horaedb_tpu.rules.engine import RuleEngine
+from tests.conftest import async_test
+
+BASE = 1_700_000_000_000
+MIN = 60_000
+# the epoch-aligned first step of a rule with since_ms=BASE, interval=1m
+FIRST = -(-BASE // MIN) * MIN
+
+
+def payload(series: dict, name: bytes = b"cpu") -> bytes:
+    req = remote_write_pb2.WriteRequest()
+    for host, samples in sorted(series.items()):
+        ts = req.timeseries.add()
+        for k, v in ((b"__name__", name), (b"host", host.encode())):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = v
+        for t, v in samples:
+            s = ts.samples.add()
+            s.timestamp = t
+            s.value = v
+    return req.SerializeToString()
+
+
+async def open_pair(root: str, store=None, **engine_kw):
+    store = store if store is not None else MemStore()
+    eng = await MetricEngine.open(root, store,
+                                  enable_compaction=False, **engine_kw)
+    rules = await RuleEngine.open(eng, store, root=f"{root}/rules")
+    return store, eng, rules
+
+
+async def cold_eval(eng, expr: str, now: int, step: int = MIN) -> dict:
+    """(labels-key, step) -> value from a COLD evaluation of the body
+    over the rule's own grid — the oracle recording output must equal."""
+    from horaedb_tpu.promql.eval import evaluate_range
+
+    target = now // step * step
+    steps, series = await evaluate_range(eng, expr, FIRST, target, step)
+    out = {}
+    for sv in series:
+        key = tuple(sorted(
+            (k, v) for k, v in sv.labels.items() if k != "__name__"
+        ))
+        for t, v in zip(steps, sv.values):
+            if not np.isnan(v):
+                out[(key, int(t))] = float(v)
+    return out
+
+
+async def rule_output(eng, name: str) -> dict:
+    """(labels-key, ts) -> value as stored for the rule's output metric."""
+    t = await eng.query(QueryRequest(
+        metric=name.encode(), start_ms=0, end_ms=BASE + 10_000 * MIN,
+    ))
+    if t is None:
+        return {}
+    labels = await eng.match_series(name.encode(), [], [])
+    key_of = {
+        tsid: tuple(sorted(
+            (k.decode(), v.decode()) for k, v in labs.items()
+        ))
+        for tsid, labs in labels.items()
+    }
+    out = {}
+    for tsid, ts, v in zip(t.column("tsid").to_pylist(),
+                           t.column("ts").to_pylist(),
+                           t.column("value").to_pylist()):
+        out[(key_of[int(tsid)], ts)] = float(v)
+    return out
+
+
+async def assert_exact(eng, rules, name: str, expr: str, now: int):
+    got = await rule_output(eng, name)
+    cold = await cold_eval(eng, expr, now)
+    assert got == cold, (
+        f"rule output diverged from cold eval: only_rule="
+        f"{sorted(set(got) - set(cold))[:3]} only_cold="
+        f"{sorted(set(cold) - set(got))[:3]}"
+    )
+    return len(got)
+
+
+SUM_EXPR = "sum by (host) (sum_over_time(cpu[1m]))"
+
+
+class TestRuleModels:
+    def test_validation_rejects_garbage(self):
+        with pytest.raises(Exception):
+            RecordingRule(name="bad name!", expr="cpu",
+                          interval_ms=MIN).validate()
+        with pytest.raises(Exception):
+            RecordingRule(name="ok", expr="rate(cpu)",
+                          interval_ms=MIN).validate()  # bad body
+        with pytest.raises(Exception):
+            RecordingRule(name="ok", expr="cpu", interval_ms=0).validate()
+        with pytest.raises(Exception):
+            AlertRule(name="ok", expr="cpu", for_ms=-1).validate()
+        with pytest.raises(Exception):
+            RecordingRule(name="ok", expr="cpu", interval_ms=MIN,
+                          labels={"__name__": "x"}).validate()
+        with pytest.raises(HoraeError):
+            rule_from_dict({"kind": "nope", "name": "x", "expr": "cpu"},
+                           now_ms=BASE)
+        with pytest.raises(HoraeError):
+            rule_from_dict({"kind": "recording", "name": "x",
+                            "expr": "cpu", "for": "5m"}, now_ms=BASE)
+
+    def test_dict_and_json_roundtrip(self):
+        r = rule_from_dict({
+            "kind": "recording", "name": "cpu:sum", "expr": SUM_EXPR,
+            "interval": "1m", "labels": {"team": "infra"},
+            "since_ms": BASE,
+        }, now_ms=BASE)
+        assert r.interval_ms == MIN and r.labels == {"team": "infra"}
+        from horaedb_tpu.rules import rule_from_json
+
+        assert rule_from_json(r.to_json()) == r
+        a = rule_from_dict({
+            "kind": "alert", "name": "High", "expr": 'cpu{host="a"}',
+            "for": "2m", "annotations": {"summary": "cpu high"},
+        }, now_ms=BASE)
+        assert a.for_ms == 2 * MIN
+        assert rule_from_json(a.to_json()) == a
+        # identity ignores since_ms (config rules re-asserted at boot)
+        r2 = RecordingRule(name=r.name, expr=r.expr, interval_ms=MIN,
+                           labels=dict(r.labels), since_ms=BASE + 5)
+        assert r.identity() == r2.identity()
+
+    def test_input_metrics(self):
+        r = rule_from_dict({
+            "kind": "recording", "name": "x:y",
+            "expr": "sum_over_time(cpu[1m]) + max_over_time(mem[1m])",
+            "interval": "1m", "since_ms": BASE,
+        }, now_ms=BASE)
+        assert r.input_metrics == ("cpu", "mem")
+
+
+class TestRecordingRules:
+    @async_test
+    async def test_bit_exact_across_flush_backfill_delete(self):
+        store, eng, rules = await open_pair("recx")
+        await eng.write_payload(payload({
+            "a": [(BASE + i * MIN, float(i)) for i in range(10)],
+            "b": [(BASE + i * MIN, float(10 + i)) for i in range(10)],
+        }))
+        await rules.register(RecordingRule(
+            name="cpu:sum1m", expr=SUM_EXPR, interval_ms=MIN,
+            since_ms=BASE,
+        ).validate())
+        now = BASE + 10 * MIN
+        s = await rules.tick(now_ms=now)
+        assert s["evaluated"] == 1 and s["errors"] == 0
+        n = await assert_exact(eng, rules, "cpu:sum1m", SUM_EXPR, now)
+        assert n > 0
+
+        # fresh ingest -> dirty -> incremental recompute stays exact
+        now += 3 * MIN
+        await eng.write_payload(payload({
+            "a": [(BASE + (10 + i) * MIN, float(100 + i))
+                  for i in range(3)],
+        }))
+        s = await rules.tick(now_ms=now)
+        assert s["evaluated"] == 1
+        await assert_exact(eng, rules, "cpu:sum1m", SUM_EXPR, now)
+
+        # backfill into an already-materialized range
+        await eng.write_payload(payload({
+            "b": [(BASE + 2 * MIN + 7, 500.0)],
+        }))
+        now += MIN
+        s = await rules.tick(now_ms=now)
+        assert s["evaluated"] == 1
+        await assert_exact(eng, rules, "cpu:sum1m", SUM_EXPR, now)
+
+        # delete input data: affected output steps must DISAPPEAR (the
+        # clear path), not linger as stale overwritable values
+        await eng.delete_series(b"cpu", filters=[(b"host", b"a")],
+                                start_ms=BASE, end_ms=BASE + 4 * MIN)
+        now += MIN
+        s = await rules.tick(now_ms=now)
+        assert s["evaluated"] == 1 and s["deletes"] >= 1
+        await assert_exact(eng, rules, "cpu:sum1m", SUM_EXPR, now)
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_no_mutation_tick_evaluates_zero_rules(self):
+        """The dirty-set acceptance pin: once the trailing window drains,
+        a tick with no overlapping mutations evaluates NOTHING and the
+        skip counter says so."""
+        store, eng, rules = await open_pair("recquiet")
+        await eng.write_payload(payload({
+            "a": [(BASE + i * MIN, 1.0) for i in range(5)],
+        }))
+        for name in ("q:one", "q:two", "q:three"):
+            await rules.register(RecordingRule(
+                name=name, expr=SUM_EXPR, interval_ms=MIN, since_ms=BASE,
+            ).validate())
+        now = BASE + 6 * MIN
+        await rules.tick(now_ms=now)            # first materialization
+        await rules.tick(now_ms=now + 10 * MIN)  # trailing window drains
+        skips0 = RULE_DIRTY_SKIPS.labels("recording").value
+        s = await rules.tick(now_ms=now + 11 * MIN)
+        assert s["noop"] is True
+        assert s["evaluated"] == 0 and s["skipped"] == 3
+        assert RULE_DIRTY_SKIPS.labels("recording").value == skips0 + 3
+        # and the skipped output is still exact (nothing was missed)
+        await assert_exact(eng, rules, "q:one", SUM_EXPR, now + 11 * MIN)
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_self_invalidation_loop_guard(self):
+        """A rule's own write-back must not re-trigger its dirty set —
+        but a DOWNSTREAM rule reading the output must see it (chaining
+        is dirt; self-reference is a loop)."""
+        store, eng, rules = await open_pair("recloop")
+        await eng.write_payload(payload({
+            "a": [(BASE + i * MIN, 2.0) for i in range(5)],
+        }))
+        await rules.register(RecordingRule(
+            name="lvl1:sum", expr=SUM_EXPR, interval_ms=MIN,
+            since_ms=BASE,
+        ).validate())
+        await rules.register(RecordingRule(
+            name="lvl2:sum",
+            expr='sum by (host) (sum_over_time({__name__}[1m]))'.replace(
+                "{__name__}", "lvl1:sum"
+            ),
+            interval_ms=MIN, since_ms=BASE,
+        ).validate())
+        now = BASE + 6 * MIN
+        s1 = await rules.tick(now_ms=now)
+        assert s1["evaluated"] == 2
+        # lvl1's write-back marked lvl2 dirty (chaining), and lvl2's own
+        # write marked nobody: the next tick evaluates lvl2 only
+        s2 = await rules.tick(now_ms=now)
+        assert s2["evaluated"] == 1, s2
+        # chain settled: the third same-instant tick is a pure noop —
+        # the self-invalidation loop would instead evaluate forever
+        s3 = await rules.tick(now_ms=now)
+        assert s3["noop"] is True and s3["evaluated"] == 0, s3
+        # downstream output exact vs its own cold eval
+        await assert_exact(eng, rules, "lvl2:sum",
+                           "sum by (host) (sum_over_time(lvl1:sum[1m]))",
+                           now)
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_cardinality_degrade_counted_not_silent(self):
+        """Rule output counts against the table's series budget (PR 7):
+        at the limit the write-back partially degrades — counted and
+        logged — and the tick keeps going."""
+        store, eng, rules = await open_pair("reccard", max_series=3)
+        # 3 input series fill the budget exactly; the gate engages for
+        # the output series the rule wants to create
+        await eng.write_payload(payload({
+            f"h{i}": [(BASE + j * MIN, float(j)) for j in range(4)]
+            for i in range(3)
+        }))
+        await rules.register(RecordingRule(
+            name="card:sum", expr=SUM_EXPR, interval_ms=MIN,
+            since_ms=BASE,
+        ).validate())
+        deg0 = RULE_WRITE_DEGRADED.value
+        s = await rules.tick(now_ms=BASE + 5 * MIN)
+        assert s["errors"] == 0  # degrade, never a tick failure
+        assert RULE_WRITE_DEGRADED.value > deg0
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_crash_reopen_exact_and_quiet(self):
+        """Reopen over the surviving store: fingerprints match -> no
+        spurious work; data written WHILE DOWN (no evaluator process) is
+        re-derived from the fingerprint diff; output stays exact."""
+        store, eng, rules = await open_pair("recreopen")
+        await eng.write_payload(payload({
+            "a": [(BASE + i * MIN, float(i)) for i in range(6)],
+        }))
+        await rules.register(RecordingRule(
+            name="ro:sum", expr=SUM_EXPR, interval_ms=MIN, since_ms=BASE,
+        ).validate())
+        now = BASE + 7 * MIN
+        await rules.tick(now_ms=now)
+        await rules.tick(now_ms=now + 10 * MIN)  # drain + checkpoint
+        await rules.close()
+        await eng.close()
+
+        # clean reopen: fingerprints match, first tick is a noop
+        eng2 = await MetricEngine.open("recreopen", store,
+                                       enable_compaction=False)
+        rules2 = await RuleEngine.open(eng2, store, root="recreopen/rules")
+        s = await rules2.tick(now_ms=now + 11 * MIN)
+        assert s["noop"] is True, s
+        await assert_exact(eng2, rules2, "ro:sum", SUM_EXPR,
+                           now + 11 * MIN)
+        await rules2.close()
+        await eng2.close()
+
+        # write while NO evaluator is alive, then reopen: the fingerprint
+        # diff must seed the dirty set and the output re-converge
+        eng3 = await MetricEngine.open("recreopen", store,
+                                       enable_compaction=False)
+        await eng3.write_payload(payload({
+            "a": [(BASE + 2 * MIN + 13, 999.0)],  # backfill while down
+        }))
+        await eng3.close()
+        eng4 = await MetricEngine.open("recreopen", store,
+                                       enable_compaction=False)
+        rules4 = await RuleEngine.open(eng4, store, root="recreopen/rules")
+        s = await rules4.tick(now_ms=now + 12 * MIN)
+        assert s["evaluated"] == 1, s
+        await assert_exact(eng4, rules4, "ro:sum", SUM_EXPR,
+                           now + 12 * MIN)
+        await rules4.close()
+        await eng4.close()
+
+    @async_test
+    async def test_registration_durable_and_idempotent(self):
+        store, eng, rules = await open_pair("recreg")
+        r = rule_from_dict({
+            "kind": "recording", "name": "reg:sum", "expr": SUM_EXPR,
+            "interval": "1m", "since_ms": BASE,
+        }, now_ms=BASE)
+        assert await rules.ensure_registered(r) is True
+        # unchanged definition: no-op (watermark survives restarts)
+        r2 = rule_from_dict({
+            "kind": "recording", "name": "reg:sum", "expr": SUM_EXPR,
+            "interval": "1m",
+        }, now_ms=BASE + 999)
+        assert await rules.ensure_registered(r2) is False
+        await rules.close()
+        await eng.close()
+        eng2 = await MetricEngine.open("recreg", store,
+                                       enable_compaction=False)
+        rules2 = await RuleEngine.open(eng2, store, root="recreg/rules")
+        assert [x.name for x in rules2.list_rules()] == ["reg:sum"]
+        assert await rules2.delete("reg:sum") is True
+        assert await rules2.delete("reg:sum") is False
+        assert rules2.list_rules() == []
+        await rules2.close()
+        await eng2.close()
+
+
+class TestAlertRules:
+    @async_test
+    async def test_for_duration_state_machine(self):
+        store, eng, rules = await open_pair("alx")
+        await rules.register(AlertRule(
+            name="CpuHigh", expr='cpu{host="a"}', for_ms=2 * MIN,
+            labels={"severity": "page"},
+            annotations={"summary": "cpu is high"},
+        ).validate())
+        now = BASE + 10 * MIN
+        await eng.write_payload(payload({"a": [(now - MIN, 5.0)]}))
+        s = await rules.tick(now_ms=now)
+        assert s["transitions"] == 1
+        [al] = rules.alerts()
+        assert al["state"] == "pending"
+        assert al["labels"]["severity"] == "page"
+        assert al["annotations"]["summary"] == "cpu is high"
+        # before `for` elapses: still pending, no new transition
+        s = await rules.tick(now_ms=now + MIN)
+        assert s["transitions"] == 0
+        assert rules.alerts()[0]["state"] == "pending"
+        # `for` elapsed (sample still within the 5m lookback): firing
+        s = await rules.tick(now_ms=now + 2 * MIN)
+        assert s["transitions"] == 1
+        assert rules.alerts()[0]["state"] == "firing"
+        # data ages out of the lookback: resolved
+        s = await rules.tick(now_ms=now + 30 * MIN)
+        assert s["transitions"] == 1
+        assert rules.alerts() == []
+        log = rules.transitions("CpuHigh")
+        assert [(t["from"], t["to"]) for t in log] == [
+            ("inactive", "pending"), ("pending", "firing"),
+            ("firing", "inactive"),
+        ]
+        assert [t["seq"] for t in log] == [1, 2, 3]  # gapless, no dups
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_exactly_once_across_reopen(self):
+        """Transitions survive crash/reopen without duplication: the
+        durable log is the identity, and a reopened evaluator re-deriving
+        the same world makes no new transitions."""
+        store, eng, rules = await open_pair("alre")
+        await rules.register(AlertRule(
+            name="Fast", expr='cpu{host="a"}', for_ms=0,
+        ).validate())
+        now = BASE + 10 * MIN
+        await eng.write_payload(payload({"a": [(now - MIN, 1.0)]}))
+        s = await rules.tick(now_ms=now)
+        assert s["transitions"] == 1
+        assert rules.alerts()[0]["state"] == "firing"
+        await rules.close()
+        await eng.close()
+
+        eng2 = await MetricEngine.open("alre", store,
+                                       enable_compaction=False)
+        rules2 = await RuleEngine.open(eng2, store, root="alre/rules")
+        assert rules2.alerts()[0]["state"] == "firing"
+        log0 = rules2.transitions("Fast")
+        assert [t["seq"] for t in log0] == [1]
+        # same world, fresh process: NO duplicate firing
+        s = await rules2.tick(now_ms=now + MIN)
+        assert s["transitions"] == 0
+        assert [t["seq"] for t in rules2.transitions("Fast")] == [1]
+        # resolution is a NEW transition with the next sequence
+        s = await rules2.tick(now_ms=now + 30 * MIN)
+        assert s["transitions"] == 1
+        assert [t["seq"] for t in rules2.transitions("Fast")] == [1, 2]
+        await rules2.close()
+        await eng2.close()
+
+    @async_test
+    async def test_failed_checkpoint_defers_transition(self):
+        """The exactly-once commit point is the state PUT: when it fails,
+        the transition is NOT visible, and the next tick derives it
+        once."""
+
+        class FlakyStateStore(MemStore):
+            fail = False
+
+            async def put(self, path, data):
+                if self.fail and "/manifest/state/" in path:
+                    raise TimeoutError("injected state-put failure")
+                await super().put(path, data)
+
+        store = FlakyStateStore()
+        eng = await MetricEngine.open("alck", store,
+                                      enable_compaction=False)
+        rules = await RuleEngine.open(eng, store, root="alck/rules")
+        await rules.register(AlertRule(
+            name="Ck", expr='cpu{host="a"}', for_ms=0,
+        ).validate())
+        now = BASE + 10 * MIN
+        await eng.write_payload(payload({"a": [(now - MIN, 1.0)]}))
+        store.fail = True
+        s = await rules.tick(now_ms=now)
+        assert s["errors"] == 1 and s["transitions"] == 0
+        assert rules.alerts() == []  # nothing visible without the PUT
+        store.fail = False
+        s = await rules.tick(now_ms=now + 1)
+        assert s["transitions"] == 1
+        assert [t["seq"] for t in rules.transitions("Ck")] == [1]
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_inactive_quiet_alert_skips(self):
+        store, eng, rules = await open_pair("alskip")
+        await rules.register(AlertRule(
+            name="Quiet", expr='cpu{host="zzz"}', for_ms=0,
+        ).validate())
+        await eng.write_payload(payload({"a": [(BASE, 1.0)]}))
+        s = await rules.tick(now_ms=BASE + MIN)   # consumes the event
+        assert s["evaluated"] == 1
+        # INSIDE the presence frontier (data_hi + lookback) the quiet
+        # rule must keep evaluating: a sample's influence window has not
+        # closed yet
+        s = await rules.tick(now_ms=BASE + 2 * MIN)
+        assert s["evaluated"] == 1
+        skips0 = RULE_DIRTY_SKIPS.labels("alert").value
+        # beyond the frontier: the settled-inactive quiet rule skips
+        s = await rules.tick(now_ms=BASE + 10 * MIN)
+        assert s["noop"] is True and s["skipped"] == 1
+        assert RULE_DIRTY_SKIPS.labels("alert").value == skips0 + 1
+        await rules.close()
+        await eng.close()
+
+
+class TestReviewRegressions:
+    def test_offset_smear_adds_not_maxes(self):
+        """Review regression: `offset` shifts the data window back, so a
+        sample at x feeds steps in (x+offset, x+offset+window] — the
+        smear is window PLUS offset. The old max() undersmeared exactly
+        when range > LOOKBACK, leaving backfill steps unrecomputed."""
+        from horaedb_tpu.promql import parse
+        from horaedb_tpu.promql.eval import max_selector_window_ms
+
+        assert max_selector_window_ms(parse("m")) == 300_000
+        assert max_selector_window_ms(parse("m offset 2m")) == 420_000
+        assert max_selector_window_ms(
+            parse("sum_over_time(m[6m] offset 2m)")
+        ) == 480_000
+        assert max_selector_window_ms(
+            parse("sum_over_time(m[10m] offset 10m)")
+        ) == 1_200_000
+
+    @async_test
+    async def test_offset_rule_bit_exact_after_backfill(self):
+        expr = "sum by (host) (sum_over_time(cpu[6m] offset 2m))"
+        store, eng, rules = await open_pair("recoff")
+        await eng.write_payload(payload({
+            "a": [(BASE + i * MIN, float(i)) for i in range(10)],
+        }))
+        await rules.register(RecordingRule(
+            name="off:sum", expr=expr, interval_ms=MIN, since_ms=BASE,
+        ).validate())
+        now = BASE + 14 * MIN
+        await rules.tick(now_ms=now)
+        await assert_exact(eng, rules, "off:sum", expr, now)
+        # backfill: the influenced steps sit offset+window PAST the
+        # sample — the undersmear bug left the tail stale
+        await eng.write_payload(payload({"a": [(BASE + 4 * MIN + 5,
+                                               777.0)]}))
+        now += MIN
+        s = await rules.tick(now_ms=now)
+        assert s["evaluated"] == 1
+        await assert_exact(eng, rules, "off:sum", expr, now)
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_replacing_alert_rule_resets_durable_state(self):
+        """Review regression: replacing an alert rule must durably reset
+        its state record — a crash after the replacement must not boot
+        the NEW definition already firing with the OLD rule's log."""
+        store, eng, rules = await open_pair("alrepl")
+        await rules.register(AlertRule(
+            name="R", expr='cpu{host="a"}', for_ms=0,
+        ).validate())
+        now = BASE + 10 * MIN
+        await eng.write_payload(payload({"a": [(now - MIN, 1.0)]}))
+        await rules.tick(now_ms=now)
+        assert rules.alerts()[0]["state"] == "firing"
+        # replace with a different condition, then "crash" (no tick)
+        await rules.register(AlertRule(
+            name="R", expr='cpu{host="nope"}', for_ms=0,
+        ).validate())
+        await rules.close()
+        await eng.close()
+        eng2 = await MetricEngine.open("alrepl", store,
+                                       enable_compaction=False)
+        rules2 = await RuleEngine.open(eng2, store, root="alrepl/rules")
+        assert rules2.alerts() == []          # old firing NOT resurrected
+        assert rules2.transitions("R") == []  # old log NOT attributed
+        s = await rules2.tick(now_ms=now + MIN)
+        assert s["transitions"] == 0          # new condition never true
+        await rules2.close()
+        await eng2.close()
+
+    @async_test
+    async def test_fresh_alert_over_preexisting_data_evaluates(self):
+        """Review regression: an alert registered AFTER its condition
+        became true must evaluate on the next tick even though no
+        mutation event arrives — registration forces one evaluation."""
+        store, eng, rules = await open_pair("alfresh")
+        now = BASE + 10 * MIN
+        await eng.write_payload(payload({"a": [(now - MIN, 1.0)]}))
+        s = await rules.tick(now_ms=now)      # consumes the flush events
+        assert s["noop"] is True              # (no rules registered yet)
+        await rules.register(AlertRule(
+            name="Late", expr='cpu{host="a"}', for_ms=0,
+        ).validate())
+        s = await rules.tick(now_ms=now + 1)  # zero events since register
+        assert s["evaluated"] == 1 and s["transitions"] == 1
+        assert rules.alerts()[0]["state"] == "firing"
+        # and the forced evaluation is one-shot: quiet inactive rules
+        # still skip after their first pass
+        await rules.close()
+        await eng.close()
+
+
+class TestReviewRegressions2:
+    @async_test
+    async def test_offset_alert_fires_when_presence_window_arrives(self):
+        """Review regression: `offset` shifts presence FORWARD — a sample
+        at T makes `m offset 10m` true only at ticks in (T+10m, ...]. The
+        old skip condition froze the alert inactive forever once the
+        write's event was consumed; the presence frontier keeps it
+        evaluating until every known sample's window has closed."""
+        store, eng, rules = await open_pair("aloff")
+        await rules.register(AlertRule(
+            name="Off", expr='cpu{host="a"} offset 10m', for_ms=0,
+        ).validate())
+        now = BASE + 10 * MIN
+        await eng.write_payload(payload({"a": [(now, 1.0)]}))
+        s = await rules.tick(now_ms=now + MIN)   # consumes the event;
+        assert s["transitions"] == 0             # window not open yet
+        s = await rules.tick(now_ms=now + 5 * MIN)  # still shifted out
+        assert s["transitions"] == 0
+        # presence window open: (sample+10m, sample+10m+lookback]
+        s = await rules.tick(now_ms=now + 11 * MIN)
+        assert s["evaluated"] == 1 and s["transitions"] == 1, s
+        assert rules.alerts()[0]["state"] == "firing"
+        # ...and closes: resolved, then the rule settles and skips
+        s = await rules.tick(now_ms=now + 30 * MIN)
+        assert s["transitions"] == 1
+        s = await rules.tick(now_ms=now + 31 * MIN)
+        assert s["noop"] is True
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_future_since_rule_consumes_events(self):
+        """Review regression: a recording rule whose grid has not started
+        (future since_ms) must still CONSUME funnel events — the old
+        early-return pinned the event list forever and starved the epoch
+        checkpoint for every rule."""
+        store, eng, rules = await open_pair("recfuture")
+        await rules.register(RecordingRule(
+            name="fut:sum", expr=SUM_EXPR, interval_ms=MIN,
+            since_ms=BASE + 10_000 * MIN,  # far future
+        ).validate())
+        for i in range(4):
+            await eng.write_payload(payload({"a": [(BASE + i * MIN,
+                                                    1.0)]}))
+            s = await rules.tick(now_ms=BASE + (i + 1) * MIN)
+            assert s["evaluated"] == 0 and s["errors"] == 0
+        # events consumed: the list compacts to empty and the epoch
+        # checkpoint is writable (nothing pending-relevant)
+        assert rules._events == []
+        assert rules._pending_relevant() is False
+        assert rules._last_epoch is not None  # checkpoint actually wrote
+        await rules.close()
+        await eng.close()
+
+
+class TestReviewRegressions3:
+    @async_test
+    async def test_replacing_recording_rule_clears_old_output(self):
+        """Review regression: the OLD body's materialized series must not
+        survive a replacement — stored output must equal cold evaluation
+        of the NEW body, with no stale series attributed to it."""
+        store, eng, rules = await open_pair("recswap")
+        await eng.write_payload(payload({
+            "a": [(BASE + i * MIN, 1.0) for i in range(5)],
+            "b": [(BASE + i * MIN, 2.0) for i in range(5)],
+        }))
+        old = 'sum by (host) (sum_over_time(cpu{host="a"}[1m]))'
+        new = 'sum by (host) (sum_over_time(cpu{host="b"}[1m]))'
+        await rules.register(RecordingRule(
+            name="swap:sum", expr=old, interval_ms=MIN, since_ms=BASE,
+        ).validate())
+        now = BASE + 6 * MIN
+        await rules.tick(now_ms=now)
+        assert any(k[0] == (("host", "a"),)
+                   for k in await rule_output(eng, "swap:sum"))
+        await rules.register(RecordingRule(
+            name="swap:sum", expr=new, interval_ms=MIN, since_ms=BASE,
+        ).validate())
+        await rules.tick(now_ms=now + MIN)
+        await assert_exact(eng, rules, "swap:sum", new, now + MIN)
+        got = await rule_output(eng, "swap:sum")
+        assert got and all(k[0] == (("host", "b"),) for k in got), got
+        await rules.close()
+        await eng.close()
+
+    @async_test
+    async def test_repost_identical_rule_keeps_alert_state(self):
+        """Review regression: re-asserting an UNCHANGED definition (the
+        HTTP handler now rides ensure_registered) must not wipe the
+        state machine or truncate the exactly-once transition log."""
+        store, eng, rules = await open_pair("alrepost")
+        rule = AlertRule(name="Keep", expr='cpu{host="a"}',
+                         for_ms=0).validate()
+        await rules.register(rule)
+        now = BASE + 10 * MIN
+        await eng.write_payload(payload({"a": [(now - MIN, 1.0)]}))
+        await rules.tick(now_ms=now)
+        assert rules.alerts()[0]["state"] == "firing"
+        assert await rules.ensure_registered(AlertRule(
+            name="Keep", expr='cpu{host="a"}', for_ms=0,
+        ).validate()) is False
+        assert rules.alerts()[0]["state"] == "firing"   # state kept
+        assert [t["seq"] for t in rules.transitions("Keep")] == [1]
+        await rules.close()
+        await eng.close()
+
+    def test_alertname_label_rejected_and_identity_wins(self):
+        with pytest.raises(Exception):
+            AlertRule(name="X", expr="cpu",
+                      labels={"alertname": "Other"}).validate()
+
+    @async_test
+    async def test_series_alertname_label_cannot_hijack_identity(self):
+        """A data series carrying its own `alertname` label must not
+        rename the alert in the /api/v1/alerts surface."""
+        store, eng, rules = await open_pair("alhijack")
+        req = remote_write_pb2.WriteRequest()
+        ts = req.timeseries.add()
+        now = BASE + 10 * MIN
+        for k, v in ((b"__name__", b"cpu"), (b"alertname", b"Spoof")):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = v
+        s = ts.samples.add()
+        s.timestamp = now - MIN
+        s.value = 1.0
+        await eng.write_payload(req.SerializeToString())
+        await rules.register(AlertRule(name="Real", expr="cpu",
+                                       for_ms=0).validate())
+        await rules.tick(now_ms=now)
+        [al] = rules.alerts()
+        assert al["labels"]["alertname"] == "Real"
+        await rules.close()
+        await eng.close()
+
+
+class TestRulesConfig:
+    def test_toml_rule_arrays_get_their_kind(self):
+        """Regression (found driving the real server): the generic config
+        loader recurses into nested dataclasses itself, so the kind
+        tagging of [[metric_engine.rules.recording]]/[[...alerting]]
+        must live in _from_dict — rules declared in TOML were reaching
+        rule_from_dict kindless and failing the boot."""
+        from horaedb_tpu.rules import rule_from_dict
+        from horaedb_tpu.server.config import Config
+
+        cfg = Config.from_toml(
+            '[metric_engine.rules]\n'
+            'eval_interval = "5s"\n'
+            '[[metric_engine.rules.recording]]\n'
+            'name = "t:sum"\n'
+            'expr = "sum by (host) (sum_over_time(cpu[1m]))"\n'
+            'interval = "1m"\n'
+            '[[metric_engine.rules.alerting]]\n'
+            'name = "THigh"\n'
+            'expr = \'cpu{host="a"}\'\n'
+            'for = "2m"\n'
+            'labels = { severity = "page" }\n'
+        )
+        cfg.validate()
+        rcfg = cfg.metric_engine.rules
+        assert rcfg.eval_interval.seconds == 5.0
+        rec = rule_from_dict(rcfg.recording[0], now_ms=BASE)
+        assert rec.kind == "recording" and rec.interval_ms == MIN
+        al = rule_from_dict(rcfg.alerting[0], now_ms=BASE)
+        assert al.kind == "alert" and al.for_ms == 2 * MIN
+        assert al.labels == {"severity": "page"}
+
+    def test_validate_rejects_garbage(self):
+        from horaedb_tpu.server.config import Config
+
+        with pytest.raises(Exception):
+            Config.from_dict({"metric_engine": {"rules": {
+                "eval_interval": "0s",
+            }}}).validate()
+        with pytest.raises(Exception):
+            Config.from_dict({"metric_engine": {"rules": {
+                "tenant_weight": 0,
+            }}}).validate()
+        with pytest.raises(Exception):
+            Config.from_dict({"metric_engine": {"rules": {
+                "nope": 1,
+            }}})
+
+
+class TestSubscriptionHook:
+    def test_error_isolation_and_unsubscribe(self):
+        """A broken subscriber must never fail the commit that fired the
+        event, and unsubscribing stops delivery."""
+        from horaedb_tpu.serving.cache import ResultCache
+        from horaedb_tpu.storage.types import TimeRange
+
+        c = ResultCache(1 << 20)
+        seen = []
+
+        def bad(root, reason, rng):
+            raise RuntimeError("broken subscriber")
+
+        def good(root, reason, rng):
+            seen.append((root, reason, rng))
+
+        t_bad = c.serving_subscribe(bad)
+        t_good = c.serving_subscribe(good)
+        rng = TimeRange(10, 20)
+        # the raising subscriber is isolated; the good one still fires
+        dropped = c.serving_invalidate("t1", "flush", rng)
+        assert dropped == 0
+        assert seen == [("t1", "flush", rng)]
+        c.serving_unsubscribe(t_good)
+        c.serving_invalidate("t1", "delete")
+        assert len(seen) == 1
+        c.serving_unsubscribe(t_bad)
+        c.serving_unsubscribe(t_bad)  # idempotent

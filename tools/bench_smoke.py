@@ -191,6 +191,33 @@ def main() -> int:
               and warm_p50 < qs.get("cold_p50_ms", 0),
               f"query_serving: warm p50 not faster than cold "
               f"(warm={warm_p50}, cold={qs.get('cold_p50_ms')})")
+        # rule-storm lane (horaedb_tpu/rules): the dirty-set proof — a
+        # no-mutation tick evaluates ZERO rules and beats the full
+        # materialization tick by an order of magnitude; alert rules
+        # sharing a selector ride the result cache
+        rs = result.get("rule_storm") or {}
+        check(rs.get("rules", 0) > 0, "rule_storm lane missing")
+        check(rs.get("materialize_rules_per_sec", 0) > 0,
+              f"rule_storm: non-positive materialize rate: {rs}")
+        check(rs.get("quiet_evaluated", -1) == 0,
+              f"rule_storm: quiet tick evaluated "
+              f"{rs.get('quiet_evaluated')} rules (want 0)")
+        check(rs.get("quiet_skipped", 0)
+              == rs.get("rules", 0) + rs.get("alert_rules", 0),
+              f"rule_storm: quiet tick skipped {rs.get('quiet_skipped')} "
+              f"of {rs.get('rules', 0) + rs.get('alert_rules', 0)}")
+        check(rs.get("quiet_speedup_vs_materialize", 0) > 10,
+              f"rule_storm: quiet tick not >10x cheaper than "
+              f"materialize: {rs.get('quiet_speedup_vs_materialize')}")
+        check(rs.get("incremental_tick_p99_ms", 0) > 0,
+              "rule_storm: incremental tick p99 missing")
+        check(rs.get("eval_lag_after_tick_s", 1) == 0,
+              f"rule_storm: evaluator lagging after tick: "
+              f"{rs.get('eval_lag_after_tick_s')}")
+        hr = rs.get("alert_cache_hit_rate")
+        check(hr is not None and hr > 0.5,
+              f"rule_storm: alert rules not riding the result cache "
+              f"(hit rate {hr})")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")

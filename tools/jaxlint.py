@@ -102,6 +102,15 @@ Rules (see docs/static-analysis.md for rationale and examples):
         elsewhere lets cache state change without the commit that
         justifies it. Harness/test introspection suppresses with the
         reason
+  J014  invalidation-funnel subscription outside the audited consumer
+        set: `serving_subscribe`/`serving_unsubscribe`
+        (serving/cache.py) register synchronous callbacks inside every
+        mutation commit; the only sanctioned consumers are the cache
+        itself (serving/) and the rule evaluator (horaedb_tpu/rules,
+        whose dirty-set exactness is chaos-tested). A third subscriber
+        is a second standing-query engine growing outside the audited
+        one — consume the rule engine's dirty sets instead, or suppress
+        with the reason
   J009  naked object-store construction outside objstore/: a concrete
         store (`MemStore`/`LocalStore`/`S3LikeStore`) built in engine
         code without being handed straight to a `ResilientStore(...)`
@@ -274,6 +283,19 @@ SERVING_WRITE_FUNCS = {
     "serving_put", "serving_invalidate", "note_fetch", "evict_sst",
     "evict_rollup",
 }
+
+# J014: the invalidation funnel's CONSUMER set. serving_subscribe /
+# serving_unsubscribe (serving/cache.py) hand out a synchronous callback
+# inside every mutation commit; the audited consumers are the cache
+# itself (serving/) and the rule evaluator (rules/ — the streaming rule
+# engine's dirty sets). Anything else subscribing is a second standing-
+# query engine growing outside the one whose exactness is tested.
+J014_MODULES = ("horaedb_tpu/",)
+J014_EXEMPT = (
+    "horaedb_tpu/serving/",
+    "horaedb_tpu/rules/",
+)
+FUNNEL_SUBSCRIBE_FUNCS = {"serving_subscribe", "serving_unsubscribe"}
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
 STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
@@ -925,6 +947,28 @@ def _check_serving_funnel(
             ))
 
 
+def _check_funnel_subscribers(tree: ast.Module,
+                              findings: list[Finding]) -> None:
+    """J014: the invalidation funnel's consumer set is pinned — only the
+    cache (serving/) and the rule evaluator (rules/) may subscribe. A
+    third subscriber is a standing-query engine growing outside the one
+    whose dirty-set exactness is chaos-tested."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        tail = fd.rsplit(".", 1)[-1] if fd else None
+        if tail in FUNNEL_SUBSCRIBE_FUNCS:
+            findings.append(Finding(
+                node.lineno, "J014",
+                f"invalidation-funnel subscription `{tail}(...)` outside "
+                "the audited consumer set (serving/cache.py internals and "
+                "the rule evaluator, horaedb_tpu/rules) — mutation-commit "
+                "callbacks are a standing-query surface; consume the rule "
+                "engine's dirty sets instead, or suppress with the reason",
+            ))
+
+
 def _check_visibility_boundary(tree: ast.Module, findings: list[Finding]) -> None:
     """J010: attribute access on the visibility state's row-filtering
     fields (`.tombstones`, `.retention_floor_ms`) outside the shared
@@ -1161,6 +1205,13 @@ def lint_file(path: Path) -> list[str]:
         (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
         for m in J013_WRITE_EXEMPT
     )
+    in_j014_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J014_MODULES
+    ) and not any(
+        (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
+        for m in J014_EXEMPT
+    )
 
     idx = JitIndex()
     idx.visit(tree)
@@ -1190,6 +1241,8 @@ def lint_file(path: Path) -> list[str]:
         _check_decode_funnel(tree, findings)
     if j013_reads or j013_writes:
         _check_serving_funnel(tree, findings, j013_reads, j013_writes)
+    if in_j014_scope:
+        _check_funnel_subscribers(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

@@ -126,6 +126,23 @@ REQUIRED_FAMILIES = (
     "horaedb_serving_resident_bytes",
     "horaedb_serving_resident_blocks",
     "horaedb_serving_residency_total",
+    # streaming rule engine (horaedb_tpu/rules): families render from
+    # boot (zero states pre-registered); the rule flow below moves the
+    # eval/tick/transition counters
+    "horaedb_rules_registered",
+    'horaedb_rules_registered{kind="recording"',
+    'horaedb_rules_registered{kind="alert"',
+    "horaedb_rules_eval_seconds_bucket",
+    "horaedb_rules_evals_total",
+    'horaedb_rules_evals_total{kind="recording",result="ok"',
+    "horaedb_rules_dirty_skips_total",
+    "horaedb_rules_ticks_total",
+    "horaedb_rules_eval_lag_seconds",
+    "horaedb_rules_samples_written_total",
+    "horaedb_rules_write_degraded_total",
+    "horaedb_rules_alert_transitions_total",
+    'horaedb_rules_alert_transitions_total{transition="firing"',
+    "horaedb_rules_alerts_active",
 )
 
 
@@ -316,6 +333,78 @@ async def run() -> int:
                 check(srv.get("cache") == "miss",
                       f"post-write re-query is a miss again (invalidation "
                       f"funnel fired): {srv}")
+            # ---- streaming rule engine: register a recording rule + an
+            # alert rule over HTTP, drive a threshold-crossing write,
+            # force a tick, and assert the rule series is queryable, the
+            # alert reached firing, and the families moved
+            from horaedb_tpu.common.time_ext import now_ms as _now_ms
+
+            now = _now_ms()
+            r_reg = {
+                "kind": "recording", "name": "smoke:sig:sum",
+                "expr": "sum by (host) (sum_over_time(smoke_sig[1m]))",
+                "interval": "1m", "since_ms": now - 600_000,
+            }
+            async with s.post(f"{base}/api/v1/rules", json=r_reg) as r:
+                check(r.status == 200, f"recording rule registered "
+                                       f"({r.status})")
+            a_reg = {
+                "kind": "alert", "name": "SmokeSignal",
+                "expr": 'smoke_sig{host="sig"}', "for": 0,
+                "labels": {"severity": "smoke"},
+            }
+            async with s.post(f"{base}/api/v1/rules", json=a_reg) as r:
+                check(r.status == 200, f"alert rule registered ({r.status})")
+            # the threshold-crossing write: recent samples so the alert's
+            # instant evaluation (5m lookback) sees them
+            from horaedb_tpu.pb import remote_write_pb2
+
+            sig = remote_write_pb2.WriteRequest()
+            tser = sig.timeseries.add()
+            for k, v in ((b"__name__", b"smoke_sig"), (b"host", b"sig")):
+                lab = tser.labels.add()
+                lab.name = k
+                lab.value = v
+            for i in range(5):
+                smp = tser.samples.add()
+                smp.timestamp = now - (5 - i) * 60_000
+                smp.value = float(10 + i)
+            async with s.post(f"{base}/api/v1/write",
+                              data=sig.SerializeToString()) as r:
+                check(r.status == 200, "rule-signal write accepted")
+            async with s.post(f"{base}/api/v1/rules/tick") as r:
+                tick = (await r.json()).get("data") or {}
+                check(r.status == 200 and tick.get("errors") == 0
+                      and tick.get("evaluated", 0) >= 2,
+                      f"forced rule tick evaluated both rules: {tick}")
+                check(tick.get("samples_written", 0) > 0,
+                      f"recording rule wrote output samples: {tick}")
+            async with s.post(f"{base}/api/v1/query?explain=1", json={
+                "metric": "smoke:sig:sum", "start_ms": now - 900_000,
+                "end_ms": now + 60_000,
+            }) as r:
+                body = await r.json()
+                check(r.status == 200 and body.get("rows", 0) > 0,
+                      f"rule-produced series is queryable: "
+                      f"rows={body.get('rows')}")
+                rp = ((body.get("explain") or {}).get("rules")
+                      or {}).get("rule_produced") or {}
+                check("smoke:sig:sum" in rp,
+                      f"EXPLAIN carries rule provenance: {rp}")
+            async with s.get(f"{base}/api/v1/alerts") as r:
+                alerts = ((await r.json()).get("data") or {}).get(
+                    "alerts") or []
+                firing = [a for a in alerts
+                          if a["labels"].get("alertname") == "SmokeSignal"]
+                check(bool(firing) and firing[0]["state"] == "firing",
+                      f"alert reached firing: {alerts}")
+            async with s.get(f"{base}/api/v1/rules") as r:
+                body = await r.json()
+                groups = (body.get("data") or {}).get("groups") or []
+                check(r.status == 200 and {g["name"] for g in groups}
+                      == {"recording", "alerting"},
+                      f"/api/v1/rules lists both groups "
+                      f"({[g.get('name') for g in groups]})")
             async with s.get(f"{base}/debug/kernels") as r:
                 cat = await r.json()
                 check(
