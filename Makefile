@@ -38,8 +38,10 @@ lint:
 # object-store construction outside the ResilientStore boundary (J009),
 # ad-hoc tombstone/retention filtering off the shared visibility helper
 # (J010), server query entries bypassing admission (J011), ad-hoc decode
-# of encoded SST lanes outside the sanctioned funnel (J012). Findings
-# print as path:line: CODE message.
+# of encoded SST lanes outside the sanctioned funnel (J012), serving-tier
+# funnel breaches (J013), unaudited invalidation-funnel subscribers
+# (J014), per-tenant accounting outside the metering funnel (J015).
+# Findings print as path:line: CODE message.
 # Rules + suppression syntax: docs/static-analysis.md
 jaxlint:
 	python tools/jaxlint.py

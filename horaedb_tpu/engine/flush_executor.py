@@ -66,6 +66,9 @@ FLUSH_STAGE_SECONDS = GLOBAL_METRICS.histogram(
     help="Per-stage flush cost: drain (memtable -> pk-sorted column "
          "lanes), encode (parquet), upload (object-store PUT).",
     labelnames=("table", "stage"),
+    # OpenMetrics exemplars: a slow flush stage names the trace that
+    # paid it (telemetry package wires the source)
+    exemplars=True,
 )
 FLUSH_FAILURES_TOTAL = GLOBAL_METRICS.counter(
     "horaedb_flush_failures_total",

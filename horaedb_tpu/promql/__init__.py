@@ -10,7 +10,10 @@ pushdown (the TPU path) and counter functions riding the raw scan.
 
 Supported grammar (see promql/eval.py for semantics and divergences):
 
-    expr      := term (("+"|"-") term)*
+    expr      := and_expr ("or" and_expr)*
+    and_expr  := cmp (("and"|"unless") cmp)*
+    cmp       := arith ((">"|">="|"<"|"<="|"=="|"!=") arith)*
+    arith     := term (("+"|"-") term)*
     term      := unary (("*"|"/") unary)*
     unary     := "-"? primary
     primary   := NUMBER
@@ -35,8 +38,15 @@ A NAME from any function set followed by anything but "(" parses as a
 metric selector (a metric named `rate` stays queryable).
 DURATION: integer + unit in {ms, s, m, h, d, w}
 
-Binary arithmetic requires at least one scalar operand (vector-vector
-matching is out of the subset and rejected loudly).
+Binary arithmetic: scalar-vector elementwise, or vector-vector with
+EXACT label-set matching (ignoring __name__; one-to-one only — group_left
+/group_right many-to-one matching is out of the subset and rejected
+loudly). Comparisons are Prometheus filter semantics (failing steps drop;
+the `bool` modifier is out of the subset), and the set operators
+and/or/unless match per step on the __name__-stripped label set — the
+shapes SLO burn-rate rules need (`err/total` ratios, `short > x and
+long > x`). `and`/`or`/`unless` are reserved words in operator position
+only; a metric so named stays queryable standalone.
 """
 
 from __future__ import annotations
@@ -139,6 +149,30 @@ class BinOp:
     right: object
 
 
+@dataclass(frozen=True)
+class Cmp:
+    """Filter comparison (Prometheus semantics: steps failing the
+    predicate drop out; the value kept is the LEFT operand's)."""
+
+    op: str  # > >= < <= == !=
+    left: object
+    right: object
+
+
+@dataclass(frozen=True)
+class SetOp:
+    """Vector set operator matching per step on the __name__-stripped
+    label set: and (intersect), or (union, left wins), unless (minus)."""
+
+    op: str  # and | or | unless
+    left: object
+    right: object
+
+
+CMP_OPS = frozenset({">", ">=", "<", "<=", "==", "!="})
+SET_OPS = frozenset({"and", "or", "unless"})
+
+
 # -- tokenizer --------------------------------------------------------------
 
 _TOKEN_RE = re.compile(
@@ -147,7 +181,7 @@ _TOKEN_RE = re.compile(
   | (?P<NUMBER>\d+\.\d*|\.\d+|\d+)
   | (?P<NAME>[a-zA-Z_:][a-zA-Z0-9_:]*)
   | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
-  | (?P<OP>=~|!~|!=|=|\+|-|\*|/|\(|\)|\{|\}|\[|\]|,)
+  | (?P<OP>=~|!~|!=|==|>=|<=|=|>|<|\+|-|\*|/|\(|\)|\{|\}|\[|\]|,)
     """,
     re.VERBOSE,
 )
@@ -234,8 +268,31 @@ class _Parser:
             raise PromQLError(f"expected {text!r} at {t.pos}, got {t.text!r}")
         return t
 
-    # expr := term (("+"|"-") term)*
+    # expr := and_expr ("or" and_expr)*  — Prometheus precedence: `or`
+    # binds loosest, then and/unless, then comparisons, then +-, then */
     def expr(self):
+        node = self.and_expr()
+        while self.peek().kind == "NAME" and self.peek().text == "or":
+            self.next()
+            node = SetOp("or", node, self.and_expr())
+        return node
+
+    def and_expr(self):
+        node = self.cmp()
+        while (self.peek().kind == "NAME"
+               and self.peek().text in ("and", "unless")):
+            op = self.next().text
+            node = SetOp(op, node, self.cmp())
+        return node
+
+    def cmp(self):
+        node = self.arith()
+        while self.peek().kind == "OP" and self.peek().text in CMP_OPS:
+            op = self.next().text
+            node = Cmp(op, node, self.arith())
+        return node
+
+    def arith(self):
         node = self.term()
         while self.peek().text in ("+", "-"):
             op = self.next().text

@@ -46,6 +46,7 @@ from horaedb_tpu.storage import scanstats
 from horaedb_tpu.promql import (
     Agg,
     BinOp,
+    Cmp,
     Func,
     HistogramQuantile,
     LabelReplace,
@@ -53,6 +54,7 @@ from horaedb_tpu.promql import (
     PromQLError,
     Scalar,
     Selector,
+    SetOp,
     TopK,
     _MATCH_OPS,
 )
@@ -131,6 +133,10 @@ class RangeEvaluator:
             return node.value
         if isinstance(node, BinOp):
             return await self._binop(node)
+        if isinstance(node, Cmp):
+            return await self._cmp(node)
+        if isinstance(node, SetOp):
+            return await self._setop(node)
         if isinstance(node, Selector):
             if node.range_ms is not None:
                 raise PromQLError(
@@ -582,10 +588,98 @@ class RangeEvaluator:
                 SeriesVector(sv.labels, _apply(node.op, sv.values, right))
                 for sv in left
             ]
-        raise PromQLError(
-            "vector-vector arithmetic is outside the subset; one operand "
-            "must be a scalar"
-        )
+        # vector-vector: exact one-to-one label-set matching (__name__
+        # ignored, dropped from the result — Prometheus arithmetic strips
+        # the metric name). Unmatched series drop; a duplicate label set
+        # on either side would be many-to-one matching, which is outside
+        # the subset and rejected loudly.
+        rmap = _keyed(right, "right operand")
+        out = []
+        for key, lsv in _keyed(left, "left operand").items():
+            rsv = rmap.get(key)
+            if rsv is None:
+                continue
+            out.append(SeriesVector(
+                dict(key), _apply(node.op, lsv.values, rsv.values)
+            ))
+        return out
+
+    async def _cmp(self, node: "Cmp"):
+        """Filter comparison: steps where the predicate fails become NaN
+        (absent); the surviving value is the LEFT operand's, labels kept
+        verbatim (Prometheus keeps __name__ through filter comparisons).
+        Series with no surviving step drop entirely."""
+        left = await self.eval(node.left)
+        right = await self.eval(node.right)
+        if isinstance(left, float) and isinstance(right, float):
+            raise PromQLError(
+                "scalar-scalar comparison needs the bool modifier, which "
+                "is outside the subset; compare a vector against a scalar"
+            )
+        if isinstance(left, float):
+            # scalar OP vector keeps the VECTOR's entries (Prometheus:
+            # the vector side survives filtering); mirror the predicate
+            out = []
+            for sv in right:
+                keep = _cmp_mask(node.op, np.full_like(sv.values, left),
+                                 sv.values)
+                vals = np.where(keep, sv.values, np.nan)
+                if not np.isnan(vals).all():
+                    out.append(SeriesVector(sv.labels, vals))
+            return out
+        if isinstance(right, float):
+            pairs = [(sv, np.full_like(sv.values, right)) for sv in left]
+        else:
+            rmap = _keyed(right, "right operand")
+            pairs = [
+                (lsv, rmap[key].values)
+                for key, lsv in _keyed(left, "left operand").items()
+                if key in rmap
+            ]
+        out = []
+        for lsv, rvals in pairs:
+            keep = _cmp_mask(node.op, lsv.values, rvals)
+            vals = np.where(keep, lsv.values, np.nan)
+            if not np.isnan(vals).all():
+                out.append(SeriesVector(lsv.labels, vals))
+        return out
+
+    async def _setop(self, node: "SetOp"):
+        """and/or/unless per step on the __name__-stripped label set:
+        `and` keeps left steps where the right series has a value,
+        `unless` keeps left steps where it does NOT, `or` is the union
+        with left winning matched steps. Left labels survive verbatim."""
+        left = await self.eval(node.left)
+        right = await self.eval(node.right)
+        if isinstance(left, float) or isinstance(right, float):
+            raise PromQLError(
+                f"`{node.op}` needs vector operands on both sides"
+            )
+        rmap = _keyed(right, "right operand")
+        lmap = _keyed(left, "left operand")
+        out = []
+        for key, lsv in lmap.items():
+            rsv = rmap.get(key)
+            if node.op == "and":
+                if rsv is None:
+                    continue
+                vals = np.where(np.isnan(rsv.values), np.nan, lsv.values)
+                if np.isnan(vals).all():
+                    continue
+            elif node.op == "unless":
+                vals = (lsv.values if rsv is None
+                        else np.where(np.isnan(rsv.values), lsv.values,
+                                      np.nan))
+                if np.isnan(vals).all():
+                    continue
+            else:  # or: left value wins; right fills left's gaps
+                vals = (lsv.values if rsv is None
+                        else np.where(np.isnan(lsv.values), rsv.values,
+                                      lsv.values))
+            out.append(SeriesVector(lsv.labels, vals))
+        if node.op == "or":
+            out.extend(rsv for key, rsv in rmap.items() if key not in lmap)
+        return out
 
 
 def _apply(op: str, a, b):
@@ -597,6 +691,41 @@ def _apply(op: str, a, b):
         if op == "*":
             return a * b
         return a / b
+
+
+def _keyed(vec, side: str) -> dict:
+    """{__name__-stripped sorted label items: SeriesVector}. A duplicate
+    key is many-to-one territory — rejected, not silently merged."""
+    out = {}
+    for sv in vec:
+        key = tuple(sorted(
+            (k, v) for k, v in sv.labels.items() if k != "__name__"
+        ))
+        if key in out:
+            raise PromQLError(
+                f"vector matching: duplicate label set {dict(key)} on the "
+                f"{side} (many-to-one matching is outside the subset; "
+                "aggregate one side first)"
+            )
+        out[key] = sv
+    return out
+
+
+def _cmp_mask(op: str, a, b):
+    """Comparison predicate; NaN on either side compares False (the step
+    is absent, so it cannot survive a filter)."""
+    with np.errstate(all="ignore"):
+        if op == ">":
+            return a > b
+        if op == ">=":
+            return a >= b
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == "==":
+            return a == b
+        return ~np.isnan(a) & ~np.isnan(b) & (a != b)
 
 
 def walk_expr(node):

@@ -59,6 +59,10 @@ from horaedb_tpu.common import tracing, xprof
 from horaedb_tpu.common.error import DeadlineExceeded, UnavailableError
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
 from horaedb_tpu.storage import scanstats
+# per-tenant usage accounting rides the J015 metering funnel — the
+# admission scheduler is the layer that KNOWS the tenant, so queue
+# waits, sheds, deadline hits, and admitted-query counts meter here
+from horaedb_tpu.telemetry.metering import GLOBAL_METER
 
 QUERY_INFLIGHT = GLOBAL_METRICS.gauge(
     "horaedb_query_inflight",
@@ -211,9 +215,11 @@ class AdmissionSlot:
             # mark the trace and count the shed before the cancellation
             # unwinds the handler
             QUERY_SHED.labels("client_disconnect").inc()
+            GLOBAL_METER.account(self.tenant, sheds=1)
             tracing.add_attr(cancelled=True)
         elif e is not None and isinstance(e, DeadlineExceeded):
             QUERY_DEADLINE_EXCEEDED.inc()
+            GLOBAL_METER.account(self.tenant, deadline_hits=1)
         return False
 
     def verdict(self) -> dict:
@@ -308,6 +314,7 @@ class AdmissionController:
     async def _acquire(self, slot: AdmissionSlot) -> None:
         if self._forced_full:
             QUERY_SHED.labels("forced").inc()
+            GLOBAL_METER.account(slot.tenant, sheds=1)
             raise UnavailableError(
                 "query admission forced full (admin hook)",
                 retry_after_s=1.0,
@@ -316,6 +323,7 @@ class AdmissionController:
         slot.cost_estimate_s = est
         if self.max_cost_s > 0 and est is not None and est > self.max_cost_s:
             QUERY_SHED.labels("cost").inc()
+            GLOBAL_METER.account(slot.tenant, sheds=1)
             raise UnavailableError(
                 f"query estimated device cost {est:.3f}s exceeds "
                 f"max_cost_s={self.max_cost_s:g} "
@@ -327,12 +335,15 @@ class AdmissionController:
         if d is not None and d.expired():
             # arrived already out of budget: 504 without touching a slot
             QUERY_DEADLINE_EXCEEDED.inc()
+            GLOBAL_METER.account(slot.tenant, deadline_hits=1)
             d.check("admission")
         if self._queued == 0 and self._headroom(slot.tenant):
             self._grant_counts(slot.tenant)
+            GLOBAL_METER.account(slot.tenant, queries=1)
             return
         if self._queued >= self.queue_max:
             QUERY_SHED.labels("queue_full").inc()
+            GLOBAL_METER.account(slot.tenant, sheds=1)
             raise UnavailableError(
                 f"query queue full ({self._queued} queued, "
                 f"{self._inflight} in flight, cap {self.max_concurrent})",
@@ -359,10 +370,13 @@ class AdmissionController:
                 self._remove_waiter(w)
                 wait = self._clock() - w.enq_t
                 scanstats.record("queue_wait", wait)
+                GLOBAL_METER.account(slot.tenant, queue_wait_seconds=wait)
                 if d is not None and d.expired():
                     QUERY_DEADLINE_EXCEEDED.inc()
+                    GLOBAL_METER.account(slot.tenant, deadline_hits=1)
                     d.check("admission_queue")
                 QUERY_SHED.labels("stall").inc()
+                GLOBAL_METER.account(slot.tenant, sheds=1)
                 raise UnavailableError(
                     f"query stalled {wait:.2f}s in the admission queue "
                     f"({self._inflight} in flight, cap "
@@ -378,12 +392,15 @@ class AdmissionController:
             else:
                 self._remove_waiter(w)
             QUERY_SHED.labels("client_disconnect").inc()
+            GLOBAL_METER.account(slot.tenant, sheds=1)
             tracing.add_attr(cancelled=True)
             raise
         slot.queued = True
         slot.queue_wait_s = self._clock() - w.enq_t
         scanstats.record("queue_wait", slot.queue_wait_s)
         scanstats.note("admission_queued")
+        GLOBAL_METER.account(slot.tenant, queries=1,
+                             queue_wait_seconds=slot.queue_wait_s)
 
     def _remove_waiter(self, w: _Waiter) -> None:
         q = self._queues.get(w.tenant)

@@ -62,6 +62,14 @@ def _serving_mod():
     return serving
 
 
+def _telemetry_mod():
+    # deferred: telemetry registers the horaedb_tenant_*/_telemetry_*
+    # families and wires the exemplar source
+    from horaedb_tpu import telemetry
+
+    return telemetry
+
+
 @dataclass
 class TestConfig:
     """Self-write load generator (reference config.rs TestConfig)."""
@@ -325,6 +333,17 @@ class MetricEngineConfig:
     serving: "ServingTierConfig" = field(
         default_factory=lambda: _serving_mod().ServingTierConfig()
     )
+    # Self-telemetry ([metric_engine.telemetry], horaedb_tpu/telemetry):
+    # the self-scrape loop writing the registry's families back through
+    # the normal ingest path as first-class series, per-tenant usage
+    # metering, and the HORAEDB_TELEMETRY=off kill switch.
+    telemetry: "TelemetryConfig" = field(
+        default_factory=lambda: _telemetry_mod().TelemetryConfig()
+    )
+    # SLO burn-rate templates ([[metric_engine.slo]] array of tables,
+    # telemetry/slo.py): each expands into recording + alert rules over
+    # the self-scraped series at boot (requires rules.enabled).
+    slo: list = field(default_factory=list)
     storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
     # Ingest buffering (engine/data.py SampleManager): 0 = every write is
     # immediately durable (reference write==SST semantics); > 0 buffers up
@@ -467,6 +486,21 @@ class Config:
         ensure(rules.tenant_weight > 0,
                "rules.tenant_weight must be positive")
         ensure(bool(rules.tenant), "rules.tenant must be non-empty")
+        tel = self.metric_engine.telemetry
+        ensure(tel.scrape_interval.seconds > 0,
+               "telemetry.scrape_interval must be positive")
+        ensure(tel.max_series >= 0,
+               "telemetry.max_series must be >= 0 (0 = unbudgeted)")
+        ensure(bool(tel.tenant), "telemetry.tenant must be non-empty")
+        ensure(tel.tenant_weight > 0,
+               "telemetry.tenant_weight must be positive")
+        if self.metric_engine.slo:
+            ensure(rules.enabled,
+                   "[[metric_engine.slo]] requires metric_engine.rules "
+                   "enabled (the templates expand into rules)")
+            # validate every block NOW: a typo'd SLO must fail boot, not
+            # the first evaluator tick
+            _telemetry_mod().expand_slos(self.metric_engine.slo)
         store = self.metric_engine.storage.object_store
         kind = store.type.lower()
         ensure(

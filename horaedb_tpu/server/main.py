@@ -71,6 +71,7 @@ from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.read import CompactRequest, WriteRequest
 from horaedb_tpu.storage.storage import ObjectBasedStorage
 from horaedb_tpu.storage.types import TimeRange
+from horaedb_tpu.telemetry.metering import GLOBAL_METER as _METER
 
 logger = logging.getLogger("horaedb_tpu.server")
 
@@ -82,6 +83,9 @@ HTTP_SECONDS = METRICS.histogram(
     "horaedb_http_request_seconds",
     help="HTTP request latency by route template and method.",
     labelnames=("endpoint", "method"),
+    # OpenMetrics exemplars: route-latency buckets carry the trace id of
+    # their latest observation (rendered under content negotiation)
+    exemplars=True,
 )
 HTTP_REQUESTS = METRICS.counter(
     "horaedb_http_requests_total",
@@ -217,7 +221,7 @@ class ServerState:
     def __init__(self, config: Config, storage, engine: MetricEngine,
                  parser_pool=None, slowlog: "SlowLog | None" = None,
                  admission_controller: "AdmissionController | None" = None,
-                 rules=None):
+                 rules=None, telemetry=None):
         self.config = config
         self.storage = storage       # demo ColumnarStorage (reference parity)
         self.engine = engine         # metric engine (remote-write path)
@@ -228,6 +232,9 @@ class ServerState:
         self.admission = admission_controller or AdmissionController()
         # streaming rule engine (horaedb_tpu/rules), None = disabled
         self.rules = rules
+        # self-scrape collector (horaedb_tpu/telemetry), None = disabled
+        # (config or the HORAEDB_TELEMETRY=off kill switch)
+        self.telemetry = telemetry
         self.write_enabled = asyncio.Event()
         self.write_workers: list[asyncio.Task] = []
 
@@ -359,6 +366,16 @@ async def handle_metrics(request: web.Request) -> web.Response:
             table.manifest.deltas_num,
         )
     METRICS.set("horaedb_ingest_buffered_rows", buffered)
+    # content negotiation: OpenMetrics (with # EOF + trace-id exemplars
+    # on the latency histograms) when the scraper asks for it; classic
+    # Prometheus text otherwise
+    from horaedb_tpu.server.metrics import OPENMETRICS_CONTENT_TYPE
+
+    if OPENMETRICS_CONTENT_TYPE in request.headers.get("Accept", ""):
+        return web.Response(
+            text=METRICS.render_openmetrics(),
+            content_type=OPENMETRICS_CONTENT_TYPE,
+        )
     return web.Response(text=METRICS.render(), content_type="text/plain")
 
 
@@ -380,6 +397,9 @@ async def handle_remote_write(request: web.Request) -> web.Response:
         # series (and their samples) were rejected. 503 + Retry-After so
         # senders back off; the body carries the exact accounting.
         logger.warning("remote write cardinality-limited: %s", e)
+        _METER.account(_tenant_of(request),
+                       rows_ingested=e.accepted_samples,
+                       samples_rejected=e.rejected_samples)
         return unavailable_response(e, extra={
             "partial_accept": True,
             "accepted_samples": e.accepted_samples,
@@ -410,6 +430,8 @@ async def handle_remote_write(request: web.Request) -> web.Response:
     METRICS.inc("horaedb_remote_write_requests_total")
     METRICS.inc("horaedb_remote_write_samples_total", n)
     INGEST_BATCH_SAMPLES.observe(n)
+    # per-tenant usage (telemetry/metering.py, the J015 funnel)
+    _METER.account(_tenant_of(request), rows_ingested=n)
     return web.json_response({"samples": n}, status=200)
 
 
@@ -450,6 +472,17 @@ def _tenant_of(request: web.Request) -> str:
     state: ServerState = request.app[STATE_KEY]
     hdr = state.config.metric_engine.query.tenant_header
     return request.headers.get(hdr, "") or "default"
+
+
+def _meter_scan(request: web.Request, st) -> None:
+    """Fold one finished (or deadline-killed / shed — the caller paid for
+    the partial scan too) query's byte provenance into the tenant's usage
+    ledger (telemetry/metering.py)."""
+    if st is None:
+        return
+    b = st.counts.get("bytes_scanned", 0)
+    if b:
+        _METER.account(_tenant_of(request), bytes_scanned=b)
 
 
 def _query_deadline(state: "ServerState", raw_timeout) -> "deadline_ctx.Deadline":
@@ -710,12 +743,19 @@ async def handle_query_range(request: web.Request) -> web.Response:
             async with slot:
                 series = await ev.eval(expr)
     except DeadlineExceeded as e:
+        _meter_scan(request, st)
         return deadline_response(e, progress=_progress_payload(st))
     except UnavailableError as e:
+        _meter_scan(request, st)
         return unavailable_response(e)
     except (PromQLError, HoraeError, KeyError, ValueError) as e:
+        # post-scan PromQL errors exist (e.g. many-to-one vector
+        # matching rejects AFTER both operands scanned) — the caller
+        # paid for those bytes too
+        _meter_scan(request, st)
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
+    _meter_scan(request, st)
     explain = _finish_explain(state, st, "promql_range",
                               _want_explain(request, p),
                               admission_verdict=slot.verdict())
@@ -756,12 +796,16 @@ async def handle_promql_instant(
             async with slot:
                 series = await ev.eval(expr)
     except DeadlineExceeded as e:
+        _meter_scan(request, st)
         return deadline_response(e, progress=_progress_payload(st))
     except UnavailableError as e:
+        _meter_scan(request, st)
         return unavailable_response(e)
     except (PromQLError, HoraeError, ValueError) as e:
+        _meter_scan(request, st)  # post-scan eval errors paid for bytes
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
+    _meter_scan(request, st)
     explain = _finish_explain(state, st, "promql_instant",
                               _want_explain(request, params),
                               admission_verdict=slot.verdict())
@@ -890,6 +934,7 @@ async def handle_query(request: web.Request) -> web.Response:
     except DeadlineExceeded as e:
         # end-to-end budget spent (queued or mid-scan): 504 with the
         # partial-progress provenance of what the scan HAD done
+        _meter_scan(request, st)
         extra = (
             {"explain": _explain_payload(st, mode)} if want_explain else None
         )
@@ -901,12 +946,15 @@ async def handle_query(request: web.Request) -> web.Response:
         # stalled / cost gate): typed 503 + Retry-After, with the
         # partial-result provenance of what WAS reached when the caller
         # asked for the plan
+        _meter_scan(request, st)
         extra = (
             {"explain": _explain_payload(st, mode)} if want_explain else None
         )
         return unavailable_response(e, extra=extra)
     except HoraeError as e:
+        _meter_scan(request, st)  # post-scan errors paid for bytes
         return web.json_response({"error": str(e)}, status=400)
+    _meter_scan(request, st)
     explain = _finish_explain(state, st, mode, want_explain,
                               admission_verdict=slot.verdict())
     _attach_rule_provenance(state, explain, [q["metric"]])
@@ -1227,12 +1275,16 @@ async def handle_query_exemplars(request: web.Request) -> web.Response:
                 tenant=_tenant_of(request),
             )
     except DeadlineExceeded as e:
+        _meter_scan(request, st)
         return deadline_response(e, progress=_progress_payload(st))
     except UnavailableError as e:
+        _meter_scan(request, st)
         return unavailable_response(e)
     except (PromQLError, HoraeError, KeyError, ValueError) as e:
+        _meter_scan(request, st)  # post-scan errors paid for bytes
         return _promql_error(e)
     METRICS.inc("horaedb_queries_total")
+    _meter_scan(request, st)
     if table is None or table.num_rows == 0:
         return web.json_response({"status": "success", "data": []})
     matched = await state.engine.match_series(req.metric, req.filters, req.matchers)
@@ -1274,6 +1326,90 @@ async def handle_metadata(request: web.Request) -> web.Response:
             for name, t in sorted(meta.items())
         },
     })
+
+
+# ---------------------------------------------------------------------------
+# self-telemetry surface (horaedb_tpu/telemetry)
+# ---------------------------------------------------------------------------
+
+
+async def handle_usage(request: web.Request) -> web.Response:
+    """Per-tenant usage summary (telemetry/metering.py, the J015 funnel):
+    `?tenant=X` for one tenant (since-boot + `?window=5m` trailing view);
+    without `tenant`, every known tenant's since-boot totals. Serving
+    this never touches the query path — it reads the in-memory ledger."""
+    window_s = None
+    raw_window = request.query.get("window")
+    if raw_window:
+        try:
+            # the admission parser is the one float-or-duration reader
+            # (and the one that rejects NaN/inf — a NaN window would
+            # silently sum nothing). Clamped to the ledger's actual ring
+            # horizon (1 h): a wider window CANNOT be answered here —
+            # the clamp is visible in the response's `seconds`, and
+            # `coverage_seconds` marks any further truncation (short
+            # uptime). Longer ranges are a PromQL query over the
+            # self-scraped horaedb_tenant_* series.
+            from horaedb_tpu.telemetry.metering import UsageMeter
+
+            window_s = admission.parse_timeout_s(
+                raw_window, 300.0, UsageMeter.horizon_s()
+            )
+        except Exception as e:  # noqa: BLE001 — client data
+            return web.json_response(
+                {"status": "error", "errorType": "bad_data",
+                 "error": f"bad window: {e}"},
+                status=400,
+            )
+    tenant = request.query.get("tenant")
+    if tenant:
+        data = _METER.summary(tenant, window_s=window_s)
+    else:
+        data = {
+            "tenants": [
+                _METER.summary(t, window_s=window_s)
+                for t in _METER.tenants()
+            ],
+        }
+    return web.json_response({"status": "success", "data": data})
+
+
+def _telemetry_unavailable() -> web.Response:
+    return web.json_response(
+        {"status": "error", "errorType": "unavailable",
+         "error": "self-telemetry disabled ([metric_engine.telemetry] "
+                  "enabled = false, or HORAEDB_TELEMETRY=off)"},
+        status=501,
+    )
+
+
+async def handle_telemetry_scrape(request: web.Request) -> web.Response:
+    """Force one self-scrape tick NOW (admin/debug; the smoke gate uses
+    it instead of waiting out the interval). `?include=<prefix>` echoes
+    the written samples whose __name__ starts with the prefix — the
+    bit-equality oracle for range-query checks."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.telemetry is None:
+        return _telemetry_unavailable()
+    summary = await shield_mutation(state.telemetry.tick())
+    if summary.get("error"):
+        # the background loop retries silently; the FORCED tick is an
+        # operator probe, and a probe must not dress a failed write as
+        # success (automation keys on the status)
+        return web.json_response(
+            {"status": "error", "errorType": "internal",
+             "error": "self-scrape tick failed (see server log)",
+             "data": summary},
+            status=503,
+        )
+    samples = summary.pop("samples_list", [])
+    include = request.query.get("include")
+    if include:
+        summary["matched"] = [
+            {"name": n, "labels": dict(k), "value": v}
+            for n, k, v in samples if n.startswith(include)
+        ]
+    return web.json_response({"status": "success", "data": summary})
 
 
 # ---------------------------------------------------------------------------
@@ -1598,11 +1734,14 @@ async def build_app(config: Config, store=None) -> web.Application:
         )
     qcfg = config.metric_engine.query
     rcfg = config.metric_engine.rules
+    tcfg = config.metric_engine.telemetry
     # rule evaluations run as a distinct weighted-fair tenant; its LOW
     # default share means a rule storm queues behind dashboards, never
-    # ahead of them (an explicit tenant_weights entry wins)
+    # ahead of them (an explicit tenant_weights entry wins). The
+    # self-scrape `_system` tenant gets the same treatment.
     weights = dict(qcfg.tenant_weights)
     weights.setdefault(rcfg.tenant, rcfg.tenant_weight)
+    weights.setdefault(tcfg.tenant, tcfg.tenant_weight)
     adm = AdmissionController(
         max_concurrent=qcfg.max_concurrent,
         max_per_tenant=qcfg.max_per_tenant,
@@ -1611,6 +1750,8 @@ async def build_app(config: Config, store=None) -> web.Application:
         max_cost_s=qcfg.max_cost_s,
         weights=weights,
     )
+    from horaedb_tpu import telemetry as telemetry_mod
+
     rules_engine = None
     if rcfg.enabled:
         from horaedb_tpu.rules import rule_from_dict
@@ -1625,14 +1766,31 @@ async def build_app(config: Config, store=None) -> web.Application:
             admission=adm, tenant=rcfg.tenant,
         )
         # config-declared rules: asserted idempotently (an unchanged
-        # definition keeps its watermark / alert states across restarts)
-        for entry in list(rcfg.recording) + list(rcfg.alerting):
+        # definition keeps its watermark / alert states across restarts).
+        # SLO burn-rate templates (telemetry/slo.py) expand into the same
+        # idempotent path — an unchanged [[metric_engine.slo]] block
+        # keeps its rules' watermarks and alert states.
+        declared = (
+            list(rcfg.recording) + list(rcfg.alerting)
+            + telemetry_mod.expand_slos(config.metric_engine.slo)
+        )
+        for entry in declared:
             await rules_engine.ensure_registered(
                 rule_from_dict(entry, now_ms=now_ms())
             )
+    collector = None
+    if telemetry_mod.telemetry_enabled(tcfg.enabled):
+        collector = telemetry_mod.SelfScrapeCollector(
+            engine,
+            tenant=tcfg.tenant,
+            max_series=tcfg.max_series,
+            exclude=tuple(tcfg.exclude),
+            retention_ms=tcfg.retention_ms(),
+            instance=tcfg.instance,
+        )
     state = ServerState(config, storage, engine, parser_pool=pool,
                         slowlog=slow, admission_controller=adm,
-                        rules=rules_engine)
+                        rules=rules_engine, telemetry=collector)
     if config.test.enable_write:
         state.write_enabled.set()
     for i in range(config.test.write_worker_num):
@@ -1672,6 +1830,23 @@ async def build_app(config: Config, store=None) -> web.Application:
         state.write_workers.append(
             asyncio.create_task(rules_loop(), name="rule-evaluator")
         )
+    if collector is not None:
+        # the self-scrape loop: the registry becomes first-class series
+        # on this interval; tick failures log and retry (the collector
+        # is stateless between ticks beyond its series budget)
+        scrape_interval = tcfg.scrape_interval.seconds
+
+        async def telemetry_loop():
+            while True:
+                await asyncio.sleep(scrape_interval)
+                try:
+                    await collector.tick()
+                except Exception:  # noqa: BLE001 — keep scraping
+                    logger.exception("self-scrape tick failed")
+
+        state.write_workers.append(
+            asyncio.create_task(telemetry_loop(), name="telemetry-scrape")
+        )
 
     tracing.configure(
         sample=config.tracing.sample,
@@ -1707,6 +1882,8 @@ async def build_app(config: Config, store=None) -> web.Application:
             web.delete("/api/v1/rules/{name}", handle_rules_delete),
             web.get("/api/v1/alerts", handle_alerts),
             web.post("/api/v1/rules/tick", handle_rules_tick),
+            web.get("/api/v1/usage", handle_usage),
+            web.post("/api/v1/telemetry/scrape", handle_telemetry_scrape),
             web.post("/api/v1/admin/tsdb/delete_series", handle_delete_series),
             web.get("/api/v1/status/buildinfo", handle_buildinfo),
             web.get("/debug/traces", handle_debug_traces),

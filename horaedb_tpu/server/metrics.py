@@ -33,8 +33,22 @@ import time
 
 __all__ = [
     "Metrics", "CounterFamily", "GaugeFamily", "HistogramFamily",
-    "GLOBAL_METRICS", "DEFAULT_BUCKETS",
+    "GLOBAL_METRICS", "DEFAULT_BUCKETS", "set_exemplar_source",
+    "OPENMETRICS_CONTENT_TYPE",
 ]
+
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text"
+
+# Exemplar source: a zero-arg callable returning the active trace id (or
+# None outside a trace). Injected — NOT imported — so this module stays
+# dependency-free (storage/ and parallel/ import it); the telemetry
+# package wires it to common/tracing.current_trace_id at import.
+_exemplar_source = None
+
+
+def set_exemplar_source(fn) -> None:
+    global _exemplar_source
+    _exemplar_source = fn
 
 # Prometheus' classic latency buckets (seconds); wide enough to cover a
 # sub-ms device dispatch and a multi-second compaction in one family.
@@ -211,13 +225,17 @@ class GaugeFamily(_Family):
 
 
 class _HistogramChild:
-    __slots__ = ("_bounds", "_counts", "_sum", "_lock")
+    __slots__ = ("_bounds", "_counts", "_sum", "_lock", "_ex")
 
-    def __init__(self, bounds: tuple[float, ...]):
+    def __init__(self, bounds: tuple[float, ...], exemplars: bool = False):
         self._bounds = bounds
         self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf
         self._sum = 0.0
         self._lock = threading.Lock()
+        # per-bucket latest exemplar (labels, value, unix seconds) — only
+        # allocated on exemplar-enabled families (route/scan/flush latency)
+        self._ex: "list | None" = [None] * (len(bounds) + 1) if exemplars \
+            else None
 
     def time(self) -> "_Timer":
         """Context manager observing the block's wall time."""
@@ -228,6 +246,18 @@ class _HistogramChild:
         with self._lock:
             self._counts[i] += 1
             self._sum += value
+        if self._ex is not None and _exemplar_source is not None:
+            tid = _exemplar_source()
+            if tid:
+                # one tuple store under the GIL; rendering snapshots the
+                # tuple, never the mutating list slot
+                self._ex[i] = ({"trace_id": str(tid)}, value, time.time())
+
+    def exemplars(self) -> "list":
+        """Per-bucket (labels, value, ts) snapshot, index-aligned with
+        the bounds (+Inf last); empty when the family is not
+        exemplar-enabled."""
+        return list(self._ex) if self._ex is not None else []
 
     @property
     def count(self) -> int:
@@ -270,7 +300,7 @@ class _HistogramChild:
 class HistogramFamily(_Family):
     TYPE = "histogram"
 
-    def __init__(self, name, help, labelnames, buckets):
+    def __init__(self, name, help, labelnames, buckets, exemplars=False):
         super().__init__(name, help, labelnames)
         bounds = tuple(sorted(float(b) for b in buckets))
         if not bounds:
@@ -278,9 +308,10 @@ class HistogramFamily(_Family):
         if bounds and bounds[-1] == float("inf"):
             bounds = bounds[:-1]  # +Inf is implicit
         self.buckets = bounds
+        self.exemplars_enabled = bool(exemplars)
 
     def _make_child(self):
-        return _HistogramChild(self.buckets)
+        return _HistogramChild(self.buckets, exemplars=self.exemplars_enabled)
 
     def observe(self, value: float) -> None:
         self._default().observe(value)
@@ -348,9 +379,12 @@ class Metrics:
     def histogram(self, name: str, help: str = "",
                   labelnames: tuple[str, ...] = (),
                   buckets: tuple[float, ...] = DEFAULT_BUCKETS,
-                  ) -> HistogramFamily:
+                  exemplars: bool = False) -> HistogramFamily:
+        """`exemplars=True` stores the latest (trace_id, value, ts) per
+        bucket when an exemplar source is wired (set_exemplar_source) —
+        rendered only in the OpenMetrics exposition."""
         return self._register(HistogramFamily, name, help, labelnames,
-                              buckets=buckets)
+                              buckets=buckets, exemplars=exemplars)
 
     def get(self, name: str) -> _Family | None:
         with self._lock:
@@ -383,6 +417,23 @@ class Metrics:
         """Legacy: gauge set; `name` may embed `{k="v"}` labels."""
         self._legacy_child(GaugeFamily, name).set(value)
 
+    # -- snapshots (self-scrape collector) -----------------------------------
+    def snapshot_samples(self) -> list[tuple[str, str, str, tuple, float]]:
+        """(family, type, sample_name, label items, value) for every
+        sample the text exposition would render (histograms exploded to
+        _bucket/_sum/_count, cumulative counts, `le` formatted exactly as
+        render() prints it). The self-scrape collector's source of truth:
+        a PromQL query over a self-written series must return values
+        bit-equal to this snapshot at the scrape timestamp."""
+        with self._lock:
+            fams = sorted(self._families.items())
+        out = []
+        for name, fam in fams:
+            for suffix, key, value in fam.samples():
+                out.append((name, fam.TYPE, name + suffix, key,
+                            float(value)))
+        return out
+
     # -- rendering -----------------------------------------------------------
     def render(self) -> str:
         lines = [
@@ -399,6 +450,68 @@ class Metrics:
             for suffix, key, value in fam.samples():
                 lines.append(f"{name}{suffix}{_label_str(key)} {_fmt(value)}")
         return "\n".join(lines) + "\n"
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics 1.0 exposition (content-negotiated on /metrics):
+        counter family names drop the `_total` suffix (the sample keeps
+        it), exemplar-enabled histograms append `# {trace_id="..."} v ts`
+        to their bucket lines, and the body terminates with `# EOF`. A
+        counter whose registered name lacks `_total` cannot be spelled as
+        an OpenMetrics counter — it renders as `unknown` (tools/
+        promcheck.py --openmetrics enforces the grammar)."""
+        lines = [
+            "# TYPE horaedb_uptime_seconds gauge",
+            f"horaedb_uptime_seconds {time.time() - self._start:.1f}",
+        ]
+        with self._lock:
+            fams = sorted(self._families.items())
+        for name, fam in fams:
+            if fam.TYPE == "counter":
+                conformant = name.endswith("_total")
+                base = name[:-len("_total")] if conformant else name
+                om_type = "counter" if conformant else "unknown"
+            else:
+                base, om_type = name, fam.TYPE
+            if fam.help:
+                lines.append(f"# HELP {base} {_escape_help(fam.help)}")
+            lines.append(f"# TYPE {base} {om_type}")
+            if fam.TYPE != "histogram":
+                for suffix, key, value in fam.samples():
+                    lines.append(
+                        f"{name}{suffix}{_label_str(key)} {_fmt(value)}"
+                    )
+                continue
+            with fam._lock:
+                items = sorted(fam._children.items())
+            for key, child in items:
+                counts, total_sum = child._snapshot()
+                exs = child.exemplars()
+                bounds = list(child._bounds) + [float("inf")]
+                acc = 0
+                for j, b in enumerate(bounds):
+                    acc += counts[j]
+                    line = (
+                        f"{name}_bucket"
+                        f"{_label_str(key + (('le', _fmt(float(b))),))} "
+                        f"{_fmt(float(acc))}"
+                    )
+                    ex = exs[j] if j < len(exs) else None
+                    if ex is not None:
+                        line += _exemplar_str(ex)
+                    lines.append(line)
+                lines.append(f"{name}_sum{_label_str(key)} {_fmt(total_sum)}")
+                lines.append(
+                    f"{name}_count{_label_str(key)} {_fmt(float(acc))}"
+                )
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+def _exemplar_str(ex: tuple) -> str:
+    """` # {trace_id="..."} value timestamp` (OpenMetrics exemplar)."""
+    labels, value, ts = ex
+    items = tuple((str(k), str(v)) for k, v in labels.items())
+    return f" # {_label_str(items)} {_fmt(float(value))} {ts:.3f}"
 
 
 GLOBAL_METRICS = Metrics()

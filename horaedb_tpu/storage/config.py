@@ -61,9 +61,14 @@ def _from_dict(cls, d: dict):
     kwargs = {}
     for name, value in d.items():
         default = getattr(cls(), name)
-        if name in ("ttl", "period") and value is not None:
-            # duration-or-None fields: the None default gives the generic
-            # `.parse` dispatch below nothing to go on
+        if (value is not None and default is None
+                and not isinstance(value, dict)
+                and "ReadableDuration" in str(known[name].type)):
+            # duration-or-None fields (`ReadableDuration | None = None`,
+            # e.g. ttl/period/telemetry.retention): the None default gives
+            # the generic `.parse` dispatch below nothing to go on, so
+            # dispatch on the DECLARED field type — a name list here
+            # already rotted once (the PR 11 RulesConfig lesson)
             kwargs[name] = ReadableDuration.parse(value)
         elif name in ("resolutions", "rollup_resolutions") and value is not None:
             # rollup resolutions: "1m"/"1h" strings or raw ms ints

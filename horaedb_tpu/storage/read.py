@@ -1228,6 +1228,12 @@ class ParquetReader:
                     if table is not None:
                         scanstats.note("ssts_read")
                         scanstats.note("ssts_encoded")
+                        # per-tenant usage provenance (telemetry/metering):
+                        # bytes this query MATERIALIZED from storage (the
+                        # decoded size — the work done for this tenant;
+                        # wire-size compression provenance is the separate
+                        # encoded_bytes/decoded_bytes pair)
+                        scanstats.note("bytes_scanned", int(table.nbytes))
                         return self._mask_visibility(sst, table)
         scanstats.note("ssts_read")
         cols_key = tuple(sorted(columns)) if columns is not None else ("*",)
@@ -1235,6 +1241,10 @@ class ParquetReader:
         if rg_cache is not None:
             cached = self._assemble_cached(sst.id, rg_cache[0], predicate)
             if cached is not None:
+                # block-cache-served reads charge the same materialized
+                # bytes as cold reads: usage metering must not depend on
+                # which cache layer answered an identical query
+                scanstats.note("bytes_scanned", int(cached.nbytes))
                 return self._mask_visibility(sst, cached)
 
         def meta_sink(meta, arrow_schema) -> None:
@@ -1302,6 +1312,7 @@ class ParquetReader:
             # compaction deleted the file after the caller's manifest
             # snapshot; normalized so scan layers can refresh + retry
             raise NotFound(f"sst object vanished: {path}") from e
+        scanstats.note("bytes_scanned", int(table.nbytes))
         return self._mask_visibility(sst, table)
 
     async def _enc_sidecar(self, sst: SstFile):

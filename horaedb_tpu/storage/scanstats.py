@@ -53,6 +53,10 @@ STAGE_SECONDS = GLOBAL_METRICS.histogram(
     help="Per-stage scan time by lane (io_decode, host_prep, transfer, "
          "kernel, compile, ...): the request-attribution view of scanstats.",
     labelnames=("stage",),
+    # OpenMetrics exemplars: each bucket remembers the trace id of its
+    # latest observation, so a stage-latency spike on a dashboard links
+    # straight to a /debug/traces/{id} span tree
+    exemplars=True,
 )
 # Pre-register the canonical lanes so /metrics always exposes the full
 # attribution surface (zero-count histograms), even before the first scan

@@ -1,0 +1,96 @@
+"""Self-telemetry pipeline: the engine as its own long-term metric store.
+
+Three legs (ISSUE 12 / ROADMAP item 4 follow-on):
+
+- **telemetry/collector.py** — the self-scrape loop: the typed metric
+  registry snapshotted straight into the normal ingest path under the
+  low-weight `_system` tenant, so every `horaedb_*` family becomes
+  PromQL-queryable history that survives restarts;
+- **telemetry/metering.py** — the per-tenant usage funnel (jaxlint J015):
+  rows ingested, samples rejected, bytes scanned, queue-wait seconds,
+  sheds and deadline hits per tenant, exported as `horaedb_tenant_*`
+  families and served at `GET /api/v1/usage`;
+- **telemetry/slo.py** — declarative `[[metric_engine.slo]]` burn-rate
+  templates expanded into PR 11 recording + alert rules over the
+  self-scraped series.
+
+Importing this package also wires the OpenMetrics exemplar source:
+exemplar-enabled latency histograms (route latency, scan stages, flush
+stages) stamp the active trace id onto their observations, and the
+OpenMetrics exposition (`Accept: application/openmetrics-text` on
+/metrics) renders them as `# {trace_id="..."}` — any metric spike links
+straight to its `/debug/traces/{id}` span tree. The hook is injected
+here rather than imported by server/metrics.py because that module must
+stay dependency-free (storage/ and parallel/ import it).
+
+Kill switch: `HORAEDB_TELEMETRY=off` (env) disables the self-scrape loop
+regardless of config — the honesty-switch convention (HORAEDB_SERVING)
+for A/B-ing the monitor's own overhead.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common import tracing as _tracing
+from horaedb_tpu.common.time_ext import ReadableDuration
+from horaedb_tpu.server import metrics as _metrics
+from horaedb_tpu.telemetry.collector import SelfScrapeCollector
+from horaedb_tpu.telemetry.metering import FIELDS, GLOBAL_METER, UsageMeter
+from horaedb_tpu.telemetry.slo import SloSpec, expand_slo, expand_slos
+
+__all__ = [
+    "TelemetryConfig", "SelfScrapeCollector", "UsageMeter", "GLOBAL_METER",
+    "FIELDS", "SloSpec", "expand_slo", "expand_slos", "telemetry_enabled",
+]
+
+# the exemplar wiring (module docstring): one injection, process-wide
+_metrics.set_exemplar_source(_tracing.current_trace_id)
+
+
+def telemetry_enabled(config_enabled: bool = True) -> bool:
+    """Config AND the HORAEDB_TELEMETRY env kill switch (off/0/false/no
+    disables; anything else — including unset — defers to config)."""
+    env = os.environ.get("HORAEDB_TELEMETRY", "").strip().lower()
+    if env in ("off", "0", "false", "no"):
+        return False
+    return bool(config_enabled)
+
+
+@dataclass
+class TelemetryConfig:
+    """`[metric_engine.telemetry]` — the self-scrape loop's knobs."""
+
+    enabled: bool = True
+    # scrape spacing; each tick writes one sample per registry series
+    scrape_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(15)
+    )
+    # accounting + admission identity of the loop's writes
+    tenant: str = "_system"
+    tenant_weight: float = 0.25
+    # instance label stamped on every self-written series (the
+    # Prometheus self-scrape idiom); the retention sweep deletes ONLY
+    # series carrying it — give each engine feeding a shared store a
+    # distinct value
+    instance: str = "self"
+    # feedback-safety budget: distinct self-written series the collector
+    # may create (existing series keep flowing at the cap)
+    max_series: int = 8192
+    # family-name prefixes to skip entirely
+    exclude: list = field(default_factory=list)
+    # self-series horizon (tombstone sweep); None/0s keeps forever
+    retention: ReadableDuration | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "TelemetryConfig":
+        from horaedb_tpu.storage.config import _from_dict
+
+        return _from_dict(cls, d)
+
+    def retention_ms(self) -> int | None:
+        if self.retention is None:
+            return None
+        ms = self.retention.as_millis()
+        return ms if ms > 0 else None

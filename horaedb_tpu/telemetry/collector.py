@@ -1,0 +1,302 @@
+"""Self-scrape collector: the engine ingests its OWN /metrics.
+
+Every observability surface before this PR was trapped in-process — a
+/metrics scrape is a point in time, the trace ring is bounded, nothing
+survives a restart — yet this is a time-series database. The collector
+closes the loop (the Prometheus self-scrape pattern): on an interval it
+snapshots the typed metric registry DIRECTLY (no HTTP round-trip, no
+text-format parse), converts every family into samples — counters and
+gauges as-is, histograms exploded to `_bucket`/`_sum`/`_count` series
+with their `le` labels — and writes them through the NORMAL ingest path.
+`horaedb_query_shed_total` et al. become first-class series: queryable
+by PromQL range queries, cacheable by the serving tier, alertable by the
+rules engine (the SLO burn-rate templates in telemetry/slo.py read
+nothing else), retained and compacted like any tenant's data.
+
+Feedback safety — a telemetry loop inside its own store must not
+amplify itself:
+
+- the snapshot is taken from the registry BEFORE the write, so a tick
+  never observes its own ingest side effects (they surface next tick as
+  ordinary counter movement — new VALUES on the same series);
+- series cardinality is budgeted: the collector tracks every distinct
+  (sample name, label set) it has ever emitted and DROPS new series past
+  `max_series` (`horaedb_telemetry_dropped_series_total` counts them, a
+  one-per-breach log names the first offender) — the registry's label
+  sets are bounded by construction, so steady state emits the same
+  series every tick and the budget is never touched;
+- writes bypass the HTTP handler, so the HTTP families do not move from
+  self-scraping (no scrape->counter->scrape spiral);
+- the rules engine's self-invalidation guard already ensures an SLO
+  rule's own write-back never re-dirties it; the scrape's events dirty
+  rules exactly like external ingest (they ARE new data).
+
+Usage is metered like any tenant: rows land under the low-weight
+`_system` tenant in the J015 funnel, so the monitor's own cost shows up
+in `/api/v1/usage?tenant=_system`.
+
+Retention: self-telemetry is high-churn and rarely worth keeping beyond
+the ops horizon; `retention` (config) tombstones self-written series
+older than the horizon through the normal delete path on an infrequent
+sweep, independent of the table-wide retention knob.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from horaedb_tpu.common.time_ext import now_ms as wall_now_ms
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
+from horaedb_tpu.telemetry.metering import GLOBAL_METER
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SelfScrapeCollector"]
+
+TELEMETRY_TICKS = GLOBAL_METRICS.counter(
+    "horaedb_telemetry_ticks_total",
+    help="Self-scrape ticks by result: ok (snapshot written through the "
+         "ingest path), error (write failed; retried next interval).",
+    labelnames=("result",),
+)
+TELEMETRY_SAMPLES = GLOBAL_METRICS.counter(
+    "horaedb_telemetry_samples_total",
+    help="Samples written by the self-scrape loop (one per registry "
+         "sample per tick).",
+)
+TELEMETRY_SERIES = GLOBAL_METRICS.gauge(
+    "horaedb_telemetry_series",
+    help="Distinct self-scraped series emitted since boot — bounded by "
+         "[metric_engine.telemetry] max_series (the feedback-safety "
+         "budget).",
+)
+TELEMETRY_DROPPED = GLOBAL_METRICS.counter(
+    "horaedb_telemetry_dropped_series_total",
+    help="Series the self-scrape refused to create because the "
+         "max_series budget was exhausted (values on existing series "
+         "keep flowing).",
+)
+TELEMETRY_SCRAPE_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_telemetry_scrape_seconds",
+    help="One self-scrape tick wall time (snapshot + payload build + "
+         "ingest write).",
+)
+TELEMETRY_RETENTION_SWEEPS = GLOBAL_METRICS.counter(
+    "horaedb_telemetry_retention_sweeps_total",
+    help="Self-telemetry retention sweeps (tombstone deletes of "
+         "self-series older than the configured horizon).",
+)
+for _r in ("ok", "error"):
+    TELEMETRY_TICKS.labels(_r)
+del _r
+
+
+class SelfScrapeCollector:
+    """One collector per engine (module docstring has the contract).
+
+    `clock` returns epoch ms and is injectable for the bit-equality
+    property tests; `registry` defaults to the process registry."""
+
+    def __init__(
+        self,
+        engine,
+        registry=GLOBAL_METRICS,
+        tenant: str = "_system",
+        max_series: int = 8192,
+        exclude: tuple = (),
+        retention_ms: "int | None" = None,
+        instance: str = "self",
+        clock=wall_now_ms,
+        meter=GLOBAL_METER,
+    ):
+        self._engine = engine
+        self._registry = registry
+        self.tenant = tenant
+        self.max_series = max(0, int(max_series))
+        self.exclude = tuple(str(p) for p in exclude)
+        self.retention_ms = (int(retention_ms)
+                             if retention_ms else None)
+        # the Prometheus self-scrape idiom: every written series carries
+        # instance="<self>" — it marks the series as THIS collector's, so
+        # the retention sweep can tombstone its own output without
+        # touching same-named series another agent remote-wrote into
+        # this engine (the engine-as-shared-metrics-store case)
+        self.instance = str(instance)
+        self._clock = clock
+        self._meter = meter
+        self._series: set = set()
+        self._budget_logged = False
+        # every __name__ ever written (the retention sweep's target list)
+        self._written_names: set[str] = set()
+        self._last_sweep_ms: int = 0
+        self._swept_hi_ms: int = 0
+
+    # -- snapshot -> samples --------------------------------------------------
+    def snapshot(self) -> tuple[int, list[tuple[str, tuple, float]]]:
+        """(family count, [(__name__, label items, value)]) for every
+        registry sample that survives the exclusion list — the exact
+        values a PromQL query over the written series must return for
+        the scrape timestamp."""
+        out = []
+        families = set()
+        for family, _type, sample, key, value in \
+                self._registry.snapshot_samples():
+            if any(family.startswith(p) for p in self.exclude):
+                continue
+            families.add(family)
+            out.append((sample, key, value))
+        return len(families), out
+
+    def _budgeted(self, samples: list) -> tuple[list, list, int]:
+        """Apply the series budget: samples on already-known series
+        always pass; new series admit only under max_series. New keys
+        are STAGED, not committed — the tick commits them only after
+        the engine accepted the write, so a failed/degraded write never
+        leaves phantom entries consuming the budget."""
+        kept, dropped = [], 0
+        staged: set = set()
+        for name, key, value in samples:
+            skey = (name, key)
+            if skey not in self._series and skey not in staged:
+                if self.max_series and (
+                    len(self._series) + len(staged) >= self.max_series
+                ):
+                    dropped += 1
+                    continue
+                staged.add(skey)
+            kept.append((name, key, value))
+        if dropped:
+            TELEMETRY_DROPPED.inc(dropped)
+            if not self._budget_logged:
+                self._budget_logged = True
+                logger.warning(
+                    "self-telemetry series budget (%d) exhausted; %d new "
+                    "series dropped this tick (existing series keep "
+                    "flowing; raise [metric_engine.telemetry] max_series "
+                    "or extend the exclude list)",
+                    self.max_series, dropped,
+                )
+        return kept, sorted(staged), dropped
+
+    def _payload(self, samples: list, ts_ms: int) -> bytes:
+        from horaedb_tpu.pb import remote_write_pb2
+
+        req = remote_write_pb2.WriteRequest()
+        for name, key, value in samples:
+            series = req.timeseries.add()
+            lab = series.labels.add()
+            lab.name = b"__name__"
+            lab.value = name.encode()
+            if all(k != "instance" for k, _v in key):
+                lab = series.labels.add()
+                lab.name = b"instance"
+                lab.value = self.instance.encode()
+            for k, v in key:
+                lab = series.labels.add()
+                lab.name = str(k).encode()
+                lab.value = str(v).encode()
+            smp = series.samples.add()
+            smp.timestamp = ts_ms
+            smp.value = float(value)
+        return req.SerializeToString()
+
+    # -- the tick -------------------------------------------------------------
+    async def tick(self) -> dict:
+        """One scrape: snapshot, budget, write, meter. Returns the tick
+        summary INCLUDING the written samples (the property tests' and
+        smoke gate's bit-equality oracle)."""
+        from horaedb_tpu.common import tracing
+        from horaedb_tpu.ingest.cardinality import CardinalityLimited
+
+        t0 = time.perf_counter()
+        ts_ms = int(self._clock())
+        n_families, snap = self.snapshot()
+        kept, staged, dropped = self._budgeted(snap)
+        summary = {
+            "ts_ms": ts_ms,
+            "families": n_families,
+            "samples": len(kept),
+            "series": len(self._series) + len(staged),
+            "dropped": dropped,
+            "written": 0,
+        }
+        try:
+            with tracing.trace("telemetry_scrape", samples=len(kept)):
+                if kept:
+                    try:
+                        n = await self._engine.write_payload(
+                            self._payload(kept, ts_ms)
+                        )
+                        # clean write: the staged series were really
+                        # emitted — commit them against the budget
+                        self._series.update(staged)
+                    except CardinalityLimited as e:
+                        # the ENGINE's cardinality defense also applies
+                        # to the monitor itself: in-budget samples
+                        # landed, but WHICH staged series the engine
+                        # rejected is unknown — leave them uncommitted
+                        # (re-staging a landed series is an idempotent
+                        # set-add next tick; committing a rejected one
+                        # would burn budget on a phantom)
+                        n = e.accepted_samples
+                        self._meter.account(
+                            self.tenant,
+                            samples_rejected=e.rejected_samples,
+                        )
+                    summary["written"] = n
+                    self._meter.account(self.tenant, rows_ingested=n)
+        except Exception:
+            TELEMETRY_TICKS.labels("error").inc()
+            logger.warning("self-scrape tick failed; next interval "
+                           "retries", exc_info=True)
+            summary["error"] = True
+            return summary
+        finally:
+            TELEMETRY_SERIES.set(len(self._series))
+            summary["series"] = len(self._series)
+        try:
+            # the sweep is housekeeping, isolated from the scrape
+            # verdict: a failed delete must not mark a LANDED write as
+            # a failed tick (the next due sweep retries — _swept_hi_ms
+            # only advances on success)
+            await self._maybe_sweep(ts_ms)
+        except Exception:  # noqa: BLE001 — housekeeping only
+            logger.warning("self-telemetry retention sweep failed; "
+                           "next due sweep retries", exc_info=True)
+            summary["sweep_error"] = True
+        for name, _k, _v in kept:
+            self._written_names.add(name)
+        TELEMETRY_TICKS.labels("ok").inc()
+        TELEMETRY_SAMPLES.inc(len(kept))
+        TELEMETRY_SCRAPE_SECONDS.observe(time.perf_counter() - t0)
+        summary["samples_list"] = kept
+        return summary
+
+    async def _maybe_sweep(self, now_ms: int) -> None:
+        """Infrequent retention sweep: tombstone self-series older than
+        the horizon. Sweep spacing is horizon/8 (floored at 60 s) — the
+        horizon bounds staleness, not the sweep's punctuality. Scoped
+        two ways: the instance="..." filter confines deletes to THIS
+        collector's series (never same-named data another agent wrote),
+        and each sweep covers only the (prev horizon, horizon) delta, so
+        a long-lived server never re-tombstones already-swept ranges
+        (tombstones and invalidation-funnel events both cost)."""
+        if self.retention_ms is None or not self._written_names:
+            return
+        spacing = max(self.retention_ms // 8, 60_000)
+        if now_ms - self._last_sweep_ms < spacing:
+            return
+        self._last_sweep_ms = now_ms
+        horizon = now_ms - self.retention_ms
+        if horizon <= self._swept_hi_ms:
+            return
+        start = self._swept_hi_ms  # 0 on a fresh process: one full pass
+        for name in sorted(self._written_names):
+            await self._engine.delete_series(
+                name.encode(),
+                filters=[(b"instance", self.instance.encode())],
+                start_ms=start, end_ms=horizon,
+            )
+        self._swept_hi_ms = horizon
+        TELEMETRY_RETENTION_SWEEPS.inc()

@@ -1,0 +1,214 @@
+"""Per-tenant usage metering: THE accounting funnel (jaxlint J015).
+
+Every layer that knows a tenant (the admission scheduler, the remote-write
+handler, the query handlers' scan provenance) reports usage through ONE
+process-wide meter — never through ad-hoc per-tenant counters (J015 pins
+this: a `horaedb_tenant_*` family or a `tenant` labelname registered
+outside horaedb_tpu/telemetry/ is a lint finding). One funnel means the
+Prometheus families, the `/api/v1/usage` summary, and any future billing
+export can never disagree about what a tenant consumed.
+
+Two views of the same ledger:
+
+- **since-boot**: monotone per-tenant counters, exported as the
+  `horaedb_tenant_*` families below (and therefore self-scraped into
+  first-class series by telemetry/collector.py — long-term per-tenant
+  usage history is a PromQL query, not a side system);
+- **windowed**: a bounded ring of coarse time buckets per tenant, served
+  by `GET /api/v1/usage?tenant=...&window=5m` for "what did this tenant
+  do in the last N minutes" without touching the query path.
+
+Tenant-count bounded: past `MAX_TENANTS` distinct tenants, new ones fold
+into the `_overflow` bucket (cardinality defense on the accounting
+surface itself — a tenant-id flood must not grow /metrics unboundedly).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+__all__ = ["UsageMeter", "GLOBAL_METER", "FIELDS"]
+
+# the ledger's schema: one counter family per field, labeled by tenant
+FIELDS = (
+    "rows_ingested", "samples_rejected", "bytes_scanned",
+    "queue_wait_seconds", "queries", "sheds", "deadline_hits",
+)
+
+TENANT_ROWS = GLOBAL_METRICS.counter(
+    "horaedb_tenant_rows_ingested_total",
+    help="Samples accepted through the ingest path, by tenant "
+         "(X-Horaedb-Tenant header; the self-scrape loop writes as "
+         "`_system`).",
+    labelnames=("tenant",),
+)
+TENANT_REJECTED = GLOBAL_METRICS.counter(
+    "horaedb_tenant_samples_rejected_total",
+    help="Samples rejected at ingest by the series-cardinality defense "
+         "(partial-accepts), by tenant. Wholly-malformed payloads 400 "
+         "before their sample count is knowable and are visible in "
+         "horaedb_http_requests_total{status=\"400\"} instead.",
+    labelnames=("tenant",),
+)
+TENANT_BYTES_SCANNED = GLOBAL_METRICS.counter(
+    "horaedb_tenant_bytes_scanned_total",
+    help="Bytes MATERIALIZED from SSTs to answer this tenant's queries "
+         "(decoded in-memory size, identical whether the read came cold "
+         "or from the block cache; result-cache hits scan nothing and "
+         "charge nothing).",
+    labelnames=("tenant",),
+)
+TENANT_QUEUE_WAIT = GLOBAL_METRICS.counter(
+    "horaedb_tenant_queue_wait_seconds_total",
+    help="Seconds this tenant's queries spent waiting in the admission "
+         "queue before a slot.",
+    labelnames=("tenant",),
+)
+TENANT_QUERIES = GLOBAL_METRICS.counter(
+    "horaedb_tenant_queries_total",
+    help="Queries admitted (granted a slot) by tenant.",
+    labelnames=("tenant",),
+)
+TENANT_SHEDS = GLOBAL_METRICS.counter(
+    "horaedb_tenant_sheds_total",
+    help="Queries shed before or during a slot (queue_full/stall/cost/"
+         "forced/client_disconnect), by tenant.",
+    labelnames=("tenant",),
+)
+TENANT_DEADLINE = GLOBAL_METRICS.counter(
+    "horaedb_tenant_deadline_exceeded_total",
+    help="Queries that ran out of their end-to-end deadline, by tenant.",
+    labelnames=("tenant",),
+)
+
+_FAMILY_OF = {
+    "rows_ingested": TENANT_ROWS,
+    "samples_rejected": TENANT_REJECTED,
+    "bytes_scanned": TENANT_BYTES_SCANNED,
+    "queue_wait_seconds": TENANT_QUEUE_WAIT,
+    "queries": TENANT_QUERIES,
+    "sheds": TENANT_SHEDS,
+    "deadline_hits": TENANT_DEADLINE,
+}
+
+
+class UsageMeter:
+    """The process-wide per-tenant ledger (module docstring).
+
+    Thread-safe (ingest accounting can arrive from executor threads);
+    `clock` is injectable for deterministic windowed-view tests and must
+    return unix seconds."""
+
+    MAX_TENANTS = 1024
+    OVERFLOW = "_overflow"
+    BUCKET_S = 10          # windowed-view granularity
+    MAX_BUCKETS = 360      # per tenant: 1h of history at 10 s buckets
+
+    def __init__(self, clock=time.time):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._boot = clock()
+        # tenant -> {field: float} since boot
+        self._totals: dict[str, dict[str, float]] = {}
+        # tenant -> OrderedDict[bucket_epoch -> {field: float}]
+        self._windows: dict[str, OrderedDict] = {}
+
+    def _tenant_slot(self, tenant: str) -> str:
+        t = str(tenant) or "default"
+        if t in self._totals or len(self._totals) < self.MAX_TENANTS:
+            return t
+        return self.OVERFLOW
+
+    def account(self, tenant: str, **deltas: float) -> None:
+        """Fold one usage event into the ledger. Unknown fields raise —
+        a typo'd field would silently meter nothing."""
+        bad = set(deltas) - set(FIELDS)
+        if bad:
+            raise ValueError(f"unknown usage fields: {sorted(bad)}")
+        now = self._clock()
+        bucket = int(now // self.BUCKET_S) * self.BUCKET_S
+        with self._lock:
+            t = self._tenant_slot(tenant)
+            tot = self._totals.setdefault(t, dict.fromkeys(FIELDS, 0.0))
+            ring = self._windows.setdefault(t, OrderedDict())
+            win = ring.get(bucket)
+            if win is None:
+                win = ring[bucket] = dict.fromkeys(FIELDS, 0.0)
+                while len(ring) > self.MAX_BUCKETS:
+                    ring.popitem(last=False)
+            for k, v in deltas.items():
+                v = float(v)
+                if v == 0.0:
+                    continue
+                tot[k] += v
+                win[k] += v
+                _FAMILY_OF[k].labels(t).inc(v)
+
+    # -- the /api/v1/usage view ---------------------------------------------
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._totals)
+
+    def summary(self, tenant: str, window_s: float | None = None) -> dict:
+        """Since-boot totals plus (optionally) the trailing-window sums.
+        An unknown tenant answers zeros — absence of usage is a valid
+        usage report, not a 404."""
+        now = self._clock()
+        with self._lock:
+            tot = dict(self._totals.get(tenant) or dict.fromkeys(FIELDS, 0.0))
+            out = {
+                "tenant": tenant,
+                "since_boot": {k: _tidy(v) for k, v in tot.items()},
+                "boot_unix_s": round(self._boot, 3),
+            }
+            if window_s is not None:
+                window_s = float(window_s)
+                lo = now - window_s
+                win = dict.fromkeys(FIELDS, 0.0)
+                for bucket, vals in (self._windows.get(tenant) or {}).items():
+                    # a bucket [b, b+BUCKET_S) counts when it overlaps
+                    # [lo, now] — coarse by design (BUCKET_S resolution)
+                    if bucket + self.BUCKET_S > lo:
+                        for k, v in vals.items():
+                            win[k] += v
+                out["window"] = {
+                    "seconds": window_s,
+                    # honest-truncation marker: the ring retains
+                    # MAX_BUCKETS x BUCKET_S of history and nothing
+                    # predates boot — a window wider than either is only
+                    # COVERED this far back (the caller must never read
+                    # a truncated sum as the full window)
+                    "coverage_seconds": round(min(
+                        window_s,
+                        self.MAX_BUCKETS * self.BUCKET_S,
+                        max(now - self._boot, 0.0),
+                    ), 3),
+                    **{k: _tidy(v) for k, v in win.items()},
+                }
+        return out
+
+    @classmethod
+    def horizon_s(cls) -> float:
+        """The windowed view's retention: requests beyond this cannot be
+        answered from the ring (use the self-scraped horaedb_tenant_*
+        series for longer ranges)."""
+        return float(cls.MAX_BUCKETS * cls.BUCKET_S)
+
+    def reset(self) -> None:
+        """Forget the ledger (tests). The Prometheus counters are NOT
+        reset — they are monotone by contract."""
+        with self._lock:
+            self._totals.clear()
+            self._windows.clear()
+            self._boot = self._clock()
+
+
+def _tidy(v: float):
+    return int(v) if float(v).is_integer() else round(v, 6)
+
+
+GLOBAL_METER = UsageMeter()

@@ -1,0 +1,257 @@
+"""SLO burn-rate templates: declarative `[[metric_engine.slo]]` blocks
+expanded into PR 11 recording + alert rules.
+
+The multi-window multi-burn-rate pattern (Google SRE workbook ch. 5): an
+SLO names an `errors` counter and a `total` counter (instant selectors
+over the SELF-SCRAPED `horaedb_*` series telemetry/collector.py
+materializes); each configured burn pair (short window, long window,
+burn factor) expands into
+
+- one recording rule per distinct window:
+      slo:<name>:error_ratio_<w> =
+          sum(rate(<errors>[w])) / sum(rate(<total>[w]))
+  (materialized as first-class series — dashboards plot the error ratio
+  directly, and the alert reads the MATERIALIZED series, so a burn-rate
+  evaluation costs two index lookups, not two raw scans);
+
+- one alert rule per pair:
+      (short_ratio > factor * budget) and (long_ratio > factor * budget)
+  where budget = 1 - objective. The short window makes the alert fast to
+  fire AND fast to resolve; the long window keeps a brief spike from
+  paging; the factor scales threshold to how fast the error budget is
+  actually burning.
+
+The expansion produces plain rule dicts for rules.rule_from_dict — the
+rules engine owns registration, durability, exactly-once transitions,
+and the admission tenant; this module is pure template math. Expansion
+is deterministic, so boot-time re-registration is idempotent (an
+unchanged SLO keeps its rules' watermarks and alert states).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common.error import ensure
+from horaedb_tpu.common.time_ext import ReadableDuration
+
+__all__ = ["SloSpec", "BurnWindow", "expand_slo", "expand_slos"]
+
+# the workbook's canonical pairs: page on a fast burn, ticket on a slow one
+DEFAULT_BURN = (("5m", "1h", 14.4), ("30m", "6h", 6.0))
+
+_NAME_SAFE_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_METRIC_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+
+
+def _safe(name: str) -> str:
+    return _NAME_SAFE_RE.sub("_", str(name))
+
+
+def _num(x: float) -> str:
+    """Positional decimal for PromQL exprs (no scientific notation)."""
+    s = f"{x:.12f}".rstrip("0").rstrip(".")
+    return s or "0"
+
+
+def _dur_str(v) -> str:
+    """Normalize a duration to the string spelled in rule names/exprs
+    (validates it parses; "5m" stays "5m")."""
+    ReadableDuration.parse(v if isinstance(v, str) else str(v))
+    return str(v)
+
+
+def _instant_selector(expr: str, what: str) -> str:
+    """The errors/total fields must be INSTANT selectors (the template
+    appends the burn windows itself)."""
+    from horaedb_tpu.promql import Selector, parse
+
+    node = parse(str(expr))
+    ensure(
+        isinstance(node, Selector) and node.range_ms is None
+        and node.offset_ms == 0,
+        f"slo {what} must be an instant selector (the template appends "
+        f"[window] itself), got {expr!r}",
+    )
+    return str(expr)
+
+
+def _burn_entry(b, slo: str) -> tuple:
+    """Normalize one burn entry — `{short, long, factor}` table or
+    `[short, long, factor]` array — to (str, str, float) with a CONFIG
+    error on any malformed shape (a raw TypeError at boot names no
+    knob)."""
+    if isinstance(b, dict):
+        unknown = set(b) - {"short", "long", "factor"}
+        ensure(not unknown,
+               f"slo {slo}: unknown burn keys {sorted(unknown)}")
+        missing = [k for k in ("short", "long", "factor") if b.get(k) is None]
+        ensure(not missing,
+               f"slo {slo}: burn entry missing {missing} "
+               f"(str(None) would otherwise fail later as a duration "
+               f"naming no knob)")
+        vals = (b["short"], b["long"], b["factor"])
+    else:
+        ensure(isinstance(b, (list, tuple)) and len(b) == 3,
+               f"slo {slo}: burn entry must be a {{short, long, factor}} "
+               f"table or a 3-element array, got {b!r}")
+        vals = tuple(b)
+    try:
+        return (str(vals[0]), str(vals[1]), float(vals[2]))
+    except (TypeError, ValueError):
+        ensure(False,
+               f"slo {slo}: burn entry needs short/long durations and a "
+               f"numeric factor, got {b!r}")
+
+
+@dataclass(frozen=True)
+class BurnWindow:
+    short: str
+    long: str
+    factor: float
+
+    def validate(self, slo: str) -> "BurnWindow":
+        s = ReadableDuration.parse(self.short).as_millis()
+        lo = ReadableDuration.parse(self.long).as_millis()
+        ensure(s > 0 and lo > s,
+               f"slo {slo}: burn window must have short < long "
+               f"({self.short!r} vs {self.long!r})")
+        ensure(self.factor > 0,
+               f"slo {slo}: burn factor must be > 0")
+        return self
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One `[[metric_engine.slo]]` block (validated)."""
+
+    name: str
+    objective: float            # good fraction, e.g. 0.999
+    errors: str                 # instant selector: the bad-event counter
+    total: str                  # instant selector: the all-event counter
+    interval: str = "1m"        # recording-rule grid
+    for_duration: str = "0s"    # alert for-duration (config key: `for`)
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+    burn: tuple = DEFAULT_BURN
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SloSpec":
+        ensure(isinstance(d, dict), "slo entry must be a table")
+        known = {"name", "objective", "errors", "total", "interval",
+                 "for", "labels", "annotations", "burn"}
+        unknown = set(d) - known
+        ensure(not unknown, f"unknown slo keys: {sorted(unknown)}")
+        for req in ("name", "objective", "errors", "total"):
+            ensure(req in d, f"slo needs {req!r}")
+        burn = d.get("burn")
+        if burn:
+            pairs = tuple(_burn_entry(b, str(d["name"])) for b in burn)
+        else:
+            pairs = DEFAULT_BURN
+        return cls(
+            name=str(d["name"]),
+            objective=float(d["objective"]),
+            errors=str(d["errors"]),
+            total=str(d["total"]),
+            interval=str(d.get("interval", "1m")),
+            for_duration=str(d.get("for", "0s")),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            burn=pairs,
+        ).validate()
+
+    def validate(self) -> "SloSpec":
+        ensure(bool(_METRIC_RE.match(_safe(self.name))),
+               f"invalid slo name {self.name!r}")
+        ensure(0.0 < self.objective < 1.0,
+               f"slo {self.name}: objective must be in (0, 1), got "
+               f"{self.objective}")
+        _instant_selector(self.errors, f"{self.name}.errors")
+        _instant_selector(self.total, f"{self.name}.total")
+        _dur_str(self.interval)
+        _dur_str(self.for_duration)
+        ensure(len(self.burn) > 0, f"slo {self.name}: needs >=1 burn pair")
+        for b in self.burn:
+            BurnWindow(*b).validate(self.name)
+        return self
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+    def windows(self) -> list[str]:
+        seen: list[str] = []
+        for short, long_, _f in self.burn:
+            for w in (short, long_):
+                if w not in seen:
+                    seen.append(w)
+        return seen
+
+    def ratio_metric(self, window: str) -> str:
+        return f"slo:{_safe(self.name)}:error_ratio_{_safe(window)}"
+
+    def alert_name(self, short: str, long_: str) -> str:
+        return (f"SLOBurn_{_safe(self.name)}_{_safe(short)}_"
+                f"{_safe(long_)}")
+
+
+def expand_slo(spec: SloSpec) -> list[dict]:
+    """One validated spec -> rule dicts (recording first: the alerts
+    read the materialized ratio series)."""
+    out: list[dict] = []
+    for w in spec.windows():
+        out.append({
+            "kind": "recording",
+            "name": spec.ratio_metric(w),
+            "expr": (f"sum(rate({spec.errors}[{w}])) / "
+                     f"sum(rate({spec.total}[{w}]))"),
+            "interval": spec.interval,
+            "labels": {"slo": _safe(spec.name)},
+        })
+    for short, long_, factor in spec.burn:
+        # decimal-positional formatting: the PromQL tokenizer's NUMBER
+        # grammar has no scientific notation, and repr(1.44e-05) would
+        # emit exactly that
+        threshold = _num(float(factor) * spec.budget)
+        out.append({
+            "kind": "alert",
+            "name": spec.alert_name(short, long_),
+            "expr": (f"({spec.ratio_metric(short)} > {threshold}) and "
+                     f"({spec.ratio_metric(long_)} > {threshold})"),
+            "for": spec.for_duration,
+            "labels": {
+                "slo": _safe(spec.name),
+                "short_window": str(short),
+                "long_window": str(long_),
+                **{str(k): str(v) for k, v in spec.labels.items()},
+            },
+            "annotations": {
+                "summary": (
+                    f"SLO {spec.name} burning error budget at >"
+                    f"{factor}x (objective {spec.objective:g}; error "
+                    f"ratio above {threshold} over both {short} and "
+                    f"{long_})"
+                ),
+                "runbook": "docs/operations.md#self-telemetry--slos",
+                **{str(k): str(v) for k, v in spec.annotations.items()},
+            },
+        })
+    return out
+
+
+def expand_slos(raw: list) -> list[dict]:
+    """Validate + expand every `[[metric_engine.slo]]` block; duplicate
+    SLO names reject loudly (their rules would silently overwrite each
+    other by name)."""
+    seen: set[str] = set()
+    out: list[dict] = []
+    for entry in raw or ():
+        spec = entry if isinstance(entry, SloSpec) else \
+            SloSpec.from_dict(entry)
+        key = _safe(spec.name)
+        ensure(key not in seen, f"duplicate slo name {spec.name!r}")
+        seen.add(key)
+        out.extend(expand_slo(spec))
+    return out

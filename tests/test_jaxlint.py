@@ -910,3 +910,78 @@ class TestJ014FunnelSubscribers:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ015MeteringFunnel:
+    """J015: per-tenant accounting goes through telemetry/metering.py —
+    a horaedb_tenant_* family, a `tenant` labelname, or a legacy name
+    embedding a tenant label registered anywhere else forks the usage
+    ledger."""
+
+    def seeded(self, tmp_path, body, rel="server/billing.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_tenant_family_outside_funnel_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def reg(m):\n"
+            "    return m.counter('horaedb_tenant_writes_total')\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J015" in r.stdout and "metering funnel" in r.stdout
+
+    def test_tenant_labelname_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def reg(m):\n"
+            "    return m.gauge('horaedb_active', labelnames=('tenant',))\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J015" in r.stdout
+
+    def test_legacy_string_tenant_label_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def bump(METRICS, t):\n"
+            "    METRICS.inc('horaedb_rows_total{tenant=\"acme\"}')\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J015" in r.stdout
+
+    def test_funnel_module_exempt(self, tmp_path):
+        body = (
+            "def reg(m):\n"
+            "    return m.counter('horaedb_tenant_writes_total',\n"
+            "                     labelnames=('tenant',))\n"
+        )
+        f = self.seeded(tmp_path, body, rel="telemetry/metering.py")
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_untenanted_families_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def reg(m):\n"
+            "    c = m.counter('horaedb_writes_total',\n"
+            "                  labelnames=('table',))\n"
+            "    m.inc('horaedb_rows_total{table=\"data\"}')\n"
+            "    return c\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def reg(m):\n"
+            "    # jaxlint: disable=J015 bench harness measuring the funnel itself\n"
+            "    return m.counter('horaedb_tenant_bench_total')\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

@@ -304,3 +304,126 @@ class TestPromcheckValidator:
         m.histogram("c_seconds").observe(0.1)
         m.inc('legacy_total{k="v"}')
         assert not promcheck.validate(m.render()), promcheck.validate(m.render())
+
+
+class TestOpenMetrics:
+    """OpenMetrics exposition (render_openmetrics): # EOF terminator,
+    counter suffix handling, exemplar placement, and agreement with the
+    OpenMetrics validator (tools/promcheck.py --openmetrics)."""
+
+    def make(self) -> Metrics:
+        m = Metrics()
+        c = m.counter("om_reqs_total", help="requests",
+                      labelnames=("route",))
+        c.labels("/q").inc(3)
+        m.gauge("om_inflight", help="g").set(2)
+        m.histogram("om_lat_seconds", help="h",
+                    buckets=(0.1, 1.0)).observe(0.05)
+        return m
+
+    def test_eof_and_counter_family_naming(self):
+        out = self.make().render_openmetrics()
+        assert out.endswith("# EOF\n")
+        assert out.count("# EOF") == 1
+        # counter family drops _total; the sample keeps it
+        assert "# TYPE om_reqs counter" in out
+        assert 'om_reqs_total{route="/q"} 3' in out
+        assert "# TYPE om_reqs_total" not in out
+        assert not promcheck.validate_openmetrics(out), \
+            promcheck.validate_openmetrics(out)
+
+    def test_classic_render_unchanged_by_exemplars(self):
+        """The Prometheus text format never carries exemplars (they are
+        an OpenMetrics construct)."""
+        from horaedb_tpu.server import metrics as metrics_mod
+
+        m = Metrics()
+        h = m.histogram("om_ex_seconds", buckets=(1.0,), exemplars=True)
+        metrics_mod.set_exemplar_source(lambda: "feedbeef")
+        try:
+            h.observe(0.5)
+        finally:
+            metrics_mod.set_exemplar_source(None)
+        classic = m.render()
+        assert "feedbeef" not in classic
+        assert not promcheck.validate(classic)
+        om = m.render_openmetrics()
+        assert '# {trace_id="feedbeef"} 0.5' in om
+        assert not promcheck.validate_openmetrics(om)
+
+    def test_exemplar_lands_in_the_observed_bucket(self):
+        from horaedb_tpu.server import metrics as metrics_mod
+
+        m = Metrics()
+        h = m.histogram("om_b_seconds", buckets=(0.1, 1.0), exemplars=True)
+        metrics_mod.set_exemplar_source(lambda: "t1")
+        try:
+            h.observe(0.5)   # second bucket (0.1 < v <= 1.0)
+        finally:
+            metrics_mod.set_exemplar_source(None)
+        out = m.render_openmetrics()
+        lines = [ln for ln in out.splitlines() if "om_b_seconds_bucket" in ln]
+        assert len(lines) == 3
+        assert "trace_id" not in lines[0]
+        assert 'le="1"} 1 # {trace_id="t1"} 0.5' in lines[1]
+
+    def test_no_exemplars_without_source_or_flag(self):
+        from horaedb_tpu.server import metrics as metrics_mod
+
+        m = Metrics()
+        plain = m.histogram("om_p_seconds", buckets=(1.0,))
+        flagged = m.histogram("om_f_seconds", buckets=(1.0,),
+                              exemplars=True)
+        plain.observe(0.5)
+        flagged.observe(0.5)  # no source wired in this registry's scope
+        metrics_mod.set_exemplar_source(lambda: None)  # traceless request
+        try:
+            flagged.observe(0.7)
+        finally:
+            metrics_mod.set_exemplar_source(None)
+        assert "# {" not in m.render_openmetrics()
+
+    def test_snapshot_matches_render(self):
+        """snapshot_samples is the collector's source of truth: every
+        rendered sample line appears in the snapshot with the same
+        labels and value."""
+        m = self.make()
+        snap = {
+            (sample, key): v
+            for _f, _t, sample, key, v in m.snapshot_samples()
+        }
+        # 1 counter child + 1 gauge + histogram (3 buckets, sum, count)
+        assert len(snap) == 7
+        assert snap[("om_reqs_total", (("route", "/q"),))] == 3.0
+        assert snap[("om_lat_seconds_bucket", (("le", "+Inf"),))] == 1.0
+        assert snap[("om_lat_seconds_sum", ())] == 0.05
+
+    def test_validator_rejects_bad_openmetrics(self):
+        good = self.make().render_openmetrics()
+        assert promcheck.validate_openmetrics(
+            good.replace("# EOF\n", ""))
+        assert promcheck.validate_openmetrics(
+            good + "# EOF\n")  # two EOFs
+        # exemplar on a gauge
+        bad = good.replace(
+            "om_inflight 2", 'om_inflight 2 # {trace_id="x"} 2 1.0')
+        assert any("exemplar" in e
+                   for e in promcheck.validate_openmetrics(bad))
+        # counter sample not spelled _total
+        bad2 = good.replace('om_reqs_total{route="/q"} 3',
+                            'om_reqs{route="/q"} 3')
+        assert promcheck.validate_openmetrics(bad2)
+        # structural checks ride the OpenMetrics mode too: duplicate
+        # sample, missing +Inf bucket, non-cumulative counts
+        dup = good.replace("om_inflight 2", "om_inflight 2\nom_inflight 3")
+        assert any("duplicate" in e
+                   for e in promcheck.validate_openmetrics(dup))
+        no_inf = "\n".join(
+            ln for ln in good.splitlines() if 'le="+Inf"' not in ln
+        ) + "\n"
+        assert any("+Inf" in e
+                   for e in promcheck.validate_openmetrics(no_inf))
+        noncum = good.replace('om_lat_seconds_bucket{le="1"} 1',
+                              'om_lat_seconds_bucket{le="1"} 0')
+        assert any("cumulative" in e
+                   for e in promcheck.validate_openmetrics(noncum))

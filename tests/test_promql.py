@@ -225,13 +225,100 @@ class TestEvaluator:
         await eng.close()
 
     @async_test
-    async def test_vector_vector_arith_rejected(self):
+    async def test_vector_vector_arith_one_to_one(self):
+        """Vector-vector arithmetic matches one-to-one on the exact
+        __name__-stripped label set; the result drops __name__; unmatched
+        sides drop; duplicate label sets (many-to-one) reject loudly."""
         eng = await new_engine()
-        ev = RangeEvaluator(eng, BASE, BASE + 60_000, 60_000)
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        single = await ev.eval(parse('reqs{host="web-1"}'))
+        doubled = await ev.eval(parse('reqs{host="web-1"} + reqs'))
+        assert len(doubled) == 1
+        assert "__name__" not in doubled[0].labels
+        assert doubled[0].labels["host"] == "web-1"
+        np.testing.assert_array_equal(doubled[0].values, single[0].values * 2)
+        # ratio of two aggregates (the SLO error-ratio shape): both sides
+        # collapse to the empty label set -> one matched series of 1.0s
+        ratio = await ev.eval(parse(
+            "sum(sum_over_time(reqs[1m])) / sum(sum_over_time(reqs[1m]))"
+        ))
+        assert len(ratio) == 1 and ratio[0].labels == {}
+        finite = ratio[0].values[~np.isnan(ratio[0].values)]
+        assert len(finite) > 0 and np.all(finite == 1.0)
+        # many-to-one: label_replace collapses hosts into duplicate label
+        # sets on one side -> rejected, never silently merged
         with pytest.raises(PromQLError):
-            await ev.eval(parse("reqs + reqs"))
+            await ev.eval(parse(
+                'label_replace(reqs, "host", "x", "host", ".*") + reqs'
+            ))
         with pytest.raises(PromQLError):
             await ev.eval(parse("sum(2)"))
+        await eng.close()
+
+    @async_test
+    async def test_comparison_filters(self):
+        """Filter comparisons: failing steps drop to NaN, all-NaN series
+        drop entirely, labels (incl. __name__) survive."""
+        eng = await new_engine()
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        # values are host*1000 + i: `> 2000` keeps hosts 2 and 3 only
+        out = await ev.eval(parse("reqs > 2000"))
+        hosts = sorted(s.labels["host"] for s in out)
+        assert hosts == ["web-2", "web-3"]
+        assert all(s.labels["__name__"] == "reqs" for s in out)
+        for s in out:
+            finite = s.values[~np.isnan(s.values)]
+            assert np.all(finite > 2000)
+        # scalar OP vector keeps the vector side
+        flipped = await ev.eval(parse("2000 < reqs"))
+        assert sorted(s.labels["host"] for s in flipped) == hosts
+        # vector cmp vector: self-comparison keeps everything
+        self_cmp = await ev.eval(parse("reqs >= reqs"))
+        assert len(self_cmp) == 4
+        # scalar-scalar needs the (unsupported) bool modifier
+        with pytest.raises(PromQLError):
+            await ev.eval(parse("1 > 2"))
+        await eng.close()
+
+    @async_test
+    async def test_set_operators(self):
+        eng = await new_engine()
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        # and: intersect on the label set — only hosts also > 2000
+        out = await ev.eval(parse("reqs and (reqs > 2000)"))
+        assert sorted(s.labels["host"] for s in out) == ["web-2", "web-3"]
+        # unless: the complement (threshold below host-2's minimum value
+        # of 2000, so no per-step partial survival muddies the set)
+        out = await ev.eval(parse("reqs unless (reqs > 1999)"))
+        assert sorted(s.labels["host"] for s in out) == ["web-0", "web-1"]
+        # or: union, left wins matched steps
+        out = await ev.eval(parse(
+            'reqs{host="web-0"} or reqs{host="web-3"}'
+        ))
+        assert sorted(s.labels["host"] for s in out) == ["web-0", "web-3"]
+        with pytest.raises(PromQLError):
+            await ev.eval(parse("reqs and 3"))
+        await eng.close()
+
+    @async_test
+    async def test_multiwindow_burn_shape(self):
+        """The SLO template's alert shape — `(short > t) and (long > t)`
+        over two ratio expressions — evaluates end to end."""
+        eng = await new_engine()
+        end = BASE + 39 * 15_000
+        ev = RangeEvaluator(eng, BASE, end, 60_000)
+        out = await ev.eval(parse(
+            "(sum(sum_over_time(reqs[1m])) / sum(sum_over_time(reqs[2m])))"
+            " > 0.1 and "
+            "(sum(sum_over_time(reqs[2m])) / sum(sum_over_time(reqs[5m])))"
+            " > 0.1"
+        ))
+        assert len(out) == 1
+        finite = out[0].values[~np.isnan(out[0].values)]
+        assert len(finite) > 0 and np.all(finite > 0.1)
         await eng.close()
 
     @async_test

@@ -1,0 +1,580 @@
+"""Self-telemetry pipeline (horaedb_tpu/telemetry): the self-scrape
+collector writes the registry through the NORMAL ingest path and PromQL
+range queries return values BIT-EQUAL to the registry snapshots; the
+per-tenant metering funnel's ledger matches what was accounted; feedback
+safety (bounded cardinality, budget drops, no rule self-re-evaluation);
+the SLO template expansion; and the HORAEDB_TELEMETRY=off kill switch."""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.engine import MetricEngine
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.server.metrics import Metrics
+from horaedb_tpu.telemetry import SloSpec, expand_slo, expand_slos
+from horaedb_tpu.telemetry.collector import SelfScrapeCollector
+from horaedb_tpu.telemetry.metering import FIELDS, UsageMeter
+from tests.conftest import async_test
+
+BASE = 1_700_000_000_000
+STEP = 15_000
+
+
+def private_registry() -> Metrics:
+    """A hermetic registry: a labeled counter, a gauge, and a small
+    histogram — every family shape the converter must explode."""
+    reg = Metrics()
+    reg.counter("tel_reqs_total", help="r", labelnames=("route",))
+    reg.gauge("tel_inflight", help="g")
+    reg.histogram("tel_lat_seconds", help="h", buckets=(0.1, 1.0))
+    return reg
+
+
+async def open_collector(reg: Metrics, clock_box: list, **kw):
+    eng = await MetricEngine.open("tel", MemStore(), enable_compaction=False)
+    col = SelfScrapeCollector(
+        eng, registry=reg, clock=lambda: clock_box[0],
+        meter=UsageMeter(), **kw,
+    )
+    return eng, col
+
+
+class TestUsageMeter:
+    def test_account_summary_and_window(self):
+        clock = [1000.0]
+        m = UsageMeter(clock=lambda: clock[0])
+        m.account("acme", rows_ingested=10, queue_wait_seconds=0.5)
+        clock[0] = 1200.0
+        m.account("acme", rows_ingested=5, sheds=1)
+        s = m.summary("acme", window_s=60)
+        assert s["since_boot"]["rows_ingested"] == 15
+        assert s["since_boot"]["queue_wait_seconds"] == 0.5
+        assert s["since_boot"]["sheds"] == 1
+        # the 60 s window covers only the second event
+        assert s["window"]["rows_ingested"] == 5
+        assert s["window"]["queue_wait_seconds"] == 0
+        # coverage marker: uptime (200 s) < the requested window is the
+        # truncation the caller must see; a huge window clamps to the
+        # ring horizon
+        assert s["window"]["coverage_seconds"] == 60
+        wide = m.summary("acme", window_s=7 * 86_400)
+        assert wide["window"]["coverage_seconds"] == 200.0
+        # unknown tenant: zeros, never an error
+        z = m.summary("ghost")
+        assert all(z["since_boot"][f] == 0 for f in FIELDS)
+
+    def test_unknown_field_rejected(self):
+        m = UsageMeter()
+        with pytest.raises(ValueError):
+            m.account("t", bytes_scaned=1)  # typo must not meter nothing
+
+    def test_tenant_overflow_folds(self):
+        m = UsageMeter()
+        m.MAX_TENANTS = 3
+        for i in range(5):
+            m.account(f"t{i}", queries=1)
+        assert len(m.tenants()) <= 4  # 3 real + _overflow
+        assert m.summary(m.OVERFLOW)["since_boot"]["queries"] == 2
+
+    def test_window_ring_bounded(self):
+        clock = [0.0]
+        m = UsageMeter(clock=lambda: clock[0])
+        for i in range(m.MAX_BUCKETS + 50):
+            clock[0] = i * m.BUCKET_S
+            m.account("t", rows_ingested=1)
+        assert len(m._windows["t"]) <= m.MAX_BUCKETS
+        # since-boot totals never forget
+        assert m.summary("t")["since_boot"]["rows_ingested"] \
+            == m.MAX_BUCKETS + 50
+
+
+class TestBitEquality:
+    @async_test
+    async def test_range_query_bit_equal_to_snapshots(self):
+        """The acceptance property, seeded-random over 5 ticks: every
+        sample the collector wrote comes back from a PromQL range query
+        at the tick grid BIT-EQUAL to the registry snapshot of that
+        tick — counters, gauges, and exploded histogram series alike."""
+        from horaedb_tpu.promql.eval import evaluate_range
+
+        reg = private_registry()
+        c = reg.get("tel_reqs_total")
+        g = reg.get("tel_inflight")
+        h = reg.get("tel_lat_seconds")
+        clock = [BASE]
+        eng, col = await open_collector(reg, clock)
+        rng = np.random.default_rng(42)
+        snaps = []
+        try:
+            for k in range(5):
+                c.labels("/query").inc(float(rng.uniform(0, 10)))
+                c.labels("/write").inc(float(rng.integers(1, 100)))
+                g.set(float(rng.normal()))
+                h.observe(float(rng.uniform(0, 2)))
+                clock[0] = BASE + k * STEP
+                s = await col.tick()
+                assert not s.get("error") and s["dropped"] == 0
+                snaps.append((s["ts_ms"], {
+                    (n, key): v for n, key, v in s["samples_list"]
+                }))
+            # distinct series: 2 counter children + 1 gauge + histogram
+            # (3 buckets incl +Inf, _sum, _count) = 8, constant
+            assert s["series"] == 8
+            end = BASE + 4 * STEP
+            checked = 0
+            for (name, key), _v in snaps[0][1].items():
+                sel = name if not key else (
+                    name + "{" + ",".join(
+                        f'{k2}="{v2}"' for k2, v2 in key) + "}"
+                )
+                steps, series = await evaluate_range(
+                    eng, sel, BASE, end, STEP,
+                )
+                assert len(series) == 1, sel
+                vals = series[0].values
+                for i, (ts, snap) in enumerate(snaps):
+                    assert int(steps[i]) == ts
+                    assert vals[i] == snap[(name, key)], (sel, i)
+                    checked += 1
+            assert checked == 8 * 5
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_histogram_le_labels_survive_round_trip(self):
+        reg = private_registry()
+        reg.get("tel_lat_seconds").observe(0.05)
+        clock = [BASE]
+        eng, col = await open_collector(reg, clock)
+        try:
+            await col.tick()
+            from horaedb_tpu.promql.eval import evaluate_range
+
+            _steps, series = await evaluate_range(
+                eng, 'tel_lat_seconds_bucket{le="+Inf"}', BASE, BASE, STEP,
+            )
+            assert len(series) == 1
+            assert series[0].values[0] == 1.0
+        finally:
+            await eng.close()
+
+
+class TestFeedbackSafety:
+    @async_test
+    async def test_cardinality_pinned_across_ticks(self):
+        """N ticks emit the SAME series set: cardinality is pinned after
+        the first tick (the no-self-amplification invariant)."""
+        reg = private_registry()
+        reg.get("tel_reqs_total").labels("/a").inc()
+        clock = [BASE]
+        eng, col = await open_collector(reg, clock)
+        try:
+            first = await col.tick()
+            for k in range(1, 6):
+                clock[0] = BASE + k * STEP
+                s = await col.tick()
+                assert s["series"] == first["series"]
+                assert s["dropped"] == 0
+            # the engine agrees: one registered series per emitted series
+            total = sum(
+                eng.series_count(n.encode())
+                for n in {x[0] for x in first["samples_list"]}
+            )
+            assert total == first["series"]
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_series_budget_drops_and_holds(self):
+        reg = private_registry()
+        clock = [BASE]
+        eng, col = await open_collector(reg, clock, max_series=3)
+        try:
+            s1 = await col.tick()
+            assert s1["series"] == 3
+            assert s1["dropped"] > 0
+            clock[0] = BASE + STEP
+            s2 = await col.tick()
+            # the SAME 3 series keep flowing; the same overflow drops
+            assert s2["series"] == 3
+            assert s2["dropped"] == s1["dropped"]
+            assert s2["written"] == 3
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_failed_write_does_not_consume_the_budget(self):
+        """A failed tick must not leave phantom series charged against
+        max_series (they were never emitted)."""
+        class _DeadEngine:
+            async def write_payload(self, payload):
+                raise RuntimeError("store down")
+
+        reg = private_registry()
+        reg.get("tel_inflight").set(1)
+        col = SelfScrapeCollector(
+            _DeadEngine(), registry=reg, clock=lambda: BASE,
+            meter=UsageMeter(), max_series=4,
+        )
+        s = await col.tick()
+        assert s.get("error") is True
+        assert col._series == set() and s["series"] == 0
+        # recovery on a healthy engine uses the full budget
+        eng, col2 = await open_collector(reg, [BASE], max_series=4)
+        col2._series = col._series
+        try:
+            s2 = await col2.tick()
+            assert s2["series"] == 4 and s2["written"] == 4
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_scrape_dirties_rules_once_not_forever(self):
+        """An SLO-shaped recording rule over a self-scraped series
+        re-evaluates after a scrape tick (new data) but a SECOND rule
+        tick with no scrape in between is a no-op — the rule's own
+        write-back never re-dirties it (the self-invalidation guard)."""
+        from horaedb_tpu.rules import rule_from_dict
+        from horaedb_tpu.rules.engine import RuleEngine
+
+        reg = private_registry()
+        reg.get("tel_reqs_total").labels("/a").inc(5)
+        clock = [BASE]
+        store = MemStore()
+        eng = await MetricEngine.open("tel", store, enable_compaction=False)
+        col = SelfScrapeCollector(
+            eng, registry=reg, clock=lambda: clock[0], meter=UsageMeter(),
+        )
+        rules = await RuleEngine.open(eng, store, root="tel/rules")
+        try:
+            await rules.register(rule_from_dict({
+                "kind": "recording", "name": "slo:tel:reqs_1m",
+                "expr": "sum(rate(tel_reqs_total[1m]))",
+                "interval": "1m", "since_ms": BASE,
+            }, now_ms=BASE))
+            await col.tick()
+            s1 = await rules.tick(now_ms=BASE + 60_000)
+            assert s1["errors"] == 0 and s1["evaluated"] == 1
+            # no scrape between: the rule's own output must not re-dirty
+            s2 = await rules.tick(now_ms=BASE + 60_000)
+            assert s2["evaluated"] == 0 and s2["skipped"] == 1
+            # a new scrape IS new data: the rule evaluates again
+            clock[0] = BASE + STEP
+            reg.get("tel_reqs_total").labels("/a").inc(3)
+            await col.tick()
+            s3 = await rules.tick(now_ms=BASE + 61_000)
+            assert s3["errors"] == 0 and s3["evaluated"] == 1
+        finally:
+            await rules.close()
+            await eng.close()
+
+    @async_test
+    async def test_retention_sweep_tombstones_old_self_series(self):
+        reg = private_registry()
+        reg.get("tel_inflight").set(7)
+        clock = [BASE]
+        eng, col = await open_collector(
+            reg, clock, retention_ms=10 * 60_000,
+        )
+        try:
+            # a FOREIGN series under the same name (another agent
+            # remote-writing into this engine, no instance="self" label):
+            # the sweep must never touch it
+            from horaedb_tpu.pb import remote_write_pb2
+
+            req = remote_write_pb2.WriteRequest()
+            ts = req.timeseries.add()
+            for k, v in ((b"__name__", b"tel_inflight"),
+                         (b"instance", b"other-agent")):
+                lab = ts.labels.add()
+                lab.name = k
+                lab.value = v
+            smp = ts.samples.add()
+            smp.timestamp = BASE
+            smp.value = 99.0
+            await eng.write_payload(req.SerializeToString())
+            await col.tick()
+            # jump far past the horizon + sweep spacing; the next tick
+            # sweeps and the old SELF sample disappears from queries
+            clock[0] = BASE + 60 * 60_000
+            await col.tick()
+            assert col._swept_hi_ms == clock[0] - col.retention_ms
+            from horaedb_tpu.promql.eval import evaluate_range
+
+            _s, series = await evaluate_range(
+                eng, 'tel_inflight{instance="self"}', BASE, BASE, STEP,
+            )
+            vals = [sv for sv in series
+                    if not np.isnan(sv.values).all()]
+            assert vals == []
+            # the foreign same-named series survives the sweep untouched
+            _s, series = await evaluate_range(
+                eng, 'tel_inflight{instance="other-agent"}',
+                BASE, BASE, STEP,
+            )
+            assert len(series) == 1 and series[0].values[0] == 99.0
+            # the fresh self sample (inside the horizon) survives
+            _s, series = await evaluate_range(
+                eng, 'tel_inflight{instance="self"}',
+                clock[0], clock[0], STEP,
+            )
+            assert len(series) == 1
+            # delta discipline: a third tick just past the next spacing
+            # only sweeps (prev horizon, new horizon) — swept_hi advances
+            # monotonically, no re-tombstoning of [0, prev)
+            tombs_after_full = sum(
+                len(sub.data_table.manifest.all_tombstones())
+                for sub in eng.sub_engines().values()
+            )
+            clock[0] += 2 * 60_000
+            await col.tick()
+            assert col._swept_hi_ms == clock[0] - col.retention_ms
+            tombs_after_delta = sum(
+                len(sub.data_table.manifest.all_tombstones())
+                for sub in eng.sub_engines().values()
+            )
+            # one delete per written name per sweep, never more
+            assert tombs_after_delta - tombs_after_full \
+                <= len(col._written_names)
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_sweep_failure_never_poisons_a_landed_tick(self):
+        """The sweep is housekeeping: a failing delete_series must not
+        mark a tick whose WRITE landed as an error (the data flowed)."""
+        reg = private_registry()
+        reg.get("tel_inflight").set(1)
+        clock = [BASE]
+        eng, col = await open_collector(
+            reg, clock, retention_ms=10 * 60_000,
+        )
+        orig = eng.delete_series
+
+        async def boom(*a, **kw):
+            raise RuntimeError("store down")
+
+        try:
+            await col.tick()
+            eng.delete_series = boom
+            clock[0] = BASE + 60 * 60_000
+            s = await col.tick()
+            assert s.get("error") is None and s["written"] > 0
+            assert s.get("sweep_error") is True
+            assert col._swept_hi_ms == 0  # not advanced: retried later
+            eng.delete_series = orig
+            clock[0] += 10 * 60_000
+            s2 = await col.tick()
+            assert s2.get("sweep_error") is None
+            assert col._swept_hi_ms == clock[0] - col.retention_ms
+        finally:
+            eng.delete_series = orig
+            await eng.close()
+
+    @async_test
+    async def test_env_kill_switch(self, tmp_path, monkeypatch):
+        """HORAEDB_TELEMETRY=off: no collector, no loop, 501 on the
+        forced-scrape admin endpoint — cleanly disabled, not half-on."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu import telemetry as T
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import STATE_KEY, build_app
+
+        monkeypatch.setenv("HORAEDB_TELEMETRY", "off")
+        assert T.telemetry_enabled(True) is False
+        cfg = Config.from_toml(f"""
+port = 0
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+""")
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            assert app[STATE_KEY].telemetry is None
+            assert not any(
+                t.get_name() == "telemetry-scrape"
+                for t in app[STATE_KEY].write_workers
+            )
+            r = await client.post("/api/v1/telemetry/scrape")
+            assert r.status == 501
+        finally:
+            await client.close()
+
+
+class TestUsageEndpoint:
+    @async_test
+    async def test_usage_tracks_issued_requests(self, tmp_path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import build_app
+        from horaedb_tpu.telemetry.metering import GLOBAL_METER
+        from tests.test_engine import make_remote_write
+
+        cfg = Config.from_toml(f"""
+port = 0
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+""")
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        GLOBAL_METER.reset()
+        try:
+            payload = make_remote_write([
+                ({"__name__": "um", "host": "a"}, [(1000, 1.0)]),
+                ({"__name__": "um", "host": "b"}, [(2000, 2.0)]),
+            ])
+            r = await client.post("/api/v1/write", data=payload,
+                                  headers={"X-Horaedb-Tenant": "acme"})
+            assert r.status == 200
+            r = await client.post("/api/v1/query", json={
+                "metric": "um", "start_ms": 0, "end_ms": 10_000,
+            }, headers={"X-Horaedb-Tenant": "acme"})
+            assert r.status == 200
+            r = await client.get("/api/v1/usage?tenant=acme&window=60")
+            body = await r.json()
+            boot = body["data"]["since_boot"]
+            assert boot["rows_ingested"] == 2
+            assert boot["queries"] == 1
+            assert boot["bytes_scanned"] > 0
+            assert body["data"]["window"]["rows_ingested"] == 2
+            # a post-scan PromQL error still meters the bytes the
+            # failed evaluation scanned (many-to-one rejects AFTER
+            # both operands were read)
+            r = await client.get(
+                "/api/v1/query_range",
+                params={"query": 'label_replace(um, "host", "x", '
+                                 '"host", ".*") + um',
+                        "start": "0", "end": "10", "step": "10"},
+                headers={"X-Horaedb-Tenant": "acme"})
+            assert r.status == 400
+            r = await client.get("/api/v1/usage?tenant=acme")
+            boot2 = (await r.json())["data"]["since_boot"]
+            assert boot2["bytes_scanned"] > boot["bytes_scanned"]
+            # the window cannot exceed the ring horizon: the clamp is
+            # visible in the echoed seconds
+            r = await client.get("/api/v1/usage?tenant=acme&window=2d")
+            win = (await r.json())["data"]["window"]
+            from horaedb_tpu.telemetry.metering import UsageMeter
+
+            assert win["seconds"] == UsageMeter.horizon_s()
+            # listing view names the tenant
+            r = await client.get("/api/v1/usage")
+            tenants = {t["tenant"]
+                       for t in (await r.json())["data"]["tenants"]}
+            assert "acme" in tenants
+            # malformed window: 400, not a 500 — including the non-finite
+            # values the shared admission parser exists to reject
+            for bad in ("bogus", "nan", "inf", "-5"):
+                r = await client.get(
+                    f"/api/v1/usage?tenant=acme&window={bad}"
+                )
+                assert r.status == 400, bad
+        finally:
+            await client.close()
+
+    @async_test
+    async def test_forced_scrape_failure_is_503(self, tmp_path):
+        """The forced tick is an operator probe: a failed write must
+        answer 5xx, never a 200 with the failure buried in the body."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import STATE_KEY, build_app
+
+        cfg = Config.from_toml(f"""
+port = 0
+[metric_engine.storage.object_store]
+type = "Local"
+data_dir = "{tmp_path}/data"
+""")
+        app = await build_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            class _FailingCollector:
+                async def tick(self):
+                    return {"error": True, "written": 0}
+
+            app[STATE_KEY].telemetry = _FailingCollector()
+            r = await client.post("/api/v1/telemetry/scrape")
+            assert r.status == 503
+            body = await r.json()
+            assert body["status"] == "error"
+        finally:
+            await client.close()
+
+
+class TestSloTemplates:
+    def test_expansion_shape(self):
+        spec = SloSpec.from_dict({
+            "name": "read-latency", "objective": 0.99,
+            "errors": 'tel_slow_total', "total": 'tel_reqs_total',
+            "burn": [{"short": "5m", "long": "1h", "factor": 14.4}],
+            "for": "2m", "labels": {"severity": "page"},
+        })
+        rules = expand_slo(spec)
+        kinds = [r["kind"] for r in rules]
+        assert kinds == ["recording", "recording", "alert"]
+        rec5, rec1h, alert = rules
+        assert rec5["name"] == "slo:read_latency:error_ratio_5m"
+        assert "rate(tel_slow_total[5m])" in rec5["expr"]
+        assert "rate(tel_reqs_total[1h])" in rec1h["expr"]
+        # threshold = 14.4 * 0.01, spelled positionally (no sci-notation)
+        assert "> 0.144" in alert["expr"]
+        assert "and" in alert["expr"]
+        assert alert["labels"]["severity"] == "page"
+        assert alert["for"] == "2m"
+        # every expansion validates as a registrable rule
+        from horaedb_tpu.rules import rule_from_dict
+
+        for r in rules:
+            rule_from_dict(dict(r), now_ms=BASE)
+
+    def test_validation_rejects_garbage(self):
+        base = {"name": "x", "objective": 0.99,
+                "errors": "e_total", "total": "t_total"}
+        with pytest.raises(HoraeError):
+            SloSpec.from_dict({**base, "objective": 1.5})
+        with pytest.raises(HoraeError):
+            SloSpec.from_dict({**base, "errors": "rate(e_total[5m])"})
+        with pytest.raises(HoraeError):
+            SloSpec.from_dict({
+                **base,
+                "burn": [{"short": "1h", "long": "5m", "factor": 2}],
+            })
+        with pytest.raises(HoraeError):
+            SloSpec.from_dict({**base, "bogus_key": 1})
+        with pytest.raises(HoraeError):
+            expand_slos([base, base])  # duplicate name
+        # malformed burn shapes fail with a CONFIG error, not a raw
+        # TypeError at boot; array-shaped entries coerce like tables
+        with pytest.raises(HoraeError):
+            SloSpec.from_dict({**base, "burn": [["5m", "1h"]]})
+        with pytest.raises(HoraeError):
+            SloSpec.from_dict(
+                {**base, "burn": [{"short": "5m", "long": "1h",
+                                   "factor": "fast"}]})
+        ok = SloSpec.from_dict(
+            {**base, "burn": [["5m", "1h", "14.4"]]})
+        assert ok.burn == (("5m", "1h", 14.4),)
+        # missing keys fail with the slo named, never a str(None)
+        # duration error downstream
+        with pytest.raises(HoraeError, match="missing"):
+            SloSpec.from_dict({**base, "burn": [{"factor": 2}]})
+
+    def test_default_burn_pairs(self):
+        spec = SloSpec.from_dict({
+            "name": "d", "objective": 0.999,
+            "errors": "e_total", "total": "t_total",
+        })
+        rules = expand_slo(spec)
+        # 4 distinct windows (5m/1h/30m/6h) + 2 alerts
+        assert len([r for r in rules if r["kind"] == "recording"]) == 4
+        assert len([r for r in rules if r["kind"] == "alert"]) == 2

@@ -1,0 +1,126 @@
+"""Chaos lane for the SLO burn-rate pipeline: sustained query sheds
+under a FAULTED object store drive the self-scraped shed counter, the
+burn-rate recording rules, and the alert state machine — the alert must
+transition to firing EXACTLY ONCE through the fenced checkpoint
+(including across a crash/reopen mid-breach) and recover to inactive
+once the sheds stop and the windows drain."""
+
+import numpy as np
+
+from horaedb_tpu.engine import MetricEngine
+from horaedb_tpu.objstore import MemStore
+from horaedb_tpu.objstore.chaos import ChaosStore, FaultPlan, OpFaults
+from horaedb_tpu.objstore.resilient import ResilientStore
+from horaedb_tpu.rules import rule_from_dict
+from horaedb_tpu.rules.engine import RuleEngine
+from horaedb_tpu.server.metrics import Metrics
+from horaedb_tpu.telemetry import SloSpec, expand_slo
+from horaedb_tpu.telemetry.collector import SelfScrapeCollector
+from horaedb_tpu.telemetry.metering import UsageMeter
+from tests.conftest import async_test
+
+BASE = 1_700_000_000_000
+TICK = 15_000  # scrape + rule tick spacing (ms)
+
+SLO = SloSpec.from_dict({
+    "name": "shed", "objective": 0.99,
+    "errors": "horaedb_query_shed_total",
+    "total": "horaedb_http_requests_total",
+    "interval": "15s",
+    "burn": [{"short": "1m", "long": "5m", "factor": 2.0}],
+    "labels": {"severity": "page"},
+})
+ALERT = SLO.alert_name("1m", "5m")
+
+
+def shed_registry() -> Metrics:
+    """Private registry mirroring the real shed/request families (the
+    global one would leak other tests' traffic into the rates)."""
+    reg = Metrics()
+    reg.counter("horaedb_query_shed_total", help="sheds",
+                labelnames=("reason",))
+    reg.counter("horaedb_http_requests_total", help="reqs")
+    return reg
+
+
+class TestBurnRateChaos:
+    @async_test
+    async def test_fires_exactly_once_and_recovers(self):
+        # faulted store: seeded injected errors on the hot verbs, fully
+        # absorbed by the resilient wrapper's retries — the fenced
+        # checkpoint path must stay exactly-once THROUGH the faults
+        chaos = ChaosStore(MemStore(), FaultPlan(seed=11, ops={
+            "put": OpFaults(error_rate=0.08),
+            "get": OpFaults(error_rate=0.08),
+            "list": OpFaults(error_rate=0.05),
+        }))
+        store = ResilientStore(chaos, name="telchaos")
+        eng = await MetricEngine.open("tc", store, enable_compaction=False)
+        reg = shed_registry()
+        clock = [BASE]
+        col = SelfScrapeCollector(
+            eng, registry=reg, clock=lambda: clock[0], meter=UsageMeter(),
+        )
+        rules = await RuleEngine.open(eng, store, root="tc/rules")
+        now = BASE
+
+        async def advance(n_ticks: int, shedding: bool):
+            nonlocal now, rules
+            for _ in range(n_ticks):
+                now += TICK
+                clock[0] = now
+                reg.get("horaedb_http_requests_total").inc(20)
+                if shedding:
+                    reg.get("horaedb_query_shed_total").labels(
+                        "queue_full").inc(10)
+                s = await col.tick()
+                assert not s.get("error"), s
+                ts = await rules.tick(now_ms=now)
+                assert ts["errors"] == 0, ts
+
+        try:
+            for entry in expand_slo(SLO):
+                await rules.register(rule_from_dict(
+                    dict(entry), now_ms=BASE,
+                ))
+            # -- quiet warmup: no sheds, alert stays inactive ---------------
+            await advance(6, shedding=False)
+            assert rules.transitions(ALERT) == []
+            # -- sustained breach: 6 simulated minutes of sheds -------------
+            await advance(24, shedding=True)
+            log = rules.transitions(ALERT)
+            firings = [t for t in log if t["to"] == "firing"]
+            assert len(firings) == 1, log
+            assert [a for a in rules.alerts()
+                    if a["labels"]["alertname"] == ALERT
+                    and a["state"] == "firing"]
+            # -- crash/reopen MID-BREACH: the durable checkpoint owns the
+            # transition; re-derivation must not double-fire
+            await rules.close()
+            rules = await RuleEngine.open(eng, store, root="tc/rules")
+            await advance(4, shedding=True)
+            log = rules.transitions(ALERT)
+            assert len([t for t in log if t["to"] == "firing"]) == 1, log
+            # -- recovery: sheds stop; once the 5m window drains the ratio
+            # drops below threshold and the alert resolves — once
+            await advance(28, shedding=False)
+            log = rules.transitions(ALERT)
+            assert len([t for t in log if t["to"] == "firing"]) == 1, log
+            resolves = [t for t in log
+                        if t["from"] == "firing" and t["to"] == "inactive"]
+            assert len(resolves) == 1, log
+            assert not [a for a in rules.alerts()
+                        if a["labels"]["alertname"] == ALERT]
+            # the materialized burn-rate series is itself queryable and
+            # ends at ~zero (the dashboards' view of the recovery)
+            from horaedb_tpu.promql.eval import evaluate_range
+
+            _s, series = await evaluate_range(
+                eng, SLO.ratio_metric("1m"), now - 60_000, now, TICK,
+            )
+            assert series, "burn-rate series not materialized"
+            tail = series[0].values[~np.isnan(series[0].values)]
+            assert tail.size and tail[-1] < 0.02
+        finally:
+            await rules.close()
+            await eng.close()

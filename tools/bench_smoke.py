@@ -27,7 +27,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # script execution: tools/ is sys.path[0]
     sys.path.insert(0, REPO)
-BUDGET_S = 180  # hard kill; the soft target is <120 s
+BUDGET_S = 240  # hard kill; the soft target is <150 s
 
 
 def main() -> int:
@@ -218,6 +218,26 @@ def main() -> int:
         check(hr is not None and hr > 0.5,
               f"rule_storm: alert rules not riding the result cache "
               f"(hit rate {hr})")
+        # self-telemetry lane (horaedb_tpu/telemetry): the monitor's own
+        # cost — a real tick measured, and the steady-state duty cycle
+        # (tick wall / default 15 s interval) inside the <2% ingest
+        # overhead budget the acceptance bar pins. The interleaved-A/B
+        # overhead is reported but not asserted (box-noise territory).
+        st = result.get("self_telemetry") or {}
+        check(st.get("families", 0) > 20,
+              f"self_telemetry lane missing/implausible: {st}")
+        check(st.get("samples_per_tick", 0) > 100,
+              f"self_telemetry: snapshot too small: {st}")
+        check(st.get("snapshot_ns_per_family", 0) > 0,
+              "self_telemetry: snapshot cost missing")
+        check(st.get("tick_ms", 0) > 0, "self_telemetry: tick cost missing")
+        duty = st.get("duty_pct_at_default_interval")
+        check(duty is not None and 0 < duty < 2.0,
+              f"self_telemetry: steady-state duty cycle out of the <2% "
+              f"budget: {duty}")
+        check(st.get("ingest_base_samples_per_sec", 0) > 0
+              and st.get("ingest_with_scrape_samples_per_sec", 0) > 0,
+              f"self_telemetry: ingest A/B missing: {st}")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")
@@ -226,12 +246,12 @@ def main() -> int:
                 json.load(open(cache_file, encoding="utf-8"))
             except ValueError:
                 failures.append("calibration cache is not valid JSON")
-        # budget grew 60 -> 120 s when the query_serving lane joined:
-        # the pre-existing lanes alone measured 57-80 s on the loaded
-        # 2-core bench box (high contention variance); the gate exists to
+        # budget grew 60 -> 120 s when the query_serving lane joined and
+        # 120 -> 150 s when the self_telemetry lane did (118 s measured
+        # with it on the loaded 2-core bench box); the gate exists to
         # catch runaway regressions, not 20% box noise
-        check(elapsed < 120,
-              f"smoke bench took {elapsed:.0f}s (budget 120s)")
+        check(elapsed < 150,
+              f"smoke bench took {elapsed:.0f}s (budget 150s)")
         if failures:
             for f in failures:
                 print(f"bench-smoke: FAIL {f}")

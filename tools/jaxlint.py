@@ -111,6 +111,14 @@ Rules (see docs/static-analysis.md for rationale and examples):
         is a second standing-query engine growing outside the audited
         one — consume the rule engine's dirty sets instead, or suppress
         with the reason
+  J015  ad-hoc per-tenant accounting outside the metering funnel
+        (horaedb_tpu/telemetry/): registering a `horaedb_tenant_*`
+        metric family, a family with a `tenant` labelname, or a legacy
+        string-API call embedding a `tenant="..."` label anywhere else
+        forks the usage ledger — /metrics, /api/v1/usage, and any future
+        billing export would disagree about what a tenant consumed.
+        Account through telemetry.metering.GLOBAL_METER.account(...), or
+        suppress with the reason
   J009  naked object-store construction outside objstore/: a concrete
         store (`MemStore`/`LocalStore`/`S3LikeStore`) built in engine
         code without being handed straight to a `ResilientStore(...)`
@@ -296,6 +304,13 @@ J014_EXEMPT = (
     "horaedb_tpu/rules/",
 )
 FUNNEL_SUBSCRIBE_FUNCS = {"serving_subscribe", "serving_unsubscribe"}
+
+# J015: the per-tenant usage funnel (telemetry/metering.py). Tenant
+# accounting registered anywhere else forks the ledger.
+J015_MODULES = ("horaedb_tpu/",)
+J015_EXEMPT = ("horaedb_tpu/telemetry/",)
+METRIC_REGISTER_VERBS = {"counter", "gauge", "histogram"}
+TENANT_FAMILY_PREFIX = "horaedb_tenant_"
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
 STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
@@ -969,6 +984,65 @@ def _check_funnel_subscribers(tree: ast.Module,
             ))
 
 
+def _check_metering_funnel(tree: ast.Module, findings: list[Finding]) -> None:
+    """J015: per-tenant accounting goes through telemetry/metering.py —
+    three prongs: (1) a metric family registered under the reserved
+    `horaedb_tenant_*` namespace; (2) a family registered with a
+    `tenant` labelname; (3) a legacy string-API name literal embedding a
+    `tenant="..."` label."""
+    def _str_const(node):
+        return node.value if (isinstance(node, ast.Constant)
+                              and isinstance(node.value, str)) else None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        name_arg = None
+        if node.args:
+            name_arg = _str_const(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "name" and name_arg is None:
+                name_arg = _str_const(kw.value)
+        if f.attr in METRIC_REGISTER_VERBS:
+            if name_arg and name_arg.startswith(TENANT_FAMILY_PREFIX):
+                findings.append(Finding(
+                    node.lineno, "J015",
+                    f"metric family {name_arg!r} registered outside the "
+                    "metering funnel (horaedb_tpu/telemetry/) — the "
+                    "horaedb_tenant_* namespace is the usage ledger's; "
+                    "account through telemetry.metering.GLOBAL_METER, or "
+                    "suppress with the reason",
+                ))
+                continue
+            for kw in node.keywords:
+                if kw.arg != "labelnames":
+                    continue
+                if isinstance(kw.value, (ast.Tuple, ast.List)) and any(
+                    _str_const(e) == "tenant" for e in kw.value.elts
+                ):
+                    findings.append(Finding(
+                        node.lineno, "J015",
+                        "metric family registered with a `tenant` "
+                        "labelname outside the metering funnel — ad-hoc "
+                        "per-tenant series fork the usage ledger; route "
+                        "the accounting through telemetry.metering."
+                        "GLOBAL_METER, or suppress with the reason",
+                    ))
+        elif f.attr in ("inc", "set") and node.args:
+            legacy = _str_const(node.args[0])
+            if legacy and "tenant=\"" in legacy:
+                findings.append(Finding(
+                    node.lineno, "J015",
+                    f"legacy metric name {legacy!r} embeds a tenant "
+                    "label outside the metering funnel; route through "
+                    "telemetry.metering.GLOBAL_METER, or suppress with "
+                    "the reason",
+                ))
+
+
 def _check_visibility_boundary(tree: ast.Module, findings: list[Finding]) -> None:
     """J010: attribute access on the visibility state's row-filtering
     fields (`.tombstones`, `.retention_floor_ms`) outside the shared
@@ -1212,6 +1286,13 @@ def lint_file(path: Path) -> list[str]:
         (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
         for m in J014_EXEMPT
     )
+    in_j015_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J015_MODULES
+    ) and not any(
+        (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
+        for m in J015_EXEMPT
+    )
 
     idx = JitIndex()
     idx.visit(tree)
@@ -1243,6 +1324,8 @@ def lint_file(path: Path) -> list[str]:
         _check_serving_funnel(tree, findings, j013_reads, j013_writes)
     if in_j014_scope:
         _check_funnel_subscribers(tree, findings)
+    if in_j015_scope:
+        _check_metering_funnel(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

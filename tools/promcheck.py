@@ -20,9 +20,23 @@ Checks, per https://prometheus.io/docs/instrumenting/exposition_formats/:
   the `+Inf` bucket;
 - no duplicate sample (same name + label set).
 
+OpenMetrics mode (`validate_openmetrics`, auto-detected by a `# EOF`
+line or forced with --openmetrics): the exposition served under
+`Accept: application/openmetrics-text` —
+- the body MUST end with exactly one `# EOF` line (a truncated scrape is
+  indistinguishable from a complete one without it);
+- counter samples spell `<family>_total` with `# TYPE <family> counter`
+  (the family name drops the suffix);
+- exemplars (` # {labels} value [timestamp]`) are allowed ONLY on
+  histogram `_bucket` samples and counter `_total` samples — an exemplar
+  on a gauge/unknown/`_sum`/`_count` line is a violation;
+- exemplar label sets parse with the escaped-label grammar and stay
+  within the spec's 128-rune budget; exemplar values parse as floats.
+
 Usage:
     python tools/promcheck.py [file]      # file or stdin
-    from tools.promcheck import validate  # -> list[str] of violations
+    python tools/promcheck.py --openmetrics [file]
+    from tools.promcheck import validate, validate_openmetrics
 """
 
 from __future__ import annotations
@@ -190,16 +204,169 @@ def validate(text: str) -> list[str]:
     return errors
 
 
+# ---------------------------------------------------------------------------
+# OpenMetrics mode
+# ---------------------------------------------------------------------------
+
+# sample line with an optional exemplar tail: the base grammar plus
+# ` # {labels} value [timestamp]`
+OM_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*?)\})?"
+    r"\s+(?P<value>\S+)"
+    r"(?:\s+(?P<ts>-?\d+(?:\.\d+)?))?"
+    r"(?:\s+#\s+\{(?P<exlabels>.*)\}\s+(?P<exvalue>\S+)"
+    r"(?:\s+(?P<exts>-?\d+(?:\.\d+)?))?)?\s*$"
+)
+OM_EXEMPLAR_TYPES = ("histogram", "counter")
+OM_EXEMPLAR_RUNE_BUDGET = 128
+
+
+def _om_family(name: str, typed: dict) -> tuple[str, str | None]:
+    """Resolve an OpenMetrics sample name to its declared family:
+    counters drop `_total`, histograms drop `_bucket`/`_sum`/`_count`."""
+    if name in typed:
+        return name, typed[name]
+    for suf in ("_total",) + HISTOGRAM_SUFFIXES:
+        base = name[: -len(suf)] if name.endswith(suf) else None
+        if base and base in typed:
+            return base, typed[base]
+    return name, None
+
+
+def validate_openmetrics(text: str) -> list[str]:
+    """OpenMetrics-specific checks (module docstring) PLUS the
+    structural checks the classic validator enforces where the grammars
+    agree: no duplicate samples, histogram le bounds sorted, bucket
+    counts cumulative, a +Inf bucket per child, `_count` == +Inf."""
+    errors: list[str] = []
+    typed: dict[str, str] = {}
+    seen_samples: set[tuple] = set()
+    # family -> child label key (minus le) -> [(le, count)], and _count
+    buckets: dict[str, dict[tuple, list[tuple[float, float]]]] = {}
+    counts: dict[str, dict[tuple, float]] = {}
+    lines = text.split("\n")
+    # -- the EOF contract ----------------------------------------------------
+    stripped = [ln for ln in lines if ln.strip()]
+    if not stripped or stripped[-1].strip() != "# EOF":
+        errors.append("missing `# EOF` terminator as the final line")
+    eof_count = sum(1 for ln in stripped if ln.strip() == "# EOF")
+    if eof_count > 1:
+        errors.append(f"{eof_count} `# EOF` lines (must be exactly one, "
+                      "at the end)")
+    for i, line in enumerate(lines, 1):
+        def err(msg: str, i=i) -> None:
+            errors.append(f"line {i}: {msg}")
+
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                name = parts[2]
+                t = parts[3].strip() if len(parts) > 3 else ""
+                if name in typed:
+                    err(f"duplicate # TYPE for {name}")
+                typed[name] = t
+                if t == "counter" and name.endswith("_total"):
+                    err(f"counter family {name!r} must drop the _total "
+                        "suffix (the sample keeps it)")
+            continue
+        m = OM_SAMPLE_RE.match(line)
+        if m is None:
+            err(f"unparseable sample line: {line[:60]!r}")
+            continue
+        name = m.group("name")
+        value = _parse_value(m.group("value"))
+        if value is None:
+            err(f"unparseable value {m.group('value')!r} for {name}")
+        labels = (_parse_labels(m.group("labels"), err)
+                  if m.group("labels") else ())
+        if labels is not None:
+            skey = (name, labels)
+            if skey in seen_samples:
+                err(f"duplicate sample {name}{dict(labels)}")
+            seen_samples.add(skey)
+        family, ftype = _om_family(name, typed)
+        if ftype is None:
+            err(f"sample {name!r} has no preceding # TYPE")
+            continue
+        if ftype == "counter" and name != f"{family}_total":
+            err(f"counter sample {name!r} must be spelled "
+                f"{family}_total")
+        if ftype == "histogram" and labels is not None and value is not None:
+            child = tuple(p for p in labels if p[0] != "le")
+            if name.endswith("_bucket"):
+                le = dict(labels).get("le")
+                b = _parse_value(le) if le is not None else None
+                if b is None:
+                    err(f"{name}: missing/unparseable le {le!r}")
+                else:
+                    buckets.setdefault(family, {}).setdefault(
+                        child, []).append((b, value))
+            elif name.endswith("_count"):
+                counts.setdefault(family, {})[child] = value
+        if m.group("exlabels") is None:
+            continue
+        # -- exemplar checks -------------------------------------------------
+        ok_target = (
+            (ftype == "histogram" and name.endswith("_bucket"))
+            or (ftype == "counter" and name.endswith("_total"))
+        )
+        if not ok_target:
+            err(f"exemplar on {name!r} ({ftype}): exemplars are only "
+                "allowed on histogram _bucket and counter _total samples")
+        pairs = _parse_labels(m.group("exlabels"), err)
+        if pairs is not None:
+            runes = sum(len(k) + len(v) for k, v in pairs)
+            if runes > OM_EXEMPLAR_RUNE_BUDGET:
+                err(f"exemplar labelset on {name!r} is {runes} runes "
+                    f"(budget {OM_EXEMPLAR_RUNE_BUDGET})")
+        if _parse_value(m.group("exvalue")) is None:
+            err(f"unparseable exemplar value {m.group('exvalue')!r} "
+                f"on {name}")
+    # structural histogram checks (identical contract to the classic
+    # validator: sorted le, cumulative counts, +Inf present, _count ==
+    # the +Inf bucket)
+    for family, children in buckets.items():
+        for child, rows in children.items():
+            lbl = dict(child)
+            les = [b for b, _ in rows]
+            if les != sorted(les):
+                errors.append(f"{family}{lbl}: le bounds not sorted")
+            cum = [c for _, c in rows]
+            if any(later < earlier
+                   for earlier, later in zip(cum, cum[1:])):
+                errors.append(f"{family}{lbl}: bucket counts not "
+                              f"cumulative")
+            if not les or les[-1] != float("inf"):
+                errors.append(f"{family}{lbl}: missing +Inf bucket")
+            else:
+                total = counts.get(family, {}).get(child)
+                if total is not None and total != cum[-1]:
+                    errors.append(
+                        f"{family}{lbl}: _count {total} != +Inf bucket "
+                        f"{cum[-1]}"
+                    )
+    return errors
+
+
 def main() -> None:
-    if len(sys.argv) > 1:
-        with open(sys.argv[1], encoding="utf-8") as f:
+    args = [a for a in sys.argv[1:] if a != "--openmetrics"]
+    force_om = len(args) != len(sys.argv) - 1
+    if args:
+        with open(args[0], encoding="utf-8") as f:
             text = f.read()
     else:
         text = sys.stdin.read()
-    errors = validate(text)
+    openmetrics = force_om or any(
+        ln.strip() == "# EOF" for ln in text.split("\n")
+    )
+    errors = validate_openmetrics(text) if openmetrics else validate(text)
     for e in errors:
         print(e)
-    print(f"promcheck: {len(errors)} violation(s)")
+    mode = "openmetrics" if openmetrics else "text"
+    print(f"promcheck[{mode}]: {len(errors)} violation(s)")
     raise SystemExit(min(len(errors), 125))
 
 

@@ -33,7 +33,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from promcheck import validate  # noqa: E402
+from promcheck import validate, validate_openmetrics  # noqa: E402
 
 REQUIRED_FAMILIES = (
     "horaedb_scan_stage_seconds_bucket",
@@ -143,6 +143,26 @@ REQUIRED_FAMILIES = (
     "horaedb_rules_alert_transitions_total",
     'horaedb_rules_alert_transitions_total{transition="firing"',
     "horaedb_rules_alerts_active",
+    # self-telemetry pipeline (horaedb_tpu/telemetry): the per-tenant
+    # usage funnel's families carry the default tenant from the traffic
+    # above and `_system` from the forced self-scrape tick; the
+    # telemetry meta-families render from boot
+    "horaedb_tenant_rows_ingested_total",
+    'horaedb_tenant_rows_ingested_total{tenant="default"',
+    'horaedb_tenant_rows_ingested_total{tenant="_system"',
+    "horaedb_tenant_samples_rejected_total",
+    "horaedb_tenant_bytes_scanned_total",
+    'horaedb_tenant_bytes_scanned_total{tenant="default"',
+    "horaedb_tenant_queue_wait_seconds_total",
+    "horaedb_tenant_queries_total",
+    "horaedb_tenant_sheds_total",
+    "horaedb_tenant_deadline_exceeded_total",
+    "horaedb_telemetry_ticks_total",
+    'horaedb_telemetry_ticks_total{result="ok"',
+    "horaedb_telemetry_samples_total",
+    "horaedb_telemetry_series",
+    "horaedb_telemetry_dropped_series_total",
+    "horaedb_telemetry_scrape_seconds_bucket",
 )
 
 
@@ -277,6 +297,24 @@ async def run() -> int:
                 check(r.status == 200 and body.get("rows") == 3,
                       f"raw query answered: {body}")
                 check(bool(trace_id), "query echoed X-Horaedb-Trace-Id")
+            # ---- per-tenant usage metering: the ledger must match the
+            # requests THIS smoke actually issued so far — 3 + 160
+            # ingested samples, exactly 2 admitted queries, and a real
+            # bytes-scanned figure from the SST reads above
+            async with s.get(f"{base}/api/v1/usage?tenant=default"
+                             f"&window=5m") as r:
+                u = ((await r.json()).get("data") or {})
+                boot = u.get("since_boot") or {}
+                check(r.status == 200 and boot.get("rows_ingested") == 163,
+                      f"usage rows_ingested matches issued writes "
+                      f"(3+160): {boot}")
+                check(boot.get("queries") == 2,
+                      f"usage queries matches admitted queries: {boot}")
+                check(boot.get("bytes_scanned", 0) > 0,
+                      f"usage bytes_scanned moved: {boot}")
+                win = u.get("window") or {}
+                check(win.get("rows_ingested") == 163,
+                      f"windowed usage agrees since boot < window: {win}")
             async with s.post(f"{base}/api/v1/query?explain=1", json={
                 "metric": "smoke_cpu", "start_ms": 0, "end_ms": 4000,
                 "bucket_ms": 2000,
@@ -509,6 +547,89 @@ async def run() -> int:
             check(adm_ctl.inflight == 0,
                   f"admission slots all freed (inflight="
                   f"{adm_ctl.inflight})")
+            # ---- self-telemetry: a SECOND server over a fresh store
+            # (this one's 60-series cardinality cap would reject the
+            # ~400-series self-scrape) proves the closed loop: a forced
+            # scrape tick writes the registry through the ingest path,
+            # and a PromQL range query over the self-written series
+            # returns the snapshot BIT-EQUAL
+            tel_scratch = tempfile.mkdtemp(prefix="horaedb-smoke-tel-")
+            tel_cfg = Config.from_dict({
+                "metric_engine": {
+                    "storage": {"object_store": {
+                        "type": "Local", "data_dir": tel_scratch,
+                    }},
+                    "telemetry": {"scrape_interval": "1h"},
+                },
+            })
+            tel_app = await build_app(tel_cfg)
+            tel_runner = web.AppRunner(tel_app)
+            await tel_runner.setup()
+            tel_site = web.TCPSite(tel_runner, "127.0.0.1", 0)
+            await tel_site.start()
+            tel_port = tel_site._server.sockets[0].getsockname()[1]
+            tel = f"http://127.0.0.1:{tel_port}"
+            try:
+                fam = "horaedb_remote_write_samples_total"
+                async with s.post(
+                    f"{tel}/api/v1/telemetry/scrape?include={fam}"
+                ) as r:
+                    data = (await r.json()).get("data") or {}
+                    check(r.status == 200 and data.get("written", 0) > 100,
+                          f"forced self-scrape wrote the registry "
+                          f"({data.get('written')} samples)")
+                    check(data.get("dropped") == 0,
+                          f"no series dropped by the budget: {data}")
+                    matched = data.get("matched") or []
+                    check(len(matched) == 1,
+                          f"scrape echoed the {fam} snapshot: {matched}")
+                    snap_v = matched[0]["value"]
+                    ts_s = data["ts_ms"] / 1000.0
+                async with s.get(
+                    f"{tel}/api/v1/query_range?query={fam}"
+                    f"&start={ts_s}&end={ts_s}&step=15"
+                ) as r:
+                    body = await r.json()
+                    res = ((body.get("data") or {}).get("result") or [])
+                    check(r.status == 200 and len(res) == 1,
+                          f"range query over the self-series answered: "
+                          f"{body}")
+                    vals = res[0].get("values") or [] if res else []
+                    check(
+                        bool(vals) and float(vals[0][1]) == float(snap_v),
+                        f"self-scraped value BIT-EQUAL to the registry "
+                        f"snapshot ({vals[:1]} vs {snap_v})",
+                    )
+                async with s.get(f"{tel}/api/v1/usage?tenant=_system") as r:
+                    u = ((await r.json()).get("data") or {}).get(
+                        "since_boot") or {}
+                    check(u.get("rows_ingested", 0) > 100,
+                          f"_system tenant metered the scrape's rows: {u}")
+            finally:
+                await tel_runner.cleanup()
+                import shutil as _shutil
+
+                _shutil.rmtree(tel_scratch, ignore_errors=True)
+            # ---- OpenMetrics negotiation: # EOF-terminated, exemplar-
+            # carrying, and clean under the OpenMetrics validator
+            async with s.get(f"{base}/metrics", headers={
+                "Accept": "application/openmetrics-text",
+            }) as r:
+                om = await r.text()
+                check("openmetrics-text" in r.headers.get(
+                    "Content-Type", ""),
+                    f"openmetrics content type negotiated "
+                    f"({r.headers.get('Content-Type')!r})")
+                check(om.rstrip().endswith("# EOF"),
+                      "openmetrics body ends with # EOF")
+                check('# {trace_id="' in om,
+                      "openmetrics carries trace-id exemplars")
+                om_errors = validate_openmetrics(om)
+                for e in om_errors[:10]:
+                    print(f"FAIL promcheck[openmetrics]: {e}")
+                check(not om_errors,
+                      f"openmetrics body passes the validator "
+                      f"({len(om.splitlines())} lines)")
             async with s.get(f"{base}/metrics") as r:
                 text = await r.text()
         errors = validate(text)
