@@ -40,7 +40,9 @@ lint:
 # (J010), server query entries bypassing admission (J011), ad-hoc decode
 # of encoded SST lanes outside the sanctioned funnel (J012), serving-tier
 # funnel breaches (J013), unaudited invalidation-funnel subscribers
-# (J014), per-tenant accounting outside the metering funnel (J015).
+# (J014), per-tenant accounting outside the metering funnel (J015),
+# ad-hoc stacking/padding of query result lanes outside the query
+# batcher's stacked-execution funnel (J016).
 # Findings print as path:line: CODE message.
 # Rules + suppression syntax: docs/static-analysis.md
 jaxlint:

@@ -183,8 +183,17 @@ def query_qps_lane(smoke: bool) -> dict:
     (1/8/64 clients), QPS, p50/p99 latency, and the shed rate. The
     scheduler is sized small (cap 4, queue 16) so the 64-client level
     actually exercises shedding — the lane measures the DEGRADATION
-    contract (bounded latency + 503-class sheds), not just raw speed."""
+    contract (bounded latency + 503-class sheds), not just raw speed.
+
+    Grows the query-batching A/B (server/batching.py): the same closed
+    loop over DISTINCT same-shape panels (per-client rotating host
+    filters — the dashboard-of-N-panels traffic batching exists for),
+    run with coalescing on vs HORAEDB_BATCH=off, forced cold
+    (HORAEDB_SERVING=off) so every query real-scans and the window sees
+    exactly the expensive distinct shapes. Reports per level/arm p50/p99
+    + QPS, the batched_with mix, and measured pad waste."""
     import asyncio
+    import os
     import shutil
     import tempfile
 
@@ -193,6 +202,7 @@ def query_qps_lane(smoke: bool) -> dict:
     from horaedb_tpu.objstore import LocalStore
     from horaedb_tpu.pb import remote_write_pb2
     from horaedb_tpu.server.admission import AdmissionController, run_query
+    from horaedb_tpu.storage import scanstats
 
     n_series, n_samples = 100, 20
 
@@ -262,9 +272,105 @@ def query_qps_lane(smoke: bool) -> dict:
                     ) if lat else None,
                     "shed_pct": round(100.0 * sheds / total, 1) if total else 0.0,
                 }
+            out["batching"] = await batching_ab(eng, base)
         finally:
             await eng.close()
             shutil.rmtree(root, ignore_errors=True)
+        return out
+
+    async def batching_ab(eng, base: int) -> dict:
+        """The coalescing A/B: distinct same-shape panels, serving forced
+        cold, batching on vs HORAEDB_BATCH=off at each level."""
+        def panel(k: int) -> QueryRequest:
+            return QueryRequest(
+                metric=b"qps_cpu", start_ms=base,
+                end_ms=base + n_samples * 1000, bucket_ms=5000,
+                filters=[(b"host", f"host-{k % n_series:04d}".encode())],
+            )
+
+        saved = {k: os.environ.get(k)
+                 for k in ("HORAEDB_SERVING", "HORAEDB_BATCH")}
+        os.environ["HORAEDB_SERVING"] = "off"
+        out: dict[str, dict] = {}
+        wall = 0.35 if smoke else 1.5
+        try:
+            # warmup: compile the stacked shapes (and the solo pushdown's)
+            # outside the timed loops so the A/B measures steady state
+            os.environ["HORAEDB_BATCH"] = ""
+            for _ in range(3):
+                await asyncio.gather(
+                    *(eng.query(panel(k)) for k in range(8))
+                )
+            os.environ["HORAEDB_BATCH"] = "off"
+            await asyncio.gather(*(eng.query(panel(k)) for k in range(8)))
+            for clients in (1, 8, 64):
+                row: dict[str, dict] = {}
+                for arm in ("on", "off"):
+                    os.environ["HORAEDB_BATCH"] = "" if arm == "on" else "off"
+                    ctl = AdmissionController(
+                        max_concurrent=8, queue_max=max(16, clients),
+                        queue_deadline_s=2.0,
+                    )
+                    lat: list[float] = []
+                    sheds = 0
+                    mix: dict[str, int] = {}
+                    waste: list[int] = []
+                    t_end = time.perf_counter() + wall
+
+                    async def one_client(seed: int):
+                        nonlocal sheds
+                        i = 0
+                        while time.perf_counter() < t_end:
+                            req = panel(seed * 37 + i)
+                            i += 1
+                            t0 = time.perf_counter()
+                            try:
+                                with scanstats.scan_stats() as st:
+                                    await run_query(ctl, eng, req,
+                                                    cells=4)
+                            except UnavailableError:
+                                sheds += 1
+                                await asyncio.sleep(0.002)
+                                continue
+                            lat.append(time.perf_counter() - t0)
+                            bw = st.counts.get("batched_with")
+                            if bw:
+                                mix[str(bw)] = mix.get(str(bw), 0) + 1
+                            if "batch_pad_waste_pct" in st.counts:
+                                waste.append(
+                                    st.counts["batch_pad_waste_pct"]
+                                )
+                            await asyncio.sleep(0)
+
+                    t0 = time.perf_counter()
+                    await asyncio.gather(
+                        *(one_client(c) for c in range(clients))
+                    )
+                    elapsed = time.perf_counter() - t0
+                    lat.sort()
+                    row[arm] = {
+                        "qps": round(len(lat) / elapsed, 1),
+                        "p50_ms": round(lat[len(lat) // 2] * 1000, 3)
+                        if lat else None,
+                        "p99_ms": round(
+                            lat[max(0, int(len(lat) * 0.99) - 1)] * 1000, 3
+                        ) if lat else None,
+                        "shed_pct": round(
+                            100.0 * sheds / (len(lat) + sheds), 1
+                        ) if (lat or sheds) else 0.0,
+                        "batched_with_mix": dict(sorted(mix.items())),
+                    }
+                    if waste:
+                        row[arm]["pad_waste_pct_avg"] = round(
+                            sum(waste) / len(waste), 1
+                        )
+                out[str(clients)] = row
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         return out
 
     return {"query_qps": asyncio.run(run())}

@@ -32,6 +32,13 @@ run python bench.py
 # residency cache's pinned lanes are HBM handles, so this is where the
 # device-resident warm-scan rate (ROOFLINE §8's open question) lands
 run python -c "import json, bench; print(json.dumps({\"metric\": \"query_serving\", **bench.query_serving_lane(False)}))"
+# batching sweep (fifth lane, queued since PR 13): the coalescing A/B —
+# HORAEDB_BATCH on vs off at 1/8/64 clients with batched_with mix and
+# pad waste. On CPU the win is the shared union scan; on the real chip
+# the stacked launch additionally amortizes the ~95%-of-wall dispatch
+# overhead ROOFLINE §4 charges per query, so this is where the
+# full-size coalescing speedup lands
+run python -c "import json, bench; print(json.dumps({\"metric\": \"query_batching\", **bench.query_qps_lane(False)}))"
 run python benchmarks/run_baselines.py
 run python benchmarks/ingest_bench.py 2000
 run python benchmarks/query_bench.py 8000000

@@ -1082,7 +1082,30 @@ class SampleManager:
         (storage/rollup.py) fold bucket-count-scale pre-aggregated rows
         instead of scanning raw; everything else takes the device
         pushdown. `prov` collects the provenance a cached entry replays
-        on later hits."""
+        on later hits.
+
+        Only cache MISSES reach here, so this is the query batcher's
+        dispatch point (server/batching.py): a grid query with compatible
+        concurrent company coalesces into ONE stacked kernel launch.
+        Eligibility is decided HERE because only this layer knows the
+        segment layout and the rollup plan:
+
+        - grid/segment alignment (bucket_ms divides the segment duration
+          AND rng.start is bucket-aligned) guarantees no bucket spans a
+          segment boundary, so every cell accumulates rows of exactly one
+          segment — the condition under which the batched single-stream
+          reduction is bit-exact vs the solo per-segment partial fold
+          (unaligned grids could differ in float association on
+          cancelling data, so they run solo);
+        - a non-empty rollup plan means the solo pushdown folds
+          bucket-count-scale artifacts — far cheaper than the batched
+          lane's raw scan — so rollup-covered queries run solo too.
+
+        Everything else (lone queries, short deadlines, oversized
+        shapes, HORAEDB_BATCH=off) continues down the solo pushdown
+        unchanged."""
+        from horaedb_tpu.server import batching
+
         if prov is None:
             prov = {}
         # retention-pruned SST selection (storage.select_ssts notes
@@ -1097,11 +1120,112 @@ class SampleManager:
             return await self._query_downsample_materialized(
                 metric_id, tsids if filtered else None, rng, bucket_ms
             )
+        series_ids = np.asarray(sorted(tsids), dtype=np.uint64)
+        segments = self._storage.group_by_segment(ssts)
+        # Rollup substitution plan (storage/rollup.py): per segment, the
+        # coarsest aligned rollup whose freshness contract passes — the
+        # segment then costs a bucket-count-scale artifact read instead
+        # of a raw scan. Planning is pure manifest state; a failure
+        # degrades to all-raw, never an error. Computed BEFORE the
+        # batching decision: rollup-covered queries must not trade the
+        # artifact fold for the batched lane's raw scan.
+        plan: dict = {}
+        if serving is not None and serving.rollups_active:
+            from horaedb_tpu.storage import rollup as rollup_mod
+
+            try:
+                plan = rollup_mod.plan_rollups(
+                    self._storage, segments, rng, rng.start, bucket_ms
+                )
+            except Exception:  # noqa: BLE001 — raw is always available
+                logger.warning("rollup planning failed; scanning raw",
+                               exc_info=True)
+                plan = {}
+        batcher = batching.GLOBAL_BATCHER
+        aligned = (
+            self._segment_duration % bucket_ms == 0
+            and rng.start % bucket_ms == 0
+        )
+        if not aligned or plan:
+            batcher.note_ineligible()
+            return await self._query_downsample_pushdown(
+                metric_id, series_ids, ssts, segments, plan, rng,
+                bucket_ms, num_buckets, filtered, prov,
+            )
+        tok = batcher.begin()
+        try:
+            res = await batcher.coalesce(
+                bucket_ms=bucket_ms, num_buckets=num_buckets,
+                series_ids=series_ids, t0=rng.start, filtered=filtered,
+                # same-(table, metric, range) members share ONE union
+                # scan — the N-panels-one-dashboard case pays one read
+                share_key=(self._table_id, metric_id, rng.start, rng.end),
+                scan=lambda ids: self._batch_scan_rows(metric_id, rng, ids),
+            )
+            if res is not batching.SOLO:
+                grids, notes = res
+                for k, n in (notes or {}).items():
+                    if k == "batched_with":
+                        scanstats.note_max(k, n)
+                    else:
+                        scanstats.note(k, n)
+                    # cache replay must not claim a stacked launch on a
+                    # later HIT — batch provenance stays out of `prov`
+                    if not k.startswith(("batched_", "batch_")):
+                        prov[k] = prov.get(k, 0) + n
+                if grids is None:
+                    return None
+                return [int(x) for x in series_ids], grids
+            return await self._query_downsample_pushdown(
+                metric_id, series_ids, ssts, segments, plan, rng,
+                bucket_ms, num_buckets, filtered, prov,
+            )
+        finally:
+            batcher.end(tok)
+
+    async def _batch_scan_rows(
+        self,
+        metric_id: int,
+        rng: TimeRange,
+        tsids: "list[int] | None",
+    ):
+        """One batch scan's row materialization (runs in the group's
+        detached context): the same merged/deduped/visibility-masked rows
+        a solo scan sees, as flat (ts i64, tsid u64, values f64) lanes —
+        or None when nothing is in range. `tsids` may be the UNION of
+        several members' series sets (batching.py de-multiplexes rows
+        per member afterwards); None scans the whole metric."""
+        table = await self._query_raw_cold(metric_id, tsids, rng)
+        if table is None or table.num_rows == 0:
+            return None
+        return (
+            table.column("ts").to_numpy().astype(np.int64, copy=False),
+            table.column("tsid").to_numpy(),
+            table.column("value").to_numpy().astype(np.float64, copy=False),
+        )
+
+    async def _query_downsample_pushdown(
+        self,
+        metric_id: int,
+        series_ids: np.ndarray,
+        ssts: list,
+        segments: list,
+        plan: dict,
+        rng: TimeRange,
+        bucket_ms: int,
+        num_buckets: int,
+        filtered: bool,
+        prov: "dict | None" = None,
+    ) -> tuple[list[int], dict[str, np.ndarray]] | None:
+        """The solo per-segment device pushdown (the batcher's oracle).
+        `segments`/`plan` come precomputed from the cold entry — the
+        rollup plan now also feeds the batching eligibility decision."""
+        if prov is None:
+            prov = {}
         # EXPLAIN provenance: how many SSTs the time range selected (bloom
         # pruning and actual reads are noted per SST in storage/read.py)
         scanstats.note("ssts_selected", len(ssts))
         prov["ssts_selected"] = len(ssts)
-        series_ids = np.asarray(sorted(tsids), dtype=np.uint64)
         pred = self._predicate(
             metric_id, list(series_ids) if filtered else None, rng
         )
@@ -1117,25 +1241,6 @@ class SampleManager:
         if self._scan_sem is None:
             self._scan_sem = asyncio.Semaphore(SEGMENT_SCAN_CONCURRENCY)
         acc: dict[str, np.ndarray] | None = None
-
-        segments = self._storage.group_by_segment(ssts)
-        # Rollup substitution plan (storage/rollup.py): per segment, the
-        # coarsest aligned rollup whose freshness contract passes — the
-        # segment then costs a bucket-count-scale artifact read instead
-        # of a raw scan. Planning is pure manifest state; a failure
-        # degrades to all-raw, never an error.
-        plan: dict = {}
-        if serving is not None and serving.rollups_active:
-            from horaedb_tpu.storage import rollup as rollup_mod
-
-            try:
-                plan = rollup_mod.plan_rollups(
-                    self._storage, segments, rng, rng.start, bucket_ms
-                )
-            except Exception:  # noqa: BLE001 — raw is always available
-                logger.warning("rollup planning failed; scanning raw",
-                               exc_info=True)
-                plan = {}
 
         def fold(part) -> None:
             nonlocal acc

@@ -118,7 +118,17 @@ class CostModel:
       the measured mean compile seconds of the scan kernels.
 
     `estimate_s(None)` (raw/unsized queries) returns None — the gate
-    only prices the grid-shaped queries whose cost is predictable."""
+    only prices the grid-shaped queries whose cost is predictable.
+
+    Batched-sample attribution (server/batching.py): a query that rode a
+    stacked launch of N measured ~the GROUP's wall — observing that as a
+    solo sample would feed the EWMA N amortized walls per launch and
+    bias the cost gate optimistic for future SOLO queries. `observe`
+    with `batched_with=N > 1` therefore records cost/N into a SEPARATE
+    batched EWMA (observability: how cheap does coalescing make a cell)
+    and leaves the solo EWMA, the gate's input, and the compiled-shape
+    set untouched (the stacked kernel compiled a stacked shape, not this
+    query's solo shape)."""
 
     PER_CELL_SEED = 2e-8  # 50M cells/s
     MAX_SHAPES = 1024
@@ -126,6 +136,7 @@ class CostModel:
     def __init__(self, alpha: float = 0.2):
         self._alpha = float(alpha)
         self._per_cell = self.PER_CELL_SEED
+        self._per_cell_batched: float | None = None
         self._shapes: set[int] = set()
 
     @staticmethod
@@ -146,6 +157,13 @@ class CostModel:
     def per_cell_s(self) -> float:
         return self._per_cell
 
+    @property
+    def per_cell_batched_s(self) -> "float | None":
+        """Amortized per-cell seconds under stacked launches (None until
+        the first batched sample). Observability only — the admission
+        gate prices SOLO execution, the pessimistic bound."""
+        return self._per_cell_batched
+
     def estimate_s(self, cells: int | None) -> float | None:
         if not cells or cells <= 0:
             return None
@@ -154,10 +172,24 @@ class CostModel:
             est += self.compile_cost_s()
         return est
 
-    def observe(self, cells: int | None, seconds: float) -> None:
+    def observe(self, cells: int | None, seconds: float,
+                batched_with: int = 1) -> None:
         """Feed one finished query's measured wall (excluding queue wait)
-        back into the EWMA."""
+        back into the EWMA. `batched_with > 1` = the wall covers a
+        stacked launch shared by that many queries: the amortized share
+        (seconds / batched_with) feeds the batched EWMA only — the solo
+        EWMA and the compiled-shape set stay unpolluted (class
+        docstring)."""
         if not cells or cells <= 0 or seconds <= 0:
+            return
+        if batched_with > 1:
+            share = (seconds / batched_with) / cells
+            if self._per_cell_batched is None:
+                self._per_cell_batched = share
+            else:
+                self._per_cell_batched += self._alpha * (
+                    share - self._per_cell_batched
+                )
             return
         if len(self._shapes) >= self.MAX_SHAPES:
             self._shapes.clear()
@@ -206,8 +238,12 @@ class AdmissionSlot:
             if (
                 et is None and self.cells and self._t_run is not None
             ):
+                # stacked-launch attribution: batched_with rides the scan
+                # collector (server/batching.py notes it) — amortized
+                # samples must not pollute the solo EWMA the gate prices
                 self._ctl.cost_model.observe(
-                    self.cells, self._ctl._clock() - self._t_run
+                    self.cells, self._ctl._clock() - self._t_run,
+                    batched_with=scanstats.get_note("batched_with") or 1,
                 )
             self._ctl._do_release(self.tenant)
         if et is not None and issubclass(et, asyncio.CancelledError):

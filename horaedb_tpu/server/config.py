@@ -70,6 +70,13 @@ def _telemetry_mod():
     return telemetry
 
 
+def _batching_mod():
+    # deferred: batching registers the horaedb_batch_* families
+    from horaedb_tpu.server import batching
+
+    return batching
+
+
 @dataclass
 class TestConfig:
     """Self-write load generator (reference config.rs TestConfig)."""
@@ -226,6 +233,13 @@ class QueryConfig:
     # Weighted-fair shares per tenant (default weight 1.0):
     # [metric_engine.query.tenant_weights] dashboards = 2.0
     tenant_weights: dict = field(default_factory=dict)
+    # Query batcher ([metric_engine.query.batching], server/batching.py):
+    # compatible cache-MISS grid queries arriving within max_delay
+    # coalesce into ONE stacked kernel launch; HORAEDB_BATCH=off is the
+    # runtime honesty switch. See docs/operations.md "Query batching".
+    batching: object = field(
+        default_factory=lambda: _batching_mod().BatchingConfig()
+    )
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "QueryConfig":
@@ -476,6 +490,15 @@ class Config:
                 for v in q.tenant_weights.values()),
             "query.tenant_weights values must be positive numbers",
         )
+        b = q.batching
+        ensure(b.max_delay.seconds > 0,
+               "query.batching.max_delay must be positive")
+        ensure(b.max_group >= 2,
+               "query.batching.max_group must be >= 2 (a group of one "
+               "is the solo path; disable with batching.enabled=false)")
+        ensure(b.max_stacked_cells >= 1,
+               "query.batching.max_stacked_cells must be >= 1")
+        ensure(b.max_rows >= 1, "query.batching.max_rows must be >= 1")
         ensure(
             self.metric_engine.limits.max_series >= 0,
             "limits.max_series must be >= 0 (0 disables the limit)",

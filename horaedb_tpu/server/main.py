@@ -629,6 +629,22 @@ def _explain_payload(st, mode: str, admission_verdict: dict | None = None) -> di
         "blocks_resident": counts.get("blocks_resident", 0),
         "blocks_fetched": counts.get("blocks_fetched", 0),
     }
+    # query-batcher verdict (server/batching.py): how many compatible
+    # grid queries shared this query's stacked kernel launch (1 = ran
+    # solo; None = never reached the batching decision point, e.g. raw
+    # mode or a cache hit replay), the padded-buffer waste of that
+    # launch, the shape class it coalesced under, and the time spent
+    # holding in the coalescing window.
+    batch_classes = sorted(
+        k[len("batch_class_"):] for k in counts
+        if k.startswith("batch_class_")
+    )
+    batching_verdict = {
+        "batched_with": counts.get("batched_with"),
+        "pad_waste_pct": counts.get("batch_pad_waste_pct", 0),
+        "shape_class": batch_classes[0] if batch_classes else None,
+        "window_wait_s": round(st.seconds.get("batch_window", 0.0), 6),
+    }
     compile_s = st.seconds.get("compile", 0.0)
     total_s = sum(att["lanes_s"].values())
     kernels = []
@@ -669,6 +685,7 @@ def _explain_payload(st, mode: str, admission_verdict: dict | None = None) -> di
         "admission": admission_verdict,
         "encoding": encoding,
         "serving": serving_verdict,
+        "batching": batching_verdict,
         "counts": counts,
         "kernels": kernels,
     }
@@ -1750,6 +1767,13 @@ async def build_app(config: Config, store=None) -> web.Application:
         max_cost_s=qcfg.max_cost_s,
         weights=weights,
     )
+    # query batcher ([metric_engine.query.batching], server/batching.py):
+    # process-global like the serving caches — the planner rides the
+    # engine's cold downsample path, so configuring it here covers every
+    # read surface (native JSON, PromQL, rules, regioned fan-out)
+    from horaedb_tpu.server import batching as batching_mod
+
+    batching_mod.GLOBAL_BATCHER.configure(qcfg.batching)
     from horaedb_tpu import telemetry as telemetry_mod
 
     rules_engine = None

@@ -277,6 +277,23 @@ def note(name: str, n: int = 1) -> None:
         st.count(name, n)
 
 
+def current() -> "ScanStats | None":
+    """The active collector object (or None). The query batcher keys its
+    concurrency signal on collector IDENTITY: a regioned query's N
+    fan-out sub-queries share one collector, so they count as ONE client
+    and a lone regioned query keeps the no-window fast path."""
+    return _ACTIVE.get()
+
+
+def get_note(name: str) -> "int | None":
+    """Read a counter off the active collector (None without one or when
+    the note was never set). The admission slot uses this to learn how
+    wide a stacked launch its query rode (batched_with) without threading
+    the batcher through the slot protocol."""
+    st = _ACTIVE.get()
+    return None if st is None else st.counts.get(name)
+
+
 def note_max(name: str, n: int) -> None:
     """Record the MAXIMUM of `n` across the collector's lifetime instead
     of a running sum — for width-style facts (e.g. regions fanned out)

@@ -985,3 +985,84 @@ class TestJ015MeteringFunnel:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ016StackingFunnel:
+    """J016: stacking/padding of query result lanes belongs to the query
+    batcher (server/batching.py) and the sanctioned stacked kernels
+    (ops/aggregate.py) — a stack/pad-shaped call over batch-lane-named
+    buffers anywhere else is a second stacked-execution path."""
+
+    def seeded(self, tmp_path, body, rel="engine/fastpath.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_stack_over_result_grids_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def combine(result_grids):\n"
+            "    return np.stack(result_grids)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J016" in r.stdout and "query batcher" in r.stdout
+
+    def test_pad_over_batched_lane_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def widen(batched_values, n):\n"
+            "    return np.pad(batched_values, (0, n))\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J016" in r.stdout
+
+    def test_batcher_module_exempt(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def combine(result_grids):\n"
+            "    return np.vstack(result_grids)\n",
+            rel="server/batching.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_sanctioned_stacked_kernel_exempt(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import jax.numpy as jnp\n"
+            "def stacked(ts_lanes):\n"
+            "    return jnp.stack(ts_lanes)\n",
+            rel="ops/aggregate.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_unrelated_stack_not_flagged(self, tmp_path):
+        # stacking buffers that do not name a query lane (the promql
+        # evaluator's per-series value matrices, blockagg's feature
+        # planes) stays legal
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def matrix(members):\n"
+            "    return np.stack([m.values for m in members])\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def bench(stacked_rows):\n"
+            "    # jaxlint: disable=J016 harness measuring the stacked lane itself\n"
+            "    return np.stack(stacked_rows)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

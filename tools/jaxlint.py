@@ -111,6 +111,17 @@ Rules (see docs/static-analysis.md for rationale and examples):
         is a second standing-query engine growing outside the audited
         one — consume the rule engine's dirty sets instead, or suppress
         with the reason
+  J016  ad-hoc stacking/padding of query result lanes outside the query
+        batcher (server/batching.py) and the sanctioned stacked kernels
+        (ops/aggregate.py): a stack/pad-shaped call (`stack`/`vstack`/
+        `hstack`/`dstack`/`column_stack`/`pad`) whose arguments name a
+        batched query lane (`stacked_*`, `padded_*`, `batch_*`, `*_grids`,
+        `*_lanes`, ...) builds a second stacked-execution path — one that
+        dodges the batcher's power-of-two shape classes (retraces escape
+        the compiled-shape sharing), its pad-waste accounting
+        (horaedb_batch_pad_waste_ratio lies), and its bit-exact demux
+        contract. Route through the batcher, or suppress with the reason
+        for harnesses measuring the stacked lane itself
   J015  ad-hoc per-tenant accounting outside the metering funnel
         (horaedb_tpu/telemetry/): registering a `horaedb_tenant_*`
         metric family, a family with a `tenant` labelname, or a legacy
@@ -309,6 +320,23 @@ FUNNEL_SUBSCRIBE_FUNCS = {"serving_subscribe", "serving_unsubscribe"}
 # accounting registered anywhere else forks the ledger.
 J015_MODULES = ("horaedb_tpu/",)
 J015_EXEMPT = ("horaedb_tpu/telemetry/",)
+
+# J016: the stacked-execution funnel (server/batching.py pads/stacks the
+# coalesced query lanes; ops/aggregate.py hosts the sanctioned stacked
+# kernels). Stack/pad-shaped calls over batched-query-lane names anywhere
+# else are a second stacking path (same heuristic class as J012's
+# encoded-buffer prong: primitive tail + argument naming idiom).
+J016_MODULES = ("horaedb_tpu/",)
+J016_EXEMPT = (
+    "horaedb_tpu/server/batching.py",
+    "horaedb_tpu/ops/aggregate.py",
+)
+STACK_SHAPED_TAILS = {
+    "stack", "vstack", "hstack", "dstack", "column_stack", "pad",
+}
+_BATCH_LANE_RE = re.compile(
+    r"(^|_)(stacked?|padded|batch(ed)?|grids?|lanes?)(_|$)"
+)
 METRIC_REGISTER_VERBS = {"counter", "gauge", "histogram"}
 TENANT_FAMILY_PREFIX = "horaedb_tenant_"
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
@@ -962,6 +990,36 @@ def _check_serving_funnel(
             ))
 
 
+def _check_stacking_funnel(tree: ast.Module,
+                           findings: list[Finding]) -> None:
+    """J016: stack/pad-shaped primitives over query result lanes outside
+    the batcher and the sanctioned stacked kernels. A call fires when its
+    dotted tail is a stacking/padding primitive AND any argument
+    identifier names a batched query lane (`stacked_*`, `padded_*`,
+    `batch_*`, `*_grids`, `*_lanes` — the naming idiom of every stacked
+    buffer in this tree, the J011/J012 heuristic class)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        tail = fd.rsplit(".", 1)[-1] if fd else None
+        if tail in STACK_SHAPED_TAILS and any(
+            _BATCH_LANE_RE.search(name) for name in _arg_identifiers(node)
+        ):
+            findings.append(Finding(
+                node.lineno, "J016",
+                f"stacking/padding `{tail}(...)` over a query result lane "
+                "outside the query batcher (server/batching.py) / the "
+                "sanctioned stacked kernels (ops/aggregate.py) — a second "
+                "stacked-execution path dodges the batcher's power-of-two "
+                "shape classes (retraces escape the shared compiled "
+                "shapes), its pad-waste accounting, and the bit-exact "
+                "demux contract; route through the batcher, or suppress "
+                "with the reason for harnesses measuring the stacked "
+                "lane itself",
+            ))
+
+
 def _check_funnel_subscribers(tree: ast.Module,
                               findings: list[Finding]) -> None:
     """J014: the invalidation funnel's consumer set is pinned — only the
@@ -1293,6 +1351,10 @@ def lint_file(path: Path) -> list[str]:
         (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
         for m in J015_EXEMPT
     )
+    in_j016_scope = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J016_MODULES
+    ) and not any(posix.endswith(m) for m in J016_EXEMPT)
 
     idx = JitIndex()
     idx.visit(tree)
@@ -1326,6 +1388,8 @@ def lint_file(path: Path) -> list[str]:
         _check_funnel_subscribers(tree, findings)
     if in_j015_scope:
         _check_metering_funnel(tree, findings)
+    if in_j016_scope:
+        _check_stacking_funnel(tree, findings)
     _check_lock_discipline(tree, findings)
 
     out = [

@@ -163,6 +163,19 @@ REQUIRED_FAMILIES = (
     "horaedb_telemetry_series",
     "horaedb_telemetry_dropped_series_total",
     "horaedb_telemetry_scrape_seconds_bucket",
+    # query batcher (server/batching.py): every family renders from boot
+    # (pre-registered children); the same-shape panel burst below moves
+    # the batched counter and the group-size/pad-waste histograms
+    "horaedb_batch_group_size_bucket",
+    "horaedb_batch_pad_waste_ratio_bucket",
+    "horaedb_batch_window_wait_seconds_bucket",
+    "horaedb_batch_queries_total",
+    'horaedb_batch_queries_total{mode="batched"',
+    'horaedb_batch_queries_total{mode="solo_lone"',
+    'horaedb_batch_queries_total{mode="solo_deadline"',
+    'horaedb_batch_queries_total{mode="solo_off"',
+    "horaedb_batch_launches_total",
+    'horaedb_scan_stage_seconds_bucket{stage="batch_window"',
 )
 
 
@@ -371,6 +384,38 @@ async def run() -> int:
                 check(srv.get("cache") == "miss",
                       f"post-write re-query is a miss again (invalidation "
                       f"funnel fired): {srv}")
+            # ---- query batcher: a concurrent burst of same-shape panels
+            # (distinct host filters -> distinct cache keys, all misses)
+            # must coalesce into a stacked launch (EXPLAIN batched_with >
+            # 1), while a lone query afterwards stays batched_with=1 with
+            # ZERO window hold — the 1-client p50 contract
+            async def one_panel(host: str) -> dict:
+                async with s.post(f"{base}/api/v1/query?explain=1", json={
+                    "metric": "smoke_bulk", "start_ms": 0,
+                    "end_ms": 4000, "bucket_ms": 1000,
+                    "filters": {"host": host},
+                }) as r:
+                    body = await r.json()
+                    return ((body.get("explain") or {}).get("batching")
+                            or {})
+            burst = await asyncio.gather(
+                *(one_panel(f"bulk-{i:03d}") for i in range(8))
+            )
+            widths = [b.get("batched_with") for b in burst]
+            check(any(w and w > 1 for w in widths),
+                  f"concurrent same-shape burst coalesced "
+                  f"(batched_with mix {widths})")
+            coalesced = next(b for b in burst
+                             if (b.get("batched_with") or 0) > 1)
+            check(coalesced.get("shape_class") is not None,
+                  f"EXPLAIN carries the shape class: {coalesced}")
+            check("pad_waste_pct" in coalesced,
+                  f"EXPLAIN carries pad waste: {coalesced}")
+            lone = await one_panel("bulk-009")
+            check(lone.get("batched_with") == 1
+                  and lone.get("window_wait_s") == 0.0,
+                  f"lone query stays batched_with=1 with no window "
+                  f"penalty: {lone}")
             # ---- streaming rule engine: register a recording rule + an
             # alert rule over HTTP, drive a threshold-crossing write,
             # force a tick, and assert the rule series is queryable, the
