@@ -1,6 +1,6 @@
 # Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
 
-.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics bench-smoke chaos-smoke clean soak dryruns tpu-suite
+.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics bench-smoke chaos-smoke cluster-smoke clean soak dryruns tpu-suite
 
 test:
 	python -m pytest tests/ -x -q
@@ -29,6 +29,7 @@ lint:
 	$(MAKE) smoke-metrics
 	$(MAKE) bench-smoke
 	$(MAKE) chaos-smoke
+	$(MAKE) cluster-smoke
 
 # Domain-aware gate (tools/jaxlint.py): host-sync on hot paths (J001),
 # retrace hazards under jit (J002), dtype drift in engine code (J003),
@@ -42,7 +43,9 @@ lint:
 # funnel breaches (J013), unaudited invalidation-funnel subscribers
 # (J014), per-tenant accounting outside the metering funnel (J015),
 # ad-hoc stacking/padding of query result lanes outside the query
-# batcher's stacked-execution funnel (J016).
+# batcher's stacked-execution funnel (J016), cluster-funnel breaches —
+# manifest views outside the replica funnel, assignment-record mutation
+# outside the fenced CAS API (J017).
 # Findings print as path:line: CODE message.
 # Rules + suppression syntax: docs/static-analysis.md
 jaxlint:
@@ -69,6 +72,14 @@ bench-smoke:
 # orphan-SST GC) at smoke scale (tools/chaos_smoke.py).
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
+# Cluster gate: boot one writer + one stateless read replica (two real
+# servers, two S3 clients) over one fake-S3 bucket and assert exact
+# replica reads after catch-up, the X-Horaedb-Staleness-Ms header, write
+# forwarding replica->writer, /api/v1/cluster/status epoch equality, and
+# the horaedb_cluster_* families (tools/cluster_smoke.py).
+cluster-smoke:
+	JAX_PLATFORMS=cpu python tools/cluster_smoke.py
 
 # mypy over the annotated core (config in pyproject.toml [tool.mypy]); the
 # dev image has no mypy, so this degrades to a loud skip locally — CI

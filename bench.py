@@ -376,6 +376,207 @@ def query_qps_lane(smoke: bool) -> dict:
     return {"query_qps": asyncio.run(run())}
 
 
+def cluster_scaleout_lane(smoke: bool) -> dict:
+    """Cluster lane (horaedb_tpu/cluster): closed-loop read QPS at
+    1/8/64 clients against ONE writer vs the SAME writer + 2 stateless
+    read replicas on one bucket, with live ingest churning underneath
+    (the replicas tail manifests via the conditional-GET watch loop).
+
+    Reported: per-level QPS/p50/p99/shed for both arms, the scale-out
+    factor (replica-arm QPS / writer-only QPS at the top level), replica
+    lag p99 under churn, and `replica_exact` — replicas answered
+    bit-identically to the writer after catch-up (bench_smoke asserts
+    it). Honesty caveat carried in the JSON: all three "nodes" share one
+    process/event loop here, so the lane measures the ROUTING + per-node
+    admission-cap contract (each node gets its own scheduler), not
+    cross-host CPU scaling; serving is forced cold so every query really
+    scans."""
+    import asyncio
+    import os
+    import shutil
+    import tempfile
+
+    from horaedb_tpu.cluster import rendezvous_pick
+    from horaedb_tpu.common.error import UnavailableError
+    from horaedb_tpu.engine import MetricEngine, QueryRequest
+    from horaedb_tpu.objstore import LocalStore
+    from horaedb_tpu.pb import remote_write_pb2
+    from horaedb_tpu.server.admission import AdmissionController, run_query
+
+    n_series, n_samples = 100, 20
+    base = 1_700_000_000_000
+
+    def payload(seq: int = 0, rows: int = n_samples) -> bytes:
+        req = remote_write_pb2.WriteRequest()
+        for s in range(n_series if seq == 0 else 4):
+            series = req.timeseries.add()
+            for k, v in ((b"__name__", b"cluster_cpu"),
+                         (b"host", f"host-{s:04d}".encode())):
+                lab = series.labels.add()
+                lab.name = k
+                lab.value = v
+            for i in range(rows):
+                smp = series.samples.add()
+                smp.timestamp = base + seq * 60_000 + i * 1000
+                smp.value = float(s + i)
+        return req.SerializeToString()
+
+    wall_s = 0.3 if smoke else 1.5
+    levels = (1, 8, 64)
+
+    async def run() -> dict:
+        root = tempfile.mkdtemp(prefix="horaedb-bench-cluster-")
+        store = LocalStore(root)
+        writer = await MetricEngine.open("db", store,
+                                         enable_compaction=False)
+        out: dict = {}
+        saved = os.environ.get("HORAEDB_SERVING")
+        os.environ["HORAEDB_SERVING"] = "off"
+        replicas = []
+        try:
+            from horaedb_tpu.cluster.replica import ReplicaEngine
+
+            await writer.write_payload(payload())
+            await writer.flush()
+            for _ in range(2):
+                replicas.append(await ReplicaEngine.open(
+                    "db", store, engine_kwargs={},
+                ))
+            req = QueryRequest(
+                metric=b"cluster_cpu", start_ms=base,
+                end_ms=base + n_samples * 1000, bucket_ms=5000,
+            )
+            # replica-served correctness after catch-up: bit-identical
+            wt = await writer.query(req)
+            exact = True
+            for r in replicas:
+                rt = await r.query(req)
+                exact = exact and (
+                    rt[1]["sum"].tolist() == wt[1]["sum"].tolist()
+                    and rt[0] == wt[0]
+                )
+            out["replica_exact"] = bool(exact)
+
+            # live churn: the writer commits small batches while the
+            # replicas tail — lag p99 is measured under real movement
+            stop = asyncio.Event()
+            lag_ms: list[float] = []
+
+            async def churn():
+                seq = 1
+                while not stop.is_set():
+                    try:
+                        await writer.write_payload(payload(seq, rows=2))
+                        await writer.flush()
+                    except Exception:  # noqa: BLE001 — bench keeps going
+                        pass
+                    seq += 1
+                    await asyncio.sleep(0.05)
+
+            async def tail(rep):
+                while not stop.is_set():
+                    try:
+                        # sample the lag AS SEEN AT the probe (time since
+                        # the view was last confirmed current) — after a
+                        # successful probe it is ~0 by definition
+                        lag_ms.append(rep.staleness_ms())
+                        await rep.watch_once()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    await asyncio.sleep(0.02)
+
+            bg = [asyncio.create_task(churn())] + [
+                asyncio.create_task(tail(r)) for r in replicas
+            ]
+            cells = 4 * n_series
+            arms = {
+                "writer_only": [writer],
+                "writer_plus_2_replicas": [writer] + replicas,
+            }
+            for clients in levels:
+                row = {}
+                for arm, nodes in arms.items():
+                    # one bounded scheduler PER NODE — the per-process
+                    # caps a real deployment would run
+                    ctls = [
+                        AdmissionController(
+                            max_concurrent=2, queue_max=16,
+                            queue_deadline_s=0.25,
+                        )
+                        for _ in nodes
+                    ]
+                    node_names = [f"n{i}" for i in range(len(nodes))]
+                    lat: list[float] = []
+                    sheds = 0
+
+                    async def one_client(cid: int):
+                        nonlocal sheds
+                        # rendezvous on the client identity: one client's
+                        # repeats stay on one node, like the router
+                        pick = rendezvous_pick(
+                            f"client-{cid}".encode(), node_names
+                        )
+                        idx = node_names.index(pick)
+                        t_end = time.perf_counter() + wall_s
+                        while time.perf_counter() < t_end:
+                            t0 = time.perf_counter()
+                            try:
+                                await run_query(
+                                    ctls[idx], nodes[idx], req, cells=cells
+                                )
+                            except UnavailableError:
+                                sheds += 1
+                                await asyncio.sleep(0.002)
+                                continue
+                            lat.append(time.perf_counter() - t0)
+
+                    t0 = time.perf_counter()
+                    await asyncio.gather(
+                        *(one_client(c) for c in range(clients))
+                    )
+                    elapsed = time.perf_counter() - t0
+                    lat.sort()
+                    total = len(lat) + sheds
+                    row[arm] = {
+                        "qps": round(len(lat) / elapsed, 1),
+                        "p50_ms": round(lat[len(lat) // 2] * 1000, 2)
+                        if lat else None,
+                        "p99_ms": round(
+                            lat[max(0, int(len(lat) * 0.99) - 1)] * 1000, 2
+                        ) if lat else None,
+                        "shed_pct": round(100.0 * sheds / total, 1)
+                        if total else 0.0,
+                    }
+                out[str(clients)] = row
+            stop.set()
+            await asyncio.gather(*bg, return_exceptions=True)
+            top = str(levels[-1])
+            w_qps = out[top]["writer_only"]["qps"]
+            c_qps = out[top]["writer_plus_2_replicas"]["qps"]
+            out["scale_out_factor"] = round(c_qps / max(w_qps, 1e-9), 2)
+            if lag_ms:
+                lag_ms.sort()
+                out["replica_lag_p99_ms"] = round(
+                    lag_ms[max(0, int(len(lag_ms) * 0.99) - 1)], 1
+                )
+            out["honesty"] = (
+                "single-process simulation: per-node admission caps + "
+                "routing measured; cross-host CPU scaling is not"
+            )
+        finally:
+            if saved is None:
+                os.environ.pop("HORAEDB_SERVING", None)
+            else:
+                os.environ["HORAEDB_SERVING"] = saved
+            for r in replicas:
+                await r.close()
+            await writer.close()
+            shutil.rmtree(root, ignore_errors=True)
+        return out
+
+    return {"cluster_scaleout": asyncio.run(run())}
+
+
 def query_serving_lane(smoke: bool) -> dict:
     """Serving-tier lane (horaedb_tpu/serving + storage/rollup.py): a
     zipf(1.1)-repeated dashboard workload over 64 distinct panels —
@@ -1267,6 +1468,9 @@ def main() -> None:
     # self-telemetry lane (horaedb_tpu/telemetry): scrape-tick cost and
     # the steady-state duty cycle the <2% overhead budget pins
     result.update(self_telemetry_lane(SMOKE))
+    # cluster lane (horaedb_tpu/cluster): 1 writer vs writer + 2 read
+    # replicas on one bucket — scale-out factor + replica lag p99
+    result.update(cluster_scaleout_lane(SMOKE))
 
     # Last-chance accelerator retry, ONLY on the wedged-tunnel fallback
     # path (`not responsive`): the CPU fallback run itself took minutes —

@@ -1,0 +1,225 @@
+"""Cluster layer: stateless read replicas over shared object storage.
+
+The HoraeDB v2 design's scale-out story (RFC :28-76) is shared object
+storage as the data plane plus range-partitioned regions routed by an
+assignment map — the meta-service architecture, minus the meta service.
+Every prerequisite shipped piecemeal in this tree: the epoch fence gives
+single-writer-per-region (storage/fence.py), the result-cache key
+(sealed-SST set + tombstone epoch) is a correct bounded-staleness token
+(serving/cache.py), and ResilientStore makes the shared store survivable.
+This package composes them into horizontal scale-out — the Taurus
+near-data-processing argument (arXiv:2506.20010): compute should be
+stateless replicas over one durable log, applied to an LSM-over-S3
+metric engine.
+
+Three modules:
+
+- **replica.py** — a stateless read-replica mode (`role = "replica"`):
+  the engine opens READ-ONLY against the shared store and tails each
+  region's manifest with a cheap conditional-GET watch loop
+  (`ObjectStore.get_if_changed`, ETag/If-None-Match — the fence-probe
+  machinery's sibling), atomically swapping in new sealed-SST/tombstone/
+  rollup snapshots. Queries serve with bounded staleness; the staleness
+  token (manifest epoch + lag ms) rides the EXPLAIN `cluster` verdict,
+  the `X-Horaedb-Staleness-Ms` response header, and
+  `horaedb_cluster_replica_lag_seconds`.
+- **assignment.py** — a fence-protected region-assignment map persisted
+  in the object store (`{root}/cluster/assignment/{version}` records,
+  put_if_absent-arbitrated exactly like epoch claims) so multiple
+  writer processes split regions; takeover = a new assignment version +
+  a higher epoch fence on the region root, which deposes the lapsed
+  writer mid-flight (jaxlint J017 pins mutation to this module's API).
+- **router.py** — a consistent-hash (rendezvous) query router embedded
+  in the HTTP tier: writes forward to the owning writer, reads fan
+  across healthy replicas with hedged failover to the local engine on
+  replica error, health-checked via `/api/v1/cluster/status`.
+
+Topology contract: N processes, one bucket. Exactly one writer owns each
+region's epoch fence at a time; any number of replicas serve reads with
+bounded staleness; a standby writer takes over a lapsed fence without
+coordination beyond the store itself.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common.hash import seahash
+from horaedb_tpu.common.time_ext import ReadableDuration
+from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+# -- metric families (pre-registered zero states so /metrics shows them
+# -- from boot, the PR2 convention) ------------------------------------------
+
+REPLICA_LAG = GLOBAL_METRICS.gauge(
+    "horaedb_cluster_replica_lag_seconds",
+    help="Seconds since this replica last confirmed its view matches the "
+         "shared store (a successful watch probe with no change, or a "
+         "completed snapshot swap). The bounded-staleness number the "
+         "X-Horaedb-Staleness-Ms response header surfaces per query.",
+)
+REPLICA_EPOCH = GLOBAL_METRICS.gauge(
+    "horaedb_cluster_manifest_epoch",
+    help="This process's manifest epoch (max live record id across "
+         "tables/regions, floored monotonic). Writer-vs-replica equality "
+         "IS the catch-up check.",
+)
+REFRESHES = GLOBAL_METRICS.counter(
+    "horaedb_cluster_refreshes_total",
+    help="Replica snapshot swaps, by outcome: ok (fresh view swapped "
+         "in), error (open failed; backoff + retry), unchanged (watch "
+         "probe found nothing new).",
+    labelnames=("result",),
+)
+WATCH_ERRORS = GLOBAL_METRICS.counter(
+    "horaedb_cluster_watch_errors_total",
+    help="Watch-loop probe failures (faulted store); each grows the "
+         "loop's exponential backoff until the next success resets it.",
+)
+FORWARDS = GLOBAL_METRICS.counter(
+    "horaedb_cluster_forwards_total",
+    help="Requests the cluster router forwarded to a peer, by kind: "
+         "write (replica/non-owner -> owning writer), read (writer -> "
+         "replica offload).",
+    labelnames=("kind",),
+)
+FAILOVERS = GLOBAL_METRICS.counter(
+    "horaedb_cluster_failovers_total",
+    help="Hedged read failovers: a routed replica answered with an "
+         "error (or was unreachable) and the query was served by the "
+         "local engine instead.",
+)
+TAKEOVERS = GLOBAL_METRICS.counter(
+    "horaedb_cluster_takeovers_total",
+    help="Region ownership takeovers this process performed (assignment "
+         "record rewrite + fresh epoch fence deposing the lapsed writer).",
+)
+PEER_HEALTHY = GLOBAL_METRICS.gauge(
+    "horaedb_cluster_peer_healthy",
+    help="Peer health as the router sees it (1 healthy / 0 not), from "
+         "/api/v1/cluster/status probes and request outcomes.",
+    labelnames=("node",),
+)
+
+for _r in ("ok", "error", "unchanged"):
+    REFRESHES.labels(_r)
+for _k in ("write", "read"):
+    FORWARDS.labels(_k)
+
+
+def rendezvous_order(key: bytes, nodes: "list[str]") -> "list[str]":
+    """Highest-random-weight (rendezvous) ranking of `nodes` for `key`:
+    every router instance computes the same order with no shared state,
+    and removing a node only moves the keys it owned (the minimal-
+    disruption property consistent hashing exists for). Used for
+    read fan-out (key = a query identity) and the default region ->
+    writer assignment (key = the region id)."""
+    return sorted(
+        nodes,
+        key=lambda n: seahash(key + b"\x00" + n.encode()),
+        reverse=True,
+    )
+
+
+def rendezvous_pick(key: bytes, nodes: "list[str]") -> "str | None":
+    order = rendezvous_order(key, nodes)
+    return order[0] if order else None
+
+
+@dataclass
+class ClusterPeer:
+    """One peer process in the cluster ([[metric_engine.cluster.peers]])."""
+
+    node: str = ""
+    url: str = ""
+    role: str = "writer"  # "writer" | "replica"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClusterPeer":
+        from horaedb_tpu.common.error import ensure
+
+        unknown = set(d) - {"node", "url", "role"}
+        ensure(not unknown,
+               f"unknown cluster peer keys: {sorted(unknown)}")
+        p = cls(node=str(d.get("node", "")), url=str(d.get("url", "")),
+                role=str(d.get("role", "writer")).lower())
+        ensure(bool(p.node), "cluster peer needs a node id")
+        ensure(p.role in ("writer", "replica"),
+               f"cluster peer role must be writer|replica, got {p.role!r}")
+        return p
+
+
+@dataclass
+class ClusterConfig:
+    """`[metric_engine.cluster]` knobs (docs/operations.md "Scale-out").
+
+    `enabled = false` (the default) keeps the single-process behavior
+    byte-identical. With it on, `role` picks the process's job:
+
+    - "writer": owns region epoch fences per the assignment map, accepts
+      writes, serves reads (optionally offloading them to replicas).
+    - "replica": opens the engine read-only, tails manifests with the
+      conditional-GET watch loop, serves reads with bounded staleness,
+      forwards writes to the owning writer.
+    """
+
+    enabled: bool = False
+    role: str = "writer"
+    # watch-loop probe spacing on replicas (each probe is one conditional
+    # GET + a few LISTs per table; unchanged probes cost no transfer)
+    watch_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(2)
+    )
+    # watch-loop backoff cap under a faulted store
+    watch_backoff_cap: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(30)
+    )
+    # advisory bound: /api/v1/cluster/status reports stale=true past it
+    max_staleness: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(30)
+    )
+    # peer status-probe spacing (the router's health view)
+    probe_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(5)
+    )
+    # writers offload reads to healthy replicas (rendezvous-routed) when
+    # any are known; off = every node serves its own reads
+    route_reads: bool = True
+    # this process's advertised URL (what peers' routers forward to)
+    self_url: str = ""
+    # peer processes sharing the bucket
+    peers: "list[ClusterPeer]" = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "ClusterConfig":
+        from horaedb_tpu.common.error import ensure
+
+        if d is None:
+            return cls()
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        ensure(not unknown,
+               f"unknown config keys for ClusterConfig: {sorted(unknown)}")
+        kwargs = dict(d)
+        for k in ("watch_interval", "watch_backoff_cap", "max_staleness",
+                  "probe_interval"):
+            if k in kwargs:
+                kwargs[k] = ReadableDuration.parse(kwargs[k])
+        if "peers" in kwargs:
+            kwargs["peers"] = [
+                p if isinstance(p, ClusterPeer) else ClusterPeer.from_dict(p)
+                for p in kwargs["peers"]
+            ]
+        cfg = cls(**kwargs)
+        ensure(cfg.role in ("writer", "replica"),
+               f"cluster.role must be writer|replica, got {cfg.role!r}")
+        return cfg
+
+    def writer_nodes(self) -> "list[str]":
+        return [p.node for p in self.peers if p.role == "writer"]
+
+    def peer_by_node(self, node: str) -> "ClusterPeer | None":
+        for p in self.peers:
+            if p.node == node:
+                return p
+        return None

@@ -1,0 +1,234 @@
+"""Fence-protected region-assignment map over the shared object store.
+
+The RFC's meta plane maps each region to exactly one writer node
+(docs/rfcs/20240827-metric-engine.md:28-76). Without a meta service, the
+store itself arbitrates — the same monotonic-version conditional-put
+pattern as epoch fencing (storage/fence.py):
+
+- The map is a JSON record `{version, regions: {region_id: node},
+  updated_by, updated_unix_ms}` persisted at
+  `{cluster_root}/assignment/{version:020d}`.
+- To mutate, read the current max version, apply the change, and
+  `put_if_absent` version+1. Exactly one contender can create a given
+  version (S3 `If-None-Match: *`); losers re-read and retry — a stale
+  proposer can never silently clobber a concurrent claim.
+- Highest version wins, forever. Records are never deleted: the dir
+  stays tiny (one object per ownership change) and doubles as an
+  ownership audit log, exactly like the fence dir.
+
+The map is ROUTING state, not the safety mechanism: data safety is the
+region's epoch fence. `takeover` therefore writes the new assignment
+version FIRST (so routers converge on the new owner) and then acquires a
+fresh epoch fence on each taken region root — the moment the fence
+lands, the lapsed writer's next manifest mutation raises FencedError
+regardless of what any router believes. A crash between the two steps
+leaves routing pointing at a node that never claimed the fences; the old
+writer keeps working until a retried takeover completes — inconsistent
+routing, never split-brain.
+
+jaxlint J017 pins assignment-record mutation to this module: a second
+writer of `cluster/assignment` objects would fork the meta plane.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+
+from horaedb_tpu.common.error import HoraeError, ensure
+from horaedb_tpu.objstore import ObjectStore, PreconditionFailed
+
+logger = logging.getLogger(__name__)
+
+ASSIGNMENT_DIR = "assignment"
+
+
+def assignment_dir(cluster_root: str) -> str:
+    return f"{cluster_root.rstrip('/')}/{ASSIGNMENT_DIR}"
+
+
+def assignment_path(cluster_root: str, version: int) -> str:
+    return f"{assignment_dir(cluster_root)}/{version:020d}"
+
+
+def _version_of(path: str) -> int:
+    try:
+        return int(path.rsplit("/", 1)[-1])
+    except ValueError:
+        return -1
+
+
+@dataclass(frozen=True)
+class Assignment:
+    """One decoded assignment-map version: region id -> owning node."""
+
+    version: int = 0
+    regions: "dict[int, str]" = field(default_factory=dict)
+    updated_by: str = ""
+    updated_unix_ms: int = 0
+
+    def owner_of(self, region_id: int) -> "str | None":
+        return self.regions.get(int(region_id))
+
+    def regions_of(self, node: str) -> "list[int]":
+        return sorted(r for r, n in self.regions.items() if n == node)
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "version": self.version,
+            "regions": {str(r): n for r, n in sorted(self.regions.items())},
+            "updated_by": self.updated_by,
+            "updated_unix_ms": self.updated_unix_ms,
+        }).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Assignment":
+        try:
+            d = json.loads(data.decode())
+            return cls(
+                version=int(d["version"]),
+                regions={int(r): str(n)
+                         for r, n in dict(d.get("regions") or {}).items()},
+                updated_by=str(d.get("updated_by", "")),
+                updated_unix_ms=int(d.get("updated_unix_ms", 0)),
+            )
+        except HoraeError:
+            raise
+        except Exception as e:  # noqa: BLE001 — corrupt record, typed error
+            raise HoraeError(f"corrupt assignment record: {e}") from e
+
+
+async def load_assignment(store: ObjectStore, cluster_root: str) -> Assignment:
+    """The current (highest-version) assignment; empty when none exists.
+    A corrupt NEWEST record fails loudly — silently falling back to an
+    older version would reroute writes to a deposed owner."""
+    metas = [
+        m for m in await store.list(assignment_dir(cluster_root))
+        if _version_of(m.path) >= 0
+    ]
+    if not metas:
+        return Assignment()
+    newest = max(metas, key=lambda m: _version_of(m.path))
+    return Assignment.from_json(await store.get(newest.path))
+
+
+async def propose_assignment(
+    store: ObjectStore,
+    cluster_root: str,
+    node_id: str,
+    mutate,
+    max_attempts: int = 16,
+) -> Assignment:
+    """CAS loop: read the current map, apply `mutate(regions_dict) ->
+    regions_dict`, put_if_absent the next version. Returns the committed
+    Assignment. `mutate` returning the UNCHANGED dict short-circuits
+    without a write (idempotent boot claims). Losing the conditional put
+    re-reads and re-applies — the fenced mutation API J017 pins."""
+    for _ in range(max_attempts):
+        cur = await load_assignment(store, cluster_root)
+        new_regions = mutate(dict(cur.regions))
+        ensure(isinstance(new_regions, dict),
+               "assignment mutate must return the regions dict")
+        new_regions = {int(r): str(n) for r, n in new_regions.items()}
+        if new_regions == cur.regions:
+            return cur
+        nxt = Assignment(
+            version=cur.version + 1,
+            regions=new_regions,
+            updated_by=node_id,
+            updated_unix_ms=int(time.time() * 1000),
+        )
+        try:
+            await store.put_if_absent(
+                assignment_path(cluster_root, nxt.version), nxt.to_json()
+            )
+        except PreconditionFailed:
+            continue  # another proposer won this version; re-read
+        logger.info(
+            "assignment v%d committed by %s: %s",
+            nxt.version, node_id, nxt.regions,
+        )
+        return nxt
+    raise HoraeError(
+        f"could not commit assignment on {cluster_root} after "
+        f"{max_attempts} attempts (heavy meta-plane contention)"
+    )
+
+
+def bootstrap_regions(
+    region_ids: "list[int]", writer_nodes: "list[str]"
+) -> "dict[int, str]":
+    """Deterministic default split: rendezvous-hash each region id over
+    the writer set, so every writer boots to the same proposal without
+    coordination (the CAS commit then makes one of them the author)."""
+    from horaedb_tpu.cluster import rendezvous_pick
+
+    ensure(bool(writer_nodes), "cluster needs at least one writer node")
+    return {
+        int(r): rendezvous_pick(str(int(r)).encode(), list(writer_nodes))
+        for r in region_ids
+    }
+
+
+async def claim_regions(
+    store: ObjectStore,
+    cluster_root: str,
+    node_id: str,
+    region_ids: "list[int]",
+    writer_nodes: "list[str] | None" = None,
+) -> Assignment:
+    """Boot-time claim: ensure every region in `region_ids` has an owner,
+    claiming unowned ones per the rendezvous bootstrap (or to `node_id`
+    when it is the only writer). Never steals an owned region — that is
+    `takeover`'s explicit job."""
+    writers = list(writer_nodes or [node_id])
+    if node_id not in writers:
+        writers.append(node_id)
+    defaults = bootstrap_regions(region_ids, writers)
+
+    def mutate(regions: dict) -> dict:
+        for r in region_ids:
+            regions.setdefault(int(r), defaults[int(r)])
+        return regions
+
+    return await propose_assignment(store, cluster_root, node_id, mutate)
+
+
+async def takeover_region(
+    store: ObjectStore,
+    root: str,
+    cluster_root: str,
+    node_id: str,
+    region_id: int,
+    region_root: str,
+    fence_validate_interval_s: float = 5.0,
+):
+    """Take ownership of `region_id` from its (presumed lapsed) writer:
+    commit the assignment rewrite, then acquire a fresh epoch fence on
+    `region_root` — the acquisition mints a HIGHER epoch, so the deposed
+    writer's next fenced mutation raises FencedError no matter what it
+    believes about the assignment map. Returns (Assignment, EpochFence).
+
+    `root` is unused beyond logging symmetry with the engine roots; the
+    fence root is the region's engine root (one fence covers all six
+    tables of the region, engine/engine.py)."""
+    from horaedb_tpu.cluster import TAKEOVERS
+    from horaedb_tpu.storage.fence import EpochFence
+
+    def mutate(regions: dict) -> dict:
+        regions[int(region_id)] = node_id
+        return regions
+
+    asg = await propose_assignment(store, cluster_root, node_id, mutate)
+    fence = await EpochFence.acquire(
+        store, region_root.strip("/"), node_id,
+        validate_interval_s=fence_validate_interval_s,
+    )
+    TAKEOVERS.inc()
+    logger.info(
+        "takeover: node=%s region=%d root=%s assignment_v=%d epoch=%d",
+        node_id, region_id, region_root, asg.version, fence.epoch,
+    )
+    return asg, fence

@@ -1,0 +1,415 @@
+"""Stateless read replica: a read-only engine view tailing the writer's
+manifests over the shared object store.
+
+Mechanics (package docstring has the architecture):
+
+- The engine opens with `read_only=True` end to end (engine/engine.py →
+  storage/storage.py → storage/manifest): no fence, no compaction, no
+  orphan GC, no sidecar dumps — the replica NEVER writes the bucket.
+- A watch loop probes each region root for change: one conditional GET
+  on every table's manifest snapshot (`ObjectStore.get_if_changed`,
+  ETag/If-None-Match — an unchanged probe costs no transfer on stores
+  with real ETags) plus LISTs of the delta/tombstone/rollup dirs. The
+  composed digest IS the change token; an unchanged token refreshes the
+  staleness clock for free.
+- On change, the replica opens a FRESH read-only view (the full manifest
+  fold + index replay the normal open runs) and atomically swaps it in —
+  in-flight queries keep the old view via their own references, and
+  read-only engines hold no background state, so the old view closes
+  safely after the swap. Regioned deployments swap per REGION
+  (RegionedEngine.refresh_region), so one busy region never pays for a
+  quiet one; a REGIONS-descriptor change (split) reopens the whole tree.
+- Every swap routes through the serving invalidation funnel
+  (`serving_invalidate`) with the mutation's time range — the union of
+  time ranges of SSTs/tombstones that changed between the views — so
+  replica-side result caches and rule dirty-sets stay invalidation-
+  correct exactly like a local write commit would have left them.
+
+Staleness contract: the token is (manifest epoch, lag ms). The epoch is
+`Manifest.epoch()` floored monotonic (GC can retire the max id; the
+surfaced token never moves backwards); the lag is the time since the
+last probe that CONFIRMED the view matches the store. Queries on a
+replica carry it in the EXPLAIN `cluster` verdict and the
+`X-Horaedb-Staleness-Ms` response header; `/api/v1/cluster/status`
+compares epochs writer-vs-replica — equality is catch-up.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+
+from horaedb_tpu.cluster import REFRESHES, REPLICA_EPOCH, REPLICA_LAG, WATCH_ERRORS
+from horaedb_tpu.common.error import ReplicaReadOnlyError
+from horaedb_tpu.objstore import NotFound
+from horaedb_tpu.storage.types import TimeRange
+
+logger = logging.getLogger(__name__)
+
+ENGINE_TABLES = ("metrics", "series", "index", "tags", "data", "exemplars")
+# result-cache / rule-dirty-set bearing tables (the funnel's audience)
+SAMPLE_TABLES = ("data", "exemplars")
+
+
+def _table_diff_range(old_table, new_table):
+    """(changed?, union TimeRange of what changed, tombstones_changed?)
+    between two manifest views of one table root."""
+    old_ssts = {s.id: s.meta.time_range for s in old_table.manifest.all_ssts()}
+    new_ssts = {s.id: s.meta.time_range for s in new_table.manifest.all_ssts()}
+    old_tombs = {t.id: t.time_range for t in old_table.manifest.all_tombstones()}
+    new_tombs = {t.id: t.time_range for t in new_table.manifest.all_tombstones()}
+    changed_ids = set(old_ssts) ^ set(new_ssts)
+    changed_tombs = set(old_tombs) ^ set(new_tombs)
+    if not changed_ids and not changed_tombs:
+        return False, None, False
+    lo, hi = None, None
+    for rid in changed_ids:
+        rng = old_ssts.get(rid) or new_ssts[rid]
+        lo = rng.start if lo is None else min(lo, rng.start)
+        hi = rng.end if hi is None else max(hi, rng.end)
+    for tid in changed_tombs:
+        rng = old_tombs.get(tid) or new_tombs[tid]
+        lo = rng.start if lo is None else min(lo, rng.start)
+        hi = rng.end if hi is None else max(hi, rng.end)
+    rng = TimeRange(int(lo), int(hi)) if lo is not None else None
+    return True, rng, bool(changed_tombs)
+
+
+def invalidate_swapped_views(old_engine, new_engine) -> int:
+    """Satellite contract (ISSUE 15): a replica's snapshot swap is its
+    flush/delete commit — route it through the serving invalidation
+    funnel with the mutation's time range so the result cache purges and
+    the rule evaluator's dirty sets see the event, exactly like a local
+    write would have. Returns funnel events fired."""
+    from horaedb_tpu.serving.cache import RESULT_CACHE
+
+    fired = 0
+    old_subs = old_engine.sub_engines()
+    for prefix, new_sub in new_engine.sub_engines().items():
+        old_sub = old_subs.get(prefix)
+        if old_sub is None:
+            continue  # fresh region (split): nothing cached under it yet
+        for name in SAMPLE_TABLES:
+            old_t = getattr(old_sub, f"{name}_table")
+            new_t = getattr(new_sub, f"{name}_table")
+            changed, rng, tombs = _table_diff_range(old_t, new_t)
+            if not changed:
+                continue
+            reason = "delete" if tombs else "flush"
+            RESULT_CACHE.serving_invalidate(new_t._root, reason, rng)
+            fired += 1
+    return fired
+
+
+class ReplicaEngine:
+    """Read-only engine facade with the watch/swap loop. Delegates the
+    entire query/discovery surface to the current view (atomic reference
+    swap), so the HTTP tier uses it exactly like an engine."""
+
+    def __init__(self) -> None:
+        raise RuntimeError("use ReplicaEngine.open")
+
+    @classmethod
+    async def open(
+        cls,
+        root: str,
+        store,
+        num_regions: int = 1,
+        granularity: str = "series",
+        watch_interval_s: float = 2.0,
+        watch_backoff_cap_s: float = 30.0,
+        engine_kwargs: "dict | None" = None,
+        open_retries: int = 0,
+        open_retry_delay_s: float = 0.5,
+    ) -> "ReplicaEngine":
+        """Open the read-only view. `open_retries` > 0 waits for the
+        writer to have created the store layout (REGIONS descriptor /
+        first manifests) instead of failing a racing boot."""
+        self = object.__new__(cls)
+        self._root = root
+        self._store = store
+        self._num_regions = num_regions
+        self._granularity = granularity
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self._engine_kwargs["read_only"] = True
+        self._interval_s = watch_interval_s
+        self._backoff_cap_s = watch_backoff_cap_s
+        self._etags: dict[str, str | None] = {}
+        self._tokens: dict[str, str] = {}
+        self._desc_token: "str | None" = None
+        self._epoch_floor = 0
+        self._consecutive_errors = 0
+        self._watch_task: "asyncio.Task | None" = None
+        self._refresh_lock = asyncio.Lock()
+        self._engine = None
+        last: "BaseException | None" = None
+        for attempt in range(max(1, open_retries + 1)):
+            try:
+                eng = await self._open_view()
+            except NotFound as e:
+                last = e
+                if attempt < open_retries:
+                    await asyncio.sleep(open_retry_delay_s)
+                continue
+            if (not self._regioned and attempt < open_retries
+                    and not await self._store.list(self._root)):
+                # single-engine roots have no boot marker (the regioned
+                # path waits on the REGIONS descriptor): ZERO objects
+                # under the root inside the retry window means the
+                # writer hasn't booted — wait instead of confidently
+                # serving nothing. A booted-but-idle writer has already
+                # left layout (index sidecar, fence, manifests) and its
+                # truthful answer IS empty, so it opens immediately; the
+                # watch loop swaps in the first flush.
+                await eng.close()
+                await asyncio.sleep(open_retry_delay_s)
+                continue
+            self._engine = eng
+            break
+        if self._engine is None:
+            raise ReplicaReadOnlyError(
+                f"replica open: no store layout under {root!r} yet "
+                "(is the writer up?)", cause=last,
+            )
+        # prime the watch tokens so the first loop probe compares against
+        # the view just opened, not against nothing
+        for eroot in self._engine_roots():
+            self._tokens[eroot] = await self._root_token(eroot)
+        if self._regioned:
+            self._desc_token = await self._descriptor_token()
+        self._last_sync = time.monotonic()
+        self._export()
+        return self
+
+    # -- view management ------------------------------------------------------
+    @property
+    def _regioned(self) -> bool:
+        return self._num_regions > 1
+
+    async def _open_view(self):
+        if self._regioned:
+            from horaedb_tpu.engine.region import RegionedEngine
+
+            return await RegionedEngine.open(
+                self._root, self._store, self._num_regions,
+                granularity=self._granularity, **self._engine_kwargs,
+            )
+        from horaedb_tpu.engine.engine import MetricEngine
+
+        return await MetricEngine.open(
+            self._root, self._store, **self._engine_kwargs,
+        )
+
+    def _engine_roots(self) -> "list[str]":
+        if self._regioned:
+            return [f"{self._root}/region-{i}" for i in sorted(self._engine.engines)]
+        return [self._root]
+
+    @property
+    def engine(self):
+        """The current read-only view (atomic reference; swapped whole)."""
+        return self._engine
+
+    @property
+    def read_only(self) -> bool:
+        return True
+
+    def __getattr__(self, name: str):
+        # the full engine surface (query/labels/series/metadata/...)
+        # delegates to the CURRENT view; mutations raise from the view's
+        # own read-only guards. __getattr__ only fires for names this
+        # facade doesn't define. Private names never delegate — during
+        # open, a missing private attr delegating through a missing
+        # `_engine` would recurse.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        eng = self.__dict__.get("_engine")
+        if eng is None:
+            raise AttributeError(name)
+        return getattr(eng, name)
+
+    # -- staleness token ------------------------------------------------------
+    def manifest_epoch(self) -> int:
+        """Floored-monotonic manifest epoch (the staleness token's first
+        half): GC retiring the max record id must not move the surfaced
+        token backwards."""
+        self._epoch_floor = max(self._epoch_floor,
+                                self._engine.manifest_epoch())
+        return self._epoch_floor
+
+    def staleness_ms(self) -> float:
+        """Milliseconds since the view was last CONFIRMED current (an
+        unchanged probe or a completed swap)."""
+        return max(0.0, (time.monotonic() - self._last_sync) * 1000.0)
+
+    def staleness(self) -> dict:
+        return {
+            "manifest_epoch": self.manifest_epoch(),
+            "staleness_ms": round(self.staleness_ms(), 1),
+        }
+
+    def _export(self) -> None:
+        REPLICA_EPOCH.set(self.manifest_epoch())
+        REPLICA_LAG.set(round(self.staleness_ms() / 1000.0, 3))
+
+    # -- the watch loop -------------------------------------------------------
+    async def _root_token(self, eroot: str) -> str:
+        """Change token for one region root: conditional-GET ETag of each
+        table's manifest snapshot + the delta/tombstone/rollup listings.
+        Any commit anywhere in the region changes it (a flush writes a
+        delta; a fold rewrites the snapshot AND empties the delta dir; a
+        delete adds a tombstone record; compaction reshapes all three)."""
+        h = hashlib.blake2b(digest_size=16)
+        for table in ENGINE_TABLES:
+            troot = f"{eroot}/{table}"
+            snap = f"{troot}/manifest/snapshot"
+            try:
+                _data, etag = await self._store.get_if_changed(
+                    snap, self._etags.get(snap)
+                )
+                self._etags[snap] = etag
+            except NotFound:
+                self._etags[snap] = None
+            h.update(str(self._etags[snap]).encode())
+            for sub in ("delta", "tombstone", "rollup"):
+                metas = await self._store.list(f"{troot}/manifest/{sub}")
+                h.update(b"|")
+                h.update(",".join(m.path for m in metas).encode())
+            h.update(b"#")
+        return h.hexdigest()
+
+    async def _descriptor_token(self) -> "str | None":
+        path = f"{self._root}/REGIONS"
+        try:
+            _data, etag = await self._store.get_if_changed(
+                path, self._etags.get(path)
+            )
+            self._etags[path] = etag
+            return etag
+        except NotFound:
+            return None
+
+    async def watch_once(self) -> str:
+        """One probe-and-maybe-swap pass. Returns "unchanged", "refreshed",
+        or raises on store failure (the loop counts + backs off)."""
+        async with self._refresh_lock:
+            refreshed = False
+            if self._regioned:
+                desc = await self._descriptor_token()
+                if desc != self._desc_token:
+                    # meta-plane change (split): the region SET moved —
+                    # reopen the whole tree
+                    await self._swap_full()
+                    self._desc_token = desc
+                    refreshed = True
+                else:
+                    for eroot in self._engine_roots():
+                        tok = await self._root_token(eroot)
+                        if tok != self._tokens.get(eroot):
+                            region_id = int(eroot.rsplit("-", 1)[-1])
+                            await self._swap_region(region_id)
+                            self._tokens[eroot] = tok
+                            refreshed = True
+            else:
+                eroot = self._root
+                tok = await self._root_token(eroot)
+                if tok != self._tokens.get(eroot):
+                    await self._swap_full()
+                    self._tokens[eroot] = tok
+                    refreshed = True
+            self._last_sync = time.monotonic()
+            self._consecutive_errors = 0
+            self._export()
+            if refreshed:
+                REFRESHES.labels("ok").inc()
+                return "refreshed"
+            REFRESHES.labels("unchanged").inc()
+            return "unchanged"
+
+    async def _swap_full(self) -> None:
+        old = self._engine
+        fresh = await self._open_view()
+        fired = invalidate_swapped_views(old, fresh)
+        self._engine = fresh
+        # re-prime per-root tokens (the region set may have changed);
+        # anything committed between token and swap shows as one harmless
+        # extra refresh on the next probe
+        for eroot in self._engine_roots():
+            self._tokens[eroot] = await self._root_token(eroot)
+        await old.close()
+        logger.info(
+            "replica %s: full snapshot swap (epoch %d, %d invalidations)",
+            self._root, self.manifest_epoch(), fired,
+        )
+
+    async def _swap_region(self, region_id: int) -> None:
+        old_sub = self._engine.engines[region_id]
+        # refresh_region swaps inside the RegionedEngine; diff the views
+        # through a one-region facade pair for the funnel events
+        class _One:
+            def __init__(self, sub, rid):
+                self._sub, self._rid = sub, rid
+
+            def sub_engines(self):
+                return {f"region-{self._rid}/": self._sub}
+
+        await self._engine.refresh_region(region_id)
+        invalidate_swapped_views(
+            _One(old_sub, region_id),
+            _One(self._engine.engines[region_id], region_id),
+        )
+        logger.info(
+            "replica %s: region %d snapshot swap (epoch %d)",
+            self._root, region_id, self.manifest_epoch(),
+        )
+
+    def backoff_s(self) -> float:
+        """Current watch-loop delay: the base interval, doubled per
+        consecutive probe failure, capped — a faulted store costs
+        bounded probe traffic, and one success resets the ladder."""
+        if self._consecutive_errors == 0:
+            return self._interval_s
+        return min(
+            self._backoff_cap_s,
+            self._interval_s * (2 ** self._consecutive_errors),
+        )
+
+    def note_watch_error(self) -> None:
+        # jaxlint: disable=J004 loop-confined; fires after watch_once raised OUT of the lock
+        self._consecutive_errors += 1
+        WATCH_ERRORS.inc()
+
+    async def watch_loop(self) -> None:
+        """The background tail loop (server/main.py owns the task)."""
+        while True:
+            try:
+                await self.watch_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — faulted store: backoff
+                self.note_watch_error()
+                REFRESHES.labels("error").inc()
+                self._export()
+                logger.warning(
+                    "replica watch probe failed (%d consecutive): %s",
+                    self._consecutive_errors, e,
+                )
+            await asyncio.sleep(self.backoff_s())
+
+    def start_watch(self) -> None:
+        if self._watch_task is None:
+            self._watch_task = asyncio.create_task(
+                self.watch_loop(), name="cluster-replica-watch"
+            )
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            try:
+                await self._watch_task
+            except asyncio.CancelledError:
+                pass
+            self._watch_task = None
+        await self._engine.close()

@@ -1,0 +1,378 @@
+"""Consistent-hash query router embedded in the HTTP tier.
+
+Placement is rendezvous hashing (cluster/__init__.rendezvous_order):
+every node computes the same ranking from (key, node-set) with no shared
+state, and membership changes move only the keys the departed node
+owned. Three routing decisions live here:
+
+- **Writes** forward to the owning writer. A replica forwards the whole
+  payload; a partial writer (assignment map splits regions) parses the
+  payload once, splits the non-owned series per owner with the SAME
+  subset machinery the regioned engine uses, re-encodes each subset to
+  wire bytes (`encode_write_request` — exact inverse of the parser for
+  the label/sample/exemplar surface), and forwards them while its own
+  subset lands locally.
+- **Reads** on a writer fan across healthy replicas (rendezvous on the
+  query identity so one panel's repeats hit one replica's caches), with
+  hedged failover: a replica error or non-2xx marks it unhealthy and the
+  query serves from the local engine instead — never a user-visible
+  failure because a replica died.
+- **Health** comes from `/api/v1/cluster/status` probes on an interval
+  plus request outcomes; a recovered probe restores the peer.
+
+Forwarded requests carry `X-Horaedb-Forwarded: 1`; a node never re-routes
+a forwarded request (loop guard).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+import numpy as np
+
+from horaedb_tpu.cluster import (
+    FAILOVERS,
+    FORWARDS,
+    PEER_HEALTHY,
+    ClusterConfig,
+    ClusterPeer,
+    rendezvous_order,
+)
+
+logger = logging.getLogger(__name__)
+
+FORWARD_HEADER = "X-Horaedb-Forwarded"
+STALENESS_HEADER = "X-Horaedb-Staleness-Ms"
+STATUS_PATH = "/api/v1/cluster/status"
+
+# request headers that must not be copied onto a forwarded request (hop
+# metadata; aiohttp recomputes them for the new body/connection)
+_HOP_HEADERS = frozenset((
+    "host", "content-length", "transfer-encoding", "connection",
+    "accept-encoding",
+))
+
+
+def encode_write_request(req) -> bytes:
+    """Re-encode a ParsedWriteRequest to remote-write wire bytes — the
+    forwarding inverse of the parser for labels/samples/exemplars/
+    metadata. Samples ride the parser's per-series grouping lanes
+    (series_sample_start/count), so this is O(total rows), not
+    O(series x samples)."""
+    from horaedb_tpu.pb import remote_write_pb2
+
+    pb = remote_write_pb2.WriteRequest()
+    ex_by_series: dict[int, list[int]] = {}
+    for i, s in enumerate(np.asarray(req.exemplar_series).tolist()):
+        ex_by_series.setdefault(int(s), []).append(i)
+    for s in range(req.n_series):
+        ts = pb.timeseries.add()
+        for k, v in req.series_labels(s):
+            lab = ts.labels.add()
+            lab.name = bytes(k)
+            lab.value = bytes(v)
+        start = int(req.series_sample_start[s])
+        count = int(req.series_sample_count[s])
+        for i in range(start, start + count):
+            smp = ts.samples.add()
+            smp.timestamp = int(req.sample_ts[i])
+            smp.value = float(req.sample_value[i])
+        for i in ex_by_series.get(s, ()):
+            ex = ts.exemplars.add()
+            ex.timestamp = int(req.exemplar_ts[i])
+            ex.value = float(req.exemplar_value[i])
+            for k, v in req.exemplar_labels(i):
+                lab = ex.labels.add()
+                lab.name = bytes(k)
+                lab.value = bytes(v)
+    for i in range(len(req.meta_type)):
+        md = pb.metadata.add()
+        md.type = int(req.meta_type[i])
+        md.metric_family_name = bytes(req.meta_name(i))
+    return pb.SerializeToString()
+
+
+def split_by_owner(parsed, range_router, assignment, local_node: str):
+    """Partial-writer write split: (local ParsedWriteRequest | None,
+    {owner_node: wire payload}) — series whose region this node owns
+    stay local; the rest group per owning node and re-encode for
+    forwarding. Unassigned regions fall to the local node (better a
+    ReplicaReadOnlyError naming the problem than a dropped batch)."""
+    from horaedb_tpu.engine.region import RegionedEngine, _subset_request
+
+    if parsed.n_series == 0:
+        return parsed, {}
+    # per-series region ids via the same lanes the regioned engine routes
+    # by (recomputed when the native parser didn't supply them)
+    need_tsids = range_router.granularity == "series"
+    if parsed.series_metric_id is not None and (
+        not need_tsids or parsed.series_tsid is not None
+    ):
+        mids = parsed.series_metric_id
+        tsids = parsed.series_tsid if need_tsids else mids
+    else:
+        shim = object.__new__(RegionedEngine)
+        mids, tsids = RegionedEngine._hash_lanes(shim, parsed, need_tsids)
+    regions = range_router.regions_of_lanes(mids, tsids)
+    owners = np.asarray([
+        assignment.owner_of(int(r)) or local_node for r in regions.tolist()
+    ])
+    local_mask = owners == local_node
+    local = None
+    if bool(local_mask.all()):
+        return parsed, {}
+    if bool(local_mask.any()):
+        local = _subset_request(parsed, np.flatnonzero(local_mask))
+    remote: dict[str, bytes] = {}
+    for node in sorted(set(owners.tolist()) - {local_node}):
+        sub = _subset_request(parsed, np.flatnonzero(owners == node))
+        remote[node] = encode_write_request(sub)
+    return local, remote
+
+
+class ClusterRouter:
+    """Peer table + health + forwarding client for one node."""
+
+    def __init__(self, config: ClusterConfig, node_id: str):
+        self.config = config
+        self.node_id = node_id
+        # peers EXCLUDING self (a config listing every member everywhere
+        # is the deployment-friendly shape)
+        self.peers: dict[str, ClusterPeer] = {
+            p.node: p for p in config.peers if p.node != node_id
+        }
+        self._healthy: dict[str, bool] = {n: True for n in self.peers}
+        self._peer_status: dict[str, dict] = {}
+        self._assignment = None  # cluster/assignment.Assignment | None
+        self._session = None
+        self._probe_task: "asyncio.Task | None" = None
+        for n in self.peers:
+            PEER_HEALTHY.labels(n).set(1)
+
+    # -- membership views -----------------------------------------------------
+    def replica_nodes(self) -> "list[str]":
+        return sorted(
+            n for n, p in self.peers.items()
+            if p.role == "replica" and self._healthy.get(n)
+        )
+
+    def writer_nodes(self) -> "list[str]":
+        return sorted(
+            n for n, p in self.peers.items()
+            if p.role == "writer" and self._healthy.get(n)
+        )
+
+    def set_assignment(self, assignment) -> None:
+        self._assignment = assignment
+
+    def _adopt_assignment(self, status_body: dict) -> None:
+        """Converge on ownership changes made elsewhere: a peer's status
+        payload carries its assignment view; a HIGHER version than ours
+        is adopted, so a takeover on one node re-routes every other
+        node's writes within one probe interval — without this, a
+        deposed owner would be routed to forever."""
+        from horaedb_tpu.cluster.assignment import Assignment
+
+        try:
+            asg = (status_body.get("data") or {}).get("assignment")
+            if not asg:
+                return
+            version = int(asg.get("version", 0))
+            if (self._assignment is not None
+                    and version <= self._assignment.version):
+                return
+            self._assignment = Assignment(
+                version=version,
+                regions={int(r): str(n)
+                         for r, n in dict(asg.get("regions") or {}).items()},
+            )
+            logger.info("adopted assignment v%d from peer status", version)
+        except Exception:  # noqa: BLE001 — a malformed peer payload must
+            # never kill the probe loop; the store remains ground truth
+            logger.warning("ignoring malformed peer assignment payload",
+                           exc_info=True)
+
+    @property
+    def assignment(self):
+        return self._assignment
+
+    def owner_node(self, region_id: int = 0) -> "str | None":
+        if self._assignment is not None:
+            owner = self._assignment.owner_of(region_id)
+            if owner and owner != self.node_id:
+                return owner
+            if owner == self.node_id:
+                return None  # we own it
+        # no assignment state: any healthy writer peer is the best guess
+        writers = self.writer_nodes()
+        return writers[0] if writers else None
+
+    def write_targets(self, region_id: int = 0) -> "list[str]":
+        """Forward candidates for a whole-payload write, in order: the
+        assigned owner first, then every other healthy writer — a dead
+        owner must not 503 writes that any healthy writer could land or
+        split-forward itself (partial writers re-split on arrival)."""
+        out: list[str] = []
+        owner = self.owner_node(region_id)
+        if owner is not None:
+            out.append(owner)
+        for n in self.writer_nodes():
+            if n not in out:
+                out.append(n)
+        return out
+
+    def peer_url(self, node: str) -> "str | None":
+        p = self.peers.get(node)
+        return p.url or None if p is not None else None
+
+    def pick_read_peer(self, key: bytes) -> "ClusterPeer | None":
+        """Rendezvous-ranked healthy replica for this query identity, or
+        None (serve locally). Keying by query identity keeps one panel's
+        repeats on one replica — its result cache earns its hit rate."""
+        nodes = self.replica_nodes()
+        if not nodes:
+            return None
+        for node in rendezvous_order(key, nodes):
+            p = self.peers.get(node)
+            if p is not None and p.url:
+                return p
+        return None
+
+    # -- health ---------------------------------------------------------------
+    def mark_unhealthy(self, node: str) -> None:
+        if self._healthy.get(node):
+            logger.warning("cluster peer %s marked unhealthy", node)
+        self._healthy[node] = False
+        PEER_HEALTHY.labels(node).set(0)
+
+    def mark_healthy(self, node: str) -> None:
+        if self._healthy.get(node) is False:
+            logger.info("cluster peer %s recovered", node)
+        self._healthy[node] = True
+        PEER_HEALTHY.labels(node).set(1)
+
+    def peer_status(self) -> dict:
+        return {
+            n: {
+                "role": p.role,
+                "url": p.url,
+                "healthy": bool(self._healthy.get(n)),
+                **({"manifest_epoch":
+                        self._peer_status[n].get("manifest_epoch")}
+                   if n in self._peer_status else {}),
+            }
+            for n, p in sorted(self.peers.items())
+        }
+
+    async def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30, connect=5),
+            )
+        return self._session
+
+    async def probe_once(self) -> None:
+        """One health sweep: GET every peer's cluster status."""
+        import aiohttp
+
+        session = await self._ensure_session()
+        for node, peer in self.peers.items():
+            if not peer.url:
+                continue
+            try:
+                async with session.get(
+                    peer.url.rstrip("/") + STATUS_PATH,
+                    timeout=aiohttp.ClientTimeout(total=5),
+                ) as resp:
+                    if resp.status == 200:
+                        body = await resp.json()
+                        self._peer_status[node] = body
+                        self.mark_healthy(node)
+                        self._adopt_assignment(body)
+                    else:
+                        self.mark_unhealthy(node)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — unreachable peer
+                self.mark_unhealthy(node)
+
+    async def probe_loop(self) -> None:
+        interval = self.config.probe_interval.seconds
+        while True:
+            try:
+                await self.probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — keep probing
+                logger.exception("cluster probe sweep failed")
+            await asyncio.sleep(interval)
+
+    def start_probes(self) -> None:
+        if self._probe_task is None and self.peers:
+            self._probe_task = asyncio.create_task(
+                self.probe_loop(), name="cluster-peer-probe"
+            )
+
+    # -- forwarding -----------------------------------------------------------
+    async def forward(
+        self,
+        node: str,
+        method: str,
+        path_qs: str,
+        headers,
+        body: "bytes | None",
+        kind: str,
+    ):
+        """Proxy one request to `node`; returns (status, headers, body)
+        or None when the peer is unknown/unreachable (the caller serves
+        locally / errors). Outcome feeds the peer's health."""
+        url = self.peer_url(node)
+        if url is None:
+            return None
+        import aiohttp
+
+        session = await self._ensure_session()
+        fwd_headers = {
+            k: v for k, v in headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        fwd_headers[FORWARD_HEADER] = "1"
+        t0 = time.perf_counter()
+        try:
+            async with session.request(
+                method, url.rstrip("/") + path_qs,
+                data=body, headers=fwd_headers,
+            ) as resp:
+                out = await resp.read()
+                FORWARDS.labels(kind).inc()
+                if resp.status >= 500:
+                    self.mark_unhealthy(node)
+                return resp.status, dict(resp.headers), out
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — peer down mid-request
+            self.mark_unhealthy(node)
+            logger.warning(
+                "forward %s %s to %s failed after %.3fs: %s",
+                method, path_qs, node, time.perf_counter() - t0, e,
+            )
+            return None
+
+    def note_failover(self) -> None:
+        FAILOVERS.inc()
+
+    async def close(self) -> None:
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except asyncio.CancelledError:
+                pass
+            self._probe_task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

@@ -73,6 +73,14 @@ class UnavailableError(RetryableError):
         self.retry_after_s = retry_after_s
 
 
+class ReplicaReadOnlyError(PersistentError):
+    """This process holds a READ-ONLY replica view of the data (cluster
+    role = "replica", or a non-owned region on a writer): the mutation
+    must run on the owning writer instead. Persistent in the taxonomy —
+    retrying HERE can never succeed; the HTTP router forwards the write
+    to the owner (cluster/router.py) rather than 500ing."""
+
+
 class DeadlineExceeded(HoraeError):
     """The end-to-end deadline of the request driving this work expired
     (common/deadline.py carries the token; every natural yield point of

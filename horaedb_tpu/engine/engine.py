@@ -150,6 +150,7 @@ class MetricEngine:
         retention_period_ms: int | None = None,
         max_series: int = 0,
         serving=None,
+        read_only: bool = False,
     ) -> "MetricEngine":
         """`ingest_buffer_rows` > 0 buffers data-table rows across writes
         and flushes as one SST per segment when the threshold is reached
@@ -182,10 +183,22 @@ class MetricEngine:
         `serving`: ServingTierConfig for the dashboard serving tier
         (horaedb_tpu/serving — compaction-time rollups, the result
         cache, device block residency). None = defaults (ON: the tier
-        is bit-exact vs forced-cold scans by construction)."""
+        is bit-exact vs forced-cold scans by construction).
+
+        `read_only`: cluster replica mode (horaedb_tpu/cluster): open a
+        read-only VIEW over a root a writer process owns on the shared
+        store — no fence, no compaction, no flush pipeline, no sidecar
+        dumps; every mutation raises ReplicaReadOnlyError. Queries work
+        unchanged with bounded staleness (the replica's watch loop swaps
+        in fresh views)."""
         from horaedb_tpu.serving import ServingTier
 
         self = object.__new__(cls)
+        self._read_only = read_only
+        if read_only:
+            fence_node_id = None
+            enable_compaction = False
+            ingest_buffer_rows = 0
         self._store = store
         self._segment_duration = segment_duration_ms
         self._pool = parser_pool
@@ -248,6 +261,7 @@ class MetricEngine:
                 # row-exact retention + time-range tombstone deletes
                 # (storage/visibility.py) need the schema's time column
                 time_column="ts" if sample_table else None,
+                read_only=read_only,
             )
 
         self.metrics_table = await open_table(
@@ -277,6 +291,7 @@ class MetricEngine:
             sidecar_store=store,
             sidecar_path=f"{root}/index_sidecar/base.arrow",
             tags_storage=self.tags_table,
+            read_only=read_only,
         )
         # Payload-shape fingerprint cache: scrapers resend the same series
         # set every interval, so the (metric_id, tsid) lane BYTES repeat
@@ -314,6 +329,32 @@ class MetricEngine:
         """Uniform enumeration for observability surfaces — one unpartitioned
         engine; RegionedEngine returns one entry per region."""
         return {"": self}
+
+    @property
+    def read_only(self) -> bool:
+        """True in cluster replica mode (see `open`'s read_only)."""
+        return self._read_only
+
+    def manifest_epoch(self) -> int:
+        """Monotonic catch-up token over ALL six tables' manifests: the
+        replica's view matches the writer's exactly when the epochs are
+        equal (cluster/replica.py floors it so the surfaced token never
+        moves backwards across GC)."""
+        return max(
+            t.manifest_epoch()
+            for t in (self.metrics_table, self.series_table,
+                      self.index_table, self.tags_table,
+                      self.data_table, self.exemplars_table)
+        )
+
+    def _ensure_writable(self, what: str) -> None:
+        if self._read_only:
+            from horaedb_tpu.common.error import ReplicaReadOnlyError
+
+            raise ReplicaReadOnlyError(
+                f"engine {self._table_label} is a read-only replica view; "
+                f"refusing {what} (route the mutation to the owning writer)"
+            )
 
     async def flush(self) -> None:
         """Flush any buffered ingest rows to durable SSTs (waits out any
@@ -360,6 +401,7 @@ class MetricEngine:
         (ingest/types.py), id resolution is pure numpy + set probes — no
         per-series label slicing or Python seahash (the reference hash
         contract lives in C++, src/metric_engine/src/types.rs:18-41)."""
+        self._ensure_writable("write_parsed")
         if len(req.meta_type):
             self._record_metadata(req)
         if req.n_series == 0:
@@ -584,6 +626,8 @@ class MetricEngine:
         materialized to owned bytes before the await). Exemplar persistence
         and threshold flushes use owned copies and run after release."""
         import asyncio
+
+        self._ensure_writable("write_payload")
 
         from horaedb_tpu.ingest import ParserPool
 
@@ -916,6 +960,7 @@ class MetricEngine:
         overlapping that window; None compacts globally."""
         from horaedb_tpu.storage.read import CompactRequest
 
+        self._ensure_writable("compact")
         await self.data_table.compact(CompactRequest(time_range=time_range))
 
     # -- deletes ---------------------------------------------------------------
@@ -946,6 +991,8 @@ class MetricEngine:
         sequence below the tombstone's and is therefore covered — the
         delete-then-crash-then-replay case cannot resurrect data."""
         from horaedb_tpu.storage.visibility import build_series_matchers
+
+        self._ensure_writable("delete_series")
 
         if end_ms is None:
             end_ms = now_ms() + 1

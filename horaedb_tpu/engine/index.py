@@ -234,10 +234,15 @@ class IndexManager:
         sidecar_store=None,
         sidecar_path: str = "",
         tags_storage=None,
+        read_only: bool = False,
     ):
         self._series = series_storage
         self._index = index_storage
         self._segment_duration = segment_duration_ms
+        # cluster replica mode: the index is a VIEW rebuilt from another
+        # writer's tables — never dump the sidecar cache or backfill tags
+        # rows (both are store writes a replica must not issue)
+        self._read_only = read_only
         # RFC :118-130 optional `tags` table: one row per distinct
         # (metric, key, value) — the storage-backed LabelValues surface.
         # pk = (metric_id, tag_hash): the engine accepts 64-bit hash
@@ -287,6 +292,8 @@ class IndexManager:
             return
         await self._rebuild_from_tables()
         await self._backfill_tags()
+        if self._read_only:
+            return  # a replica view never writes the sidecar cache
         # make the NEXT open fast even if this process never closes cleanly;
         # best-effort — the sidecar is a cache, a failed put must not abort
         # an open whose rebuild just succeeded
@@ -354,7 +361,7 @@ class IndexManager:
         quiesced (open/close): with registrations in flight, a row can be
         durable in an SST <= watermark but not yet committed to the delta,
         and the dump would lose it."""
-        if self._sidecar_store is None:
+        if self._sidecar_store is None or self._read_only:
             return
         with self._mu:
             base = dict(self._base)
@@ -869,7 +876,8 @@ class IndexManager:
         existed has series/index rows but no tags rows — backfill distinct
         pairs from the freshly-opened in-memory index so
         label_values_storage agrees with label_values on legacy stores."""
-        if self._tags is None or self._tags._manifest.all_ssts():
+        if self._read_only or self._tags is None \
+                or self._tags._manifest.all_ssts():
             return
         with self._mu:
             base = dict(self._base)

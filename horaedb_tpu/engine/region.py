@@ -239,8 +239,19 @@ class RegionedEngine:
         num_regions: int,
         parser_pool=None,
         granularity: str = "series",
+        writable_regions: "set[int] | None" = None,
         **engine_kwargs,
     ) -> "RegionedEngine":
+        """`writable_regions`: cluster partial-writer mode (the
+        assignment map splits regions across writer processes,
+        cluster/assignment.py) — regions IN the set open as writers
+        (fenced when fence_node_id is configured), every other region
+        opens as a read-only replica view, so this process can still
+        serve full fan-out reads while writes to non-owned regions raise
+        ReplicaReadOnlyError for the HTTP router to forward. None = all
+        regions writable (the single-writer deployment). Passing
+        read_only=True in engine_kwargs makes EVERY region a view (the
+        replica role)."""
         import asyncio
         import json
 
@@ -290,6 +301,11 @@ class RegionedEngine:
                 )
                 self.router = RegionRouter(num_regions)
         except NotFound:
+            if engine_kwargs.get("read_only"):
+                # a replica cannot mint the meta-plane descriptor: the
+                # writer owns the layout; surface NotFound so the caller
+                # (cluster/replica.py) retries until the writer booted
+                raise
             self.router = RangeRouter(
                 [i * _TOP // num_regions for i in range(num_regions)],
                 list(range(num_regions)),
@@ -302,6 +318,9 @@ class RegionedEngine:
             )
 
         self._engine_kwargs = engine_kwargs
+        self._writable_regions = (
+            None if writable_regions is None else set(writable_regions)
+        )
         self._split_lock = asyncio.Lock()
         region_ids = (self.router.ids if isinstance(self.router, RangeRouter)
                       else list(range(num_regions)))
@@ -309,7 +328,8 @@ class RegionedEngine:
         try:
             for i in region_ids:
                 self.engines[i] = await MetricEngine.open(
-                    f"{root}/region-{i}", store, **engine_kwargs
+                    f"{root}/region-{i}", store,
+                    **self._region_kwargs(i),
                 )
         except BaseException:
             # close the regions that did open — a retry loop must not leak
@@ -324,6 +344,85 @@ class RegionedEngine:
     @property
     def _legacy(self) -> bool:
         return not isinstance(self.router, RangeRouter)
+
+    def _region_kwargs(self, region_id: int) -> dict:
+        """Per-region open kwargs: non-owned regions under a partial
+        writer open as read-only views (no fence claimed — the owning
+        writer holds it)."""
+        kw = dict(self._engine_kwargs)
+        if (self._writable_regions is not None
+                and region_id not in self._writable_regions
+                and not kw.get("read_only")):
+            kw["read_only"] = True
+            kw.pop("fence_node_id", None)
+            kw.pop("fence_validate_interval_s", None)
+        return kw
+
+    @property
+    def read_only(self) -> bool:
+        """True when EVERY region is a read-only view (the replica role)."""
+        return all(e.read_only for e in self.engines.values())
+
+    def writable_region_ids(self) -> list[int]:
+        return sorted(i for i, e in self.engines.items() if not e.read_only)
+
+    def manifest_epoch(self) -> int:
+        """Max manifest epoch across regions (the cluster catch-up token)."""
+        return max(e.manifest_epoch() for e in self.engines.values())
+
+    def region_epochs(self) -> "dict[int, int]":
+        """Per-region manifest epochs (/api/v1/cluster/status payload)."""
+        return {i: e.manifest_epoch() for i, e in self.engines.items()}
+
+    async def promote_region(self, region_id: int, fence_node_id: str) -> int:
+        """Cluster takeover: reopen a read-only region as a WRITER. The
+        fresh open acquires a new (higher) epoch fence on the region
+        root — the acquisition IS the deposing step for whatever process
+        last owned it (storage/fence.py). Returns the claimed epoch."""
+        from horaedb_tpu.common.error import ensure
+
+        # state checks INSIDE the lock: a concurrent refresh/promote must
+        # not race this one to a double-swap (the loser would close an
+        # engine the winner just installed)
+        async with self._split_lock:
+            old = self.engines.get(region_id)
+            ensure(old is not None, f"unknown region {region_id}")
+            ensure(old.read_only, f"region {region_id} is already writable")
+            if self._writable_regions is not None:
+                self._writable_regions.add(region_id)
+            kw = dict(self._engine_kwargs)
+            kw.pop("read_only", None)
+            kw["fence_node_id"] = fence_node_id
+            fresh = await MetricEngine.open(
+                f"{self._root}/region-{region_id}", self._store, **kw,
+            )
+            self.engines[region_id] = fresh
+            await old.close()
+            return fresh._fence.epoch if fresh._fence is not None else 0
+
+    async def refresh_region(self, region_id: int) -> int:
+        """Cluster snapshot swap for ONE read-only region: open a fresh
+        view over the shared store and atomically swap it in (in-flight
+        queries keep the old engine via their own references; read-only
+        engines hold no background state, so closing the old one after
+        the swap is safe). Returns the fresh region epoch. Only valid on
+        read-only regions — a writable region's state is already live.
+        Serialized with promote/split: a refresh racing a promotion must
+        not revert the freshly-claimed writer to a stale view."""
+        from horaedb_tpu.common.error import ensure
+
+        async with self._split_lock:
+            old = self.engines.get(region_id)
+            ensure(old is not None, f"unknown region {region_id}")
+            ensure(old.read_only,
+                   f"region {region_id} is writable; refresh is a replica op")
+            fresh = await MetricEngine.open(
+                f"{self._root}/region-{region_id}", self._store,
+                **self._region_kwargs(region_id),
+            )
+            self.engines[region_id] = fresh
+            await old.close()
+            return fresh.manifest_epoch()
 
     async def split_region(self, region_id: int) -> int:
         """Halve `region_id`'s hash range; returns the daughter region id.
@@ -340,13 +439,23 @@ class RegionedEngine:
         ensure(not self._legacy,
                "legacy v1 region stores cannot split; recreate with the "
                "range-partitioned layout")
+        ensure(not self._engine_kwargs.get("read_only"),
+               "a replica cannot split regions (meta-plane writes belong "
+               "to the writer)")
+        parent = self.engines.get(region_id)
+        ensure(parent is not None and not parent.read_only,
+               f"region {region_id} is not writable by this process; the "
+               "owning writer must run the split")
         # serialized: concurrent splits reading the same router would mint
         # the same daughter id and open two engines on one sub-root
         async with self._split_lock:
             new_router, new_id, _mid = self.router.split(region_id)
+            if self._writable_regions is not None:
+                # the daughter inherits the parent's ownership
+                self._writable_regions.add(new_id)
             self.engines[new_id] = await MetricEngine.open(
                 f"{self._root}/region-{new_id}", self._store,
-                **self._engine_kwargs,
+                **self._region_kwargs(new_id),
             )
             # engine first, descriptor second: a crash between the two
             # leaves an empty unreferenced sub-root (harmless), never a

@@ -11,6 +11,7 @@ to threads so manifest/compaction loops never block the event loop.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import itertools
 import os
 import shutil
@@ -71,6 +72,24 @@ class ObjectStore(ABC):
 
     @abstractmethod
     async def get(self, path: str) -> bytes: ...
+
+    async def get_if_changed(
+        self, path: str, etag: "str | None"
+    ) -> "tuple[bytes | None, str]":
+        """Conditional GET — the cluster watch primitive (HTTP 304 /
+        If-None-Match analog). Returns `(data, new_etag)` when the object
+        differs from `etag`, `(None, etag)` when unchanged; raises
+        NotFound on a missing object like `get`. `etag=None` always
+        fetches. The default is an unconditional GET plus a content
+        digest compare — correct for every backend; stores with real
+        ETags (S3-likes) override so an unchanged probe costs one 304,
+        not a transfer. Read replicas tail manifests with this
+        (horaedb_tpu/cluster/replica.py)."""
+        data = await self.get(path)
+        new = "d:" + hashlib.blake2b(data, digest_size=16).hexdigest()
+        if etag is not None and new == etag:
+            return None, etag
+        return data, new
 
     @abstractmethod
     async def list(self, prefix: str) -> list[ObjectMeta]: ...
@@ -240,6 +259,31 @@ class LocalStore(ObjectStore):
                 raise NotFound(f"object not found: {path}") from None
 
         return await asyncio.to_thread(_get)
+
+    async def get_if_changed(
+        self, path: str, etag: "str | None"
+    ) -> "tuple[bytes | None, str]":
+        """Stat-token conditional GET: (inode, mtime_ns, size) names the
+        object version — every put lands via os.replace, so a changed
+        object is a NEW inode. An unchanged probe costs one stat, no
+        read (the watch-loop economy the base digest default can't give
+        a filesystem store)."""
+        def _probe():
+            fs = self._fs_path(path)
+            try:
+                st = os.stat(fs)
+            except FileNotFoundError:
+                raise NotFound(f"object not found: {path}") from None
+            tok = f"s:{st.st_ino}:{st.st_mtime_ns}:{st.st_size}"
+            if etag is not None and tok == etag:
+                return None, tok
+            try:
+                with open(fs, "rb") as f:
+                    return f.read(), tok
+            except FileNotFoundError:
+                raise NotFound(f"object not found: {path}") from None
+
+        return await asyncio.to_thread(_probe)
 
     async def list(self, prefix: str) -> list[ObjectMeta]:
         def _list() -> list[ObjectMeta]:

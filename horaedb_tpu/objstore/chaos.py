@@ -201,6 +201,14 @@ class ChaosStore(ObjectStore):
         self._post("get", path, faults)
         return data
 
+    async def get_if_changed(self, path: str, etag):
+        # the conditional GET is a get for fault purposes: same error
+        # rates/latency/crash points (the replica watch loop under test)
+        faults = await self._pre("get", path)
+        out = await self._inner.get_if_changed(path, etag)
+        self._post("get", path, faults)
+        return out
+
     async def list(self, prefix: str) -> list[ObjectMeta]:
         faults = await self._pre("list", prefix)
         out = await self._inner.list(prefix)

@@ -54,6 +54,14 @@ class FakeS3:
         self._fail_budget = n
         self._fail_status = status
 
+    def _etag(self, key: str) -> str:
+        """S3-shaped quoted ETag over the object content (md5 like real
+        single-part uploads — it only has to be stable and
+        content-addressed for the conditional-GET contract)."""
+        import hashlib
+
+        return f'"{hashlib.md5(self.objects[key]).hexdigest()}"'
+
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> str:
@@ -126,11 +134,18 @@ class FakeS3:
             ):
                 return web.Response(status=412, text="PreconditionFailed")
             self.objects[key] = await request.read()
-            return web.Response(status=200)
+            return web.Response(status=200,
+                                headers={"ETag": self._etag(key)})
         if key not in self.objects:
             return web.Response(status=404, text="NoSuchKey")
         if request.method == "GET":
-            return web.Response(body=self.objects[key])
+            # conditional GET (the cluster watch primitive): a matching
+            # If-None-Match answers 304 with no body, like real S3
+            etag = self._etag(key)
+            if request.headers.get("If-None-Match") == etag:
+                return web.Response(status=304, headers={"ETag": etag})
+            return web.Response(body=self.objects[key],
+                                headers={"ETag": etag})
         if request.method == "HEAD":
             return web.Response(
                 headers={"Content-Length": str(len(self.objects[key]))}

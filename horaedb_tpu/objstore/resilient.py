@@ -391,6 +391,12 @@ class ResilientStore(ObjectStore):
     async def get(self, path: str) -> bytes:
         return await self._call("get", self._inner.get, path)
 
+    async def get_if_changed(self, path: str, etag):
+        """Conditional GET rides the `get` verb's retry/breaker/metrics
+        (it IS a get, economized); an "unchanged" answer counts as a
+        success like the other semantic results."""
+        return await self._call("get", self._inner.get_if_changed, path, etag)
+
     async def list(self, prefix: str) -> list[ObjectMeta]:
         return await self._call("list", self._inner.list, prefix)
 

@@ -254,10 +254,15 @@ class S3LikeStore(ObjectStore):
         uri: str | None = None,
         extra_headers: dict[str, str] | None = None,
         allow_statuses: tuple[int, ...] = (),
+        header_names: tuple[str, ...] = (),
     ):
         """One signed request with bounded retries. Returns (status, body,
-        content_length). 404 surfaces as NotFound; other 4xx raise S3Error
-        immediately; 5xx/429 and transport errors retry.
+        content_length) — plus a dict of the response headers named in
+        `header_names` (keyed EXACTLY as passed; aiohttp's lookup is
+        case-insensitive, the returned dict's is not) appended as a 4th
+        element when any are requested. 404 surfaces as NotFound; other
+        4xx raise S3Error immediately; 5xx/429 and transport errors
+        retry.
 
         `extra_headers` ride unsigned (legal in SigV4 — only SignedHeaders
         participate in the signature); conditional headers like
@@ -304,8 +309,13 @@ class S3LikeStore(ObjectStore):
                     timeout=req_timeout,
                 ) as resp:
                     body = await resp.read()
+                    got = (
+                        {n: resp.headers.get(n, "") for n in header_names}
+                        if header_names else None
+                    )
                     if resp.status in allow_statuses:
-                        return resp.status, body, 0
+                        return ((resp.status, body, 0, got)
+                                if got is not None else (resp.status, body, 0))
                     if resp.status == 404:
                         raise NotFound(f"object not found: {key}")
                     if resp.status in (429,) or resp.status >= 500:
@@ -316,7 +326,9 @@ class S3LikeStore(ObjectStore):
                         )
                     else:
                         clen = int(resp.headers.get("Content-Length", len(body)))
-                        return resp.status, body, clen
+                        return ((resp.status, body, clen, got)
+                                if got is not None
+                                else (resp.status, body, clen))
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 last = f"{type(e).__name__}: {e}"
             if attempt + 1 < attempts:
@@ -381,6 +393,33 @@ class S3LikeStore(ObjectStore):
     async def get(self, path: str) -> bytes:
         _, body, _ = await self._request("GET", self._key(path), io=True)
         return body
+
+    async def get_if_changed(
+        self, path: str, etag: "str | None"
+    ) -> "tuple[bytes | None, str]":
+        """Real conditional GET: `If-None-Match: <etag>` answers 304 with
+        no body when the object is unchanged — the watch-loop probe costs
+        a round-trip, never a transfer. The same fence-probe machinery
+        pattern as put_if_absent: the condition rides unsigned extra
+        headers through the signed request path. Stores that ignore the
+        condition (200 + full body on a match) degrade gracefully: the
+        returned ETag compare below restores the unchanged verdict, only
+        the transfer economy is lost."""
+        extra = {"If-None-Match": etag} if etag else None
+        status, body, _clen, hdrs = await self._request(
+            "GET", self._key(path), io=True, extra_headers=extra,
+            allow_statuses=(304,), header_names=("ETag",),
+        )
+        new = hdrs.get("ETag", "") or ""
+        if status == 304:
+            return None, etag or new
+        if not new:
+            # no ETag from this endpoint: fall back to a content digest
+            # so the caller's change detection stays sound
+            new = "d:" + hashlib.blake2b(body, digest_size=16).hexdigest()
+        if etag is not None and new == etag:
+            return None, etag
+        return body, new
 
     async def head(self, path: str) -> ObjectMeta:
         _, _, clen = await self._request("HEAD", self._key(path))

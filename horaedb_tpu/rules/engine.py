@@ -305,6 +305,20 @@ class RuleEngine:
             f"rule {rule.name!r} already exists with the other kind; "
             "delete it first",
         )
+        if rule.kind == "recording" and getattr(rule, "group", ""):
+            # rule-group contract: one shared interval per group, so the
+            # whole chain rides one aligned step grid (members evaluate
+            # in order within one tick — _tick_recording)
+            for other in self._recording.values():
+                o = other.rule
+                ensure(
+                    o.name == rule.name
+                    or getattr(o, "group", "") != rule.group
+                    or o.interval_ms == rule.interval_ms,
+                    f"rule {rule.name}: group {rule.group!r} has interval "
+                    f"{o.interval_ms}ms (from {o.name}); group members "
+                    "share one interval",
+                )
         replacing_recording = rule.name in self._recording
         await self._store.put_rule(rule)
         if replacing_recording:
@@ -606,9 +620,40 @@ class RuleEngine:
     # -- recording rules ------------------------------------------------------
     async def _tick_recording(self, now: int, snapshot: int,
                               summary: dict) -> None:
+        """Ungrouped rules keep the batched one-write-back tick; rule
+        GROUPS evaluate sequentially in (group_order, name) order with a
+        per-member write-back, so a chain (B reads A's output) lands
+        deterministically in ONE tick: A's write-back fires the funnel
+        event B's per-member snapshot then includes."""
+        grouped: dict[str, list[str]] = {}
+        ungrouped: list[str] = []
+        for name in sorted(self._recording):
+            g = getattr(self._recording[name].rule, "group", "")
+            if g:
+                grouped.setdefault(g, []).append(name)
+            else:
+                ungrouped.append(name)
+        await self._tick_recording_set(now, snapshot, summary, ungrouped)
+        for g in sorted(grouped):
+            members = sorted(
+                grouped[g],
+                key=lambda n: (self._recording[n].rule.group_order, n),
+            )
+            for name in members:
+                if name not in self._recording:
+                    continue  # deleted over HTTP mid-tick
+                # per-member snapshot: predecessors' write-backs already
+                # fired their events — the chain resolves this tick
+                member_snapshot = self._next_event - 1
+                await self._tick_recording_set(
+                    now, member_snapshot, summary, [name]
+                )
+
+    async def _tick_recording_set(self, now: int, snapshot: int,
+                                  summary: dict, names: list) -> None:
         plans = []  # (rt, target, data_hi', samples, clears)
         out_names = set()
-        for name in sorted(self._recording):
+        for name in names:
             rt = self._recording.get(name)
             if rt is None:
                 continue  # deleted over HTTP while this tick awaited

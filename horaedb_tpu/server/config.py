@@ -77,6 +77,13 @@ def _batching_mod():
     return batching
 
 
+def _cluster_mod():
+    # deferred: cluster registers the horaedb_cluster_* families
+    from horaedb_tpu import cluster
+
+    return cluster
+
+
 @dataclass
 class TestConfig:
     """Self-write load generator (reference config.rs TestConfig)."""
@@ -358,6 +365,13 @@ class MetricEngineConfig:
     # telemetry/slo.py): each expands into recording + alert rules over
     # the self-scraped series at boot (requires rules.enabled).
     slo: list = field(default_factory=list)
+    # Cluster layer ([metric_engine.cluster], horaedb_tpu/cluster):
+    # stateless read replicas over the shared object store, the
+    # region-assignment map, and the rendezvous query router. Disabled =
+    # the single-process behavior, byte-identical.
+    cluster: "ClusterConfig" = field(
+        default_factory=lambda: _cluster_mod().ClusterConfig()
+    )
     storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
     # Ingest buffering (engine/data.py SampleManager): 0 = every write is
     # immediately durable (reference write==SST semantics); > 0 buffers up
@@ -524,6 +538,24 @@ class Config:
             # validate every block NOW: a typo'd SLO must fail boot, not
             # the first evaluator tick
             _telemetry_mod().expand_slos(self.metric_engine.slo)
+        cl = self.metric_engine.cluster
+        ensure(cl.role in ("writer", "replica"),
+               f"cluster.role must be writer|replica, got {cl.role!r}")
+        ensure(cl.watch_interval.seconds > 0,
+               "cluster.watch_interval must be positive")
+        ensure(cl.probe_interval.seconds > 0,
+               "cluster.probe_interval must be positive")
+        ensure(cl.watch_backoff_cap.seconds >= cl.watch_interval.seconds,
+               "cluster.watch_backoff_cap must be >= watch_interval")
+        if cl.enabled:
+            ensure(bool(self.metric_engine.node_id),
+                   "cluster.enabled requires metric_engine.node_id (the "
+                   "node's identity in the assignment map and peer table)")
+            if cl.role == "replica":
+                ensure(
+                    not self.test.enable_write,
+                    "a replica cannot run the self-write load generator",
+                )
         store = self.metric_engine.storage.object_store
         kind = store.type.lower()
         ensure(

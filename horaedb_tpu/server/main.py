@@ -171,6 +171,171 @@ async def observability_middleware(request: web.Request, handler):
     return resp
 
 
+# Read endpoints the cluster router may offload from a writer to a
+# healthy replica (the expensive query surface; discovery endpoints are
+# index-cheap and always serve locally).
+CLUSTER_READ_ROUTES = frozenset((
+    "/api/v1/query", "/api/v1/query_range", "/api/v1/query_exemplars",
+))
+
+
+@web.middleware
+async def cluster_middleware(request: web.Request, handler):
+    """Cluster routing in the HTTP tier (horaedb_tpu/cluster/router.py):
+
+    - On a WRITER with healthy replicas (`route_reads`), query requests
+      forward to the rendezvous-picked replica (one panel's repeats keep
+      hitting one replica's caches); a replica failure fails over to the
+      local engine — hedged, never user-visible.
+    - On a REPLICA (or standby), every query response carries the
+      bounded-staleness token as `X-Horaedb-Staleness-Ms`.
+    - `X-Horaedb-Forwarded` marks proxied requests; they are never
+      re-routed (loop guard). Write forwarding lives in the write
+      handler (it needs the body + partial-ownership split)."""
+    from horaedb_tpu.cluster.router import FORWARD_HEADER, STALENESS_HEADER
+
+    state: ServerState = request.app[STATE_KEY]
+    cl = state.cluster
+    if cl is None:
+        return await handler(request)
+    if (
+        cl.role == "writer" and not cl.standby
+        and cl.config.route_reads
+        and FORWARD_HEADER not in request.headers
+        and request.path in CLUSTER_READ_ROUTES
+        and request.method in ("GET", "POST")
+    ):
+        key = request.path_qs.encode()
+        body = None
+        if request.method == "POST":
+            body = await request.read()  # cached: the handler re-reads
+            key += body
+        peer = cl.router.pick_read_peer(key)
+        if peer is not None:
+            res = await cl.router.forward(
+                peer.node, request.method, request.path_qs,
+                request.headers, body, "read",
+            )
+            if res is not None and res[0] < 500:
+                status, hdrs, out = res
+                resp = web.Response(status=status, body=out)
+                resp.headers["Content-Type"] = hdrs.get(
+                    "Content-Type", "application/json"
+                )
+                for h in (STALENESS_HEADER, TRACE_HEADER):
+                    if h in hdrs:
+                        resp.headers[h] = hdrs[h]
+                return resp
+            # replica error / unreachable: hedged failover to local
+            cl.router.note_failover()
+    resp = await handler(request)
+    if (cl.replica is not None
+            and request.path.startswith("/api/v1/")
+            and request.path != "/api/v1/cluster/status"):
+        from horaedb_tpu.cluster.router import STALENESS_HEADER as _SH
+
+        resp.headers[_SH] = str(round(cl.replica.staleness_ms(), 1))
+    return resp
+
+
+def _cluster_verdict(state: "ServerState") -> dict:
+    """EXPLAIN `cluster` verdict: who served this query and how stale
+    its view may be. Standalone deployments report the role alone."""
+    cl = state.cluster
+    if cl is None:
+        return {"role": "standalone"}
+    out = {"role": "replica" if (cl.replica is not None) else cl.role,
+           "node": cl.node_id}
+    try:
+        if cl.replica is not None:
+            out.update(cl.replica.staleness())
+        else:
+            out["manifest_epoch"] = state.engine.manifest_epoch()
+            out["staleness_ms"] = 0.0
+    except Exception:  # noqa: BLE001 — verdict must never fail a query
+        pass
+    return out
+
+
+async def _cluster_forward_write(state: "ServerState", request: web.Request,
+                                 raw_body: bytes) -> "web.Response | None":
+    """Whole-payload write forwarding: a replica (or standby writer)
+    routes every write to the owning writer, raw body + headers intact
+    (snappy stays snappy). None = handle locally."""
+    from horaedb_tpu.cluster.router import FORWARD_HEADER
+
+    cl = state.cluster
+    if cl is None or FORWARD_HEADER in request.headers:
+        return None
+    if cl.role != "replica" and not cl.standby:
+        return None
+    targets = cl.router.write_targets(0)
+    if not targets:
+        return unavailable_response(UnavailableError(
+            "replica knows no healthy writer to forward the write to"
+        ))
+    res = None
+    for node in targets:
+        res = await cl.router.forward(
+            node, "POST", request.path_qs, request.headers, raw_body,
+            "write",
+        )
+        if res is not None:
+            break
+    if res is None:
+        return unavailable_response(UnavailableError(
+            f"no reachable writer (tried {targets!r})"
+        ))
+    status, hdrs, out = res
+    resp = web.Response(status=status, body=out)
+    resp.headers["Content-Type"] = hdrs.get("Content-Type",
+                                            "application/json")
+    return resp
+
+
+async def _cluster_split_write(
+    state: "ServerState", body: bytes, tenant: str,
+) -> "tuple[int, int]":
+    """Partial-writer write path: split the (decompressed) payload per
+    region owner — the local subset lands through the normal parsed
+    write, non-owned subsets re-encode and forward to their owners WITH
+    the caller's tenant identity (the owner meters its own subset; the
+    origin meters only the local one — the J015 ledger must neither
+    double-count nor misattribute forwarded rows to "default").
+    Returns (total accepted, locally landed); raises on a failed
+    forward (the sender retries the whole batch; local writes are
+    LWW-idempotent)."""
+    from horaedb_tpu.cluster.router import split_by_owner
+
+    cl = state.cluster
+    tenant_hdr = state.config.metric_engine.query.tenant_header
+    parsed = await state.parser_pool.decode(body)
+    local, remote = split_by_owner(
+        parsed, state.engine.router, cl.router.assignment, cl.node_id,
+    )
+    total = local_n = 0
+    if local is not None:
+        local_n = await state.engine.write_parsed(local)
+        total += local_n
+    for node, payload in remote.items():
+        res = await cl.router.forward(
+            node, "POST", "/api/v1/write", {tenant_hdr: tenant}, payload,
+            "write",
+        )
+        if res is None or res[0] >= 300:
+            raise UnavailableError(
+                f"forwarded write subset to {node!r} failed "
+                f"(status {res[0] if res else 'unreachable'})"
+            )
+        try:
+            import json as _json
+
+            total += int(_json.loads(res[2]).get("samples", 0))
+        except Exception:  # noqa: BLE001 — body shape is ours, but be safe
+            pass
+    return total, local_n
+
+
 def init_logging() -> None:
     """file:line + local time + env filter (main.rs:88-94 analog; level from
     the standard logging env var style: HORAEDB_LOG=DEBUG)."""
@@ -217,11 +382,40 @@ def snappy_decompress(buf: bytes) -> bytes:
     return bytes(pa.Codec("snappy").decompress(buf, decompressed_size=size))
 
 
+class ClusterState:
+    """This node's cluster identity + routing fabric (horaedb_tpu/cluster):
+    the rendezvous router over the peer table, the replica handle when
+    role = "replica" (or a standby writer), and the partial-ownership
+    flag that turns on write splitting."""
+
+    def __init__(self, config, node_id: str, router, replica=None,
+                 standby: bool = False, partial: bool = False,
+                 store=None, cluster_root: str = "metrics/cluster",
+                 engine_root: str = "metrics",
+                 engine_kwargs: "dict | None" = None):
+        self.config = config          # cluster.ClusterConfig
+        self.node_id = node_id
+        self.router = router          # cluster.router.ClusterRouter
+        self.replica = replica        # cluster.replica.ReplicaEngine | None
+        self.role = config.role
+        # a writer-role process that owns no regions yet (serves reads as
+        # a replica; /api/v1/cluster/takeover promotes it)
+        self.standby = standby
+        # a regioned writer owning a strict subset of regions (the
+        # assignment map split them): non-owned writes forward per owner
+        self.partial = partial
+        # takeover needs to reopen engines over the shared store
+        self.store = store
+        self.cluster_root = cluster_root
+        self.engine_root = engine_root
+        self.engine_kwargs = dict(engine_kwargs or {})
+
+
 class ServerState:
     def __init__(self, config: Config, storage, engine: MetricEngine,
                  parser_pool=None, slowlog: "SlowLog | None" = None,
                  admission_controller: "AdmissionController | None" = None,
-                 rules=None, telemetry=None):
+                 rules=None, telemetry=None, cluster: "ClusterState | None" = None):
         self.config = config
         self.storage = storage       # demo ColumnarStorage (reference parity)
         self.engine = engine         # metric engine (remote-write path)
@@ -235,6 +429,8 @@ class ServerState:
         # self-scrape collector (horaedb_tpu/telemetry), None = disabled
         # (config or the HORAEDB_TELEMETRY=off kill switch)
         self.telemetry = telemetry
+        # cluster layer (horaedb_tpu/cluster), None = standalone
+        self.cluster = cluster
         self.write_enabled = asyncio.Event()
         self.write_workers: list[asyncio.Task] = []
 
@@ -299,8 +495,29 @@ async def handle_compact(request: web.Request) -> web.Response:
                 {"error": f"start ({start}) must be <= end ({end})"}, status=400
             )
         rng = TimeRange(start, end)
-    await shield_mutation(state.storage.compact(CompactRequest(time_range=rng)))
-    await shield_mutation(state.engine.compact(time_range=rng))
+    try:
+        # the demo root may be a read-only view under cluster mode (its
+        # writer is whichever process runs the load generator); the admin
+        # op still compacts the METRIC engine below
+        if not getattr(state.storage, "read_only", False):
+            await shield_mutation(
+                state.storage.compact(CompactRequest(time_range=rng))
+            )
+        await shield_mutation(state.engine.compact(time_range=rng))
+    except UnavailableError as e:
+        # transient store trouble stays the retryable 503 contract
+        return unavailable_response(e)
+    except HoraeError as e:
+        # ONLY the deployment-shaped refusals are client errors:
+        # read-only replica views and disabled schedulers. Anything
+        # else (corrupt snapshot, FencedError mid-compaction) is a real
+        # internal fault and must keep its 5xx signal for monitoring.
+        from horaedb_tpu.common.error import ReplicaReadOnlyError
+
+        if isinstance(e, ReplicaReadOnlyError) \
+                or "compaction scheduler disabled" in str(e):
+            return web.json_response({"error": str(e)}, status=400)
+        raise
     METRICS.inc("horaedb_compactions_triggered_total")
     return web.json_response({
         "compaction": "triggered",
@@ -382,15 +599,29 @@ async def handle_metrics(request: web.Request) -> web.Response:
 async def handle_remote_write(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     body = await request.read()
+    # cluster write routing: a replica / standby forwards the RAW body
+    # to the owning writer (before any decompression — bytes stay bytes)
+    forwarded = await _cluster_forward_write(state, request, body)
+    if forwarded is not None:
+        return forwarded
     if request.headers.get("Content-Encoding", "").lower() == "snappy":
         try:
             with tracing.span("snappy_decompress", bytes=len(body)):
                 body = snappy_decompress(body)
         except Exception:  # noqa: BLE001
             return web.json_response({"error": "bad snappy payload"}, status=400)
+    cl = state.cluster
     try:
         with tracing.span("ingest", bytes=len(body)):
-            n = await shield_mutation(state.engine.write_payload(body))
+            if cl is not None and cl.partial:
+                # assignment-split regions: local subset + per-owner
+                # forwards (cluster/router.py split_by_owner)
+                n, n_local = await shield_mutation(
+                    _cluster_split_write(state, body, _tenant_of(request))
+                )
+            else:
+                n = await shield_mutation(state.engine.write_payload(body))
+                n_local = n
     except CardinalityLimited as e:
         # series-cardinality partial-accept: existing-series samples WERE
         # accepted and are durable per the normal ack contract; only new
@@ -430,8 +661,10 @@ async def handle_remote_write(request: web.Request) -> web.Response:
     METRICS.inc("horaedb_remote_write_requests_total")
     METRICS.inc("horaedb_remote_write_samples_total", n)
     INGEST_BATCH_SAMPLES.observe(n)
-    # per-tenant usage (telemetry/metering.py, the J015 funnel)
-    _METER.account(_tenant_of(request), rows_ingested=n)
+    # per-tenant usage (telemetry/metering.py, the J015 funnel): only
+    # LOCALLY-landed rows — a split-forwarded subset is metered by its
+    # owning writer under the propagated tenant, never twice
+    _METER.account(_tenant_of(request), rows_ingested=n_local)
     return web.json_response({"samples": n}, status=200)
 
 
@@ -702,6 +935,9 @@ def _finish_explain(state: "ServerState", st, mode: str,
     if not want and state.slowlog is None:
         return None
     explain = _explain_payload(st, mode, admission_verdict=admission_verdict)
+    # cluster verdict (horaedb_tpu/cluster): who served this and how
+    # stale its view may be — the staleness token EXPLAIN carries
+    explain["cluster"] = _cluster_verdict(state)
     tracing.add_attr(explain=explain, scanstats=st.as_dict())
     return explain if want else None
 
@@ -1485,16 +1721,27 @@ async def handle_rules_get(request: web.Request) -> web.Response:
     if state.rules is None:
         return _rules_unavailable()
     recording, alerting = [], []
+    # named rule GROUPS (shared interval, ordered in-tick evaluation):
+    # each renders as its own Prometheus group; ungrouped recording
+    # rules keep the implicit "recording" group
+    named_groups: dict[str, list] = {}
     active = {}
     for a in state.rules.alerts():
         active.setdefault(a["labels"]["alertname"], []).append(a)
     for rule in state.rules.list_rules():
         if rule.kind == "recording":
-            recording.append({
+            entry = {
                 "type": "recording", "name": rule.name,
                 "query": rule.expr, "labels": rule.labels,
                 "interval": rule.interval_ms / 1000.0,
-            })
+            }
+            if getattr(rule, "group", ""):
+                entry["group_order"] = rule.group_order
+                named_groups.setdefault(rule.group, []).append(
+                    (rule.group_order, rule.name, entry)
+                )
+            else:
+                recording.append(entry)
         else:
             alerts = active.get(rule.name, [])
             worst = "inactive"
@@ -1511,6 +1758,15 @@ async def handle_rules_get(request: web.Request) -> web.Response:
     groups = []
     if recording:
         groups.append({"name": "recording", "rules": recording})
+    for g in sorted(named_groups):
+        members = [e for _o, _n, e in sorted(named_groups[g],
+                                             key=lambda t: t[:2])]
+        groups.append({
+            "name": g,
+            # the group-shared interval (registration enforces equality)
+            "interval": members[0]["interval"],
+            "rules": members,
+        })
     if alerting:
         groups.append({"name": "alerting", "rules": alerting})
     return web.json_response({"status": "success",
@@ -1600,6 +1856,193 @@ async def handle_rules_tick(request: web.Request) -> web.Response:
     except UnavailableError as e:
         return unavailable_response(e)
     return web.json_response({"status": "success", "data": summary})
+
+
+# ---------------------------------------------------------------------------
+# cluster surface (horaedb_tpu/cluster)
+# ---------------------------------------------------------------------------
+
+
+def _cluster_regions_view(state: "ServerState") -> dict:
+    """{region_id: {"owned", "epoch"}} for the status payload — works for
+    a single engine, a regioned engine, and a replica facade alike."""
+    eng = state.engine
+    engines = getattr(eng, "engines", None)
+    if engines is None:
+        return {"0": {
+            "owned": not getattr(eng, "read_only", False),
+            "epoch": eng.manifest_epoch(),
+        }}
+    return {
+        str(i): {"owned": not sub.read_only, "epoch": sub.manifest_epoch()}
+        for i, sub in sorted(engines.items())
+    }
+
+
+async def handle_cluster_status(request: web.Request) -> web.Response:
+    """`/api/v1/cluster/status`: this node's role, per-region ownership +
+    manifest epochs, the staleness token (replicas), the assignment-map
+    view, and peer health — the router's probe target AND the operator's
+    catch-up check (writer epoch == replica epoch means caught up)."""
+    state: ServerState = request.app[STATE_KEY]
+    cl = state.cluster
+    if cl is None:
+        return web.json_response({"status": "success", "data": {
+            "enabled": False, "role": "standalone",
+            "manifest_epoch": state.engine.manifest_epoch(),
+        }})
+    data = {
+        "enabled": True,
+        "role": cl.role,
+        "node": cl.node_id,
+        "standby": cl.standby,
+        "partial": cl.partial,
+        "manifest_epoch": state.engine.manifest_epoch(),
+        "regions": _cluster_regions_view(state),
+        "peers": cl.router.peer_status(),
+    }
+    if cl.replica is not None:
+        st = cl.replica.staleness()
+        data["manifest_epoch"] = st["manifest_epoch"]
+        data["staleness_ms"] = st["staleness_ms"]
+        data["stale"] = (
+            st["staleness_ms"] / 1000.0
+            > cl.config.max_staleness.seconds
+        )
+    asg = cl.router.assignment
+    if asg is not None:
+        data["assignment"] = {
+            "version": asg.version,
+            "regions": {str(r): n for r, n in sorted(asg.regions.items())},
+        }
+    return web.json_response({"status": "success", "data": data})
+
+
+async def handle_cluster_refresh(request: web.Request) -> web.Response:
+    """Force one watch probe NOW (admin/debug; smoke gates and tests use
+    it instead of waiting out the watch interval). On a replica this
+    swaps in any fresh snapshots; on a partial writer it refreshes the
+    non-owned (read-only) region views."""
+    state: ServerState = request.app[STATE_KEY]
+    cl = state.cluster
+    if cl is None:
+        return web.json_response(
+            {"status": "error", "errorType": "unavailable",
+             "error": "cluster layer disabled ([metric_engine.cluster])"},
+            status=501,
+        )
+    if cl.replica is not None:
+        try:
+            outcome = await shield_mutation(cl.replica.watch_once())
+        except Exception as e:  # noqa: BLE001 — faulted store
+            return unavailable_response(UnavailableError(
+                f"refresh probe failed: {e}"
+            ))
+        return web.json_response({"status": "success", "data": {
+            "outcome": outcome, **cl.replica.staleness(),
+        }})
+    engines = getattr(state.engine, "engines", None)
+    refreshed = []
+    if engines is not None:
+        for rid, sub in sorted(engines.items()):
+            if sub.read_only:
+                await shield_mutation(state.engine.refresh_region(rid))
+                refreshed.append(rid)
+    return web.json_response({"status": "success", "data": {
+        "outcome": "refreshed" if refreshed else "noop",
+        "regions": refreshed,
+        "manifest_epoch": state.engine.manifest_epoch(),
+    }})
+
+
+async def handle_cluster_takeover(request: web.Request) -> web.Response:
+    """Writer takeover (`?region=all` or `?region=<id>`): rewrite the
+    assignment map to name THIS node the owner, then reopen the region
+    as a writer — the fresh epoch-fence acquisition deposes the lapsed
+    writer regardless of what it believes (storage/fence.py). The
+    operator runbook for a dead writer (docs/operations.md "Scale-out");
+    background rule/telemetry loops resume on the next boot."""
+    from horaedb_tpu.cluster import TAKEOVERS
+    from horaedb_tpu.cluster import assignment as asg_mod
+
+    state: ServerState = request.app[STATE_KEY]
+    cl = state.cluster
+    if cl is None or cl.role != "writer":
+        return web.json_response(
+            {"error": "takeover requires cluster role = writer"}, status=400
+        )
+    raw = request.query.get("region", "all")
+    asg = cl.router.assignment or await asg_mod.load_assignment(
+        cl.store, cl.cluster_root
+    )
+    # the regions this deployment actually has: the engine's live set,
+    # plus anything the assignment map names (a split elsewhere)
+    engines = getattr(state.engine, "engines", None)
+    known = set(asg.regions) | (set(engines) if engines is not None
+                                else {0})
+    if raw == "all":
+        targets = sorted(known - set(asg.regions_of(cl.node_id)))
+    else:
+        try:
+            targets = [int(raw)]
+        except ValueError:
+            return web.json_response(
+                {"error": "?region= must be an int or 'all'"}, status=400
+            )
+        unknown = [r for r in targets if r not in known]
+        if unknown:
+            # never commit an assignment version (a permanent audit-log
+            # record) for a region that does not exist
+            return web.json_response(
+                {"error": f"unknown region(s) {unknown}; known: "
+                          f"{sorted(known)}"},
+                status=400,
+            )
+    taken = []
+    for rid in targets:
+        def mutate(regions, rid=rid):
+            regions[int(rid)] = cl.node_id
+            return regions
+
+        asg = await shield_mutation(asg_mod.propose_assignment(
+            cl.store, cl.cluster_root, cl.node_id, mutate
+        ))
+        engines = getattr(state.engine, "engines", None)
+        if engines is not None and rid in engines:
+            if engines[rid].read_only:
+                await shield_mutation(
+                    state.engine.promote_region(rid, cl.node_id)
+                )
+        elif cl.replica is not None or cl.standby:
+            # single-engine standby: swap the replica facade for a real
+            # writer engine (the open's fence acquisition deposes)
+            new_engine = await shield_mutation(MetricEngine.open(
+                cl.engine_root, cl.store,
+                **{**cl.engine_kwargs, "fence_node_id": cl.node_id},
+            ))
+            old = state.engine
+            state.engine = new_engine
+            if cl.replica is not None:
+                await cl.replica.close()
+            else:
+                await old.close()
+            cl.replica = None
+            cl.standby = False
+        TAKEOVERS.inc()
+        taken.append(rid)
+    cl.router.set_assignment(asg)
+    if getattr(state.engine, "engines", None) is not None:
+        cl.partial = any(
+            sub.read_only for sub in state.engine.engines.values()
+        )
+    return web.json_response({"status": "success", "data": {
+        "taken": taken,
+        "assignment_version": asg.version,
+        "regions": _cluster_regions_view(state),
+        # rule evaluation / self-telemetry were sized for the boot-time
+        # role; a restart picks them up under the new ownership
+        "restart_recommended": bool(taken) and (state.rules is None),
+    }})
 
 
 # ---------------------------------------------------------------------------
@@ -1693,6 +2136,16 @@ async def build_app(config: Config, store=None) -> web.Application:
         max_workers=config.metric_engine.threads.manifest_thread_num,
         thread_name_prefix="manifest",
     )
+    cluster_cfg = config.metric_engine.cluster
+    replica_role = cluster_cfg.enabled and cluster_cfg.role == "replica"
+    # The demo root has no epoch fence: in ANY cluster topology (writer +
+    # standby included) a second process running its merger/compaction/GC
+    # would be an unfenced concurrent mutator on the shared bucket. It
+    # opens writable only when this process actually drives it (the
+    # self-write load generator, single-process by config validation).
+    demo_read_only = cluster_cfg.enabled and (
+        replica_role or not config.test.enable_write
+    )
     storage = await ObjectBasedStorage.try_new(
         root="demo",
         store=store,
@@ -1702,6 +2155,7 @@ async def build_app(config: Config, store=None) -> web.Application:
         config=config.metric_engine.storage.time_merge_storage,
         sst_executor=sst_executor,
         manifest_executor=manifest_executor,
+        read_only=demo_read_only,
     )
     # one shared parser pool: the /metrics pool telemetry must reflect the
     # pool the engine's ingest actually borrows from
@@ -1728,16 +2182,106 @@ async def build_app(config: Config, store=None) -> web.Application:
     if config.metric_engine.node_id:
         # multi-process shared store: claim per-region write ownership
         engine_kwargs["fence_node_id"] = config.metric_engine.node_id
-    if config.metric_engine.num_regions > 1:
+    num_regions = config.metric_engine.num_regions
+    granularity = config.metric_engine.region_granularity
+    cluster_state: "ClusterState | None" = None
+    if cluster_cfg.enabled:
+        from horaedb_tpu.cluster import assignment as asg_mod
+        from horaedb_tpu.cluster.replica import ReplicaEngine
+        from horaedb_tpu.cluster.router import ClusterRouter
+
+        node_id = config.metric_engine.node_id
+        router = ClusterRouter(cluster_cfg, node_id)
+        cluster_root = "metrics/cluster"
+        replica_kwargs = {
+            k: v for k, v in engine_kwargs.items()
+            if k not in ("fence_node_id",)
+        }
+        if replica_role:
+            replica = await ReplicaEngine.open(
+                "metrics", store,
+                num_regions=num_regions, granularity=granularity,
+                watch_interval_s=cluster_cfg.watch_interval.seconds,
+                watch_backoff_cap_s=cluster_cfg.watch_backoff_cap.seconds,
+                engine_kwargs=replica_kwargs,
+                # a racing boot waits for the writer's store layout
+                open_retries=40, open_retry_delay_s=0.5,
+            )
+            engine = replica
+            try:
+                router.set_assignment(
+                    await asg_mod.load_assignment(store, cluster_root)
+                )
+            except Exception:  # noqa: BLE001 — routing converges on probes
+                logger.warning("assignment map unreadable at replica boot")
+            cluster_state = ClusterState(
+                cluster_cfg, node_id, router, replica=replica,
+                store=store, cluster_root=cluster_root,
+                engine_kwargs=replica_kwargs,
+            )
+        else:
+            # writer: claim regions per the assignment map (never steals;
+            # takeover is the explicit /api/v1/cluster/takeover op).
+            # Unowned regions claim to SELF — first writer to boot owns
+            # them; a later writer finds them taken and serves as a
+            # standby. Rendezvous-splitting regions across several LIVE
+            # writers is a deliberate operator action (the assignment
+            # API's writer_nodes bootstrap / per-region takeover), never
+            # an inference from the peer table: a configured-but-down
+            # peer must not be handed regions nobody can write.
+            region_ids = list(range(num_regions))
+            asg = await asg_mod.claim_regions(
+                store, cluster_root, node_id, region_ids, [node_id],
+            )
+            owned = set(asg.regions_of(node_id))
+            router.set_assignment(asg)
+            standby = False
+            replica = None
+            if num_regions > 1:
+                from horaedb_tpu.engine.region import RegionedEngine
+
+                engine = await RegionedEngine.open(
+                    "metrics", store, num_regions,
+                    granularity=granularity,
+                    writable_regions=(None if owned == set(region_ids)
+                                      else owned),
+                    **engine_kwargs,
+                )
+            elif 0 in owned:
+                engine = await MetricEngine.open(
+                    "metrics", store, **engine_kwargs,
+                )
+            else:
+                # standby writer: another writer owns the region — serve
+                # reads as a replica until takeover promotes this node
+                standby = True
+                replica = await ReplicaEngine.open(
+                    "metrics", store,
+                    num_regions=num_regions, granularity=granularity,
+                    watch_interval_s=cluster_cfg.watch_interval.seconds,
+                    watch_backoff_cap_s=cluster_cfg.watch_backoff_cap.seconds,
+                    engine_kwargs=replica_kwargs,
+                    open_retries=40, open_retry_delay_s=0.5,
+                )
+                engine = replica
+            cluster_state = ClusterState(
+                cluster_cfg, node_id, router, replica=replica,
+                standby=standby,
+                partial=(num_regions > 1 and owned != set(region_ids)),
+                store=store, cluster_root=cluster_root,
+                engine_kwargs=replica_kwargs,
+            )
+    elif num_regions > 1:
         from horaedb_tpu.engine.region import RegionedEngine
 
         engine = await RegionedEngine.open(
-            "metrics", store, config.metric_engine.num_regions,
-            granularity=config.metric_engine.region_granularity,
+            "metrics", store, num_regions,
+            granularity=granularity,
             **engine_kwargs,
         )
     else:
         engine = await MetricEngine.open("metrics", store, **engine_kwargs)
+    engine_read_only = bool(getattr(engine, "read_only", False))
     slow = None
     if config.slowlog.capacity > 0:
         import os as _os
@@ -1777,7 +2321,12 @@ async def build_app(config: Config, store=None) -> web.Application:
     from horaedb_tpu import telemetry as telemetry_mod
 
     rules_engine = None
-    if rcfg.enabled:
+    if rcfg.enabled and engine_read_only:
+        # rules materialize output through the ingest path and checkpoint
+        # fenced state — writer-only work; replicas serve the rule OUTPUT
+        # series like any other data with bounded staleness
+        logger.info("rule engine disabled on a read-only replica")
+    elif rcfg.enabled:
         from horaedb_tpu.rules import rule_from_dict
         from horaedb_tpu.rules.engine import RuleEngine
 
@@ -1803,7 +2352,10 @@ async def build_app(config: Config, store=None) -> web.Application:
                 rule_from_dict(entry, now_ms=now_ms())
             )
     collector = None
-    if telemetry_mod.telemetry_enabled(tcfg.enabled):
+    if telemetry_mod.telemetry_enabled(tcfg.enabled) and engine_read_only:
+        logger.info("self-telemetry collector disabled on a read-only "
+                    "replica (its writes belong to the writer)")
+    elif telemetry_mod.telemetry_enabled(tcfg.enabled):
         collector = telemetry_mod.SelfScrapeCollector(
             engine,
             tenant=tcfg.tenant,
@@ -1814,14 +2366,15 @@ async def build_app(config: Config, store=None) -> web.Application:
         )
     state = ServerState(config, storage, engine, parser_pool=pool,
                         slowlog=slow, admission_controller=adm,
-                        rules=rules_engine, telemetry=collector)
+                        rules=rules_engine, telemetry=collector,
+                        cluster=cluster_state)
     if config.test.enable_write:
         state.write_enabled.set()
     for i in range(config.test.write_worker_num):
         state.write_workers.append(
             asyncio.create_task(bench_write_worker(state, i), name=f"bench-write-{i}")
         )
-    if config.metric_engine.ingest_buffer_rows > 0:
+    if config.metric_engine.ingest_buffer_rows > 0 and not engine_read_only:
         # periodic flush bounds the buffered-ingest data-loss window
         interval = config.metric_engine.ingest_flush_interval.seconds
 
@@ -1872,6 +2425,13 @@ async def build_app(config: Config, store=None) -> web.Application:
             asyncio.create_task(telemetry_loop(), name="telemetry-scrape")
         )
 
+    if cluster_state is not None:
+        # background cluster fabric: the replica watch/swap loop and the
+        # peer health probes (both tasks die with their owners' close)
+        if cluster_state.replica is not None:
+            cluster_state.replica.start_watch()
+        cluster_state.router.start_probes()
+
     tracing.configure(
         sample=config.tracing.sample,
         slow_s=config.tracing.slow_threshold.seconds,
@@ -1879,7 +2439,7 @@ async def build_app(config: Config, store=None) -> web.Application:
     )
     app = web.Application(
         client_max_size=64 * 1024 * 1024,
-        middlewares=[observability_middleware],
+        middlewares=[observability_middleware, cluster_middleware],
     )
     app[STATE_KEY] = state
     app.add_routes(
@@ -1907,6 +2467,9 @@ async def build_app(config: Config, store=None) -> web.Application:
             web.get("/api/v1/alerts", handle_alerts),
             web.post("/api/v1/rules/tick", handle_rules_tick),
             web.get("/api/v1/usage", handle_usage),
+            web.get("/api/v1/cluster/status", handle_cluster_status),
+            web.post("/api/v1/cluster/refresh", handle_cluster_refresh),
+            web.post("/api/v1/cluster/takeover", handle_cluster_takeover),
             web.post("/api/v1/telemetry/scrape", handle_telemetry_scrape),
             web.post("/api/v1/admin/tsdb/delete_series", handle_delete_series),
             web.get("/api/v1/status/buildinfo", handle_buildinfo),
@@ -1924,6 +2487,8 @@ async def build_app(config: Config, store=None) -> web.Application:
         await asyncio.gather(*state.write_workers, return_exceptions=True)
         if state.rules is not None:
             await state.rules.close()
+        if state.cluster is not None:
+            await state.cluster.router.close()
         await state.storage.close()
         await state.engine.close()
         closer = getattr(store, "close", None)

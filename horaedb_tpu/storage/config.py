@@ -84,6 +84,15 @@ def _from_dict(cls, d: dict):
                 {**e, "kind": kind} if isinstance(e, dict) else e
                 for e in value
             ]
+        elif name == "peers" and value is not None:
+            # cluster peer table ([[metric_engine.cluster.peers]]):
+            # validated member records, not raw dicts
+            from horaedb_tpu.cluster import ClusterPeer
+
+            kwargs[name] = [
+                ClusterPeer.from_dict(p) if isinstance(p, dict) else p
+                for p in value
+            ]
         elif name == "column_options" and value is not None:
             kwargs[name] = {
                 col: _from_dict(ColumnOptions, opts) for col, opts in value.items()

@@ -224,16 +224,53 @@ async def read_snapshot(store: ObjectStore, path: str) -> Snapshot:
         return Snapshot.from_bytes(data)
 
 
+async def read_folded_view(store: ObjectStore, root: str) -> Snapshot:
+    """Read-only manifest view for cluster replicas: snapshot + any
+    unfolded deltas, folded IN MEMORY — nothing is ever written back.
+    Safe to race the owning writer's merger: deltas are deleted only
+    AFTER the snapshot containing them lands, so a NotFound mid-read
+    means a fresher snapshot exists (retry re-reads it), and re-applying
+    a delta already folded is idempotent (Snapshot.ssts keys by id).
+    jaxlint J017 pins the consumers of this view to the cluster replica
+    funnel (cluster/replica.py drives it via read-only storage opens)."""
+    for _attempt in range(5):
+        metas = await store.list(delta_dir(root))
+        snapshot = await read_snapshot(store, snapshot_path(root))
+        if not metas:
+            return snapshot
+        try:
+            blobs = await asyncio.gather(*(store.get(m.path) for m in metas))
+        except NotFound:
+            continue  # the owner's merger folded under us; re-read
+        all_adds: list[SstFile] = []
+        all_deletes: list[int] = []
+        for blob in blobs:
+            adds, deletes = decode_update(blob)
+            all_adds.extend(adds)
+            all_deletes.extend(deletes)
+        snapshot.add_records(all_adds)
+        snapshot.delete_records(all_deletes)
+        return snapshot
+    # five straight races: the bare snapshot alone is still a consistent
+    # (slightly staler) view — bounded staleness is the replica contract
+    return await read_snapshot(store, snapshot_path(root))
+
+
 class Manifest:
     """Live-SST registry (mod.rs:66-176)."""
 
     def __init__(
         self, root: str, store: ObjectStore, config: ManifestConfig, executor=None,
-        fence=None,
+        fence=None, read_only: bool = False,
     ):
         self._root = root
         self._store = store
         self._config = config
+        # Cluster replica mode (horaedb_tpu/cluster): this process holds a
+        # VIEW of another writer's manifest — every mutation raises, the
+        # merger never runs (its fold WRITES the snapshot), and loads use
+        # the in-memory delta fold (read_folded_view).
+        self._read_only = read_only
         self._ssts: list[SstFile] = []
         # Tombstone delete records (storage/visibility.py): manifest-level
         # control-plane state, one JSON object per record under
@@ -260,24 +297,57 @@ class Manifest:
         start_background_merger: bool = True,
         executor=None,
         fence=None,
+        read_only: bool = False,
     ) -> "Manifest":
         """`fence`: optional EpochFence enforcing cross-process single-writer
         ownership of this manifest root (storage/fence.py) — every update
-        and snapshot fold validates the epoch first."""
+        and snapshot fold validates the epoch first.
+
+        `read_only`: open a VIEW of a manifest another process owns
+        (cluster replica mode): the bootstrap fold stays in memory
+        (read_folded_view), the background merger never starts, and every
+        mutation raises — a replica must not move a writer's manifest."""
         m = cls(root, store, config or ManifestConfig(), executor=executor,
-                fence=fence)
-        await m._merger.bootstrap()
-        snapshot = await read_snapshot(store, snapshot_path(root))
+                fence=fence, read_only=read_only)
+        if read_only:
+            snapshot = await read_folded_view(store, root)
+        else:
+            await m._merger.bootstrap()
+            snapshot = await read_snapshot(store, snapshot_path(root))
         m._ssts = snapshot.into_ssts()
         await m._load_tombstones()
         await m._load_rollups()
         logger.info(
-            "manifest loaded: root=%s ssts=%d tombstones=%d",
+            "manifest loaded: root=%s ssts=%d tombstones=%d%s",
             root, len(m._ssts), len(m._tombstone_records),
+            " (read-only view)" if read_only else "",
         )
-        if start_background_merger:
+        if start_background_merger and not read_only:
             m._merger.start()
         return m
+
+    def _ensure_writable(self, what: str) -> None:
+        if self._read_only:
+            raise HoraeError(
+                f"manifest {self._root} is a read-only replica view; "
+                f"refusing {what} (writes belong to the owning writer)"
+            )
+
+    def epoch(self) -> int:
+        """Monotonic manifest epoch: the highest id any live record
+        carries (SSTs, tombstones, rollups — all minted by the shared
+        monotonic allocator). Every commit raises it (flush adds a fresh
+        SST id, compaction outputs carry higher ids than their inputs,
+        deletes mint tombstone ids), so writer-vs-replica comparison of
+        this number IS the catch-up check the cluster status surfaces.
+        GC can retire the max id holder; callers needing strict
+        monotonicity floor it (cluster/replica.py does)."""
+        top = max((s.id for s in self._ssts), default=0)
+        top = max(top, max((int(t.id) for t in self._tombstone_records),
+                           default=0))
+        top = max(top, max((int(r.id) for r in self._rollup_records.values()),
+                           default=0))
+        return top
 
     async def close(self) -> None:
         await self._merger.close()
@@ -292,6 +362,7 @@ class Manifest:
         # Encode BEFORE counting the delta: an encode failure (e.g. a meta
         # field overflowing the u32 wire format) must not leak a phantom
         # increment that the merger can never drain.
+        self._ensure_writable("manifest update")
         if self._fence is not None:
             # single-writer fence: a superseded epoch must not commit
             await self._fence.ensure_valid()
@@ -330,6 +401,7 @@ class Manifest:
     async def add_tombstone(self, tomb) -> None:
         """Durability point of a delete: the tombstone object's PUT. Applied
         in memory only after it lands — an acked delete survives a crash."""
+        self._ensure_writable("tombstone add")
         if self._fence is not None:
             await self._fence.ensure_valid()
         with context("write tombstone record"):
@@ -348,7 +420,7 @@ class Manifest:
         compaction keeps re-applying it, which is idempotent). Object
         deletions are best-effort: a failed delete keeps the record
         in memory AND on disk for the next pass. Returns records dropped."""
-        if not self._tombstone_records:
+        if self._read_only or not self._tombstone_records:
             return 0
         live = self._ssts
         dead = [
@@ -416,6 +488,10 @@ class Manifest:
             else:
                 losers.append(rec)
         self._rollup_records = records
+        if losers and self._read_only:
+            # a replica view never mutates the store: the owner's next
+            # open/GC reclaims its own superseded records
+            losers = []
         if losers:
             # delete the superseded record objects now, best-effort: no
             # later GC pass ever sees them (gc_rollups walks the in-memory
@@ -442,6 +518,7 @@ class Manifest:
         object's PUT). Replaces any older record for the same
         (segment, resolution); the CALLER deletes the replaced record's
         objects (supersede is part of the compaction commit path)."""
+        self._ensure_writable("rollup record add")
         if self._fence is not None:
             await self._fence.ensure_valid()
         with context("write rollup record"):
@@ -460,6 +537,7 @@ class Manifest:
         from horaedb_tpu.storage.rollup import evict_rollup
         from horaedb_tpu.storage.sst import SstPathGenerator
 
+        self._ensure_writable("rollup removal")
         if not records:
             return
         path_gen = SstPathGenerator(self._root)
@@ -483,7 +561,7 @@ class Manifest:
         """Drop records whose source SSTs are no longer all live — their
         freshness contract can never pass again (ids are never reused).
         Called post-commit by the compaction executor; best-effort."""
-        if not self._rollup_records:
+        if self._read_only or not self._rollup_records:
             return 0
         live = {s.id for s in self._ssts}
         dead = [
@@ -510,6 +588,8 @@ class Manifest:
 
     async def force_merge(self) -> None:
         """Deterministic merge hook for tests and shutdown."""
+        if self._read_only:
+            return  # the fold writes the snapshot; a view never does
         await self._merger.do_merge()
 
     @property

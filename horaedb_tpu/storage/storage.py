@@ -158,6 +158,7 @@ class ObjectBasedStorage(ColumnarStorage):
         fence=None,
         gc_orphans: bool = True,
         time_column: str | None = None,
+        read_only: bool = False,
     ) -> "ObjectBasedStorage":
         """`sst_executor` / `manifest_executor`: optional
         concurrent.futures.Executors for CPU-heavy SST work (sort, parquet
@@ -178,12 +179,28 @@ class ObjectBasedStorage(ColumnarStorage):
         (epoch ms), enabling ROW-exact retention masking and time-range
         tombstone deletes (storage/visibility.py). None = retention only
         prunes/expires whole SSTs (manifest time ranges) and
-        `delete_rows` is unavailable."""
+        `delete_rows` is unavailable.
+
+        `read_only`: cluster replica mode (horaedb_tpu/cluster) — a VIEW
+        over a root another writer process owns on the shared store. No
+        fence, no compaction scheduler, no orphan GC, no background
+        merger; the manifest loads via the in-memory delta fold and every
+        write/delete raises. Scans work unchanged."""
         self = object.__new__(cls)
+        if read_only:
+            # a replica must never mutate the owner's root: every write
+            # path below is gated, and the store-touching open-time
+            # maintenance (GC, snapshot folds, compaction) is disabled
+            enable_compaction_scheduler = False
+            start_background_merger = False
+            gc_orphans = False
+            fence_node_id = None
+            fence = None
         config = config or StorageConfig()
         self._root = root.strip("/")
         self._store = store
         self._config = config
+        self._read_only = read_only
         self._time_column = time_column
         if time_column is not None:
             ensure(
@@ -219,6 +236,7 @@ class ObjectBasedStorage(ColumnarStorage):
             start_background_merger=start_background_merger,
             executor=manifest_executor,
             fence=self._fence,
+            read_only=read_only,
         )
         # Startup id-collision guard: never allocate at or below an id the
         # manifest already holds (clock moved backwards across restarts, or
@@ -360,7 +378,26 @@ class ObjectBasedStorage(ColumnarStorage):
             self._root, len(orphans), failed,
         )
 
+    def _ensure_writable(self, what: str) -> None:
+        if self._read_only:
+            from horaedb_tpu.common.error import ReplicaReadOnlyError
+
+            raise ReplicaReadOnlyError(
+                f"storage {self._root} is a read-only replica view; "
+                f"refusing {what} (route the mutation to the owning writer)"
+            )
+
     # -- accessors ----------------------------------------------------------
+    @property
+    def read_only(self) -> bool:
+        return self._read_only
+
+    def manifest_epoch(self) -> int:
+        """The manifest's monotonic epoch (Manifest.epoch) — the number
+        the cluster staleness token and /api/v1/cluster/status compare
+        between writer and replicas."""
+        return self._manifest.epoch()
+
     @property
     def schema(self) -> StorageSchema:
         return self._schema
@@ -451,6 +488,7 @@ class ObjectBasedStorage(ColumnarStorage):
         as write sequences — every row acked (sealed/written) before this
         call has a smaller seq and is therefore covered; rows written
         after it survive (re-ingest into a deleted range works)."""
+        self._ensure_writable("delete_rows")
         ensure(
             self._time_column is not None,
             "delete_rows requires a table with a time_column",
@@ -481,6 +519,7 @@ class ObjectBasedStorage(ColumnarStorage):
 
     # -- write path (storage.rs:189-333) ------------------------------------
     async def write(self, req: WriteRequest) -> None:
+        self._ensure_writable("write")
         if self._fence is not None:
             # reject BEFORE the encode+upload: the manifest update would
             # fence anyway, but by then a deposed writer has already PUT a

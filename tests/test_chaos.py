@@ -1327,3 +1327,171 @@ class TestEncodedChaosSoak:
         assert got == model
         assert chaos.injected_errors > 0
         await eng2.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster partition/failover (ISSUE 15): kill a writer mid-soak, the
+# replica keeps serving exact bounded-stale results, a standby writer
+# takes the lapsed fence over, zero acked rows are lost
+# ---------------------------------------------------------------------------
+
+
+class TestClusterFailoverChaos:
+    """The cluster layer's failover contract over a seeded ChaosStore:
+    one writer + one stateless read replica share one faulted bucket.
+    Invariants: after every catch-up the replica answers EXACTLY the
+    host model (and its repeat — the result-cache path — agrees); a
+    killed writer leaves the replica serving the bounded-stale pre-crash
+    model; the standby's takeover (assignment rewrite + fresh epoch
+    fence) deposes the dead writer's zombie engine; and after recovery
+    every acked row — pre- and post-crash — is served by both the new
+    writer and the replica."""
+
+    @staticmethod
+    async def _sync_until(replica, model: dict, tag: str,
+                          attempts: int = 80) -> None:
+        """Drive watch probes (with sender-style retries against
+        injected faults + listing lag) until the replica's view matches
+        the host model exactly."""
+        last = None
+        for _ in range(attempts):
+            try:
+                await replica.watch_once()
+            except (InjectedFault, UnavailableError) as e:
+                last = e
+                continue
+            if await query_model(replica) == model:
+                return
+        raise AssertionError(
+            f"{tag}: replica never caught up after {attempts} probes "
+            f"(last error: {last})"
+        )
+
+    @async_test
+    async def test_writer_kill_replica_serves_standby_takes_over(self):
+        from horaedb_tpu.cluster import assignment as asg_mod
+        from horaedb_tpu.cluster.replica import ReplicaEngine
+        from horaedb_tpu.storage.fence import FencedError
+
+        inner = MemStore()
+        chaos = ChaosStore(inner, FaultPlan(
+            seed=20260815,
+            ops={
+                "put": OpFaults(error_rate=0.08, lost_ack_rate=0.03),
+                "get": OpFaults(error_rate=0.06),
+                "list": OpFaults(error_rate=0.06),
+                "delete": OpFaults(error_rate=0.06),
+            },
+            visibility_lag_ops=4,
+        ))
+        store = ResilientStore(
+            chaos, retry=fast_retry(attempts=10),
+            breaker=BreakerPolicy(failure_threshold=6, open_for=ms(40)),
+            name="cluster-soak",
+        )
+        w1 = await open_chaos_engine(
+            store, fence_node_id="w1", fence_validate_interval_s=0.0,
+        )
+        for _ in range(30):
+            try:
+                asg = await asg_mod.claim_regions(
+                    store, "db/cluster", "w1", [0], ["w1"],
+                )
+                break
+            except (InjectedFault, UnavailableError):
+                continue
+        assert asg.owner_of(0) == "w1"
+
+        replica = None
+        for _ in range(30):
+            try:
+                replica = await ReplicaEngine.open(
+                    "db", store,
+                    engine_kwargs={"segment_duration_ms": HOUR},
+                )
+                break
+            except (InjectedFault, UnavailableError):
+                continue
+        assert replica is not None, "replica never opened"
+        assert replica.read_only
+
+        model: dict = {}
+        epochs = []
+        for rnd in range(8):
+            series = {
+                f"h{rnd % 3}": [(6 * HOUR + rnd * 1000 + i, float(rnd * 10 + i))
+                                for i in range(4)],
+            }
+            await write_acked(w1, model, series)
+            await flush_retrying(w1)
+            await self._sync_until(replica, model, f"round {rnd}")
+            # the repeat (result-cache path) agrees too, and the
+            # staleness token only ever moves forward
+            await assert_model_twice(replica, model, f"replica round {rnd}")
+            epochs.append(replica.manifest_epoch())
+        assert epochs == sorted(epochs), "staleness token moved backwards"
+
+        # ---- kill the writer mid-soak (no graceful close)
+        pre_crash = dict(model)
+        await crash(w1)
+        chaos.settle()
+        # the replica keeps serving the bounded-stale view EXACTLY
+        for _ in range(30):
+            try:
+                await replica.watch_once()
+                break
+            except (InjectedFault, UnavailableError):
+                continue
+        await assert_model_twice(replica, pre_crash, "post writer-kill")
+
+        # ---- standby takeover: assignment rewrite + deposing fence
+        for _ in range(30):
+            try:
+                new_asg, _fence = await asg_mod.takeover_region(
+                    store, "db", "db/cluster", "w2", 0, "db",
+                )
+                break
+            except (InjectedFault, UnavailableError):
+                continue
+        assert new_asg.owner_of(0) == "w2"
+        # the zombie's engine object (crashed, never closed) is deposed:
+        # any write it still tries is fenced off the manifest (retrying
+        # past the injected faults must still land on FencedError)
+        zombie_err = None
+        for _ in range(30):
+            try:
+                await w1.write_parsed(PooledParser.decode(
+                    payload_for({"zombie": [(6 * HOUR + 1, 1.0)]})
+                ))
+            except (InjectedFault, UnavailableError):
+                continue
+            except FencedError as e:
+                zombie_err = e
+                break
+            break  # a successful write would be the split-brain bug
+        assert isinstance(zombie_err, FencedError), \
+            f"zombie writer was not fenced: {zombie_err!r}"
+        del w1
+
+        w2 = await open_chaos_engine(
+            store, fence_node_id="w2", fence_validate_interval_s=0.0,
+        )
+        # zero acked-row loss across the failover: the new writer sees
+        # every pre-crash acked row
+        await assert_model_twice(w2, pre_crash, "standby after takeover")
+
+        # ---- the cluster keeps working: new writer ingests, replica tails
+        for rnd in range(8, 14):
+            series = {
+                f"h{rnd % 3}": [(6 * HOUR + rnd * 1000 + i, float(rnd * 10 + i))
+                                for i in range(4)],
+            }
+            await write_acked(w2, model, series)
+            await flush_retrying(w2)
+            await self._sync_until(replica, model, f"post-failover {rnd}")
+        await assert_model_twice(w2, model, "soak end (writer)")
+        await assert_model_twice(replica, model, "soak end (replica)")
+        assert replica.manifest_epoch() == w2.manifest_epoch()
+        assert chaos.injected_errors > 0  # the plan actually fired
+        await replica.close()
+        await w2.close()

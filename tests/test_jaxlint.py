@@ -1066,3 +1066,114 @@ class TestJ016StackingFunnel:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ017ClusterFunnel:
+    """J017: manifest snapshot views belong to the manifest package and
+    the cluster replica funnel; assignment records mutate only through
+    cluster/assignment.py's fenced CAS API."""
+
+    def seeded(self, tmp_path, body, rel="engine/sync.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_manifest_view_outside_funnel_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.storage.manifest import read_snapshot\n"
+            "async def peek(store, root):\n"
+            "    return await read_snapshot(store, root + '/manifest/snapshot')\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J017" in r.stdout and "replica funnel" in r.stdout
+
+    def test_folded_view_outside_funnel_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.storage.manifest import read_folded_view\n"
+            "async def tail(store, root):\n"
+            "    return await read_folded_view(store, root)\n",
+            rel="server/replicator.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J017" in r.stdout
+
+    def test_replica_module_exempt(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.storage.manifest import read_folded_view\n"
+            "async def tail(store, root):\n"
+            "    return await read_folded_view(store, root)\n",
+            rel="cluster/replica.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_manifest_package_exempt(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def fold(store, path):\n"
+            "    return await read_snapshot(store, path)\n",
+            rel="storage/manifest/extra.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_assignment_mutation_outside_api_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def steal(store, me):\n"
+            "    await store.put('metrics/cluster/assignment/7', me)\n",
+            rel="server/sync.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J017" in r.stdout and "fenced CAS" in r.stdout
+
+    def test_assignment_path_helper_mutation_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.cluster.assignment import assignment_path\n"
+            "async def clobber(store, root, data):\n"
+            "    await store.put(assignment_path(root, 3), data)\n",
+            rel="server/sync.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J017" in r.stdout
+
+    def test_assignment_module_exempt(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def commit(store, root, ver, data):\n"
+            "    await store.put_if_absent(\n"
+            "        f'{root}/cluster/assignment/{ver}', data)\n",
+            rel="cluster/assignment.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_unrelated_put_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def save(store, path, data):\n"
+            "    await store.put(path, data)\n",
+            rel="server/sync.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def seed(store, data):\n"
+            "    # jaxlint: disable=J017 harness seeding a corrupt record on purpose\n"
+            "    await store.put('db/cluster/assignment/1', data)\n",
+            rel="server/sync.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

@@ -800,3 +800,89 @@ class TestSubscriptionHook:
         assert len(seen) == 1
         c.serving_unsubscribe(t_bad)
         c.serving_unsubscribe(t_bad)  # idempotent
+
+
+class TestRuleGroups:
+    """Rule groups (ISSUE 15 satellite): shared interval, ordered
+    evaluation within the group — a chain of recording rules (B reads
+    A's output) materializes deterministically in ONE tick."""
+
+    @async_test
+    async def test_chain_materializes_in_one_tick(self):
+        store, eng, rules = await open_pair("rg1")
+        # register DELIBERATELY out of chain order: group_order, not
+        # registration order, decides
+        for name, expr, order in (("g:c", "g:b * 2", 2),
+                                  ("g:a", "cpu * 10", 0),
+                                  ("g:b", "g:a + 1", 1)):
+            await rules.ensure_registered(rule_from_dict(
+                {"kind": "recording", "name": name, "expr": expr,
+                 "interval": "60s", "group": "chain", "group_order": order},
+                now_ms=0))
+        await eng.write_payload(payload(
+            {"h1": [(BASE + i * MIN, 5.0) for i in range(1, 8)]}
+        ))
+        await eng.flush()
+        now = BASE + 10 * MIN
+        summary = await rules.tick(now_ms=now)
+        assert summary["evaluated"] == 3, summary
+        # one tick produced the whole chain: c = (5*10 + 1) * 2
+        out_c = await rule_output(eng, "g:c")
+        assert out_c, "chain tail empty after one tick"
+        assert set(out_c.values()) == {102.0}, sorted(set(out_c.values()))
+        # and the chain is bit-exact vs cold evaluation of each body
+        await assert_exact(eng, rules, "g:b", "g:a + 1", now)
+        await assert_exact(eng, rules, "g:c", "g:b * 2", now)
+        # a no-advance tick stays quiet: every chained write-back event
+        # was consumed by the members' per-member snapshots IN tick one —
+        # the self-invalidation guard + ordered snapshots leave nothing
+        # dirty (a target-advancing tick still drains the trailing
+        # window, exactly like ungrouped rules)
+        q = await rules.tick(now_ms=now)
+        assert q["evaluated"] == 0, q
+        await eng.close()
+
+    @async_test
+    async def test_group_interval_shared_and_enforced(self):
+        store, eng, rules = await open_pair("rg2")
+        await rules.ensure_registered(rule_from_dict(
+            {"kind": "recording", "name": "s:a", "expr": "cpu",
+             "interval": "60s", "group": "g"}, now_ms=0))
+        with pytest.raises(Exception, match="share one interval"):
+            await rules.ensure_registered(rule_from_dict(
+                {"kind": "recording", "name": "s:b", "expr": "cpu",
+                 "interval": "30s", "group": "g"}, now_ms=0))
+        # same interval joins fine; alert rules refuse groups outright
+        await rules.ensure_registered(rule_from_dict(
+            {"kind": "recording", "name": "s:b", "expr": "cpu",
+             "interval": "60s", "group": "g"}, now_ms=0))
+        with pytest.raises(Exception, match="group"):
+            rule_from_dict({"kind": "alert", "name": "A", "expr": "cpu > 1",
+                            "group": "g"}, now_ms=0)
+        await eng.close()
+
+    @async_test
+    async def test_group_definition_survives_reopen(self):
+        store, eng, rules = await open_pair("rg3")
+        await rules.ensure_registered(rule_from_dict(
+            {"kind": "recording", "name": "p:a", "expr": "cpu",
+             "interval": "60s", "group": "g", "group_order": 7},
+            now_ms=0))
+        await eng.close()
+        eng2 = await MetricEngine.open("rg3", store, enable_compaction=False)
+        rules2 = await RuleEngine.open(eng2, store, root="rg3/rules")
+        rt = rules2._recording["p:a"]
+        assert rt.rule.group == "g" and rt.rule.group_order == 7
+        # an unchanged definition (group fields included) is idempotent
+        changed = await rules2.ensure_registered(rule_from_dict(
+            {"kind": "recording", "name": "p:a", "expr": "cpu",
+             "interval": "60s", "group": "g", "group_order": 7},
+            now_ms=99))
+        assert changed is False
+        # a group-field change IS a definition change
+        changed = await rules2.ensure_registered(rule_from_dict(
+            {"kind": "recording", "name": "p:a", "expr": "cpu",
+             "interval": "60s", "group": "g2", "group_order": 7},
+            now_ms=99))
+        assert changed is True
+        await eng2.close()

@@ -556,7 +556,7 @@ EXPLAIN_KEYS = {
     "mode", "regions", "ssts", "scan_paths", "agg_impl", "agg_impls",
     "stages_s", "lanes_s", "bound", "compile_s", "steady_s", "counts",
     "kernels", "tombstones_applied", "tombstone_rows_masked", "admission",
-    "encoding", "serving",
+    "encoding", "serving", "cluster",
 }
 EXPLAIN_LANES = {"io", "host", "transfer", "kernel", "compile", "decode"}
 # compressed-domain scan provenance (storage/encoding.py + ops/decode.py)

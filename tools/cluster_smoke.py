@@ -1,0 +1,225 @@
+"""Cluster smoke gate (`make cluster-smoke`, folded into `make lint`).
+
+Boots ONE writer and ONE stateless read replica — two real servers
+(build_app), two independent S3 clients — over one fake-S3 bucket
+(objstore/fake_s3.py, real HTTP + ETag/304 conditional GETs), and
+asserts the scale-out contract end to end:
+
+- writes acked by the writer are served EXACTLY by the replica once its
+  manifest epoch catches up (`/api/v1/cluster/refresh` forces the probe
+  instead of waiting out the watch interval);
+- replica query responses carry the `X-Horaedb-Staleness-Ms` header and
+  the EXPLAIN `cluster` verdict names the serving role + staleness token;
+- a write POSTed to the replica forwards to the owning writer (200 with
+  the writer's accounting; `horaedb_cluster_forwards_total` moves);
+- `/api/v1/cluster/status` answers on both nodes with matching manifest
+  epochs after catch-up, and the `horaedb_cluster_*` families render on
+  /metrics from boot.
+
+This is the end-to-end half tests/test_cluster.py can't give: two live
+server processes' worth of boot paths, the HTTP router, the header
+plumbing, and the real S3 wire protocol for the conditional-GET watch.
+
+Run: JAX_PLATFORMS=cpu python tools/cluster_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def make_payload(metric: str, rows: list) -> bytes:
+    from horaedb_tpu.pb import remote_write_pb2
+
+    by_host: dict = {}
+    for host, ts, v in rows:
+        by_host.setdefault(host, []).append((ts, v))
+    req = remote_write_pb2.WriteRequest()
+    for host in sorted(by_host):
+        series = req.timeseries.add()
+        for k, v in ((b"__name__", metric.encode()), (b"host", host.encode())):
+            lab = series.labels.add()
+            lab.name = k
+            lab.value = v
+        for t, val in by_host[host]:
+            s = series.samples.add()
+            s.timestamp = t
+            s.value = val
+    return req.SerializeToString()
+
+
+async def run(check) -> None:
+    import aiohttp
+    from aiohttp import web
+
+    from horaedb_tpu.objstore.fake_s3 import FakeS3
+    from horaedb_tpu.objstore.resilient import ResilientStore
+    from horaedb_tpu.objstore.s3 import S3LikeConfig, S3LikeStore
+    from horaedb_tpu.server.config import Config
+    from horaedb_tpu.server.main import build_app
+
+    creds = dict(region="us-east-1", key_id="smoke", key_secret="smoke")
+    fake = FakeS3(bucket="cluster-smoke")
+    s3_url = await fake.start()
+
+    def bucket_store(name: str):
+        # each "process" builds its own client over the ONE bucket, and
+        # wraps it in the same ResilientStore the production boot uses
+        return ResilientStore(
+            S3LikeStore(S3LikeConfig(endpoint=s3_url, bucket="cluster-smoke",
+                                     **creds)),
+            name=name,
+        )
+
+    def cfg(port: int, node: str, role: str, peers: list) -> Config:
+        return Config.from_dict({
+            "port": port,
+            "metric_engine": {
+                "node_id": node,
+                # smoke boxes: small + quiet
+                "rules": {"enabled": False},
+                "telemetry": {"enabled": False},
+                "storage": {"object_store": {
+                    "data_dir": tempfile.mkdtemp(prefix=f"horaedb-cs-{node}-"),
+                }},
+                "cluster": {
+                    "enabled": True,
+                    "role": role,
+                    "watch_interval": "500ms",
+                    "self_url": f"http://127.0.0.1:{port}",
+                    "peers": peers,
+                },
+            },
+        })
+
+    async def boot(config: Config, store):
+        app = await build_app(config, store=store)
+        runner = web.AppRunner(app, handler_cancellation=True)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", config.port)
+        await site.start()
+        return runner
+
+    wport, rport = 28871, 28872
+    wrunner = await boot(
+        cfg(wport, "w1", "writer",
+            [{"node": "r1", "url": f"http://127.0.0.1:{rport}",
+              "role": "replica"}]),
+        bucket_store("w1"),
+    )
+    rrunner = await boot(
+        cfg(rport, "r1", "replica",
+            [{"node": "w1", "url": f"http://127.0.0.1:{wport}",
+              "role": "writer"}]),
+        bucket_store("r1"),
+    )
+    wbase = f"http://127.0.0.1:{wport}"
+    rbase = f"http://127.0.0.1:{rport}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            # ---- write on the writer, catch the replica up, read exact
+            rows = [(f"h{i % 3}", 1000 + i * 500, float(i)) for i in range(12)]
+            async with s.post(f"{wbase}/api/v1/write",
+                              data=make_payload("cs_metric", rows)) as r:
+                check(r.status == 200, f"writer accepts the write ({r.status})")
+            async with s.post(f"{rbase}/api/v1/cluster/refresh") as r:
+                body = await r.json()
+                check(r.status == 200, "replica refresh answers 200")
+                check(body["data"]["outcome"] in ("refreshed", "unchanged"),
+                      f"refresh outcome sane ({body['data']})")
+
+            async def query(base: str):
+                async with s.post(f"{base}/api/v1/query", json={
+                    "metric": "cs_metric", "start_ms": 0, "end_ms": 10**9,
+                    "explain": 1,
+                }) as r:
+                    return r.status, await r.json(), dict(r.headers)
+
+            ws, wbody, _ = await query(wbase)
+            rs, rbody, rheaders = await query(rbase)
+            check(ws == 200 and rs == 200, "both nodes answer the query")
+            check(wbody["rows"] == len(rows), f"writer rows ({wbody['rows']})")
+            check(
+                {k: rbody[k] for k in ("rows", "tsid", "ts", "value")}
+                == {k: wbody[k] for k in ("rows", "tsid", "ts", "value")},
+                "replica serves BIT-IDENTICAL results after catch-up",
+            )
+            check("X-Horaedb-Staleness-Ms" in rheaders,
+                  "replica response carries X-Horaedb-Staleness-Ms")
+            verdict = rbody.get("explain", {}).get("cluster", {})
+            check(verdict.get("role") == "replica"
+                  and "staleness_ms" in verdict,
+                  f"EXPLAIN cluster verdict on the replica ({verdict})")
+
+            # ---- status on both nodes: epochs match after catch-up
+            async with s.get(f"{wbase}/api/v1/cluster/status") as r:
+                wst = (await r.json())["data"]
+            async with s.get(f"{rbase}/api/v1/cluster/status") as r:
+                rst = (await r.json())["data"]
+            check(wst["role"] == "writer" and rst["role"] == "replica",
+                  f"status roles ({wst['role']}, {rst['role']})")
+            check(wst["manifest_epoch"] == rst["manifest_epoch"],
+                  f"manifest epochs match after catch-up "
+                  f"({wst['manifest_epoch']} vs {rst['manifest_epoch']})")
+            check(rst.get("stale") is False, "replica within max_staleness")
+
+            # ---- a write POSTed to the REPLICA forwards to the writer
+            fwd_rows = [("fwd", 50_000, 7.0)]
+            async with s.post(f"{rbase}/api/v1/write",
+                              data=make_payload("cs_metric", fwd_rows)) as r:
+                body = await r.json()
+                check(r.status == 200 and body.get("samples") == 1,
+                      f"replica forwards the write ({r.status}, {body})")
+            async with s.post(f"{rbase}/api/v1/cluster/refresh") as r:
+                check(r.status == 200, "post-forward refresh")
+            _, rbody2, _ = await query(rbase)
+            check(rbody2["rows"] == len(rows) + 1,
+                  f"forwarded row visible on the replica ({rbody2['rows']})")
+
+            # ---- cluster metric families render on /metrics
+            async with s.get(f"{rbase}/metrics") as r:
+                text = await r.text()
+            for fam in ("horaedb_cluster_replica_lag_seconds",
+                        "horaedb_cluster_manifest_epoch",
+                        "horaedb_cluster_refreshes_total",
+                        "horaedb_cluster_forwards_total",
+                        "horaedb_cluster_watch_errors_total"):
+                check(fam in text, f"/metrics exposes {fam}")
+            fwd_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith("horaedb_cluster_forwards_total")
+                and 'kind="write"' in ln
+            ]
+            check(bool(fwd_lines)
+                  and float(fwd_lines[0].rsplit(" ", 1)[1]) >= 1,
+                  "write forward counted")
+    finally:
+        await rrunner.cleanup()
+        await wrunner.cleanup()
+        await fake.stop()
+
+
+def main() -> int:
+    failures: list[str] = []
+
+    def check(ok: bool, msg: str) -> None:
+        tag = "ok" if ok else "FAIL"
+        print(f"[cluster-smoke] {tag}: {msg}")
+        if not ok:
+            failures.append(msg)
+
+    asyncio.run(run(check))
+    if failures:
+        print(f"[cluster-smoke] {len(failures)} failure(s)")
+        return 1
+    print("[cluster-smoke] all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -130,6 +130,18 @@ Rules (see docs/static-analysis.md for rationale and examples):
         billing export would disagree about what a tenant consumed.
         Account through telemetry.metering.GLOBAL_METER.account(...), or
         suppress with the reason
+  J017  cluster-funnel breach (horaedb_tpu/cluster), two prongs:
+        (1) manifest snapshot VIEWS (`read_snapshot`/`read_folded_view`)
+        consumed outside the manifest package and the replica funnel
+        (cluster/replica.py drives them via read-only opens) — a second
+        view consumer is a second replication path whose staleness
+        token, swap invalidation, and watch backoff are untested;
+        (2) assignment-record mutation (a store put/delete whose
+        arguments name `cluster/assignment` / `assignment_path`)
+        outside cluster/assignment.py's fenced CAS API — an unversioned
+        write forks the meta plane and can silently reroute writes to a
+        deposed owner. Suppress with the reason for harnesses seeding
+        records on purpose
   J009  naked object-store construction outside objstore/: a concrete
         store (`MemStore`/`LocalStore`/`S3LikeStore`) built in engine
         code without being handed straight to a `ResilientStore(...)`
@@ -293,6 +305,9 @@ J013_WRITE_EXEMPT = (
     "horaedb_tpu/storage/manifest/",
     "horaedb_tpu/storage/rollup.py",
     "horaedb_tpu/storage/read.py",
+    # the replica's snapshot swap IS its flush/delete commit — the swap
+    # routes through serving_invalidate with the mutation's time range
+    "horaedb_tpu/cluster/replica.py",
 )
 SERVING_READ_FUNCS = {
     "serving_get", "serving_single_flight", "plan_rollups", "read_rollup",
@@ -336,6 +351,22 @@ STACK_SHAPED_TAILS = {
 }
 _BATCH_LANE_RE = re.compile(
     r"(^|_)(stacked?|padded|batch(ed)?|grids?|lanes?)(_|$)"
+)
+
+# J017: the cluster funnel (horaedb_tpu/cluster). Prong 1: manifest
+# snapshot views belong to the manifest package + the replica funnel.
+# Prong 2: assignment records mutate only through assignment.py's
+# fenced CAS (put_if_absent-arbitrated versions).
+J017_MODULES = ("horaedb_tpu/",)
+J017_VIEW_EXEMPT = (
+    "horaedb_tpu/storage/manifest/",
+    "horaedb_tpu/cluster/replica.py",
+)
+J017_ASSIGN_EXEMPT = ("horaedb_tpu/cluster/assignment.py",)
+MANIFEST_VIEW_FUNCS = {"read_snapshot", "read_folded_view"}
+STORE_MUTATION_TAILS = {"put", "put_if_absent", "put_stream", "delete"}
+_ASSIGNMENT_NAME_RE = re.compile(
+    r"cluster/assignment|assignment_path|assignment_dir|ASSIGNMENT_DIR"
 )
 METRIC_REGISTER_VERBS = {"counter", "gauge", "histogram"}
 TENANT_FAMILY_PREFIX = "horaedb_tenant_"
@@ -1020,6 +1051,55 @@ def _check_stacking_funnel(tree: ast.Module,
             ))
 
 
+def _check_cluster_funnel(
+    tree: ast.Module, findings: list[Finding],
+    check_views: bool, check_assign: bool,
+) -> None:
+    """J017: manifest-view consumption outside the replica funnel, and
+    assignment-record mutation outside the fenced CAS API (dotted-tail +
+    argument-naming heuristics, the J012/J016 class)."""
+    def _arg_names_and_strings(node: ast.Call):
+        for name in _arg_identifiers(node):
+            yield name
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    yield sub.value
+                elif isinstance(sub, ast.JoinedStr):
+                    for v in sub.values:
+                        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                            yield v.value
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        tail = fd.rsplit(".", 1)[-1] if fd else None
+        if check_views and tail in MANIFEST_VIEW_FUNCS:
+            findings.append(Finding(
+                node.lineno, "J017",
+                f"manifest view `{tail}(...)` consumed outside the "
+                "manifest package / the cluster replica funnel "
+                "(cluster/replica.py) — a second snapshot consumer is a "
+                "second replication path with no staleness token, swap "
+                "invalidation, or watch backoff; open the storage "
+                "read-only (read_only=True) or go through ReplicaEngine, "
+                "or suppress with the reason",
+            ))
+        elif check_assign and tail in STORE_MUTATION_TAILS and any(
+            _ASSIGNMENT_NAME_RE.search(s)
+            for s in _arg_names_and_strings(node)
+        ):
+            findings.append(Finding(
+                node.lineno, "J017",
+                f"assignment-record mutation `{tail}(...)` outside the "
+                "fenced CAS API (cluster/assignment.py) — an unversioned "
+                "write forks the meta plane and can reroute writes to a "
+                "deposed owner; use propose_assignment/claim_regions/"
+                "takeover_region, or suppress with the reason",
+            ))
+
+
 def _check_funnel_subscribers(tree: ast.Module,
                               findings: list[Finding]) -> None:
     """J014: the invalidation funnel's consumer set is pinned — only the
@@ -1355,6 +1435,17 @@ def lint_file(path: Path) -> list[str]:
         (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
         for h in J016_MODULES
     ) and not any(posix.endswith(m) for m in J016_EXEMPT)
+    in_j017_base = any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in J017_MODULES
+    )
+    j017_views = in_j017_base and not any(
+        (m.endswith("/") and f"/{m}" in f"/{posix}") or posix.endswith(m)
+        for m in J017_VIEW_EXEMPT
+    )
+    j017_assign = in_j017_base and not any(
+        posix.endswith(m) for m in J017_ASSIGN_EXEMPT
+    )
 
     idx = JitIndex()
     idx.visit(tree)
@@ -1390,6 +1481,8 @@ def lint_file(path: Path) -> list[str]:
         _check_metering_funnel(tree, findings)
     if in_j016_scope:
         _check_stacking_funnel(tree, findings)
+    if j017_views or j017_assign:
+        _check_cluster_funnel(tree, findings, j017_views, j017_assign)
     _check_lock_discipline(tree, findings)
 
     out = [
