@@ -31,7 +31,7 @@ lint:
 	$(MAKE) chaos-smoke
 	$(MAKE) cluster-smoke
 
-# Domain-aware gate (tools/jaxlint.py): host-sync on hot paths (J001),
+# Domain-aware gate (tools/jaxlint/): host-sync on hot paths (J001),
 # retrace hazards under jit (J002), dtype drift in engine code (J003),
 # lock discipline on the concurrency surface (J004), host timers/spans
 # inside jit bodies (J005), ad-hoc aggregation lanes (J006), naked jit
@@ -45,11 +45,15 @@ lint:
 # ad-hoc stacking/padding of query result lanes outside the query
 # batcher's stacked-execution funnel (J016), cluster-funnel breaches —
 # manifest views outside the replica funnel, assignment-record mutation
-# outside the fenced CAS API (J017).
+# outside the fenced CAS API (J017). Whole-program passes over the
+# shared call-graph index: event-loop blocking reachable from
+# coroutines (J018), lock-order deadlock cycles + await-under-sync-lock
+# (J019), deadline-propagation completeness on query-reachable loops
+# (J020), suppression hygiene — stale or reason-less disables (J021).
 # Findings print as path:line: CODE message.
 # Rules + suppression syntax: docs/static-analysis.md
 jaxlint:
-	python tools/jaxlint.py
+	python -m tools.jaxlint
 
 # Observability gate: boot the server against the in-process fake S3,
 # push one remote-write batch, run one query, and fail if any /metrics

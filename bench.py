@@ -704,7 +704,7 @@ def query_serving_lane(smoke: bool) -> dict:
             cells = n_hosts * hours  # hourly-grid panel cost estimate
 
             # ---- cold pass: every panel's first execution (all misses)
-            RESULT_CACHE.clear()  # jaxlint: disable=J013 bench harness resets state between passes
+            RESULT_CACHE.clear()  # bench harness resets state between passes
             cold_lat: list[float] = []
             subst = 0
             for req in reqs:
@@ -1089,7 +1089,7 @@ def scan_encoded_lane(smoke: bool) -> dict:
     enc_bpr = sum(l.encoded_bytes() for l in e.lanes.values()) / n
 
     # ---- decode rows/s per (codec, impl) through the funnel ------------
-    # jaxlint: disable=J012 bench lane measuring the funnel's own decode rate
+    # bench lane measuring the funnel's own decode rate
     decode_rps: dict[str, dict] = {}
     auto_impl: dict[str, str] = {}
     for name, lane in e.lanes.items():

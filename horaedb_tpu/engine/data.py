@@ -33,6 +33,7 @@ from horaedb_tpu.ops import filter as F
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
 from horaedb_tpu.storage import scanstats
 from horaedb_tpu.storage.read import ScanRequest, WriteRequest
+from horaedb_tpu.storage.storage import ObjectBasedStorage
 from horaedb_tpu.storage.types import TimeRange
 
 logger = logging.getLogger(__name__)
@@ -97,7 +98,7 @@ def _zeros_u64(n: int) -> np.ndarray:
 class SampleManager:
     def __init__(
         self,
-        storage,
+        storage: ObjectBasedStorage,
         segment_duration_ms: int,
         buffer_rows: int = 0,
         flush_workers: int = 2,

@@ -37,6 +37,7 @@ def series_key_of(labels: list[tuple[bytes, bytes]]) -> bytes:
     parts = []
     for k, v in sorted(labels):
         parts.append(struct.pack("<I", len(k)) + k + struct.pack("<I", len(v)) + v)
+    # jaxlint: disable=J018 bounded by one series' label count, not a streaming accumulation
     return b"".join(parts)
 
 

@@ -112,7 +112,9 @@ class ObjectStore(ABC):
         parts = []
         async for c in chunks:
             parts.append(c)
-        data = b"".join(parts)
+        # the join materializes the whole object — CPU-bound for large
+        # SSTs, so it runs off the event loop (J018)
+        data = await asyncio.to_thread(b"".join, parts)
         await self.put(path, data)
         return len(data)
 

@@ -238,7 +238,8 @@ class ChaosStore(ObjectStore):
         async for c in chunks:
             parts.append(c)
             self._check_crash("put_stream_mid", path)
-        await self._inner.put(path, b"".join(parts))
+        data = await asyncio.to_thread(b"".join, parts)
+        await self._inner.put(path, data)
         self._mark_unlisted(path)
         self._post("put_stream", path, faults)
         return sum(len(p) for p in parts)

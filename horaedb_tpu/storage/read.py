@@ -1638,7 +1638,10 @@ class ParquetReader:
             (
                 sorted_cols, perm, _keep, starts, kept, numeric_names, binary_names,
             ) = self._fused_pass(table, predicate)
-            result = self._materialize_append_mode(
+            # group-byte concatenation + arrow rebuild is CPU-bound
+            # host work: off the event loop (J018)
+            result = await asyncio.to_thread(
+                self._materialize_append_mode,
                 table, sorted_cols, np.asarray(perm), np.asarray(starts),
                 int(kept), numeric_names, binary_names, out_names,
             )
@@ -1790,12 +1793,17 @@ class ParquetReader:
                         if v in table.schema.names
                     ]
                 )
-                groups = [
-                    op.merge(table.slice(s, e - s).to_batches()[0])
-                    if e - s > 1
-                    else table.slice(s, 1).to_batches()[0]
-                    for s, e in zip(start_idx, ends)
-                ]
+                def _merge_groups() -> list[pa.RecordBatch]:
+                    # per-group byte concatenation is CPU-bound host
+                    # work: one thread hop for the whole batch (J018)
+                    return [
+                        op.merge(table.slice(s, e - s).to_batches()[0])
+                        if e - s > 1
+                        else table.slice(s, 1).to_batches()[0]
+                        for s, e in zip(start_idx, ends)
+                    ]
+
+                groups = await asyncio.to_thread(_merge_groups)
                 table = pa.Table.from_batches(groups)
 
         out_names = self._output_names(read_names, keep_builtin)

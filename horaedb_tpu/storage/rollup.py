@@ -40,6 +40,7 @@ series instead of every raw sample — the billion-point-query fix.
 
 from __future__ import annotations
 
+import asyncio
 import io
 import json
 import logging
@@ -272,7 +273,8 @@ async def read_rollup(storage, record: RollupRecord) -> dict:
             return hit[0]
     path = storage.sst_path_gen.generate_rollup(record.sst_id)
     data = await storage.store.get(path)
-    lanes = decode_rollup(data)
+    # parquet decode is CPU-bound host work: off the event loop (J018)
+    lanes = await asyncio.to_thread(decode_rollup, data)
     nbytes = sum(a.nbytes for a in lanes.values())
     with _CACHE_LOCK:
         if record.sst_id not in _CACHE and nbytes <= _CACHE_CAP // 4:

@@ -51,6 +51,7 @@ from horaedb_tpu.objstore.resilient import (
 )
 from tests.conftest import async_test
 from tests.test_flush_pipeline import make_remote_write
+from tools.lockwitness import maybe_witness
 
 HOUR = 3_600_000
 
@@ -512,9 +513,25 @@ SOAK_PLAN = FaultPlan(
 )
 
 
+@pytest.fixture()
+def lock_witness():
+    """Dynamic lock-order recording over a soak, behind
+    HORAEDB_LOCKWITNESS=1 (tools/lockwitness.py). When enabled, every
+    threading.Lock/RLock the soak creates is wrapped, held-before edges
+    are recorded, and the teardown fails on any order cycle — a latent
+    deadlock the static J019 pass can only see per lock-attribute, not
+    across live instances. Yields None (zero overhead) when off."""
+    with maybe_witness() as w:
+        yield w
+    if w is not None:
+        assert not w.cycles(), w.format_report()
+
+
 class TestEngineChaosSoak:
     @async_test
-    async def test_soak_exact_results_zero_acked_loss_orphan_gc(self):
+    async def test_soak_exact_results_zero_acked_loss_orphan_gc(
+        self, lock_witness
+    ):
         """The chaos soak: 24 rounds of write -> (flush) -> (compact) ->
         query under SOAK_PLAN, a mid-soak crash (abandon without close)
         and reopen. Invariants: query results EXACTLY match the host
@@ -697,7 +714,9 @@ class TestDirtyTrafficChaosSoak:
     never a hang, never silent loss of in-budget samples."""
 
     @async_test
-    async def test_dirty_soak_exact_with_deletes_crash_and_limit(self):
+    async def test_dirty_soak_exact_with_deletes_crash_and_limit(
+        self, lock_witness
+    ):
         from horaedb_tpu.ingest.cardinality import CardinalityLimited
 
         inner = MemStore()
@@ -1084,7 +1103,9 @@ class TestRulesChaosSoak:
         return out
 
     @async_test
-    async def test_rules_soak_exact_output_exactly_once_transitions(self):
+    async def test_rules_soak_exact_output_exactly_once_transitions(
+        self, lock_witness
+    ):
         from horaedb_tpu.rules import AlertRule, RecordingRule
         from horaedb_tpu.rules.engine import RuleEngine
 
@@ -1236,7 +1257,7 @@ class TestRulesChaosSoak:
 class TestEncodedChaosSoak:
     @async_test
     async def test_encoded_ssts_survive_chaos_crash_and_compaction(
-        self, monkeypatch
+        self, monkeypatch, lock_witness
     ):
         """The compressed-domain-scan chaos variant: the same
         write -> flush -> compact -> query soak under SOAK_PLAN, with

@@ -18,12 +18,12 @@ class TestLintGate:
         assert r.returncode == 0, f"lint findings:\n{r.stdout}{r.stderr}"
 
     def test_tree_is_jaxlint_clean(self):
-        """The JAX-aware gate (tools/jaxlint.py: host-sync, retrace,
+        """The JAX-aware gate (tools/jaxlint: host-sync, retrace,
         dtype, lock-discipline rules) rides the same pytest gate, so
         every test run enforces BOTH analyzers — see tests/test_jaxlint.py
         for the rule-behavior corpus."""
         r = subprocess.run(
-            [sys.executable, str(REPO / "tools" / "jaxlint.py")],
+            [sys.executable, "-m", "tools.jaxlint"],
             capture_output=True, text=True, cwd=REPO, timeout=120,
         )
         assert r.returncode == 0, f"jaxlint findings:\n{r.stdout}{r.stderr}"

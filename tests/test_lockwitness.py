@@ -1,0 +1,176 @@
+"""tools/lockwitness.py — the dynamic lock-order witness.
+
+Proves the recorder: creation-site identity, held-before edge capture,
+AB/BA inversion detection (incl. across real threads), RLock-reentry
+and self-edge exemptions, clean uninstall, and the HORAEDB_LOCKWITNESS
+gate the chaos soaks honor. The soak wiring itself lives in
+tests/test_chaos.py (`lock_witness` fixture)."""
+
+import threading
+
+from tools.lockwitness import ENV_FLAG, LockWitness, maybe_witness, witness
+
+
+def make_pair():
+    """Two locks with distinct creation sites (distinct lines)."""
+    a = threading.Lock()
+    b = threading.Lock()
+    return a, b
+
+
+class TestRecording:
+    def test_nested_acquire_records_edge(self):
+        with witness() as w:
+            a, b = make_pair()
+            with a:
+                with b:
+                    pass
+        edges = w.edges()
+        assert len(edges) == 1
+        (src, dst), (count, site, thread) = next(iter(edges.items()))
+        assert "test_lockwitness.py" in src and "test_lockwitness.py" in dst
+        assert src != dst  # distinct creation lines -> distinct identities
+        assert count == 1
+        assert "test_lockwitness.py" in site
+        assert thread  # witness thread name captured
+
+    def test_consistent_order_has_no_cycle(self):
+        with witness() as w:
+            a, b = make_pair()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        assert w.cycles() == []
+        ((_, _),) = w.edges().keys()  # still a single collapsed edge
+        (count, _, _) = next(iter(w.edges().values()))
+        assert count == 3
+
+    def test_ab_ba_inversion_is_a_cycle(self):
+        with witness() as w:
+            a, b = make_pair()
+            with a:
+                with b:
+                    pass
+            with b:  # sequential, so no real deadlock — but a latent one
+                with a:
+                    pass
+        cycles = w.cycles()
+        assert len(cycles) == 1
+        assert "CYCLES" in w.format_report()
+
+    def test_inversion_across_real_threads(self):
+        """The shape the soak hunts: two threads, opposite order."""
+        with witness() as w:
+            a, b = make_pair()
+
+            def t1():
+                with a:
+                    with b:
+                        pass
+
+            def t2():
+                with b:
+                    with a:
+                        pass
+
+            # run t1 to completion BEFORE starting t2: the inversion is
+            # recorded across threads without ever actually deadlocking
+            th1 = threading.Thread(target=t1)
+            th1.start()
+            th1.join()
+            th2 = threading.Thread(target=t2)
+            th2.start()
+            th2.join()
+        assert len(w.cycles()) >= 1
+
+    def test_same_site_instances_collapse_no_self_edge(self):
+        """Locks born at one site are one node; nesting two instances
+        from the same line records no self-edge (the per-instance case
+        is the static J019 self-reacquire rule's job)."""
+        with witness() as w:
+            locks = [threading.Lock() for _ in range(2)]  # one site
+            with locks[0]:
+                with locks[1]:
+                    pass
+        assert w.edges() == {}
+
+    def test_rlock_reentry_records_nothing(self):
+        with witness() as w:
+            r = threading.RLock()
+            with r:
+                with r:  # reentry cannot deadlock against itself
+                    pass
+        assert w.edges() == {}
+        assert w.cycles() == []
+
+    def test_condition_default_lock_is_recorded(self):
+        """Condition() builds its lock via the patched RLock factory,
+        so condition-protected regions join the order graph."""
+        with witness() as w:
+            outer = threading.Lock()
+            cond = threading.Condition()
+            with outer:
+                with cond:
+                    pass
+        assert len(w.edges()) == 1
+
+    def test_non_lifo_release_keeps_held_set_correct(self):
+        with witness() as w:
+            a, b = make_pair()
+            a.acquire()
+            b.acquire()
+            a.release()  # release out of order
+            c = threading.Lock()
+            c.acquire()  # held = {b} -> edge b->c only
+            b.release()
+            c.release()
+        srcs = {s for s, _ in w.edges()}
+        assert len(w.edges()) == 2  # a->b and b->c; never a->c
+        assert all("test_lockwitness.py" in s for s in srcs)
+
+
+class TestInstall:
+    def test_uninstall_restores_factories(self):
+        before = (threading.Lock, threading.RLock)
+        with witness():
+            assert threading.Lock is not before[0]
+            assert threading.RLock is not before[1]
+        assert (threading.Lock, threading.RLock) == before
+
+    def test_locks_created_before_install_are_invisible(self):
+        pre = threading.Lock()
+        with witness() as w:
+            post = threading.Lock()
+            with pre:
+                with post:
+                    pass
+        # pre-existing lock is a raw _thread.lock: no node, no edge
+        assert w.edges() == {}
+
+    def test_double_install_is_idempotent(self):
+        w = LockWitness()
+        orig = threading.Lock
+        w.install()
+        w.install()
+        w.uninstall()
+        assert threading.Lock is orig
+
+
+class TestEnvGate:
+    def test_off_by_default_yields_none(self, monkeypatch):
+        monkeypatch.delenv(ENV_FLAG, raising=False)
+        orig = threading.Lock
+        with maybe_witness() as w:
+            assert w is None
+            assert threading.Lock is orig  # nothing patched
+
+    def test_flag_enables_recording(self, monkeypatch):
+        monkeypatch.setenv(ENV_FLAG, "1")
+        with maybe_witness() as w:
+            assert w is not None
+            a, b = make_pair()
+            with a:
+                with b:
+                    pass
+        assert len(w.edges()) == 1

@@ -1,0 +1,1 @@
+"""Repo tooling (lint gates, witnesses). Package so `python -m tools.jaxlint` works."""

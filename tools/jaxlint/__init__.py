@@ -1,0 +1,16 @@
+"""jaxlint: the domain-aware lint gate, as a package.
+
+Layout (one module per concern):
+- base.py      findings, suppressions, dotted names, path scoping
+- jitrules.py  J001-J003, J005-J007 (trace discipline, dtype hygiene)
+- lockrules.py J004 (per-class lock discipline)
+- funnels.py   J008-J017 (architectural funnel boundaries)
+- perfile.py   per-file dispatcher (parse + scope + run J001-J017)
+- program.py   the shared whole-program index (call graph, async
+               reachability, lock graph, loop inventory)
+- concurrency.py J018-J020 graph passes
+- hygiene.py   J000/J021 suppression hygiene
+- registry.py  check inventory (docs drift gate + cache key)
+- cache.py     incremental lint cache
+- __main__.py  CLI orchestrator (`python -m tools.jaxlint`)
+"""

@@ -1,0 +1,128 @@
+"""Shared primitives for every jaxlint pass: findings, suppressions,
+dotted-name resolution, scope matching.
+
+Kept dependency-free (stdlib `ast`/`re` only) so both the per-file
+passes and the whole-program index build on one vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=((?:J\d{3})(?:\s*,\s*J\d{3})*)(?:\s+(.+))?"
+)
+
+
+class Finding:
+    __slots__ = ("lineno", "code", "msg")
+
+    def __init__(self, lineno: int, code: str, msg: str):
+        self.lineno, self.code, self.msg = lineno, code, msg
+
+    def as_tuple(self) -> tuple[int, str, str]:
+        return (self.lineno, self.code, self.msg)
+
+
+class Suppressions:
+    """Per-file `# jaxlint: disable=...` map (same line or line above).
+
+    ``by_line`` maps comment line -> (codes, reason); ``malformed``
+    lists reason-less comments (J000). The hygiene pass (J021) walks
+    ``by_line`` directly to find suppressions whose line no longer
+    triggers the named check.
+    """
+
+    def __init__(self, lines: list[str]):
+        self.by_line: dict[int, tuple[set[str], str]] = {}
+        self.malformed: list[int] = []
+        for i, line in enumerate(lines, 1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            codes = {c.strip() for c in m.group(1).split(",")}
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.malformed.append(i)
+            self.by_line[i] = (codes, reason)
+
+    def covers(self, lineno: int, code: str) -> bool:
+        for ln in (lineno, lineno - 1):
+            ent = self.by_line.get(ln)
+            if ent and code in ent[0] and ent[1]:
+                return True
+        return False
+
+    def as_dict(self) -> dict:
+        """JSON-serializable form for the incremental cache."""
+        return {
+            "by_line": {
+                str(ln): [sorted(codes), reason]
+                for ln, (codes, reason) in self.by_line.items()
+            },
+            "malformed": self.malformed,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Suppressions":
+        self = cls([])
+        self.by_line = {
+            int(ln): (set(codes), reason)
+            for ln, (codes, reason) in d.get("by_line", {}).items()
+        }
+        self.malformed = list(d.get("malformed", []))
+        return self
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`jax.numpy.full` -> "jax.numpy.full"; None for non-name chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_no_nested_defs(body: list[ast.stmt]):
+    """Yield nodes of a function body WITHOUT descending into nested
+    function/class definitions (those are visited separately, with
+    their own context flags)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def arg_identifiers(node: ast.Call):
+    """Every Name/Attribute identifier reachable from a call's args."""
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name):
+                yield sub.id
+            elif isinstance(sub, ast.Attribute):
+                yield sub.attr
+
+
+def in_scope(posix: str, prefixes: tuple[str, ...]) -> bool:
+    """Path-scope test shared by every module-scoped rule: a prefix
+    ending in "/" matches a directory component anywhere in the path;
+    otherwise the path's tail must match exactly."""
+    return any(
+        (h.endswith("/") and f"/{h}" in f"/{posix}") or posix.endswith(h)
+        for h in prefixes
+    )
+
+
+def scoped(posix: str, modules: tuple[str, ...],
+           exempt: tuple[str, ...] = ()) -> bool:
+    return in_scope(posix, modules) and not in_scope(posix, exempt)

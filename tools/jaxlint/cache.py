@@ -1,0 +1,135 @@
+"""Incremental lint cache (satellite 2).
+
+Two entry kinds, both keyed on `inventory_digest()` so ANY change to
+the linter's own source invalidates everything:
+
+- per-file: (path, sha256 of file bytes) -> raw per-file findings +
+  suppression table. A warm re-lint parses and re-checks only files
+  whose bytes changed.
+- tree: sha256 over every (path, file digest) pair -> the graph-pass
+  findings (J018-J020). The whole-program index is only rebuilt when
+  any analyzed file changed; an untouched tree re-lints from cache in
+  well under the 2 s budget.
+
+Same persistence convention as the engine's calibration caches
+(common/calib_cache.py): `$TMPDIR/horaedb-tpu/jaxlint_cache.json`,
+`HORAEDB_JAXLINT_CACHE` overrides with a full file path, writes are
+atomic (tmp + os.replace). A corrupt or unreadable cache file is
+treated as empty — the cache can never make lint fail."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from pathlib import Path
+
+from tools.jaxlint.base import Finding, Suppressions
+
+_SCHEMA = 2
+
+
+def cache_path() -> Path:
+    env = os.environ.get("HORAEDB_JAXLINT_CACHE")
+    if env:
+        return Path(env)
+    return Path(tempfile.gettempdir()) / "horaedb-tpu" / \
+        "jaxlint_cache.json"
+
+
+def file_digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def tree_digest(file_digests: dict[str, str]) -> str:
+    h = hashlib.sha256()
+    for path in sorted(file_digests):
+        h.update(path.encode())
+        h.update(file_digests[path].encode())
+    return h.hexdigest()
+
+
+def _findings_to_json(findings: list[Finding]) -> list[list]:
+    return [list(f.as_tuple()) for f in findings]
+
+
+def _findings_from_json(rows) -> list[Finding]:
+    return [Finding(int(r[0]), str(r[1]), str(r[2])) for r in rows]
+
+
+class LintCache:
+    def __init__(self, inventory: str, path: Path | None = None):
+        self.inventory = inventory
+        self.path = path or cache_path()
+        self._data: dict = {"schema": _SCHEMA, "inventory": inventory,
+                            "files": {}, "tree": None}
+        self._dirty = False
+
+    def load(self) -> None:
+        try:
+            raw = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return
+        if not isinstance(raw, dict) or raw.get("schema") != _SCHEMA \
+                or raw.get("inventory") != self.inventory:
+            return  # linter source changed: start cold
+        self._data = raw
+        self._data.setdefault("files", {})
+        self._data.setdefault("tree", None)
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.path.with_suffix(
+                f".tmp.{os.getpid()}")
+            tmp.write_text(json.dumps(self._data, separators=(",", ":")))
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # best effort: a read-only tmpdir just means cold runs
+
+    # ------------------------------------------------------- per-file
+
+    def get_file(self, path: str, digest: str) \
+            -> tuple[list[Finding], Suppressions] | None:
+        entry = self._data["files"].get(path)
+        if not entry or entry.get("digest") != digest:
+            return None
+        return (_findings_from_json(entry["findings"]),
+                Suppressions.from_dict(entry["sup"]))
+
+    def put_file(self, path: str, digest: str, findings: list[Finding],
+                 sup: Suppressions) -> None:
+        self._data["files"][path] = {
+            "digest": digest,
+            "findings": _findings_to_json(findings),
+            "sup": sup.as_dict(),
+        }
+        self._dirty = True
+
+    def prune(self, live_paths: set[str]) -> None:
+        """Drop entries for files no longer analyzed (deleted/renamed)."""
+        stale = [p for p in self._data["files"] if p not in live_paths]
+        for p in stale:
+            del self._data["files"][p]
+            self._dirty = True
+
+    # ----------------------------------------------------------- tree
+
+    def get_tree(self, digest: str) -> dict[str, list[Finding]] | None:
+        entry = self._data.get("tree")
+        if not entry or entry.get("digest") != digest:
+            return None
+        return {p: _findings_from_json(rows)
+                for p, rows in entry["findings"].items()}
+
+    def put_tree(self, digest: str,
+                 findings: dict[str, list[Finding]]) -> None:
+        self._data["tree"] = {
+            "digest": digest,
+            "findings": {p: _findings_to_json(fs)
+                         for p, fs in findings.items() if fs},
+        }
+        self._dirty = True

@@ -1,0 +1,216 @@
+"""Whole-program concurrency passes over the shared ProgramIndex.
+
+J018 — event-loop blocking: a blocking primitive whose enclosing
+function can run ON the event loop (async-reachability), reported at
+the blocking site with the witness call chain back to a coroutine.
+
+J019 — lock-order deadlock: (a) cycles among distinct lock identities
+in the held-while-acquiring graph (every edge of a cyclic SCC gets a
+finding, so both sides of an AB/BA inversion are visible); (b)
+re-acquiring a non-reentrant lock through a pure `self.` call chain;
+(c) `await` while holding a sync `threading` lock — the loop thread
+parks inside the critical section and every other thread contending
+for that lock stalls behind a suspended coroutine.
+
+J020 — deadline-propagation completeness: loops in query-reachable
+code that do heavy work (await, blocking op, kernel dispatch within
+FRAME_DEPTH frames) but reach no `deadline.check`/`deadline_scope`
+checkpoint within the same depth. Only the INNERMOST offending loop is
+reported — placing a check there covers the enclosing loops too.
+"""
+
+from __future__ import annotations
+
+from tools.jaxlint.base import Finding
+from tools.jaxlint.program import LoopInfo, ProgramIndex
+
+FRAME_DEPTH = 3
+QUERY_SEEDS = {"query", "query_exemplars", "run_query",
+               "run_query_exemplars"}
+
+
+def check_event_loop_blocking(
+        index: ProgramIndex) -> dict[str, list[Finding]]:
+    """J018: blocking ops in on-loop functions -> {path: findings}."""
+    out: dict[str, list[Finding]] = {}
+    seen: set[tuple[str, int, str]] = set()
+    for qname in index.on_loop:
+        fi = index.functions[qname]
+        for lineno, desc in fi.blocking:
+            key = (fi.path, lineno, desc)
+            if key in seen:
+                continue
+            seen.add(key)
+            chain = index.witness_chain(qname)
+            via = " <- ".join(q.rsplit(".", 1)[-1] for q in chain)
+            out.setdefault(fi.path, []).append(Finding(
+                lineno, "J018",
+                f"{desc} blocks the event loop (reachable from a "
+                f"coroutine: {via}); offload via asyncio.to_thread / "
+                "run_in_executor or move off the async path",
+            ))
+    return out
+
+
+def _sccs(nodes: set[str],
+          edges: dict[tuple[str, str], tuple]) -> list[set[str]]:
+    """Tarjan SCCs, iterative (lock graphs are tiny but cycles are the
+    whole point, so no recursion-depth surprises)."""
+    adj: dict[str, list[str]] = {n: [] for n in nodes}
+    for (a, b) in edges:
+        if a in adj and b in nodes:
+            adj[a].append(b)
+    idx: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = [0]
+    out: list[set[str]] = []
+
+    for root in sorted(nodes):
+        if root in idx:
+            continue
+        work: list[tuple[str, int]] = [(root, 0)]
+        while work:
+            node, child_i = work.pop()
+            if child_i == 0:
+                idx[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            for i in range(child_i, len(adj[node])):
+                nxt = adj[node][i]
+                if nxt not in idx:
+                    work.append((node, i + 1))
+                    work.append((nxt, 0))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], idx[nxt])
+            if advanced:
+                continue
+            if low[node] == idx[node]:
+                scc: set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return out
+
+
+def check_lock_order(index: ProgramIndex) -> dict[str, list[Finding]]:
+    """J019 -> {path: findings}."""
+    out: dict[str, list[Finding]] = {}
+    nodes = {n for e in index.lock_edges for n in e}
+    for scc in _sccs(nodes, index.lock_edges):
+        if len(scc) < 2:
+            continue
+        cycle = " -> ".join(sorted(scc))
+        for (a, b), (path, lineno, via) in sorted(
+                index.lock_edges.items(),
+                key=lambda kv: (kv[1][0], kv[1][1])):
+            if a in scc and b in scc:
+                out.setdefault(path, []).append(Finding(
+                    lineno, "J019",
+                    f"lock-order cycle {{{cycle}}}: this site acquires "
+                    f"`{b}` while holding `{a}` (via {via}); another "
+                    "path acquires them in the opposite order — fix a "
+                    "global order or collapse to one lock",
+                ))
+    for lock, path, lineno, via in sorted(
+            set(index.self_reacquires), key=lambda t: (t[1], t[2])):
+        out.setdefault(path, []).append(Finding(
+            lineno, "J019",
+            f"re-acquires non-reentrant `{lock}` already held by this "
+            f"call chain (via {via}) — self-deadlock; use the _locked "
+            "variant of the callee or an RLock",
+        ))
+    for qname, fi in sorted(index.functions.items()):
+        for lineno, lock in fi.awaits_under_sync_lock:
+            out.setdefault(fi.path, []).append(Finding(
+                lineno, "J019",
+                f"`await` while holding sync threading lock `{lock}` — "
+                "the event loop parks inside the critical section and "
+                "other threads stall; release before awaiting or use "
+                "asyncio.Lock",
+            ))
+    return out
+
+
+def _query_reachable(index: ProgramIndex) -> set[str]:
+    seeds = [q for q, fi in index.functions.items()
+             if fi.name in QUERY_SEEDS]
+    seen = set(seeds)
+    queue = list(seeds)
+    while queue:
+        q = queue.pop()
+        for cs in index.functions[q].calls:
+            t = cs.target
+            if cs.offload == "detached" or cs.deadline_free:
+                continue  # spawned / deliberately shielded work is off
+                # the query's deadline path
+            if t and t in index.functions and t not in seen:
+                if index.functions[t].detaches_deadline:
+                    continue  # callee opts out (deadline_ctx.detach())
+                seen.add(t)
+                queue.append(t)
+    return seen
+
+
+def _loop_heavy(index: ProgramIndex, lp: LoopInfo) -> bool:
+    if lp.has_await or lp.blocking:
+        return True
+    return any(
+        cs.target and cs.offload != "detached"
+        and index.reaches_heavy_work(cs.target, FRAME_DEPTH)
+        for cs in lp.calls
+    )
+
+
+def _loop_checked(index: ProgramIndex, lp: LoopInfo) -> bool:
+    if lp.has_check:
+        return True
+    return any(
+        cs.target and cs.offload != "detached"
+        and index.reaches_checkpoint(cs.target, FRAME_DEPTH)
+        for cs in lp.calls
+    )
+
+
+def check_deadline_propagation(
+        index: ProgramIndex) -> dict[str, list[Finding]]:
+    """J020 -> {path: findings}."""
+    out: dict[str, list[Finding]] = {}
+    reachable = _query_reachable(index)
+    for qname in sorted(reachable):
+        fi = index.functions[qname]
+        offending: list[LoopInfo] = [
+            lp for lp in fi.loops
+            if _loop_heavy(index, lp) and not _loop_checked(index, lp)
+        ]
+        offending_set = set(id(lp) for lp in offending)
+
+        def has_offending_child(lp: LoopInfo) -> bool:
+            return any(
+                id(c) in offending_set or has_offending_child(c)
+                for c in lp.children
+            )
+
+        for lp in offending:
+            if has_offending_child(lp):
+                continue  # report the innermost loop only
+            out.setdefault(fi.path, []).append(Finding(
+                lp.lineno, "J020",
+                f"query-reachable loop in {fi.name}() does heavy work "
+                "but no deadline checkpoint within "
+                f"{FRAME_DEPTH} frames; add deadline_ctx.check(...) so "
+                "slow queries cancel instead of running to completion",
+            ))
+    return out

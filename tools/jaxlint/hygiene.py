@@ -1,0 +1,46 @@
+"""J021 suppression hygiene, computed LAST from the raw (pre-
+suppression) findings of every other pass: a `# jaxlint: disable=J0xx`
+whose code no longer fires on the line it covers is stale — the
+underlying finding was fixed (or the rule retired) and the suppression
+now hides nothing except FUTURE regressions of unknown provenance.
+Reported at the suppression comment's line. J000 (missing reason) also
+lives here since it is a property of the suppression table, not of any
+pass's findings."""
+
+from __future__ import annotations
+
+from tools.jaxlint.base import Finding, Suppressions
+from tools.jaxlint.registry import BY_CODE
+
+
+def check_suppression_hygiene(
+        sup: Suppressions, raw: list[Finding]) -> list[Finding]:
+    out: list[Finding] = []
+    for lineno in sup.malformed:
+        out.append(Finding(
+            lineno, "J000",
+            "suppression without a reason: write "
+            "`# jaxlint: disable=J0xx <why this is intentional>`",
+        ))
+    fired: set[tuple[int, str]] = {(f.lineno, f.code) for f in raw}
+    for lineno, (codes, reason) in sorted(sup.by_line.items()):
+        if not reason:
+            continue  # J000 above already demands a rewrite
+        for code in sorted(codes):
+            if code not in BY_CODE:
+                out.append(Finding(
+                    lineno, "J021",
+                    f"suppression names unknown code {code} — "
+                    "not in the check inventory",
+                ))
+                continue
+            # a suppression on line L covers findings at L and L+1
+            if (lineno, code) in fired or (lineno + 1, code) in fired:
+                continue
+            out.append(Finding(
+                lineno, "J021",
+                f"stale suppression: {code} does not fire here any "
+                "more — delete the disable comment (fixed findings "
+                "must not leave blanket immunity behind)",
+            ))
+    return out

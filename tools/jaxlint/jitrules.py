@@ -1,0 +1,448 @@
+"""Per-file JIT-surface rules: J001 host sync, J002 retrace hazards,
+J003 dtype drift, J005 host timers under jit, J006 ad-hoc aggregation
+lanes, J007 naked jit. Moved verbatim from the single-file linter;
+rationale and examples live in docs/static-analysis.md."""
+
+from __future__ import annotations
+
+import ast
+
+from tools.jaxlint.base import Finding, dotted, walk_no_nested_defs
+
+# Modules whose host-side code is ALSO held to the no-silent-sync bar
+# (the columnar scan/merge/aggregate surface PAPERS.md budgets):
+HOT_MODULES = (
+    "horaedb_tpu/ops/",
+    "horaedb_tpu/parallel/",
+    "horaedb_tpu/storage/read.py",
+)
+# Engine-code scope for the dtype rule (J003):
+DTYPE_MODULES = (
+    "horaedb_tpu/ops/",
+    "horaedb_tpu/parallel/",
+    "horaedb_tpu/engine/",
+    "horaedb_tpu/storage/",
+)
+
+JIT_WRAPPERS = {
+    "jit", "jax.jit", "pjit", "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+    # the instrumented wrapper (common/xprof.py) IS a jit wrapper: bodies
+    # it traces stay under the J001/J002/J005/J006 in-jit rules
+    "xjit", "xprof.xjit", "common.xprof.xjit",
+}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+# J007: jit spellings that bypass xprof's compile telemetry. Scope below
+# (J007_MODULES); `shard_map` alone is fine — the telemetry hook is the
+# OUTER jit wrapper, which must be xjit.
+NAKED_JIT = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+J007_MODULES = (
+    "horaedb_tpu/ops/",
+    "horaedb_tpu/parallel/",
+    "horaedb_tpu/promql/",
+)
+
+# device -> host syncs, unambiguous even outside jit
+SYNC_METHODS = {"item", "block_until_ready"}
+SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+# additionally wrong inside a traced function
+TRACE_SYNC_METHODS = SYNC_METHODS | {"tolist"}
+TRACE_SYNC_CALLS = SYNC_CALLS | {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.block_until_ready",
+}
+CONCRETIZING_BUILTINS = {"float", "int", "bool"}
+
+# trace-time-frozen calls: evaluated ONCE at trace time, silently stale
+# on every cached-trace call after that
+FROZEN_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "time.process_time", "datetime.now", "datetime.datetime.now",
+    "datetime.utcnow", "datetime.datetime.utcnow",
+}
+FROZEN_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+JNP_DTYPE_CTORS = {
+    "jnp.array": 1, "jnp.full": 2,          # positional index of dtype
+    "jax.numpy.array": 1, "jax.numpy.full": 2,
+}
+
+# Host-wall-clock timer / span context managers (J005): legitimate on the
+# host side of a kernel boundary, a lie inside a traced body. Bare names
+# cover `from ... import stage` style; dotted forms match only when the
+# module component is literally `scanstats`/`tracing` — an alias like
+# `import ... as ss; ss.stage(...)` evades the rule (the cost of not
+# flagging every unrelated `.trace()`/`.stage()` method, e.g. the linalg
+# `jnp.trace`). The tree imports these modules by their real names.
+TIMER_FUNCS = {"stage", "scan_stats", "span", "start_trace"}
+TIMER_MODULES = {"scanstats", "tracing"}
+
+# J006 scope: modules allowed to hold aggregation lanes (the registry and
+# its execution module); everything else in engine code must go through
+# them. Host-ufunc prong matches (np|numpy).<ufunc>.(at|reduceat).
+AGG_LANE_MODULES = (
+    "horaedb_tpu/ops/agg_registry.py",
+    "horaedb_tpu/ops/blockagg.py",
+)
+ONE_HOT_CALLS = {"jax.nn.one_hot", "nn.one_hot"}
+ONE_HOT_CLASS_THRESHOLD = 64
+IOTA_CALLS = {"jax.lax.broadcasted_iota", "lax.broadcasted_iota"}
+
+
+def _is_timer_cm(fd: str | None) -> bool:
+    if fd is None:
+        return False
+    parts = fd.split(".")
+    tail = parts[-1]
+    if tail not in TIMER_FUNCS and not (tail == "trace" and len(parts) > 1):
+        return False
+    if len(parts) == 1:
+        return True
+    return parts[-2] in TIMER_MODULES or parts[0] in TIMER_MODULES
+
+
+def _is_host_ufunc_lane(fd: str | None) -> bool:
+    if fd is None:
+        return False
+    parts = fd.split(".")
+    return (
+        len(parts) == 3
+        and parts[0] in ("np", "numpy")
+        and parts[-1] in ("at", "reduceat")
+    )
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """True for `jax.jit`, `partial(jax.jit, ...)`, `shard_map`, and
+    calls of those (e.g. the decorator `@partial(jax.jit, ...)`)."""
+    d = dotted(node)
+    if d in JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fd = dotted(node.func)
+        if fd in JIT_WRAPPERS:
+            return True
+        if fd in PARTIAL_NAMES and node.args and _is_jit_expr(node.args[0]):
+            return True
+    return False
+
+
+def _jit_call_static(call: ast.Call) -> bool:
+    """Does this jit/partial(jit) call carry static_argnums/argnames?"""
+    kws = {kw.arg for kw in call.keywords}
+    if {"static_argnums", "static_argnames"} & kws:
+        return True
+    # partial(jax.jit, static_argnames=...) nests one level
+    if dotted(call.func) in PARTIAL_NAMES and call.args:
+        inner = call.args[0]
+        if isinstance(inner, ast.Call):
+            return _jit_call_static(inner)
+    return False
+
+
+class JitIndex(ast.NodeVisitor):
+    """First pass: which defs/lambdas run under a jit trace, and which
+    NAMES are bound to bare (no-static) jit wrappers — for the J002
+    call-site check."""
+
+    def __init__(self) -> None:
+        self.jit_defs: set[ast.AST] = set()       # FunctionDef/Lambda nodes
+        self.wrapped_names: set[str] = set()       # names passed to jit/shard_map
+        self.bare_jit_names: set[str] = set()      # jit-wrapped, no statics
+        self._defs_by_name: dict[str, list[ast.AST]] = {}
+
+    def visit_FunctionDef(self, node):  # noqa  (shared handler)
+        self._defs_by_name.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec):
+                self.jit_defs.add(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fd = dotted(node.func)
+        is_wrap = fd in JIT_WRAPPERS or (
+            fd in PARTIAL_NAMES and node.args and _is_jit_expr(node.args[0])
+        )
+        if is_wrap and node.args:
+            pos = 1 if fd in PARTIAL_NAMES else 0
+            target = node.args[pos] if len(node.args) > pos else None
+            if isinstance(target, ast.Lambda):
+                self.jit_defs.add(target)
+            elif isinstance(target, ast.Name):
+                self.wrapped_names.add(target.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # `kernel = jax.jit(fn)` without statics: calls to `kernel` with
+        # untraceable literal args are J002 call-site findings
+        if (
+            isinstance(node.value, ast.Call)
+            and dotted(node.value.func) in JIT_WRAPPERS
+            and not _jit_call_static(node.value)
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.bare_jit_names.add(t.id)
+        self.generic_visit(node)
+
+    def finish(self) -> None:
+        # names handed to jit()/shard_map() mark their local defs traced
+        for name in self.wrapped_names:
+            for d in self._defs_by_name.get(name, []):
+                self.jit_defs.add(d)
+        # a def decorated @jax.jit with NO statics is also a bare-jit name
+        for defs in self._defs_by_name.values():
+            for d in defs:
+                if d in self.jit_defs and isinstance(
+                    d, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    for dec in d.decorator_list:
+                        if _is_jit_expr(dec) and not (
+                            isinstance(dec, ast.Call) and _jit_call_static(dec)
+                        ):
+                            self.bare_jit_names.add(d.name)
+
+
+def check_traced_body(fn, findings: list[Finding]) -> None:
+    """J001 + J002 inside one jit-traced function body."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for node in walk_no_nested_defs(body):
+        if isinstance(node, ast.JoinedStr):
+            findings.append(Finding(
+                node.lineno, "J002",
+                "f-string under jit runs at trace time only (and "
+                "concretizes tracers); move formatting outside the kernel "
+                "or use jax.debug.print",
+            ))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        if _is_host_ufunc_lane(fd):
+            findings.append(Finding(
+                node.lineno, "J006",
+                f"host ufunc lane `{fd}(...)` inside a jit-traced function "
+                "— concretizes tracers AND bypasses the calibrated "
+                "aggregation dispatcher; register the strategy in "
+                "ops/agg_registry.py and call it outside jit",
+            ))
+        elif _is_timer_cm(fd):
+            findings.append(Finding(
+                node.lineno, "J005",
+                f"host timer/span `{fd}(...)` inside a jit-traced function "
+                "— the block measures trace time, not device execution "
+                "(kernels dispatch asynchronously); time at the kernel call "
+                "boundary outside jit",
+            ))
+        elif fd in TRACE_SYNC_CALLS:
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"host sync `{fd}(...)` inside a jit-traced function — "
+                "forces a device->host transfer (or trace-time "
+                "concretization) on the hot path",
+            ))
+        elif fd in CONCRETIZING_BUILTINS and node.args and not isinstance(
+            node.args[0], ast.Constant
+        ):
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"`{fd}()` on a traced value inside jit concretizes the "
+                "tracer (ConcretizationTypeError at best, a silent host "
+                "sync at worst)",
+            ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in TRACE_SYNC_METHODS
+            and not node.args
+        ):
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"host sync `.{node.func.attr}()` inside a jit-traced "
+                "function — forces a device->host transfer on the hot path",
+            ))
+        elif fd == "print":
+            findings.append(Finding(
+                node.lineno, "J002",
+                "print() under jit runs at trace time only (silent on "
+                "cached traces); use jax.debug.print",
+            ))
+        elif fd in FROZEN_CALLS or (
+            fd is not None and fd.startswith(FROZEN_PREFIXES)
+        ):
+            findings.append(Finding(
+                node.lineno, "J002",
+                f"`{fd}()` under jit is evaluated once at trace time and "
+                "frozen into the compiled graph — every later call reuses "
+                "the stale value",
+            ))
+
+
+def check_host_hot(tree: ast.Module, jit_defs: set, findings: list) -> None:
+    """J001 outside jit, hot modules only: unambiguous device syncs."""
+    # collect nodes inside traced defs so we don't double-report them
+    traced: set[ast.AST] = set()
+    for d in jit_defs:
+        body = d.body if isinstance(d.body, list) else [d.body]
+        for stmt in body:
+            traced.update(ast.walk(stmt))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or node in traced:
+            continue
+        fd = dotted(node.func)
+        if fd in SYNC_CALLS:
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"`{fd}(...)` in a hot module — an explicit device->host "
+                "sync on the scan/merge path; move it behind the kernel "
+                "boundary or suppress with the measured justification",
+            ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in SYNC_METHODS
+            and not node.args
+        ):
+            findings.append(Finding(
+                node.lineno, "J001",
+                f"`.{node.func.attr}()` in a hot module — an explicit "
+                "device->host sync on the scan/merge path",
+            ))
+
+
+def check_jit_call_sites(tree, bare_jit_names: set[str], findings) -> None:
+    """J002: untraceable literal args to bare-jit callables."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id in bare_jit_names):
+            continue
+        exprs = list(node.args) + [kw.value for kw in node.keywords]
+        for a in exprs:
+            bad = None
+            if isinstance(a, ast.Constant) and isinstance(a.value, (str, bytes)):
+                bad = f"{type(a.value).__name__} literal"
+            elif isinstance(a, ast.Set):
+                bad = "set literal"
+            if bad:
+                findings.append(Finding(
+                    node.lineno, "J002",
+                    f"{bad} passed to jit-wrapped `{node.func.id}` with no "
+                    "static_argnums/static_argnames — untraceable types "
+                    "must be static (and each distinct value retraces)",
+                ))
+
+
+def check_dtype(tree: ast.Module, findings: list[Finding]) -> None:
+    """J003: bare float literals into jnp.array/jnp.full without dtype."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        if fd not in JNP_DTYPE_CTORS:
+            continue
+        dtype_pos = JNP_DTYPE_CTORS[fd]
+        if len(node.args) > dtype_pos:
+            continue  # positional dtype given
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        value_args = node.args[:dtype_pos]
+        has_float = any(
+            isinstance(sub, ast.Constant) and isinstance(sub.value, float)
+            for a in value_args
+            for sub in ast.walk(a)
+        )
+        if has_float:
+            findings.append(Finding(
+                node.lineno, "J003",
+                f"bare float literal into `{fd}` without dtype= — weak-type "
+                "promotion decides the lane width (f32 vs f64) from context; "
+                "pin it explicitly in engine code",
+            ))
+
+
+def check_onehot(tree: ast.Module, findings: list[Finding]) -> None:
+    """J006 prong 2: one-hot materializations in engine code outside the
+    registry modules. Two idioms: `jax.nn.one_hot(x, N)` with N above the
+    size threshold (a literal N <= 64 is a small embedding, not an
+    aggregation one-hot; a non-literal N is flagged — it can be anything),
+    and the `rank == broadcasted_iota(..., rank-3+ shape, ...)` compare
+    this codebase's block compaction uses."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fd = dotted(node.func)
+            if fd in ONE_HOT_CALLS:
+                n_arg = None
+                if len(node.args) > 1:
+                    n_arg = node.args[1]
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "num_classes":
+                            n_arg = kw.value
+                if (
+                    isinstance(n_arg, ast.Constant)
+                    and isinstance(n_arg.value, int)
+                    and n_arg.value <= ONE_HOT_CLASS_THRESHOLD
+                ):
+                    continue
+                findings.append(Finding(
+                    node.lineno, "J006",
+                    f"`{fd}` materialization above {ONE_HOT_CLASS_THRESHOLD} "
+                    "classes outside ops/blockagg.py / ops/agg_registry.py — "
+                    "one-hot traffic is the aggregate path's roofline "
+                    "(ROOFLINE §1); register the kernel so the calibrated "
+                    "dispatcher can measure it",
+                ))
+        elif isinstance(node, ast.Compare):
+            sides = [node.left, *node.comparators]
+            for side in sides:
+                if not (isinstance(side, ast.Call)
+                        and dotted(side.func) in IOTA_CALLS):
+                    continue
+                shape = side.args[1] if len(side.args) > 1 else None
+                if isinstance(shape, (ast.Tuple, ast.List)) \
+                        and len(shape.elts) < 3:
+                    continue  # rank-2 iota compares are index masks, not
+                    # materialized one-hots
+                findings.append(Finding(
+                    node.lineno, "J006",
+                    "one-hot materialization via `== broadcasted_iota` "
+                    "(rank-3+ shape) outside ops/blockagg.py / "
+                    "ops/agg_registry.py — register the kernel in the "
+                    "aggregation registry instead of an ad-hoc lane",
+                ))
+                break
+
+
+def check_naked_jit(tree: ast.Module, findings: list[Finding]) -> None:
+    """J007, hot modules only: any use of `jax.jit`/`jax.pjit` — call,
+    decorator, or `partial(jax.jit, ...)` (all contain the `jax.jit`
+    attribute node this walks for) — plus the import-alias escape hatch
+    `from jax import jit`. The instrumented wrapper (common/xprof.xjit)
+    is the only sanctioned jit spelling here: a naked jit silently drops
+    the kernel out of compile telemetry, /debug/kernels, and EXPLAIN's
+    compile/steady split."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            fd = dotted(node)
+            if fd in NAKED_JIT:
+                findings.append(Finding(
+                    node.lineno, "J007",
+                    f"naked `{fd}` in a hot module bypasses compile "
+                    "telemetry (horaedb_jit_* families, /debug/kernels, "
+                    "EXPLAIN compile split); route through "
+                    "common/xprof.xjit",
+                ))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(
+                a.name in ("jit", "pjit") for a in node.names
+            ):
+                findings.append(Finding(
+                    node.lineno, "J007",
+                    "`from jax import jit` in a hot module — importing the "
+                    "uninstrumented wrapper invites naked jit call sites; "
+                    "use common/xprof.xjit",
+                ))

@@ -1,0 +1,172 @@
+"""J004 lock discipline (per-file): a class that owns a `*lock`
+attribute but mutates lock-guarded `self._*` state in a PUBLIC method
+outside any `with self._lock:` block. Moved verbatim from the
+single-file linter; docs/static-analysis.md has the rationale."""
+
+from __future__ import annotations
+
+import ast
+
+from tools.jaxlint.base import Finding, dotted
+
+LOCK_FACTORIES = ("Lock", "RLock", "Semaphore", "Condition")
+MUTATORS = {
+    "append", "appendleft", "add", "update", "pop", "popitem", "clear",
+    "extend", "remove", "discard", "insert", "setdefault",
+}
+
+
+def lock_attrs_of(cls: ast.ClassDef) -> set[str]:
+    """Attribute names of locks this class OWNS (self._lock = Lock())."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target, value = node.target, node.value
+        else:
+            continue
+        name = None
+        if isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id in ("self", "cls"):
+            name = target.attr
+        elif isinstance(target, ast.Name) and node in cls.body:
+            name = target.id
+        if name is None or not name.endswith("lock"):
+            continue
+        if isinstance(value, ast.Call):
+            vd = dotted(value.func) or ""
+            if vd.rsplit(".", 1)[-1] in LOCK_FACTORIES:
+                out.add(name)
+    return out
+
+
+def _self_underscore_target(expr: ast.expr, bound: str) -> str | None:
+    """Resolve (possibly subscripted) `<bound>._x...` store targets to
+    the owning attribute name `_x` (`bound` is the method's receiver
+    parameter: self or cls)."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == bound
+        and expr.attr.startswith("_")
+    ):
+        return expr.attr
+    return None
+
+
+def check_lock_discipline(tree: ast.Module, findings: list[Finding]) -> None:
+    """J004 per class, two passes: (1) which `self._*` attrs does ANY
+    method mutate under a `with self.<lock>:` block — that set IS the
+    lock-guarded state, declared by the code itself; (2) a PUBLIC method
+    mutating one of those attrs outside the lock is the finding. Attrs
+    the lock never guards anywhere (event-loop-confined counters next
+    to a lock that serializes something else) are not flagged — the
+    class never claimed the lock covers them."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = lock_attrs_of(cls)
+        if not locks:
+            continue
+        guarded: set[str] = set()
+        for meth in cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_method_locking(meth, locks, guarded, None)
+        if not guarded:
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if meth.name.startswith("_"):
+                continue  # private/dunder: callers hold the lock
+            _scan_method_locking(meth, locks, guarded, findings)
+
+
+def _scan_method_locking(meth, locks, guarded, findings) -> None:
+    """findings=None: COLLECT attrs mutated under a lock into `guarded`.
+    Otherwise: FLAG unlocked mutations of guarded attrs."""
+    # only the method's FIRST parameter names the shared instance; `self`
+    # as a plain local (the `self = object.__new__(cls)` constructor
+    # idiom inside classmethods) is a not-yet-published object and its
+    # attribute writes race with nobody
+    params = meth.args.posonlyargs + meth.args.args
+    bound = params[0].arg if params else None
+    if bound not in ("self", "cls"):
+        return
+
+    def held_by(with_node) -> bool:
+        for item in with_node.items:
+            ctx = item.context_expr
+            if (
+                isinstance(ctx, ast.Attribute)
+                and isinstance(ctx.value, ast.Name)
+                and ctx.value.id == bound
+                and ctx.attr in locks
+            ):
+                return True
+        return False
+
+    def visit(nodes, locked: bool) -> None:
+        for node in nodes:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                visit(node.body, locked or held_by(node))
+                continue
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                       ast.ClassDef)
+            ):
+                continue  # nested scopes have their own call discipline
+            mut = _mutation_of(node, bound)
+            if mut is not None:
+                attr, verb = mut
+                if findings is None:
+                    if locked:
+                        guarded.add(attr)
+                elif not locked and attr in guarded:
+                    findings.append(Finding(
+                        node.lineno, "J004",
+                        f"public method {verb} `self.{attr}` outside "
+                        f"`with self.{'/'.join(sorted(locks))}:` — other "
+                        "methods mutate this attribute under the lock, so "
+                        "unlocked writes race them; take the lock or make "
+                        "the method private",
+                    ))
+            visit(ast.iter_child_nodes(node), locked)
+
+    visit(meth.body, False)
+
+
+def _mutation_of(node, bound: str) -> tuple[str, str] | None:
+    """(attr, verb) when `node` mutates `<bound>._x` state, else None.
+    Bare annotations (`self._x: int` with no value) declare, not write."""
+    attr = None
+    verb = None
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        if isinstance(node, ast.AnnAssign) and node.value is None:
+            return None
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            a = _self_underscore_target(t, bound)
+            if a:
+                attr, verb = a, "assigns"
+                break
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            a = _self_underscore_target(t, bound)
+            if a:
+                attr, verb = a, "deletes"
+                break
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in MUTATORS:
+        a = _self_underscore_target(node.func.value, bound)
+        if a:
+            attr, verb = a, f"mutates (.{node.func.attr})"
+    if attr is None or attr.endswith("lock"):
+        return None  # lazy lock creation is the lock's own lifecycle
+    return attr, verb

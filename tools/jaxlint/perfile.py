@@ -1,0 +1,96 @@
+"""Per-file pass dispatcher: parses one file, applies every
+path-scoped per-file rule (J001-J017), and returns RAW findings plus
+the file's suppression table. Suppression filtering happens in the
+orchestrator (tools/jaxlint/__main__.py) AFTER the whole-program
+passes run, so the hygiene pass (J021) can see which suppressions
+actually fire."""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.jaxlint import funnels, jitrules, lockrules
+from tools.jaxlint.base import Finding, Suppressions, in_scope, scoped
+
+
+def parse_file(path: Path) -> tuple[str, ast.Module | None, Finding | None]:
+    """(text, tree, syntax_finding). A syntax error yields tree=None and
+    one J999 finding — the file is skipped by every other pass
+    (including the whole-program index build)."""
+    text = path.read_bytes().decode("utf-8", errors="replace")
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        return text, None, Finding(
+            e.lineno or 1, "J999", f"syntax error: {e.msg}"
+        )
+    return text, tree, None
+
+
+def run_perfile(path: Path, text: str,
+                tree: ast.Module) -> tuple[list[Finding], Suppressions]:
+    """All per-file rules over one parsed file -> raw findings."""
+    sup = Suppressions(text.split("\n"))
+    posix = path.as_posix()
+
+    is_hot = in_scope(posix, jitrules.HOT_MODULES)
+    in_dtype_scope = in_scope(posix, jitrules.DTYPE_MODULES)
+    in_j007_scope = in_scope(posix, jitrules.J007_MODULES)
+    in_j008_scope = scoped(posix, funnels.J008_MODULES, funnels.J008_EXEMPT)
+    in_j009_scope = scoped(posix, funnels.J009_MODULES, funnels.J009_EXEMPT)
+    in_j010_scope = scoped(posix, funnels.J010_MODULES, funnels.J010_EXEMPT)
+    in_j011_scope = scoped(posix, funnels.J011_MODULES, funnels.J011_EXEMPT)
+    in_j012_scope = scoped(posix, funnels.J012_MODULES, funnels.J012_EXEMPT)
+    in_j013_base = in_scope(posix, funnels.J013_MODULES)
+    j013_reads = in_j013_base and not in_scope(
+        posix, funnels.J013_READ_EXEMPT)
+    j013_writes = in_j013_base and not in_scope(
+        posix, funnels.J013_WRITE_EXEMPT)
+    in_j014_scope = scoped(posix, funnels.J014_MODULES, funnels.J014_EXEMPT)
+    in_j015_scope = scoped(posix, funnels.J015_MODULES, funnels.J015_EXEMPT)
+    in_j016_scope = scoped(posix, funnels.J016_MODULES, funnels.J016_EXEMPT)
+    in_j017_base = in_scope(posix, funnels.J017_MODULES)
+    j017_views = in_j017_base and not in_scope(
+        posix, funnels.J017_VIEW_EXEMPT)
+    j017_assign = in_j017_base and not in_scope(
+        posix, funnels.J017_ASSIGN_EXEMPT)
+
+    idx = jitrules.JitIndex()
+    idx.visit(tree)
+    idx.finish()
+
+    findings: list[Finding] = []
+    for fn in idx.jit_defs:
+        jitrules.check_traced_body(fn, findings)
+    if is_hot:
+        jitrules.check_host_hot(tree, idx.jit_defs, findings)
+    jitrules.check_jit_call_sites(tree, idx.bare_jit_names, findings)
+    if in_dtype_scope:
+        jitrules.check_dtype(tree, findings)
+        if not any(posix.endswith(m) for m in jitrules.AGG_LANE_MODULES):
+            jitrules.check_onehot(tree, findings)
+    if in_j007_scope:
+        jitrules.check_naked_jit(tree, findings)
+    if in_j008_scope:
+        funnels.check_append_hot_path(tree, findings)
+    if in_j009_scope:
+        funnels.check_store_boundary(tree, findings)
+    if in_j010_scope:
+        funnels.check_visibility_boundary(tree, findings)
+    if in_j011_scope:
+        funnels.check_admission_boundary(tree, findings)
+    if in_j012_scope:
+        funnels.check_decode_funnel(tree, findings)
+    if j013_reads or j013_writes:
+        funnels.check_serving_funnel(tree, findings, j013_reads, j013_writes)
+    if in_j014_scope:
+        funnels.check_funnel_subscribers(tree, findings)
+    if in_j015_scope:
+        funnels.check_metering_funnel(tree, findings)
+    if in_j016_scope:
+        funnels.check_stacking_funnel(tree, findings)
+    if j017_views or j017_assign:
+        funnels.check_cluster_funnel(tree, findings, j017_views, j017_assign)
+    lockrules.check_lock_discipline(tree, findings)
+    return findings, sup

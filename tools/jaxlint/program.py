@@ -1,0 +1,911 @@
+"""Whole-program index shared by the graph passes (J018-J020).
+
+Built ONCE per run over every analyzed file under a `horaedb_tpu`
+package root, then handed to each graph pass:
+
+- **module map** — file path -> dotted module name, per-module import
+  aliases (absolute + relative), top-level symbols;
+- **call graph** — call sites resolved to in-tree functions through
+  plain names, module attributes, `self.`/`cls.` method dispatch,
+  `self._attr.` dispatch via inferred attribute types
+  (`self._attr = SomeClass(...)`), local-variable types
+  (`x = SomeClass(...)`), nested `def` scopes, and the `xjit`/`jit`
+  wrapper boundary (`kernel = xjit(fn)` calls resolve to `fn`);
+- **offload edges** — callables handed to `asyncio.to_thread` /
+  `run_in_executor` (awaited: the caller blocks but the callee runs
+  OFF the event loop) and `executor.submit` / `threading.Thread`
+  (detached: fire-and-forget);
+- **async-reachability** — which functions can run ON the event loop
+  (coroutines plus everything they call through non-offload edges);
+- **lock-acquisition graph** — `with self._lock:` / module-level lock
+  blocks resolved to class-qualified lock identities, direct nesting
+  edges plus transitive held-while-acquiring edges through the call
+  graph (awaited offloads included: the caller still holds the lock
+  in wall-clock terms while the worker runs);
+- **loop inventory** — every for/while/async-for with the calls,
+  awaits, blocking ops, and deadline checkpoints its body contains.
+
+Static identity notes (documented precision choices):
+- A lock identity is `(Class, attr)` or `(module, name)` — instances
+  collapse. Self-deadlock (re-acquiring the SAME identity) is only
+  reported when every hop is a `self.` call in one class, so two
+  *different* instances of one class locking each other are out of
+  scope for the static pass (the dynamic lockwitness covers them).
+- `.acquire()` calls are not tracked — the tree's idiom is the `with`
+  block; a hand-rolled acquire/release pair evades the pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.jaxlint.base import dotted
+from tools.jaxlint.jitrules import _is_jit_expr
+
+LOCK_FACTORY_KINDS = {
+    "Lock": ("threading", False),
+    "RLock": ("threading", True),
+    "Condition": ("threading", False),
+    "Semaphore": ("threading", False),
+    "BoundedSemaphore": ("threading", False),
+}
+OFFLOAD_AWAITED_TAILS = {"to_thread", "run_in_executor"}
+OFFLOAD_DETACHED_TAILS = {"submit"}
+# `asyncio.create_task(coro())` / `get_running_loop().create_task(...)`
+# detaches: the spawned work is OFF the spawner's critical path (no lock
+# holding, no deadline propagation — flush_executor._run detaches its
+# deadline for exactly this reason). `tg.create_task(...)` TaskGroup
+# children are awaited at scope exit and stay on the caller's path.
+SPAWN_TAILS = {"create_task", "ensure_future"}
+
+PARQUET_TAILS = {"read_table", "write_table", "write_to_dataset"}
+PARQUET_CTORS = {"ParquetWriter", "ParquetFile"}
+PARQUET_HEADS = {"pq", "parquet", "pyarrow"}
+FILE_BLOCKING_CALLS = {
+    "os.fsync", "os.replace", "os.rename", "os.link",
+    "shutil.copyfile", "shutil.move", "shutil.rmtree",
+}
+PATH_IO_TAILS = {"read_bytes", "write_bytes", "read_text", "write_text"}
+BLOCKING_PREFIXES = ("subprocess.", "urllib.request.", "requests.")
+# deadline checkpoints, syntactic form: the `deadline_ctx.check(...)` /
+# `deadline_scope(...)` idiom of horaedb_tpu/common/deadline.py
+DEADLINE_MODULE_NAMES = {"deadline", "deadline_ctx"}
+
+
+def module_name(path: Path) -> str | None:
+    """Dotted module name for files under a `horaedb_tpu` package root;
+    None for everything else (graph passes only see the engine tree —
+    tools/ and benchmarks/ harnesses are per-file-pass territory)."""
+    parts = list(path.with_suffix("").parts)
+    if "horaedb_tpu" not in parts:
+        return None
+    parts = parts[parts.index("horaedb_tpu"):]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def blocking_desc(node: ast.Call, fd: str | None) -> str | None:
+    """Event-loop-blocking primitives, syntactic prong (J018). The
+    resolution-dependent prong (calls into the CPU-heavy codec funnel)
+    is added in ProgramIndex.finish()."""
+    if fd == "time.sleep":
+        return "time.sleep()"
+    if fd == "open":
+        return "open()"
+    if fd in FILE_BLOCKING_CALLS:
+        return f"{fd}()"
+    if fd:
+        parts = fd.split(".")
+        tail = parts[-1]
+        if (tail in PARQUET_TAILS or tail in PARQUET_CTORS) and \
+                parts[0] in PARQUET_HEADS:
+            return f"parquet IO `{fd}(...)`"
+        if fd.startswith(BLOCKING_PREFIXES):
+            return f"`{fd}(...)`"
+        if len(parts) > 1 and tail in PATH_IO_TAILS:
+            return f"file IO `.{tail}()`"
+        if tail == "result" and len(parts) > 1 and "fut" in parts[-2].lower():
+            return "Future.result()"
+    f = node.func
+    if (
+        isinstance(f, ast.Attribute) and f.attr == "join"
+        and isinstance(f.value, ast.Constant)
+        and isinstance(f.value.value, bytes)
+    ):
+        return "b''.join() accumulation"
+    return None
+
+
+class CallSite:
+    __slots__ = ("lineno", "raw", "target", "offload", "held", "receiver",
+                 "deadline_free")
+
+    def __init__(self, lineno: int, raw: str | None, *,
+                 offload: str | None = None,
+                 held: tuple[str, ...] = (), receiver: str | None = None,
+                 deadline_free: bool = False):
+        self.lineno = lineno
+        self.raw = raw                  # dotted call text, pre-resolution
+        self.target: str | None = None  # resolved function qname
+        self.offload = offload          # None | "awaited" | "detached"
+        self.held = held                # lock ids held at the site
+        self.receiver = receiver        # "self"/"cls" for self-dispatch
+        # inside `with deadline_scope(None):` — the caller DELIBERATELY
+        # shields this work from the request deadline (flush barriers):
+        # J020 must not demand checkpoints below such a call
+        self.deadline_free = deadline_free
+
+
+class LoopInfo:
+    __slots__ = ("lineno", "depth", "calls", "has_await", "has_check",
+                 "blocking", "children")
+
+    def __init__(self, lineno: int, depth: int):
+        self.lineno = lineno
+        self.depth = depth              # loop nesting depth in function
+        self.calls: list[CallSite] = []
+        self.has_await = False
+        self.has_check = False
+        self.blocking: list[tuple[int, str]] = []
+        self.children: list[LoopInfo] = []
+
+
+class Acquisition:
+    __slots__ = ("lock", "lineno", "held", "via_self")
+
+    def __init__(self, lock: str, lineno: int, held: tuple[str, ...],
+                 via_self: bool):
+        self.lock, self.lineno = lock, lineno
+        self.held, self.via_self = held, via_self
+
+
+class FuncInfo:
+    __slots__ = (
+        "qname", "module", "path", "node", "is_async", "cls_qname",
+        "is_kernel", "is_checkpoint", "calls", "blocking", "acquires",
+        "awaits_under_sync_lock", "loops", "has_check", "name",
+        "detaches_deadline",
+    )
+
+    def __init__(self, qname: str, module: str, path: str, node,
+                 cls_qname: str | None):
+        self.qname = qname
+        self.module = module
+        self.path = path
+        self.node = node
+        self.name = node.name
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.cls_qname = cls_qname
+        self.is_kernel = any(_is_jit_expr(d) for d in node.decorator_list)
+        self.is_checkpoint = False
+        self.calls: list[CallSite] = []
+        self.blocking: list[tuple[int, str]] = []
+        self.acquires: list[Acquisition] = []
+        self.awaits_under_sync_lock: list[tuple[int, str]] = []
+        self.loops: list[LoopInfo] = []
+        self.has_check = False
+        self.detaches_deadline = False  # calls deadline_ctx.detach()
+
+
+class ClassInfo:
+    __slots__ = ("qname", "module", "methods", "bases", "base_qnames",
+                 "attr_types_raw", "attr_types", "lock_attrs",
+                 "lock_returning_methods")
+
+    def __init__(self, qname: str, module: str):
+        self.qname = qname
+        self.module = module
+        self.methods: dict[str, str] = {}           # name -> func qname
+        self.bases: list[str] = []                  # raw dotted names
+        self.base_qnames: list[str] = []            # resolved, in-tree
+        self.attr_types_raw: dict[str, str] = {}    # attr -> raw ctor name
+        self.attr_types: dict[str, str] = {}        # attr -> class qname
+        # attr -> (kind, reentrant): threading vs asyncio, RLock or not
+        self.lock_attrs: dict[str, tuple[str, bool]] = {}
+        self.lock_returning_methods: dict[str, str] = {}  # meth -> attr
+
+
+class ModuleInfo:
+    __slots__ = ("name", "path", "imports", "symbols", "aliases", "locks")
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self.imports: dict[str, str] = {}   # alias -> absolute dotted target
+        self.symbols: dict[str, str] = {}   # top-level def/class -> qname
+        self.aliases: dict[str, str] = {}   # name -> raw dotted (xjit(fn))
+        self.locks: dict[str, tuple[str, bool]] = {}  # module-level locks
+
+
+def _lock_kind(call: ast.Call) -> tuple[str, bool] | None:
+    """(kind, reentrant) for `threading.Lock()` / `asyncio.Lock()` etc."""
+    fd = dotted(call.func)
+    if not fd:
+        return None
+    parts = fd.split(".")
+    factory = parts[-1]
+    if factory not in LOCK_FACTORY_KINDS:
+        return None
+    _, reentrant = LOCK_FACTORY_KINDS[factory]
+    kind = "asyncio" if "asyncio" in parts or "aio" in parts else "threading"
+    return kind, reentrant
+
+
+class ProgramIndex:
+    """The shared whole-program index. Build with add_file() per parsed
+    module, then finish() resolves the call graph and derived maps."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        # lock id -> (kind, reentrant)
+        self.locks: dict[str, tuple[str, bool]] = {}
+        # lock-order edges: (holder, acquired) -> witness
+        # witness: (path, lineno, via: str)
+        self.lock_edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        # self-chain re-acquisitions: (lock, path, lineno, via)
+        self.self_reacquires: list[tuple[str, str, int, str]] = []
+        self.on_loop: dict[str, str | None] = {}  # qname -> predecessor
+
+    # ------------------------------------------------------------ build
+
+    def add_file(self, path: Path, tree: ast.Module) -> None:
+        mod = module_name(path)
+        if mod is None or mod in self.modules:
+            return
+        mi = ModuleInfo(mod, path.as_posix())
+        self.modules[mod] = mi
+        package = mod if path.stem == "__init__" else mod.rpartition(".")[0]
+
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.imports[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg_parts = package.split(".") if package else []
+                    up = node.level - 1
+                    pkg_parts = pkg_parts[:len(pkg_parts) - up] if up else \
+                        pkg_parts
+                    base = ".".join(p for p in [".".join(pkg_parts), base]
+                                    if p)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    tgt = f"{base}.{a.name}" if base else a.name
+                    mi.imports[a.asname or a.name] = tgt
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(node, mi, None, [])
+            elif isinstance(node, ast.ClassDef):
+                self._add_class(node, mi)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if isinstance(node.value, ast.Call):
+                    lk = _lock_kind(node.value)
+                    if lk:
+                        mi.locks[name] = lk
+                        self.locks[f"{mod}.{name}"] = lk
+                        continue
+                    # `kernel = xjit(fn)` / `jax.jit(fn)` / partial(fn,..)
+                    fv = node.value
+                    if _is_jit_expr(fv.func) and fv.args:
+                        inner = dotted(fv.args[0])
+                        if inner:
+                            mi.aliases[name] = inner
+                    elif dotted(fv.func) in ("partial", "functools.partial") \
+                            and fv.args:
+                        inner = dotted(fv.args[0])
+                        if inner:
+                            mi.aliases[name] = inner
+                elif isinstance(node.value, ast.Name):
+                    mi.aliases[name] = node.value.id
+
+    def _add_class(self, node: ast.ClassDef, mi: ModuleInfo) -> None:
+        qname = f"{mi.name}.{node.name}"
+        ci = ClassInfo(qname, mi.name)
+        self.classes[qname] = ci
+        mi.symbols[node.name] = qname
+        for b in node.bases:
+            bd = dotted(b)
+            if bd:
+                ci.bases.append(bd)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[item.name] = f"{qname}.{item.name}"
+                self._add_function(item, mi, ci, [])
+            elif isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                    and isinstance(item.targets[0], ast.Name) \
+                    and isinstance(item.value, ast.Call):
+                lk = _lock_kind(item.value)
+                if lk:
+                    ci.lock_attrs[item.targets[0].id] = lk
+        # attribute inference over every method body: lock attrs, types,
+        # and `return self._x` lock-returning accessors (the flush
+        # executor's lazy `_condition()` idiom)
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # annotated params: `def __init__(self, storage: ObjectStore)`
+            # followed by `self._store = storage` types the attribute
+            ann: dict[str, str] = {}
+            for a in (item.args.posonlyargs + item.args.args
+                      + item.args.kwonlyargs):
+                if a.annotation is None:
+                    continue
+                d = dotted(a.annotation)
+                if d is None and isinstance(a.annotation, ast.Constant) \
+                        and isinstance(a.annotation.value, str):
+                    d = a.annotation.value  # string annotation
+                if d:
+                    ann[a.arg] = d
+            for sub in ast.walk(item):
+                t = value = None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t, value = sub.targets[0], sub.value
+                elif isinstance(sub, ast.AnnAssign) and \
+                        sub.value is not None:
+                    t, value = sub.target, sub.value
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                if isinstance(value, ast.Call):
+                    lk = _lock_kind(value)
+                    if lk:
+                        ci.lock_attrs[t.attr] = lk
+                    else:
+                        ctor = dotted(value.func)
+                        if ctor:
+                            ci.attr_types_raw.setdefault(t.attr, ctor)
+                elif isinstance(value, ast.Name) and value.id in ann:
+                    ci.attr_types_raw.setdefault(t.attr, ann[value.id])
+            for stmt in item.body:
+                if isinstance(stmt, ast.Return) and \
+                        isinstance(stmt.value, ast.Attribute) and \
+                        isinstance(stmt.value.value, ast.Name) and \
+                        stmt.value.value.id == "self":
+                    ci.lock_returning_methods[item.name] = stmt.value.attr
+
+    def _add_function(self, node, mi: ModuleInfo, ci: ClassInfo | None,
+                      outer: list[str]) -> FuncInfo:
+        if ci is not None:
+            qname = f"{ci.qname}.{node.name}"
+        elif outer:
+            qname = f"{outer[-1]}.<locals>.{node.name}"
+        else:
+            qname = f"{mi.name}.{node.name}"
+            mi.symbols.setdefault(node.name, qname)
+        fi = FuncInfo(qname, mi.name, mi.path, node, ci.qname if ci else None)
+        if mi.name.endswith(".deadline") and \
+                node.name in ("check", "deadline_scope"):
+            fi.is_checkpoint = True
+        self.functions[qname] = fi
+        return fi
+
+    # --------------------------------------------------------- resolve
+
+    def _mro(self, cls_qname: str, _seen=None) -> list[str]:
+        seen = _seen or set()
+        if cls_qname in seen or cls_qname not in self.classes:
+            return []
+        seen.add(cls_qname)
+        out = [cls_qname]
+        for b in self.classes[cls_qname].base_qnames:
+            out.extend(self._mro(b, seen))
+        return out
+
+    def _method(self, cls_qname: str, name: str) -> str | None:
+        for c in self._mro(cls_qname):
+            m = self.classes[c].methods.get(name)
+            if m:
+                return m
+        return None
+
+    def _attr_type(self, cls_qname: str, attr: str) -> str | None:
+        for c in self._mro(cls_qname):
+            t = self.classes[c].attr_types.get(attr)
+            if t:
+                return t
+        return None
+
+    def _lock_attr(self, cls_qname: str, attr: str) \
+            -> tuple[str, bool] | None:
+        for c in self._mro(cls_qname):
+            lk = self.classes[c].lock_attrs.get(attr)
+            if lk:
+                return lk
+        return None
+
+    def _resolve_module_name(self, mod: str, raw: str) -> str | None:
+        """Resolve a raw dotted name in a module's namespace to a
+        function qname ("f") or class qname ("C" -> its __init__)."""
+        parts = raw.split(".")
+        mi = self.modules.get(mod)
+        if mi is None:
+            return None
+        head, rest = parts[0], parts[1:]
+        base: str | None = None
+        if head in mi.symbols:
+            base = mi.symbols[head]
+        elif head in mi.aliases and head not in mi.imports:
+            # one aliasing hop (`kernel = xjit(fn)`): resolve the inner
+            inner = mi.aliases[head]
+            return self._resolve_module_name(
+                mod, ".".join([inner] + rest))
+        elif head in mi.imports:
+            base = mi.imports[head]
+        else:
+            return None
+        full = ".".join([base] + rest)
+        return self._canonical(full)
+
+    def _canonical(self, full: str) -> str | None:
+        """Map an absolute dotted name to a known function qname."""
+        if full in self.functions:
+            return full
+        if full in self.classes:
+            return self.classes[full].methods.get("__init__", full)
+        head, _, tail = full.rpartition(".")
+        if head in self.classes:
+            return self._method(head, tail)
+        # `from pkg import sym` where pkg re-exports: try one more level
+        # through the imported module's own import table
+        if head in self.modules:
+            mi = self.modules[head]
+            if tail in mi.imports:
+                return self._canonical(mi.imports[tail])
+            if tail in mi.aliases:
+                return self._resolve_module_name(head, mi.aliases[tail])
+        return None
+
+    def _resolve_call(self, fi: FuncInfo, raw: str,
+                      scopes: list[dict[str, str]],
+                      local_types: dict[str, str]) -> str | None:
+        parts = raw.split(".")
+        head, rest = parts[0], parts[1:]
+        if head in ("self", "cls") and fi.cls_qname:
+            if not rest:
+                return None
+            if len(rest) == 1:
+                return self._method(fi.cls_qname, rest[0])
+            t = self._attr_type(fi.cls_qname, rest[0])
+            if t and len(rest) == 2:
+                return self._method(t, rest[1])
+            return None
+        for scope in reversed(scopes):
+            if head in scope:
+                return self._canonical(".".join([scope[head]] + rest)) \
+                    or (scope[head] if not rest else None)
+        t = local_types.get(head)
+        if t and len(rest) == 1:
+            return self._method(t, rest[0])
+        return self._resolve_module_name(fi.module, raw)
+
+    def _class_of(self, mod: str, raw: str) -> str | None:
+        """Resolve a ctor name to a class qname (for type inference)."""
+        mi = self.modules.get(mod)
+        if mi is None:
+            return None
+        parts = raw.split(".")
+        head, rest = parts[0], parts[1:]
+        base = mi.symbols.get(head) or mi.imports.get(head)
+        if base is None:
+            return None
+        full = ".".join([base] + rest)
+        return full if full in self.classes else None
+
+    # ----------------------------------------------------------- walk
+
+    def finish(self) -> None:
+        # resolve class bases + attribute types
+        for ci in self.classes.values():
+            for b in ci.bases:
+                q = self._class_of(ci.module, b)
+                if q:
+                    ci.base_qnames.append(q)
+        for ci in self.classes.values():
+            for attr, raw in ci.attr_types_raw.items():
+                q = self._class_of(ci.module, raw)
+                if q:
+                    ci.attr_types[attr] = q
+            # lock-returning accessors must return an actual lock attr
+            ci.lock_returning_methods = {
+                m: a for m, a in ci.lock_returning_methods.items()
+                if self._lock_attr(ci.qname, a)
+            }
+        # register lock identities
+        for ci in self.classes.values():
+            for attr, lk in ci.lock_attrs.items():
+                self.locks[f"{ci.qname}.{attr}"] = lk
+        # walk every function body: call sites, locks, loops, blocking
+        for fi in list(self.functions.values()):
+            if "<locals>" in fi.qname:
+                continue  # walked by its parent
+            self._walk_function(fi, [])
+        # resolve call targets
+        for fi in self.functions.values():
+            local_types = self._infer_local_types(fi)
+            scopes = self._scope_chain(fi)
+            for cs in fi.calls:
+                if cs.raw:
+                    cs.target = self._resolve_call(
+                        fi, cs.raw, scopes, local_types)
+        self._propagate_async_reachability()
+        self._build_lock_edges()
+
+    def _scope_chain(self, fi: FuncInfo) -> list[dict[str, str]]:
+        """Nested-def name maps from enclosing functions, outer first."""
+        chain: list[dict[str, str]] = []
+        parts = fi.qname.split(".<locals>.")
+        for i in range(1, len(parts) + 1):
+            prefix = ".<locals>.".join(parts[:i])
+            scope = {
+                q.rsplit(".", 1)[-1]: q
+                for q in self.functions
+                if q.startswith(prefix + ".<locals>.")
+                and "<locals>" not in q[len(prefix) + len(".<locals>."):]
+            }
+            if scope:
+                chain.append(scope)
+        return chain
+
+    def _infer_local_types(self, fi: FuncInfo) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                v = node.value
+                if isinstance(v, ast.Call):
+                    ctor = dotted(v.func)
+                    if ctor:
+                        q = self._class_of(fi.module, ctor)
+                        if q:
+                            out[name] = q
+                elif isinstance(v, ast.Attribute) and \
+                        isinstance(v.value, ast.Name) and \
+                        v.value.id == "self" and fi.cls_qname:
+                    t = self._attr_type(fi.cls_qname, v.attr)
+                    if t:
+                        out[name] = t
+        return out
+
+    def _lock_id_of(self, fi: FuncInfo, ctx: ast.expr) \
+            -> tuple[str, str, bool, bool] | None:
+        """(lock_id, kind, reentrant, via_self) for a with-item context
+        expression, or None when it isn't a recognized lock."""
+        if isinstance(ctx, ast.Attribute) and \
+                isinstance(ctx.value, ast.Name) and \
+                ctx.value.id in ("self", "cls") and fi.cls_qname:
+            lk = self._lock_attr(fi.cls_qname, ctx.attr)
+            if lk:
+                return (f"{fi.cls_qname}.{ctx.attr}", lk[0], lk[1], True)
+        elif isinstance(ctx, ast.Name):
+            mi = self.modules.get(fi.module)
+            if mi and ctx.id in mi.locks:
+                lk = mi.locks[ctx.id]
+                return (f"{fi.module}.{ctx.id}", lk[0], lk[1], False)
+        elif isinstance(ctx, ast.Call):
+            fd = dotted(ctx.func)
+            if fd and fd.startswith(("self.", "cls.")) and fi.cls_qname:
+                meth = fd.split(".")[1]
+                for c in self._mro(fi.cls_qname):
+                    attr = self.classes[c].lock_returning_methods.get(meth)
+                    if attr:
+                        lk = self._lock_attr(fi.cls_qname, attr)
+                        if lk:
+                            return (f"{fi.cls_qname}.{attr}",
+                                    lk[0], lk[1], True)
+        return None
+
+    def _walk_function(self, fi: FuncInfo, outer_qnames: list[str]) -> None:
+        loop_stack: list[LoopInfo] = []
+        lock_stack: list[tuple[str, str, bool, bool]] = []
+        detached_args: set[int] = set()  # Call nodes spawned detached
+        dl_free = [0]  # nesting depth of `with deadline_scope(None):`
+        # generator bindings: `gen = obj.scan(...)` or `async with
+        # aclosing(obj.scan(...)) as gen:` — `async for _ in gen:` drives
+        # the bound expression's calls PER-ITERATION, so those call sites
+        # belong to the driving loop (their deadline checkpoints count)
+        gen_bindings: dict[str, list[CallSite]] = {}
+
+        def add_call(node: ast.Call) -> None:
+            fd = dotted(node.func)
+            held = tuple(lid for lid, _, _, _ in lock_stack)
+            receiver = None
+            if fd and fd.split(".")[0] in ("self", "cls"):
+                receiver = "self"
+            if fd:
+                tail = fd.rsplit(".", 1)[-1]
+            elif isinstance(node.func, ast.Attribute):
+                tail = node.func.attr  # e.g. get_running_loop().create_task
+            else:
+                tail = None
+            if tail in SPAWN_TAILS and (fd is None
+                                        or fd.startswith("asyncio.")):
+                for arg in node.args:
+                    if isinstance(arg, ast.Call) and dotted(arg.func):
+                        detached_args.add(id(arg))
+            offload_args: list[tuple[ast.expr, str]] = []
+            if tail in OFFLOAD_AWAITED_TAILS:
+                pos = 0 if tail == "to_thread" else 1
+                if len(node.args) > pos:
+                    offload_args.append((node.args[pos], "awaited"))
+            elif tail in OFFLOAD_DETACHED_TAILS and node.args:
+                offload_args.append((node.args[0], "detached"))
+            elif tail == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        offload_args.append((kw.value, "detached"))
+            for expr, kind in offload_args:
+                if isinstance(expr, ast.Call) and \
+                        dotted(expr.func) in ("partial", "functools.partial") \
+                        and expr.args:
+                    expr = expr.args[0]
+                od = dotted(expr)
+                if od:
+                    ocs = CallSite(node.lineno, od, offload=kind, held=held,
+                                   deadline_free=dl_free[0] > 0)
+                    fi.calls.append(ocs)
+                    for lp in loop_stack:
+                        lp.calls.append(ocs)
+            cs = CallSite(node.lineno, fd, held=held, receiver=receiver,
+                          offload="detached" if id(node) in detached_args
+                          else None,
+                          deadline_free=dl_free[0] > 0)
+            fi.calls.append(cs)
+            for lp in loop_stack:
+                lp.calls.append(cs)
+            desc = blocking_desc(node, fd)
+            if desc is not None:
+                fi.blocking.append((node.lineno, desc))
+                for lp in loop_stack:
+                    lp.blocking.append((node.lineno, desc))
+            if fd:
+                parts = fd.split(".")
+                if (parts[-1] == "check"
+                        and (len(parts) == 1
+                             or parts[-2] in DEADLINE_MODULE_NAMES
+                             or "deadline" in parts[-2] or parts[-2] == "dl")
+                        ) or parts[-1] == "deadline_scope":
+                    fi.has_check = True
+                    for lp in loop_stack:
+                        lp.has_check = True
+                if parts[-1] == "detach" and len(parts) > 1 and \
+                        "deadline" in parts[-2]:
+                    fi.detaches_deadline = True
+
+        def visit(nodes) -> None:
+            for node in nodes:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    mi = self.modules[fi.module]
+                    child = self._add_function(
+                        node, mi, None, outer_qnames + [fi.qname])
+                    # local defs start with no inherited lock/loop context
+                    self._walk_function(child, outer_qnames + [fi.qname])
+                    continue
+                if isinstance(node, (ast.Lambda, ast.ClassDef)):
+                    continue
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    acquired: list[tuple[str, str, bool, bool]] = []
+                    shields = 0
+                    for item in node.items:
+                        ctx = item.context_expr
+                        if isinstance(ctx, ast.Call):
+                            before = len(fi.calls)
+                            visit([ctx])
+                            if isinstance(item.optional_vars, ast.Name):
+                                gen_bindings[item.optional_vars.id] = \
+                                    fi.calls[before:]
+                            cfd = dotted(ctx.func) or ""
+                            if cfd.rsplit(".", 1)[-1] == "deadline_scope" \
+                                    and ctx.args \
+                                    and isinstance(ctx.args[0], ast.Constant) \
+                                    and ctx.args[0].value is None:
+                                shields += 1
+                        lid = self._lock_id_of(fi, ctx)
+                        if lid:
+                            held = tuple(
+                                x[0] for x in lock_stack)
+                            fi.acquires.append(Acquisition(
+                                lid[0], node.lineno, held, lid[3]))
+                            acquired.append(lid)
+                            lock_stack.append(lid)
+                    dl_free[0] += shields
+                    visit(node.body)
+                    dl_free[0] -= shields
+                    for _ in acquired:
+                        lock_stack.pop()
+                    continue
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    lp = LoopInfo(node.lineno, len(loop_stack))
+                    if loop_stack:
+                        loop_stack[-1].children.append(lp)
+                    fi.loops.append(lp)
+                    if isinstance(node, ast.AsyncFor):
+                        lp.has_await = True
+                        for outer_lp in loop_stack:
+                            outer_lp.has_await = True
+                    loop_stack.append(lp)
+                    if isinstance(node, ast.AsyncFor):
+                        # an async generator's body runs per-iteration,
+                        # interleaved with the loop — its calls (and any
+                        # deadline checkpoints inside it) belong to the
+                        # loop for J018/J020 purposes
+                        visit([node.iter, node.target])
+                        if isinstance(node.iter, ast.Name):
+                            for bcs in gen_bindings.get(node.iter.id, ()):
+                                for outer_lp in loop_stack:
+                                    outer_lp.calls.append(bcs)
+                    elif isinstance(node, ast.For):
+                        # a plain iterable evaluates once, OUTSIDE
+                        loop_stack.pop()
+                        visit([node.iter])
+                        loop_stack.append(lp)
+                        visit([node.target])
+                    else:
+                        visit([node.test])
+                    visit(node.body)
+                    loop_stack.pop()
+                    visit(node.orelse)
+                    continue
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    before = len(fi.calls)
+                    visit([node.value])
+                    if len(fi.calls) > before:
+                        gen_bindings[node.targets[0].id] = fi.calls[before:]
+                    continue
+                if isinstance(node, ast.Await):
+                    for lid, kind, reentrant, _ in lock_stack:
+                        if kind == "threading":
+                            fi.awaits_under_sync_lock.append(
+                                (node.lineno, lid))
+                    for lp in loop_stack:
+                        lp.has_await = True
+                elif isinstance(node, ast.Call):
+                    add_call(node)
+                visit(ast.iter_child_nodes(node))
+
+        visit(fi.node.body)
+
+    # ------------------------------------------------- derived queries
+
+    def _propagate_async_reachability(self) -> None:
+        """on_loop: functions that can execute ON the event loop — every
+        coroutine, plus everything reached through non-offload edges.
+        Values form a predecessor map for witness chains."""
+        queue: list[str] = []
+        for q, fi in self.functions.items():
+            if fi.is_async:
+                self.on_loop[q] = None
+                queue.append(q)
+        while queue:
+            q = queue.pop()
+            for cs in self.functions[q].calls:
+                t = cs.target
+                if t is None or cs.offload is not None:
+                    continue
+                if t in self.functions and t not in self.on_loop:
+                    self.on_loop[t] = q
+                    queue.append(t)
+
+    def witness_chain(self, qname: str, limit: int = 6) -> list[str]:
+        """qname's call chain back to an async root, innermost first."""
+        out = [qname]
+        cur = self.on_loop.get(qname)
+        while cur is not None and len(out) < limit:
+            out.append(cur)
+            cur = self.on_loop.get(cur)
+        return out
+
+    def _build_lock_edges(self) -> None:
+        """Direct + transitive held-while-acquiring edges, and the
+        self-chain re-acquisition list (same identity, same instance)."""
+        # transitive lock sets: locks a call to f may acquire (via any
+        # chain of calls, offload-awaited edges included)
+        trans: dict[str, set[str]] = {
+            q: {a.lock for a in fi.acquires}
+            for q, fi in self.functions.items()
+        }
+        # self-chain variant: acquisitions via `self.` reached through
+        # `self.` calls only (same instance by construction)
+        self_trans: dict[str, set[str]] = {
+            q: {a.lock for a in fi.acquires if a.via_self}
+            for q, fi in self.functions.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.functions.items():
+                for cs in fi.calls:
+                    t = cs.target
+                    if t is None or t not in self.functions or \
+                            cs.offload == "detached":
+                        continue
+                    add = trans[t] - trans[q]
+                    if add:
+                        trans[q] |= add
+                        changed = True
+                    if cs.receiver == "self" and \
+                            self.functions[t].cls_qname and \
+                            fi.cls_qname and \
+                            self._same_class_family(fi.cls_qname,
+                                                    self.functions[t]
+                                                    .cls_qname):
+                        sadd = self_trans[t] - self_trans[q]
+                        if sadd:
+                            self_trans[q] |= sadd
+                            changed = True
+        for q, fi in self.functions.items():
+            # direct nesting edges
+            for a in fi.acquires:
+                for h in a.held:
+                    if h == a.lock:
+                        continue
+                    self.lock_edges.setdefault(
+                        (h, a.lock), (fi.path, a.lineno, fi.qname))
+            # transitive edges through calls made while holding
+            for cs in fi.calls:
+                t = cs.target
+                if t is None or t not in self.functions or \
+                        cs.offload == "detached" or not cs.held:
+                    continue
+                for h in cs.held:
+                    for acq in trans[t]:
+                        if acq == h:
+                            # same identity: only a real re-acquire when
+                            # the whole chain stays on one instance
+                            if cs.receiver == "self" and \
+                                    acq in self_trans.get(t, ()):
+                                kind, reentrant = self.locks.get(
+                                    acq, ("threading", False))
+                                if not reentrant:
+                                    self.self_reacquires.append(
+                                        (acq, fi.path, cs.lineno, t))
+                            continue
+                        self.lock_edges.setdefault(
+                            (h, acq), (fi.path, cs.lineno, t))
+
+    def _same_class_family(self, a: str, b: str) -> bool:
+        return a == b or b in self._mro(a) or a in self._mro(b)
+
+    # frame-bounded reachability helpers for J020
+    def reaches_checkpoint(self, qname: str, depth: int) -> bool:
+        fi = self.functions.get(qname)
+        if fi is None:
+            return False
+        if fi.has_check or fi.is_checkpoint:
+            return True
+        if depth <= 0:
+            return False
+        return any(
+            cs.target and cs.offload != "detached"
+            and self.reaches_checkpoint(cs.target, depth - 1)
+            for cs in fi.calls
+        )
+
+    def reaches_heavy_work(self, qname: str, depth: int) -> bool:
+        fi = self.functions.get(qname)
+        if fi is None:
+            return False
+        if fi.blocking or fi.is_kernel:
+            return True
+        if any(True for _ in fi.awaits_under_sync_lock):
+            return True
+        if fi.is_async and (fi.calls or fi.loops):
+            return True
+        if depth <= 0:
+            return False
+        return any(
+            cs.target and cs.offload != "detached"
+            and self.reaches_heavy_work(cs.target, depth - 1)
+            for cs in fi.calls
+        )

@@ -1,0 +1,145 @@
+"""Check inventory: one row per rule, derived from the pass modules'
+own scoping constants. This is the single source of truth for
+
+- the `--check-index` CLI output (markdown) that docs/static-analysis.md
+  embeds verbatim — tests/test_jaxlint_engine.py asserts the docs table
+  matches, so docs cannot drift from the implementation;
+- `inventory_digest()`, the cache key component that invalidates every
+  cached lint result when any pass source changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+
+from tools.jaxlint import funnels, jitrules
+
+
+class Check:
+    __slots__ = ("code", "title", "kind", "modules", "exempt", "summary")
+
+    def __init__(self, code: str, title: str, kind: str,
+                 modules: tuple[str, ...], exempt: tuple[str, ...],
+                 summary: str):
+        self.code = code
+        self.title = title
+        self.kind = kind          # perfile | graph | hygiene | meta
+        self.modules = modules    # scope ("tree" rows apply everywhere)
+        self.exempt = exempt
+        self.summary = summary
+
+
+def _t(x) -> tuple[str, ...]:
+    return tuple(dict.fromkeys(x))  # display dedupe, order-preserving
+
+
+CHECKS: list[Check] = [
+    Check("J000", "suppression reason", "meta", ("tree",), (),
+          "every `# jaxlint: disable=` must name codes AND a reason"),
+    Check("J001", "host sync on hot path", "perfile", ("tree",), (),
+          ".item()/device_get/block_until_ready inside jit bodies "
+          "(tree-wide) and in the hot modules"),
+    Check("J002", "retrace hazard", "perfile", ("tree",), (),
+          "trace-time-frozen time/random/print under jit; untraceable "
+          "static args without static_argnums"),
+    Check("J003", "dtype drift", "perfile", _t(jitrules.DTYPE_MODULES), (),
+          "bare float literal into jnp.array/jnp.full without dtype= "
+          "in engine code"),
+    Check("J004", "lock discipline", "perfile", ("tree",), (),
+          "public method mutates lock-guarded state outside the lock"),
+    Check("J005", "host timer in jit body", "perfile", ("tree",), (),
+          "scanstats/tracing span opened inside a traced body — times "
+          "the trace, not the kernel"),
+    Check("J006", "agg lane registry", "perfile",
+          _t(jitrules.DTYPE_MODULES), _t(jitrules.AGG_LANE_MODULES),
+          "host ufunc lanes under jit / one-hot materializations "
+          "outside the aggregation registry"),
+    Check("J007", "naked jit", "perfile", _t(jitrules.J007_MODULES), (),
+          "`jax.jit` used directly instead of the `xjit` wrapper"),
+    Check("J008", "append hot path", "perfile", _t(funnels.J008_MODULES),
+          _t(funnels.J008_EXEMPT),
+          "parquet encode / object-store put on the append path "
+          "outside the flush executor"),
+    Check("J009", "store boundary", "perfile", _t(funnels.J009_MODULES),
+          _t(funnels.J009_EXEMPT),
+          "concrete store constructed outside a ResilientStore wrap"),
+    Check("J010", "visibility funnel", "perfile",
+          _t(funnels.J010_MODULES), _t(funnels.J010_EXEMPT),
+          "tombstone/retention filtering outside apply_visibility"),
+    Check("J011", "admission funnel", "perfile",
+          _t(funnels.J011_MODULES), _t(funnels.J011_EXEMPT),
+          "server handler calling engine.query without the admission "
+          "scheduler"),
+    Check("J012", "decode funnel", "perfile", _t(funnels.J012_MODULES),
+          _t(funnels.J012_EXEMPT),
+          "segment decode outside the storage codec funnel"),
+    Check("J013", "serving funnel", "perfile", _t(funnels.J013_MODULES),
+          _t(funnels.J013_READ_EXEMPT + funnels.J013_WRITE_EXEMPT),
+          "serving-cache reads/writes outside the serving module"),
+    Check("J014", "funnel subscribers", "perfile",
+          _t(funnels.J014_MODULES), _t(funnels.J014_EXEMPT),
+          "commit-event subscribers registered outside wiring modules"),
+    Check("J015", "metering funnel", "perfile", _t(funnels.J015_MODULES),
+          _t(funnels.J015_EXEMPT),
+          "usage metering recorded outside the metering module"),
+    Check("J016", "stacking funnel", "perfile", _t(funnels.J016_MODULES),
+          _t(funnels.J016_EXEMPT),
+          "grid stacking/padding outside the batcher funnel"),
+    Check("J017", "cluster funnel", "perfile", _t(funnels.J017_MODULES),
+          _t(funnels.J017_VIEW_EXEMPT + funnels.J017_ASSIGN_EXEMPT),
+          "manifest views / assignment-record writes outside the "
+          "cluster funnels"),
+    Check("J018", "event-loop blocking", "graph", ("horaedb_tpu",), (),
+          "blocking primitive (sleep, file/parquet IO, byte-join "
+          "materialization) transitively reachable from a coroutine "
+          "without to_thread/run_in_executor offload"),
+    Check("J019", "lock-order deadlock", "graph", ("horaedb_tpu",), (),
+          "cycle in the cross-module lock-acquisition graph, "
+          "non-reentrant re-acquire through self-dispatch, or `await` "
+          "while holding a sync threading lock"),
+    Check("J020", "deadline propagation", "graph", ("horaedb_tpu",), (),
+          "query-reachable loop doing heavy work with no "
+          "deadline.check/deadline_scope within bounded frame depth"),
+    Check("J021", "suppression hygiene", "hygiene", ("tree",), (),
+          "suppression names a code that no longer fires on that line "
+          "(stale) — delete it when the underlying finding is fixed"),
+    Check("J999", "syntax error", "meta", ("tree",), (),
+          "file fails to parse; every other pass skips it"),
+]
+
+BY_CODE: dict[str, Check] = {c.code: c for c in CHECKS}
+
+
+def check_index_markdown() -> str:
+    """The check-index table embedded in docs/static-analysis.md."""
+    lines = [
+        "| code | title | kind | scope | exemptions |",
+        "|------|-------|------|-------|------------|",
+    ]
+    for c in CHECKS:
+        scope = ", ".join(f"`{m}`" for m in c.modules)
+        exempt = ", ".join(f"`{e}`" for e in c.exempt) or "—"
+        lines.append(
+            f"| {c.code} | {c.title} | {c.kind} | {scope} | {exempt} |")
+    return "\n".join(lines)
+
+
+def check_index_json() -> list[dict]:
+    return [
+        {"code": c.code, "title": c.title, "kind": c.kind,
+         "modules": list(c.modules), "exempt": list(c.exempt),
+         "summary": c.summary}
+        for c in CHECKS
+    ]
+
+
+def inventory_digest() -> str:
+    """Digest over every pass source file in this package: ANY change to
+    the linter invalidates ALL cached per-file and tree results."""
+    h = hashlib.sha256()
+    pkg = Path(__file__).resolve().parent
+    for src in sorted(pkg.glob("*.py")):
+        h.update(src.name.encode())
+        h.update(src.read_bytes())
+    return h.hexdigest()

@@ -1,0 +1,234 @@
+"""Dynamic lock-order witness: the runtime complement to jaxlint's
+static J019 pass.
+
+While installed, the `threading.Lock` / `RLock` factories return
+recording wrappers (`Condition()` is covered too: its default lock
+comes from the patched `RLock`). Each wrapper's identity is its CREATION
+site (file:line of the factory call) — instances created at one site
+collapse to one node, mirroring the static pass's `(Class, attr)`
+identity, and catching the cross-INSTANCE inversions the static pass
+deliberately leaves to this tool. Every acquisition records
+held-before edges into a process-wide digraph; `cycles()` reports
+order inversions that actually happened, with a witness site per edge.
+
+Usage in tests (the chaos soak wires this behind HORAEDB_LOCKWITNESS=1):
+
+    with maybe_witness() as w:
+        ... exercise the engine ...
+    if w is not None:
+        assert not w.cycles(), w.format_report()
+
+Scope notes:
+- only locks CREATED while installed are recorded (pytest/stdlib
+  machinery constructed earlier is invisible — deliberate);
+- re-acquiring an RLock already held by the thread adds no edge (it
+  cannot deadlock against itself);
+- asyncio locks are not recorded: they serialize tasks on ONE thread,
+  and the static pass (await-under-sync-lock, asyncio lock graph)
+  covers them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from contextlib import contextmanager
+
+ENV_FLAG = "HORAEDB_LOCKWITNESS"
+_SELF = __file__  # exact-match filter: "lockwitness" substring would
+#                   also skip frames of tests/test_lockwitness.py
+
+
+def _creation_site() -> str:
+    for frame in reversed(traceback.extract_stack()):
+        if frame.filename != _SELF:
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _acquire_site() -> str:
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename
+        if fn != _SELF and not fn.endswith("threading.py"):
+            return f"{fn}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _RecordingLock:
+    """Wraps a real lock; forwards everything (Condition pokes at
+    `_is_owned`/`_release_save` etc. via __getattr__)."""
+
+    def __init__(self, inner, site: str, witness: "LockWitness",
+                 reentrant: bool):
+        self._inner = inner
+        self._site = site
+        self._witness = witness
+        self._reentrant = reentrant
+
+    def acquire(self, *a, **kw):
+        self._witness._note_acquire(self)
+        ok = self._inner.acquire(*a, **kw)
+        if not ok:
+            self._witness._note_release(self)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        self._witness._note_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class LockWitness:
+    def __init__(self) -> None:
+        self._held = threading.local()       # per-thread list of sites
+        self._edges: dict[tuple[str, str], tuple[int, str, str]] = {}
+        self._graph_lock = threading.Lock()  # the REAL factory's product
+        self._orig: dict[str, object] = {}
+        self._installed = False
+
+    # ------------------------------------------------------- recording
+
+    def _stack(self) -> list[str]:
+        s = getattr(self._held, "sites", None)
+        if s is None:
+            s = self._held.sites = []
+        return s
+
+    def _note_acquire(self, lock: _RecordingLock) -> None:
+        held = self._stack()
+        if lock._reentrant and lock._site in held:
+            held.append(lock._site)  # reentry: depth only, no edge
+            return
+        site = _acquire_site()
+        # get_ident, NOT current_thread(): in a not-yet-registered
+        # thread the latter constructs a _DummyThread whose Event goes
+        # through the patched Lock factory -> infinite recursion
+        thread = f"thread-{threading.get_ident()}"
+        with self._graph_lock:
+            for h in held:
+                if h == lock._site:
+                    continue
+                key = (h, lock._site)
+                if key in self._edges:
+                    n, s0, t0 = self._edges[key]
+                    self._edges[key] = (n + 1, s0, t0)
+                else:
+                    self._edges[key] = (1, site, thread)
+        held.append(lock._site)
+
+    def _note_release(self, lock: _RecordingLock) -> None:
+        held = self._stack()
+        if lock._site in held:  # non-LIFO release: drop last occurrence
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == lock._site:
+                    del held[i]
+                    break
+
+    # ----------------------------------------------------- install/api
+
+    def install(self) -> None:
+        if self._installed:
+            return
+        self._orig = {
+            "Lock": threading.Lock,
+            "RLock": threading.RLock,
+        }
+        witness = self
+
+        def make(factory, reentrant):
+            def wrapped():
+                return _RecordingLock(
+                    factory(), _creation_site(), witness, reentrant)
+            return wrapped
+
+        threading.Lock = make(self._orig["Lock"], False)
+        threading.RLock = make(self._orig["RLock"], True)
+        self._installed = True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock = self._orig["Lock"]
+        threading.RLock = self._orig["RLock"]
+        self._installed = False
+
+    def edges(self) -> dict[tuple[str, str], tuple[int, str, str]]:
+        with self._graph_lock:
+            return dict(self._edges)
+
+    def cycles(self) -> list[list[str]]:
+        """Elementary cycles (as node lists) in the recorded order
+        graph — any cycle is a latent deadlock."""
+        edges = self.edges()
+        adj: dict[str, list[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        out: list[list[str]] = []
+        seen_cycles: set[frozenset[str]] = set()
+        for root in sorted(adj):
+            # DFS from root; a path back to root is a cycle
+            stack: list[tuple[str, list[str]]] = [(root, [root])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in adj[node]:
+                    if nxt == root and len(path) > 1 or \
+                            nxt == root == node:
+                        key = frozenset(path)
+                        if key not in seen_cycles:
+                            seen_cycles.add(key)
+                            out.append(path + [root])
+                    elif nxt not in path and nxt > root:
+                        # only walk nodes > root: each cycle found once,
+                        # from its smallest node
+                        stack.append((nxt, path + [nxt]))
+        return out
+
+    def format_report(self) -> str:
+        lines = ["lockwitness: recorded lock-order graph"]
+        for (a, b), (n, site, thread) in sorted(self.edges().items()):
+            lines.append(f"  {a} -> {b}  (x{n}, first at {site} "
+                         f"in {thread})")
+        cyc = self.cycles()
+        if cyc:
+            lines.append("CYCLES (latent deadlocks):")
+            for c in cyc:
+                lines.append("  " + " -> ".join(c))
+        else:
+            lines.append("no cycles")
+        return "\n".join(lines)
+
+
+@contextmanager
+def witness():
+    w = LockWitness()
+    w.install()
+    try:
+        yield w
+    finally:
+        w.uninstall()
+
+
+@contextmanager
+def maybe_witness():
+    """The soak-test hook: records only when HORAEDB_LOCKWITNESS=1,
+    yields None otherwise so the soak runs unchanged by default."""
+    if os.environ.get(ENV_FLAG) == "1":
+        with witness() as w:
+            yield w
+    else:
+        yield None
