@@ -424,6 +424,140 @@ def cluster_scaleout_lane(smoke: bool) -> dict:
     wall_s = 0.3 if smoke else 1.5
     levels = (1, 8, 64)
 
+    async def forwarded_write_ab(smoke: bool) -> dict:
+        """Trace-shipping overhead on the FORWARDED write path: the same
+        replica->writer HTTP forward, A/B'd with tracing off (no spans,
+        no headers, no shipping) vs full sampling (remote adopt + subtree
+        export + graft), over real aiohttp servers so the measured hop
+        includes the router's traced client funnel end to end. The
+        acceptance bar is <5% added to the forwarded-request p50."""
+        import socket
+
+        from aiohttp import ClientSession, ClientTimeout, web
+
+        from horaedb_tpu.common import tracing
+        from horaedb_tpu.server.config import Config
+        from horaedb_tpu.server.main import build_app
+
+        socks, ports = [], []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        wport, rport = ports
+
+        def cfg(port: int, node: str, role: str, peers: list) -> Config:
+            return Config.from_dict({
+                "port": port,
+                "metric_engine": {
+                    "node_id": node,
+                    "rules": {"enabled": False},
+                    "telemetry": {"enabled": False},
+                    "storage": {"object_store": {"type": "Local",
+                                                 "data_dir": http_root}},
+                    "cluster": {
+                        "enabled": True,
+                        "role": role,
+                        "watch_interval": "30s",
+                        "probe_interval": "30s",
+                        "self_url": f"http://127.0.0.1:{port}",
+                        "peers": peers,
+                    },
+                },
+            })
+
+        async def boot(config: Config):
+            app = await build_app(config)
+            runner = web.AppRunner(app, handler_cancellation=True,
+                                   shutdown_timeout=1.0)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", config.port)
+            await site.start()
+            return runner
+
+        def fwd_payload(seq: int) -> bytes:
+            req = remote_write_pb2.WriteRequest()
+            for s in range(4):
+                series = req.timeseries.add()
+                for k, v in ((b"__name__", b"fwd_cpu"),
+                             (b"host", f"fwd-{s:02d}".encode())):
+                    lab = series.labels.add()
+                    lab.name = k
+                    lab.value = v
+                smp = series.samples.add()
+                smp.timestamp = base + seq * 1000
+                smp.value = float(seq)
+            return req.SerializeToString()
+
+        warmup, iters = (10, 50) if smoke else (25, 200)
+        prev_sample = tracing._sample_rate
+        http_root = tempfile.mkdtemp(prefix="horaedb-bench-fwd-")
+        runners = []
+        out: dict = {}
+        try:
+            runners.append(await boot(cfg(
+                wport, "bw1", "writer",
+                [{"node": "br1", "url": f"http://127.0.0.1:{rport}",
+                  "role": "replica"}])))
+            runners.append(await boot(cfg(
+                rport, "br1", "replica",
+                [{"node": "bw1", "url": f"http://127.0.0.1:{wport}",
+                  "role": "writer"}])))
+            rbase = f"http://127.0.0.1:{rport}"
+            async with ClientSession(
+                timeout=ClientTimeout(total=10)
+            ) as sess:
+                # deterministic peer health before timing anything
+                await sess.post(f"{rbase}/api/v1/cluster/refresh")
+
+                async def one(sample: float, seq: int) -> float:
+                    tracing.configure(sample=sample)
+                    body = fwd_payload(seq)
+                    t0 = time.perf_counter()
+                    async with sess.post(
+                        f"{rbase}/api/v1/write", data=body,
+                        headers={"Content-Type":
+                                 "application/x-protobuf"},
+                    ) as r:
+                        assert r.status == 200, await r.text()
+                    return time.perf_counter() - t0
+
+                # interleaved arms: alternating traced/untraced requests
+                # share any warmup/GC/flush drift instead of one arm
+                # eating all of it (sequential arms bias the later one)
+                off_lat: list[float] = []
+                on_lat: list[float] = []
+                for i in range(warmup + iters):
+                    a = await one(0.0, 2 * i)
+                    b = await one(1.0, 2 * i + 1)
+                    if i >= warmup:
+                        off_lat.append(a)
+                        on_lat.append(b)
+                off_lat.sort()
+                on_lat.sort()
+                p50_off = off_lat[len(off_lat) // 2] * 1000
+                p50_on = on_lat[len(on_lat) // 2] * 1000
+            out = {
+                "p50_ms_untraced": round(p50_off, 3),
+                "p50_ms_traced": round(p50_on, 3),
+                "trace_ship_overhead_pct": round(
+                    100.0 * (p50_on - p50_off) / max(p50_off, 1e-9), 1
+                ),
+                "iters_per_arm": iters,
+            }
+        finally:
+            tracing.configure(sample=prev_sample)
+            for r in runners:
+                try:
+                    await r.cleanup()
+                except Exception:  # noqa: BLE001 — bench teardown
+                    pass
+            shutil.rmtree(http_root, ignore_errors=True)
+        return out
+
     async def run() -> dict:
         root = tempfile.mkdtemp(prefix="horaedb-bench-cluster-")
         store = LocalStore(root)
@@ -550,6 +684,7 @@ def cluster_scaleout_lane(smoke: bool) -> dict:
                 out[str(clients)] = row
             stop.set()
             await asyncio.gather(*bg, return_exceptions=True)
+            out["forwarded_write"] = await forwarded_write_ab(smoke)
             top = str(levels[-1])
             w_qps = out[top]["writer_only"]["qps"]
             c_qps = out[top]["writer_plus_2_replicas"]["qps"]

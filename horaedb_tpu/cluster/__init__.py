@@ -100,11 +100,73 @@ PEER_HEALTHY = GLOBAL_METRICS.gauge(
          "/api/v1/cluster/status probes and request outcomes.",
     labelnames=("node",),
 )
+PROBE_SECONDS = GLOBAL_METRICS.histogram(
+    "horaedb_cluster_probe_seconds",
+    help="Peer status-probe latency through the traced client funnel, "
+         "by peer and outcome: ok (2xx), error (non-2xx answer), "
+         "unreachable (connect/timeout failure).",
+    labelnames=("peer", "outcome"),
+)
+FLEET_PARTIALS = GLOBAL_METRICS.counter(
+    "horaedb_cluster_fleet_partials_total",
+    help="Federated EXPLAIN merges that degraded: a remote fragment was "
+         "missing (dead peer, non-explain answer, hedged failover) and "
+         "the fleet verdict counted it in `partial` instead of hanging.",
+)
 
 for _r in ("ok", "error", "unchanged"):
     REFRESHES.labels(_r)
 for _k in ("write", "read"):
     FORWARDS.labels(_k)
+
+
+# -- federated EXPLAIN -------------------------------------------------------
+
+def fleet_fragment(node: str, explain: dict | None) -> dict | None:
+    """Extract one node's contribution to the fleet verdict from its full
+    EXPLAIN payload: the identity + staleness token + the sub-verdicts an
+    operator compares across nodes. Returns None when the payload isn't
+    an EXPLAIN dict (the caller counts it as a partial)."""
+    if not isinstance(explain, dict):
+        return None
+    cluster = explain.get("cluster")
+    cluster = cluster if isinstance(cluster, dict) else {}
+    frag = {
+        "node": cluster.get("node", node),
+        "role": cluster.get("role", "unknown"),
+        "staleness_ms": float(cluster.get("staleness_ms", 0.0) or 0.0),
+        "manifest_epoch": cluster.get("manifest_epoch"),
+        "cluster": cluster,
+    }
+    for key in ("serving", "admission", "encoding"):
+        if isinstance(explain.get(key), dict):
+            frag[key] = explain[key]
+    return frag
+
+
+def fleet_verdict(origin: str, fragments: "list[dict]",
+                  partial: int = 0) -> dict:
+    """Merge per-node EXPLAIN fragments into the pinned-schema `fleet`
+    verdict — the merge surface the ROADMAP's distributed scatter-gather
+    will reuse. Schema (stable; cluster_smoke + the chaos lane assert it):
+
+        origin        node id that ran the merge
+        nodes         per-node fragments (fleet_fragment), origin first
+        staleness_ms  MAX across fragments — the result is only as fresh
+                      as its stalest contributor
+        partial       fragments lost to dead/degraded peers (counted,
+                      never waited for)
+    """
+    if partial:
+        FLEET_PARTIALS.inc(partial)
+    return {
+        "origin": origin,
+        "nodes": fragments,
+        "staleness_ms": max(
+            (f.get("staleness_ms", 0.0) for f in fragments), default=0.0
+        ),
+        "partial": int(partial),
+    }
 
 
 def rendezvous_order(key: bytes, nodes: "list[str]") -> "list[str]":

@@ -42,6 +42,7 @@ import logging
 import time
 
 from horaedb_tpu.cluster import REFRESHES, REPLICA_EPOCH, REPLICA_LAG, WATCH_ERRORS
+from horaedb_tpu.common import tracing
 from horaedb_tpu.common.error import ReplicaReadOnlyError
 from horaedb_tpu.objstore import NotFound
 from horaedb_tpu.storage.types import TimeRange
@@ -141,7 +142,9 @@ class ReplicaEngine:
         self._desc_token: "str | None" = None
         self._epoch_floor = 0
         self._consecutive_errors = 0
+        self._swaps = 0
         self._watch_task: "asyncio.Task | None" = None
+        self._closing = False
         self._refresh_lock = asyncio.Lock()
         self._engine = None
         last: "BaseException | None" = None
@@ -254,6 +257,19 @@ class ReplicaEngine:
         REPLICA_EPOCH.set(self.manifest_epoch())
         REPLICA_LAG.set(round(self.staleness_ms() / 1000.0, 3))
 
+    def watch_stats(self) -> dict:
+        """The watch loop's health in one dict (/debug/cluster's replica
+        row): lag token plus the loop's error/backoff posture — an
+        operator reads "is this replica keeping up, and if not, is it
+        the store or the writer" without grepping logs."""
+        return {
+            **self.staleness(),
+            "watch_interval_s": self._interval_s,
+            "backoff_s": round(self.backoff_s(), 3),
+            "consecutive_errors": self._consecutive_errors,
+            "swaps": self._swaps,
+        }
+
     # -- the watch loop -------------------------------------------------------
     async def _root_token(self, eroot: str) -> str:
         """Change token for one region root: conditional-GET ETag of each
@@ -330,9 +346,11 @@ class ReplicaEngine:
 
     async def _swap_full(self) -> None:
         old = self._engine
-        fresh = await self._open_view()
-        fired = invalidate_swapped_views(old, fresh)
+        with tracing.span("replica_swap_full", root=self._root):
+            fresh = await self._open_view()
+            fired = invalidate_swapped_views(old, fresh)
         self._engine = fresh
+        self._swaps += 1
         # re-prime per-root tokens (the region set may have changed);
         # anything committed between token and swap shows as one harmless
         # extra refresh on the next probe
@@ -355,11 +373,13 @@ class ReplicaEngine:
             def sub_engines(self):
                 return {f"region-{self._rid}/": self._sub}
 
-        await self._engine.refresh_region(region_id)
-        invalidate_swapped_views(
-            _One(old_sub, region_id),
-            _One(self._engine.engines[region_id], region_id),
-        )
+        with tracing.span("replica_swap_region", region=region_id):
+            await self._engine.refresh_region(region_id)
+            invalidate_swapped_views(
+                _One(old_sub, region_id),
+                _One(self._engine.engines[region_id], region_id),
+            )
+        self._swaps += 1
         logger.info(
             "replica %s: region %d snapshot swap (epoch %d)",
             self._root, region_id, self.manifest_epoch(),
@@ -383,7 +403,7 @@ class ReplicaEngine:
 
     async def watch_loop(self) -> None:
         """The background tail loop (server/main.py owns the task)."""
-        while True:
+        while not self._closing:
             try:
                 await self.watch_once()
             except asyncio.CancelledError:
@@ -396,6 +416,13 @@ class ReplicaEngine:
                     "replica watch probe failed (%d consecutive): %s",
                     self._consecutive_errors, e,
                 )
+            # re-check before the (up to backoff-cap) sleep: close()'s
+            # cancel can be swallowed by the asyncio.wait_for race in the
+            # resilient store's attempt loop (bpo-37658 on 3.10) when it
+            # lands exactly as an inner op completes — without the flag,
+            # a lost cancel turns close() into a full-backoff stall
+            if self._closing:
+                return
             await asyncio.sleep(self.backoff_s())
 
     def start_watch(self) -> None:
@@ -405,6 +432,7 @@ class ReplicaEngine:
             )
 
     async def close(self) -> None:
+        self._closing = True
         if self._watch_task is not None:
             self._watch_task.cancel()
             try:

@@ -22,11 +22,19 @@ owned. Three routing decisions live here:
 
 Forwarded requests carry `X-Horaedb-Forwarded: 1`; a node never re-routes
 a forwarded request (loop guard).
+
+Every outbound hop — write forwarding, split-write fan-out, read
+offload, hedged failover, status probes — goes through ONE traced
+client funnel (`traced_request`, jaxlint J022): it injects the
+cross-node trace headers (X-Horaedb-Trace-Id + parent span) and grafts
+the peer's shipped-back span subtree under a node-labeled client span,
+so the origin's /debug/traces/{id} shows the whole cross-node tree.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 
@@ -36,10 +44,12 @@ from horaedb_tpu.cluster import (
     FAILOVERS,
     FORWARDS,
     PEER_HEALTHY,
+    PROBE_SECONDS,
     ClusterConfig,
     ClusterPeer,
     rendezvous_order,
 )
+from horaedb_tpu.common import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -148,6 +158,7 @@ class ClusterRouter:
         self._assignment = None  # cluster/assignment.Assignment | None
         self._session = None
         self._probe_task: "asyncio.Task | None" = None
+        self._closing = False
         for n in self.peers:
             PEER_HEALTHY.labels(n).set(1)
 
@@ -247,6 +258,9 @@ class ClusterRouter:
         self._healthy[node] = False
         PEER_HEALTHY.labels(node).set(0)
 
+    def is_healthy(self, node: str) -> bool:
+        return bool(self._healthy.get(node))
+
     def mark_healthy(self, node: str) -> None:
         if self._healthy.get(node) is False:
             logger.info("cluster peer %s recovered", node)
@@ -266,6 +280,27 @@ class ClusterRouter:
             for n, p in sorted(self.peers.items())
         }
 
+    def peer_detail(self) -> dict:
+        """peer_status() enriched with each peer's last probe body (the
+        /debug/cluster fleet page): role as the PEER reports it, its
+        manifest epoch / staleness token, its region count, and its load
+        view (inflight, queued, breakers, sheds — cluster status carries
+        it since the fleet-observability PR). A never-probed or dead
+        peer keeps the bare health row — the page degrades, never 500s."""
+        out = self.peer_status()
+        for node, info in out.items():
+            body = (self._peer_status.get(node) or {}).get("data") or {}
+            if not isinstance(body, dict):
+                continue
+            for k in ("role", "standby", "partial", "manifest_epoch",
+                      "staleness_ms", "stale", "load"):
+                if k in body:
+                    info[k] = body[k]
+            regions = body.get("regions")
+            if isinstance(regions, (dict, list)):
+                info["regions"] = len(regions)
+        return out
+
     async def _ensure_session(self):
         if self._session is None:
             import aiohttp
@@ -275,40 +310,106 @@ class ClusterRouter:
             )
         return self._session
 
+    # -- the traced client funnel ---------------------------------------------
+    async def traced_request(
+        self,
+        node: str,
+        method: str,
+        url: str,
+        *,
+        headers=None,
+        body: "bytes | None" = None,
+        kind: str = "forward",
+        timeout=None,
+    ):
+        """THE outbound cluster HTTP call (jaxlint J022 pins every
+        cluster-tier client request here). Opens a `cluster_<kind>`
+        client span, injects the cross-node trace headers when a trace
+        is active, and grafts the peer's shipped-back span subtree
+        (SPANS_HEADER, stripped from the returned headers) under that
+        span — the origin's tree gains the remote half, node-labeled.
+        Returns (status, headers dict, body bytes); raises on transport
+        failure so each caller keeps its own health/fallback policy."""
+        session = await self._ensure_session()
+        req_headers = dict(headers or {})
+        with tracing.span(f"cluster_{kind}", node=node,
+                          method=method) as sp:
+            tid = tracing.current_trace_id()
+            if tid is not None:
+                req_headers[tracing.TRACE_HEADER] = tid
+                parent = tracing.current_span_id()
+                if parent is not None:
+                    req_headers[tracing.PARENT_SPAN_HEADER] = str(parent)
+            kw = {} if timeout is None else {"timeout": timeout}
+            async with session.request(
+                method, url, data=body, headers=req_headers, **kw,
+            ) as resp:
+                out = await resp.read()
+                resp_headers = dict(resp.headers)
+                shipped = None
+                for k in list(resp_headers):
+                    if k.lower() == tracing.SPANS_HEADER.lower():
+                        shipped = resp_headers.pop(k)
+                if sp is not None:
+                    sp.attrs["status"] = resp.status
+                    if shipped:
+                        sp.attrs["remote_spans"] = tracing.graft_remote(
+                            shipped, node
+                        )
+                return resp.status, resp_headers, out
+
     async def probe_once(self) -> None:
-        """One health sweep: GET every peer's cluster status."""
+        """One health sweep: GET every peer's cluster status through the
+        funnel, timing each probe into
+        horaedb_cluster_probe_seconds{peer,outcome}."""
         import aiohttp
 
-        session = await self._ensure_session()
         for node, peer in self.peers.items():
             if not peer.url:
                 continue
+            t0 = time.perf_counter()
             try:
-                async with session.get(
-                    peer.url.rstrip("/") + STATUS_PATH,
-                    timeout=aiohttp.ClientTimeout(total=5),
-                ) as resp:
-                    if resp.status == 200:
-                        body = await resp.json()
-                        self._peer_status[node] = body
-                        self.mark_healthy(node)
-                        self._adopt_assignment(body)
-                    else:
-                        self.mark_unhealthy(node)
+                status, _headers, out = await self.traced_request(
+                    node, "GET", peer.url.rstrip("/") + STATUS_PATH,
+                    kind="probe", timeout=aiohttp.ClientTimeout(total=5),
+                )
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — unreachable peer
+                PROBE_SECONDS.labels(node, "unreachable").observe(
+                    time.perf_counter() - t0
+                )
+                self.mark_unhealthy(node)
+                continue
+            outcome = "ok" if status == 200 else "error"
+            PROBE_SECONDS.labels(node, outcome).observe(
+                time.perf_counter() - t0
+            )
+            if status == 200:
+                try:
+                    status_body = json.loads(out)
+                except (ValueError, UnicodeDecodeError):
+                    status_body = {}
+                self._peer_status[node] = status_body
+                self.mark_healthy(node)
+                self._adopt_assignment(status_body)
+            else:
                 self.mark_unhealthy(node)
 
     async def probe_loop(self) -> None:
         interval = self.config.probe_interval.seconds
-        while True:
+        while not self._closing:
             try:
                 await self.probe_once()
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 — keep probing
                 logger.exception("cluster probe sweep failed")
+            # same lost-cancel guard as the replica watch loop: a cancel
+            # swallowed mid-probe must not leave close() waiting out the
+            # full probe interval (or forever, on a re-armed loop)
+            if self._closing:
+                return
             await asyncio.sleep(interval)
 
     def start_probes(self) -> None:
@@ -333,9 +434,6 @@ class ClusterRouter:
         url = self.peer_url(node)
         if url is None:
             return None
-        import aiohttp
-
-        session = await self._ensure_session()
         fwd_headers = {
             k: v for k, v in headers.items()
             if k.lower() not in _HOP_HEADERS
@@ -343,15 +441,14 @@ class ClusterRouter:
         fwd_headers[FORWARD_HEADER] = "1"
         t0 = time.perf_counter()
         try:
-            async with session.request(
-                method, url.rstrip("/") + path_qs,
-                data=body, headers=fwd_headers,
-            ) as resp:
-                out = await resp.read()
-                FORWARDS.labels(kind).inc()
-                if resp.status >= 500:
-                    self.mark_unhealthy(node)
-                return resp.status, dict(resp.headers), out
+            status, resp_headers, out = await self.traced_request(
+                node, method, url.rstrip("/") + path_qs,
+                headers=fwd_headers, body=body, kind=kind,
+            )
+            FORWARDS.labels(kind).inc()
+            if status >= 500:
+                self.mark_unhealthy(node)
+            return status, resp_headers, out
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — peer down mid-request
@@ -366,6 +463,7 @@ class ClusterRouter:
         FAILOVERS.inc()
 
     async def close(self) -> None:
+        self._closing = True
         if self._probe_task is not None:
             self._probe_task.cancel()
             try:

@@ -32,9 +32,11 @@ Knobs (env, overridable via configure()):
 from __future__ import annotations
 
 import itertools
+import json
 import logging
 import os
 import random
+import re
 import threading
 import time
 from collections import OrderedDict
@@ -42,6 +44,29 @@ from contextlib import contextmanager
 from contextvars import ContextVar
 
 logger = logging.getLogger(__name__)
+
+# Cross-node propagation headers (cluster observability plane). They live
+# HERE — not in server/main.py or cluster/router.py — because both the
+# HTTP tier and the router funnel need them and this module is the only
+# stdlib-clean common ground (router importing server would cycle).
+TRACE_HEADER = "X-Horaedb-Trace-Id"
+PARENT_SPAN_HEADER = "X-Horaedb-Parent-Span"
+SPANS_HEADER = "X-Horaedb-Trace-Spans"
+
+# Serialized-subtree ship budget: the callee returns its span list in a
+# response header, and aiohttp's client rejects header fields over ~8190
+# bytes — blowing that budget would fail the FORWARDED REQUEST to report
+# on it. Stay well under, degrading detail instead (export_spans).
+SHIP_BUDGET_BYTES = 4096
+
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{8,64}$")
+
+
+def valid_trace_id(s) -> bool:
+    """Is `s` shaped like one of our trace ids? Remote peers are trusted
+    cluster members, but the id lands in filenames (slowlog spool) and
+    log lines — refuse anything that isn't plain bounded hex."""
+    return isinstance(s, str) and _TRACE_ID_RE.match(s) is not None
 
 
 def _env_float(name: str, default: float) -> float:
@@ -179,20 +204,35 @@ def _sampled() -> bool:
 
 
 @contextmanager
-def trace(name: str, **attrs):
+def trace(name: str, *, remote_id: str | None = None,
+          remote_parent: int | None = None, **attrs):
     """Root span context: starts a new trace (subject to sampling) and
     registers it in the recent-trace ring on exit. Yields the Trace, or
     None when this request is not sampled. Nested calls degrade to a
-    child span of the enclosing trace."""
+    child span of the enclosing trace.
+
+    `remote_id` adopts a trace id minted by a peer (a forwarded request's
+    X-Horaedb-Trace-Id) instead of minting one: the sampling decision was
+    the ORIGIN's — it only sent headers because it sampled — so adoption
+    bypasses the local sampler; an unsampled origin sends nothing and the
+    callee falls through to its own sampling. A malformed id is ignored
+    (normal local trace). `remote_parent` records the origin-side span id
+    this request hangs under, so the shipped-back subtree is attributable
+    even when read raw."""
     cur = _ACTIVE.get()
     if cur is not None:
         with span(name, **attrs):
             yield cur[0]
         return
-    if not _sampled():
+    if remote_id is not None and valid_trace_id(remote_id):
+        t = Trace(remote_id)
+        if remote_parent is not None:
+            attrs = dict(attrs, remote_parent=remote_parent)
+    elif not _sampled():
         yield None
         return
-    t = Trace(os.urandom(8).hex())
+    else:
+        t = Trace(os.urandom(8).hex())
     root = t.new_span(None, name, attrs)
     token = _ACTIVE.set((t, root))
     t0 = time.perf_counter()
@@ -300,3 +340,109 @@ def reset() -> None:
     """Clear the ring (tests)."""
     with _ring_lock:
         _ring.clear()
+
+
+# -- cross-node stitching ----------------------------------------------------
+# The callee EXPORTS its finished span list (flat, compact JSON) in the
+# response's SPANS_HEADER; the origin GRAFTS it under the router funnel's
+# client span. Flat-with-parent-ids beats a nested tree on the wire: the
+# graft is one pass, and a record whose parent got truncated away still
+# attaches (to the anchor span) instead of orphaning.
+
+# root attrs that must NOT ride the ship header: the EXPLAIN payload and
+# scanstats already travel in the response BODY (the federated-EXPLAIN
+# fragment); duplicating them here would blow the budget on every query
+_NOSHIP_ATTRS = frozenset({"explain", "scanstats"})
+
+
+def current_span_id() -> int | None:
+    """Span id of the running context's current span (the funnel puts it
+    in PARENT_SPAN_HEADER so the callee can name its origin anchor)."""
+    cur = _ACTIVE.get()
+    return cur[1].span_id if cur is not None else None
+
+
+def export_spans(t: Trace, budget: int = SHIP_BUDGET_BYTES) -> str:
+    """Serialize a finished trace's span list for the SPANS_HEADER,
+    degrading under `budget` instead of failing the response: full
+    records -> records without attrs -> one root summary carrying a
+    `truncated_spans` count. Always returns header-safe ASCII JSON."""
+    spans = list(t.spans)
+
+    def enc(recs) -> str:
+        return json.dumps(recs, separators=(",", ":"), ensure_ascii=True,
+                          default=str)
+
+    def record(s: Span, with_attrs: bool) -> dict:
+        rec = {
+            "id": s.span_id,
+            "parent": s.parent_id,
+            "name": s.name,
+            "start_ms": round(s.start_ms, 3),
+            "duration_s": round(s.duration_s or 0.0, 6),
+        }
+        if with_attrs and s.attrs:
+            attrs = {k: v for k, v in list(s.attrs.items())
+                     if k not in _NOSHIP_ATTRS}
+            if attrs:
+                rec["attrs"] = attrs
+        return rec
+
+    for with_attrs in (True, False):
+        try:
+            out = enc([record(s, with_attrs) for s in spans])
+        except (TypeError, ValueError):
+            continue  # a non-JSON attr value: retry without attrs
+        if len(out) <= budget:
+            return out
+    root = t.root
+    return enc([{
+        "id": root.span_id if root else 1,
+        "parent": None,
+        "name": root.name if root else "",
+        "start_ms": round(root.start_ms, 3) if root else 0.0,
+        "duration_s": round(root.duration_s or 0.0, 6) if root else 0.0,
+        "attrs": {"truncated_spans": len(spans)},
+    }])
+
+
+def graft_remote(payload, node: str) -> int:
+    """Attach a peer's exported span list under the CURRENT span, re-ided
+    from the local trace's counter and labeled `node=<peer>`. A record
+    whose parent is unknown (truncated ship, malformed entry) anchors to
+    the current span — the stitched tree has no orphans by construction.
+    Returns spans grafted; 0 (never a raise) on any malformed payload —
+    a peer's bad header must not fail the origin's request."""
+    cur = _ACTIVE.get()
+    if cur is None or not payload:
+        return 0
+    if isinstance(payload, (str, bytes)):
+        try:
+            payload = json.loads(payload)
+        except (ValueError, UnicodeDecodeError):
+            return 0
+    if not isinstance(payload, list):
+        return 0
+    t, anchor = cur
+    idmap: dict[int, int] = {}
+    grafted = 0
+    for rec in payload:
+        if not isinstance(rec, dict):
+            continue
+        attrs = rec.get("attrs")
+        attrs = dict(attrs) if isinstance(attrs, dict) else {}
+        attrs["node"] = node
+        rparent = rec.get("parent")
+        parent = (idmap.get(rparent, anchor.span_id)
+                  if isinstance(rparent, int) else anchor.span_id)
+        sp = t.new_span(parent, str(rec.get("name", "?")), attrs)
+        try:
+            sp.start_ms = float(rec.get("start_ms", sp.start_ms))
+            sp.duration_s = float(rec.get("duration_s", 0.0))
+        except (TypeError, ValueError):
+            sp.duration_s = 0.0
+        rid = rec.get("id")
+        if isinstance(rid, int):
+            idmap[rid] = sp.span_id
+        grafted += 1
+    return grafted

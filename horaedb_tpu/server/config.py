@@ -531,6 +531,18 @@ class Config:
         ensure(bool(tel.tenant), "telemetry.tenant must be non-empty")
         ensure(tel.tenant_weight > 0,
                "telemetry.tenant_weight must be positive")
+        fed = tel.federation
+        ensure(fed.scrape_interval.seconds > 0,
+               "telemetry.federation.scrape_interval must be positive")
+        ensure(fed.timeout.seconds > 0,
+               "telemetry.federation.timeout must be positive")
+        ensure(fed.max_series >= 0,
+               "telemetry.federation.max_series must be >= 0 "
+               "(0 = unbudgeted)")
+        if fed.enabled:
+            ensure(self.metric_engine.cluster.enabled,
+                   "telemetry.federation requires metric_engine.cluster "
+                   "(peer scrapes pull from the cluster peer table)")
         if self.metric_engine.slo:
             ensure(rules.enabled,
                    "[[metric_engine.slo]] requires metric_engine.rules "

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
 import sys
 import time
@@ -77,7 +78,9 @@ logger = logging.getLogger("horaedb_tpu.server")
 
 STATE_KEY = web.AppKey("state", object)
 
-TRACE_HEADER = "X-Horaedb-Trace-Id"
+# canonical spellings live in common/tracing.py (the cluster router
+# funnel injects them; this tier adopts + echoes them)
+TRACE_HEADER = tracing.TRACE_HEADER
 
 HTTP_SECONDS = METRICS.histogram(
     "horaedb_http_request_seconds",
@@ -122,6 +125,23 @@ def _record_slow_query(slowlog: "SlowLog | None", t) -> None:
     slowlog.record(t.trace_id, root.duration_s, entry)
 
 
+def _remote_trace_context(request: web.Request):
+    """(remote trace id, remote parent span id) when this request arrived
+    through a peer's traced client funnel; (None, None) otherwise. The
+    parent-span header is the gate: only the funnel sends it, so a client
+    replaying an X-Horaedb-Trace-Id from a previous response cannot make
+    this node adopt (and clobber) an old ring entry."""
+    parent_raw = request.headers.get(tracing.PARENT_SPAN_HEADER)
+    if parent_raw is None:
+        return None, None
+    remote_id = request.headers.get(TRACE_HEADER)
+    try:
+        parent = int(parent_raw)
+    except ValueError:
+        parent = None
+    return remote_id, parent
+
+
 @web.middleware
 async def observability_middleware(request: web.Request, handler):
     """Every request (except the observability surfaces themselves) gets a
@@ -129,17 +149,24 @@ async def observability_middleware(request: web.Request, handler):
     id is echoed in the X-Horaedb-Trace-Id response header so a caller can
     fetch its span tree from /debug/traces/{id}. Finished traces of query
     endpoints feed the slow-query flight recorder (including failed
-    requests — a slow 500 is exactly what the recorder exists for)."""
+    requests — a slow 500 is exactly what the recorder exists for).
+
+    Cross-node plumbing: a request carrying the router funnel's trace
+    headers ADOPTS the origin's trace id instead of minting one, and the
+    finished span subtree ships back in the response's SPANS_HEADER so
+    the origin grafts it into one stitched, node-labeled tree."""
     resource = request.match_info.route.resource
     endpoint = resource.canonical if resource is not None else "unmatched"
     if request.path.startswith(("/metrics", "/debug")):
         return await handler(request)
+    remote_id, remote_parent = _remote_trace_context(request)
     t0 = time.perf_counter()
     status = 500
     finished = None
     try:
         with tracing.trace(
-            f"{request.method} {endpoint}", method=request.method,
+            f"{request.method} {endpoint}", remote_id=remote_id,
+            remote_parent=remote_parent, method=request.method,
             path=request.path,
         ) as t:
             finished = t
@@ -157,6 +184,12 @@ async def observability_middleware(request: web.Request, handler):
                     time.perf_counter() - t0
                 )
                 HTTP_REQUESTS.labels(endpoint, request.method, str(status)).inc()
+    except web.HTTPException as e:
+        # the trace finished when the with-block unwound: a forwarded
+        # request's error response still ships its span subtree home
+        if finished is not None and remote_id == finished.trace_id:
+            e.headers[tracing.SPANS_HEADER] = tracing.export_spans(finished)
+        raise
     finally:
         # the trace context exited above, so duration_s is final here
         if finished is not None and endpoint in QUERY_ENDPOINTS:
@@ -168,6 +201,11 @@ async def observability_middleware(request: web.Request, handler):
                 logger.exception("slowlog record failed")
     if finished is not None:
         resp.headers[TRACE_HEADER] = finished.trace_id
+        if remote_id == finished.trace_id:
+            # adopted context: the callee's half of the cross-node tree
+            # rides home in one bounded header (export degrades under
+            # budget instead of overflowing aiohttp's field cap)
+            resp.headers[tracing.SPANS_HEADER] = tracing.export_spans(finished)
     return resp
 
 
@@ -198,6 +236,7 @@ async def cluster_middleware(request: web.Request, handler):
     cl = state.cluster
     if cl is None:
         return await handler(request)
+    failed_peer = None
     if (
         cl.role == "writer" and not cl.standby
         and cl.config.route_reads
@@ -218,17 +257,31 @@ async def cluster_middleware(request: web.Request, handler):
             )
             if res is not None and res[0] < 500:
                 status, hdrs, out = res
+                out = _fleet_merge_body(state, out, remote_node=peer.node)
                 resp = web.Response(status=status, body=out)
                 resp.headers["Content-Type"] = hdrs.get(
                     "Content-Type", "application/json"
                 )
-                for h in (STALENESS_HEADER, TRACE_HEADER):
-                    if h in hdrs:
-                        resp.headers[h] = hdrs[h]
+                if STALENESS_HEADER in hdrs:
+                    resp.headers[STALENESS_HEADER] = hdrs[STALENESS_HEADER]
                 return resp
             # replica error / unreachable: hedged failover to local
             cl.router.note_failover()
+            failed_peer = peer.node
     resp = await handler(request)
+    if failed_peer is not None and resp.body:
+        # the dead peer's EXPLAIN fragment degrades to a counted partial
+        # on the locally-served answer — the fleet verdict never hangs
+        # on (or silently forgets) a replica that failed mid-route
+        local_body = bytes(resp.body)
+        merged = _fleet_merge_body(state, local_body,
+                                   remote_node=None, partial=1)
+        if merged is not local_body:
+            fresh = web.Response(status=resp.status, body=merged)
+            fresh.headers["Content-Type"] = resp.headers.get(
+                "Content-Type", "application/json"
+            )
+            resp = fresh
     if (cl.replica is not None
             and request.path.startswith("/api/v1/")
             and request.path != "/api/v1/cluster/status"):
@@ -255,6 +308,52 @@ def _cluster_verdict(state: "ServerState") -> dict:
     except Exception:  # noqa: BLE001 — verdict must never fail a query
         pass
     return out
+
+
+def _fleet_merge_body(state: "ServerState", out: bytes,
+                      remote_node: "str | None", partial: int = 0) -> bytes:
+    """Splice the federated `fleet` verdict into a JSON query response
+    carrying an EXPLAIN payload. `remote_node` names the peer whose
+    engine produced the response (read offload); None means this node
+    executed it (local serve / hedged failover). `partial` counts
+    fragments lost to dead peers. Returns `out` UNCHANGED (same object —
+    callers compare identity) when there is no EXPLAIN to merge into or
+    the body isn't parseable; the cheap substring gate keeps the
+    non-EXPLAIN forwarded path at zero parse cost."""
+    cl = state.cluster
+    if cl is None or not out or b'"explain"' not in out:
+        return out
+    from horaedb_tpu import cluster as cluster_mod
+
+    try:
+        body = json.loads(out)
+        explain = body.get("explain") if isinstance(body, dict) else None
+        if not isinstance(explain, dict):
+            return out
+        executed_by = remote_node if remote_node is not None else cl.node_id
+        frags = []
+        frag = cluster_mod.fleet_fragment(executed_by, explain)
+        if frag is None:
+            partial += 1
+        if remote_node is not None:
+            # the origin routed but did not execute: it contributes its
+            # identity + freshness token, so the merged verdict names
+            # BOTH halves of the hop (the scatter-gather shape)
+            origin_frag = cluster_mod.fleet_fragment(
+                cl.node_id, {"cluster": _cluster_verdict(state)}
+            )
+            if origin_frag is not None:
+                frags.append(origin_frag)
+        if frag is not None:
+            frags.append(frag)
+        explain["fleet"] = cluster_mod.fleet_verdict(
+            cl.node_id, frags, partial
+        )
+        return json.dumps(body).encode()
+    except Exception:  # noqa: BLE001 — the merge must never turn a good
+        # answer into a 500; the un-merged body is still correct
+        logger.exception("fleet EXPLAIN merge failed")
+        return out
 
 
 async def _cluster_forward_write(state: "ServerState", request: web.Request,
@@ -1644,7 +1743,11 @@ async def handle_telemetry_scrape(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     if state.telemetry is None:
         return _telemetry_unavailable()
-    summary = await shield_mutation(state.telemetry.tick())
+    # a forced tick also forces a federation sweep (when configured):
+    # the operator probing "is telemetry flowing" means the FLEET view
+    summary = await shield_mutation(
+        state.telemetry.tick(force_federation=True)
+    )
     if summary.get("error"):
         # the background loop retries silently; the FORCED tick is an
         # operator probe, and a probe must not dress a failed write as
@@ -1663,6 +1766,22 @@ async def handle_telemetry_scrape(request: web.Request) -> web.Response:
             for n, k, v in samples if n.startswith(include)
         ]
     return web.json_response({"status": "success", "data": summary})
+
+
+async def handle_telemetry_snapshot(request: web.Request) -> web.Response:
+    """`GET /api/v1/telemetry/snapshot`: the registry's JSON twin of
+    /metrics — [[sample name, [[label, value]...], value]...] — what a
+    peer's federation sweep pulls through the traced client funnel.
+    Served regardless of the local collector (a read-only replica never
+    WRITES its own telemetry, but the fleet still scrapes it)."""
+    state: ServerState = request.app[STATE_KEY]
+    cl = state.cluster
+    node = (cl.node_id if cl is not None
+            else state.config.metric_engine.telemetry.instance)
+    return web.json_response({"status": "success", "data": {
+        "node": node,
+        "samples": METRICS.federation_snapshot(),
+    }})
 
 
 # ---------------------------------------------------------------------------
@@ -1879,6 +1998,32 @@ def _cluster_regions_view(state: "ServerState") -> dict:
     }
 
 
+_BREAKER_STATES = {0: "closed", 1: "half_open", 2: "open"}
+
+
+def _load_view() -> dict:
+    """This node's load in one dict, read ENTIRELY from the metric
+    registry (no reach into admission/resilient internals — the metrics
+    are the stable contract): admission inflight/queued, object-store
+    breaker states, shed totals by reason. Rides the cluster status
+    payload, so peers' probe loops carry every node's load to every
+    /debug/cluster page within one probe interval."""
+    view: dict = {"inflight": 0, "queued": 0, "breakers": {}, "sheds": {}}
+    for family, _type, _sample, key, value in METRICS.snapshot_samples():
+        if family == "horaedb_query_inflight":
+            view["inflight"] = int(value)
+        elif family == "horaedb_query_queued":
+            view["queued"] = int(value)
+        elif family == "horaedb_objstore_breaker_state":
+            store = dict(key).get("store", "?")
+            view["breakers"][store] = _BREAKER_STATES.get(
+                int(value), str(value)
+            )
+        elif family == "horaedb_query_shed_total" and value:
+            view["sheds"][dict(key).get("reason", "?")] = value
+    return view
+
+
 async def handle_cluster_status(request: web.Request) -> web.Response:
     """`/api/v1/cluster/status`: this node's role, per-region ownership +
     manifest epochs, the staleness token (replicas), the assignment-map
@@ -1900,6 +2045,7 @@ async def handle_cluster_status(request: web.Request) -> web.Response:
         "manifest_epoch": state.engine.manifest_epoch(),
         "regions": _cluster_regions_view(state),
         "peers": cl.router.peer_status(),
+        "load": _load_view(),
     }
     if cl.replica is not None:
         st = cl.replica.staleness()
@@ -1922,7 +2068,10 @@ async def handle_cluster_refresh(request: web.Request) -> web.Response:
     """Force one watch probe NOW (admin/debug; smoke gates and tests use
     it instead of waiting out the watch interval). On a replica this
     swaps in any fresh snapshots; on a partial writer it refreshes the
-    non-owned (read-only) region views."""
+    non-owned (read-only) region views. Either way one peer-probe round
+    runs first, so a peer that was down at boot (and got marked
+    unhealthy by the initial probe) rejoins the routable set without
+    waiting out the probe interval."""
     state: ServerState = request.app[STATE_KEY]
     cl = state.cluster
     if cl is None:
@@ -1931,6 +2080,11 @@ async def handle_cluster_refresh(request: web.Request) -> web.Response:
              "error": "cluster layer disabled ([metric_engine.cluster])"},
             status=501,
         )
+    if cl.router.peers:
+        try:
+            await cl.router.probe_once()
+        except Exception:  # noqa: BLE001 — health converges on the loop
+            logger.warning("forced peer probe failed", exc_info=True)
     if cl.replica is not None:
         try:
             outcome = await shield_mutation(cl.replica.watch_once())
@@ -2043,6 +2197,45 @@ async def handle_cluster_takeover(request: web.Request) -> web.Response:
         # role; a restart picks them up under the new ownership
         "restart_recommended": bool(taken) and (state.rules is None),
     }})
+
+
+async def handle_debug_cluster(request: web.Request) -> web.Response:
+    """`GET /debug/cluster`: the fleet on one page — this node's role,
+    epoch, staleness/watch posture and load, plus every peer as the
+    router sees it (health, probe-reported role/epoch/staleness/load)
+    and the telemetry-federation posture. Everything here is already
+    in memory (registry reads + the router's probe cache): rendering
+    the page costs no cluster traffic."""
+    state: ServerState = request.app[STATE_KEY]
+    cl = state.cluster
+    self_view: dict = {
+        "node": (cl.node_id if cl is not None
+                 else state.config.metric_engine.telemetry.instance),
+        "role": cl.role if cl is not None else "standalone",
+        "manifest_epoch": state.engine.manifest_epoch(),
+        "load": _load_view(),
+    }
+    if cl is not None:
+        self_view["standby"] = cl.standby
+        self_view["partial"] = cl.partial
+        if cl.replica is not None:
+            self_view["replica"] = cl.replica.watch_stats()
+    federation = (state.telemetry.federation_status()
+                  if state.telemetry is not None else {"enabled": False})
+    data = {
+        "enabled": cl is not None,
+        "self": self_view,
+        "peers": cl.router.peer_detail() if cl is not None else {},
+        "federation": federation,
+    }
+    if cl is not None and cl.router.assignment is not None:
+        asg = cl.router.assignment
+        data["assignment"] = {
+            "version": asg.version,
+            "regions": {str(r): n
+                        for r, n in sorted(asg.regions.items())},
+        }
+    return web.json_response({"status": "success", "data": data})
 
 
 # ---------------------------------------------------------------------------
@@ -2363,7 +2556,17 @@ async def build_app(config: Config, store=None) -> web.Application:
             exclude=tuple(tcfg.exclude),
             retention_ms=tcfg.retention_ms(),
             instance=tcfg.instance,
+            # fleet federation: pull peers' snapshots through the cluster
+            # router's traced client funnel (no cluster layer, no fleet)
+            federation=tcfg.federation,
+            router=(cluster_state.router
+                    if cluster_state is not None else None),
         )
+        if tcfg.federation.enabled and cluster_state is None:
+            logger.warning(
+                "[metric_engine.telemetry.federation] enabled without the "
+                "cluster layer; there are no peers to scrape"
+            )
     state = ServerState(config, storage, engine, parser_pool=pool,
                         slowlog=slow, admission_controller=adm,
                         rules=rules_engine, telemetry=collector,
@@ -2471,12 +2674,14 @@ async def build_app(config: Config, store=None) -> web.Application:
             web.post("/api/v1/cluster/refresh", handle_cluster_refresh),
             web.post("/api/v1/cluster/takeover", handle_cluster_takeover),
             web.post("/api/v1/telemetry/scrape", handle_telemetry_scrape),
+            web.get("/api/v1/telemetry/snapshot", handle_telemetry_snapshot),
             web.post("/api/v1/admin/tsdb/delete_series", handle_delete_series),
             web.get("/api/v1/status/buildinfo", handle_buildinfo),
             web.get("/debug/traces", handle_debug_traces),
             web.get("/debug/traces/{id}", handle_debug_trace),
             web.get("/debug/kernels", handle_debug_kernels),
             web.get("/debug/slowlog", handle_debug_slowlog),
+            web.get("/debug/cluster", handle_debug_cluster),
         ]
     )
 

@@ -434,6 +434,18 @@ class Metrics:
                             float(value)))
         return out
 
+    def federation_snapshot(self) -> list:
+        """The JSON-serializable wire shape of snapshot_samples for
+        `GET /api/v1/telemetry/snapshot` — what a fleet-telemetry peer
+        pulls: [[sample_name, [[label, value]...], value]...]. Family
+        and type drop out (the puller relabels and writes through its
+        own ingest path; the text exposition keeps the typed view)."""
+        return [
+            [sample, [[k, v] for k, v in key], value]
+            for _family, _type, sample, key, value in
+            self.snapshot_samples()
+        ]
+
     # -- rendering -----------------------------------------------------------
     def render(self) -> str:
         lines = [

@@ -200,20 +200,38 @@ class SlowLog:
             return len(self._index)
 
 
+def _span_nodes(span: dict, out: set) -> None:
+    attrs = span.get("attrs")
+    if isinstance(attrs, dict) and attrs.get("node"):
+        out.add(str(attrs["node"]))
+    for child in span.get("children") or ():
+        if isinstance(child, dict):
+            _span_nodes(child, out)
+
+
 def build_entry(trace_dict: dict, explain: dict | None) -> dict:
     """The spooled payload for one slow request: the full span tree (whose
     root attrs carry the scanstats stages) plus the EXPLAIN plan. The
     plan also sits in the trace ROOT's attrs (the handler attached it
     there for /debug/traces); drop that copy — it is byte-identical to
-    the top-level `explain` and would double the spool size."""
+    the top-level `explain` and would double the spool size.
+
+    `nodes` lists the peer nodes whose grafted span subtrees appear in
+    the tree (cross-node traces: router funnel spans and remote spans
+    both carry a `node` attr) — "was this slow request slow because of
+    a forward" is answerable from the listing without opening the tree."""
     root = trace_dict.get("root")
     if isinstance(root, dict) and isinstance(root.get("attrs"), dict):
         root["attrs"].pop("explain", None)
+    nodes: set = set()
+    if isinstance(root, dict):
+        _span_nodes(root, nodes)
     return {
         "trace_id": trace_dict.get("trace_id"),
         "name": trace_dict.get("name"),
         "duration_s": trace_dict.get("duration_s"),
         "recorded_unix_ms": int(time.time() * 1000),
+        "nodes": sorted(nodes),
         "explain": explain,
         "trace": trace_dict,
     }

@@ -41,8 +41,9 @@ from horaedb_tpu.telemetry.metering import FIELDS, GLOBAL_METER, UsageMeter
 from horaedb_tpu.telemetry.slo import SloSpec, expand_slo, expand_slos
 
 __all__ = [
-    "TelemetryConfig", "SelfScrapeCollector", "UsageMeter", "GLOBAL_METER",
-    "FIELDS", "SloSpec", "expand_slo", "expand_slos", "telemetry_enabled",
+    "TelemetryConfig", "FederationConfig", "SelfScrapeCollector",
+    "UsageMeter", "GLOBAL_METER", "FIELDS", "SloSpec", "expand_slo",
+    "expand_slos", "telemetry_enabled",
 ]
 
 # the exemplar wiring (module docstring): one injection, process-wide
@@ -56,6 +57,37 @@ def telemetry_enabled(config_enabled: bool = True) -> bool:
     if env in ("off", "0", "false", "no"):
         return False
     return bool(config_enabled)
+
+
+@dataclass
+class FederationConfig:
+    """`[metric_engine.telemetry.federation]` — fleet telemetry pulls.
+
+    With `enabled = true` on a node that runs the collector AND the
+    cluster layer, each federation sweep pulls every healthy peer's
+    registry snapshot (`GET /api/v1/telemetry/snapshot`, through the
+    router's traced client funnel) and writes it into the local
+    `_system` tenant with an `instance = "<peer node>"` label — one
+    node's PromQL sees the whole fleet's `horaedb_*` history. Budgeted
+    separately from the self-scrape (`max_series` below) so a noisy
+    peer can never starve local self-observability."""
+
+    enabled: bool = False
+    # peer-pull spacing; independent of the self-scrape interval (a
+    # forced POST /api/v1/telemetry/scrape also forces a sweep)
+    scrape_interval: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(30)
+    )
+    # per-request timeout for one peer snapshot pull
+    timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(5)
+    )
+    # fleet-wide budget: distinct federated series (across ALL peers)
+    # this collector may create; existing series keep flowing at the cap
+    max_series: int = 16384
+    # family-name prefixes to skip in PEER snapshots (on top of the
+    # collector's own exclude list)
+    exclude: list = field(default_factory=list)
 
 
 @dataclass
@@ -82,6 +114,8 @@ class TelemetryConfig:
     exclude: list = field(default_factory=list)
     # self-series horizon (tombstone sweep); None/0s keeps forever
     retention: ReadableDuration | None = None
+    # fleet federation: pull peers' registry snapshots into `_system`
+    federation: FederationConfig = field(default_factory=FederationConfig)
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "TelemetryConfig":

@@ -43,6 +43,8 @@ sweep, independent of the table-wide retention knob.
 
 from __future__ import annotations
 
+import asyncio
+import json
 import logging
 import time
 
@@ -52,7 +54,11 @@ from horaedb_tpu.telemetry.metering import GLOBAL_METER
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["SelfScrapeCollector"]
+__all__ = ["SelfScrapeCollector", "SNAPSHOT_PATH"]
+
+# the wire endpoint a federation sweep pulls from each peer (the JSON
+# twin of /metrics: [[sample name, [[label, value]...], value]...])
+SNAPSHOT_PATH = "/api/v1/telemetry/snapshot"
 
 TELEMETRY_TICKS = GLOBAL_METRICS.counter(
     "horaedb_telemetry_ticks_total",
@@ -87,6 +93,14 @@ TELEMETRY_RETENTION_SWEEPS = GLOBAL_METRICS.counter(
     help="Self-telemetry retention sweeps (tombstone deletes of "
          "self-series older than the configured horizon).",
 )
+TELEMETRY_PEER_SCRAPES = GLOBAL_METRICS.counter(
+    "horaedb_telemetry_peer_scrapes_total",
+    help="Fleet-telemetry federation pulls of peers' registry snapshots, "
+         "by peer and result: ok (snapshot written under the peer's "
+         "instance label), error (non-200 / malformed snapshot), "
+         "unreachable (transport failure).",
+    labelnames=("peer", "result"),
+)
 for _r in ("ok", "error"):
     TELEMETRY_TICKS.labels(_r)
 del _r
@@ -109,6 +123,8 @@ class SelfScrapeCollector:
         instance: str = "self",
         clock=wall_now_ms,
         meter=GLOBAL_METER,
+        federation=None,
+        router=None,
     ):
         self._engine = engine
         self._registry = registry
@@ -131,6 +147,15 @@ class SelfScrapeCollector:
         self._written_names: set[str] = set()
         self._last_sweep_ms: int = 0
         self._swept_hi_ms: int = 0
+        # fleet federation (telemetry.FederationConfig + the cluster
+        # router's traced client funnel); None on single-node deployments
+        self._federation = federation
+        self._router = router
+        self._fed_series: set = set()
+        self._fed_budget_logged = False
+        self._last_fed_ms: int = 0
+        # (__name__, peer node) pairs the sweep tombstones per instance
+        self._fed_written: "set[tuple[str, str]]" = set()
 
     # -- snapshot -> samples --------------------------------------------------
     def snapshot(self) -> tuple[int, list[tuple[str, tuple, float]]]:
@@ -148,24 +173,32 @@ class SelfScrapeCollector:
             out.append((sample, key, value))
         return len(families), out
 
-    def _budgeted(self, samples: list) -> tuple[list, list, int]:
-        """Apply the series budget: samples on already-known series
-        always pass; new series admit only under max_series. New keys
-        are STAGED, not committed — the tick commits them only after
-        the engine accepted the write, so a failed/degraded write never
-        leaves phantom entries consuming the budget."""
+    @staticmethod
+    def _admit(samples: list, series: set,
+               max_series: int) -> tuple[list, list, int]:
+        """The staged-commit series budget, shared by the self-scrape
+        and the federation sweep (each against its OWN series set and
+        cap): samples on already-known series always pass; new series
+        admit only under max_series. New keys are STAGED, not committed
+        — the caller commits them only after the engine accepted the
+        write, so a failed/degraded write never leaves phantom entries
+        consuming the budget."""
         kept, dropped = [], 0
         staged: set = set()
         for name, key, value in samples:
             skey = (name, key)
-            if skey not in self._series and skey not in staged:
-                if self.max_series and (
-                    len(self._series) + len(staged) >= self.max_series
-                ):
+            if skey not in series and skey not in staged:
+                if max_series and len(series) + len(staged) >= max_series:
                     dropped += 1
                     continue
                 staged.add(skey)
             kept.append((name, key, value))
+        return kept, sorted(staged), dropped
+
+    def _budgeted(self, samples: list) -> tuple[list, list, int]:
+        kept, staged, dropped = self._admit(
+            samples, self._series, self.max_series
+        )
         if dropped:
             TELEMETRY_DROPPED.inc(dropped)
             if not self._budget_logged:
@@ -201,8 +234,139 @@ class SelfScrapeCollector:
             smp.value = float(value)
         return req.SerializeToString()
 
+    # -- federation (fleet telemetry) -----------------------------------------
+    def federation_status(self) -> dict:
+        """The /debug/cluster federation row."""
+        fed = self._federation
+        if fed is None or not fed.enabled or self._router is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "series": len(self._fed_series),
+            "max_series": fed.max_series,
+            "scrape_interval_s": fed.scrape_interval.seconds,
+        }
+
+    def _federation_due(self, now_ms: int, force: bool = False) -> bool:
+        fed = self._federation
+        if fed is None or not fed.enabled or self._router is None:
+            return False
+        if force:
+            return True
+        return now_ms - self._last_fed_ms >= fed.scrape_interval.as_millis()
+
+    def _peer_triples(self, node: str, status: int, body: bytes,
+                      exclude: tuple) -> "list | None":
+        """Parse one peer's snapshot answer into the (__name__, label
+        items, value) triples `_payload` expects — every series relabeled
+        `instance=<peer node>` (any instance the peer claimed for itself
+        is OVERRIDDEN: the federation's instance axis is the scraper's
+        peer table, never a remote string). None = malformed/non-200."""
+        if status != 200:
+            return None
+        try:
+            samples = (json.loads(body).get("data") or {}).get("samples")
+            triples = []
+            for name, key, value in samples:
+                name = str(name)
+                if any(name.startswith(p) for p in exclude):
+                    continue
+                items = tuple(sorted(
+                    [(str(k), str(v)) for k, v in key
+                     if str(k) != "instance"]
+                    + [("instance", node)]
+                ))
+                triples.append((name, items, float(value)))
+            return triples
+        except (TypeError, ValueError, AttributeError):
+            return None
+
+    async def scrape_peers(self, ts_ms: "int | None" = None) -> dict:
+        """One federation sweep: pull every healthy peer's registry
+        snapshot through the router's traced client funnel and write it
+        under instance="<peer>". Per-peer failures are counted and
+        skipped — a dead peer degrades the fleet view, never the sweep.
+        Returns {peers: {node: ok|error|unreachable}, written, dropped}."""
+        from horaedb_tpu.ingest.cardinality import CardinalityLimited
+
+        fed, router = self._federation, self._router
+        summary: dict = {"peers": {}, "written": 0, "dropped": 0}
+        if fed is None or not fed.enabled or router is None:
+            return summary
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(total=fed.timeout.seconds)
+        ts = int(ts_ms if ts_ms is not None else self._clock())
+        exclude = self.exclude + tuple(str(p) for p in fed.exclude)
+        for node in sorted(router.peers):
+            url = router.peer_url(node)
+            if url is None or not router.is_healthy(node):
+                continue
+            try:
+                status, _h, out = await router.traced_request(
+                    node, "GET", url.rstrip("/") + SNAPSHOT_PATH,
+                    kind="telemetry", timeout=timeout,
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — unreachable peer
+                TELEMETRY_PEER_SCRAPES.labels(node, "unreachable").inc()
+                summary["peers"][node] = "unreachable"
+                continue
+            triples = self._peer_triples(node, status, out, exclude)
+            if triples is None:
+                TELEMETRY_PEER_SCRAPES.labels(node, "error").inc()
+                summary["peers"][node] = "error"
+                continue
+            kept, staged, dropped = self._admit(
+                triples, self._fed_series, fed.max_series
+            )
+            if dropped:
+                TELEMETRY_DROPPED.inc(dropped)
+                if not self._fed_budget_logged:
+                    self._fed_budget_logged = True
+                    logger.warning(
+                        "fleet-telemetry series budget (%d) exhausted; "
+                        "%d new series from peer %s dropped (existing "
+                        "series keep flowing; raise [metric_engine."
+                        "telemetry.federation] max_series or extend its "
+                        "exclude list)", fed.max_series, dropped, node,
+                    )
+            written = 0
+            try:
+                if kept:
+                    try:
+                        written = await self._engine.write_payload(
+                            self._payload(kept, ts)
+                        )
+                        self._fed_series.update(staged)
+                    except CardinalityLimited as e:
+                        # same staged-commit contract as the self-scrape
+                        written = e.accepted_samples
+                        self._meter.account(
+                            self.tenant,
+                            samples_rejected=e.rejected_samples,
+                        )
+                    self._meter.account(self.tenant, rows_ingested=written)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — local write failed
+                TELEMETRY_PEER_SCRAPES.labels(node, "error").inc()
+                summary["peers"][node] = "error"
+                logger.warning("federated scrape of peer %s failed to "
+                               "land; next sweep retries", node,
+                               exc_info=True)
+                continue
+            for name, _k, _v in kept:
+                self._fed_written.add((name, node))
+            TELEMETRY_PEER_SCRAPES.labels(node, "ok").inc()
+            summary["peers"][node] = "ok"
+            summary["written"] += written
+            summary["dropped"] += dropped
+        return summary
+
     # -- the tick -------------------------------------------------------------
-    async def tick(self) -> dict:
+    async def tick(self, force_federation: bool = False) -> dict:
         """One scrape: snapshot, budget, write, meter. Returns the tick
         summary INCLUDING the written samples (the property tests' and
         smoke gate's bit-equality oracle)."""
@@ -271,6 +435,19 @@ class SelfScrapeCollector:
         TELEMETRY_SAMPLES.inc(len(kept))
         TELEMETRY_SCRAPE_SECONDS.observe(time.perf_counter() - t0)
         summary["samples_list"] = kept
+        if self._federation_due(ts_ms, force=force_federation):
+            # federation rides the tick but is isolated from its
+            # verdict, like the sweep: a dead fleet must not mark a
+            # LANDED self-scrape as a failed tick
+            self._last_fed_ms = ts_ms
+            try:
+                summary["federation"] = await self.scrape_peers(ts_ms)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — housekeeping only
+                logger.warning("federation sweep failed; next due sweep "
+                               "retries", exc_info=True)
+                summary["federation"] = {"error": True}
         return summary
 
     async def _maybe_sweep(self, now_ms: int) -> None:
@@ -282,7 +459,9 @@ class SelfScrapeCollector:
         and each sweep covers only the (prev horizon, horizon) delta, so
         a long-lived server never re-tombstones already-swept ranges
         (tombstones and invalidation-funnel events both cost)."""
-        if self.retention_ms is None or not self._written_names:
+        if self.retention_ms is None or not (
+            self._written_names or self._fed_written
+        ):
             return
         spacing = max(self.retention_ms // 8, 60_000)
         if now_ms - self._last_sweep_ms < spacing:
@@ -296,6 +475,14 @@ class SelfScrapeCollector:
             await self._engine.delete_series(
                 name.encode(),
                 filters=[(b"instance", self.instance.encode())],
+                start_ms=start, end_ms=horizon,
+            )
+        # federated series carry the PEER's instance label; sweep each
+        # under its own filter so another agent's same-named data stays
+        for name, inst in sorted(self._fed_written):
+            await self._engine.delete_series(
+                name.encode(),
+                filters=[(b"instance", inst.encode())],
                 start_ms=start, end_ms=horizon,
             )
         self._swept_hi_ms = horizon

@@ -1,0 +1,383 @@
+"""Fleet observability chaos lane: trace-tree integrity and
+federated-EXPLAIN exactness under the cluster's failure modes.
+
+Two (or three) REAL servers (build_app + AppRunner on pre-picked ports,
+one shared Local store) per scenario:
+
+- write-forward: a replica-forwarded write yields ONE stitched trace —
+  the client-visible X-Horaedb-Trace-Id resolves at /debug/traces/{id}
+  to a tree whose span count equals its reachable-node count (zero
+  orphans) and which carries the writer's node-labeled subtree (the
+  ISSUE 17 satellite-1 regression);
+- split-write: a partial-writer write (pre-seeded assignment splits the
+  regions across two writers) keeps local + forwarded subsets under one
+  trace, with the co-owner's grafted spans;
+- hedged failover: a replica that dies after being probed healthy
+  degrades the offloaded read to a LOCAL answer whose fleet verdict
+  counts the dead fragment (`partial` >= 1) — bounded, never a hang;
+- mid-flight writer kill: a forward to a dead writer fails fast with a
+  503 whose trace is still a complete, orphan-free tree;
+- federation sweep over a dead peer: counted `unreachable`, the tick's
+  self-scrape still lands;
+- probe observability: `horaedb_cluster_probe_seconds{peer,outcome}`
+  moves on a forced probe round (satellite 2).
+
+The healthy-path assertions (stitched trace + fleet verdict + instance
+relabeling over real S3 wire) live in tools/cluster_smoke.py.
+"""
+
+import socket
+
+import pytest
+from aiohttp import ClientSession, ClientTimeout
+from aiohttp import web
+
+from horaedb_tpu.common import tracing
+from horaedb_tpu.server.config import Config
+from horaedb_tpu.server.main import build_app
+from tests.conftest import async_test
+from tests.test_flush_pipeline import make_remote_write
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracing():
+    tracing.configure(sample=1.0, slow_s=3600.0, ring=256)
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+def free_ports(n: int) -> list:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def node_cfg(data_dir: str, port: int, node: str, role: str,
+             peers: list, num_regions: int = 1,
+             telemetry: "dict | None" = None) -> Config:
+    return Config.from_dict({
+        "port": port,
+        "metric_engine": {
+            "node_id": node,
+            "num_regions": num_regions,
+            "rules": {"enabled": False},
+            "telemetry": telemetry or {"enabled": False},
+            "storage": {"object_store": {"type": "Local",
+                                         "data_dir": data_dir}},
+            "cluster": {
+                "enabled": True,
+                "role": role,
+                "watch_interval": "30s",   # forced refresh drives probes
+                "probe_interval": "30s",   # so nothing moves behind tests
+                "self_url": f"http://127.0.0.1:{port}",
+                "peers": peers,
+            },
+        },
+    })
+
+
+async def boot(config: Config):
+    app = await build_app(config)
+    # bounded shutdown: a peer router's keep-alive connection must not
+    # stall cleanup for the default 60s graceful-shutdown window
+    runner = web.AppRunner(app, handler_cancellation=True,
+                           shutdown_timeout=1.0)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", config.port)
+    await site.start()
+    return runner
+
+
+def peer(node: str, port: int, role: str) -> dict:
+    return {"node": node, "url": f"http://127.0.0.1:{port}", "role": role}
+
+
+def payload(hosts: list, metric: str = "obs") -> bytes:
+    return make_remote_write([
+        ({"__name__": metric, "host": h}, [(1000, 1.0)]) for h in hosts
+    ])
+
+
+def walk(span: dict, spans: list, nodes: set) -> None:
+    """Collect span names and the node labels of GRAFTED remote spans.
+    The funnel's own `cluster_*` client span also carries a `node` attr
+    (it names the TARGET) — only non-funnel names prove a peer actually
+    shipped its subtree back."""
+    spans.append(span["name"])
+    if ((span.get("attrs") or {}).get("node")
+            and not span["name"].startswith("cluster_")):
+        nodes.add(span["attrs"]["node"])
+    for child in span.get("children") or []:
+        walk(child, spans, nodes)
+
+
+def assert_tree_integrity(tree: dict, trace_id: str) -> set:
+    """Every recorded span is reachable from the single root — the
+    zero-orphans acceptance bar — and the tree answers under the
+    client-visible id. Returns the node labels seen."""
+    assert tree is not None and tree["trace_id"] == trace_id
+    spans: list = []
+    nodes: set = set()
+    assert tree["root"] is not None
+    walk(tree["root"], spans, nodes)
+    assert len(spans) == tree["spans"], (
+        f"orphan spans: walked {len(spans)} of {tree['spans']} "
+        f"({spans})"
+    )
+    return nodes
+
+
+class TestForwardedWriteTrace:
+    @async_test
+    async def test_forwarded_write_stitches_one_two_node_trace(
+            self, tmp_path):
+        wport, rport = free_ports(2)
+        data = str(tmp_path / "data")
+        wrun = await boot(node_cfg(data, wport, "w1", "writer",
+                                   [peer("r1", rport, "replica")]))
+        rrun = await boot(node_cfg(data, rport, "r1", "replica",
+                                   [peer("w1", wport, "writer")]))
+        try:
+            async with ClientSession(
+                    timeout=ClientTimeout(total=30)) as s:
+                async with s.post(
+                        f"http://127.0.0.1:{rport}/api/v1/write",
+                        data=payload(["a", "b"])) as r:
+                    assert r.status == 200
+                    assert (await r.json())["samples"] == 2
+                    tid = r.headers.get("X-Horaedb-Trace-Id")
+                # the client-visible id resolves end-to-end: the
+                # forwarded hop did NOT mint a second trace
+                assert tid and tracing.valid_trace_id(tid)
+                async with s.get(
+                        f"http://127.0.0.1:{rport}/debug/traces/{tid}"
+                ) as r:
+                    assert r.status == 200
+                    tree = await r.json()
+            nodes = assert_tree_integrity(tree, tid)
+            assert nodes == {"w1"}, (
+                f"expected the writer's grafted subtree, saw {nodes}")
+            # the graft hangs under the funnel's client span
+            spans: list = []
+            walk(tree["root"], spans, set())
+            assert "cluster_write" in spans
+        finally:
+            await rrun.cleanup()
+            await wrun.cleanup()
+
+    @async_test
+    async def test_probe_seconds_moves_on_forced_round(self, tmp_path):
+        """Satellite 2: peer probes ride the traced funnel and time into
+        horaedb_cluster_probe_seconds{peer,outcome}."""
+        wport, rport = free_ports(2)
+        data = str(tmp_path / "data")
+        wrun = await boot(node_cfg(data, wport, "w1", "writer",
+                                   [peer("r1", rport, "replica")]))
+        rrun = await boot(node_cfg(data, rport, "r1", "replica",
+                                   [peer("w1", wport, "writer")]))
+        try:
+            async with ClientSession(
+                    timeout=ClientTimeout(total=30)) as s:
+                async with s.post(
+                        f"http://127.0.0.1:{wport}"
+                        "/api/v1/cluster/refresh") as r:
+                    assert r.status == 200
+                async with s.get(
+                        f"http://127.0.0.1:{wport}/metrics") as r:
+                    text = await r.text()
+            probe_ok = [
+                ln for ln in text.splitlines()
+                if ln.startswith("horaedb_cluster_probe_seconds_count")
+                and 'peer="r1"' in ln and 'outcome="ok"' in ln
+            ]
+            assert probe_ok, "no ok-outcome probe sample for r1"
+            assert float(probe_ok[0].rsplit(" ", 1)[1]) >= 1
+        finally:
+            await rrun.cleanup()
+            await wrun.cleanup()
+
+
+class TestSplitWriteTrace:
+    @async_test
+    async def test_split_write_keeps_one_trace_across_owners(
+            self, tmp_path):
+        """Assignment pre-seeded {0: w1, 1: w2}: both boot as PARTIAL
+        writers, and a batch spanning both regions submitted to w1
+        lands local + forwarded subsets under ONE orphan-free trace
+        carrying w2's grafted spans."""
+        from horaedb_tpu.cluster import assignment as asg_mod
+        from horaedb_tpu.objstore import LocalStore
+
+        w1port, w2port = free_ports(2)
+        data = str(tmp_path / "data")
+        await asg_mod.propose_assignment(
+            LocalStore(data), "metrics/cluster", "test-seed",
+            lambda regions: {0: "w1", 1: "w2"},
+        )
+        w1run = await boot(node_cfg(data, w1port, "w1", "writer",
+                                    [peer("w2", w2port, "writer")],
+                                    num_regions=2))
+        w2run = await boot(node_cfg(data, w2port, "w2", "writer",
+                                    [peer("w1", w1port, "writer")],
+                                    num_regions=2))
+        try:
+            hosts = [f"h{i:02d}" for i in range(16)]
+            async with ClientSession(
+                    timeout=ClientTimeout(total=30)) as s:
+                async with s.post(
+                        f"http://127.0.0.1:{w1port}/api/v1/write",
+                        data=payload(hosts)) as r:
+                    assert r.status == 200
+                    assert (await r.json())["samples"] == len(hosts)
+                    tid = r.headers.get("X-Horaedb-Trace-Id")
+                assert tid
+                async with s.get(
+                        f"http://127.0.0.1:{w1port}/debug/traces/{tid}"
+                ) as r:
+                    assert r.status == 200
+                    tree = await r.json()
+            nodes = assert_tree_integrity(tree, tid)
+            # 16 distinct series over 2 hash-partitioned regions: the
+            # non-owned subset forwarded to w2 inside the same trace
+            assert nodes == {"w2"}, (
+                f"expected w2's grafted subset spans, saw {nodes}")
+        finally:
+            await w2run.cleanup()
+            await w1run.cleanup()
+
+
+class TestHedgedFailoverChaos:
+    @async_test
+    async def test_dead_replica_degrades_to_counted_partial(
+            self, tmp_path):
+        """Kill the probed-healthy replica, then query the writer with
+        EXPLAIN: hedged failover answers LOCALLY (bounded — connection
+        refused, not a hang) and the fleet verdict counts the lost
+        fragment instead of silently forgetting the peer."""
+        wport, rport = free_ports(2)
+        data = str(tmp_path / "data")
+        wrun = await boot(node_cfg(data, wport, "w1", "writer",
+                                   [peer("r1", rport, "replica")]))
+        rrun = await boot(node_cfg(data, rport, "r1", "replica",
+                                   [peer("w1", wport, "writer")]))
+        killed = False
+        try:
+            async with ClientSession(
+                    timeout=ClientTimeout(total=30)) as s:
+                base = f"http://127.0.0.1:{wport}"
+                async with s.post(f"{base}/api/v1/write",
+                                  data=payload(["a", "b", "c"])) as r:
+                    assert r.status == 200
+                # probe marks r1 healthy, then the replica dies
+                async with s.post(f"{base}/api/v1/cluster/refresh") as r:
+                    assert r.status == 200
+                await rrun.cleanup()
+                killed = True
+                async with s.post(f"{base}/api/v1/query", json={
+                    "metric": "obs", "start_ms": 0, "end_ms": 10**9,
+                    "explain": 1,
+                }) as r:
+                    assert r.status == 200
+                    body = await r.json()
+                    tid = r.headers.get("X-Horaedb-Trace-Id")
+                assert body["rows"] == 3
+                fleet = body["explain"]["fleet"]
+                assert fleet["origin"] == "w1"
+                assert fleet["partial"] >= 1
+                # the locally-executed fragment is still present and
+                # max-staleness stays exact over what DID answer
+                nodes = {f["node"] for f in fleet["nodes"]}
+                assert nodes == {"w1"}
+                assert fleet["staleness_ms"] == max(
+                    f.get("staleness_ms", 0.0) for f in fleet["nodes"])
+                # the failed hop's trace is still one orphan-free tree
+                async with s.get(f"{base}/debug/traces/{tid}") as r:
+                    assert r.status == 200
+                    assert_tree_integrity(await r.json(), tid)
+                async with s.get(f"{base}/metrics") as r:
+                    text = await r.text()
+            partials = [
+                ln for ln in text.splitlines()
+                if ln.startswith("horaedb_cluster_fleet_partials_total ")
+            ]
+            assert partials and float(partials[0].rsplit(" ", 1)[1]) >= 1
+        finally:
+            if not killed:
+                await rrun.cleanup()
+            await wrun.cleanup()
+
+    @async_test
+    async def test_forward_to_dead_writer_fails_fast_with_full_trace(
+            self, tmp_path):
+        """Mid-flight writer kill: the replica's forward hits a dead
+        socket — a bounded 503 whose trace still closes cleanly (the
+        funnel span records the failure; nothing dangles)."""
+        wport, rport = free_ports(2)
+        data = str(tmp_path / "data")
+        wrun = await boot(node_cfg(data, wport, "w1", "writer",
+                                   [peer("r1", rport, "replica")]))
+        rrun = await boot(node_cfg(data, rport, "r1", "replica",
+                                   [peer("w1", wport, "writer")]))
+        try:
+            async with ClientSession(
+                    timeout=ClientTimeout(total=30)) as s:
+                rbase = f"http://127.0.0.1:{rport}"
+                async with s.post(f"{rbase}/api/v1/cluster/refresh") as r:
+                    assert r.status == 200
+                await wrun.cleanup()
+                async with s.post(f"{rbase}/api/v1/write",
+                                  data=payload(["a"])) as r:
+                    assert r.status == 503
+                    tid = r.headers.get("X-Horaedb-Trace-Id")
+                assert tid
+                async with s.get(f"{rbase}/debug/traces/{tid}") as r:
+                    assert r.status == 200
+                    tree = await r.json()
+            nodes = assert_tree_integrity(tree, tid)
+            assert nodes == set()  # no writer half: nothing shipped back
+            spans: list = []
+            walk(tree["root"], spans, set())
+            assert "cluster_write" in spans
+        finally:
+            await rrun.cleanup()
+
+
+class TestFederationChaos:
+    @async_test
+    async def test_dead_peer_scrape_counts_unreachable(self, tmp_path):
+        """The federation sweep over a probed-healthy-then-killed peer
+        records `unreachable` and keeps the tick's self-scrape verdict
+        clean — a dead fleet never fails local observability."""
+        wport, rport = free_ports(2)
+        data = str(tmp_path / "data")
+        wrun = await boot(node_cfg(
+            data, wport, "w1", "writer", [peer("r1", rport, "replica")],
+            telemetry={"enabled": True, "scrape_interval": "1h",
+                       "federation": {"enabled": True,
+                                      "scrape_interval": "1h",
+                                      "timeout": "2s"}}))
+        rrun = await boot(node_cfg(data, rport, "r1", "replica",
+                                   [peer("w1", wport, "writer")]))
+        try:
+            async with ClientSession(
+                    timeout=ClientTimeout(total=60)) as s:
+                base = f"http://127.0.0.1:{wport}"
+                async with s.post(f"{base}/api/v1/cluster/refresh") as r:
+                    assert r.status == 200
+                await rrun.cleanup()
+                async with s.post(f"{base}/api/v1/telemetry/scrape") as r:
+                    assert r.status == 200
+                    data_out = (await r.json())["data"]
+            assert data_out.get("written", 0) > 0  # self-scrape landed
+            fed = data_out["federation"]
+            assert fed["peers"] == {"r1": "unreachable"}
+            assert fed["written"] == 0
+        finally:
+            await wrun.cleanup()

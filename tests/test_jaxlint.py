@@ -1186,3 +1186,95 @@ class TestJ017ClusterFunnel:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ022TracedClientFunnel:
+    """J022: outbound cluster-tier HTTP — session construction and verb
+    calls on session-named receivers — belongs in the router's
+    traced_request funnel (cluster/router.py is exempt: it IS it)."""
+
+    def seeded(self, tmp_path, body, rel="cluster/sync.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_session_construction_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import aiohttp\n"
+            "def connect():\n"
+            "    return aiohttp.ClientSession()\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J022" in r.stdout and "traced" in r.stdout
+
+    def test_verb_on_session_receiver_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def ping(session, url):\n"
+            "    async with session.post(url, data=b'x') as resp:\n"
+            "        return resp.status\n",
+            rel="server/prober.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J022" in r.stdout and "traced_request" in r.stdout
+
+    def test_self_session_attribute_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "class C:\n"
+            "    async def fetch(self, url):\n"
+            "        async with self._session.get(url) as resp:\n"
+            "            return await resp.read()\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J022" in r.stdout
+
+    def test_router_module_exempt(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import aiohttp\n"
+            "class R:\n"
+            "    async def _ensure(self):\n"
+            "        self._session = aiohttp.ClientSession()\n"
+            "        return self._session\n",
+            rel="cluster/router.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_outside_scope_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import aiohttp\n"
+            "def connect():\n"
+            "    return aiohttp.ClientSession()\n",
+            rel="objstore/s3like.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_unrelated_get_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def role(request, d):\n"
+            "    return request.query.get('role') or d.get('role')\n",
+            rel="server/views.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import aiohttp\n"
+            "def connect():\n"
+            "    # jaxlint: disable=J022 bootstrap probe before the router exists\n"
+            "    return aiohttp.ClientSession()\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

@@ -297,6 +297,17 @@ class TestPromcheckValidator:
         bad = "# TYPE c counter\nc 1\nc 2\n"
         assert any("duplicate sample" in e for e in promcheck.validate(bad))
 
+    def test_detects_reserved_instance_label(self):
+        # `instance` is the federation's scrape-time axis — a family
+        # exposing it itself would collide with write-time relabeling
+        bad = '# TYPE c counter\nc{instance="n1"} 1\n'
+        assert any("reserved label" in e for e in promcheck.validate(bad))
+
+    def test_detects_reserved_instance_label_openmetrics(self):
+        bad = ('# TYPE c counter\nc_total{instance="n1"} 1\n# EOF\n')
+        assert any("reserved label" in e
+                   for e in promcheck.validate_openmetrics(bad))
+
     def test_accepts_full_registry_output(self):
         m = Metrics()
         m.counter("a_total", help="with help \\ and\nnewline").inc()

@@ -499,7 +499,7 @@ data_dir = "{tmp_path}/data"
         await client.start_server()
         try:
             class _FailingCollector:
-                async def tick(self):
+                async def tick(self, force_federation=False):
                     return {"error": True, "written": 0}
 
             app[STATE_KEY].telemetry = _FailingCollector()

@@ -244,3 +244,159 @@ class TestScanstatsBridge:
         out = GLOBAL_METRICS.render()
         for lane in ("io_decode", "host_prep", "transfer", "kernel"):
             assert f'horaedb_scan_stage_seconds_bucket{{stage="{lane}"' in out
+
+
+class TestRemoteContext:
+    """Cross-node context adoption (the fleet-observability funnel):
+    a forwarded request's callee joins the ORIGIN's trace id instead of
+    minting a fresh one, so /debug/traces/{id} answers with one tree."""
+
+    def test_adoption_uses_remote_id_and_bypasses_sampler(self):
+        # sampling OFF locally: the origin's decision travels with the
+        # headers — it only sent them because IT sampled
+        tracing.configure(sample=0.0, slow_s=3600.0, ring=256)
+        rid = "ab" * 8
+        with tracing.trace("callee", remote_id=rid, remote_parent=7) as t:
+            assert t is not None
+            assert t.trace_id == rid
+            with tracing.span("work"):
+                pass
+        got = tracing.get(rid)
+        assert got is not None
+        assert got["root"]["attrs"]["remote_parent"] == 7
+        assert got["spans"] == 2
+
+    def test_malformed_remote_id_is_ignored(self):
+        tracing.configure(sample=0.0, slow_s=3600.0, ring=256)
+        for bad in ("ZZZZZZZZ", "short", "a" * 65, "", None):
+            with tracing.trace("callee", remote_id=bad) as t:
+                # unsampled + no adoptable id: normal local sampling
+                assert t is None
+
+    def test_malformed_remote_id_with_sampling_mints_local(self):
+        with tracing.trace("callee", remote_id="not-hex!") as t:
+            assert t is not None
+            assert t.trace_id != "not-hex!"
+            assert tracing.valid_trace_id(t.trace_id)
+
+    def test_current_span_id_tracks_nesting(self):
+        assert tracing.current_span_id() is None
+        with tracing.trace("r") as t:
+            root_id = tracing.current_span_id()
+            assert root_id == t.root.span_id
+            with tracing.span("child") as sp:
+                assert tracing.current_span_id() == sp.span_id
+            assert tracing.current_span_id() == root_id
+
+
+class TestExportSpans:
+    def _trace(self, n_children: int = 3):
+        with tracing.trace("root", kind="origin") as t:
+            for i in range(n_children):
+                with tracing.span(f"child_{i}", idx=i, blob="x" * 40):
+                    pass
+        return t
+
+    def test_full_export_round_trips_records(self):
+        import json
+
+        t = self._trace()
+        out = tracing.export_spans(t)
+        recs = json.loads(out)
+        assert len(recs) == 4
+        by_name = {r["name"]: r for r in recs}
+        assert by_name["child_1"]["attrs"]["idx"] == 1
+        assert by_name["child_1"]["parent"] == by_name["root"]["id"]
+        assert all(r["duration_s"] >= 0.0 for r in recs)
+
+    def test_noship_attrs_never_ride_the_header(self):
+        import json
+
+        with tracing.trace("root") as t:
+            tracing.add_attr(explain={"huge": "payload"},
+                             scanstats={"also": "big"}, keep=1)
+        recs = json.loads(tracing.export_spans(t))
+        assert recs[0]["attrs"] == {"keep": 1}
+
+    def test_budget_degrades_to_attrless_then_summary(self):
+        import json
+
+        t = self._trace(8)
+        full = tracing.export_spans(t)
+        # squeeze: attrs dropped, every span still present
+        attrless = tracing.export_spans(t, budget=len(full) - 1)
+        recs = json.loads(attrless)
+        assert len(recs) == 9
+        assert all("attrs" not in r for r in recs)
+        # crush: one root summary carrying the truncation count
+        summary = json.loads(tracing.export_spans(t, budget=40))
+        assert len(summary) == 1
+        assert summary[0]["name"] == "root"
+        assert summary[0]["attrs"]["truncated_spans"] == 9
+
+    def test_export_is_header_safe_ascii(self):
+        with tracing.trace("r") as t:
+            tracing.add_attr(label="naïve-❄")
+        out = tracing.export_spans(t)
+        out.encode("ascii")  # raises if not header-safe
+        assert "\n" not in out
+
+
+class TestGraftRemote:
+    def test_graft_preserves_hierarchy_and_labels_node(self):
+        with tracing.trace("callee") as remote:
+            with tracing.span("inner"):
+                with tracing.span("leaf"):
+                    pass
+        shipped = tracing.export_spans(remote)
+        with tracing.trace("origin") as t:
+            with tracing.span("cluster_write") as anchor:
+                n = tracing.graft_remote(shipped, "w1")
+                assert n == 3
+        tree = tracing.get(t.trace_id)
+        assert tree["spans"] == 5  # origin root + anchor + 3 grafted
+        fwd = tree["root"]["children"][0]
+        assert fwd["name"] == "cluster_write"
+        grafted_root = fwd["children"][0]
+        assert grafted_root["name"] == "callee"
+        assert grafted_root["attrs"]["node"] == "w1"
+        assert grafted_root["children"][0]["name"] == "inner"
+        assert grafted_root["children"][0]["children"][0]["name"] == "leaf"
+        # every grafted span carries the node label
+        def nodes(s, out):
+            if s["attrs"].get("node"):
+                out.append(s["name"])
+            for c in s["children"]:
+                nodes(c, out)
+        labeled: list = []
+        nodes(tree["root"], labeled)
+        assert sorted(labeled) == ["callee", "inner", "leaf"]
+
+    def test_unknown_parent_anchors_instead_of_orphaning(self):
+        import json
+
+        shipped = json.dumps([
+            {"id": 10, "parent": 999, "name": "lost",
+             "start_ms": 0.0, "duration_s": 0.1},
+        ])
+        with tracing.trace("origin") as t:
+            with tracing.span("anchor"):
+                assert tracing.graft_remote(shipped, "w1") == 1
+        tree = tracing.get(t.trace_id)
+        anchor = tree["root"]["children"][0]
+        assert [c["name"] for c in anchor["children"]] == ["lost"]
+
+    def test_malformed_payloads_never_raise(self):
+        with tracing.trace("origin"):
+            with tracing.span("anchor"):
+                assert tracing.graft_remote(b"not json", "w1") == 0
+                assert tracing.graft_remote("123", "w1") == 0
+                assert tracing.graft_remote([42, "x"], "w1") == 0
+                # non-int parent, junk fields: anchored, not raised
+                assert tracing.graft_remote(
+                    [{"parent": "x", "name": "n", "duration_s": "bad"}],
+                    "w1",
+                ) == 1
+
+    def test_graft_outside_a_trace_is_a_noop(self):
+        assert tracing.graft_remote('[{"id": 1, "name": "x"}]', "w1") == 0

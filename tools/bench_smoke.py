@@ -291,6 +291,19 @@ def main() -> int:
               f"cluster lane: scale_out_factor missing: {cs}")
         check(cs.get("replica_lag_p99_ms", 0) > 0,
               f"cluster lane: replica lag p99 missing: {cs}")
+        # trace-shipping A/B on the forwarded write path: both arms
+        # present, and the overhead is not runaway. The tracked target
+        # is <5% at full iters; the smoke bound is loose because 50
+        # interleaved requests on a busy CI box jitter by several
+        # percent either way — this gate catches a broken budget
+        # (unbounded header shipping reads as 50%+), not box noise.
+        fw = cs.get("forwarded_write") or {}
+        check(fw.get("p50_ms_untraced", 0) > 0
+              and fw.get("p50_ms_traced", 0) > 0,
+              f"cluster lane: forwarded-write trace A/B missing: {fw}")
+        check(fw.get("trace_ship_overhead_pct", 1e9) < 25.0,
+              f"cluster lane: trace shipping overhead runaway "
+              f"(target <5% at full iters): {fw}")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")

@@ -14,7 +14,15 @@ asserts the scale-out contract end to end:
   the writer's accounting; `horaedb_cluster_forwards_total` moves);
 - `/api/v1/cluster/status` answers on both nodes with matching manifest
   epochs after catch-up, and the `horaedb_cluster_*` families render on
-  /metrics from boot.
+  /metrics from boot;
+- fleet observability: the replica-forwarded write yields ONE stitched
+  two-node trace (the writer's span subtree grafted under the replica's
+  forward span, node-labeled, at `/debug/traces/{id}`); an offloaded
+  read on the writer answers with a federated `fleet` EXPLAIN verdict
+  naming both nodes; a forced telemetry tick on the writer peer-scrapes
+  the replica and lands `instance="r1"`-labeled series in `_system`,
+  answerable by a label-matched range query; `/debug/cluster` renders
+  the per-node fleet view.
 
 This is the end-to-end half tests/test_cluster.py can't give: two live
 server processes' worth of boot paths, the HTTP router, the header
@@ -76,14 +84,15 @@ async def run(check) -> None:
             name=name,
         )
 
-    def cfg(port: int, node: str, role: str, peers: list) -> Config:
+    def cfg(port: int, node: str, role: str, peers: list,
+            telemetry: "dict | None" = None) -> Config:
         return Config.from_dict({
             "port": port,
             "metric_engine": {
                 "node_id": node,
                 # smoke boxes: small + quiet
                 "rules": {"enabled": False},
-                "telemetry": {"enabled": False},
+                "telemetry": telemetry or {"enabled": False},
                 "storage": {"object_store": {
                     "data_dir": tempfile.mkdtemp(prefix=f"horaedb-cs-{node}-"),
                 }},
@@ -99,7 +108,10 @@ async def run(check) -> None:
 
     async def boot(config: Config, store):
         app = await build_app(config, store=store)
-        runner = web.AppRunner(app, handler_cancellation=True)
+        # bounded shutdown: a peer router's keep-alive connection must
+        # not stall cleanup for the 60s graceful-shutdown default
+        runner = web.AppRunner(app, handler_cancellation=True,
+                               shutdown_timeout=1.0)
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", config.port)
         await site.start()
@@ -109,7 +121,13 @@ async def run(check) -> None:
     wrunner = await boot(
         cfg(wport, "w1", "writer",
             [{"node": "r1", "url": f"http://127.0.0.1:{rport}",
-              "role": "replica"}]),
+              "role": "replica"}],
+            # the writer is the fleet's telemetry origin: long intervals
+            # so nothing ticks behind the smoke's back — the forced
+            # scrape below drives both self-scrape and peer federation
+            telemetry={"enabled": True, "scrape_interval": "1h",
+                       "federation": {"enabled": True,
+                                      "scrape_interval": "1h"}}),
         bucket_store("w1"),
     )
     rrunner = await boot(
@@ -132,6 +150,11 @@ async def run(check) -> None:
                 check(r.status == 200, "replica refresh answers 200")
                 check(body["data"]["outcome"] in ("refreshed", "unchanged"),
                       f"refresh outcome sane ({body['data']})")
+            # the writer booted before the replica, so its first probe
+            # round marked r1 down; a forced refresh re-probes and
+            # restores it to the routable set (offload + federation)
+            async with s.post(f"{wbase}/api/v1/cluster/refresh") as r:
+                check(r.status == 200, "writer refresh (re-probe) answers")
 
             async def query(base: str):
                 async with s.post(f"{base}/api/v1/query", json={
@@ -156,6 +179,22 @@ async def run(check) -> None:
                   and "staleness_ms" in verdict,
                   f"EXPLAIN cluster verdict on the replica ({verdict})")
 
+            # ---- federated EXPLAIN: the writer's query offloaded to
+            # the healthy replica merges BOTH nodes' fragments into one
+            # `fleet` verdict (origin routed, replica executed)
+            fleet = wbody.get("explain", {}).get("fleet", {})
+            check(fleet.get("origin") == "w1",
+                  f"fleet verdict names the routing origin ({fleet})")
+            fleet_nodes = {f.get("node") for f in fleet.get("nodes", [])}
+            check(fleet_nodes == {"w1", "r1"},
+                  f"fleet verdict carries both nodes ({fleet_nodes})")
+            check(fleet.get("partial") == 0,
+                  f"no partial fragments on a healthy fleet ({fleet})")
+            frag_stale = [f.get("staleness_ms", 0.0)
+                          for f in fleet.get("nodes", [])]
+            check(fleet.get("staleness_ms") == max(frag_stale, default=0.0),
+                  f"fleet staleness is max-wins over fragments ({fleet})")
+
             # ---- status on both nodes: epochs match after catch-up
             async with s.get(f"{wbase}/api/v1/cluster/status") as r:
                 wst = (await r.json())["data"]
@@ -175,11 +214,94 @@ async def run(check) -> None:
                 body = await r.json()
                 check(r.status == 200 and body.get("samples") == 1,
                       f"replica forwards the write ({r.status}, {body})")
+                fwd_trace_id = r.headers.get("X-Horaedb-Trace-Id")
+            check(bool(fwd_trace_id),
+                  "forwarded write echoes X-Horaedb-Trace-Id")
+
+            # ---- ONE stitched two-node trace: the writer's span
+            # subtree shipped back in the bounded response header and
+            # grafted (node-labeled) under the replica's forward span
+            async with s.get(f"{rbase}/debug/traces/{fwd_trace_id}") as r:
+                tr = await r.json()
+                check(r.status == 200,
+                      f"/debug/traces/{{id}} resolves the forwarded "
+                      f"write's trace ({r.status})")
+
+            def walk(span, out):
+                # only non-`cluster_*` names prove a GRAFTED remote
+                # span — the funnel's own client span also carries a
+                # `node` attr (it names the target, not a shipped tree)
+                if not isinstance(span, dict):
+                    return
+                node = (span.get("attrs") or {}).get("node")
+                if node and not str(span.get("name", "")).startswith(
+                        "cluster_"):
+                    out.add(node)
+                for child in span.get("children") or []:
+                    walk(child, out)
+
+            trace_nodes: set = set()
+            walk(tr.get("root"), trace_nodes)
+            check("w1" in trace_nodes,
+                  f"stitched trace carries the writer's node-labeled "
+                  f"remote spans ({trace_nodes or '{}'})")
             async with s.post(f"{rbase}/api/v1/cluster/refresh") as r:
                 check(r.status == 200, "post-forward refresh")
             _, rbody2, _ = await query(rbase)
             check(rbody2["rows"] == len(rows) + 1,
                   f"forwarded row visible on the replica ({rbody2['rows']})")
+
+            # ---- telemetry federation: a forced tick on the writer
+            # self-scrapes AND peer-scrapes r1's registry snapshot,
+            # landing `instance="r1"`-relabeled series in `_system`
+            async with s.post(f"{wbase}/api/v1/telemetry/scrape") as r:
+                data = (await r.json()).get("data") or {}
+                check(r.status == 200 and data.get("written", 0) > 0,
+                      f"forced tick lands the self-scrape "
+                      f"({r.status}, {data.get('written')})")
+                fed = data.get("federation") or {}
+                check(fed.get("peers", {}).get("r1") == "ok",
+                      f"federation sweep scraped the replica ({fed})")
+                check(fed.get("written", 0) > 0,
+                      f"federated series written ({fed.get('written')})")
+                check(fed.get("dropped", 1) == 0,
+                      f"no federated series dropped by the budget ({fed})")
+                fed_ts_s = data["ts_ms"] / 1000.0
+            fam = 'horaedb_cluster_manifest_epoch{instance="r1"}'
+            async with s.get(
+                f"{wbase}/api/v1/query_range",
+                params={"query": fam, "start": fed_ts_s,
+                        "end": fed_ts_s, "step": 15},
+                # loop-guard header pins the query to the writer's OWN
+                # engine — the federated rows live in ITS memstore
+                headers={"X-Horaedb-Forwarded": "smoke"},
+            ) as r:
+                body = await r.json()
+                res = ((body.get("data") or {}).get("result") or [])
+                check(r.status == 200 and len(res) >= 1,
+                      f"instance-matched range query answers over the "
+                      f"federated series ({r.status}, {len(res)} series)")
+                inst = (res[0].get("metric") or {}).get("instance") \
+                    if res else None
+                check(inst == "r1",
+                      f"federated series carries instance=\"r1\" ({inst})")
+
+            # ---- /debug/cluster: the operator's one-page fleet view
+            async with s.get(f"{wbase}/debug/cluster") as r:
+                fleet_view = (await r.json()).get("data") or {}
+                check(r.status == 200
+                      and fleet_view.get("self", {}).get("node") == "w1",
+                      f"/debug/cluster answers with the self view "
+                      f"({r.status})")
+                check("r1" in (fleet_view.get("peers") or {}),
+                      f"/debug/cluster lists the replica peer "
+                      f"({list((fleet_view.get('peers') or {}))})")
+                check(fleet_view.get("federation", {}).get("enabled")
+                      is True,
+                      f"/debug/cluster reports federation enabled "
+                      f"({fleet_view.get('federation')})")
+                check("load" in fleet_view.get("self", {}),
+                      "/debug/cluster self view carries the load block")
 
             # ---- cluster metric families render on /metrics
             async with s.get(f"{rbase}/metrics") as r:

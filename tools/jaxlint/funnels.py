@@ -160,6 +160,21 @@ _ASSIGNMENT_NAME_RE = re.compile(
     r"cluster/assignment|assignment_path|assignment_dir|ASSIGNMENT_DIR"
 )
 
+# J022: the traced cluster-client funnel (cluster/router.traced_request).
+# Every outbound cluster-tier HTTP hop — write forwards, split-write
+# fan-out, read offload, hedged failover, status probes, federation
+# scrapes — goes through the ONE funnel that injects the cross-node
+# trace headers, grafts the peer's shipped-back span subtree, and feeds
+# peer-health/probe metrics. A second client path ships invisible hops.
+J022_MODULES = ("horaedb_tpu/cluster/", "horaedb_tpu/server/")
+J022_EXEMPT = ("horaedb_tpu/cluster/router.py",)
+HTTP_VERB_TAILS = {
+    "get", "post", "put", "delete", "head", "options", "patch",
+    "request", "ws_connect",
+}
+SESSION_RECEIVERS = {"session", "_session", "client_session",
+                     "http_session"}
+
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
 STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
@@ -485,6 +500,51 @@ def check_metering_funnel(tree: ast.Module, findings: list[Finding]) -> None:
                     "telemetry.metering.GLOBAL_METER, or suppress with "
                     "the reason",
                 ))
+
+
+def check_traced_client_funnel(tree: ast.Module,
+                               findings: list[Finding]) -> None:
+    """J022, two prongs: (1) an `aiohttp.ClientSession` constructed in
+    cluster/server code outside the router (the funnel owns the ONE
+    outbound session); (2) an HTTP verb called on a session-named
+    receiver (`session`/`_session`/`client_session`/`http_session` —
+    the naming idiom of every client session in this tree, the J011
+    receiver-match heuristic class)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = dotted(node.func)
+        tail = fd.rsplit(".", 1)[-1] if fd else None
+        if tail == "ClientSession":
+            findings.append(Finding(
+                node.lineno, "J022",
+                f"HTTP client session `{fd}(...)` constructed outside the "
+                "traced cluster-client funnel (cluster/router."
+                "traced_request) — a second outbound session ships hops "
+                "with no X-Horaedb-Trace-Id injection, no span grafting, "
+                "and no peer-health/probe metrics; route the call through "
+                "the router funnel, or suppress with the reason",
+            ))
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in HTTP_VERB_TAILS):
+            continue
+        owner = f.value
+        owner_name = None
+        if isinstance(owner, ast.Attribute):
+            owner_name = owner.attr
+        elif isinstance(owner, ast.Name):
+            owner_name = owner.id
+        if owner_name in SESSION_RECEIVERS:
+            findings.append(Finding(
+                node.lineno, "J022",
+                f"outbound HTTP `.{f.attr}(...)` on a client session "
+                "outside the traced cluster-client funnel — the hop is "
+                "invisible to cross-node tracing (no trace-header "
+                "injection, no shipped-back span graft) and to the "
+                "peer-health view; route through cluster/router."
+                "traced_request, or suppress with the reason",
+            ))
 
 
 def check_visibility_boundary(tree: ast.Module,

@@ -1,5 +1,5 @@
 """Per-file pass dispatcher: parses one file, applies every
-path-scoped per-file rule (J001-J017), and returns RAW findings plus
+path-scoped per-file rule (J001-J017, J022), and returns RAW findings plus
 the file's suppression table. Suppression filtering happens in the
 orchestrator (tools/jaxlint/__main__.py) AFTER the whole-program
 passes run, so the hygiene pass (J021) can see which suppressions
@@ -55,6 +55,7 @@ def run_perfile(path: Path, text: str,
         posix, funnels.J017_VIEW_EXEMPT)
     j017_assign = in_j017_base and not in_scope(
         posix, funnels.J017_ASSIGN_EXEMPT)
+    in_j022_scope = scoped(posix, funnels.J022_MODULES, funnels.J022_EXEMPT)
 
     idx = jitrules.JitIndex()
     idx.visit(tree)
@@ -92,5 +93,7 @@ def run_perfile(path: Path, text: str,
         funnels.check_stacking_funnel(tree, findings)
     if j017_views or j017_assign:
         funnels.check_cluster_funnel(tree, findings, j017_views, j017_assign)
+    if in_j022_scope:
+        funnels.check_traced_client_funnel(tree, findings)
     lockrules.check_lock_discipline(tree, findings)
     return findings, sup

@@ -104,6 +104,10 @@ CHECKS: list[Check] = [
     Check("J021", "suppression hygiene", "hygiene", ("tree",), (),
           "suppression names a code that no longer fires on that line "
           "(stale) — delete it when the underlying finding is fixed"),
+    Check("J022", "traced client funnel", "perfile",
+          _t(funnels.J022_MODULES), _t(funnels.J022_EXEMPT),
+          "outbound cluster-tier HTTP (client session construction or "
+          "verb call) outside the router's traced_request funnel"),
     Check("J999", "syntax error", "meta", ("tree",), (),
           "file fails to parse; every other pass skips it"),
 ]

@@ -18,7 +18,11 @@ Checks, per https://prometheus.io/docs/instrumenting/exposition_formats/:
 - histograms: `le` bounds sorted, bucket counts cumulative
   (nondecreasing), a `+Inf` bucket present per child, and `_count` ==
   the `+Inf` bucket;
-- no duplicate sample (same name + label set).
+- no duplicate sample (same name + label set);
+- no reserved scrape-time target label (`instance`) exposed by the
+  process itself — that axis belongs to the self-scrape collector and
+  the fleet telemetry federation, which stamp it at write time (both
+  exposition modes enforce this).
 
 OpenMetrics mode (`validate_openmetrics`, auto-detected by a `# EOF`
 line or forced with --openmetrics): the exposition served under
@@ -57,6 +61,13 @@ LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
 VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
 SUMMARY_SUFFIXES = ("_sum", "_count")
+# labels a scraper assigns at WRITE time, reserved off the exposition
+# surface: the self-scrape collector stamps `instance="<self>"` on its
+# own stored series and the telemetry federation stamps the PEER's node
+# id on pulled series — a family exposing its own `instance` label
+# would collide with (and lie about) that axis. The Prometheus target-
+# label convention, enforced here for both exposition modes.
+RESERVED_EXPOSITION_LABELS = {"instance"}
 
 
 def _parse_value(s: str) -> float | None:
@@ -155,6 +166,10 @@ def validate(text: str) -> list[str]:
         for k, _v in labels:
             if not LABEL_NAME_RE.match(k):
                 err(f"invalid label name {k!r} on {name}")
+            elif k in RESERVED_EXPOSITION_LABELS:
+                err(f"reserved label {k!r} on {name}: scrape-time "
+                    "target labels (the federation's instance axis) "
+                    "must not be exposed by the process itself")
         key = (name, labels)
         if key in seen_samples:
             err(f"duplicate sample {name}{dict(labels)}")
@@ -283,6 +298,11 @@ def validate_openmetrics(text: str) -> list[str]:
         labels = (_parse_labels(m.group("labels"), err)
                   if m.group("labels") else ())
         if labels is not None:
+            for k, _v in labels:
+                if k in RESERVED_EXPOSITION_LABELS:
+                    err(f"reserved label {k!r} on {name}: scrape-time "
+                        "target labels (the federation's instance axis) "
+                        "must not be exposed by the process itself")
             skey = (name, labels)
             if skey in seen_samples:
                 err(f"duplicate sample {name}{dict(labels)}")
