@@ -39,6 +39,13 @@ run python -c "import json, bench; print(json.dumps({\"metric\": \"query_serving
 # overhead ROOFLINE §4 charges per query, so this is where the
 # full-size coalescing speedup lands
 run python -c "import json, bench; print(json.dumps({\"metric\": \"query_batching\", **bench.query_qps_lane(False)}))"
+# mesh-scan sweep (sixth lane, queued since PR 18): the scatter-gather
+# cluster lane — whole-forward vs split-compute A/B + the calibrated
+# capacity speedup. On the CPU box the mesh layer's series-axis
+# shard_map folds to one device; on the real chip each node's region
+# fragment fans across all local devices (parallel/mesh.py), so this is
+# where the scale-up half of the distributed read path lands
+run python -c "import json, bench; print(json.dumps({\"metric\": \"cluster_scaleout\", **bench.cluster_scaleout_lane(False)}))"
 run python benchmarks/run_baselines.py
 run python benchmarks/ingest_bench.py 2000
 run python benchmarks/query_bench.py 8000000

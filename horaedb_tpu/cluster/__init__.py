@@ -113,11 +113,24 @@ FLEET_PARTIALS = GLOBAL_METRICS.counter(
          "missing (dead peer, non-explain answer, hedged failover) and "
          "the fleet verdict counted it in `partial` instead of hanging.",
 )
+WIRE_BYTES = GLOBAL_METRICS.counter(
+    "horaedb_cluster_wire_bytes_total",
+    help="Bytes the cluster tier moved between nodes, by kind (write/"
+         "read forwarding payloads, partial_grid scatter-gather "
+         "fragments) and direction as this node saw them (tx = request "
+         "body sent, rx = response body received). The near-data claim "
+         "in numbers: partial_grid rx stays at bucket scale while the "
+         "rows it summarizes never cross the wire.",
+    labelnames=("kind", "direction"),
+)
 
 for _r in ("ok", "error", "unchanged"):
     REFRESHES.labels(_r)
-for _k in ("write", "read"):
+for _k in ("write", "read", "partial_grid"):
     FORWARDS.labels(_k)
+for _k in ("write", "read", "partial_grid"):
+    for _d in ("tx", "rx"):
+        WIRE_BYTES.labels(_k, _d)
 
 
 # -- federated EXPLAIN -------------------------------------------------------
@@ -141,14 +154,21 @@ def fleet_fragment(node: str, explain: dict | None) -> dict | None:
     for key in ("serving", "admission", "encoding"):
         if isinstance(explain.get(key), dict):
             frag[key] = explain[key]
+    # scatter-gather provenance: which region shards this node computed
+    # and how many fragment bytes it shipped back
+    for key in ("regions", "wire_bytes"):
+        if key in cluster:
+            frag[key] = cluster[key]
     return frag
 
 
 def fleet_verdict(origin: str, fragments: "list[dict]",
-                  partial: int = 0) -> dict:
+                  partial: int = 0,
+                  wire_bytes: "int | None" = None) -> dict:
     """Merge per-node EXPLAIN fragments into the pinned-schema `fleet`
-    verdict — the merge surface the ROADMAP's distributed scatter-gather
-    will reuse. Schema (stable; cluster_smoke + the chaos lane assert it):
+    verdict — the merge surface both the whole-forward read path and the
+    distributed scatter-gather reuse. Schema (stable; cluster_smoke +
+    the chaos lane assert it):
 
         origin        node id that ran the merge
         nodes         per-node fragments (fleet_fragment), origin first
@@ -156,10 +176,14 @@ def fleet_verdict(origin: str, fragments: "list[dict]",
                       as its stalest contributor
         partial       fragments lost to dead/degraded peers (counted,
                       never waited for)
+        wire_bytes    response/fragment bytes that crossed the wire for
+                      THIS query (present when the path measured them) —
+                      the per-query face of
+                      horaedb_cluster_wire_bytes_total
     """
     if partial:
         FLEET_PARTIALS.inc(partial)
-    return {
+    out = {
         "origin": origin,
         "nodes": fragments,
         "staleness_ms": max(
@@ -167,6 +191,9 @@ def fleet_verdict(origin: str, fragments: "list[dict]",
         ),
         "partial": int(partial),
     }
+    if wire_bytes is not None:
+        out["wire_bytes"] = int(wire_bytes)
+    return out
 
 
 def rendezvous_order(key: bytes, nodes: "list[str]") -> "list[str]":
@@ -212,6 +239,52 @@ class ClusterPeer:
 
 
 @dataclass
+class DistributedConfig:
+    """`[metric_engine.cluster.distributed]` — the scatter-gather read
+    path (docs/operations.md "Distributed query execution"). Applies
+    only on a regioned writer with healthy computing peers; everything
+    else (standalone, single region, no peers, forwarded requests)
+    executes exactly as before."""
+
+    # split eligible grid queries across computing nodes instead of
+    # forwarding them whole (the whole-forward offload stays the
+    # fallback whenever a query is not split-eligible)
+    enabled: bool = True
+    # a query must fan over at least this many regions to be worth
+    # splitting (below it, per-fragment overhead beats the parallelism)
+    min_regions: int = 2
+    # cap on computing nodes per query, self included (0 = no cap)
+    max_fanout: int = 0
+    # per-fragment budget: a peer slower than this is treated as dead
+    # (its shards re-run locally and count in the fleet `partial`)
+    fragment_timeout: ReadableDuration = field(
+        default_factory=lambda: ReadableDuration.secs(10)
+    )
+
+    @classmethod
+    def from_dict(cls, d: "dict | None") -> "DistributedConfig":
+        from horaedb_tpu.common.error import ensure
+
+        if d is None:
+            return cls()
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        ensure(not unknown,
+               f"unknown config keys for DistributedConfig: {sorted(unknown)}")
+        kwargs = dict(d)
+        if "fragment_timeout" in kwargs:
+            kwargs["fragment_timeout"] = ReadableDuration.parse(
+                kwargs["fragment_timeout"]
+            )
+        cfg = cls(**kwargs)
+        ensure(cfg.min_regions >= 1,
+               f"distributed.min_regions must be >= 1, got {cfg.min_regions}")
+        ensure(cfg.max_fanout >= 0,
+               f"distributed.max_fanout must be >= 0, got {cfg.max_fanout}")
+        return cfg
+
+
+@dataclass
 class ClusterConfig:
     """`[metric_engine.cluster]` knobs (docs/operations.md "Scale-out").
 
@@ -251,6 +324,8 @@ class ClusterConfig:
     self_url: str = ""
     # peer processes sharing the bucket
     peers: "list[ClusterPeer]" = field(default_factory=list)
+    # scatter-gather split-read knobs ([metric_engine.cluster.distributed])
+    distributed: DistributedConfig = field(default_factory=DistributedConfig)
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "ClusterConfig":
@@ -272,6 +347,12 @@ class ClusterConfig:
                 p if isinstance(p, ClusterPeer) else ClusterPeer.from_dict(p)
                 for p in kwargs["peers"]
             ]
+        if "distributed" in kwargs and not isinstance(
+            kwargs["distributed"], DistributedConfig
+        ):
+            kwargs["distributed"] = DistributedConfig.from_dict(
+                kwargs["distributed"]
+            )
         cfg = cls(**kwargs)
         ensure(cfg.role in ("writer", "replica"),
                f"cluster.role must be writer|replica, got {cfg.role!r}")

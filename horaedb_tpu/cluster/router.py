@@ -45,6 +45,7 @@ from horaedb_tpu.cluster import (
     FORWARDS,
     PEER_HEALTHY,
     PROBE_SECONDS,
+    WIRE_BYTES,
     ClusterConfig,
     ClusterPeer,
     rendezvous_order,
@@ -251,6 +252,94 @@ class ClusterRouter:
                 return p
         return None
 
+    # -- distributed scatter-gather (cluster/partial.py carries the wire) -----
+    def compute_nodes(self) -> "list[str]":
+        """Peers eligible to compute query fragments: healthy,
+        addressable replicas (writers keep their write bandwidth)."""
+        return [n for n in self.replica_nodes()
+                if (self.peers[n].url or "")]
+
+    def plan_scatter(
+        self, regions: "list[int]", max_fanout: int = 0,
+    ) -> "dict[str, list[int]] | None":
+        """Split `regions` across {self + computing peers}: per-region
+        rendezvous preference (affinity-stable: a region keeps hitting
+        the same node's caches across queries and routers) under a
+        per-node cap of ceil(R/N) — pure rendezvous could hand one node
+        everything, and a cap both balances the work and guarantees >= 2
+        computing nodes whenever R >= 2. The coordinator always computes
+        at least one shard (it holds the data locally and its admission
+        slot anchors the EXPLAIN verdict). None = nothing to scatter
+        (no eligible peer)."""
+        # canonical iteration order: the greedy cap fill must not depend
+        # on the caller's region ordering, or two routers would disagree
+        regions = sorted({int(r) for r in regions})
+        peers = self.compute_nodes()
+        if max_fanout > 0:
+            # keep the rendezvous-preferred peers for the region SET so
+            # a capped fan-out stays affinity-stable too
+            key = b",".join(str(r).encode() for r in regions)
+            peers = rendezvous_order(key, peers)[:max(0, max_fanout - 1)]
+        nodes = [self.node_id] + sorted(peers)
+        if len(nodes) < 2 or len(regions) < 2:
+            return None
+        cap = -(-len(regions) // len(nodes))
+        plan: dict[str, list[int]] = {n: [] for n in nodes}
+        for r in regions:
+            for node in rendezvous_order(str(int(r)).encode(), nodes):
+                if len(plan[node]) < cap:
+                    plan[node].append(int(r))
+                    break
+        if not plan[self.node_id]:
+            donor = max(plan, key=lambda n: len(plan[n]))
+            plan[self.node_id].append(plan[donor].pop())
+        return {n: sorted(rs) for n, rs in plan.items() if rs}
+
+    async def fetch_partials(
+        self, node: str, body: bytes, headers=None, timeout_s=None,
+    ):
+        """Ship one fragment request to `node` and return its raw
+        partial-grid payload (cluster/partial.py wire bytes), or None on
+        any failure — the caller re-runs the shards locally and counts
+        the fragment in the fleet `partial`, it never waits. Outcome
+        feeds peer health; bytes feed the wire ledger both ways."""
+        import aiohttp
+
+        from horaedb_tpu.cluster.partial import WIRE_CONTENT_TYPE
+
+        url = self.peer_url(node)
+        if url is None:
+            return None
+        req_headers = {
+            k: v for k, v in dict(headers or {}).items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        req_headers[FORWARD_HEADER] = "1"
+        req_headers["Content-Type"] = "application/json"
+        kw = {}
+        if timeout_s is not None:
+            kw["timeout"] = aiohttp.ClientTimeout(total=timeout_s)
+        try:
+            status, resp_headers, out = await self.traced_request(
+                node, "POST", url.rstrip("/") + "/api/v1/query",
+                headers=req_headers, body=body, kind="partial_grid", **kw,
+            )
+            FORWARDS.labels("partial_grid").inc()
+            WIRE_BYTES.labels("partial_grid", "tx").inc(len(body))
+            WIRE_BYTES.labels("partial_grid", "rx").inc(len(out or b""))
+            if status >= 500:
+                self.mark_unhealthy(node)
+            ctype = (resp_headers.get("Content-Type") or "").split(";")[0]
+            if status != 200 or ctype != WIRE_CONTENT_TYPE:
+                return None
+            return out
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — peer died mid-fragment
+            self.mark_unhealthy(node)
+            logger.warning("partial-grid fetch from %s failed: %s", node, e)
+            return None
+
     # -- health ---------------------------------------------------------------
     def mark_unhealthy(self, node: str) -> None:
         if self._healthy.get(node):
@@ -446,6 +535,9 @@ class ClusterRouter:
                 headers=fwd_headers, body=body, kind=kind,
             )
             FORWARDS.labels(kind).inc()
+            if kind in ("write", "read"):
+                WIRE_BYTES.labels(kind, "tx").inc(len(body or b""))
+                WIRE_BYTES.labels(kind, "rx").inc(len(out or b""))
             if status >= 500:
                 self.mark_unhealthy(node)
             return status, resp_headers, out

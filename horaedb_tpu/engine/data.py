@@ -1234,17 +1234,27 @@ class SampleManager:
         # segment overlap another's device kernel — the engine-side analog
         # of the reference's UnionExec driving per-segment plans. The
         # semaphore is SHARED across queries (one per manager) so a
-        # dashboard burst cannot multiply the bound; each task folds its
-        # partial into the accumulator as it finishes (combination is
-        # associative), so peak memory is the in-flight parts, not one grid
-        # per segment. TaskGroup cancels + awaits siblings on first error —
-        # no detached scans survive a failed query.
+        # dashboard burst cannot multiply the bound. Partials fold in
+        # SEGMENT order, not completion order: float addition is not
+        # associative, and the distributed scatter-gather path
+        # (cluster/partial.py) promises the merged result is bit-exact vs
+        # a single-node run — that only holds if the leaf fold itself is
+        # deterministic. A small reorder buffer (`pending`) holds parts
+        # that finish ahead of a slower earlier segment; in the common
+        # case segments complete roughly in order and peak memory stays
+        # the in-flight parts, not one grid per segment. TaskGroup
+        # cancels + awaits siblings on first error — no detached scans
+        # survive a failed query.
         if self._scan_sem is None:
             self._scan_sem = asyncio.Semaphore(SEGMENT_SCAN_CONCURRENCY)
         acc: dict[str, np.ndarray] | None = None
+        pending: dict[int, dict[str, np.ndarray] | None] = {}
+        next_fold = 0
 
-        def fold(part) -> None:
+        def _fold_one(part) -> None:
             nonlocal acc
+            if part is None:
+                return
             if acc is None:
                 acc = part
             else:
@@ -1253,7 +1263,14 @@ class SampleManager:
                 acc["min"] = np.minimum(acc["min"], part["min"])
                 acc["max"] = np.maximum(acc["max"], part["max"])
 
-        async def one_rollup(rec, seg):
+        def fold(idx: int, part) -> None:
+            nonlocal next_fold
+            pending[idx] = part
+            while next_fold in pending:
+                _fold_one(pending.pop(next_fold))
+                next_fold += 1
+
+        async def one_rollup(rec, seg, idx):
             """Fold one segment's rollup artifact instead of scanning it;
             any artifact-read failure degrades the segment to raw."""
             from horaedb_tpu.common import deadline as deadline_ctx
@@ -1279,7 +1296,7 @@ class SampleManager:
                         exc_info=True,
                     )
             if lanes is None:
-                await one_segment(seg)
+                await one_segment(seg, idx)
                 return
             part, rows = self._fold_rollup(
                 lanes, metric_id, series_ids, rng, bucket_ms, num_buckets,
@@ -1293,11 +1310,9 @@ class SampleManager:
             prov[f"rollup_res_{label}"] = prov.get(f"rollup_res_{label}", 0) + 1
             ROLLUP_SUBSTITUTIONS.labels(label).inc()
             ROLLUP_ROWS.inc(rows)
-            if part is not None:
-                fold(part)
+            fold(idx, part)
 
-        async def one_segment(seg):
-            nonlocal acc
+        async def one_segment(seg, idx):
             async with self._scan_sem:
                 # cooperative deadline: a segment pass acquired AFTER the
                 # budget died must not read + reduce (the TaskGroup
@@ -1325,25 +1340,27 @@ class SampleManager:
                         packed_ok=True,
                     ),
                 )
-            if part is None:  # segment vanished entirely (TTL)
+            # the fold is synchronous (no awaits): safe on the event loop.
+            # A vanished segment (TTL) reports None so the reorder buffer
+            # still advances past its index.
+            fold(idx, part)
+            if part is None:
                 return
-            # the fold is synchronous (no awaits): safe on the event loop
-            fold(part)
             scanstats.note("raw_segments")
             prov["raw_segments"] = prov.get("raw_segments", 0) + 1
 
         from horaedb_tpu.storage.types import Timestamp
 
         async with TaskGroup() as tg:
-            for seg in segments:
+            for idx, seg in enumerate(segments):
                 seg_start = Timestamp(
                     seg[0].meta.time_range.start
                 ).truncate_by(self._segment_duration).value
                 rec = plan.get(seg_start)
                 if rec is not None:
-                    tg.create_task(one_rollup(rec, seg))
+                    tg.create_task(one_rollup(rec, seg, idx))
                 else:
-                    tg.create_task(one_segment(seg))
+                    tg.create_task(one_segment(seg, idx))
         if acc is None or acc["count"].sum() == 0:
             return None
         with np.errstate(invalid="ignore", divide="ignore"):

@@ -124,6 +124,12 @@ class QueryRequest:
     # 100M-row table queried with limit=100k pays ~100k rows of work, not
     # full materialization. None = unbounded. Ignored for bucketed queries.
     limit: int | None = None
+    # Region restriction for the distributed scatter-gather read path:
+    # None = all regions (the single-node behavior); a list restricts
+    # `query_partial_grids` to exactly these region shards — each
+    # computing node receives its assigned subset here. Ignored by the
+    # plain `query` surface (whole queries always see every region).
+    regions: "list[int] | None" = None
 
 
 class MetricEngine:
@@ -855,6 +861,27 @@ class MetricEngine:
         return await self.sample_mgr.query_downsample(
             metric_id, tsids, rng, req.bucket_ms, filtered=filtered
         )
+
+    async def query_partial_grids(self, req: QueryRequest):
+        """Distributed scatter-gather leaf: per-region partial grids as
+        [(region_id, tsids, grids)]. A plain (un-regioned) engine is one
+        region — id 0 — and answers only when the restriction includes
+        it. Runs the NORMAL downsample query path (serving cache,
+        rollups, encoding, admission on the serving node all apply); the
+        coordinator folds fragments with cluster/partial.merge_partials
+        in canonical region order so the distributed result is
+        bit-exact vs single-node."""
+        from horaedb_tpu.common.error import ensure
+
+        ensure(req.bucket_ms is not None,
+               "query_partial_grids requires a bucketed (grid) query")
+        if req.regions is not None and 0 not in [int(r) for r in req.regions]:
+            return []
+        out = await self.query(req)
+        if out is None:
+            return []
+        tsids, grids = out
+        return [(0, tsids, grids)]
 
     async def query_exemplars(self, req: QueryRequest):
         """Raw exemplar rows (incl. their labels) for a metric."""

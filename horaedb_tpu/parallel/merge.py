@@ -217,3 +217,99 @@ def sharded_packed_merge(
         return np.concatenate(parts).astype(np.int64)
 
     return collect if defer else collect()
+
+
+# -- cross-chip partial-grid fold --------------------------------------------
+# The coordinator side of the distributed scatter-gather read
+# (cluster/partial.py): k aligned per-region partial grids fold into one.
+# Cells are independent, so the series axis shards over the same 1-D merge
+# mesh the sample sort uses, and each device folds its slice LEFT over the
+# k partials — the identical per-cell fold order as the host numpy path,
+# which is what keeps the device route bitwise-equal (float addition is
+# order-sensitive; tests/test_cluster_distributed.py asserts equality).
+
+_FOLD_KEYS = ("sum", "count", "min", "max")
+
+
+@lru_cache(maxsize=8)
+def device_fold_safe(mesh: Mesh) -> bool:
+    """Whether this mesh's devices preserve f64 subnormals through the
+    fold (bitwise-exactness precondition). XLA:CPU's runtime threads run
+    with FTZ/DAZ set, silently flushing denormals the host numpy fold
+    keeps — unaffected by the fast-math flags. The probe folds one DAZ
+    case (subnormal input) and one FTZ case (normal inputs whose sum is
+    subnormal) and compares bits against numpy; a flushing platform
+    falls back to the host fold in cluster/partial.py `merge_grids`."""
+    k, s, b = 2, 2, 1
+    stacked = {key: np.zeros((k, s, b)) for key in _FOLD_KEYS}
+    stacked["min"][:] = np.inf
+    stacked["max"][:] = -np.inf
+    tiny = np.float64(2.0 ** -1022)
+    stacked["sum"][0, 0, 0] = np.float64(5e-324)          # DAZ probe
+    stacked["sum"][0, 1, 0] = tiny                        # FTZ probe:
+    stacked["sum"][1, 1, 0] = -tiny * (1.0 - 2.0 ** -52)  # normal+normal
+    try:
+        got = sharded_grid_fold(mesh, stacked, _probe=True)["sum"]
+    except Exception:  # noqa: BLE001 — a broken device path is unsafe
+        return False
+    want = stacked["sum"][0] + stacked["sum"][1]
+    return bool(
+        np.array_equal(got.view(np.uint64), want.view(np.uint64))
+    )
+
+
+@lru_cache(maxsize=32)
+def _build_grid_fold(mesh1d: Mesh, k: int, local_s: int, n_buckets: int):
+    def step(stk):
+        # [k, local_s, B] per key; explicit left fold from the identity
+        # (zeros / +-inf), matching np.add.at/minimum.at/maximum.at into
+        # an identity-initialized accumulator partial-by-partial
+        s = jnp.zeros((local_s, n_buckets), stk["sum"].dtype)
+        c = jnp.zeros((local_s, n_buckets), stk["count"].dtype)
+        mn = jnp.full((local_s, n_buckets), jnp.inf, stk["min"].dtype)
+        mx = jnp.full((local_s, n_buckets), -jnp.inf, stk["max"].dtype)
+        for j in range(k):
+            s = s + stk["sum"][j]
+            c = c + stk["count"][j]
+            mn = jnp.minimum(mn, stk["min"][j])
+            mx = jnp.maximum(mx, stk["max"][j])
+        return {"sum": s, "count": c, "min": mn, "max": mx}
+
+    spec_in = {key: P(None, MERGE_AXIS, None) for key in _FOLD_KEYS}
+    spec_out = {key: P(MERGE_AXIS, None) for key in _FOLD_KEYS}
+    mapped = shard_map(step, mesh=mesh1d, in_specs=(spec_in,),
+                       out_specs=spec_out)
+    return xjit(mapped, kernel="grid_fold")
+
+
+def sharded_grid_fold(
+    mesh: Mesh, stacked: "dict[str, np.ndarray]", _probe: bool = False,
+) -> dict:
+    """Fold k stacked partial grids ([k, S, B] per key, identity rows
+    where a partial lacks a series) across every device of `mesh`.
+    Returns host {sum, count, min, max} of shape [S, B], bitwise-equal
+    to the sequential host fold. Callers that need the bitwise guarantee
+    must gate on `device_fold_safe(mesh)` first (cluster/partial.py
+    does); `_probe` marks the gate's own calibration call."""
+    k, S, n_buckets = stacked["sum"].shape
+    if k == 0 or S == 0:
+        return {key: np.asarray(v[0] if k else v.sum(0))
+                for key, v in stacked.items()}
+    mesh1d = merge_mesh(mesh)
+    D = mesh1d.size
+    local_s = -(-S // D)
+    pad = local_s * D - S
+    dev = {}
+    for key in _FOLD_KEYS:
+        a = np.ascontiguousarray(stacked[key])
+        if pad:
+            ident = {"min": np.inf, "max": -np.inf}.get(key, 0.0)
+            a = np.concatenate(
+                [a, np.full((k, pad, n_buckets), ident, a.dtype)], axis=1
+            )
+        dev[key] = jax.device_put(
+            a, NamedSharding(mesh1d, P(None, MERGE_AXIS, None))
+        )
+    fn = _build_grid_fold(mesh1d, k, local_s, n_buckets)
+    out = fn(dev)
+    return {key: np.asarray(v)[:S] for key, v in out.items()}

@@ -1,4 +1,5 @@
-"""Mesh construction helpers.
+"""The mesh execution layer: construction helpers + the one mesh-scan
+entry every engine path shares.
 
 Axis conventions:
 - "rows":   data parallelism over row blocks (segments/SST shards) — the
@@ -8,7 +9,16 @@ Axis conventions:
 
 A 1-chip mesh is (1, 1) and all collectives degenerate to identity, so the
 same pjit'ed code path serves laptop CPU, one TPU chip, and a full slice.
-"""
+
+`mesh_downsample` is the first-class scale-up surface the distributed
+scatter-gather rides: a node's region scans fan their sorted runs across
+every local device (series-axis shard_map, replicated grid axes —
+parallel/scan.py compiles the step), and it owns the host-side
+discipline that keeps the sharded result bit-identical to the
+single-device path — series padding to the axis size, per-lane row pads
+(the sid lane pads OUT of every series slice so tail pad rows keep
+sorted keys monotone and valid=0), and the f32-on-accelerator /
+f64-on-CPU dtype rule."""
 
 from __future__ import annotations
 
@@ -65,3 +75,69 @@ def make_mesh(
            f"{n} devices not divisible by series_parallel={series_parallel}")
     arr = np.array(devs).reshape(n // series_parallel, series_parallel)
     return Mesh(arr, axis_names)
+
+
+def mesh_downsample(
+    mesh: Mesh,
+    ts_np,
+    sid_np,
+    val_np,
+    t0,
+    bucket_ms,
+    num_series: int,
+    num_buckets: int,
+    with_minmax: bool = True,
+    valid_np=None,
+    sorted_input: bool = True,
+) -> dict:
+    """One run reduced over the mesh: rows shard over "rows"
+    (psum/pmin/pmax combine the partial grids over ICI), the output grid
+    shards over "series" (padded up to the axis size, trimmed on the way
+    back). `valid_np` excludes rows (set-membership misses) via the
+    kernel's weight column — their sid must stay monotone when
+    `sorted_input`.
+
+    Row padding is PER-LANE: the sid lane pads with `padded_series`
+    (out of every device's series slice, so pad rows land on the
+    sentinel key and stay contiguous at the sorted tail) and the
+    validity lane pads False — a pad row can never perturb count/min/
+    max partials, whatever the series count's divisibility
+    (tests/test_parallel.py pins it with prime series counts).
+    """
+    from horaedb_tpu.parallel.scan import shard_rows, sharded_downsample
+
+    series_par = mesh.shape["series"]
+    padded_series = num_series + (-num_series % series_par)
+    # f32 accumulation only on real accelerators (native lane width,
+    # the documented precision trade-off); CPU/XLA-fallback meshes keep
+    # the storage f64 so query results match the reference's f64
+    # aggregation exactly (advisor round-1, blockagg precision).
+    accel = mesh.devices.flat[0].platform not in ("cpu",)
+    val_dtype = np.float32 if accel else np.float64
+    row_ok = (
+        np.ones(len(ts_np), dtype=bool) if valid_np is None
+        else np.ascontiguousarray(valid_np, dtype=bool)
+    )
+    (ts_d, sid_d, val_d, ok_d), _pad_valid = shard_rows(
+        mesh,
+        (
+            np.ascontiguousarray(ts_np, dtype=np.int64),
+            np.ascontiguousarray(sid_np, dtype=np.int32),
+            np.ascontiguousarray(val_np, dtype=val_dtype),
+            row_ok,
+        ),
+        pad_value=(0, padded_series, 0, False),
+    )
+    # pad rows carry ok=False (False pad on the bool lane), so ok_d
+    # alone is the full validity mask
+    out = sharded_downsample(
+        mesh, ts_d, sid_d, val_d, ok_d,
+        t0=t0, bucket_ms=bucket_ms,
+        num_series=padded_series, num_buckets=num_buckets,
+        with_minmax=with_minmax, sorted_input=sorted_input,
+    )
+    return {
+        k: np.asarray(v)[:num_series]
+        for k, v in out.items()
+        if k in ("sum", "count", "min", "max")
+    }

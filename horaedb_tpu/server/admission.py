@@ -534,6 +534,19 @@ async def run_query_exemplars(controller: AdmissionController, engine, req, *,
     return result, slot
 
 
+async def run_query_partials(controller: AdmissionController, engine, req, *,
+                             tenant: str = "default",
+                             cells: int | None = None):
+    """Admitted `engine.query_partial_grids(req)` (see run_query) — the
+    distributed scatter-gather leaf: every node computing a fragment
+    admits it through its OWN scheduler, so a split query costs each
+    node a slot sized to its region subset, exactly like a local one."""
+    slot = controller.slot(tenant, cells=cells)
+    async with slot:
+        result = await engine.query_partial_grids(req)
+    return result, slot
+
+
 def parse_timeout_s(raw, default_s: float, max_s: float) -> float:
     """Prometheus-style per-request deadline override: `timeout=` as
     float seconds ("2.5") or a duration string ("30s", "1m30s").

@@ -249,7 +249,11 @@ async def cluster_middleware(request: web.Request, handler):
         if request.method == "POST":
             body = await request.read()  # cached: the handler re-reads
             key += body
-        peer = cl.router.pick_read_peer(key)
+        # a split-eligible grid query is worth more than one replica's
+        # caches: fall through to the local handler, which scatters
+        # region shards across the computing nodes instead
+        peer = (None if _split_eligible(state, request, body)
+                else cl.router.pick_read_peer(key))
         if peer is not None:
             res = await cl.router.forward(
                 peer.node, request.method, request.path_qs,
@@ -257,7 +261,8 @@ async def cluster_middleware(request: web.Request, handler):
             )
             if res is not None and res[0] < 500:
                 status, hdrs, out = res
-                out = _fleet_merge_body(state, out, remote_node=peer.node)
+                out = _fleet_merge_body(state, out, remote_node=peer.node,
+                                        wire_bytes=len(out))
                 resp = web.Response(status=status, body=out)
                 resp.headers["Content-Type"] = hdrs.get(
                     "Content-Type", "application/json"
@@ -311,7 +316,8 @@ def _cluster_verdict(state: "ServerState") -> dict:
 
 
 def _fleet_merge_body(state: "ServerState", out: bytes,
-                      remote_node: "str | None", partial: int = 0) -> bytes:
+                      remote_node: "str | None", partial: int = 0,
+                      wire_bytes: "int | None" = None) -> bytes:
     """Splice the federated `fleet` verdict into a JSON query response
     carrying an EXPLAIN payload. `remote_node` names the peer whose
     engine produced the response (read offload); None means this node
@@ -347,7 +353,7 @@ def _fleet_merge_body(state: "ServerState", out: bytes,
         if frag is not None:
             frags.append(frag)
         explain["fleet"] = cluster_mod.fleet_verdict(
-            cl.node_id, frags, partial
+            cl.node_id, frags, partial, wire_bytes=wire_bytes
         )
         return json.dumps(body).encode()
     except Exception:  # noqa: BLE001 — the merge must never turn a good
@@ -433,6 +439,168 @@ async def _cluster_split_write(
         except Exception:  # noqa: BLE001 — body shape is ours, but be safe
             pass
     return total, local_n
+
+
+def _split_eligible(state: "ServerState", request: web.Request,
+                    body: "bytes | None") -> bool:
+    """Cheap pre-parse gate for the scatter-gather read path: is this a
+    native grid query this node could SPLIT across computing nodes
+    instead of forwarding whole? False negatives only cost the split
+    (the query still answers, whole-forwarded); a false positive (e.g.
+    `"bucket_ms": null` in the body) just serves locally — the full
+    eligibility check re-runs on the parsed request in `_scatter_plan`.
+    """
+    cl = state.cluster
+    if cl is None or not cl.config.distributed.enabled:
+        return False
+    if request.path != "/api/v1/query":
+        return False
+    if "query" in request.query:  # PromQL rides the whole-forward path
+        return False
+    if request.method == "POST":
+        if not body or b"bucket_ms" not in body or b'"query"' in body:
+            return False
+    elif "bucket_ms" not in request.query:
+        return False
+    engines = getattr(state.engine, "engines", None)
+    if not engines or getattr(state.engine, "_legacy", True):
+        return False
+    if len(engines) < max(2, cl.config.distributed.min_regions):
+        return False
+    return bool(cl.router.compute_nodes())
+
+
+def _scatter_plan(state: "ServerState", request: web.Request, req):
+    """Full split eligibility on the PARSED query + the shard plan:
+    {node: [region ids]} across self + healthy computing peers, or None
+    (execute the single-node way). Only a non-standby regioned writer
+    coordinates; forwarded requests never re-split (loop guard, same as
+    the whole-forward path)."""
+    from horaedb_tpu.cluster.router import FORWARD_HEADER
+
+    cl = state.cluster
+    if (cl is None or req.bucket_ms is None
+            or FORWARD_HEADER in request.headers
+            or cl.role != "writer" or cl.standby
+            or not cl.config.distributed.enabled):
+        return None
+    engines = getattr(state.engine, "engines", None)
+    if not engines or getattr(state.engine, "_legacy", True):
+        return None
+    regions = [int(r) for r in engines]
+    if len(regions) < max(2, cl.config.distributed.min_regions):
+        return None
+    return cl.router.plan_scatter(
+        regions, max_fanout=cl.config.distributed.max_fanout
+    )
+
+
+async def _run_distributed(state: "ServerState", req, q: dict, tenant: str,
+                           cells: "int | None", plan: dict):
+    """Drive one scatter-gather query: local shards compute through the
+    normal admitted engine path while remote fragments are in flight;
+    any failed fragment's shards re-run locally (counted in the fleet
+    `partial`, never waited on past the fragment timeout); all
+    per-region partials fold in canonical region order
+    (cluster/partial.py) — bit-exact vs the single-node merge.
+
+    Returns (merged out | None, admission slot, dist provenance dict).
+    """
+    from dataclasses import replace
+
+    from horaedb_tpu import cluster as cluster_mod
+    from horaedb_tpu.cluster import partial as partial_mod
+    from horaedb_tpu.parallel.mesh import active_mesh
+    from horaedb_tpu.server import admission
+
+    cl = state.cluster
+    dcfg = cl.config.distributed
+    order = [int(r) for r in state.engine.engines]
+    total = max(1, len(order))
+    my_regions = list(plan.get(cl.node_id, []))
+    remote_plan = {n: rs for n, rs in plan.items() if n != cl.node_id}
+    tenant_hdr = state.config.metric_engine.query.tenant_header
+
+    def _frag_body(regions: "list[int]") -> bytes:
+        body = {k: v for k, v in q.items()
+                if k not in ("explain", "partial_grids", "regions")}
+        body["partial_grids"] = True
+        body["regions"] = [int(r) for r in regions]
+        return json.dumps(body).encode()
+
+    def _cells_for(regions: "list[int]") -> "int | None":
+        if cells is None:
+            return None
+        return max(1, cells * len(regions) // total)
+
+    async def _local(regions: "list[int]"):
+        lreq = replace(req, regions=[int(r) for r in regions])
+        return await admission.run_query_partials(
+            state.admission, state.engine, lreq, tenant=tenant,
+            cells=_cells_for(regions),
+        )
+
+    remote_tasks = {
+        node: asyncio.create_task(cl.router.fetch_partials(
+            node, _frag_body(regions), headers={tenant_hdr: tenant},
+            timeout_s=dcfg.fragment_timeout.seconds,
+        ))
+        for node, regions in remote_plan.items()
+    }
+    try:
+        parts, slot = await _local(my_regions)
+    except BaseException:
+        for t in remote_tasks.values():
+            t.cancel()
+        raise
+    parts = list(parts)
+    frags: list[dict] = []
+    failed: list[int] = []
+    partial_count = 0
+    wire_bytes = 0
+    for node, task in remote_tasks.items():
+        payload = await task
+        decoded = None
+        if payload is not None:
+            try:
+                decoded = partial_mod.decode_partials(payload)
+            except Exception:  # noqa: BLE001 — a garbled fragment is a
+                # dead fragment; its shards re-run locally below
+                logger.warning("undecodable partial-grid fragment from %s",
+                               node, exc_info=True)
+        if decoded is None:
+            failed.extend(remote_plan[node])
+            partial_count += 1
+            continue
+        header, remote_parts = decoded
+        wire_bytes += len(payload)
+        parts.extend(remote_parts)
+        prov = dict(header.get("provenance") or {})
+        prov.setdefault("regions", remote_plan[node])
+        prov["wire_bytes"] = len(payload)
+        frag = cluster_mod.fleet_fragment(
+            header.get("node", node), {"cluster": prov}
+        )
+        if frag is not None:
+            frags.append(frag)
+    if failed:
+        # degrade ladder rung 2: the coordinator owns every region
+        # locally (shared store), so dead fragments re-run here through
+        # a fresh admission slot — exact answer, degraded parallelism
+        rerun_parts, slot = await _local(sorted(failed))
+        parts.extend(rerun_parts)
+        my_regions = sorted(set(my_regions) | set(failed))
+    out = partial_mod.merge_partials(
+        parts, order=order, device_mesh=active_mesh(),
+    )
+    dist = {
+        "fragments": frags,
+        "partial": partial_count,
+        "wire_bytes": wire_bytes,
+        "regions_local": my_regions,
+        "plan": {n: [int(r) for r in rs] for n, rs in plan.items()},
+    }
+    return out, slot, dist
 
 
 def init_logging() -> None:
@@ -1270,6 +1438,19 @@ async def handle_query(request: web.Request) -> web.Response:
         n_buckets = -(-(req.end_ms - req.start_ms) // req.bucket_ms)
         cells = int(n_buckets) * max(state.engine.series_count(req.metric), 1)
     tenant = _tenant_of(request)
+    # distributed scatter-gather leaf: a coordinator asked THIS node to
+    # compute a region-shard subset and answer compact partial grids
+    # (cluster/partial.py wire) instead of a merged JSON response
+    partial_wire = bool(q.get("partial_grids")) and mode == "downsample"
+    if partial_wire and q.get("regions") is not None:
+        try:
+            req.regions = [int(r) for r in q["regions"]]
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "bad query: regions must be a list of ints"},
+                status=400,
+            )
+    dist = None
     st = None
     try:
         with scanstats.scan_stats() as st, \
@@ -1278,11 +1459,23 @@ async def handle_query(request: web.Request) -> web.Response:
                 table, slot = await admission.run_query_exemplars(
                     state.admission, state.engine, req, tenant=tenant
                 )
-            else:
-                out, slot = await admission.run_query(
+            elif partial_wire:
+                parts, slot = await admission.run_query_partials(
                     state.admission, state.engine, req, tenant=tenant,
                     cells=cells,
                 )
+            else:
+                plan = (_scatter_plan(state, request, req)
+                        if mode == "downsample" else None)
+                if plan is not None:
+                    out, slot, dist = await _run_distributed(
+                        state, req, q, tenant, cells, plan
+                    )
+                else:
+                    out, slot = await admission.run_query(
+                        state.admission, state.engine, req, tenant=tenant,
+                        cells=cells,
+                    )
     except DeadlineExceeded as e:
         # end-to-end budget spent (queued or mid-scan): 504 with the
         # partial-progress provenance of what the scan HAD done
@@ -1310,6 +1503,39 @@ async def handle_query(request: web.Request) -> web.Response:
     explain = _finish_explain(state, st, mode, want_explain,
                               admission_verdict=slot.verdict())
     _attach_rule_provenance(state, explain, [q["metric"]])
+    if partial_wire:
+        from horaedb_tpu.cluster import WIRE_BYTES
+        from horaedb_tpu.cluster.partial import (
+            WIRE_CONTENT_TYPE,
+            encode_partials,
+        )
+
+        cl = state.cluster
+        prov = _cluster_verdict(state)
+        prov["regions"] = sorted(
+            {int(p[0]) for p in parts}
+            | set(req.regions if req.regions is not None else ())
+        )
+        payload = encode_partials(
+            cl.node_id if cl is not None else "local", parts,
+            provenance=prov,
+        )
+        WIRE_BYTES.labels("partial_grid", "tx").inc(len(payload))
+        return web.Response(body=payload, content_type=WIRE_CONTENT_TYPE)
+    if dist is not None and explain is not None:
+        from horaedb_tpu import cluster as cluster_mod
+
+        cl = state.cluster
+        origin = cluster_mod.fleet_fragment(cl.node_id, explain)
+        frags = []
+        if origin is not None:
+            origin["regions"] = [int(r) for r in dist["regions_local"]]
+            frags.append(origin)
+        explain["fleet"] = cluster_mod.fleet_verdict(
+            cl.node_id, frags + dist["fragments"],
+            partial=dist["partial"], wire_bytes=dist["wire_bytes"],
+        )
+        explain["fleet"]["distributed"] = {"plan": dist["plan"]}
     if q.get("exemplars"):
         if table is None:
             return web.json_response(

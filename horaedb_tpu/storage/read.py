@@ -2291,48 +2291,17 @@ class ParquetReader:
         num_series: int, num_buckets: int, with_minmax: bool,
         valid_np=None,
     ) -> dict:
-        """One sorted run reduced over the ambient mesh: rows shard over
-        "rows" (psum/pmin/pmax combine the partial grids over ICI), the
-        output grid shards over "series" (padded up to the axis size).
-        `valid_np` excludes rows (set-membership misses) via the kernel's
-        weight column — their sid must stay monotone."""
-        from horaedb_tpu.parallel.scan import shard_rows, sharded_downsample
+        """One sorted run reduced over the ambient mesh — delegates to
+        the first-class mesh layer (parallel/mesh.py::mesh_downsample),
+        which owns the series padding, per-lane row pads, and the
+        accelerator dtype rule the sharded lane grew up with here."""
+        from horaedb_tpu.parallel.mesh import mesh_downsample
 
-        series_par = mesh.shape["series"]
-        padded_series = num_series + (-num_series % series_par)
-        # f32 accumulation only on real accelerators (native lane width,
-        # the documented precision trade-off); CPU/XLA-fallback meshes keep
-        # the storage f64 so query results match the reference's f64
-        # aggregation exactly (advisor round-1, blockagg precision).
-        accel = mesh.devices.flat[0].platform not in ("cpu",)
-        val_dtype = np.float32 if accel else np.float64
-        row_ok = (
-            np.ones(len(ts_np), dtype=bool) if valid_np is None
-            else np.ascontiguousarray(valid_np, dtype=bool)
+        return mesh_downsample(
+            mesh, ts_np, sid_np, val_np, t0, bucket_ms,
+            num_series, num_buckets, with_minmax=with_minmax,
+            valid_np=valid_np, sorted_input=True,
         )
-        (ts_d, sid_d, val_d, ok_d), _pad_valid = shard_rows(
-            mesh,
-            (
-                np.ascontiguousarray(ts_np, dtype=np.int64),
-                np.ascontiguousarray(sid_np, dtype=np.int32),
-                np.ascontiguousarray(val_np, dtype=val_dtype),
-                row_ok,
-            ),
-            pad_value=0,
-        )
-        # pad rows carry ok=False (pad_value 0 on the bool lane), so ok_d
-        # alone is the full validity mask
-        out = sharded_downsample(
-            mesh, ts_d, sid_d, val_d, ok_d,
-            t0=t0, bucket_ms=bucket_ms,
-            num_series=padded_series, num_buckets=num_buckets,
-            with_minmax=with_minmax, sorted_input=True,
-        )
-        return {
-            k: np.asarray(v)[:num_series]
-            for k, v in out.items()
-            if k in ("sum", "count", "min", "max")
-        }
 
     # -- shared prologue/epilogue ---------------------------------------------
     def _resolve_read_names(self, projections: list[int] | None, keep_builtin: bool) -> list[str]:

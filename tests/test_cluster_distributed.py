@@ -1,0 +1,517 @@
+"""Distributed scatter-gather: wire fidelity, merge discipline, scatter
+planning, and the bit-exactness property — a query split across
+fragments (any grouping, any arrival order, fragments dying mid-merge)
+must reproduce the single-node answer bit-for-bit (u64-view equality,
+NaN payloads and -0.0 signs included)."""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.cluster import ClusterConfig, ClusterPeer, DistributedConfig
+from horaedb_tpu.cluster.partial import (
+    MAGIC,
+    WIRE_CONTENT_TYPE,
+    decode_partials,
+    encode_partials,
+    merge_grids,
+    merge_partials,
+)
+from horaedb_tpu.cluster.router import ClusterRouter
+from horaedb_tpu.common.error import HoraeError
+from horaedb_tpu.engine import QueryRequest
+from horaedb_tpu.engine.region import RegionedEngine
+from horaedb_tpu.objstore import MemStore
+from tests.conftest import async_test
+
+HOUR = 3_600_000
+MIN = 60_000
+
+
+def u64(a) -> np.ndarray:
+    """Bit-view: equality that distinguishes -0.0 from 0.0 and compares
+    NaN payloads instead of treating every NaN as unequal."""
+    return np.ascontiguousarray(np.asarray(a, dtype=np.float64)).view(np.uint64)
+
+
+def assert_bit_equal(got, want) -> None:
+    if want is None or got is None:
+        assert got is None and want is None
+        return
+    got_ids, got_grids = got
+    want_ids, want_grids = want
+    assert [int(t) for t in got_ids] == [int(t) for t in want_ids]
+    for k in ("sum", "count", "min", "max", "mean"):
+        np.testing.assert_array_equal(
+            u64(got_grids[k]), u64(want_grids[k]),
+            err_msg=f"grid {k!r} diverged in the last bit",
+        )
+
+
+def awkward_grids(n, b, seed=0, dtype=np.float64):
+    """Grids seeded with every float the wire must not launder: NaN,
+    -0.0, +-inf, denormals."""
+    rng = np.random.default_rng(seed)
+    g = rng.normal(size=(n, b)).astype(dtype)
+    flat = g.reshape(-1)
+    special = [np.nan, -0.0, 0.0, np.inf, -np.inf, 5e-324, -5e-324]
+    for i, v in enumerate(special):
+        if i < flat.size:
+            flat[i * (flat.size // len(special))] = v
+    return {
+        "sum": g,
+        "count": np.abs(rng.normal(size=(n, b))).astype(dtype),
+        "min": g - 1.0,
+        "max": g + 1.0,
+        "mean": g * 0.5,
+    }
+
+
+class TestWireFormat:
+    def test_roundtrip_is_bit_exact(self):
+        tsids = [1, (1 << 64) - 1, 1 << 63, 7]
+        grids = awkward_grids(4, 3)
+        buf = encode_partials(
+            "w1", [(2, tsids, grids)], provenance={"regions": [2]}
+        )
+        assert buf.startswith(MAGIC)
+        header, parts = decode_partials(buf)
+        assert header["node"] == "w1"
+        assert header["provenance"] == {"regions": [2]}
+        assert len(parts) == 1
+        rid, got_ids, got = parts[0]
+        assert rid == 2
+        assert got_ids == tsids  # python ints incl. > 2**63
+        for k in grids:
+            assert got[k].dtype == grids[k].dtype
+            np.testing.assert_array_equal(u64(got[k]), u64(grids[k]))
+
+    def test_multi_region_and_dtype_preserved(self):
+        f32 = {k: v.astype(np.float32)
+               for k, v in awkward_grids(2, 2, seed=1).items()}
+        buf = encode_partials("n", [
+            (0, [5, 6], awkward_grids(2, 2, seed=2)),
+            (3, [9, 10], f32),
+        ])
+        _, parts = decode_partials(buf)
+        assert [p[0] for p in parts] == [0, 3]
+        assert parts[1][2]["sum"].dtype == np.float32
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError, match="magic"):
+            decode_partials(b"NOPE" + b"\x00" * 32)
+
+    def test_content_type_is_stable(self):
+        # the coordinator trusts this value to tell a partial payload
+        # from an error body — changing it is a wire break
+        assert WIRE_CONTENT_TYPE == "application/x-horaedb-partial-grids"
+
+
+class TestMergeDiscipline:
+    def test_single_partial_returns_as_is(self):
+        grids = awkward_grids(3, 2)
+        out = merge_partials([(1, [4, 5, 6], grids)], order=[0, 1])
+        assert out is not None
+        tsids, got = out
+        assert tsids == [4, 5, 6]
+        # untouched: the engine's own output is canonical for one region
+        for k in grids:
+            assert got[k] is grids[k]
+
+    def test_empty_is_none(self):
+        assert merge_partials([]) is None
+
+    def test_arrival_order_never_matters(self):
+        """Any shuffle of fragment arrival folds identically: the
+        canonical region order, not the network, decides."""
+        parts = [
+            (r, [10 * r + 1, 10 * r + 2], awkward_grids(2, 4, seed=r))
+            for r in range(4)
+        ]
+        # overlapping series across regions exercise the union path
+        parts.append((4, [1, 31], awkward_grids(2, 4, seed=9)))
+        order = [0, 1, 2, 3, 4]
+        want = merge_partials(list(parts), order=order)
+        rng = random.Random(7)
+        for _ in range(10):
+            shuffled = list(parts)
+            rng.shuffle(shuffled)
+            assert_bit_equal(merge_partials(shuffled, order=order), want)
+
+    def test_unknown_regions_sort_after_by_id(self):
+        a = (7, [1], {"sum": np.ones((1, 1)), "count": np.ones((1, 1)),
+                      "min": np.ones((1, 1)), "max": np.ones((1, 1))})
+        b = (9, [1], {"sum": np.full((1, 1), 2.0),
+                      "count": np.ones((1, 1)),
+                      "min": np.full((1, 1), 2.0),
+                      "max": np.full((1, 1), 2.0)})
+        got = merge_partials([b, a], order=[0, 1])
+        want = merge_partials([a, b], order=[0, 1, 7, 9])
+        assert_bit_equal(got, want)
+
+    def test_fold_matches_manual_skip_absent_fold(self):
+        """The device-shaped identity-row fold is the same fold: adding
+        0.0 where a partial lacks a series cannot move any bit (the
+        accumulator starts at +0.0, and +0.0 + -0.0 = +0.0 either way)."""
+        parts = [([1, 2], awkward_grids(2, 3, seed=3)),
+                 ([2, 3], awkward_grids(2, 3, seed=4))]
+        tsids, got = merge_grids(list(parts))
+        assert tsids == [1, 2, 3]
+        acc = {
+            "sum": np.zeros((3, 3)), "count": np.zeros((3, 3)),
+            "min": np.full((3, 3), np.inf), "max": np.full((3, 3), -np.inf),
+        }
+        pos = {1: 0, 2: 1, 3: 2}
+        for ids, g in parts:
+            idx = np.asarray([pos[t] for t in ids])
+            np.add.at(acc["sum"], idx, g["sum"])
+            np.add.at(acc["count"], idx, g["count"])
+            np.minimum.at(acc["min"], idx, g["min"])
+            np.maximum.at(acc["max"], idx, g["max"])
+        for k in acc:
+            np.testing.assert_array_equal(u64(got[k]), u64(acc[k]))
+
+    def test_device_mesh_never_changes_bits(self):
+        """merge_grids with a device mesh is bitwise-identical to the
+        host fold — either the platform preserves f64 subnormals through
+        the jitted fold, or the `device_fold_safe` probe detects the
+        flush (XLA:CPU runs FTZ/DAZ) and merge_grids falls back to the
+        host path. Both routes keep the guarantee; denormal inputs
+        included here so a broken gate fails loudly."""
+        from horaedb_tpu.parallel import make_mesh
+
+        parts = [([1, 2, 5], awkward_grids(3, 4, seed=11)),
+                 ([2, 3, 5], awkward_grids(3, 4, seed=12)),
+                 ([1, 3, 4], awkward_grids(3, 4, seed=13))]
+        host = merge_grids([(list(t), dict(g)) for t, g in parts])
+        dev = merge_grids(
+            [(list(t), dict(g)) for t, g in parts],
+            device_mesh=make_mesh(8, series_parallel=2),
+        )
+        assert_bit_equal(dev, host)
+
+    def test_device_fold_matches_host_without_subnormals(self):
+        """The fold kernel itself (parallel/merge.py) keeps per-cell
+        fold order: NaN, -0.0, +-inf inputs fold to the same bits as
+        the sequential host fold on any platform."""
+        from horaedb_tpu.parallel import make_mesh
+        from horaedb_tpu.parallel.merge import sharded_grid_fold
+
+        rng = np.random.default_rng(21)
+        k, s, b = 3, 5, 4
+        stacked = {key: rng.normal(size=(k, s, b))
+                   for key in ("sum", "count", "min", "max")}
+        for key, v in (("sum", np.nan), ("sum", -0.0), ("min", np.inf),
+                       ("max", -np.inf), ("count", 0.0)):
+            stacked[key][0, 0, 0] = v
+        got = sharded_grid_fold(make_mesh(8, series_parallel=2),
+                                {key: v.copy() for key, v in stacked.items()})
+        want = {
+            "sum": np.zeros((s, b)), "count": np.zeros((s, b)),
+            "min": np.full((s, b), np.inf), "max": np.full((s, b), -np.inf),
+        }
+        for j in range(k):
+            want["sum"] = want["sum"] + stacked["sum"][j]
+            want["count"] = want["count"] + stacked["count"][j]
+            want["min"] = np.minimum(want["min"], stacked["min"][j])
+            want["max"] = np.maximum(want["max"], stacked["max"][j])
+        for key in want:
+            np.testing.assert_array_equal(u64(got[key]), u64(want[key]),
+                                          err_msg=key)
+
+    def test_device_fold_safe_is_probed_once(self):
+        from horaedb_tpu.parallel import make_mesh
+        from horaedb_tpu.parallel.merge import device_fold_safe
+
+        mesh = make_mesh(8, series_parallel=2)
+        assert isinstance(device_fold_safe(mesh), bool)
+        assert device_fold_safe(mesh) is device_fold_safe(mesh)
+
+
+class TestPlanScatter:
+    def router(self, replicas=("r1", "r2"), node="w1"):
+        peers = [ClusterPeer(node=n, url=f"http://{n}:1", role="replica")
+                 for n in replicas]
+        peers.append(ClusterPeer(node=node, url=f"http://{node}:1",
+                                 role="writer"))
+        return ClusterRouter(ClusterConfig(enabled=True, peers=peers), node)
+
+    def test_covers_all_regions_balanced(self):
+        r = self.router()
+        regions = list(range(8))
+        plan = r.plan_scatter(regions)
+        assert plan is not None
+        got = sorted(x for rs in plan.values() for x in rs)
+        assert got == regions
+        cap = -(-len(regions) // 3)
+        assert all(len(rs) <= cap for rs in plan.values())
+        assert len(plan) >= 2  # always >= 2 computing nodes when R >= 2
+        assert plan.get("w1"), "coordinator always computes a shard"
+
+    def test_deterministic(self):
+        r = self.router()
+        assert r.plan_scatter([0, 1, 2, 3]) == r.plan_scatter([3, 2, 1, 0])
+
+    def test_none_when_nothing_to_scatter(self):
+        r = self.router()
+        assert r.plan_scatter([0]) is None  # one region
+        lonely = self.router(replicas=())
+        assert lonely.plan_scatter([0, 1, 2]) is None  # no peers
+        sick = self.router()
+        sick.mark_unhealthy("r1")
+        sick.mark_unhealthy("r2")
+        assert sick.plan_scatter([0, 1]) is None
+
+    def test_max_fanout_caps_nodes(self):
+        r = self.router(replicas=("r1", "r2", "r3", "r4"))
+        plan = r.plan_scatter(list(range(12)), max_fanout=2)
+        assert plan is not None
+        assert len(plan) <= 2
+        assert "w1" in plan
+
+    def test_two_regions_two_nodes(self):
+        # the acceptance floor: R=2 must still split
+        r = self.router(replicas=("r1",))
+        plan = r.plan_scatter([0, 1])
+        assert plan is not None and len(plan) == 2
+        assert sorted(x for rs in plan.values() for x in rs) == [0, 1]
+
+
+class TestDistributedConfig:
+    def test_defaults(self):
+        cfg = DistributedConfig.from_dict(None)
+        assert cfg.enabled and cfg.min_regions == 2 and cfg.max_fanout == 0
+        assert cfg.fragment_timeout.seconds == 10.0
+
+    def test_from_dict(self):
+        cfg = DistributedConfig.from_dict({
+            "enabled": False, "min_regions": 4,
+            "max_fanout": 3, "fragment_timeout": "2s",
+        })
+        assert not cfg.enabled
+        assert cfg.min_regions == 4 and cfg.max_fanout == 3
+        assert cfg.fragment_timeout.seconds == 2.0
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(HoraeError, match="unknown config keys"):
+            DistributedConfig.from_dict({"min_region": 2})
+
+    def test_validation(self):
+        with pytest.raises(HoraeError, match="min_regions"):
+            DistributedConfig.from_dict({"min_regions": 0})
+        with pytest.raises(HoraeError, match="max_fanout"):
+            DistributedConfig.from_dict({"max_fanout": -1})
+
+    def test_nested_in_cluster_config(self):
+        cfg = ClusterConfig.from_dict({
+            "enabled": True,
+            "distributed": {"min_regions": 3},
+        })
+        assert cfg.distributed.min_regions == 3
+
+
+class TestWireBytesFamily:
+    def test_preregistered_and_promcheck_clean(self):
+        """`horaedb_cluster_wire_bytes_total` renders from boot (zero
+        states for every kind x direction) and the exposition passes the
+        promcheck validator — the satellite contract for the family."""
+        import sys
+        from pathlib import Path
+
+        from horaedb_tpu.server.metrics import GLOBAL_METRICS
+
+        sys.path.insert(
+            0, str(Path(__file__).resolve().parent.parent / "tools")
+        )
+        import promcheck
+
+        out = GLOBAL_METRICS.render()
+        assert "# TYPE horaedb_cluster_wire_bytes_total counter" in out
+        for kind in ("write", "read", "partial_grid"):
+            for direction in ("tx", "rx"):
+                needle = (f'horaedb_cluster_wire_bytes_total{{'
+                          f'kind="{kind}",direction="{direction}"}}')
+                assert needle in out, needle
+        assert not promcheck.validate(out), promcheck.validate(out)
+
+
+def make_series_payload(num_series=24, hours=2, seed=0):
+    from horaedb_tpu.pb import remote_write_pb2
+
+    rng = np.random.default_rng(seed)
+    req = remote_write_pb2.WriteRequest()
+    for i in range(num_series):
+        ts = req.timeseries.add()
+        for k, v in ((b"__name__", b"cpu"), (b"host", f"h{i}".encode())):
+            lab = ts.labels.add()
+            lab.name = k
+            lab.value = v
+        for hr in range(hours):
+            for m in range(0, 60, 5):
+                s = ts.samples.add()
+                s.timestamp = hr * HOUR + m * MIN
+                # values with enough entropy that fold order shows up in
+                # the last ulp if anyone gets it wrong
+                s.value = float(rng.normal()) * (10.0 ** (i % 5))
+    return req.SerializeToString()
+
+
+def region_splits(ids):
+    """Every way to split the region list into 1, 2, or 3 contiguous-
+    by-assignment fragment groups (grouping choice must not matter)."""
+    ids = list(ids)
+    yield [ids]
+    for cut in range(1, len(ids)):
+        yield [ids[:cut], ids[cut:]]
+    if len(ids) >= 3:
+        yield [ids[:1], ids[1:2], ids[2:]]
+        yield [[ids[0], ids[-1]], ids[1:-1]]  # non-contiguous grouping
+
+
+class TestSplitQueryBitExact:
+    """The headline property: fragments computed per region group, wire
+    round-tripped, shuffled, and merged == the single-node answer."""
+
+    async def _open(self, store, num_regions=3):
+        return await RegionedEngine.open(
+            "db", store, num_regions=num_regions,
+            segment_duration_ms=HOUR, enable_compaction=False,
+        )
+
+    async def _fragments(self, eng, req, groups):
+        """Compute one wire-round-tripped fragment per region group —
+        what each computing node would answer."""
+        parts = []
+        for gi, group in enumerate(groups):
+            from dataclasses import replace
+
+            frag = await eng.query_partial_grids(
+                replace(req, regions=[int(r) for r in group])
+            )
+            buf = encode_partials(f"node-{gi}", frag)
+            _, decoded = decode_partials(buf)
+            parts.extend(decoded)
+        return parts
+
+    @async_test
+    async def test_all_splits_match_single_node(self):
+        store = MemStore()
+        eng = await self._open(store)
+        try:
+            await eng.write_payload(make_series_payload())
+            await eng.flush()
+            req = QueryRequest(metric=b"cpu", start_ms=0, end_ms=2 * HOUR,
+                               bucket_ms=15 * MIN)
+            single = await eng.query(req)
+            assert single is not None
+            order = [int(r) for r in eng.engines]
+            assert len(order) == 3
+            rng = random.Random(3)
+            for groups in region_splits(order):
+                parts = await self._fragments(eng, req, groups)
+                for _ in range(3):  # arrival order must not matter
+                    shuffled = list(parts)
+                    rng.shuffle(shuffled)
+                    got = merge_partials(shuffled, order=order)
+                    assert_bit_equal(got, single)
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_dead_fragment_rerun_locally_is_exact(self):
+        """Mid-merge replica death: drop a fragment, re-run its regions
+        locally (the coordinator's degrade ladder), merge — still exact."""
+        store = MemStore()
+        eng = await self._open(store)
+        try:
+            await eng.write_payload(make_series_payload(seed=5))
+            await eng.flush()
+            req = QueryRequest(metric=b"cpu", start_ms=0, end_ms=2 * HOUR,
+                               bucket_ms=10 * MIN)
+            single = await eng.query(req)
+            order = [int(r) for r in eng.engines]
+            groups = [order[:1], order[1:2], order[2:]]
+            parts = await self._fragments(eng, req, groups)
+            dead_regions = set(groups[1])
+            survivors = [p for p in parts if p[0] not in dead_regions]
+            rerun = await self._fragments(eng, req, [sorted(dead_regions)])
+            got = merge_partials(survivors + rerun, order=order)
+            assert_bit_equal(got, single)
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_mixed_rollup_and_raw_segments(self):
+        """One region compacted (rollup-substituted scans), the others
+        raw: the split answer still matches the single-node answer —
+        both paths run the identical per-region leaves."""
+        from horaedb_tpu.serving.cache import RESULT_CACHE
+        from horaedb_tpu.storage.config import SchedulerConfig, StorageConfig
+
+        cfg = StorageConfig()
+        cfg.scheduler = SchedulerConfig(input_sst_min_num=2)
+        store = MemStore()
+        eng = await RegionedEngine.open(
+            "db", store, num_regions=3, segment_duration_ms=HOUR,
+            enable_compaction=True, config=cfg,
+        )
+        try:
+            for seed in (1, 2):  # two flushes -> two SSTs per segment
+                await eng.write_payload(
+                    make_series_payload(num_series=18, seed=seed)
+                )
+                await eng.flush()
+            # compact exactly one region so its scans substitute rollups
+            first = next(iter(eng.engines.values()))
+            sched = first.data_table.compaction_scheduler
+            for _ in range(32):
+                picked = sched.pick_once()
+                while sched._tasks.qsize() or sched.executor._inflight:
+                    await asyncio.sleep(0.001)
+                    await sched.executor.drain()
+                if not picked:
+                    break
+            RESULT_CACHE.clear()
+            req = QueryRequest(metric=b"cpu", start_ms=0, end_ms=2 * HOUR,
+                               bucket_ms=20 * MIN)
+            single = await eng.query(req)
+            order = [int(r) for r in eng.engines]
+            for groups in ([order[:2], order[2:]],
+                           [order[:1], order[1:2], order[2:]]):
+                parts = await self._fragments(eng, req, groups)
+                assert_bit_equal(
+                    merge_partials(parts, order=order), single
+                )
+        finally:
+            await eng.close()
+
+    @async_test
+    async def test_region_restriction_is_a_partition(self):
+        """Fragments never overlap and never miss: each region's series
+        appear in exactly one fragment."""
+        store = MemStore()
+        eng = await self._open(store)
+        try:
+            await eng.write_payload(make_series_payload(seed=8))
+            await eng.flush()
+            req = QueryRequest(metric=b"cpu", start_ms=0, end_ms=HOUR,
+                               bucket_ms=30 * MIN)
+            order = [int(r) for r in eng.engines]
+            full = await eng.query_partial_grids(req)
+            per_region = {}
+            for rid in order:
+                from dataclasses import replace
+
+                frag = await eng.query_partial_grids(
+                    replace(req, regions=[rid])
+                )
+                for fr in frag:
+                    per_region.setdefault(fr[0], []).extend(fr[1])
+            want = {fr[0]: list(fr[1]) for fr in full}
+            assert per_region == want
+            all_ids = [t for ids in per_region.values() for t in ids]
+            assert len(all_ids) == len(set(all_ids))
+        finally:
+            await eng.close()

@@ -1278,3 +1278,97 @@ class TestJ022TracedClientFunnel:
         )
         r = run_jaxlint(f)
         assert r.returncode == 0, r.stdout
+
+
+class TestJ023PartialGridFunnel:
+    """J023: the partial-grid wire codec and coordinator merge belong in
+    cluster/partial.py (exempt: it IS the funnel). Shadow definitions of
+    the funnel names and ad-hoc in-place ufunc grid folds in
+    cluster/server code fork the wire format / fold order behind the
+    distributed bit-exactness guarantee."""
+
+    def seeded(self, tmp_path, body, rel="cluster/scatter.py"):
+        f = tmp_path / "horaedb_tpu" / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+        return f
+
+    def test_shadow_merge_def_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "def merge_grids(parts):\n"
+            "    return parts[0]\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J023" in r.stdout and "partial.py" in r.stdout
+
+    def test_shadow_async_encode_def_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "async def encode_partials(results):\n"
+            "    return b''\n",
+            rel="server/wire.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 1, r.stdout
+        assert "J023" in r.stdout
+
+    def test_inplace_ufunc_fold_fires(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def fold(grid, idx, part):\n"
+            "    np.add.at(grid['sum'], idx, part['sum'])\n"
+            "    np.minimum.at(grid['min'], idx, part['min'])\n",
+            rel="server/agg.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 2, r.stdout
+        assert "J023" in r.stdout and "merge_grids" in r.stdout
+
+    def test_partial_module_exempt(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def merge_grids(parts):\n"
+            "    acc = parts[0]\n"
+            "    np.add.at(acc['sum'], 0, 1.0)\n"
+            "    return acc\n",
+            rel="cluster/partial.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_calling_funnel_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "from horaedb_tpu.cluster.partial import merge_partials\n"
+            "def gather(parts, order):\n"
+            "    return merge_partials(parts, order=order)\n",
+            rel="server/gather.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_outside_scope_not_flagged(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def fold(grid, idx, part):\n"
+            "    np.add.at(grid['sum'], idx, part['sum'])\n",
+            rel="storage/rollup_fold.py",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout
+
+    def test_reasoned_suppression_accepted(self, tmp_path):
+        f = self.seeded(
+            tmp_path,
+            "import numpy as np\n"
+            "def fold(grid, idx, part):\n"
+            "    # jaxlint: disable=J023 single-fragment debug histogram, not a merge\n"
+            "    np.add.at(grid['hist'], idx, part)\n",
+        )
+        r = run_jaxlint(f)
+        assert r.returncode == 0, r.stdout

@@ -653,3 +653,76 @@ class TestShardedAppendMode:
         t_default = asyncio.run(run(None))
         assert t_sharded.num_rows == 7500  # nothing deduped
         assert t_sharded.equals(t_default)
+
+
+class TestMeshDownsamplePadDiscipline:
+    """Satellite regression: uneven series splits must not let pad rows
+    perturb count/min/max partials. The sid lane pads with the OUT-OF-
+    SLICE sentinel (padded series count) and the validity lane pads
+    False — a scalar-0 pad was only correct by weight-0 accident and
+    violated the sorted-keys contract of the blockagg kernels."""
+
+    @pytest.mark.parametrize("num_series", [7, 13, 31])
+    def test_prime_series_counts_match_oracle(self, mesh8, num_series):
+        from horaedb_tpu.parallel.mesh import mesh_downsample
+
+        rng = np.random.default_rng(num_series)
+        bucket_ms, num_buckets = 1_000, 5
+        # row count chosen so the rows axis needs pad rows too
+        n = 4 * 97 + 3
+        sid = np.sort(rng.integers(0, num_series, n)).astype(np.int32)
+        ts = np.empty(n, dtype=np.int64)
+        # sorted (sid, ts): the engine's pk-ordered scan contract
+        start = 0
+        for s in range(num_series):
+            k = int((sid == s).sum())
+            ts[start:start + k] = np.sort(
+                rng.integers(0, bucket_ms * num_buckets, k)
+            )
+            start += k
+        vals = rng.normal(size=n)
+        out = mesh_downsample(
+            mesh8, ts, sid, vals, 0, bucket_ms,
+            num_series=num_series, num_buckets=num_buckets,
+        )
+        assert out["sum"].shape == (num_series, num_buckets)
+        bucket = ts // bucket_ms
+        for s in range(num_series):
+            for b in range(num_buckets):
+                sel = vals[(sid == s) & (bucket == b)]
+                assert float(out["count"][s, b]) == len(sel)
+                if len(sel):
+                    assert np.isclose(float(out["sum"][s, b]), sel.sum())
+                    assert float(out["min"][s, b]) == sel.min()
+                    assert float(out["max"][s, b]) == sel.max()
+                else:
+                    assert float(out["min"][s, b]) == np.inf
+                    assert float(out["max"][s, b]) == -np.inf
+
+    def test_pad_rows_carry_invalid(self, mesh8):
+        """Row pads land on the sentinel sid with valid=False: a grid of
+        all-zero counts stays all-zero even when every device gets pad
+        rows (n not divisible by the rows axis)."""
+        from horaedb_tpu.parallel.mesh import mesh_downsample
+
+        n, num_series = 5, 3  # rows axis is 4 -> 3 pad rows
+        ts = np.arange(n, dtype=np.int64)
+        sid = np.zeros(n, dtype=np.int32)
+        vals = np.ones(n)
+        out = mesh_downsample(
+            mesh8, ts, sid, vals, 0, 10, num_series=num_series,
+            num_buckets=1, valid_np=np.zeros(n, dtype=bool),
+        )
+        assert float(out["count"].sum()) == 0.0
+        assert float(out["sum"].sum()) == 0.0
+
+    def test_per_lane_pads_applied(self, mesh8):
+        (a, b), _valid = shard_rows(
+            mesh8, (np.arange(5, dtype=np.int64),
+                    np.ones(5, dtype=bool)),
+            pad_value=(99, False),
+        )
+        host_a = np.asarray(a)
+        host_b = np.asarray(b)
+        assert (host_a[5:] == 99).all()
+        assert not host_b[5:].any()

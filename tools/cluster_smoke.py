@@ -326,6 +326,186 @@ async def run(check) -> None:
         await fake.stop()
 
 
+async def run_split(check) -> None:
+    """Scatter-gather lanes: a regioned writer + one computing replica
+    split one range-aggregate query (fleet EXPLAIN proves >= 2 computing
+    nodes, partial-grid provenance, wire bytes at bucket scale), then
+    the chaos rung — the replica dies and the same query still answers
+    EXACTLY via the coordinator's local re-run."""
+    import aiohttp
+    from aiohttp import web
+
+    from horaedb_tpu.objstore.fake_s3 import FakeS3
+    from horaedb_tpu.objstore.resilient import ResilientStore
+    from horaedb_tpu.objstore.s3 import S3LikeConfig, S3LikeStore
+    from horaedb_tpu.server.config import Config
+    from horaedb_tpu.server.main import build_app
+
+    creds = dict(region="us-east-1", key_id="smoke", key_secret="smoke")
+    fake = FakeS3(bucket="cluster-smoke-split")
+    s3_url = await fake.start()
+
+    def bucket_store(name: str):
+        return ResilientStore(
+            S3LikeStore(S3LikeConfig(endpoint=s3_url,
+                                     bucket="cluster-smoke-split", **creds)),
+            name=name,
+        )
+
+    def cfg(port: int, node: str, role: str, peers: list) -> Config:
+        return Config.from_dict({
+            "port": port,
+            "metric_engine": {
+                "node_id": node,
+                "num_regions": 3,
+                "rules": {"enabled": False},
+                "telemetry": {"enabled": False},
+                "storage": {"object_store": {
+                    "data_dir": tempfile.mkdtemp(prefix=f"horaedb-cs-{node}-"),
+                }},
+                "cluster": {
+                    "enabled": True,
+                    "role": role,
+                    "watch_interval": "500ms",
+                    # health changes only through the explicit refreshes
+                    # below — no background probe races the chaos rung
+                    "probe_interval": "1h",
+                    "self_url": f"http://127.0.0.1:{port}",
+                    "peers": peers,
+                },
+            },
+        })
+
+    async def boot(config: Config, store):
+        app = await build_app(config, store=store)
+        runner = web.AppRunner(app, handler_cancellation=True,
+                               shutdown_timeout=1.0)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", config.port)
+        await site.start()
+        return runner
+
+    wport, rport = 28873, 28874
+    wrunner = await boot(
+        cfg(wport, "w1", "writer",
+            [{"node": "r1", "url": f"http://127.0.0.1:{rport}",
+              "role": "replica"}]),
+        bucket_store("w1"),
+    )
+    rrunner = await boot(
+        cfg(rport, "r1", "replica",
+            [{"node": "w1", "url": f"http://127.0.0.1:{wport}",
+              "role": "writer"}]),
+        bucket_store("r1"),
+    )
+    wbase = f"http://127.0.0.1:{wport}"
+    rbase = f"http://127.0.0.1:{rport}"
+    replica_dead = False
+    try:
+        async with aiohttp.ClientSession() as s:
+            # many series x many samples: the query aggregates row-scale
+            # input into bucket-scale output, which is the whole point
+            # of shipping partial grids instead of rows
+            n_series, n_samples = 12, 400
+            rows = [
+                (f"h{i}", 1000 + j * 500, float(i * 1000 + j))
+                for i in range(n_series) for j in range(n_samples)
+            ]
+            async with s.post(f"{wbase}/api/v1/write",
+                              data=make_payload("sg_metric", rows)) as r:
+                check(r.status == 200,
+                      f"regioned writer accepts the write ({r.status})")
+            async with s.post(f"{rbase}/api/v1/cluster/refresh") as r:
+                check(r.status == 200, "split-lane replica catches up")
+            async with s.post(f"{wbase}/api/v1/cluster/refresh") as r:
+                check(r.status == 200, "split-lane writer re-probes r1")
+
+            grid_q = {"metric": "sg_metric", "start_ms": 0,
+                      "end_ms": 1000 + n_samples * 500,
+                      "bucket_ms": 20_000, "explain": 1}
+
+            async def grid_query(headers=None):
+                async with s.post(f"{wbase}/api/v1/query", json=grid_q,
+                                  headers=headers or {}) as r:
+                    return r.status, await r.json()
+
+            # the oracle: the loop-guard header pins single-node local
+            # execution (a forwarded request never re-splits)
+            bs, baseline = await grid_query({"X-Horaedb-Forwarded": "smoke"})
+            check(bs == 200 and len(baseline["tsids"]) == n_series,
+                  f"single-node baseline answers ({bs}, "
+                  f"{len(baseline.get('tsids', []))} series)")
+
+            ds, dist = await grid_query()
+            check(ds == 200, f"split query answers ({ds})")
+            same = all(
+                dist.get(k) == baseline.get(k)
+                for k in ("tsids", "buckets", "truncated", "mean", "count")
+            )
+            check(same, "split-computed grid is EXACTLY the single-node "
+                        "answer (same JSON doubles, bit for bit)")
+            fleet = dist.get("explain", {}).get("fleet", {})
+            plan = fleet.get("distributed", {}).get("plan", {})
+            check(len(plan) >= 2,
+                  f"scatter plan spans >= 2 computing nodes ({plan})")
+            computing = [f for f in fleet.get("nodes", [])
+                         if f.get("regions")]
+            check(len(computing) >= 2,
+                  f"fleet EXPLAIN shows >= 2 nodes computing region "
+                  f"shards ({fleet.get('nodes')})")
+            check(fleet.get("partial") == 0,
+                  f"healthy split: no partial fragments ({fleet})")
+            remote = [f for f in fleet.get("nodes", [])
+                      if f.get("node") == "r1"]
+            check(bool(remote) and remote[0].get("wire_bytes", 0) > 0,
+                  f"partial-grid provenance carries per-fragment wire "
+                  f"bytes ({remote})")
+            wire = fleet.get("wire_bytes", 0)
+            row_bytes = len(rows) * 16  # (ts u64, value f64) per sample
+            check(0 < wire < row_bytes / 4,
+                  f"wire bytes are bucket-scale, far under row scale "
+                  f"({wire} vs {row_bytes} row bytes)")
+
+            # satellite family: the wire counter moved on both ends
+            async with s.get(f"{wbase}/metrics") as r:
+                wtext = await r.text()
+            check("horaedb_cluster_wire_bytes_total" in wtext,
+                  "/metrics exposes horaedb_cluster_wire_bytes_total")
+            rx = [ln for ln in wtext.splitlines()
+                  if ln.startswith("horaedb_cluster_wire_bytes_total")
+                  and 'kind="partial_grid"' in ln and 'direction="rx"' in ln]
+            check(bool(rx) and float(rx[0].rsplit(" ", 1)[1]) > 0,
+                  "coordinator counted partial_grid rx wire bytes")
+            async with s.get(f"{rbase}/metrics") as r:
+                rtext = await r.text()
+            tx = [ln for ln in rtext.splitlines()
+                  if ln.startswith("horaedb_cluster_wire_bytes_total")
+                  and 'kind="partial_grid"' in ln and 'direction="tx"' in ln]
+            check(bool(tx) and float(tx[0].rsplit(" ", 1)[1]) > 0,
+                  "replica counted partial_grid tx wire bytes")
+
+            # ---- chaos rung: kill the replica, re-ask the SAME query.
+            # The planned fragment dies on the wire; its region shards
+            # re-run locally — exact answer, degraded parallelism.
+            await rrunner.cleanup()
+            replica_dead = True
+            cs, chaos = await grid_query()
+            check(cs == 200, f"query survives replica death ({cs})")
+            same = all(
+                chaos.get(k) == baseline.get(k)
+                for k in ("tsids", "buckets", "truncated", "mean", "count")
+            )
+            check(same, "post-death answer is EXACT via local re-run")
+            cfleet = chaos.get("explain", {}).get("fleet", {})
+            check(cfleet.get("partial", 0) >= 1,
+                  f"dead fragment counted in fleet partial ({cfleet})")
+    finally:
+        if not replica_dead:
+            await rrunner.cleanup()
+        await wrunner.cleanup()
+        await fake.stop()
+
+
 def main() -> int:
     failures: list[str] = []
 
@@ -336,6 +516,7 @@ def main() -> int:
             failures.append(msg)
 
     asyncio.run(run(check))
+    asyncio.run(run_split(check))
     if failures:
         print(f"[cluster-smoke] {len(failures)} failure(s)")
         return 1

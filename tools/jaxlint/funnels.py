@@ -175,6 +175,21 @@ HTTP_VERB_TAILS = {
 SESSION_RECEIVERS = {"session", "_session", "client_session",
                      "http_session"}
 
+# J023: the partial-grid funnel (cluster/partial.py). The scatter-gather
+# wire codec and the coordinator merge are the load-bearing half of the
+# distributed bit-exactness promise: ONE encode/decode pair so every
+# fragment ships the same dtype-preserving LE layout, ONE merge with the
+# fixed canonical-region fold order. A second encoder or an ad-hoc
+# in-place fold (np.add.at / np.minimum.at / np.maximum.at on grids) in
+# server/cluster code silently reorders float addition and the
+# distributed answer stops matching single-node bit-for-bit.
+J023_MODULES = ("horaedb_tpu/cluster/", "horaedb_tpu/server/")
+J023_EXEMPT = ("horaedb_tpu/cluster/partial.py",)
+PARTIAL_GRID_FUNNEL_DEFS = {
+    "encode_partials", "decode_partials", "merge_partials", "merge_grids",
+}
+GRID_FOLD_UFUNC_HEADS = {"add", "minimum", "maximum"}
+
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
 STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
@@ -544,6 +559,48 @@ def check_traced_client_funnel(tree: ast.Module,
                 "injection, no shipped-back span graft) and to the "
                 "peer-health view; route through cluster/router."
                 "traced_request, or suppress with the reason",
+            ))
+
+
+def check_partial_grid_funnel(tree: ast.Module,
+                              findings: list[Finding]) -> None:
+    """J023, two prongs: (1) a function DEFINITION reusing a partial-grid
+    funnel name (`encode_partials`/`decode_partials`/`merge_partials`/
+    `merge_grids`) outside cluster/partial.py — a shadow codec or merge
+    forks the wire format / fold order; calling the funnel is fine.
+    (2) an in-place ufunc grid fold (`np.add.at`, `np.minimum.at`,
+    `np.maximum.at`) in cluster/server code — that is merge math, and
+    merge math outside the funnel loses the canonical-region fold order
+    the bit-exactness property test pins down."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in PARTIAL_GRID_FUNNEL_DEFS:
+                findings.append(Finding(
+                    node.lineno, "J023",
+                    f"partial-grid funnel name `{node.name}` redefined "
+                    "outside cluster/partial.py — a second wire codec or "
+                    "merge forks the fragment format and the canonical "
+                    "fold order behind the distributed bit-exactness "
+                    "guarantee; import it from cluster/partial.py, or "
+                    "suppress with the reason",
+                ))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "at"):
+            continue
+        owner = f.value
+        if (isinstance(owner, ast.Attribute)
+                and owner.attr in GRID_FOLD_UFUNC_HEADS):
+            findings.append(Finding(
+                node.lineno, "J023",
+                f"in-place ufunc fold `{dotted(node.func)}(...)` in "
+                "cluster/server code — partial-grid merge math belongs "
+                "in cluster/partial.merge_grids, where the fold runs in "
+                "the fixed canonical-region order that keeps the "
+                "distributed answer bit-exact vs single-node; call the "
+                "funnel, or suppress with the reason",
             ))
 
 

@@ -1,5 +1,6 @@
 """Per-file pass dispatcher: parses one file, applies every
-path-scoped per-file rule (J001-J017, J022), and returns RAW findings plus
+path-scoped per-file rule (J001-J017, J022-J023), and returns RAW findings
+plus
 the file's suppression table. Suppression filtering happens in the
 orchestrator (tools/jaxlint/__main__.py) AFTER the whole-program
 passes run, so the hygiene pass (J021) can see which suppressions
@@ -56,6 +57,7 @@ def run_perfile(path: Path, text: str,
     j017_assign = in_j017_base and not in_scope(
         posix, funnels.J017_ASSIGN_EXEMPT)
     in_j022_scope = scoped(posix, funnels.J022_MODULES, funnels.J022_EXEMPT)
+    in_j023_scope = scoped(posix, funnels.J023_MODULES, funnels.J023_EXEMPT)
 
     idx = jitrules.JitIndex()
     idx.visit(tree)
@@ -95,5 +97,7 @@ def run_perfile(path: Path, text: str,
         funnels.check_cluster_funnel(tree, findings, j017_views, j017_assign)
     if in_j022_scope:
         funnels.check_traced_client_funnel(tree, findings)
+    if in_j023_scope:
+        funnels.check_partial_grid_funnel(tree, findings)
     lockrules.check_lock_discipline(tree, findings)
     return findings, sup

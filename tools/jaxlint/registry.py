@@ -108,6 +108,10 @@ CHECKS: list[Check] = [
           _t(funnels.J022_MODULES), _t(funnels.J022_EXEMPT),
           "outbound cluster-tier HTTP (client session construction or "
           "verb call) outside the router's traced_request funnel"),
+    Check("J023", "partial-grid funnel", "perfile",
+          _t(funnels.J023_MODULES), _t(funnels.J023_EXEMPT),
+          "partial-grid wire codec/merge name redefined, or in-place "
+          "ufunc grid fold, outside cluster/partial.py"),
     Check("J999", "syntax error", "meta", ("tree",), (),
           "file fails to parse; every other pass skips it"),
 ]
