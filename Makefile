@@ -1,6 +1,6 @@
 # Build / test / bench entry points (reference: Makefile targets fmt/clippy/test)
 
-.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics bench-smoke chaos-smoke cluster-smoke clean soak dryruns tpu-suite
+.PHONY: test native bench baselines serve lint jaxlint typecheck smoke-metrics bench-smoke mem-smoke chaos-smoke cluster-smoke clean soak dryruns tpu-suite
 
 test:
 	python -m pytest tests/ -x -q
@@ -27,6 +27,7 @@ lint:
 	$(MAKE) jaxlint
 	$(MAKE) typecheck
 	$(MAKE) smoke-metrics
+	$(MAKE) mem-smoke
 	$(MAKE) bench-smoke
 	$(MAKE) chaos-smoke
 	$(MAKE) cluster-smoke
@@ -62,6 +63,16 @@ jaxlint:
 # missing (tools/smoke_metrics.py).
 smoke-metrics:
 	JAX_PLATFORMS=cpu python tools/smoke_metrics.py
+
+# Memory gate: pins the config-2 scan path's memtrace event counts
+# (allocs/copies/views per stage, cold + cache-hit) against the committed
+# benchmarks/mem_baseline.json — ROADMAP item 2's allocation-count
+# acceptance criteria as a gate — and measures memtrace's own cost
+# (track ns/event + scan-p50 A/B vs HORAEDB_MEMTRACE=off; target <2%).
+# Re-pin after an intentional data-plane change:
+#   python tools/mem_smoke.py --pin
+mem-smoke:
+	JAX_PLATFORMS=cpu python tools/mem_smoke.py
 
 # Aggregation-dispatch gate: a <120 s quick-shape bench.py --smoke on CPU
 # asserting the calibrated registry picks a valid impl, both A/B dicts are

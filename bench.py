@@ -1631,6 +1631,156 @@ def scan_encoded_lane(smoke: bool) -> dict:
     }
 
 
+def copy_tax_lane(smoke: bool) -> dict:
+    """Memory observatory lane (common/memtrace.py): the copy tax in
+    bytes per row, measured on a real storage tree.
+
+    - ingest leg: one write (sort + parquet encode + upload) under a
+      lineage ledger -> bytes copied/allocated per row ingested, by stage;
+    - scan leg: a cold full-table scan under a ledger -> bytes copied per
+      row scanned, by stage (the ROOFLINE §4 copy-tax numbers);
+    - overhead leg: the same scan timed with memtrace default vs off —
+      the ISSUE's <2% acceptance bar on query p50 (funnels perform the
+      identical array ops in both modes; only the ledger adds work)."""
+    import asyncio
+
+    import pyarrow as pa
+
+    from horaedb_tpu.common import memtrace
+    from horaedb_tpu.common.size_ext import ReadableSize
+    from horaedb_tpu.objstore import MemStore
+    from horaedb_tpu.storage import (
+        ObjectBasedStorage,
+        ScanRequest,
+        StorageConfig,
+        TimeRange,
+        WriteRequest,
+        scanstats,
+    )
+
+    n = 30_000 if smoke else 500_000
+    n_series = 64 if smoke else 512
+    rng = np.random.default_rng(11)
+    SEG = 24 * 3_600_000
+    t_lo = (1_700_000_000_000 // SEG + 1) * SEG
+    tsid = np.sort(rng.integers(0, n_series, n, dtype=np.int64))
+    ts = t_lo + (np.arange(n, dtype=np.int64) * 15_000) % SEG
+    vals = rng.normal(size=n)
+    schema = pa.schema([
+        ("tsid", pa.int64()), ("ts", pa.int64()), ("value", pa.float64()),
+    ])
+    # scan cache OFF: every pass pays materialize/host_prep, so the
+    # per-row tax is the cold-scan number ROOFLINE quotes
+    cfg = StorageConfig(scan_cache=ReadableSize(0))
+
+    async def build():
+        # pk = (tsid, ts): rows stay distinct under the LWW merge, so
+        # the scan leg reads all n rows, not one per series
+        eng = await ObjectBasedStorage.try_new(
+            "bench_mem", MemStore(), schema, num_primary_keys=2,
+            segment_duration_ms=SEG, config=cfg,
+            enable_compaction_scheduler=False,
+            start_background_merger=False,
+        )
+        return eng
+
+    async def write(eng):
+        batch = pa.RecordBatch.from_pydict(
+            {"tsid": tsid, "ts": ts, "value": vals}, schema=schema,
+        )
+        await eng.write(WriteRequest(
+            batch, TimeRange(int(ts.min()), int(ts.max()) + 1),
+        ))
+
+    async def scan_rows(eng) -> int:
+        rows = 0
+        req = ScanRequest(range=TimeRange(0, 2**62))
+        async for b in eng.scan(req):
+            rows += b.num_rows
+        return rows
+
+    def per_stage(verdict: dict, rows: int) -> dict:
+        return {
+            stage: {
+                "copied_bytes_per_row": round(
+                    row.get("copy_bytes", 0) / max(rows, 1), 2
+                ),
+                "alloc_bytes_per_row": round(
+                    row.get("alloc_bytes", 0) / max(rows, 1), 2
+                ),
+            }
+            for stage, row in sorted(verdict["per_stage"].items())
+        }
+
+    prior_mode = memtrace.mode()
+    memtrace.configure("")
+    try:
+        eng = asyncio.run(build())
+        try:
+            with scanstats.scan_stats() as st:
+                asyncio.run(write(eng))
+            ingest_v = memtrace.verdict(st.mem)
+            with scanstats.scan_stats() as st:
+                rows = asyncio.run(scan_rows(eng))
+            scan_v = memtrace.verdict(st.mem)
+
+            # overhead leg: median (p50) of N scans, default vs off —
+            # min-of-few is noise-dominated at millisecond scan times
+            def p50_scan(reps: int) -> float:
+                times = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    with scanstats.scan_stats():
+                        asyncio.run(scan_rows(eng))
+                    times.append(time.perf_counter() - t0)
+                times.sort()
+                return times[len(times) // 2]
+
+            reps = 7 if smoke else 9
+            p50_scan(2)  # warm both code paths
+            on_s = p50_scan(reps)
+            memtrace.configure("off")
+            off_s = p50_scan(reps)
+        finally:
+            asyncio.run(eng.close())
+    finally:
+        memtrace.configure(prior_mode)
+
+    return {
+        "copy_tax": {
+            "rows": n,
+            "ingest": {
+                "bytes_copied_per_row": round(
+                    ingest_v["bytes_copied"] / n, 2
+                ),
+                "bytes_allocated_per_row": round(
+                    ingest_v["bytes_allocated"] / n, 2
+                ),
+                "per_stage": per_stage(ingest_v, n),
+            },
+            "scan": {
+                "rows_scanned": rows,
+                "bytes_copied_per_row": round(
+                    scan_v["bytes_copied"] / max(rows, 1), 2
+                ),
+                "bytes_allocated_per_row": round(
+                    scan_v["bytes_allocated"] / max(rows, 1), 2
+                ),
+                "copies": scan_v["copies"],
+                "views": scan_v["views"],
+                "per_stage": per_stage(scan_v, rows),
+            },
+            "overhead": {
+                "scan_default_s": round(on_s, 4),
+                "scan_off_s": round(off_s, 4),
+                "overhead_pct": round(
+                    (on_s - off_s) / max(off_s, 1e-9) * 100, 2
+                ),
+            },
+        }
+    }
+
+
 def main() -> None:
     # Probe BEFORE touching jax in this process (jax.devices() itself hangs
     # on a wedged tunnel); on failure, force the CPU backend so the bench
@@ -1900,6 +2050,9 @@ def main() -> None:
     # cluster lane (horaedb_tpu/cluster): 1 writer vs writer + 2 read
     # replicas on one bucket — scale-out factor + replica lag p99
     result.update(cluster_scaleout_lane(SMOKE))
+    # memory observatory lane (common/memtrace.py): bytes copied per row
+    # ingested/scanned by stage + the memtrace-off overhead control
+    result.update(copy_tax_lane(SMOKE))
 
     # Last-chance accelerator retry, ONLY on the wedged-tunnel fallback
     # path (`not responsive`): the CPU fallback run itself took minutes —

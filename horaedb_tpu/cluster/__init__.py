@@ -151,7 +151,7 @@ def fleet_fragment(node: str, explain: dict | None) -> dict | None:
         "manifest_epoch": cluster.get("manifest_epoch"),
         "cluster": cluster,
     }
-    for key in ("serving", "admission", "encoding"):
+    for key in ("serving", "admission", "encoding", "memory"):
         if isinstance(explain.get(key), dict):
             frag[key] = explain[key]
     # scatter-gather provenance: which region shards this node computed

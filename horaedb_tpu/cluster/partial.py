@@ -35,6 +35,8 @@ import struct
 
 import numpy as np
 
+from horaedb_tpu.common import memtrace
+
 MAGIC = b"HDPG1\n"
 WIRE_CONTENT_TYPE = "application/x-horaedb-partial-grids"
 # grid keys in canonical wire order (extra keys append after, sorted)
@@ -67,14 +69,16 @@ def encode_partials(
     def _append(buf: bytes) -> int:
         nonlocal offset
         blobs.append(buf)
+        # each tobytes() serialization is a real copy onto the wire
+        memtrace.track_bytes(len(buf), "wire_codec", "copy")
         start = offset
         offset += len(buf)
         return start
 
     regions = []
     for region_id, tsids, grids in parts:
-        t = np.ascontiguousarray(
-            np.asarray(list(tsids), dtype=np.uint64)
+        t = memtrace.tracked_contiguous(
+            np.asarray(list(tsids), dtype=np.uint64), "wire_codec"
         )
         if t.dtype.byteorder == ">":  # pragma: no cover — BE hosts
             t = t.byteswap().view(t.dtype.newbyteorder("<"))
@@ -86,7 +90,9 @@ def encode_partials(
         }
         n_buckets = None
         for key in _key_order(grids):
-            g = np.ascontiguousarray(np.asarray(grids[key]))
+            g = memtrace.tracked_contiguous(
+                np.asarray(grids[key]), "wire_codec"
+            )
             if g.dtype.byteorder == ">":  # pragma: no cover — BE hosts
                 g = g.byteswap().view(g.dtype.newbyteorder("<"))
             n_buckets = int(g.shape[1]) if g.ndim == 2 else 0

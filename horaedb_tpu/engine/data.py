@@ -18,7 +18,7 @@ import time
 import numpy as np
 import pyarrow as pa
 
-from horaedb_tpu.common import tracing
+from horaedb_tpu.common import memtrace, tracing
 from horaedb_tpu.common.aio import TaskGroup
 from horaedb_tpu.engine.flush_executor import (
     FLUSH_FAILURES_TOTAL,
@@ -600,7 +600,10 @@ class SampleManager:
                 lanes0, presorted0 = group[0]
             else:
                 lanes0 = tuple(
-                    np.concatenate([g[0][j] for g in group]) for j in range(4)
+                    memtrace.tracked_concat(
+                        [g[0][j] for g in group], "seal"
+                    )
+                    for j in range(4)
                 )
                 presorted0 = False  # concatenation breaks per-group order
             try:
@@ -617,7 +620,10 @@ class SampleManager:
         try:
             for _seg_start, cols_list in sorted(buf.items()):
                 seg_cols = [
-                    np.concatenate([c[i] for c in cols_list]) for i in range(4)
+                    memtrace.tracked_concat(
+                        [c[i] for c in cols_list], "seal"
+                    )
+                    for i in range(4)
                 ]
                 await self._write_segment(*seg_cols, seq=snap_seq, fast=True)
             if cols is not None:
@@ -810,11 +816,15 @@ class SampleManager:
         out without limit on a small host."""
         batch = pa.RecordBatch.from_pydict(
             {
-                "metric_id": np.ascontiguousarray(metric_ids, dtype=np.uint64),
-                "tsid": np.ascontiguousarray(tsids, dtype=np.uint64),
+                "metric_id": memtrace.tracked_contiguous(
+                    np.asarray(metric_ids, dtype=np.uint64), "append"
+                ),
+                "tsid": memtrace.tracked_contiguous(
+                    np.asarray(tsids, dtype=np.uint64), "append"
+                ),
                 "field_id": _zeros_u64(len(ts)),
-                "ts": np.ascontiguousarray(ts),
-                "value": np.ascontiguousarray(values),
+                "ts": memtrace.tracked_contiguous(ts, "append"),
+                "value": memtrace.tracked_contiguous(values, "append"),
             },
             schema=DATA_SCHEMA,
         )

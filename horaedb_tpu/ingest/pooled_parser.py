@@ -12,7 +12,7 @@ import logging
 
 import numpy as np
 
-from horaedb_tpu.common import tracing
+from horaedb_tpu.common import memtrace, tracing
 from horaedb_tpu.ingest.types import ParsedWriteRequest
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
 
@@ -55,6 +55,10 @@ class DecodeArena:
             buf = np.empty(cap, dt)
             self._bufs[tag] = buf
             self.allocations += 1
+            memtrace.track_bytes(buf.nbytes, "parse", "alloc")
+        else:
+            # steady state: a pooled buffer reissued, zero fresh bytes
+            memtrace.track_bytes(int(n) * dt.itemsize, "parse", "reuse")
         return buf[:n]
 
 PARSE_SECONDS = GLOBAL_METRICS.histogram(

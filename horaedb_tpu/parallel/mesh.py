@@ -26,6 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from horaedb_tpu.common import memtrace
 from horaedb_tpu.common.error import ensure
 
 
@@ -116,16 +117,26 @@ def mesh_downsample(
     val_dtype = np.float32 if accel else np.float64
     row_ok = (
         np.ones(len(ts_np), dtype=bool) if valid_np is None
-        else np.ascontiguousarray(valid_np, dtype=bool)
+        else memtrace.tracked_contiguous(
+            np.asarray(valid_np, dtype=bool), "h2d"
+        )
     )
+    host_lanes = (
+        memtrace.tracked_contiguous(
+            np.asarray(ts_np, dtype=np.int64), "h2d"
+        ),
+        memtrace.tracked_contiguous(
+            np.asarray(sid_np, dtype=np.int32), "h2d"
+        ),
+        memtrace.tracked_contiguous(
+            np.asarray(val_np, dtype=val_dtype), "h2d"
+        ),
+        row_ok,
+    )
+    memtrace.device_staged(sum(int(a.nbytes) for a in host_lanes), "h2d")
     (ts_d, sid_d, val_d, ok_d), _pad_valid = shard_rows(
         mesh,
-        (
-            np.ascontiguousarray(ts_np, dtype=np.int64),
-            np.ascontiguousarray(sid_np, dtype=np.int32),
-            np.ascontiguousarray(val_np, dtype=val_dtype),
-            row_ok,
-        ),
+        host_lanes,
         pad_value=(0, padded_series, 0, False),
     )
     # pad rows carry ok=False (False pad on the bool lane), so ok_d

@@ -31,6 +31,7 @@ except ImportError:  # 3.10 images ship the API-identical backport
     import tomli as tomllib
 from dataclasses import dataclass, field
 
+from horaedb_tpu.common import memtrace as _memtrace_mod
 from horaedb_tpu.common import tracing as _tracing_mod
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.time_ext import ReadableDuration
@@ -373,6 +374,9 @@ class MetricEngineConfig:
         default_factory=lambda: _cluster_mod().ClusterConfig()
     )
     storage: EngineStorageConfig = field(default_factory=EngineStorageConfig)
+    # Data-plane memory observatory ([metric_engine.memory],
+    # common/memtrace.py): per-query buffer-lineage tracing mode.
+    memory: "MemoryConfig" = field(default_factory=lambda: MemoryConfig())
     # Ingest buffering (engine/data.py SampleManager): 0 = every write is
     # immediately durable (reference write==SST semantics); > 0 buffers up
     # to that many rows (flushed at the threshold, on the flush interval,
@@ -398,6 +402,25 @@ class MetricEngineConfig:
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "MetricEngineConfig":
+        return _from_dict(cls, d)
+
+
+@dataclass
+class MemoryConfig:
+    """Data-plane memory observatory knobs ([metric_engine.memory],
+    common/memtrace.py). The default comes from HORAEDB_MEMTRACE (via
+    memtrace.env_default), so build_app applying this config never
+    clobbers an env override set without a config section; an explicit
+    config value wins over both."""
+
+    # "" (default: cheap per-query lineage ledger), "deep" (adds
+    # tracemalloc peak-delta + top allocation sites per query — debug
+    # only), "off" (no-op collectors; the funnels still perform their
+    # array ops, so the data path is byte-identical).
+    memtrace: str = field(default_factory=lambda: _memtrace_mod.env_default())
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "MemoryConfig":
         return _from_dict(cls, d)
 
 
@@ -523,6 +546,11 @@ class Config:
         ensure(rules.tenant_weight > 0,
                "rules.tenant_weight must be positive")
         ensure(bool(rules.tenant), "rules.tenant must be non-empty")
+        ensure(
+            self.metric_engine.memory.memtrace in _memtrace_mod.MODES,
+            f"memory.memtrace must be one of {sorted(_memtrace_mod.MODES)}, "
+            f"got {self.metric_engine.memory.memtrace!r}",
+        )
         tel = self.metric_engine.telemetry
         ensure(tel.scrape_interval.seconds > 0,
                "telemetry.scrape_interval must be positive")

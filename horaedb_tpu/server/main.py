@@ -50,7 +50,8 @@ import pyarrow as pa
 from aiohttp import web
 
 from horaedb_tpu.common import deadline as deadline_ctx
-from horaedb_tpu.common import tracing, xprof
+from horaedb_tpu.common import memtrace, tracing, xprof
+from horaedb_tpu.common.bytebudget import GLOBAL_POOLS, rss_bytes
 from horaedb_tpu.common.error import (
     DeadlineExceeded,
     HoraeError,
@@ -555,6 +556,7 @@ async def _run_distributed(state: "ServerState", req, q: dict, tenant: str,
         raise
     parts = list(parts)
     frags: list[dict] = []
+    memory_frags: list[dict] = []
     failed: list[int] = []
     partial_count = 0
     wire_bytes = 0
@@ -578,10 +580,15 @@ async def _run_distributed(state: "ServerState", req, q: dict, tenant: str,
         prov = dict(header.get("provenance") or {})
         prov.setdefault("regions", remote_plan[node])
         prov["wire_bytes"] = len(payload)
+        mem_frag = prov.pop("memory", None)
+        if isinstance(mem_frag, dict):
+            memory_frags.append(mem_frag)
         frag = cluster_mod.fleet_fragment(
             header.get("node", node), {"cluster": prov}
         )
         if frag is not None:
+            if isinstance(mem_frag, dict):
+                frag["memory"] = mem_frag
             frags.append(frag)
     if failed:
         # degrade ladder rung 2: the coordinator owns every region
@@ -595,6 +602,7 @@ async def _run_distributed(state: "ServerState", req, q: dict, tenant: str,
     )
     dist = {
         "fragments": frags,
+        "memory_fragments": memory_frags,
         "partial": partial_count,
         "wire_bytes": wire_bytes,
         "regions_local": my_regions,
@@ -850,6 +858,9 @@ async def handle_metrics(request: web.Request) -> web.Response:
             table.manifest.deltas_num,
         )
     METRICS.set("horaedb_ingest_buffered_rows", buffered)
+    # unified pool registry: pull occupancy from the live cache owners
+    # right before render, so horaedb_pool_* gauges are scrape-fresh
+    GLOBAL_POOLS.refresh()
     # content negotiation: OpenMetrics (with # EOF + trace-id exemplars
     # on the latency histograms) when the scraper asks for it; classic
     # Prometheus text otherwise
@@ -1186,6 +1197,11 @@ def _explain_payload(st, mode: str, admission_verdict: dict | None = None) -> di
         "encoding": encoding,
         "serving": serving_verdict,
         "batching": batching_verdict,
+        # memory provenance (common/memtrace.py): the buffer-lineage
+        # verdict — bytes allocated/copied per stage, copies vs views,
+        # device staging bytes, peak-delta + top sites under deep mode.
+        # Pinned schema (memtrace.VERDICT_KEYS); zeros when tracing off.
+        "memory": memtrace.verdict(getattr(st, "mem", None)),
         "counts": counts,
         "kernels": kernels,
     }
@@ -1516,6 +1532,9 @@ async def handle_query(request: web.Request) -> web.Response:
             {int(p[0]) for p in parts}
             | set(req.regions if req.regions is not None else ())
         )
+        # leaf memory verdict rides the fragment header so the
+        # coordinator can graft it into the federated memory verdict
+        prov["memory"] = memtrace.verdict(getattr(st, "mem", None))
         payload = encode_partials(
             cl.node_id if cl is not None else "local", parts,
             provenance=prov,
@@ -1536,6 +1555,12 @@ async def handle_query(request: web.Request) -> web.Response:
             partial=dist["partial"], wire_bytes=dist["wire_bytes"],
         )
         explain["fleet"]["distributed"] = {"plan": dist["plan"]}
+        # graft remote leaf memory verdicts into the coordinator's own:
+        # scalars add, peaks max — the fleet-wide copy tax of this query
+        for mem_frag in dist.get("memory_fragments", ()):
+            explain["memory"] = memtrace.verdict_merge(
+                explain["memory"], mem_frag
+            )
     if q.get("exemplars"):
         if table is None:
             return web.json_response(
@@ -1815,6 +1840,24 @@ async def handle_debug_slowlog(request: web.Request) -> web.Response:
         "min_duration_s": state.slowlog.min_duration_s,
         "corrupt_skipped": corrupt,
         "entries": entries,
+    })
+
+
+async def handle_debug_memory(request: web.Request) -> web.Response:
+    """`GET /debug/memory`: the data-plane memory observatory on one
+    page — unified pool occupancy (all five byte-budgeted caches through
+    the common/bytebudget registry), process RSS, the per-stage copy-tax
+    table accumulated since boot, and the memtrace mode. Every number is
+    a read-back of state the process already keeps; the handler computes
+    nothing new."""
+    pools = GLOBAL_POOLS.refresh()
+    return web.json_response({
+        "memtrace_mode": memtrace.mode() or "default",
+        "rss_bytes": rss_bytes(),
+        "pools": pools,
+        # since-boot lineage aggregate, sorted by bytes moved: the
+        # fleet-independent face of the per-query EXPLAIN verdict
+        "copy_tax": memtrace.copy_tax_table(),
     })
 
 
@@ -2511,6 +2554,9 @@ async def build_app(config: Config, store=None) -> web.Application:
     from concurrent.futures import ThreadPoolExecutor
 
     config.validate()
+    # memory observatory mode ([metric_engine.memory] memtrace, default
+    # from HORAEDB_MEMTRACE — the config never clobbers an env override)
+    memtrace.configure(config.metric_engine.memory.memtrace)
     store_cfg = config.metric_engine.storage.object_store
     # imported at boot so horaedb_agg_impl_total renders on /metrics even
     # before the first aggregate dispatch
@@ -2907,6 +2953,7 @@ async def build_app(config: Config, store=None) -> web.Application:
             web.get("/debug/traces/{id}", handle_debug_trace),
             web.get("/debug/kernels", handle_debug_kernels),
             web.get("/debug/slowlog", handle_debug_slowlog),
+            web.get("/debug/memory", handle_debug_memory),
             web.get("/debug/cluster", handle_debug_cluster),
         ]
     )

@@ -232,6 +232,10 @@ def build_entry(trace_dict: dict, explain: dict | None) -> dict:
         "duration_s": trace_dict.get("duration_s"),
         "recorded_unix_ms": int(time.time() * 1000),
         "nodes": sorted(nodes),
+        # the memory verdict at top level: "was this slow request slow
+        # because it copied" answers from the listing without opening
+        # the full plan (same payload as explain["memory"], one level up)
+        "memory": explain.get("memory") if isinstance(explain, dict) else None,
         "explain": explain,
         "trace": trace_dict,
     }

@@ -49,6 +49,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from horaedb_tpu.common import memtrace
+from horaedb_tpu.common.bytebudget import GLOBAL_POOLS
 from horaedb_tpu.serving import (
     CACHE_BYTES,
     CACHE_ENTRIES,
@@ -97,12 +99,19 @@ class ResultCache:
         # purge with error isolation.
         self._subscribers: dict[int, object] = {}
         self._next_token = 1
+        # unified pool registry (common/bytebudget.py): occupancy is read
+        # back through a weakref provider, evictions route to the pool
+        GLOBAL_POOLS.register_provider(
+            "result", self,
+            lambda c: (c._bytes, len(c._entries)),
+        )
 
     # -- sizing ---------------------------------------------------------------
     def configure(self, capacity_bytes: int) -> None:
         with self._lock:
             self._cap = capacity_bytes
             self._shrink_locked()
+        GLOBAL_POOLS.set_capacity("result", capacity_bytes)
         self._export()
 
     @property
@@ -127,6 +136,7 @@ class ResultCache:
                 if not keys:
                     del self._by_root[root]
             CACHE_EVICTIONS.inc()
+            GLOBAL_POOLS.note_eviction("result")
 
     # -- the planner's read side (jaxlint J013: choke point only) -------------
     def serving_get(self, key: bytes):
@@ -191,6 +201,9 @@ class ResultCache:
         if self._cap <= 0 or nbytes > self._cap // 4:
             return  # one panel must not dominate the whole budget
         _freeze(value)
+        # lineage: the cache retains a VIEW of the caller's result arrays
+        # (no bytes move on a fill — the charge is residency, not a copy)
+        memtrace.track_bytes(nbytes, "result_fill", "view")
         with self._lock:
             if key in self._entries:
                 return

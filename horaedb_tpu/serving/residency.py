@@ -33,6 +33,8 @@ from collections import OrderedDict
 import numpy as np
 import pyarrow as pa
 
+from horaedb_tpu.common import memtrace
+from horaedb_tpu.common.bytebudget import GLOBAL_POOLS
 from horaedb_tpu.serving import RESIDENCY, RESIDENT_BLOCKS, RESIDENT_BYTES
 
 logger = logging.getLogger(__name__)
@@ -63,12 +65,17 @@ class DeviceBlockCache:
         self._heat: "OrderedDict[tuple, int]" = OrderedDict()
         self._heat_cap = 8192
         self._lock = threading.Lock()
+        GLOBAL_POOLS.register_provider(
+            "residency", self,
+            lambda c: (c._bytes, len(c._blocks)),
+        )
 
     def configure(self, capacity_bytes: int, admit_after: int = 2) -> None:
         with self._lock:
             self._cap = capacity_bytes
             self._admit_after = max(1, admit_after)
             self._shrink_locked()
+        GLOBAL_POOLS.set_capacity("residency", capacity_bytes)
         self._export()
 
     @property
@@ -87,6 +94,7 @@ class DeviceBlockCache:
         while self._bytes > self._cap and self._blocks:
             _k, (_t, _d, nb) = self._blocks.popitem(last=False)
             self._bytes -= nb
+            GLOBAL_POOLS.note_eviction("residency")
 
     # -- read side (reached only via storage/read.py's rg hooks) --------------
     def resident_block(self, sst_id: int, rg: int, cols_key: tuple):
@@ -138,7 +146,9 @@ class DeviceBlockCache:
         dev_bytes = 0
         for name, col in zip(table.schema.names, table.columns):
             try:
-                arr = col.combine_chunks().to_numpy(zero_copy_only=False)
+                arr = memtrace.tracked_combine(
+                    col, "residency_fill"
+                ).to_numpy(zero_copy_only=False)
             except Exception:  # noqa: BLE001 — non-numeric lane (labels)
                 continue
             if arr.dtype == object:
@@ -147,6 +157,9 @@ class DeviceBlockCache:
             if dev is not None:
                 device_lanes[name] = dev
                 dev_bytes += arr.nbytes
+                # the HBM pin is a real second copy of the lane — the
+                # staging odometer and the byte budget both charge it
+                memtrace.device_staged(arr.nbytes, "residency_fill")
         total = size + dev_bytes
         with self._lock:
             if key in self._blocks or total > self._cap // 4:

@@ -43,7 +43,9 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from horaedb_tpu.common import deadline as deadline_ctx
+from horaedb_tpu.common import memtrace
 from horaedb_tpu.common import tracing
+from horaedb_tpu.common.bytebudget import GLOBAL_POOLS
 from horaedb_tpu.common.error import HoraeError, ensure
 from horaedb_tpu.common.xprof import xjit
 from horaedb_tpu.objstore import ObjectStore
@@ -1041,6 +1043,22 @@ class ParquetReader:
         # must not repopulate the caches after eviction (the entry would
         # leak forever). Bounded FIFO — old ids' reads are long finished.
         self._evicted_ids: "OrderedDict[int, None]" = OrderedDict()
+        # unified pool registry (common/bytebudget.py): the reader's two
+        # byte-budgeted caches report occupancy via weakref providers
+        # (readers are per-table and come and go with engines — a pushed
+        # gauge would drift; the provider sums only live readers)
+        GLOBAL_POOLS.register_provider(
+            "scan", self,
+            lambda r: (r._blk_cache_bytes, len(r._blk_cache)),
+        )
+        GLOBAL_POOLS.register_provider(
+            "sidecar", self,
+            lambda r: (r._enc_cache_bytes, len(r._enc_cache)),
+        )
+        if scan_cache_bytes:
+            GLOBAL_POOLS.set_capacity("scan", scan_cache_bytes)
+        if enc_cache_bytes:
+            GLOBAL_POOLS.set_capacity("sidecar", enc_cache_bytes)
 
     def _tombstoned(self, sst_id: int) -> bool:
         return sst_id in self._evicted_ids
@@ -1062,7 +1080,7 @@ class ParquetReader:
             if t is None:
                 return None
             parts.append(t)
-        return pa.concat_tables(parts)
+        return memtrace.tracked_concat_tables(parts, "host_prep")
 
     def _rg_cache_hooks(self, sst_id: int, cols_key: tuple):
         """(get, put) closures for _read_pruned, or None when disabled.
@@ -1117,8 +1135,18 @@ class ParquetReader:
                 if self._tombstoned(sst_id):
                     return t
                 # host-cache hits feed the heat gate too: the second touch
-                # of a hot block promotes it to the pinned tier
-                residency.note_fetch(sst_id, rg, cols_key, t)
+                # of a hot block promotes it to the pinned tier. On
+                # promotion the HOST entry is dropped: both tiers retain
+                # the same pa.Table object, and charging table.nbytes to
+                # both budgets double-counted every hot block's resident
+                # bytes (the doppelganger audit, tests/test_memtrace.py)
+                if residency.note_fetch(sst_id, rg, cols_key, t):
+                    with self._blk_lock:
+                        old = self._blk_cache.pop(
+                            (sst_id, rg, cols_key), None
+                        )
+                        if old is not None:
+                            self._blk_cache_bytes -= old.nbytes
             return t
 
         def put(rg: int, table: pa.Table) -> None:
@@ -1130,8 +1158,12 @@ class ParquetReader:
                     # residency admission runs BEFORE the host-cache size
                     # gate: its budget (and cap//4 dominate-check) is its
                     # own — a block too big for the host cache can still
-                    # earn a device pin
-                    residency.note_fetch(sst_id, rg, cols_key, table)
+                    # earn a device pin. An admitted block skips the host
+                    # insert entirely: the pinned tier serves it first on
+                    # every later get(), so a host copy would be pure
+                    # double-charged residency (the doppelganger audit)
+                    if residency.note_fetch(sst_id, rg, cols_key, table):
+                        return
             if size > self._blk_cache_cap // 4:
                 return  # one entry must not dominate the cache
             with self._blk_lock:
@@ -1142,6 +1174,7 @@ class ParquetReader:
                 while self._blk_cache_bytes > self._blk_cache_cap and self._blk_cache:
                     _k, old = self._blk_cache.popitem(last=False)
                     self._blk_cache_bytes -= old.nbytes
+                    GLOBAL_POOLS.note_eviction("scan")
 
         return get, put
 
@@ -1368,6 +1401,7 @@ class ParquetReader:
                 while self._enc_cache_bytes > self._enc_cache_cap and self._enc_cache:
                     _, (_, nb) = self._enc_cache.popitem(last=False)
                     self._enc_cache_bytes -= nb
+                    GLOBAL_POOLS.note_eviction("sidecar")
 
     async def _fetch_enc_sidecar(self, sst: SstFile):
         """One store fetch + decode of an SST's `.enc` object. Returns
@@ -1493,6 +1527,8 @@ class ParquetReader:
                 arrays.append(_np_to_arrow(arr, fields[names.index(n)].type))
             scanstats.note("encoded_bytes", enc_bytes)
             scanstats.note("decoded_bytes", dec_bytes)
+            # lineage: every decoded lane is a fresh host buffer
+            memtrace.track_bytes(dec_bytes, "decode", "alloc")
         return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
 
     def _mask_visibility(self, sst: SstFile, table: pa.Table) -> pa.Table:
@@ -1623,7 +1659,7 @@ class ParquetReader:
             # touches only key/predicate lanes, which _merge_table combines
             # per-column on demand, and arrow take handles chunked input —
             # measured 35% of config-2 wall clock saved
-            table = pa.concat_tables(tables)
+            table = memtrace.tracked_concat_tables(tables, "host_prep")
         out_names = self._output_names(read_names, keep_builtin)
 
         # append mode with binary VALUE columns concatenates group bytes on
@@ -1654,7 +1690,13 @@ class ParquetReader:
         if len(idx) == 0:
             return []
         with scanstats.stage("materialize"):
-            result = table.select(out_names).take(pa.array(idx)).combine_chunks()
+            # arrow take materializes fresh column buffers (the ONE copy
+            # this plan shape pays); combine then flattens any chunking
+            taken = memtrace.track(
+                table.select(out_names).take(pa.array(idx)),
+                "materialize", "copy",
+            )
+            result = memtrace.tracked_combine(taken, "materialize")
         batches = result.to_batches(max_chunksize=batch_size)
         return [b for b in batches if b.num_rows > 0]
 
@@ -1666,7 +1708,9 @@ class ParquetReader:
         def col_of(name: str) -> np.ndarray:
             a = cache.get(name)
             if a is None:
-                a = arrow_column_to_numpy(table.column(name).combine_chunks())
+                a = arrow_column_to_numpy(
+                    memtrace.tracked_combine(table.column(name), "host_prep")
+                )
                 cache[name] = a
             return a
 
@@ -1731,7 +1775,10 @@ class ParquetReader:
             chunk, chunk_rows = [], 0
             if not tables:
                 return
-            t = pa.concat_tables(tables).combine_chunks()
+            t = memtrace.tracked_combine(
+                memtrace.tracked_concat_tables(tables, "host_prep"),
+                "host_prep",
+            )
             if predicate is not None:
                 mask = filter_ops.eval_predicate_host(predicate, t)
                 t = t.filter(pa.array(mask))
@@ -1746,18 +1793,24 @@ class ParquetReader:
         await flush()
         if not filtered:
             return []
-        table = pa.concat_tables(filtered).combine_chunks()
+        table = memtrace.tracked_combine(
+            memtrace.tracked_concat_tables(filtered, "host_prep"),
+            "host_prep",
+        )
 
         pk_names = schema.primary_key_names
         sort_keys = [(n, "ascending") for n in pk_names] + [(SEQ_COLUMN_NAME, "ascending")]
-        table = table.sort_by(sort_keys).combine_chunks()
+        table = memtrace.tracked_combine(
+            memtrace.track(table.sort_by(sort_keys), "host_prep", "copy"),
+            "host_prep",
+        )
 
         if schema.update_mode == UpdateMode.OVERWRITE and table.num_rows > 1:
             n = table.num_rows
             next_differs = np.zeros(n, dtype=bool)
             next_differs[-1] = True
             for name in pk_names:
-                col = table.column(name).combine_chunks()
+                col = memtrace.tracked_combine(table.column(name), "host_prep")
                 neq = pc.fill_null(
                     pc.not_equal(col.slice(0, n - 1), col.slice(1, n)), True
                 ).to_numpy(zero_copy_only=False)
@@ -1775,7 +1828,9 @@ class ParquetReader:
                 starts = np.zeros(n, dtype=bool)
                 starts[0] = True
                 for name in pk_names:
-                    col = table.column(name).combine_chunks()
+                    col = memtrace.tracked_combine(
+                        table.column(name), "host_prep"
+                    )
                     neq = pc.fill_null(
                         pc.not_equal(col.slice(1, n), col.slice(0, n - 1)), True
                     ).to_numpy(zero_copy_only=False)
@@ -1807,7 +1862,9 @@ class ParquetReader:
                 table = pa.Table.from_batches(groups)
 
         out_names = self._output_names(read_names, keep_builtin)
-        result = table.select(out_names).combine_chunks()
+        result = memtrace.tracked_combine(
+            table.select(out_names), "materialize"
+        )
         batches = result.to_batches(max_chunksize=batch_size)
         return [b for b in batches if b.num_rows > 0]
 
@@ -1839,13 +1896,18 @@ class ParquetReader:
         )
 
         arrays = {
-            name: arrow_column_to_numpy(table.column(name).combine_chunks())
+            name: arrow_column_to_numpy(
+                memtrace.tracked_combine(table.column(name), "host_prep")
+            )
             for name in numeric_names
         }
         if extra_arrays:
             arrays.update(extra_arrays)
         with scanstats.stage("h2d"):
             block = Block.from_numpy(arrays, pad_keys=sort_keys)
+            memtrace.device_staged(
+                sum(int(a.nbytes) for a in arrays.values()), "h2d"
+            )
 
         template, raw_literals = filter_ops.split_literals(predicate)
         literals = filter_ops.literal_arrays(
@@ -1956,9 +2018,16 @@ class ParquetReader:
                     continue
                 with scanstats.stage("host_prep"):
                     tables = _order_tables_by_first_key(tables, sort_keys)
-                    table = pa.concat_tables(tables).combine_chunks()
+                    table = memtrace.tracked_combine(
+                        memtrace.tracked_concat_tables(tables, "host_prep"),
+                        "host_prep",
+                    )
                     arrays = {
-                        name: arrow_column_to_numpy(table.column(name).combine_chunks())
+                        name: arrow_column_to_numpy(
+                            memtrace.tracked_combine(
+                                table.column(name), "host_prep"
+                            )
+                        )
                         for name in table.schema.names
                     }
                 # double buffer: chunk i's kernel was dispatched last
@@ -1992,7 +2061,10 @@ class ParquetReader:
                     next_level.append(group[0])
                     continue
                 cat = {
-                    k: np.concatenate([g[k] for g in group]) for k in group[0]
+                    k: memtrace.tracked_concat(
+                        [g[k] for g in group], "host_prep"
+                    )
+                    for k in group[0]
                 }
                 next_level.append(run_block(cat, None))
             if len(next_level) == len(level):
@@ -2001,7 +2073,10 @@ class ParquetReader:
                 # merging everything would defeat the memory bound)
                 next_level.sort(key=lambda r: len(r[sort_keys[0]]))
                 a, b = next_level[0], next_level[1]
-                cat = {k: np.concatenate([a[k], b[k]]) for k in a}
+                cat = {
+                    k: memtrace.tracked_concat([a[k], b[k]], "host_prep")
+                    for k in a
+                }
                 next_level = [run_block(cat, None)] + next_level[2:]
             level = next_level
         if not level:
@@ -2148,9 +2223,16 @@ class ParquetReader:
                 tables,
                 tuple(self._schema.primary_key_names) + (SEQ_COLUMN_NAME,),
             )
-            table = pa.concat_tables(tables).combine_chunks()
+            table = memtrace.tracked_combine(
+                memtrace.tracked_concat_tables(tables, "host_prep"),
+                "host_prep",
+            )
             sid, sid_hit = dense_sid(
-                arrow_column_to_numpy(table.column(series_column).combine_chunks())
+                arrow_column_to_numpy(
+                    memtrace.tracked_combine(
+                        table.column(series_column), "host_prep"
+                    )
+                )
             )
 
         fast = (
@@ -2236,12 +2318,16 @@ class ParquetReader:
             return None
         if num_series >= (1 << self._PACK_SID_BITS):
             return None
-        ts_np = arrow_column_to_numpy(table.column(ts_column).combine_chunks())
+        ts_np = arrow_column_to_numpy(
+            memtrace.tracked_combine(table.column(ts_column), "host_prep")
+        )
         n = len(ts_np)
         if n == 0:
             return (np.empty(0, np.int64),) * 3
         seq_np = arrow_column_to_numpy(
-            table.column(SEQ_COLUMN_NAME).combine_chunks()
+            memtrace.tracked_combine(
+                table.column(SEQ_COLUMN_NAME), "host_prep"
+            )
         )
         uniq_seq = np.unique(seq_np)
         if len(uniq_seq) > (1 << self._PACK_SEQ_BITS):
@@ -2250,7 +2336,7 @@ class ParquetReader:
         span = int(ts_np.max()) - ts_min
         if span >= (1 << self._PACK_TS_BITS):
             return None
-        mask = sid_valid.copy()
+        mask = memtrace.tracked_copy(sid_valid, "host_prep")
         if predicate is not None:
             mask = mask & filter_ops.eval_predicate_host(predicate, table)
         srank = (
@@ -2277,7 +2363,7 @@ class ParquetReader:
         keep &= packed_s < sink
         idx = perm[keep]
         val_np = arrow_column_to_numpy(
-            table.column(value_column).combine_chunks()
+            memtrace.tracked_combine(table.column(value_column), "host_prep")
         )
         return (
             ts_np[idx],
@@ -2350,7 +2436,12 @@ class ParquetReader:
         for name in out_names:
             f = table.schema.field(name)
             if name in binary_names:
-                src = table.column(name).combine_chunks().take(pa.array(perm[:kept]))
+                src = memtrace.track(
+                    memtrace.tracked_combine(
+                        table.column(name), "materialize"
+                    ).take(pa.array(perm[:kept])),
+                    "materialize", "copy",
+                )
                 if name in value_names:
                     vals = src.to_pylist()
                     joined = [
@@ -2422,9 +2513,10 @@ def _read_pruned(
             t = get(rg)
             if t is None:
                 t = pf.read_row_group(rg, columns=columns, use_threads=True)
+                memtrace.track(t, "materialize", "alloc")
                 put(rg, t)
             parts.append(t)
-        return pa.concat_tables(parts)
+        return memtrace.tracked_concat_tables(parts, "materialize")
     return pf.read_row_groups(keep_groups, columns=columns, use_threads=True)
 
 

@@ -52,6 +52,8 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from horaedb_tpu.common import memtrace
+from horaedb_tpu.common.bytebudget import GLOBAL_POOLS
 from horaedb_tpu.common.error import HoraeError, ensure
 from horaedb_tpu.storage.types import TimeRange
 
@@ -68,6 +70,19 @@ _CACHE_CAP = 16 * 1024 * 1024
 _CACHE_LOCK = threading.Lock()
 
 
+class _PoolView:
+    """Module-level anchor for the unified pool registry's weakref
+    provider (the cache itself is module globals, not an instance)."""
+
+
+_POOL_VIEW = _PoolView()
+GLOBAL_POOLS.register_provider(
+    "rollup", _POOL_VIEW,
+    lambda _v: (_CACHE_BYTES, len(_CACHE)),
+)
+GLOBAL_POOLS.set_capacity("rollup", _CACHE_CAP)
+
+
 def configure_cache(capacity_bytes: int) -> None:
     """Size the decoded-artifact LRU (ServingTier does this at engine
     open); shrinking evicts oldest-first immediately."""
@@ -77,6 +92,8 @@ def configure_cache(capacity_bytes: int) -> None:
         while _CACHE_BYTES > _CACHE_CAP and _CACHE:
             _, (_l, nb) = _CACHE.popitem(last=False)
             _CACHE_BYTES -= nb
+            GLOBAL_POOLS.note_eviction("rollup")
+    GLOBAL_POOLS.set_capacity("rollup", capacity_bytes)
 
 STAT_COLUMNS = ("sum", "count", "min", "max")
 
@@ -142,17 +159,17 @@ def compute_rollup(
     the same (group..., ts) key as the data table."""
     n = table.num_rows
     ensure(n > 0, "cannot roll up an empty table")
-    ts = np.asarray(table.column(ts_column).combine_chunks().to_numpy(
-        zero_copy_only=False
-    ), dtype=np.int64)
+    ts = np.asarray(memtrace.tracked_combine(
+        table.column(ts_column), "flush_encode"
+    ).to_numpy(zero_copy_only=False), dtype=np.int64)
     bucket = ts - ts % resolution_ms
-    vals = np.asarray(table.column(value_column).combine_chunks().to_numpy(
-        zero_copy_only=False
-    ), dtype=np.float64)
+    vals = np.asarray(memtrace.tracked_combine(
+        table.column(value_column), "flush_encode"
+    ).to_numpy(zero_copy_only=False), dtype=np.float64)
     groups = [
-        np.asarray(table.column(c).combine_chunks().to_numpy(
-            zero_copy_only=False
-        ))
+        np.asarray(memtrace.tracked_combine(
+            table.column(c), "flush_encode"
+        ).to_numpy(zero_copy_only=False))
         for c in group_columns
     ]
     # boundaries where any group key or the bucket changes (input sorted)
@@ -189,7 +206,9 @@ def decode_rollup(data: bytes) -> dict:
     """Rollup object -> numpy lane dict (what the planner folds)."""
     t = pq.read_table(io.BytesIO(data))
     return {
-        name: t.column(name).combine_chunks().to_numpy(zero_copy_only=False)
+        name: memtrace.tracked_combine(t.column(name), "decode").to_numpy(
+            zero_copy_only=False
+        )
         for name in t.schema.names
     }
 
@@ -280,9 +299,11 @@ async def read_rollup(storage, record: RollupRecord) -> dict:
         if record.sst_id not in _CACHE and nbytes <= _CACHE_CAP // 4:
             _CACHE[record.sst_id] = (lanes, nbytes)
             _CACHE_BYTES += nbytes
+            memtrace.track_bytes(nbytes, "rollup_fill", "view")
             while _CACHE_BYTES > _CACHE_CAP and _CACHE:
                 _, (_l, nb) = _CACHE.popitem(last=False)
                 _CACHE_BYTES -= nb
+                GLOBAL_POOLS.note_eviction("rollup")
     return lanes
 
 

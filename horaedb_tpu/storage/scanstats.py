@@ -91,6 +91,11 @@ class ScanStats:
     # instrumented-kernel invocations (common/xprof.py feeds this): which
     # device kernels this query actually ran, and how often
     kernels: dict[str, int] = field(default_factory=dict)
+    # buffer-lineage ledger (common/memtrace.py): scan_stats() opens one
+    # alongside the timing collector, so every query route carries the
+    # pinned `memory` EXPLAIN verdict without per-handler wiring. None
+    # under HORAEDB_MEMTRACE=off.
+    mem: object = None
 
     def add(self, stage: str, secs: float) -> None:
         self.seconds[stage] = self.seconds.get(stage, 0.0) + secs
@@ -166,11 +171,17 @@ class _DeductCell:
 
 @contextmanager
 def scan_stats():
-    """Collect stage timings for every scan inside the block."""
+    """Collect stage timings — and buffer lineage — for every scan
+    inside the block: the memtrace ledger opens with the collector, so
+    the per-query memory verdict needs no per-route plumbing."""
+    from horaedb_tpu.common import memtrace
+
     st = ScanStats()
     token = _ACTIVE.set(st)
     try:
-        yield st
+        with memtrace.mem_trace() as ledger:
+            st.mem = ledger
+            yield st
     finally:
         _ACTIVE.reset(token)
 

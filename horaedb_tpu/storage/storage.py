@@ -31,7 +31,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from horaedb_tpu.common import tracing
+from horaedb_tpu.common import memtrace, tracing
 from horaedb_tpu.common.error import HoraeError, context, ensure
 from horaedb_tpu.objstore import ObjectStore
 from horaedb_tpu.ops import sort as sort_ops
@@ -746,6 +746,9 @@ class ObjectBasedStorage(ColumnarStorage):
 
             t_enc = time.perf_counter()
             blob = await self._run_sst(_encode_small)
+            # lineage: the encoded object is a fresh buffer distinct from
+            # the table's lanes (the copy-tax of the flush encode)
+            memtrace.track_bytes(len(blob), "flush_encode", "alloc")
             if fast_encode:
                 # flush-path stage attribution: encode (thread pool; pyarrow
                 # cannot thread one file's columns, so flush parallelism is
@@ -835,6 +838,7 @@ class ObjectBasedStorage(ColumnarStorage):
                 if isinstance(item, BaseException):
                     raise item
                 total += len(item)
+                memtrace.track_bytes(len(item), "flush_encode", "alloc")
                 # size is u32 in the manifest format: abort mid-stream
                 # (put_stream discards the partial object)
                 ensure(total < 2**32, f"sst too large for manifest format: {total}")

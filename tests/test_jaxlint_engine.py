@@ -453,6 +453,56 @@ class TestSeededFixturesViaCli:
         assert by_code(data2, "J020") == []
         assert by_code(data2, "J021") == []
 
+    def test_j024_flagged_then_suppressed(self, tmp_path):
+        # scoped data-plane path: all three prongs fire; tracked_* and
+        # jnp.concatenate stay silent
+        root = write_pkg(tmp_path, {"storage/read.py": """
+            import jax.numpy as jnp
+            import numpy as np
+            import pyarrow as pa
+
+            from horaedb_tpu.common import memtrace
+
+            def bad(parts, table, ts_np, sid_valid):
+                t = pa.concat_tables(parts)
+                col = table.column("ts").combine_chunks()
+                lane = np.concatenate([ts_np, ts_np])
+                packed = np.ascontiguousarray(ts_np)
+                mask = sid_valid.copy()
+                return t, col, lane, packed, mask
+
+            def good(parts, table, ts_np, cfg, grp):
+                t = memtrace.tracked_concat_tables(parts, "host_prep")
+                col = memtrace.tracked_combine(
+                    table.column("ts"), "host_prep")
+                lane = memtrace.tracked_concat([ts_np], "host_prep")
+                dev = jnp.concatenate([grp, grp])
+                opts = cfg.copy()  # non-lane receiver: bookkeeping
+                return t, col, lane, dev, opts
+        """})
+        cache = tmp_path / "cache.json"
+        _, data = lint_json(root, cache, "--no-cache")
+        hits = by_code(data, "J024")
+        assert len(hits) == 5
+        suppress_at(Path(hits[0]["path"]),
+                    sorted({h["line"] for h in hits}),
+                    "J024", "fixture seeds the raw copies on purpose")
+        _, data2 = lint_json(root, cache, "--no-cache")
+        assert by_code(data2, "J024") == []
+        assert by_code(data2, "J021") == []
+
+    def test_j024_out_of_scope_module_is_silent(self, tmp_path):
+        # same raw copies in a non-data-plane module: no findings
+        root = write_pkg(tmp_path, {"promql/eval.py": """
+            import pyarrow as pa
+
+            def merge(parts):
+                return pa.concat_tables(parts).combine_chunks()
+        """})
+        cache = tmp_path / "cache.json"
+        _, data = lint_json(root, cache, "--no-cache")
+        assert by_code(data, "J024") == []
+
     def test_j021_stale_and_unknown_suppressions(self, tmp_path):
         root = write_pkg(tmp_path, {"fixt.py": """
             def f():

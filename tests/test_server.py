@@ -556,7 +556,7 @@ EXPLAIN_KEYS = {
     "mode", "regions", "ssts", "scan_paths", "agg_impl", "agg_impls",
     "stages_s", "lanes_s", "bound", "compile_s", "steady_s", "counts",
     "kernels", "tombstones_applied", "tombstone_rows_masked", "admission",
-    "encoding", "serving", "cluster",
+    "encoding", "serving", "cluster", "memory",
 }
 EXPLAIN_LANES = {"io", "host", "transfer", "kernel", "compile", "decode"}
 # compressed-domain scan provenance (storage/encoding.py + ops/decode.py)
@@ -620,6 +620,15 @@ class TestExplain:
                 assert EXPLAIN_SERVING_KEYS <= set(srv), sorted(srv)
                 assert srv["cache"] in ("hit", "miss")
                 assert srv["rollup"] in ("none", "1m", "1h", "mixed")
+                # memory verdict (common/memtrace.py) rides every plan
+                # with the pinned schema; default mode has the ledger on
+                from horaedb_tpu.common import memtrace
+
+                mem = plan["memory"]
+                assert set(memtrace.VERDICT_KEYS) <= set(mem), sorted(mem)
+                assert mem["enabled"] is True
+                assert mem["deep"] is False
+                assert isinstance(mem["per_stage"], dict)
 
             # native raw
             r = await client.post(
@@ -706,6 +715,45 @@ class TestDebugKernels:
             await client.close()
 
 
+class TestDebugMemory:
+    @async_test
+    async def test_debug_memory_renders_all_pools(self, tmp_path):
+        """/debug/memory: the unified registry's occupancy snapshot —
+        all five pools with the pinned row shape, the process RSS, the
+        memtrace mode, and the per-stage copy-tax table (non-empty after
+        one write+query touched the data plane)."""
+        from horaedb_tpu.common.bytebudget import POOLS
+
+        client = await make_client(tmp_path)
+        try:
+            payload = make_remote_write(
+                [({"__name__": "memq", "host": "a"}, [(1000, 1.0)])]
+            )
+            r = await client.post("/api/v1/write", data=payload)
+            assert r.status == 200
+            r = await client.post(
+                "/api/v1/query",
+                json={"metric": "memq", "start_ms": 0, "end_ms": 10_000},
+            )
+            assert r.status == 200
+            r = await client.get("/debug/memory")
+            assert r.status == 200
+            body = await r.json()
+            assert set(POOLS) <= set(body["pools"]), sorted(body["pools"])
+            for pool, row in body["pools"].items():
+                assert {"bytes", "entries", "capacity_bytes",
+                        "utilization", "evictions", "owners"} <= set(row)
+            assert body["memtrace_mode"] in ("default", "deep", "off")
+            assert body["rss_bytes"] is None or body["rss_bytes"] > 0
+            tax = body["copy_tax"]
+            assert isinstance(tax, list) and tax, "copy-tax table empty"
+            for trow in tax:
+                assert {"stage", "kind", "events", "bytes"} <= set(trow)
+            assert any(trow["stage"] == "flush_encode" for trow in tax)
+        finally:
+            await client.close()
+
+
 class TestSlowlogEndpoint:
     @async_test
     async def test_query_lands_in_slowlog_and_survives(self, tmp_path):
@@ -741,6 +789,11 @@ class TestSlowlogEndpoint:
             # never sent ?explain=1
             assert entry["explain"]["mode"] == "raw"
             assert EXPLAIN_KEYS <= set(entry["explain"])
+            # the memory verdict is surfaced top-level (satellite of the
+            # memory observatory): triage reads it without unpacking the
+            # full plan
+            assert entry["memory"] == entry["explain"]["memory"]
+            assert entry["memory"]["enabled"] is True
             # writes (non-query endpoints) never spool
             assert all(
                 e["trace"]["root"]["name"] != "POST /api/v1/write"

@@ -323,6 +323,34 @@ def main() -> int:
         check(fw.get("trace_ship_overhead_pct", 1e9) < 25.0,
               f"cluster lane: trace shipping overhead runaway "
               f"(target <5% at full iters): {fw}")
+        # copy-tax lane (common/memtrace.py): the ledger must see the
+        # scan move every row exactly once — bytes_copied_per_row on the
+        # 24 B/row (tsid+ts+value) schema pins at 24 with zero slack
+        # (a second materialize pass reads as 48, a missed funnel as 0).
+        # The overhead arm is sanity-only here: smoke scans run ~5 ms,
+        # where asyncio.run jitter swamps the real <2% target (the
+        # mem-smoke gate measures that bound properly); this check only
+        # catches a runaway (accidentally-deep default mode reads 100%+).
+        ct = result.get("copy_tax") or {}
+        check(ct.get("rows", 0) > 0, "copy_tax lane missing")
+        ct_scan = ct.get("scan") or {}
+        check(ct_scan.get("rows_scanned") == ct.get("rows"),
+              f"copy_tax: scan saw {ct_scan.get('rows_scanned')} of "
+              f"{ct.get('rows')} rows (merge dedup regression?)")
+        check(ct_scan.get("bytes_copied_per_row") == 24.0,
+              f"copy_tax: scan copy tax not pinned at 24 B/row: "
+              f"{ct_scan.get('bytes_copied_per_row')}")
+        check(ct_scan.get("views", 0) > 0,
+              f"copy_tax: no view-classified hand-offs recorded: {ct_scan}")
+        ct_ingest = ct.get("ingest") or {}
+        check(ct_ingest.get("bytes_allocated_per_row", 0) > 0,
+              f"copy_tax: ingest alloc accounting missing: {ct_ingest}")
+        ov = ct.get("overhead") or {}
+        check(ov.get("scan_default_s", 0) > 0 and ov.get("scan_off_s", 0) > 0,
+              f"copy_tax: overhead A/B arms missing: {ov}")
+        check(abs(ov.get("overhead_pct", 1e9)) < 75.0,
+              f"copy_tax: memtrace overhead runaway (target <2% at real "
+              f"scan sizes; this bound is smoke-noise-only): {ov}")
         cache_file = env["HORAEDB_AGG_CACHE"]
         if not os.path.exists(cache_file):
             failures.append("calibration cache was not persisted")
@@ -337,8 +365,10 @@ def main() -> int:
         # stacked-kernel warmup compiles), 180 -> 200 s for the cluster
         # lane (six more timed arms at 0.3 s + replica opens), and
         # 200 -> 230 s for the scatter-gather A/B (regioned boot +
-        # calibration + six 1 s closed-loop arms); the gate exists to
-        # catch runaway regressions, not 20% box noise
+        # calibration + six 1 s closed-loop arms); the copy_tax lane
+        # rides inside the same budget (~5 s: 30 k-row ingest + ms-scale
+        # scans); the gate exists to catch runaway regressions, not 20%
+        # box noise
         check(elapsed < 230,
               f"smoke bench took {elapsed:.0f}s (budget 230s)")
         if failures:

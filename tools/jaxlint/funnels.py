@@ -190,6 +190,33 @@ PARTIAL_GRID_FUNNEL_DEFS = {
 }
 GRID_FOLD_UFUNC_HEADS = {"add", "minimum", "maximum"}
 
+# J024: the memtrace funnel (common/memtrace.py). The data-plane modules
+# account every buffer hand-off — copies vs views per stage — through
+# the tracked_* helpers; a raw `pa.concat_tables` / `.combine_chunks()`
+# / `np.concatenate` / `np.ascontiguousarray` / lane `.copy()` in scope
+# is an invisible copy the EXPLAIN memory verdict, the copy-tax table,
+# and the mem-smoke regression gate all silently miss. jnp.concatenate
+# (traced device math) is NOT a host copy and stays out of scope.
+J024_MODULES = (
+    "horaedb_tpu/storage/read.py",
+    "horaedb_tpu/storage/rollup.py",
+    "horaedb_tpu/serving/",
+    "horaedb_tpu/engine/data.py",
+    "horaedb_tpu/cluster/partial.py",
+    "horaedb_tpu/ingest/",
+    "horaedb_tpu/parallel/mesh.py",
+)
+J024_EXEMPT = ("horaedb_tpu/common/memtrace.py",)
+MEMTRACE_CONCAT_TAILS = {"concat_tables", "combine_chunks"}
+MEMTRACE_NUMPY_CALLS = {"np.concatenate", "np.ascontiguousarray",
+                        "numpy.concatenate", "numpy.ascontiguousarray"}
+# zero-arg `.copy()` receivers that look like data-plane lanes; scoped
+# to lane-ish names so dict/config `.copy()` bookkeeping stays quiet
+_LANE_NAME_RE = re.compile(
+    r"(^|_)(ts|tsid|sid|val(ue)?s?|mask|lane|lanes|grid|grids|arr|"
+    r"cols?|table|tables|buf)(_|$|\d*$)"
+)
+
 RAW_STORE_CTORS = {"MemStore", "LocalStore", "S3LikeStore"}
 STORE_BOUNDARY_WRAPPERS = {"ResilientStore", "ChaosStore"}
 PARQUET_ENCODE_CALLS = {
@@ -601,6 +628,66 @@ def check_partial_grid_funnel(tree: ast.Module,
                 "the fixed canonical-region order that keeps the "
                 "distributed answer bit-exact vs single-node; call the "
                 "funnel, or suppress with the reason",
+            ))
+
+
+def check_memtrace_funnel(tree: ast.Module,
+                          findings: list[Finding]) -> None:
+    """J024, three prongs over the data-plane modules: (1) a raw
+    `...concat_tables(...)` / `....combine_chunks()` arrow copy; (2) a
+    raw `np.concatenate` / `np.ascontiguousarray` host-lane copy (exact
+    numpy head — `jnp.concatenate` is traced device math, not a host
+    buffer move); (3) a zero-arg `.copy()` on a lane-named receiver
+    (`ts`/`vals`/`mask`/`grids`/...). Each belongs behind the
+    common/memtrace tracked_* helpers so the bytes land in the per-query
+    memory verdict and the copy-tax accounting; calls already wrapped by
+    a memtrace helper in the same expression are sanctioned."""
+    # sanctioned: any call nested inside a memtrace.tracked_*/track(...)
+    # call expression — collect those subtree nodes first
+    wrapped: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name and ("memtrace." in name or name.startswith("tracked_")
+                     or name in ("track", "memtrace")):
+            for sub in ast.walk(node):
+                wrapped.add(id(sub))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or id(node) in wrapped:
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        name = dotted(f) or ""
+        if f.attr in MEMTRACE_CONCAT_TAILS:
+            findings.append(Finding(
+                node.lineno, "J024",
+                f"raw `.{f.attr}(...)` in a data-plane module — this "
+                "arrow copy is invisible to the memory observatory "
+                "(EXPLAIN memory verdict, horaedb_mem_* families, the "
+                "mem-smoke copy-count gate); route it through "
+                "memtrace.tracked_combine / tracked_concat_tables, or "
+                "suppress with the reason",
+            ))
+        elif name in MEMTRACE_NUMPY_CALLS:
+            findings.append(Finding(
+                node.lineno, "J024",
+                f"raw `{name}(...)` in a data-plane module — a host-lane "
+                "copy the memory observatory cannot see; route it "
+                "through memtrace.tracked_concat / tracked_contiguous "
+                "(same array out, bytes accounted), or suppress with "
+                "the reason",
+            ))
+        elif (f.attr == "copy" and not node.args and not node.keywords
+                and isinstance(f.value, ast.Name)
+                and _LANE_NAME_RE.search(f.value.id)):
+            findings.append(Finding(
+                node.lineno, "J024",
+                f"lane `.copy()` on `{f.value.id}` in a data-plane "
+                "module — an unaccounted buffer duplication; use "
+                "memtrace.tracked_copy(arr, stage), or suppress with "
+                "the reason",
             ))
 
 

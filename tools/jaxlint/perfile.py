@@ -1,5 +1,5 @@
 """Per-file pass dispatcher: parses one file, applies every
-path-scoped per-file rule (J001-J017, J022-J023), and returns RAW findings
+path-scoped per-file rule (J001-J017, J022-J024), and returns RAW findings
 plus
 the file's suppression table. Suppression filtering happens in the
 orchestrator (tools/jaxlint/__main__.py) AFTER the whole-program
@@ -58,6 +58,7 @@ def run_perfile(path: Path, text: str,
         posix, funnels.J017_ASSIGN_EXEMPT)
     in_j022_scope = scoped(posix, funnels.J022_MODULES, funnels.J022_EXEMPT)
     in_j023_scope = scoped(posix, funnels.J023_MODULES, funnels.J023_EXEMPT)
+    in_j024_scope = scoped(posix, funnels.J024_MODULES, funnels.J024_EXEMPT)
 
     idx = jitrules.JitIndex()
     idx.visit(tree)
@@ -99,5 +100,7 @@ def run_perfile(path: Path, text: str,
         funnels.check_traced_client_funnel(tree, findings)
     if in_j023_scope:
         funnels.check_partial_grid_funnel(tree, findings)
+    if in_j024_scope:
+        funnels.check_memtrace_funnel(tree, findings)
     lockrules.check_lock_discipline(tree, findings)
     return findings, sup

@@ -112,6 +112,11 @@ CHECKS: list[Check] = [
           _t(funnels.J023_MODULES), _t(funnels.J023_EXEMPT),
           "partial-grid wire codec/merge name redefined, or in-place "
           "ufunc grid fold, outside cluster/partial.py"),
+    Check("J024", "memtrace funnel", "perfile",
+          _t(funnels.J024_MODULES), _t(funnels.J024_EXEMPT),
+          "raw concat_tables/combine_chunks/np.concatenate/"
+          "np.ascontiguousarray or lane .copy() in data-plane modules "
+          "outside the common/memtrace tracked_* accounting funnel"),
     Check("J999", "syntax error", "meta", ("tree",), (),
           "file fails to parse; every other pass skips it"),
 ]

@@ -1,0 +1,317 @@
+"""`make mem-smoke`: the allocation/copy-count regression gate on the
+config-2 scan path — ROADMAP item 2's acceptance criteria as a running
+gate instead of prose.
+
+Builds a deterministic two-SST storage tree (fixed seed, one segment,
+two overlapping writes so the merge fold runs) and scans it with the
+config-2 shape — tsid InSet + value predicate (ROOFLINE §4) — under a
+memtrace ledger, twice:
+
+- COLD: SSTs read + decoded from the store (materialize / host_prep /
+  decode events);
+- WARM: the decoded-block cache serves the same scan (the cache-hit
+  route's counts).
+
+The ledger's event COUNTS (allocs / copies / views / reuses, per stage)
+are compared against `benchmarks/mem_baseline.json`, exactly:
+
+- counts ABOVE baseline fail — a new copy or allocation crept into the
+  scan path;
+- counts BELOW baseline fail too, with a re-pin hint — an improvement
+  must be committed into the baseline (`--pin`) so it cannot silently
+  regress back. That is the "beat item 2's baseline" mechanic: the
+  Arrow-native refactor lands by re-pinning SMALLER numbers.
+
+Counts (not bytes) are the pinned quantity: byte totals scale with the
+synthetic row count, event counts are a property of the code path. The
+whole build+scan is run twice over two stores and must produce identical
+cold counts — a nondeterministic data plane would make any pin a coin
+flip, so drift between the two in-process runs fails loudly.
+
+Also measures memtrace's own cost, the ISSUE's <2% acceptance bar:
+
+- track_bytes() micro-cost, ns/event, default vs off;
+- end-to-end scan best-of-reps (cache disabled, so the scan does real decode
+  work), default mode vs `HORAEDB_MEMTRACE=off`, arms interleaved. The
+  tracked target is <2%; the asserted bound is 10% because ~10 ms scans
+  on a busy CI box jitter by more than the target (bench.py's copy_tax
+  lane measures the same A/B at 500 k rows: -5.5% on the r19 box, i.e.
+  inside noise).
+
+Re-pin after an intentional data-plane change:
+    python tools/mem_smoke.py --pin
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # script execution: tools/ is sys.path[0]
+    sys.path.insert(0, REPO)
+BASELINE_PATH = os.path.join(REPO, "benchmarks", "mem_baseline.json")
+
+N_ROWS = 120_000
+N_SERIES = 64
+INSET = 8  # config-2 selects a small tsid subset
+
+
+def counts_of(verdict: dict) -> dict:
+    """Project a memtrace verdict onto its pinnable event counts —
+    drop *_bytes (row-count-scaled) and keep the per-stage event
+    counts (code-path-shaped)."""
+    return {
+        "allocs": verdict["allocs"],
+        "copies": verdict["copies"],
+        "views": verdict["views"],
+        "reuses": verdict["reuses"],
+        "per_stage": {
+            stage: {
+                k: v for k, v in sorted(row.items())
+                if not k.endswith("_bytes")
+            }
+            for stage, row in sorted(verdict["per_stage"].items())
+        },
+    }
+
+
+def measure() -> dict:
+    import numpy as np
+    import pyarrow as pa
+
+    from horaedb_tpu.common import memtrace
+    from horaedb_tpu.common.size_ext import ReadableSize
+    from horaedb_tpu.objstore import MemStore
+    from horaedb_tpu.ops.filter import And, Compare, InSet
+    from horaedb_tpu.storage import (
+        ObjectBasedStorage,
+        ScanRequest,
+        StorageConfig,
+        TimeRange,
+        WriteRequest,
+        scanstats,
+    )
+
+    SEG = 24 * 3_600_000
+    t_lo = (1_700_000_000_000 // SEG + 1) * SEG
+    rng = np.random.default_rng(7)
+    schema = pa.schema([
+        ("tsid", pa.int64()), ("ts", pa.int64()), ("value", pa.float64()),
+    ])
+
+    def make_batch(seed_off: int, n: int) -> tuple:
+        r = np.random.default_rng(7 + seed_off)
+        tsid = np.sort(r.integers(0, N_SERIES, n, dtype=np.int64))
+        ts = t_lo + (np.arange(n, dtype=np.int64) * 15_000) % SEG
+        vals = r.normal(size=n)
+        batch = pa.RecordBatch.from_pydict(
+            {"tsid": tsid, "ts": ts, "value": vals}, schema=schema,
+        )
+        return batch, TimeRange(int(ts.min()), int(ts.max()) + 1)
+
+    pred = And(
+        InSet("tsid", tuple(int(s) for s in rng.choice(
+            N_SERIES, INSET, replace=False))),
+        Compare("value", "gt", 0.0),
+    )
+
+    async def build(cfg: StorageConfig):
+        eng = await ObjectBasedStorage.try_new(
+            "mem_smoke", MemStore(), schema, num_primary_keys=2,
+            segment_duration_ms=SEG, config=cfg,
+            enable_compaction_scheduler=False,
+            start_background_merger=False,
+        )
+        # two overlapping writes -> two SSTs -> the scan pays the
+        # merge-tree fold, not just a single-file read
+        for half in (0, 1):
+            batch, rng_t = make_batch(half, N_ROWS // 2)
+            await eng.write(WriteRequest(batch, rng_t))
+        return eng
+
+    async def scan(eng) -> int:
+        rows = 0
+        req = ScanRequest(range=TimeRange(0, 2**62), predicate=pred)
+        async for b in eng.scan(req):
+            rows += b.num_rows
+        return rows
+
+    def pinned_legs(cfg: StorageConfig) -> dict:
+        eng = asyncio.run(build(cfg))
+        try:
+            with scanstats.scan_stats() as st:
+                rows_cold = asyncio.run(scan(eng))
+            cold = memtrace.verdict(st.mem)
+            with scanstats.scan_stats() as st:
+                rows_warm = asyncio.run(scan(eng))
+            warm = memtrace.verdict(st.mem)
+        finally:
+            asyncio.run(eng.close())
+        return {
+            "rows": rows_cold, "rows_warm": rows_warm,
+            "cold": cold, "warm": warm,
+        }
+
+    prior = memtrace.mode()
+    memtrace.configure("")
+    try:
+        run_a = pinned_legs(StorageConfig())
+        run_b = pinned_legs(StorageConfig())
+
+        # -- memtrace cost, micro: ns per tracked event -------------------
+        def track_ns(n: int) -> float:
+            with memtrace.mem_trace():
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    memtrace.track_bytes(1024, "parse", "alloc")
+                return (time.perf_counter() - t0) / n * 1e9
+
+        micro_on = track_ns(200_000)
+        memtrace.configure("off")
+        micro_off = track_ns(200_000)
+        memtrace.configure("")
+
+        # -- memtrace cost, end to end: scan best-of-reps, default vs off -
+        # cache OFF so every rep pays decode + host_prep (real work, ~ms
+        # scale); arms interleaved so box drift hits both equally
+        eng = asyncio.run(build(StorageConfig(scan_cache=ReadableSize(0))))
+        try:
+            def one_scan() -> float:
+                t0 = time.perf_counter()
+                with scanstats.scan_stats():
+                    asyncio.run(scan(eng))
+                return time.perf_counter() - t0
+
+            one_scan()  # warm default-mode path
+            memtrace.configure("off")
+            one_scan()  # warm off-mode path
+            on_times, off_times = [], []
+            for _ in range(9):
+                memtrace.configure("")
+                on_times.append(one_scan())
+                memtrace.configure("off")
+                off_times.append(one_scan())
+            # min-of-interleaved: the best rep of each arm is the code's
+            # actual cost — medians absorb whatever else the CI box was
+            # doing during the window, min does not
+            on_best = min(on_times)
+            off_best = min(off_times)
+        finally:
+            memtrace.configure("")
+            asyncio.run(eng.close())
+    finally:
+        memtrace.configure(prior)
+
+    return {
+        "run_a": run_a, "run_b": run_b,
+        "micro_ns_on": round(micro_on, 1),
+        "micro_ns_off": round(micro_off, 1),
+        "scan_on_s": round(on_best, 5),
+        "scan_off_s": round(off_best, 5),
+        "overhead_pct": round(
+            (on_best - off_best) / max(off_best, 1e-9) * 100, 2),
+    }
+
+
+def main() -> int:
+    pin = "--pin" in sys.argv[1:]
+    t0 = time.perf_counter()
+    m = measure()
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            failures.append(msg)
+
+    from horaedb_tpu.common import memtrace
+
+    a, b = m["run_a"], m["run_b"]
+    check(a["rows"] > 0, "config-2 scan returned zero rows")
+    check(a["rows"] == a["rows_warm"],
+          f"warm scan row drift: {a['rows']} vs {a['rows_warm']}")
+    for leg in ("cold", "warm"):
+        check(set(a[leg]) == set(memtrace.VERDICT_KEYS),
+              f"{leg} verdict schema drift: {sorted(a[leg])}")
+        check(counts_of(a[leg]) == counts_of(b[leg]),
+              f"{leg} scan counts are nondeterministic across two "
+              f"identical builds — pinning is impossible:\n"
+              f"  a={counts_of(a[leg])}\n  b={counts_of(b[leg])}")
+    measured = {
+        "shape": {
+            "n_rows": N_ROWS, "n_series": N_SERIES, "inset": INSET,
+            "ssts": 2, "predicate": "tsid InSet + value>0 (config-2)",
+        },
+        "cold": counts_of(a["cold"]),
+        "warm": counts_of(a["warm"]),
+    }
+
+    if pin and not failures:
+        with open(BASELINE_PATH, "w", encoding="utf-8") as f:
+            json.dump(measured, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"mem-smoke: pinned baseline -> {BASELINE_PATH}")
+        print(json.dumps(measured["cold"]))
+        return 0
+
+    if not os.path.exists(BASELINE_PATH):
+        failures.append(
+            f"no committed baseline at {BASELINE_PATH} — run "
+            f"`python tools/mem_smoke.py --pin` and commit the file")
+        baseline = {}
+    else:
+        baseline = json.load(open(BASELINE_PATH, encoding="utf-8"))
+    for leg in ("cold", "warm"):
+        want, got = baseline.get(leg), measured[leg]
+        if want is None:
+            check(False, f"baseline missing the {leg} leg")
+            continue
+        if got == want:
+            continue
+        worse = (got["allocs"] > want["allocs"]
+                 or got["copies"] > want["copies"])
+        verdict_word = ("REGRESSION" if worse else
+                        "improvement — re-pin with "
+                        "`python tools/mem_smoke.py --pin`")
+        check(False,
+              f"{leg} scan counts drifted off the pinned baseline "
+              f"({verdict_word}):\n"
+              f"  pinned:   {json.dumps(want, sort_keys=True)}\n"
+              f"  measured: {json.dumps(got, sort_keys=True)}")
+
+    # memtrace's own cost: the micro bound is tight (a dict upsert),
+    # the e2e bound is the CI-safe envelope around the <2% target
+    check(m["micro_ns_on"] < 5_000,
+          f"track_bytes costs {m['micro_ns_on']} ns/event (budget 5 µs)")
+    check(m["micro_ns_off"] < 500,
+          f"memtrace-off track_bytes not near-free: "
+          f"{m['micro_ns_off']} ns/event (budget 500 ns)")
+    check(m["overhead_pct"] < 10.0,
+          f"memtrace default-mode scan overhead {m['overhead_pct']}% "
+          f"(target <2%, CI bound 10%): on={m['scan_on_s']}s "
+          f"off={m['scan_off_s']}s")
+
+    elapsed = time.perf_counter() - t0
+    check(elapsed < 120, f"mem-smoke took {elapsed:.0f}s (budget 120s)")
+    if failures:
+        for f in failures:
+            print(f"mem-smoke: FAIL {f}")
+        return 1
+    print(
+        f"mem-smoke: OK in {elapsed:.1f}s — cold "
+        f"allocs={measured['cold']['allocs']} "
+        f"copies={measured['cold']['copies']} "
+        f"views={measured['cold']['views']}, warm "
+        f"copies={measured['warm']['copies']}; track "
+        f"{m['micro_ns_on']:.0f} ns/event on / "
+        f"{m['micro_ns_off']:.0f} ns off; scan overhead "
+        f"{m['overhead_pct']}% (target <2%)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -176,6 +176,27 @@ REQUIRED_FAMILIES = (
     'horaedb_batch_queries_total{mode="solo_off"',
     "horaedb_batch_launches_total",
     'horaedb_scan_stage_seconds_bucket{stage="batch_window"',
+    # memory observatory (common/memtrace.py + common/bytebudget.py):
+    # lineage counters pre-register every (stage, kind) child and the
+    # pool registry pre-registers all five byte-budgeted caches, so
+    # every family renders the zero state from boot
+    "horaedb_mem_bytes_total",
+    'horaedb_mem_bytes_total{stage="host_prep",kind="copy"',
+    'horaedb_mem_bytes_total{stage="materialize",kind="view"',
+    "horaedb_mem_events_total",
+    'horaedb_mem_events_total{stage="decode",kind="alloc"',
+    "horaedb_mem_device_staging_bytes_total",
+    "horaedb_pool_bytes",
+    'horaedb_pool_bytes{pool="scan"',
+    'horaedb_pool_bytes{pool="sidecar"',
+    'horaedb_pool_bytes{pool="result"',
+    'horaedb_pool_bytes{pool="residency"',
+    'horaedb_pool_bytes{pool="rollup"',
+    "horaedb_pool_entries",
+    "horaedb_pool_capacity_bytes",
+    'horaedb_pool_capacity_bytes{pool="result"',
+    "horaedb_pool_evictions_total",
+    'horaedb_pool_evictions_total{pool="scan"',
 )
 
 
