@@ -1723,6 +1723,16 @@ def copy_tax_lane(smoke: bool) -> dict:
             with scanstats.scan_stats() as st:
                 rows = asyncio.run(scan_rows(eng))
             scan_v = memtrace.verdict(st.mem)
+            # stage walls off the same ledger context: the zero-copy
+            # spine's acceptance bar is host_prep+materialize wall, not
+            # just byte counts — a refactor that trades copies for slow
+            # chunk-walking would show up here
+            scan_walls = {
+                k: round(v, 5) for k, v in sorted(st.seconds.items())
+            }
+            hp_mat_ms = round(
+                (st.seconds.get("host_prep", 0.0)
+                 + st.seconds.get("materialize", 0.0)) * 1e3, 3)
 
             # overhead leg: median (p50) of N scans, default vs off —
             # min-of-few is noise-dominated at millisecond scan times
@@ -1769,6 +1779,8 @@ def copy_tax_lane(smoke: bool) -> dict:
                 "copies": scan_v["copies"],
                 "views": scan_v["views"],
                 "per_stage": per_stage(scan_v, rows),
+                "stage_walls_s": scan_walls,
+                "host_prep_materialize_ms": hp_mat_ms,
             },
             "overhead": {
                 "scan_default_s": round(on_s, 4),

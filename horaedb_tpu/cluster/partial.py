@@ -131,6 +131,7 @@ def decode_partials(buf: bytes) -> "tuple[dict, list[tuple[int, list, dict]]]":
         tsids = np.frombuffer(
             payload[toff: toff + entry["tsids"]["nbytes"]], dtype="<u8"
         ).tolist()
+        memtrace.track_bytes(entry["tsids"]["nbytes"], "wire_codec", "view")
         n = entry["n_series"]
         grids = {}
         for key, spec in entry["grids"].items():
@@ -138,6 +139,8 @@ def decode_partials(buf: bytes) -> "tuple[dict, list[tuple[int, list, dict]]]":
                 payload[spec["offset"]: spec["offset"] + spec["nbytes"]],
                 dtype=np.dtype(spec["dtype"]),
             )
+            # frombuffer aliases the wire payload — decode is view-shaped
+            memtrace.track_bytes(spec["nbytes"], "wire_codec", "view")
             nb = entry.get("n_buckets") or 0
             grids[key] = g.reshape(n, nb) if n * nb == g.size else g
         parts.append((int(entry["region_id"]), tsids, grids))
@@ -158,6 +161,13 @@ def merge_grids(results: list, device_mesh=None):
     bitwise-equal to the host path (tests/test_cluster_distributed.py
     asserts it)."""
     if len(results) == 1:
+        # by-reference shortcut: the lone region's own grids ARE the
+        # answer — file a reuse, not a copy, for the hand-back
+        _tsids, only = results[0]
+        memtrace.track_bytes(
+            sum(int(np.asarray(g).nbytes) for g in only.values()),
+            "wire_codec", "reuse",
+        )
         return results[0]
     all_tsids = sorted({t for tsids, _ in results for t in tsids})
     pos = {t: i for i, t in enumerate(all_tsids)}

@@ -18,7 +18,7 @@ import time
 import numpy as np
 import pyarrow as pa
 
-from horaedb_tpu.common import memtrace, tracing
+from horaedb_tpu.common import colblock, memtrace, tracing
 from horaedb_tpu.common.aio import TaskGroup
 from horaedb_tpu.engine.flush_executor import (
     FLUSH_FAILURES_TOTAL,
@@ -331,18 +331,26 @@ class SampleManager:
             # buffer_rows-sized arrays up front — growth is geometric
             cap = max(min(self._buffer_rows, 4 << 20), n, 1024)
             if self._spare_cols and len(self._spare_cols[-1][0]) >= cap:
+                # double-buffer steady state: the previous generation's
+                # backing re-issues without an allocation
                 cols = self._spare_cols.pop()
+                memtrace.track_bytes(
+                    sum(int(c.nbytes) for c in cols), "append", "reuse"
+                )
             else:
                 cols = (
-                    np.empty(cap, np.int64),   # dense series id per sample
-                    np.empty(cap, np.int64),   # ts
-                    np.empty(cap, np.float64),  # value
+                    colblock.aligned_empty(cap, np.int64),   # dense series id
+                    colblock.aligned_empty(cap, np.int64),   # ts
+                    colblock.aligned_empty(cap, np.float64),  # value
                 )
+                for c in cols:
+                    memtrace.track(c, "append", "alloc")
             self._cols = cols
         elif self._fill + n > len(cols[0]):
             cap = max(2 * len(cols[0]), self._fill + n)
-            grown = tuple(np.empty(cap, c.dtype) for c in cols)
+            grown = tuple(colblock.aligned_empty(cap, c.dtype) for c in cols)
             for g, c in zip(grown, cols):
+                memtrace.track(g, "append", "alloc")
                 g[: self._fill] = c[: self._fill]
             self._cols = cols = grown
         return cols
@@ -437,9 +445,23 @@ class SampleManager:
         self._dense = {}
         cols_view = None
         backing = None
+        block = None
         if self._fill:
             backing = self._cols
-            cols_view = tuple(c[: self._fill] for c in backing)
+            # the sealed rows travel as ONE frozen column block: read-only
+            # zero-copy views of the arena's filled prefix (the drain reads
+            # them in place — the old recycled-array copy is gone), while
+            # the writable backing recycles into the spare pool after the
+            # write-out lands
+            block = colblock.ColBlock.wrap({
+                "__series__": backing[0][: self._fill],
+                "ts": backing[1][: self._fill],
+                "value": backing[2][: self._fill],
+            }).freeze()
+            memtrace.track_bytes(block.nbytes, "seal", "view")
+            cols_view = tuple(
+                block.lane(k) for k in ("__series__", "ts", "value")
+            )
             self._cols = None
             self._fill = 0
         rows = self._buffered
@@ -456,7 +478,7 @@ class SampleManager:
         )
         return SealedMemtable(
             seq=seq, rows=rows, buf=buf, cols=cols_view, keys=keys,
-            cols_backing=backing, lanes=lanes,
+            cols_backing=backing, lanes=lanes, block=block,
         )
 
     async def seal_and_submit(self) -> None:
@@ -814,20 +836,21 @@ class SampleManager:
         Flush-path writes also ride the bounded upload semaphore: several
         executor workers x several shards would otherwise fan encode+PUT
         out without limit on a small host."""
-        batch = pa.RecordBatch.from_pydict(
-            {
-                "metric_id": memtrace.tracked_contiguous(
-                    np.asarray(metric_ids, dtype=np.uint64), "append"
-                ),
-                "tsid": memtrace.tracked_contiguous(
-                    np.asarray(tsids, dtype=np.uint64), "append"
-                ),
-                "field_id": _zeros_u64(len(ts)),
-                "ts": memtrace.tracked_contiguous(ts, "append"),
-                "value": memtrace.tracked_contiguous(values, "append"),
-            },
-            schema=DATA_SCHEMA,
-        )
+        # one frozen column block feeds the writer: the arrow batch wraps
+        # the lanes zero-copy (primitive types), so the parquet encoder
+        # reads the sealed bytes in place — no per-lane staging copy
+        block = colblock.ColBlock.wrap({
+            "metric_id": memtrace.tracked_contiguous(
+                np.asarray(metric_ids, dtype=np.uint64), "append"
+            ),
+            "tsid": memtrace.tracked_contiguous(
+                np.asarray(tsids, dtype=np.uint64), "append"
+            ),
+            "field_id": _zeros_u64(len(ts)),
+            "ts": memtrace.tracked_contiguous(ts, "append"),
+            "value": memtrace.tracked_contiguous(values, "append"),
+        }).freeze()
+        batch = block.to_arrow_batch(DATA_SCHEMA, stage="flush_encode")
         lo = int(ts.min())
         hi = int(ts.max()) + 1
         req = WriteRequest(batch, TimeRange(lo, hi), presorted=presorted,

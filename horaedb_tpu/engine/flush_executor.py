@@ -106,6 +106,11 @@ class SealedMemtable:
     cols_backing: tuple[np.ndarray, ...] | None = None
     # C++ accumulator drain: (mid, tsid, ts, value) pk-sorted lanes
     lanes: tuple[np.ndarray, ...] | None = None
+    # the sealed rows as ONE frozen column block (common/colblock.py):
+    # `cols` above are its read-only lane views — consumers that need the
+    # whole hand-off (drain, replay grouping) pass the block by reference
+    # (block.share()) instead of re-materializing lanes
+    block: "object | None" = None
     # pinned-seq replay groups from failed attempts:
     # (seq, segment_start, (mid, tsid, ts, value), presorted)
     groups: list[tuple[int, int, tuple, bool]] = field(default_factory=list)

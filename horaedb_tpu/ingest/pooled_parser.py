@@ -12,7 +12,7 @@ import logging
 
 import numpy as np
 
-from horaedb_tpu.common import memtrace, tracing
+from horaedb_tpu.common import colblock, memtrace, tracing
 from horaedb_tpu.ingest.types import ParsedWriteRequest
 from horaedb_tpu.server.metrics import GLOBAL_METRICS
 
@@ -29,7 +29,11 @@ class DecodeArena:
     the id-lane copies (~90 ns/sample parse budget, ROOFLINE §7). A
     pooled parser owns one arena; `take` hands out views into buffers
     that grow geometrically and never shrink, so after warmup a request
-    allocates nothing. Returned views follow the pool's borrow
+    allocates nothing. Lanes come off the column-block allocator
+    (common/colblock.py aligned_empty), so the 64-byte alignment
+    contract holds from wire decode through the memtable arena to device
+    staging — no downstream layer ever repacks a parse lane. Returned
+    views follow the pool's borrow
     discipline: valid only until the owning parser's next parse —
     callers that hold lanes past the borrow (exemplar persistence) copy
     them out first.
@@ -52,7 +56,7 @@ class DecodeArena:
             cap = max(int(n), 256)
             if buf is not None and buf.dtype == dt:
                 cap = max(cap, 2 * len(buf))
-            buf = np.empty(cap, dt)
+            buf = colblock.aligned_empty(cap, dt)
             self._bufs[tag] = buf
             self.allocations += 1
             memtrace.track_bytes(buf.nbytes, "parse", "alloc")

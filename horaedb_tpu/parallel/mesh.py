@@ -26,7 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from horaedb_tpu.common import memtrace
+from horaedb_tpu.common import colblock, memtrace
 from horaedb_tpu.common.error import ensure
 
 
@@ -115,28 +115,24 @@ def mesh_downsample(
     # aggregation exactly (advisor round-1, blockagg precision).
     accel = mesh.devices.flat[0].platform not in ("cpu",)
     val_dtype = np.float32 if accel else np.float64
-    row_ok = (
-        np.ones(len(ts_np), dtype=bool) if valid_np is None
-        else memtrace.tracked_contiguous(
-            np.asarray(valid_np, dtype=bool), "h2d"
-        )
-    )
-    host_lanes = (
-        memtrace.tracked_contiguous(
-            np.asarray(ts_np, dtype=np.int64), "h2d"
+    # ONE frozen column block stages the shard lanes: dtype coercions go
+    # through colblock.as_lane (view when no bytes move, one honest copy
+    # when a conversion is unavoidable) and the H2D transfer is charged
+    # once against the block — no intermediate staging alloc to
+    # double-charge against
+    block = colblock.ColBlock.wrap({
+        "ts": colblock.as_lane(ts_np, np.int64, "host_prep"),
+        "sid": colblock.as_lane(sid_np, np.int32, "host_prep"),
+        "value": colblock.as_lane(val_np, val_dtype, "host_prep"),
+        "ok": (
+            np.ones(len(ts_np), dtype=bool) if valid_np is None
+            else colblock.as_lane(valid_np, bool, "host_prep")
         ),
-        memtrace.tracked_contiguous(
-            np.asarray(sid_np, dtype=np.int32), "h2d"
-        ),
-        memtrace.tracked_contiguous(
-            np.asarray(val_np, dtype=val_dtype), "h2d"
-        ),
-        row_ok,
-    )
-    memtrace.device_staged(sum(int(a.nbytes) for a in host_lanes), "h2d")
+    }).freeze()
+    memtrace.device_staged(block.nbytes, "h2d")
     (ts_d, sid_d, val_d, ok_d), _pad_valid = shard_rows(
         mesh,
-        host_lanes,
+        tuple(block.lane(k) for k in ("ts", "sid", "value", "ok")),
         pad_value=(0, padded_series, 0, False),
     )
     # pad rows carry ok=False (False pad on the bool lane), so ok_d

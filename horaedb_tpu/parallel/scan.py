@@ -19,7 +19,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from horaedb_tpu.common import colblock
 from horaedb_tpu.common import deadline as deadline_ctx
+from horaedb_tpu.common import memtrace
 from horaedb_tpu.common.error import ensure
 from horaedb_tpu.common.jaxcompat import shard_map
 from horaedb_tpu.common.xprof import xjit
@@ -336,14 +338,21 @@ def shard_rows(mesh: Mesh, arrays: tuple, pad_value=0):
             else [pad_value] * len(arrays))
     ensure(len(pads) == len(arrays),
            f"per-lane pad_value needs {len(arrays)} entries, got {len(pads)}")
-    # pad on host BEFORE the timer: the concatenate is host_prep work and
+    # pad on host BEFORE the timer: the pad fill is host_prep work and
     # must not inflate the transfer lane (the exact misattribution the
-    # histogram exists to prevent)
+    # histogram exists to prevent). Pad-free lanes stage AS-IS — the
+    # jax.device_put below reads the caller's block lanes in place (no
+    # intermediate staging copy); only a genuine pad pays one aligned
+    # tracked copy per lane
     padded = []
     nbytes = 0
     for a, pv in zip(arrays, pads):
         if pad:
-            a = np.concatenate([a, np.full(pad, pv, dtype=a.dtype)])
+            g = colblock.aligned_empty(n + pad, a.dtype)
+            g[:n] = a
+            g[n:] = pv
+            memtrace.track(g, "host_prep", "copy")
+            a = g
         padded.append(a)
         nbytes += a.nbytes
     valid = np.ones(n + pad, dtype=bool)

@@ -49,7 +49,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from horaedb_tpu.common import memtrace
+from horaedb_tpu.common import colblock, memtrace
 from horaedb_tpu.common.bytebudget import GLOBAL_POOLS
 from horaedb_tpu.serving import (
     CACHE_BYTES,
@@ -66,6 +66,11 @@ _FILL_FAILED = object()
 
 def _freeze(value) -> None:
     """Mark every numpy array reachable in a cached value read-only."""
+    if isinstance(value, colblock.ColBlock):
+        # a column block freezes as a unit: its mutability epoch guards
+        # sharing, and its public lanes come back read-only already
+        value.freeze()
+        return
     if isinstance(value, np.ndarray):
         try:
             value.setflags(write=False)
@@ -78,6 +83,21 @@ def _freeze(value) -> None:
     elif isinstance(value, (list, tuple)):
         for v in value:
             _freeze(v)
+
+
+def _share_blocks(value, stage: str) -> int:
+    """`share()` every reachable frozen column block (files one `reuse`
+    lineage event per block — by-reference pinning, zero bytes moved)
+    and return their total bytes so the caller charges only the loose
+    remainder as a view."""
+    if isinstance(value, colblock.ColBlock):
+        value.share(stage)
+        return value.nbytes
+    if isinstance(value, dict):
+        return sum(_share_blocks(v, stage) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(_share_blocks(v, stage) for v in value)
+    return 0
 
 
 class ResultCache:
@@ -201,9 +221,13 @@ class ResultCache:
         if self._cap <= 0 or nbytes > self._cap // 4:
             return  # one panel must not dominate the whole budget
         _freeze(value)
-        # lineage: the cache retains a VIEW of the caller's result arrays
-        # (no bytes move on a fill — the charge is residency, not a copy)
-        memtrace.track_bytes(nbytes, "result_fill", "view")
+        # lineage: the cache retains the caller's result BY REFERENCE —
+        # frozen column blocks file a `reuse` (their epoch guards COW),
+        # loose arrays a `view`; either way no bytes move on a fill
+        shared = _share_blocks(value, "result_fill")
+        rest = max(0, int(nbytes) - shared)
+        if rest:
+            memtrace.track_bytes(rest, "result_fill", "view")
         with self._lock:
             if key in self._entries:
                 return

@@ -33,7 +33,7 @@ from collections import OrderedDict
 import numpy as np
 import pyarrow as pa
 
-from horaedb_tpu.common import memtrace
+from horaedb_tpu.common import colblock, memtrace
 from horaedb_tpu.common.bytebudget import GLOBAL_POOLS
 from horaedb_tpu.serving import RESIDENCY, RESIDENT_BLOCKS, RESIDENT_BYTES
 
@@ -144,11 +144,15 @@ class DeviceBlockCache:
         # let the true footprint run to ~2x the configured budget.
         device_lanes: dict[str, object] = {}
         dev_bytes = 0
-        for name, col in zip(table.schema.names, table.columns):
+        # chunk-aware lane export (common/colblock.py): each numeric lane
+        # stages to the device straight off its zero-copy arrow view — no
+        # fresh host alloc between decode and pin, and the HBM transfer is
+        # charged ONCE for the block below instead of once per lane
+        # against a combine copy (the r19 double-charge)
+        lanes = colblock.ArrowLanes(table, stage="residency_fill")
+        for name in table.schema.names:
             try:
-                arr = memtrace.tracked_combine(
-                    col, "residency_fill"
-                ).to_numpy(zero_copy_only=False)
+                arr = lanes.lane(name)
             except Exception:  # noqa: BLE001 — non-numeric lane (labels)
                 continue
             if arr.dtype == object:
@@ -156,10 +160,11 @@ class DeviceBlockCache:
             dev = _device_put(arr)
             if dev is not None:
                 device_lanes[name] = dev
-                dev_bytes += arr.nbytes
-                # the HBM pin is a real second copy of the lane — the
-                # staging odometer and the byte budget both charge it
-                memtrace.device_staged(arr.nbytes, "residency_fill")
+                dev_bytes += int(arr.nbytes)
+        if dev_bytes:
+            # the HBM pin is a real second copy of the numeric lanes —
+            # the staging odometer and the byte budget both charge it
+            memtrace.device_staged(dev_bytes, "residency_fill")
         total = size + dev_bytes
         with self._lock:
             if key in self._blocks or total > self._cap // 4:

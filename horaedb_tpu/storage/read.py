@@ -42,6 +42,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from horaedb_tpu.common import colblock
 from horaedb_tpu.common import deadline as deadline_ctx
 from horaedb_tpu.common import memtrace
 from horaedb_tpu.common import tracing
@@ -430,6 +431,7 @@ def _host_merge_indices(
     num_pk: int,
     mask: np.ndarray | None,
     do_dedup: bool,
+    lanes=None,
 ) -> np.ndarray:
     """Vectorized host merge: filter -> stable sort by (pk..., __seq__) ->
     last-value dedup. Returns row indices (into the unfiltered input) in
@@ -439,6 +441,13 @@ def _host_merge_indices(
     are compacted through `mask` FIRST, so the O(n log n) sort runs on
     surviving rows only — the reason this path demolishes the device round
     trip on selective scans over slow links.
+
+    With `lanes` (a colblock.ArrowLanes over the chunked scan table) the
+    merge consumes lanes chunk-wise: the sortedness probe checks per-chunk
+    order + chunk boundaries, and the mask compaction gathers survivors
+    straight out of the per-chunk views — no full-column combine_chunks
+    copy ever happens on this route (the r19 baseline's 4 host_prep
+    copies).
 
     Sort strategy: pack all sort keys into one u64 (pk columns offset to
     their min, __seq__ replaced by its dense rank — sequences are ns-clock
@@ -457,20 +466,34 @@ def _host_merge_indices(
         return np.empty(0, np.int64)
 
     def col(name: str) -> np.ndarray:
+        if lanes is not None:
+            if base is not None:
+                return lanes.gather_sorted(name, base)
+            return lanes.lane(name)
         a = np.asarray(col_of(name))
         return a[base] if base is not None else a
 
+    if lanes is not None:
+        presorted = _lanes_presorted(lanes, sort_keys)
+    else:
+        presorted = _rows_presorted(
+            {k: np.asarray(col_of(k)) for k in sort_keys}, sort_keys
+        )
     # presorted shortcut: a compacted segment (or one flush's disjoint
     # shards, pre-ordered by _order_tables_by_first_key) is already in
     # (pk..., seq) order — survivors keep input order and dedup is one
     # adjacent compare: O(n) total, no sort
-    if _rows_presorted({k: np.asarray(col_of(k)) for k in sort_keys}, sort_keys):
+    if presorted:
         if do_dedup:
             keep = np.zeros(n, dtype=bool)
             keep[-1] = True
-            for name in sort_keys[:num_pk]:
-                a = col(name)
-                keep[:-1] |= a[:-1] != a[1:]
+            if lanes is not None and base is None:
+                for name in sort_keys[:num_pk]:
+                    keep[:-1] |= _adjacent_neq_chunked(lanes, name)
+            else:
+                for name in sort_keys[:num_pk]:
+                    a = col(name)
+                    keep[:-1] |= a[:-1] != a[1:]
             final = base[keep] if base is not None else np.nonzero(keep)[0]
         else:
             final = base if base is not None else np.arange(n)
@@ -580,6 +603,7 @@ def _plan_and_merge(
     binary_pred: bool,
     itemsize_of,
     defer_device: bool = False,
+    lanes=None,
 ) -> "np.ndarray | object":
     """Decide host-SIMD vs index-only-device for one materializing merge and
     run it; returns surviving row indices in output order.
@@ -633,7 +657,8 @@ def _plan_and_merge(
         t0 = time.perf_counter()
         with scanstats.stage("host_merge"):
             res = _host_merge_indices(
-                col_of, n, sort_keys, len(pk_names), mask, do_dedup
+                col_of, n, sort_keys, len(pk_names), mask, do_dedup,
+                lanes=lanes,
             )
         # feed the planner's rolling host-sort estimate — but only when the
         # merge actually sorted (the presorted O(n) shortcut is routed
@@ -803,9 +828,13 @@ def _plan_and_merge(
         adjacent compares, zero transfer), which no device route can beat."""
         if not _presorted:
             with scanstats.stage("host_prep"):
-                _presorted.append(_rows_presorted(
-                    {k: np.asarray(col_of(k)) for k in sort_keys}, sort_keys
-                ))
+                if lanes is not None:
+                    _presorted.append(_lanes_presorted(lanes, sort_keys))
+                else:
+                    _presorted.append(_rows_presorted(
+                        {k: np.asarray(col_of(k)) for k in sort_keys},
+                        sort_keys,
+                    ))
         return _presorted[0]
 
     n_terms = (
@@ -944,6 +973,52 @@ def _order_tables_by_first_key(tables: list, sort_keys) -> list:
         return tuple(t.column(k)[0].as_py() for k in sort_keys)
 
     return sorted(tables, key=first_key)
+
+
+def _lanes_presorted(lanes, sort_keys: tuple) -> bool:
+    """Chunk-aware `_rows_presorted` over a colblock.ArrowLanes: each
+    chunk checks independently (zero-copy per-chunk views) and the chunk
+    BOUNDARIES compare as scalar key tuples — no full-column
+    materialization. Memoized per sort-key tuple across planner probes."""
+    key = tuple(sort_keys)
+    cached = lanes.presorted_cache.get(key)
+    if cached is not None:
+        return cached
+    chks = {k: lanes.chunks(k) for k in sort_keys}
+    nch = len(chks[sort_keys[0]]) if chks[sort_keys[0]] else 0
+    ok = True
+    prev_last = None
+    for i in range(nch):
+        sub = {k: chks[k][i] for k in sort_keys}
+        if len(sub[sort_keys[0]]) == 0:
+            continue
+        if not _rows_presorted(sub, key):
+            ok = False
+            break
+        first = tuple(int(sub[k][0]) for k in sort_keys)
+        if prev_last is not None and first < prev_last:
+            ok = False
+            break
+        prev_last = tuple(int(sub[k][-1]) for k in sort_keys)
+    lanes.presorted_cache[key] = ok
+    return ok
+
+
+def _adjacent_neq_chunked(lanes, name: str) -> np.ndarray:
+    """`a[:-1] != a[1:]` for one lane, computed per chunk (+ boundary
+    compares) — the presorted-dedup compare without a combine copy."""
+    views = lanes.chunks(name)
+    bounds = lanes.bounds
+    n = int(bounds[-1])
+    neq = np.zeros(max(n - 1, 0), dtype=bool)
+    for i, v in enumerate(views):
+        lo = int(bounds[i])
+        if len(v) > 1:
+            neq[lo:lo + len(v) - 1] = v[:-1] != v[1:]
+        nxt = views[i + 1] if i + 1 < len(views) else None
+        if nxt is not None and len(v) and len(nxt):
+            neq[lo + len(v) - 1] = v[-1] != nxt[0]
+    return neq
 
 
 def _rows_presorted(arrays: dict, sort_keys: tuple) -> bool:
@@ -1701,18 +1776,13 @@ class ParquetReader:
         return [b for b in batches if b.num_rows > 0]
 
     def _merge_table(self, table: pa.Table, predicate: Predicate | None) -> np.ndarray:
-        """_plan_and_merge over a decoded arrow table (column lanes convert
-        lazily and are cached across the planner's probes)."""
-        cache: dict[str, np.ndarray] = {}
-
-        def col_of(name: str) -> np.ndarray:
-            a = cache.get(name)
-            if a is None:
-                a = arrow_column_to_numpy(
-                    memtrace.tracked_combine(table.column(name), "host_prep")
-                )
-                cache[name] = a
-            return a
+        """_plan_and_merge over a decoded arrow table, consumed through a
+        chunk-aware ArrowLanes block: the host route (sortedness probe,
+        predicate eval, mask compaction, key packing) reads per-chunk
+        zero-copy views, so no per-column combine_chunks copy happens —
+        only device routes fall back to `lanes.lane` (the ONE sanctioned
+        contiguous materialization, cached across planner probes)."""
+        lanes = colblock.ArrowLanes(table, stage="host_prep")
 
         pred_cols = filter_ops.pred_columns(predicate)
         binary_pred = any(
@@ -1723,8 +1793,9 @@ class ParquetReader:
         def host_mask_fn() -> np.ndarray:
             if binary_pred:
                 return filter_ops.eval_predicate_host(predicate, table)
-            return filter_ops.eval_predicate_np(
-                predicate, {c: col_of(c) for c in pred_cols}
+            return lanes.eval_chunked(
+                lambda cols: filter_ops.eval_predicate_np(predicate, cols),
+                sorted(pred_cols),
             )
 
         def itemsize_of(name: str) -> int:
@@ -1735,8 +1806,8 @@ class ParquetReader:
                 return 16  # variable-width: rough planning estimate
 
         return _plan_and_merge(
-            self._schema, table.num_rows, col_of, predicate, host_mask_fn,
-            binary_pred, itemsize_of,
+            self._schema, table.num_rows, lanes.lane, predicate,
+            host_mask_fn, binary_pred, itemsize_of, lanes=lanes,
         )
 
     async def _scan_segment_host(

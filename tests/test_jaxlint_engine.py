@@ -503,6 +503,52 @@ class TestSeededFixturesViaCli:
         _, data = lint_json(root, cache, "--no-cache")
         assert by_code(data, "J024") == []
 
+    def test_j025_flagged_then_suppressed(self, tmp_path):
+        # scoped data-plane path: lane-accessor and block-named
+        # materializations fire; colblock/memtrace-wrapped calls and
+        # by-reference lane consumption stay silent
+        root = write_pkg(tmp_path, {"storage/read.py": """
+            import numpy as np
+
+            from horaedb_tpu.common import colblock, memtrace
+
+            def bad(block, lanes):
+                a = np.asarray(block.lane("ts"))
+                b = np.array(lanes.lane("value"))
+                c = np.copy(block)
+                return a, b, c
+
+            def good(block, ts_np):
+                lane = block.lane("ts")  # by reference: no fresh array
+                coerced = colblock.as_lane(ts_np, np.int64, "host_prep")
+                dup = memtrace.tracked_copy(
+                    np.asarray(block.lane("ts")), "host_prep")
+                fresh = np.asarray(ts_np)  # not block data: silent
+                return lane, coerced, dup, fresh
+        """})
+        cache = tmp_path / "cache.json"
+        _, data = lint_json(root, cache, "--no-cache")
+        hits = by_code(data, "J025")
+        assert len(hits) == 3
+        suppress_at(Path(hits[0]["path"]),
+                    sorted({h["line"] for h in hits}),
+                    "J025", "fixture seeds the re-materializations")
+        _, data2 = lint_json(root, cache, "--no-cache")
+        assert by_code(data2, "J025") == []
+        assert by_code(data2, "J021") == []
+
+    def test_j025_out_of_scope_module_is_silent(self, tmp_path):
+        # same materializations outside the zero-copy spine: no findings
+        root = write_pkg(tmp_path, {"promql/eval.py": """
+            import numpy as np
+
+            def flatten(block):
+                return np.asarray(block.lane("ts"))
+        """})
+        cache = tmp_path / "cache.json"
+        _, data = lint_json(root, cache, "--no-cache")
+        assert by_code(data, "J025") == []
+
     def test_j021_stale_and_unknown_suppressions(self, tmp_path):
         root = write_pkg(tmp_path, {"fixt.py": """
             def f():

@@ -342,9 +342,29 @@ def main() -> int:
               f"{ct_scan.get('bytes_copied_per_row')}")
         check(ct_scan.get("views", 0) > 0,
               f"copy_tax: no view-classified hand-offs recorded: {ct_scan}")
+        # zero-copy spine (common/colblock.py): the chunk-aware merge
+        # must make NO host_prep copies — the one remaining scan copy is
+        # the materialize take (the 24 B/row above, the output itself)
+        hp = (ct_scan.get("per_stage") or {}).get("host_prep") or {}
+        check(hp.get("copied_bytes_per_row") == 0.0,
+              f"copy_tax: host_prep copies crept back into the merge "
+              f"path (zero-copy spine regression): {hp}")
+        # and the host-side prep+materialize wall stays ms-scale — a
+        # refactor trading copies for slow chunk-walking shows up here
+        check(ct_scan.get("host_prep_materialize_ms", 1e9) <= 2.0,
+              f"copy_tax: host_prep+materialize wall "
+              f"{ct_scan.get('host_prep_materialize_ms')} ms (bar 2 ms)")
         ct_ingest = ct.get("ingest") or {}
         check(ct_ingest.get("bytes_allocated_per_row", 0) > 0,
               f"copy_tax: ingest alloc accounting missing: {ct_ingest}")
+        # flush-encode alloc density: type-driven column encodings
+        # (DELTA_BINARY_PACKED ints / BYTE_STREAM_SPLIT floats) must
+        # stay strictly below r19's plain-encoding 12.7 B/row
+        enc = (ct_ingest.get("per_stage") or {}).get("flush_encode") or {}
+        check(enc.get("alloc_bytes_per_row", 1e9) < 12.7,
+              f"copy_tax: flush_encode allocs "
+              f"{enc.get('alloc_bytes_per_row')} B/row — at or above the "
+              f"r19 12.7 B/row bar")
         ov = ct.get("overhead") or {}
         check(ov.get("scan_default_s", 0) > 0 and ov.get("scan_off_s", 0) > 0,
               f"copy_tax: overhead A/B arms missing: {ov}")

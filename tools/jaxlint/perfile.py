@@ -1,5 +1,5 @@
 """Per-file pass dispatcher: parses one file, applies every
-path-scoped per-file rule (J001-J017, J022-J024), and returns RAW findings
+path-scoped per-file rule (J001-J017, J022-J025), and returns RAW findings
 plus
 the file's suppression table. Suppression filtering happens in the
 orchestrator (tools/jaxlint/__main__.py) AFTER the whole-program
@@ -59,6 +59,7 @@ def run_perfile(path: Path, text: str,
     in_j022_scope = scoped(posix, funnels.J022_MODULES, funnels.J022_EXEMPT)
     in_j023_scope = scoped(posix, funnels.J023_MODULES, funnels.J023_EXEMPT)
     in_j024_scope = scoped(posix, funnels.J024_MODULES, funnels.J024_EXEMPT)
+    in_j025_scope = scoped(posix, funnels.J025_MODULES, funnels.J025_EXEMPT)
 
     idx = jitrules.JitIndex()
     idx.visit(tree)
@@ -102,5 +103,7 @@ def run_perfile(path: Path, text: str,
         funnels.check_partial_grid_funnel(tree, findings)
     if in_j024_scope:
         funnels.check_memtrace_funnel(tree, findings)
+    if in_j025_scope:
+        funnels.check_colblock_contract(tree, findings)
     lockrules.check_lock_discipline(tree, findings)
     return findings, sup

@@ -117,6 +117,12 @@ CHECKS: list[Check] = [
           "raw concat_tables/combine_chunks/np.concatenate/"
           "np.ascontiguousarray or lane .copy() in data-plane modules "
           "outside the common/memtrace tracked_* accounting funnel"),
+    Check("J025", "column-block contract", "perfile",
+          _t(funnels.J025_MODULES), _t(funnels.J025_EXEMPT),
+          "fresh numpy array materialized from a column block's lanes "
+          "(np.array/np.asarray/np.frombuffer/np.copy over .lane(...) "
+          "or block-named buffers) outside colblock.py's sanctioned "
+          "accessors"),
     Check("J999", "syntax error", "meta", ("tree",), (),
           "file fails to parse; every other pass skips it"),
 ]

@@ -12,6 +12,11 @@ memtrace ledger, twice:
 - WARM: the decoded-block cache serves the same scan (the cache-hit
   route's counts).
 
+The BUILD itself runs under a third ledger — the INGEST leg: the fixed
+bulk-write shape's append/seal/flush_encode counts plus the
+flush-encode alloc density (B/row), pinned under a hard ceiling of
+r19's plain-encoding 12.7 B/row.
+
 The ledger's event COUNTS (allocs / copies / views / reuses, per stage)
 are compared against `benchmarks/mem_baseline.json`, exactly:
 
@@ -141,7 +146,12 @@ def measure() -> dict:
         return rows
 
     def pinned_legs(cfg: StorageConfig) -> dict:
-        eng = asyncio.run(build(cfg))
+        # the INGEST leg rides the build: the fixed bulk-write shape's
+        # append/seal/flush_encode counts and the encode alloc bytes
+        # (the ingest-path half of the zero-copy spine's pin)
+        with scanstats.scan_stats() as st:
+            eng = asyncio.run(build(cfg))
+        ingest = memtrace.verdict(st.mem)
         try:
             with scanstats.scan_stats() as st:
                 rows_cold = asyncio.run(scan(eng))
@@ -153,7 +163,7 @@ def measure() -> dict:
             asyncio.run(eng.close())
         return {
             "rows": rows_cold, "rows_warm": rows_warm,
-            "cold": cold, "warm": warm,
+            "cold": cold, "warm": warm, "ingest": ingest,
         }
 
     prior = memtrace.mode()
@@ -233,13 +243,16 @@ def main() -> int:
     check(a["rows"] > 0, "config-2 scan returned zero rows")
     check(a["rows"] == a["rows_warm"],
           f"warm scan row drift: {a['rows']} vs {a['rows_warm']}")
-    for leg in ("cold", "warm"):
+    for leg in ("cold", "warm", "ingest"):
         check(set(a[leg]) == set(memtrace.VERDICT_KEYS),
               f"{leg} verdict schema drift: {sorted(a[leg])}")
         check(counts_of(a[leg]) == counts_of(b[leg]),
               f"{leg} scan counts are nondeterministic across two "
               f"identical builds — pinning is impossible:\n"
               f"  a={counts_of(a[leg])}\n  b={counts_of(b[leg])}")
+    enc_bytes = int(
+        a["ingest"]["per_stage"].get("flush_encode", {})
+        .get("alloc_bytes", 0))
     measured = {
         "shape": {
             "n_rows": N_ROWS, "n_series": N_SERIES, "inset": INSET,
@@ -247,6 +260,10 @@ def main() -> int:
         },
         "cold": counts_of(a["cold"]),
         "warm": counts_of(a["warm"]),
+        "ingest": {
+            "counts": counts_of(a["ingest"]),
+            "flush_encode_alloc_b_per_row": round(enc_bytes / N_ROWS, 2),
+        },
     }
 
     if pin and not failures:
@@ -282,6 +299,42 @@ def main() -> int:
               f"  pinned:   {json.dumps(want, sort_keys=True)}\n"
               f"  measured: {json.dumps(got, sort_keys=True)}")
 
+    # ingest leg: event counts pin exactly (both directions, like the
+    # scan legs); the flush-encode alloc density pins with a small
+    # tolerance (encoder version skew) under a HARD ceiling — r19's
+    # plain-encoding 12.7 B/row is the number the zero-copy spine +
+    # type-driven column encodings must stay strictly below
+    want_ing = baseline.get("ingest")
+    got_ing = measured["ingest"]
+    if want_ing is None and baseline:
+        check(False, "baseline missing the ingest leg — re-pin with "
+                     "`python tools/mem_smoke.py --pin`")
+    elif want_ing is not None:
+        if got_ing["counts"] != want_ing["counts"]:
+            worse = (got_ing["counts"]["allocs"]
+                     > want_ing["counts"]["allocs"]
+                     or got_ing["counts"]["copies"]
+                     > want_ing["counts"]["copies"])
+            verdict_word = ("REGRESSION" if worse else
+                            "improvement — re-pin with "
+                            "`python tools/mem_smoke.py --pin`")
+            check(False,
+                  f"ingest counts drifted off the pinned baseline "
+                  f"({verdict_word}):\n"
+                  f"  pinned:   "
+                  f"{json.dumps(want_ing['counts'], sort_keys=True)}\n"
+                  f"  measured: "
+                  f"{json.dumps(got_ing['counts'], sort_keys=True)}")
+        drift = abs(got_ing["flush_encode_alloc_b_per_row"]
+                    - want_ing["flush_encode_alloc_b_per_row"])
+        check(drift <= 0.3,
+              f"flush_encode alloc density drifted "
+              f"{got_ing['flush_encode_alloc_b_per_row']} B/row vs pinned "
+              f"{want_ing['flush_encode_alloc_b_per_row']} (tol 0.3)")
+    check(got_ing["flush_encode_alloc_b_per_row"] < 12.7,
+          f"flush_encode allocs {got_ing['flush_encode_alloc_b_per_row']} "
+          f"B/row — at or above the r19 plain-encoding 12.7 B/row bar")
+
     # memtrace's own cost: the micro bound is tight (a dict upsert),
     # the e2e bound is the CI-safe envelope around the <2% target
     check(m["micro_ns_on"] < 5_000,
@@ -305,7 +358,10 @@ def main() -> int:
         f"allocs={measured['cold']['allocs']} "
         f"copies={measured['cold']['copies']} "
         f"views={measured['cold']['views']}, warm "
-        f"copies={measured['warm']['copies']}; track "
+        f"copies={measured['warm']['copies']}; ingest "
+        f"flush_encode "
+        f"{measured['ingest']['flush_encode_alloc_b_per_row']} B/row; "
+        f"track "
         f"{m['micro_ns_on']:.0f} ns/event on / "
         f"{m['micro_ns_off']:.0f} ns off; scan overhead "
         f"{m['overhead_pct']}% (target <2%)"
